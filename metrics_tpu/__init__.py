@@ -1,0 +1,27 @@
+"""metrics_tpu — TPU-native streaming, distributed-aware evaluation metrics.
+
+A ground-up JAX/XLA rebuild of the capability surface of TorchMetrics
+(reference: GeeklurnAI/metrics @ v0.8.0dev): streaming metrics with pytree
+state, jitted updates, and cross-device synchronization lowered to XLA
+collectives over mesh axes.
+"""
+import logging
+
+__version__ = "0.1.0"
+
+logging.getLogger("metrics_tpu").addHandler(logging.NullHandler())
+
+from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402,F401
+from metrics_tpu.collections import MetricCollection  # noqa: E402,F401
+from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402,F401
+
+__all__ = [
+    "CatMetric",
+    "CompositionalMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "Metric",
+    "MetricCollection",
+    "MinMetric",
+    "SumMetric",
+]

@@ -1,0 +1,167 @@
+"""Streaming scalar aggregation metrics with NaN policy.
+
+Parity: reference ``torchmetrics/aggregation.py`` (``BaseAggregator`` :24 with
+``_cast_and_nan_check_input`` :83-101; ``MaxMetric`` :112, ``MinMetric`` :177,
+``SumMetric`` :242, ``CatMetric`` :300, ``MeanMetric`` :363).
+
+TPU note: the value-inspecting NaN strategies (``"error"``/``"warn"``) and the
+shape-changing ``"ignore"`` are data-dependent, so instances using them run
+their update eagerly (the engine's automatic jit fallback). The extra strategy
+``"disable"`` skips NaN handling entirely and keeps the update a static jitted
+program — the recommended setting for hot TPU loops when inputs are known
+finite.
+"""
+from typing import Any, Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Base for aggregation metrics (reference ``aggregation.py:24``)."""
+
+    is_differentiable = None
+    higher_is_better = None
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore", "disable")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, (float, int)):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} "
+                f"but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.add_state("value", default=default_value, dist_reduce_fx=fn)
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array]) -> Array:
+        """Cast to float and apply the NaN policy (reference ``aggregation.py:83``)."""
+        x = jnp.asarray(x, dtype=jnp.float32) if not isinstance(x, (jax.Array, jnp.ndarray)) else x
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(jnp.float32)
+        if self.nan_strategy == "disable":
+            return x
+        nans = jnp.isnan(x)
+        if bool(jnp.any(nans)):  # concretization point: falls back to eager under jit
+            if self.nan_strategy == "error":
+                raise RuntimeError("Encountered `nan` values in tensor")
+            if self.nan_strategy == "warn":
+                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+                x = x[~nans]
+            elif self.nan_strategy == "ignore":
+                x = x[~nans]
+            else:
+                x = jnp.where(nans, jnp.asarray(float(self.nan_strategy), dtype=x.dtype), x)
+        return x
+
+    def update(self, value: Union[float, Array]) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return self.value
+
+
+class MaxMetric(BaseAggregator):
+    """Running max (reference ``aggregation.py:112``)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:  # make sure empty-after-nan-removal doesn't error
+            self.value = jnp.maximum(self.value, jnp.max(value))
+
+
+class MinMetric(BaseAggregator):
+    """Running min (reference ``aggregation.py:177``)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = jnp.minimum(self.value, jnp.min(value))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum (reference ``aggregation.py:242``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = self.value + jnp.sum(value)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values (reference ``aggregation.py:300``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (reference ``aggregation.py:363``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        # broadcast weight to value shape FIRST, then apply the NaN policy
+        # jointly — filtering them independently would mispair (or crash on
+        # shape mismatch) whenever NaN removal changes the length
+        value = jnp.asarray(value, dtype=jnp.float32) if not isinstance(value, (jax.Array, jnp.ndarray)) else value
+        if not jnp.issubdtype(value.dtype, jnp.floating):
+            value = value.astype(jnp.float32)
+        weight = jnp.broadcast_to(jnp.asarray(weight, dtype=value.dtype), value.shape)
+        if self.nan_strategy != "disable":
+            nans = jnp.isnan(value) | jnp.isnan(weight)
+            if bool(jnp.any(nans)):
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encountered `nan` values in tensor")
+                if self.nan_strategy == "warn":
+                    rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+                if self.nan_strategy in ("warn", "ignore"):
+                    value, weight = value[~nans], weight[~nans]
+                else:
+                    fill = jnp.asarray(float(self.nan_strategy), dtype=value.dtype)
+                    value = jnp.where(jnp.isnan(value), fill, value)
+                    weight = jnp.where(jnp.isnan(weight), fill, weight)
+        if value.size == 0:
+            return
+        self.value = self.value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        return self.value / self.weight

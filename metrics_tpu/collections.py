@@ -1,0 +1,182 @@
+"""``MetricCollection`` — dict of metrics with a single lifecycle.
+
+Parity: reference ``torchmetrics/collections.py:28-237`` (there an
+``nn.ModuleDict`` subclass; here a plain ordered container — JAX has no module
+registry to hook into, and metric states are already self-managed pytrees).
+"""
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class MetricCollection:
+    """A dict-like collection of metrics sharing one ``update``/``forward``/
+    ``compute``/``reset`` call, with per-member kwarg routing and prefix/postfix
+    renaming (reference ``collections.py:28``).
+
+    Args:
+        metrics: one metric, a list/tuple of metrics, or a dict name->metric.
+        additional_metrics: more metrics appended to a single/sequence input.
+        prefix: string prepended to all result keys.
+        postfix: string appended to all result keys.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+    ) -> None:
+        self._modules: "OrderedDict[str, Metric]" = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self.add_metrics(metrics, *additional_metrics)
+
+    # -- lifecycle ------------------------------------------------------
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Call every member's ``forward`` (reference ``collections.py:106-112``)."""
+        return {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=False)}
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        for _, m in self.items(keep_base=True):
+            m_kwargs = m._filter_kwargs(**kwargs)
+            m.update(*args, **m_kwargs)
+
+    def compute(self) -> Dict[str, Any]:
+        return {k: m.compute() for k, m in self.items(keep_base=False)}
+
+    def reset(self) -> None:
+        for _, m in self.items(keep_base=True):
+            m.reset()
+
+    def persistent(self, mode: bool = True) -> None:
+        for _, m in self.items(keep_base=True):
+            m.persistent(mode)
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        """Deep copy, optionally re-keyed (reference ``collections.py:138``)."""
+        mc = MetricCollection({k: m.clone() for k, m in self._modules.items()})
+        mc.prefix = self._check_arg(prefix, "prefix") if prefix is not None else self.prefix
+        mc.postfix = self._check_arg(postfix, "postfix") if postfix is not None else self.postfix
+        return mc
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, m in self._modules.items():
+            out.update(m.state_dict(prefix=f"{k}."))
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        for k, m in self._modules.items():
+            m.load_state_dict(state_dict, prefix=f"{k}.", strict=strict)
+
+    def to_device(self, device: Any) -> "MetricCollection":
+        for _, m in self.items(keep_base=True):
+            m.to_device(device)
+        return self
+
+    def astype(self, dtype: Any) -> "MetricCollection":
+        for _, m in self.items(keep_base=True):
+            m.astype(dtype)
+        return self
+
+    # -- membership -----------------------------------------------------
+    def add_metrics(self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric) -> None:
+        """Register members (reference ``collections.py:151-194``): lists key by
+        class name (duplicates forbidden), dicts keep user keys in sorted order."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                rank_zero_warn(
+                    f"You have passes extra arguments {remain} which are not Metrics and will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                " with mapping input."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of `Metric` or `MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if isinstance(metric, MetricCollection):
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[k] = v
+                    continue
+                if not isinstance(metric, Metric):
+                    raise ValueError(f"Input {metric} to `MetricCollection` is not a instance of `Metric`")
+                name = metric.__class__.__name__
+                if name in self._modules:
+                    raise ValueError(f"Encountered two metrics both named {name}")
+                self._modules[name] = metric
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    # -- mapping protocol ----------------------------------------------
+    def items(self, keep_base: bool = False) -> Iterable[Tuple[str, Metric]]:
+        if keep_base:
+            return self._modules.items()
+        return [(self._set_name(k), v) for k, v in self._modules.items()]
+
+    def keys(self, keep_base: bool = False) -> Iterable[str]:
+        if keep_base:
+            return self._modules.keys()
+        return [self._set_name(k) for k in self._modules.keys()]
+
+    def values(self) -> Iterable[Metric]:
+        return self._modules.values()
+
+    def __getitem__(self, key: str) -> Metric:
+        return self._modules[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._modules)
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._modules
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "(\n"
+        for k, v in self._modules.items():
+            repr_str += f"  ({k}): {repr(v)}\n"
+        if self.prefix:
+            repr_str += f"  prefix={self.prefix}\n"
+        if self.postfix:
+            repr_str += f"  postfix={self.postfix}\n"
+        return repr_str + ")"

@@ -1,0 +1,8 @@
+from metrics_tpu.parallel import comm  # noqa: F401
+from metrics_tpu.parallel.comm import (  # noqa: F401
+    class_reduce,
+    distributed_available,
+    gather_all_arrays,
+    reduce,
+    sync_state_in_trace,
+)

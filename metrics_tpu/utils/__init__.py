@@ -1,0 +1,13 @@
+from metrics_tpu.utils.checks import _check_same_shape, _input_format_classification  # noqa: F401
+from metrics_tpu.utils.data import (  # noqa: F401
+    apply_to_collection,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from metrics_tpu.utils.prints import rank_zero_debug, rank_zero_info, rank_zero_warn  # noqa: F401

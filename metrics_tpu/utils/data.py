@@ -1,0 +1,162 @@
+"""Array utilities: dim-0 reductions, one-hot, top-k, pytree/collection map.
+
+Parity: reference ``torchmetrics/utilities/data.py`` (``dim_zero_cat`` :24,
+``to_onehot`` :57, ``select_topk`` :91, ``to_categorical`` :117,
+``apply_to_collection`` :166, ``get_group_indexes`` :216). All kernels here are
+pure jnp programs (jit-safe, static shapes) except the explicitly host-side
+helpers, which are documented as such.
+"""
+from collections.abc import Mapping, Sequence
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def is_tracing(*xs: Any) -> bool:
+    """True if any input is an abstract tracer (we are inside jit/vmap/scan)."""
+    return any(isinstance(x, jax.core.Tracer) for x in jax.tree_util.tree_leaves(list(xs)))
+
+
+def _flatten(x: Sequence[Any]) -> List[Any]:
+    return [item for sublist in x for item in sublist]
+
+
+def dim_zero_cat(x: Union[Array, List[Array], Tuple[Array, ...]]) -> Array:
+    """Concatenate a (possibly nested) list of arrays along dim 0.
+
+    Scalars are promoted to shape ``(1,)`` first, mirroring the reference's
+    ``x.unsqueeze(0)`` handling of 0-d entries.
+    """
+    if isinstance(x, (jax.Array, jnp.ndarray)) and not isinstance(x, (list, tuple)):
+        return x
+    x = [xi for xi in x]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    x = [jnp.asarray(xi) for xi in x]
+    x = [xi[None] if xi.ndim == 0 else xi for xi in x]
+    if len(x) == 1:
+        return x[0]
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(dim_zero_cat(x), axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(dim_zero_cat(x), axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(dim_zero_cat(x), axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(dim_zero_cat(x), axis=0)
+
+
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Convert integer labels ``(N, ...)`` to one-hot ``(N, C, ...)``.
+
+    Parity: reference ``utilities/data.py:57``. Implemented with
+    ``jax.nn.one_hot`` + moveaxis so the class axis lands at dim 1 as the
+    reference's scatter does.
+    """
+    if num_classes is None:
+        num_classes = int(jnp.max(label_tensor)) + 1
+    oh = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    # one_hot appends C last; the reference puts it at dim 1.
+    return jnp.moveaxis(oh, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binarize by top-k along ``dim`` (reference ``utilities/data.py:91``).
+
+    Keeps the reference's k=1 argmax fast-path (``data.py:110-111``), which on
+    TPU also avoids the sort inside ``lax.top_k``.
+    """
+    if topk == 1:  # argmax fast-path
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        zeros = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        topk_tensor = jnp.put_along_axis(zeros, idx, 1, axis=dim, inplace=False)
+    else:
+        moved = jnp.moveaxis(prob_tensor, dim, -1)
+        _, idx = jax.lax.top_k(moved, topk)
+        zeros = jnp.zeros_like(moved, dtype=jnp.int32)
+        scattered = jnp.put_along_axis(zeros, idx, 1, axis=-1, inplace=False)
+        topk_tensor = jnp.moveaxis(scattered, -1, dim)
+    return topk_tensor.astype(jnp.int32)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/one-hot to integer labels (reference ``data.py:117``)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    wrong_dtype: Optional[Union[type, tuple]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all ``dtype`` elements of a collection.
+
+    Parity: reference ``utilities/data.py:166``.
+    """
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+    if isinstance(data, Mapping):
+        return type(data)(
+            {k: apply_to_collection(v, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for k, v in data.items()}
+        )
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return type(data)(*(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data))
+    if isinstance(data, Sequence) and not isinstance(data, str):
+        return type(data)(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data)
+    return data
+
+
+def get_group_indexes(indexes: Array) -> List[Array]:
+    """Group positions by value (reference ``data.py:216``).
+
+    Host-side helper (Python dict loop over concrete values) retained for API
+    parity; retrieval metrics prefer the jit-friendly sort + segment-reduce
+    formulation in the retrieval functional package over this loop.
+    """
+    import numpy as np
+
+    structure: dict = {}
+    for i, index in enumerate(np.asarray(indexes).tolist()):
+        if index in structure:
+            structure[index].append(i)
+        else:
+            structure[index] = [i]
+    return [jnp.asarray(x, dtype=jnp.int32) for x in structure.values()]
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    """Squeeze 1-element arrays to 0-d (reference ``data.py:247``)."""
+
+    def _sq(x: Array) -> Array:
+        return jnp.squeeze(x) if getattr(x, "size", None) == 1 else x
+
+    return apply_to_collection(data, (jax.Array, jnp.ndarray), _sq)
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Static-length bincount (jit-safe; reference uses ``torch.bincount``)."""
+    return jnp.bincount(x.reshape(-1), length=minlength)
+
+
+def _cumsum(x: Array, axis: int = 0) -> Array:
+    return jnp.cumsum(x, axis=axis)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Bincount with data-derived length — host-side only (not jit-safe)."""
+    return jnp.bincount(x.reshape(-1), length=int(jnp.max(x)) + 1)

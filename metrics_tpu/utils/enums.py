@@ -1,0 +1,52 @@
+"""Case-insensitive string enums.
+
+Parity: reference ``torchmetrics/utilities/enums.py:18-84``.
+"""
+from enum import Enum
+from typing import Optional, Union
+
+
+class EnumStr(str, Enum):
+    """String enum whose ``from_str`` lookup is case- and separator-insensitive."""
+
+    @classmethod
+    def from_str(cls, value: str) -> Optional["EnumStr"]:
+        try:
+            return cls[value.replace("-", "_").upper()]
+        except KeyError:
+            return None
+
+    def __eq__(self, other: Union[str, "EnumStr", None]) -> bool:  # type: ignore[override]
+        if other is None:
+            return False
+        other = other.value if isinstance(other, Enum) else str(other)
+        return self.value.lower() == other.lower()
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())
+
+
+class DataType(EnumStr):
+    """Classification input case (reference ``utilities/enums.py:48``)."""
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Score averaging method (reference ``utilities/enums.py:61``)."""
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = None  # type: ignore[assignment]
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Multi-dim multi-class averaging (reference ``utilities/enums.py:78``)."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"

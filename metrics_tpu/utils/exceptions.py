@@ -1,0 +1,5 @@
+"""Exception types (reference ``torchmetrics/utilities/exceptions.py``)."""
+
+
+class MetricsUserError(Exception):
+    """Error raised by misuse of the metrics API by the user."""

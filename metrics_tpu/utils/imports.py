@@ -1,0 +1,38 @@
+"""Optional-dependency availability flags.
+
+Parity: reference ``torchmetrics/utilities/imports.py:95-120``. The reference
+gates features on wheels like ``transformers``, ``torch-fidelity``, ``pesq``;
+our equivalents gate on what is baked into the TPU image.
+"""
+import importlib.util
+from typing import Optional
+
+
+def _package_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+def _module_available(module_path: str) -> bool:
+    """Check if a path-qualified module (``a.b.c``) is importable."""
+    try:
+        parts = module_path.split(".")
+        for i in range(len(parts)):
+            if not _package_available(".".join(parts[: i + 1])):
+                return False
+        return True
+    except Exception:
+        return False
+
+
+_NUMPY_AVAILABLE = _package_available("numpy")
+_SCIPY_AVAILABLE = _package_available("scipy")
+_SKLEARN_AVAILABLE = _package_available("sklearn")
+_TRANSFORMERS_AVAILABLE = _package_available("transformers")
+_FLAX_AVAILABLE = _package_available("flax")
+_TORCH_AVAILABLE = _package_available("torch")
+_ORBAX_AVAILABLE = _package_available("orbax")
+_NLTK_AVAILABLE = _package_available("nltk")
+_REGEX_AVAILABLE = _package_available("regex")

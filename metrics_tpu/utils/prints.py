@@ -1,0 +1,54 @@
+"""Rank-zero gated printing/warnings.
+
+Parity: reference ``torchmetrics/utilities/prints.py:22-49`` — there the rank
+comes from the ``LOCAL_RANK`` env var; here it is ``jax.process_index()`` (with
+an env-var fallback so host-only code paths work before JAX distributed init).
+"""
+import logging
+import os
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("metrics_tpu")
+
+
+def _rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("JAX_PROCESS_INDEX", os.environ.get("LOCAL_RANK", 0)))
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process 0 of a multi-process job."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if _rank() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def _warn(*args: Any, **kwargs: Any) -> None:
+    warnings.warn(*args, **kwargs)
+
+
+@rank_zero_only
+def _info(*args: Any, **kwargs: Any) -> None:
+    log.info(*args, **kwargs)
+
+
+@rank_zero_only
+def _debug(*args: Any, **kwargs: Any) -> None:
+    log.debug(*args, **kwargs)
+
+
+rank_zero_warn = partial(_warn)
+rank_zero_info = partial(_info)
+rank_zero_debug = partial(_debug)

@@ -1,0 +1,72 @@
+"""Aggregation metric tests (mirrors reference ``tests/bases/test_aggregation.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from tests.helpers import seed_all
+
+seed_all(42)
+
+
+@pytest.mark.parametrize(
+    "metric_cls, np_fn",
+    [(MaxMetric, np.max), (MinMetric, np.min), (SumMetric, np.sum), (MeanMetric, np.mean)],
+)
+@pytest.mark.parametrize("shape", [(), (5,), (2, 3)])
+def test_aggregation_parity(metric_cls, np_fn, shape):
+    values = [np.asarray(np.random.randn(*shape), dtype=np.float32) for _ in range(10)]
+    metric = metric_cls()
+    for v in values:
+        metric.update(jnp.asarray(v))
+    expected = np_fn(np.concatenate([v.reshape(-1) for v in values]))
+    np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-5)
+
+
+def test_cat_metric():
+    metric = CatMetric()
+    metric.update(jnp.asarray([1.0, 2.0]))
+    metric.update(jnp.asarray([3.0]))
+    np.testing.assert_allclose(np.asarray(metric.compute()), [1.0, 2.0, 3.0])
+
+
+def test_mean_metric_weighted():
+    metric = MeanMetric()
+    metric.update(jnp.asarray([1.0, 2.0]), weight=jnp.asarray([0.5, 1.5]))
+    metric.update(jnp.asarray(3.0), weight=2.0)
+    expected = (1.0 * 0.5 + 2.0 * 1.5 + 3.0 * 2.0) / (0.5 + 1.5 + 2.0)
+    np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("metric_cls", [MaxMetric, MinMetric, SumMetric, MeanMetric, CatMetric])
+def test_nan_error(metric_cls):
+    metric = metric_cls(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="Encountered `nan` values"):
+        metric.update(jnp.asarray([1.0, float("nan")]))
+
+
+@pytest.mark.parametrize(
+    "nan_strategy, expected_sum",
+    [("ignore", 4.0), (0.0, 4.0), (2.0, 6.0)],
+)
+def test_nan_handling_sum(nan_strategy, expected_sum):
+    metric = SumMetric(nan_strategy=nan_strategy)
+    metric.update(jnp.asarray([1.0, float("nan"), 3.0]))
+    np.testing.assert_allclose(np.asarray(metric.compute()), expected_sum)
+
+
+def test_nan_disable_is_jittable():
+    metric = SumMetric(nan_strategy="disable")
+    metric.update(jnp.asarray([1.0, 2.0]))
+    metric.update(jnp.asarray([3.0, 4.0]))
+    assert not metric._jit_failed
+    np.testing.assert_allclose(np.asarray(metric.compute()), 10.0)
+
+
+def test_aggregation_forward_batch_value():
+    metric = SumMetric()
+    batch_val = metric(jnp.asarray([1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(batch_val), 3.0)
+    batch_val = metric(jnp.asarray([5.0]))
+    np.testing.assert_allclose(np.asarray(batch_val), 5.0)
+    np.testing.assert_allclose(np.asarray(metric.compute()), 8.0)

@@ -1,0 +1,93 @@
+"""MetricCollection tests (mirrors reference ``tests/bases/test_collections.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MetricCollection
+from tests.helpers import seed_all
+from tests.helpers.testers import DummyMetricDiff, DummyMetricSum
+
+seed_all(42)
+
+
+def test_metric_collection_list():
+    mc = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+    assert set(mc.keys()) == {"DummyMetricSum", "DummyMetricDiff"}
+    mc.update(5.0)  # routed to both (both signatures take one positional)
+    vals = mc.compute()
+    np.testing.assert_allclose(np.asarray(vals["DummyMetricSum"]), 5.0)
+    np.testing.assert_allclose(np.asarray(vals["DummyMetricDiff"]), -5.0)
+
+
+def test_metric_collection_dict():
+    mc = MetricCollection({"s": DummyMetricSum(), "d": DummyMetricDiff()})
+    mc.update(2.0)
+    vals = mc.compute()
+    assert set(vals) == {"s", "d"}
+
+
+def test_metric_collection_kwarg_filtering():
+    mc = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+    mc.update(x=5.0, y=3.0)  # Sum takes x, Diff takes y
+    vals = mc.compute()
+    np.testing.assert_allclose(np.asarray(vals["DummyMetricSum"]), 5.0)
+    np.testing.assert_allclose(np.asarray(vals["DummyMetricDiff"]), -3.0)
+
+
+def test_metric_collection_prefix_postfix():
+    mc = MetricCollection([DummyMetricSum()], prefix="train_", postfix="_metric")
+    assert list(mc.keys()) == ["train_DummyMetricSum_metric"]
+    mc.update(1.0)
+    assert list(mc.compute().keys()) == ["train_DummyMetricSum_metric"]
+
+
+def test_metric_collection_clone():
+    mc = MetricCollection([DummyMetricSum()])
+    mc2 = mc.clone(prefix="val_")
+    mc.update(1.0)
+    mc2.update(10.0)
+    np.testing.assert_allclose(np.asarray(mc.compute()["DummyMetricSum"]), 1.0)
+    np.testing.assert_allclose(np.asarray(mc2.compute()["val_DummyMetricSum"]), 10.0)
+
+
+def test_metric_collection_reset():
+    mc = MetricCollection([DummyMetricSum()])
+    mc.update(5.0)
+    mc.reset()
+    np.testing.assert_allclose(np.asarray(mc.compute()["DummyMetricSum"]), 0.0)
+
+
+def test_metric_collection_forward():
+    mc = MetricCollection([DummyMetricSum()])
+    out = mc(5.0)
+    np.testing.assert_allclose(np.asarray(out["DummyMetricSum"]), 5.0)
+    out = mc(3.0)
+    np.testing.assert_allclose(np.asarray(out["DummyMetricSum"]), 3.0)
+    np.testing.assert_allclose(np.asarray(mc.compute()["DummyMetricSum"]), 8.0)
+
+
+def test_error_on_duplicate_names():
+    with pytest.raises(ValueError, match="Encountered two metrics both named"):
+        MetricCollection([DummyMetricSum(), DummyMetricSum()])
+
+
+def test_error_on_wrong_input():
+    with pytest.raises(ValueError, match="is not a instance of"):
+        MetricCollection([1, 2, 3])
+
+
+def test_collection_state_dict_roundtrip():
+    mc = MetricCollection([DummyMetricSum()])
+    mc.persistent(True)
+    mc.update(7.0)
+    sd = mc.state_dict()
+    mc2 = MetricCollection([DummyMetricSum()])
+    mc2.persistent(True)
+    mc2.load_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(mc2.compute()["DummyMetricSum"]), 7.0)
+
+
+def test_nested_collection():
+    inner = MetricCollection([DummyMetricSum()])
+    outer = MetricCollection({"inner": inner, "other": DummyMetricDiff()})
+    assert "inner_DummyMetricSum" in outer._modules

