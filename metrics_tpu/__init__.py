@@ -12,16 +12,42 @@ __version__ = "0.1.0"
 logging.getLogger("metrics_tpu").addHandler(logging.NullHandler())
 
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402,F401
+from metrics_tpu.classification import (  # noqa: E402,F401
+    Accuracy,
+    CohenKappa,
+    ConfusionMatrix,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+)
 from metrics_tpu.collections import MetricCollection  # noqa: E402,F401
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402,F401
 
 __all__ = [
+    "Accuracy",
     "CatMetric",
+    "CohenKappa",
     "CompositionalMetric",
+    "ConfusionMatrix",
+    "F1Score",
+    "FBetaScore",
+    "HammingDistance",
+    "JaccardIndex",
+    "MatthewsCorrCoef",
     "MaxMetric",
     "MeanMetric",
     "Metric",
     "MetricCollection",
     "MinMetric",
+    "Precision",
+    "Recall",
+    "Specificity",
+    "StatScores",
     "SumMetric",
 ]

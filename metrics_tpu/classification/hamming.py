@@ -1,0 +1,35 @@
+"""HammingDistance module metric.
+
+Parity: reference ``torchmetrics/classification/hamming.py:24``.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hamming import _hamming_distance_compute, _hamming_distance_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class HammingDistance(Metric):
+    """Average Hamming distance/loss between targets and predictions
+    (reference ``classification/hamming.py:24``)."""
+
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(self, threshold: float = 0.5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("correct", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.threshold = threshold
+
+    def update(self, preds: Array, target: Array) -> None:
+        correct, total = _hamming_distance_update(preds, target, self.threshold)
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hamming_distance_compute(self.correct, self.total)

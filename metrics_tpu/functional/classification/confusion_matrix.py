@@ -1,0 +1,91 @@
+"""Confusion matrix functional kernel.
+
+Parity: reference ``torchmetrics/functional/classification/confusion_matrix.py``
+(``_confusion_matrix_update`` :24 — bincount over fused index,
+``_confusion_matrix_compute`` :56, ``confusion_matrix`` :114). The bincount
+uses a static ``length`` so the whole update jits.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.data import _bincount
+from metrics_tpu.utils.enums import DataType
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _confusion_matrix_update(
+    preds: Array, target: Array, num_classes: int, threshold: float = 0.5, multilabel: bool = False
+) -> Array:
+    """Unnormalized confusion matrix (reference ``confusion_matrix.py:24``).
+
+    Shapes: ``[C, C]``, or ``[C, 2, 2]`` when ``multilabel=True``.
+    """
+    import jax.numpy as _jnp
+
+    preds = _jnp.asarray(preds)
+    target = _jnp.asarray(target)
+    # forward num_classes for integer-label inputs so the formatter never needs
+    # a data-dependent max() — keeps the whole update jittable. Float preds
+    # (probabilities) must NOT get num_classes: the formatter's binary/
+    # multilabel checks reject it, and it can infer C from the shape anyway.
+    fmt_num_classes = (
+        num_classes if (not _jnp.issubdtype(preds.dtype, _jnp.floating) and preds.ndim == target.ndim) else None
+    )
+    preds, target, mode = _input_format_classification(preds, target, threshold, num_classes=fmt_num_classes)
+    if mode not in (DataType.BINARY, DataType.MULTILABEL):
+        preds = jnp.argmax(preds, axis=1)
+        target = jnp.argmax(target, axis=1)
+    if multilabel:
+        unique_mapping = ((2 * target + preds) + 4 * jnp.arange(num_classes)).reshape(-1)
+        minlength = 4 * num_classes
+    else:
+        unique_mapping = (target.reshape(-1) * num_classes + preds.reshape(-1)).astype(jnp.int32)
+        minlength = num_classes**2
+
+    bins = _bincount(unique_mapping, minlength)
+    if multilabel:
+        return bins.reshape(num_classes, 2, 2)
+    return bins.reshape(num_classes, num_classes)
+
+
+def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    """Apply normalization (reference ``confusion_matrix.py:56``)."""
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32) if not jnp.issubdtype(confmat.dtype, jnp.floating) else confmat
+        if normalize == "true":
+            confmat = confmat / jnp.sum(confmat, axis=1, keepdims=True)
+        elif normalize == "pred":
+            confmat = confmat / jnp.sum(confmat, axis=0, keepdims=True)
+        elif normalize == "all":
+            confmat = confmat / jnp.sum(confmat)
+        nan_mask = jnp.isnan(confmat)
+        from metrics_tpu.utils.data import is_tracing
+
+        if not is_tracing(confmat) and bool(jnp.any(nan_mask)):
+            rank_zero_warn(
+                f"{int(jnp.sum(nan_mask))} nan values found in confusion matrix have been replaced with zeros."
+            )
+        confmat = jnp.where(nan_mask, 0.0, confmat)
+    return confmat
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+) -> Array:
+    """Confusion matrix for binary/multiclass/multilabel inputs
+    (reference ``confusion_matrix.py:114``)."""
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel)
+    return _confusion_matrix_compute(confmat, normalize)

@@ -1,0 +1,139 @@
+"""F-beta / F1 functional kernels.
+
+Parity: reference ``torchmetrics/functional/classification/f_beta.py``
+(``_safe_divide`` :26, ``_fbeta_compute`` :32, ``fbeta_score`` :113,
+``f1_score`` :274). The reference's in-place masking is expressed with
+``jnp.where`` so the kernel jits.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """Division that treats 0/0 as 0 (reference ``f_beta.py:26``)."""
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    return num / denom
+
+
+def _fbeta_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    """Reference ``f_beta.py:32-110``."""
+    if average == AverageMethod.MICRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        mask = tp >= 0
+        precision = _safe_divide(
+            jnp.sum(jnp.where(mask, tp, 0)).astype(jnp.float32),
+            jnp.sum(jnp.where(mask, tp + fp, 0)).astype(jnp.float32),
+        )
+        recall = _safe_divide(
+            jnp.sum(jnp.where(mask, tp, 0)).astype(jnp.float32),
+            jnp.sum(jnp.where(mask, tp + fn, 0)).astype(jnp.float32),
+        )
+    else:
+        precision = _safe_divide(tp.astype(jnp.float32), (tp + fp).astype(jnp.float32))
+        recall = _safe_divide(tp.astype(jnp.float32), (tp + fn).astype(jnp.float32))
+
+    num = (1 + beta**2) * precision * recall
+    denom = beta**2 * precision + recall
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+
+    # classes absent from preds and target are meaningless and ignored
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        meaningless = (tp | fn | fp) == 0
+        if ignore_index is not None:
+            meaningless = meaningless | (jnp.arange(meaningless.shape[-1]) == ignore_index)
+        num = jnp.where(meaningless, -1, num)
+        denom = jnp.where(meaningless, -1, denom)
+    elif ignore_index is not None and average not in (AverageMethod.MICRO, AverageMethod.SAMPLES):
+        idx_mask = jnp.arange(num.shape[-1] if mdmc_average == MDMCAverageMethod.SAMPLEWISE else num.shape[0]) == ignore_index
+        if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+            num = jnp.where(idx_mask[None, :] if num.ndim > 1 else idx_mask, -1, num)
+            denom = jnp.where(idx_mask[None, :] if denom.ndim > 1 else idx_mask, -1, denom)
+        else:
+            shape = [1] * num.ndim
+            shape[0] = -1
+            num = jnp.where(idx_mask.reshape(shape), -1, num)
+            denom = jnp.where(idx_mask.reshape(shape), -1, denom)
+
+    if average == AverageMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = ((tp + fp + fn) == 0) | ((tp + fp + fn) == -3)
+        num = jnp.where(cond, -1, num)
+        denom = jnp.where(cond, -1, denom)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def fbeta_score(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F-beta score (reference ``f_beta.py:113-246``)."""
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1_score(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F1 = F-beta with beta=1 (reference ``f_beta.py:274``)."""
+    return fbeta_score(
+        preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass
+    )

@@ -1,0 +1,33 @@
+"""Hamming distance functional kernel.
+
+Parity: reference ``torchmetrics/functional/classification/hamming.py``
+(``_hamming_distance_update`` :22, ``_hamming_distance_compute`` :44,
+``hamming_distance`` :62).
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+
+Array = jax.Array
+
+
+def _hamming_distance_update(preds: Array, target: Array, threshold: float = 0.5) -> Tuple[Array, int]:
+    """Reference ``hamming.py:22``."""
+    preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
+    correct = jnp.sum(preds == target)
+    total = preds.size
+    return correct, total
+
+
+def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array:
+    """Reference ``hamming.py:44``."""
+    return 1 - correct.astype(jnp.float32) / total
+
+
+def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
+    """Fraction of wrong labels over all labels (reference ``hamming.py:62``)."""
+    correct, total = _hamming_distance_update(preds, target, threshold)
+    return _hamming_distance_compute(correct, total)

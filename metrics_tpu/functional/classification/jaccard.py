@@ -1,0 +1,53 @@
+"""Jaccard index (IoU) functional kernel.
+
+Parity: reference ``torchmetrics/functional/classification/jaccard.py``
+(``_jaccard_from_confmat`` :24, ``jaccard_index`` :69). The ignore_index
+row-zeroing and class-drop use static indices, so the kernel jits.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+from metrics_tpu.parallel.comm import reduce
+
+Array = jax.Array
+
+
+def _jaccard_from_confmat(
+    confmat: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Per-class intersection-over-union from a confusion matrix
+    (reference ``jaccard.py:24``)."""
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        confmat = confmat.at[ignore_index].set(0.0)
+
+    intersection = jnp.diag(confmat)
+    union = jnp.sum(confmat, axis=0) + jnp.sum(confmat, axis=1) - intersection
+
+    scores = intersection.astype(jnp.float32) / jnp.where(union == 0, 1.0, union.astype(jnp.float32))
+    scores = jnp.where(union == 0, absent_score, scores)
+
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        scores = jnp.concatenate([scores[:ignore_index], scores[ignore_index + 1 :]])
+
+    return reduce(scores, reduction=reduction)
+
+
+def jaccard_index(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    threshold: float = 0.5,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Jaccard index |A∩B| / |A∪B| (reference ``jaccard.py:69``)."""
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
+    return _jaccard_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

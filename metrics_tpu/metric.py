@@ -46,7 +46,7 @@ import numpy as np
 
 from metrics_tpu.parallel import comm
 from metrics_tpu.utils.data import _squeeze_if_scalar, apply_to_collection, dim_zero_cat
-from metrics_tpu.utils.exceptions import MetricsUserError
+from metrics_tpu.utils.exceptions import JitIncompatibleError, MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
@@ -56,6 +56,7 @@ _JIT_FALLBACK_ERRORS = (
     jax.errors.TracerArrayConversionError,
     jax.errors.TracerBoolConversionError,
     jax.errors.TracerIntegerConversionError,
+    JitIncompatibleError,
     NotImplementedError,
     TypeError,
 )

@@ -250,7 +250,9 @@ def _input_format_classification(
         else:
             if num_classes is None:
                 if is_tracing(preds, target):
-                    raise ValueError(
+                    from metrics_tpu.utils.exceptions import JitIncompatibleError
+
+                    raise JitIncompatibleError(
                         "Cannot infer `num_classes` from label values under jit tracing; "
                         "pass `num_classes` explicitly."
                     )

@@ -18,7 +18,9 @@ class EnumStr(str, Enum):
 
     def __eq__(self, other: Union[str, "EnumStr", None]) -> bool:  # type: ignore[override]
         if other is None:
-            return False
+            # `average=None` must match AverageMethod.NONE (whose str value is
+            # "None"), mirroring the reference's `AverageMethod.NONE == None`
+            return self.value == "None"
         other = other.value if isinstance(other, Enum) else str(other)
         return self.value.lower() == other.lower()
 

@@ -3,3 +3,12 @@
 
 class MetricsUserError(Exception):
     """Error raised by misuse of the metrics API by the user."""
+
+
+class JitIncompatibleError(ValueError):
+    """Raised when an operation is inherently data-dependent and cannot run
+    under jit tracing (e.g. inferring ``num_classes`` from label values).
+
+    The ``Metric`` engine treats this as a signal to fall back to eager
+    execution; user code calling the pure API under its own ``jax.jit`` sees
+    it as an actionable error."""

@@ -1,0 +1,71 @@
+"""Deterministic classification input fixtures.
+
+Mirrors reference ``tests/classification/inputs.py:20-80`` — named bundles of
+``[NUM_BATCHES, BATCH_SIZE, ...]`` preds/target for each input case.
+"""
+from collections import namedtuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+seed_all(1)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+
+def _rand(*shape):
+    return jnp.asarray(np.random.rand(*shape).astype(np.float32))
+
+
+def _randint(high, *shape):
+    return jnp.asarray(np.random.randint(0, high, shape), dtype=jnp.int32)
+
+
+_input_binary_prob = Input(preds=_rand(NUM_BATCHES, BATCH_SIZE), target=_randint(2, NUM_BATCHES, BATCH_SIZE))
+
+_input_binary = Input(preds=_randint(2, NUM_BATCHES, BATCH_SIZE), target=_randint(2, NUM_BATCHES, BATCH_SIZE))
+
+_input_multilabel_prob = Input(
+    preds=_rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+    target=_randint(2, NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+)
+
+_input_multilabel = Input(
+    preds=_randint(2, NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+    target=_randint(2, NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+)
+
+_input_multilabel_multidim_prob = Input(
+    preds=_rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM),
+    target=_randint(2, NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM),
+)
+
+# edge case: multilabel with no matches
+__temp_preds = _randint(2, NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)
+_input_multilabel_no_match = Input(preds=__temp_preds, target=1 - __temp_preds)
+
+__mc_prob_preds = np.random.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+__mc_prob_preds = __mc_prob_preds / __mc_prob_preds.sum(axis=2, keepdims=True)
+_input_multiclass_prob = Input(
+    preds=jnp.asarray(__mc_prob_preds), target=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE)
+)
+
+_input_multiclass = Input(
+    preds=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE),
+    target=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE),
+)
+
+__mdmc_prob_preds = np.random.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM).astype(np.float32)
+__mdmc_prob_preds = __mdmc_prob_preds / __mdmc_prob_preds.sum(axis=2, keepdims=True)
+_input_multidim_multiclass_prob = Input(
+    preds=jnp.asarray(__mdmc_prob_preds),
+    target=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE, EXTRA_DIM),
+)
+
+_input_multidim_multiclass = Input(
+    preds=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE, EXTRA_DIM),
+    target=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE, EXTRA_DIM),
+)

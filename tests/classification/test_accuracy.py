@@ -1,0 +1,109 @@
+"""Accuracy tests vs sklearn (mirrors reference ``tests/classification/test_accuracy.py``)."""
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from metrics_tpu import Accuracy
+from metrics_tpu.functional import accuracy
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_accuracy(preds, target, subset_accuracy=False):
+    """Canonicalize via our input formatter, then sklearn — the reference's own
+    oracle scheme (``tests/classification/test_accuracy.py:44-57``)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.utils.checks import _input_format_classification
+    from metrics_tpu.utils.enums import DataType
+
+    sk_preds, sk_target, mode = _input_format_classification(
+        jnp.asarray(preds), jnp.asarray(target), threshold=THRESHOLD
+    )
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+
+    if mode == DataType.MULTIDIM_MULTICLASS and not subset_accuracy:
+        sk_preds, sk_target = np.transpose(sk_preds, (0, 2, 1)), np.transpose(sk_target, (0, 2, 1))
+        sk_preds, sk_target = sk_preds.reshape(-1, sk_preds.shape[2]), sk_target.reshape(-1, sk_target.shape[2])
+    elif mode == DataType.MULTIDIM_MULTICLASS and subset_accuracy:
+        return np.all(sk_preds == sk_target, axis=(1, 2)).mean()
+    elif mode == DataType.MULTILABEL and not subset_accuracy:
+        sk_preds, sk_target = sk_preds.reshape(-1), sk_target.reshape(-1)
+
+    return sk_accuracy(y_true=sk_target, y_pred=sk_preds)
+
+
+@pytest.mark.parametrize(
+    "preds, target, subset_accuracy",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, False),
+        (_input_binary.preds, _input_binary.target, False),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, True),
+        (_input_multilabel.preds, _input_multilabel.target, True),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, False),
+        (_input_multiclass.preds, _input_multiclass.target, False),
+        (_input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target, False),
+        (_input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target, True),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, False),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, True),
+    ],
+)
+@pytest.mark.parametrize("ddp", [False, True])
+class TestAccuracy(MetricTester):
+    def test_accuracy_class(self, ddp, preds, target, subset_accuracy):
+        def sk_fn(p, t):
+            return _sk_accuracy(p, t, subset_accuracy)
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=sk_fn,
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy, "num_classes": None},
+        )
+
+    def test_accuracy_fn(self, ddp, preds, target, subset_accuracy):
+        if ddp:
+            pytest.skip("functional test runs once")
+
+        def sk_fn(p, t):
+            return _sk_accuracy(p, t, subset_accuracy)
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=accuracy,
+            sk_metric=sk_fn,
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+        )
+
+
+def test_accuracy_topk():
+    """top-k accuracy on multiclass probabilities (reference ``test_accuracy.py`` top-k cases)."""
+    import jax.numpy as jnp
+
+    preds = jnp.asarray(
+        [[0.35, 0.4, 0.25], [0.1, 0.5, 0.4], [0.2, 0.1, 0.7], [0.35, 0.4, 0.25], [0.1, 0.5, 0.4], [0.2, 0.1, 0.7]]
+    )
+    target = jnp.asarray([0, 0, 0, 1, 1, 1])
+    acc = Accuracy(top_k=2)
+    np.testing.assert_allclose(np.asarray(acc(preds, target)), 4 / 6, atol=1e-6)
+
+
+def test_error_on_mismatched_mode():
+    import jax.numpy as jnp
+
+    acc = Accuracy()
+    acc.update(jnp.asarray([0.1, 0.9]), jnp.asarray([0, 1]))  # binary
+    with pytest.raises(ValueError, match="inputs with"):
+        acc.update(jnp.asarray([[0.1, 0.9], [0.8, 0.2]]), jnp.asarray([0, 1]))  # multiclass

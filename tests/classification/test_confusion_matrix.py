@@ -1,0 +1,199 @@
+"""ConfusionMatrix/CohenKappa/MatthewsCorrCoef/JaccardIndex/HammingDistance/StatScores
+tests vs sklearn (mirrors the reference's per-metric test files)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import cohen_kappa_score as sk_cohen_kappa
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import hamming_loss as sk_hamming_loss
+from sklearn.metrics import jaccard_score as sk_jaccard
+from sklearn.metrics import matthews_corrcoef as sk_matthews
+from sklearn.metrics import multilabel_confusion_matrix
+
+from metrics_tpu import (
+    CohenKappa,
+    ConfusionMatrix,
+    HammingDistance,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    StatScores,
+)
+from metrics_tpu.functional import (
+    cohen_kappa,
+    confusion_matrix,
+    hamming_distance,
+    jaccard_index,
+    matthews_corrcoef,
+    stat_scores,
+)
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _canon(preds, target, binary_as=1):
+    preds, target = np.asarray(preds), np.asarray(target)
+    if preds.ndim == target.ndim and np.issubdtype(preds.dtype, np.floating):
+        preds = (preds >= THRESHOLD).astype(int)
+    elif preds.ndim == target.ndim + 1:
+        preds = np.argmax(preds, axis=1)
+    return preds, target
+
+
+def _sk_confmat(preds, target, num_classes, normalize=None):
+    p, t = _canon(preds, target)
+    return sk_confusion_matrix(t, p, labels=list(range(num_classes)), normalize=normalize)
+
+
+def _sk_kappa(preds, target, weights=None):
+    p, t = _canon(preds, target)
+    return sk_cohen_kappa(t, p, weights=weights)
+
+
+def _sk_mcc(preds, target):
+    p, t = _canon(preds, target)
+    return sk_matthews(t, p)
+
+
+def _sk_jaccard_fn(preds, target, num_classes):
+    p, t = _canon(preds, target)
+    return sk_jaccard(t, p, average="macro", labels=list(range(num_classes)), zero_division=0)
+
+
+def _sk_hamming(preds, target):
+    p, t = _canon(preds, target)
+    return sk_hamming_loss(t.reshape(-1), p.reshape(-1))
+
+
+def _sk_stat_scores_macro(preds, target):
+    p, t = _canon(preds, target)
+    mcm = multilabel_confusion_matrix(t, p, labels=list(range(NUM_CLASSES)))
+    tn, fp, fn, tp = mcm[:, 0, 0], mcm[:, 0, 1], mcm[:, 1, 0], mcm[:, 1, 1]
+    return np.stack([tp, fp, tn, fn, tp + fn], axis=1)
+
+
+_MC_CASES = [
+    (_input_multiclass.preds, _input_multiclass.target, 2),
+    (_input_multiclass_prob.preds, _input_multiclass_prob.target, 2),
+    (_input_binary_prob.preds, _input_binary_prob.target, 2),
+]
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+class TestConfmatFamily(MetricTester):
+    @pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+    def test_confusion_matrix(self, ddp, normalize):
+        preds, target = _input_multiclass_prob.preds, _input_multiclass_prob.target
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=ConfusionMatrix,
+            sk_metric=partial(_sk_confmat, num_classes=NUM_CLASSES, normalize=normalize),
+            metric_args={"num_classes": NUM_CLASSES, "normalize": normalize},
+            check_batch=(normalize is None),  # normalized batch values lose additivity for merge-check
+        )
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_cohen_kappa(self, ddp, weights):
+        preds, target = _input_multiclass_prob.preds, _input_multiclass_prob.target
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=CohenKappa,
+            sk_metric=partial(_sk_kappa, weights=weights),
+            metric_args={"num_classes": NUM_CLASSES, "weights": weights},
+        )
+
+    def test_matthews(self, ddp):
+        preds, target = _input_multiclass_prob.preds, _input_multiclass_prob.target
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=MatthewsCorrCoef,
+            sk_metric=_sk_mcc,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_jaccard(self, ddp):
+        preds, target = _input_multiclass_prob.preds, _input_multiclass_prob.target
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=JaccardIndex,
+            sk_metric=partial(_sk_jaccard_fn, num_classes=NUM_CLASSES),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_hamming(self, ddp):
+        preds, target = _input_multilabel_prob.preds, _input_multilabel_prob.target
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=HammingDistance,
+            sk_metric=_sk_hamming,
+            metric_args={"threshold": THRESHOLD},
+        )
+
+    def test_stat_scores_macro(self, ddp):
+        preds, target = _input_multiclass_prob.preds, _input_multiclass_prob.target
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=StatScores,
+            sk_metric=_sk_stat_scores_macro,
+            metric_args={"reduce": "macro", "num_classes": NUM_CLASSES},
+        )
+
+
+def test_functional_parity():
+    preds, target = _input_multiclass_prob.preds[0], _input_multiclass_prob.target[0]
+    np.testing.assert_allclose(
+        np.asarray(confusion_matrix(preds, target, num_classes=NUM_CLASSES)),
+        _sk_confmat(preds, target, NUM_CLASSES),
+    )
+    np.testing.assert_allclose(np.asarray(cohen_kappa(preds, target, num_classes=NUM_CLASSES)), _sk_kappa(preds, target), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(matthews_corrcoef(preds, target, num_classes=NUM_CLASSES)), _sk_mcc(preds, target), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(jaccard_index(preds, target, num_classes=NUM_CLASSES)), _sk_jaccard_fn(preds, target, NUM_CLASSES), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(stat_scores(preds, target, reduce="macro", num_classes=NUM_CLASSES)),
+        _sk_stat_scores_macro(preds, target),
+    )
+    ml_preds, ml_target = _input_multilabel_prob.preds[0], _input_multilabel_prob.target[0]
+    np.testing.assert_allclose(np.asarray(hamming_distance(ml_preds, ml_target)), _sk_hamming(ml_preds, ml_target), atol=1e-6)
+
+
+def test_multilabel_confmat():
+    preds, target = _input_multilabel_prob.preds[0], _input_multilabel_prob.target[0]
+    res = confusion_matrix(preds, target, num_classes=NUM_CLASSES, multilabel=True)
+    p, t = _canon(preds, target)
+    sk = multilabel_confusion_matrix(t, p)
+    np.testing.assert_allclose(np.asarray(res), sk)
+
+
+def test_confusion_matrix_jits_with_int_labels():
+    """Regression: int-label inputs with explicit num_classes must stay
+    jittable (num_classes forwarded to the formatter)."""
+    import jax
+    import jax.numpy as jnp
+
+    preds = jnp.asarray([0, 1, 2, 2])
+    target = jnp.asarray([0, 1, 1, 2])
+    res = jax.jit(lambda p, t: confusion_matrix(p, t, num_classes=3))(preds, target)
+    np.testing.assert_allclose(np.asarray(res), sk_confusion_matrix(np.asarray(target), np.asarray(preds), labels=[0, 1, 2]))
+    # module path keeps the auto-jit alive
+    cm = ConfusionMatrix(num_classes=3)
+    cm.update(preds, target)
+    assert not cm._jit_failed

@@ -1,0 +1,199 @@
+"""Precision/Recall/Specificity/FBeta/F1 tests vs sklearn
+(mirrors reference ``tests/classification/test_precision_recall.py`` and
+``test_specificity.py``/``test_f_beta.py``)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score as sk_fbeta
+from sklearn.metrics import multilabel_confusion_matrix
+from sklearn.metrics import precision_score as sk_precision
+from sklearn.metrics import recall_score as sk_recall
+
+from metrics_tpu import F1Score, FBetaScore, Precision, Recall, Specificity
+from metrics_tpu.functional import f1_score, fbeta_score, precision, recall, specificity
+from tests.classification.inputs import _input_binary_prob, _input_multiclass, _input_multiclass_prob
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _canon(preds, target):
+    """binary prob -> labels; multiclass prob -> argmax; labels pass through."""
+    preds, target = np.asarray(preds), np.asarray(target)
+    if preds.ndim == target.ndim and np.issubdtype(preds.dtype, np.floating):
+        preds = (preds >= THRESHOLD).astype(int)
+    elif preds.ndim == target.ndim + 1:
+        preds = np.argmax(preds, axis=1)
+    return preds, target
+
+
+def _sk_prec(preds, target, average="micro"):
+    p, t = _canon(preds, target)
+    if p.max() <= 1 and t.max() <= 1 and average == "micro":
+        return sk_precision(t, p, average="binary", zero_division=0)
+    return sk_precision(t, p, average=average, labels=list(range(NUM_CLASSES)), zero_division=0)
+
+
+def _sk_rec(preds, target, average="micro"):
+    p, t = _canon(preds, target)
+    if p.max() <= 1 and t.max() <= 1 and average == "micro":
+        return sk_recall(t, p, average="binary", zero_division=0)
+    return sk_recall(t, p, average=average, labels=list(range(NUM_CLASSES)), zero_division=0)
+
+
+def _sk_fbeta_fn(preds, target, average="micro", beta=1.0):
+    p, t = _canon(preds, target)
+    if p.max() <= 1 and t.max() <= 1 and average == "micro":
+        return sk_fbeta(t, p, beta=beta, average="binary", zero_division=0)
+    return sk_fbeta(t, p, beta=beta, average=average, labels=list(range(NUM_CLASSES)), zero_division=0)
+
+
+def _sk_specificity(preds, target, average="micro"):
+    p, t = _canon(preds, target)
+    labels = [1] if (p.max() <= 1 and t.max() <= 1 and average == "micro") else list(range(NUM_CLASSES))
+    mcm = multilabel_confusion_matrix(t, p, labels=labels)
+    tn, fp = mcm[:, 0, 0], mcm[:, 0, 1]
+    if average == "micro":
+        return tn.sum() / (tn.sum() + fp.sum())
+    scores = tn / np.where((tn + fp) == 0, 1, tn + fp)
+    if average == "macro":
+        return scores.mean()
+    if average == "weighted":
+        # the reference weights specificity by tn+fp, not support
+        # (``functional/classification/specificity.py:62``)
+        w = tn + fp
+        return (scores * w / w.sum()).sum()
+    return scores
+
+
+_CASES = [
+    (_input_binary_prob.preds, _input_binary_prob.target, 1, "micro"),
+    (_input_multiclass.preds, _input_multiclass.target, NUM_CLASSES, "micro"),
+    (_input_multiclass.preds, _input_multiclass.target, NUM_CLASSES, "macro"),
+    (_input_multiclass.preds, _input_multiclass.target, NUM_CLASSES, "weighted"),
+    (_input_multiclass_prob.preds, _input_multiclass_prob.target, NUM_CLASSES, "micro"),
+    (_input_multiclass_prob.preds, _input_multiclass_prob.target, NUM_CLASSES, "macro"),
+]
+
+
+@pytest.mark.parametrize("preds, target, num_classes, average", _CASES)
+@pytest.mark.parametrize("ddp", [False, True])
+class TestPrecisionRecall(MetricTester):
+    def test_precision(self, ddp, preds, target, num_classes, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Precision,
+            sk_metric=partial(_sk_prec, average=average),
+            metric_args={"num_classes": num_classes, "average": average, "threshold": THRESHOLD},
+        )
+
+    def test_recall(self, ddp, preds, target, num_classes, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Recall,
+            sk_metric=partial(_sk_rec, average=average),
+            metric_args={"num_classes": num_classes, "average": average, "threshold": THRESHOLD},
+        )
+
+    def test_specificity(self, ddp, preds, target, num_classes, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Specificity,
+            sk_metric=partial(_sk_specificity, average=average),
+            metric_args={"num_classes": num_classes, "average": average, "threshold": THRESHOLD},
+        )
+
+    def test_f1(self, ddp, preds, target, num_classes, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=F1Score,
+            sk_metric=partial(_sk_fbeta_fn, average=average, beta=1.0),
+            metric_args={"num_classes": num_classes, "average": average, "threshold": THRESHOLD},
+        )
+
+    def test_fbeta(self, ddp, preds, target, num_classes, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=FBetaScore,
+            sk_metric=partial(_sk_fbeta_fn, average=average, beta=0.5),
+            metric_args={"num_classes": num_classes, "average": average, "beta": 0.5, "threshold": THRESHOLD},
+        )
+
+
+@pytest.mark.parametrize(
+    "fn, sk_fn",
+    [
+        (precision, _sk_prec),
+        (recall, _sk_rec),
+        (specificity, _sk_specificity),
+        (f1_score, _sk_fbeta_fn),
+    ],
+)
+def test_functional_multiclass_macro(fn, sk_fn):
+    MetricTester().run_functional_metric_test(
+        _input_multiclass.preds,
+        _input_multiclass.target,
+        metric_functional=fn,
+        sk_metric=partial(sk_fn, average="macro"),
+        metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+    )
+
+
+def test_precision_recall_joint():
+    from metrics_tpu.functional import precision_recall
+
+    p, r = precision_recall(
+        _input_multiclass.preds[0], _input_multiclass.target[0], num_classes=NUM_CLASSES, average="macro"
+    )
+    np.testing.assert_allclose(
+        np.asarray(p), _sk_prec(_input_multiclass.preds[0], _input_multiclass.target[0], "macro"), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(r), _sk_rec(_input_multiclass.preds[0], _input_multiclass.target[0], "macro"), atol=1e-6
+    )
+
+
+def test_average_none_returns_per_class():
+    from metrics_tpu.functional import precision as prec_fn
+
+    res = prec_fn(_input_multiclass.preds[0], _input_multiclass.target[0], num_classes=NUM_CLASSES, average="none")
+    assert res.shape == (NUM_CLASSES,)
+    sk = _sk_prec(_input_multiclass.preds[0], _input_multiclass.target[0], None)
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+
+
+def test_f1_micro_ignore_index_matches_reference_semantics():
+    """Regression: ignore_index must be honored for average='micro'
+    (the ignored class column is dropped before counting)."""
+    import jax.numpy as jnp
+
+    preds = jnp.asarray([0, 0, 1, 2, 2])
+    target = jnp.asarray([0, 1, 1, 2, 0])
+    res = f1_score(preds, target, average="micro", num_classes=3, ignore_index=0)
+    np.testing.assert_allclose(np.asarray(res), 2 / 3, atol=1e-6)
+    # module and functional must agree
+    m = F1Score(average="micro", num_classes=3, ignore_index=0)
+    m.update(preds, target)
+    np.testing.assert_allclose(np.asarray(m.compute()), 2 / 3, atol=1e-6)
+
+
+def test_average_none_alias_matches_none_string():
+    """Regression: average=None and average='none' must behave identically
+    (absent classes -> nan)."""
+    import jax.numpy as jnp
+
+    preds = jnp.asarray([0, 1, 0, 1])
+    target = jnp.asarray([0, 1, 1, 0])
+    res_str = precision(preds, target, average="none", num_classes=3)
+    res_none = precision(preds, target, average=None, num_classes=3)
+    np.testing.assert_allclose(np.asarray(res_str), np.asarray(res_none), equal_nan=True)
+    assert np.isnan(np.asarray(res_none)[2])  # absent class
