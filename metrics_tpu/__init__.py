@@ -13,15 +13,26 @@ logging.getLogger("metrics_tpu").addHandler(logging.NullHandler())
 
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402,F401
 from metrics_tpu.classification import (  # noqa: E402,F401
+    AUC,
+    AUROC,
     Accuracy,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    CalibrationError,
     CohenKappa,
     ConfusionMatrix,
     F1Score,
     FBetaScore,
     HammingDistance,
+    HingeLoss,
     JaccardIndex,
+    KLDivergence,
     MatthewsCorrCoef,
     Precision,
+    PrecisionRecallCurve,
+    ROC,
     Recall,
     Specificity,
     StatScores,
@@ -30,7 +41,14 @@ from metrics_tpu.collections import MetricCollection  # noqa: E402,F401
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402,F401
 
 __all__ = [
+    "AUC",
+    "AUROC",
     "Accuracy",
+    "AveragePrecision",
+    "BinnedAveragePrecision",
+    "BinnedPrecisionRecallCurve",
+    "BinnedRecallAtFixedPrecision",
+    "CalibrationError",
     "CatMetric",
     "CohenKappa",
     "CompositionalMetric",
@@ -38,7 +56,9 @@ __all__ = [
     "F1Score",
     "FBetaScore",
     "HammingDistance",
+    "HingeLoss",
     "JaccardIndex",
+    "KLDivergence",
     "MatthewsCorrCoef",
     "MaxMetric",
     "MeanMetric",
@@ -46,6 +66,8 @@ __all__ = [
     "MetricCollection",
     "MinMetric",
     "Precision",
+    "PrecisionRecallCurve",
+    "ROC",
     "Recall",
     "Specificity",
     "StatScores",

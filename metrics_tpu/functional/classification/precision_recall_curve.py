@@ -1,0 +1,169 @@
+"""Precision-recall curve functional kernels.
+
+Parity: reference
+``torchmetrics/functional/classification/precision_recall_curve.py``
+(``_binary_clf_curve`` :23 — sort desc, dedup thresholds, cumsum tps;
+``_precision_recall_curve_update`` :64, ``_precision_recall_curve_compute_*``
+:124/:160, ``precision_recall_curve`` :231).
+
+**TPU note:** the exact curve has a *data-dependent* number of thresholds
+(dedup of tied scores), so these kernels are host/eager-side by design — the
+known XLA hazard called out in SURVEY.md §7. The jittable streaming
+alternative is the binned formulation
+(``metrics_tpu/classification/binned_precision_recall.py``).
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    sample_weights: Optional[Sequence] = None,
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """fps/tps/thresholds at each distinct score (reference ``precision_recall_curve.py:23``)."""
+    if sample_weights is not None and not isinstance(sample_weights, (jax.Array, jnp.ndarray)):
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    desc_score_indices = jnp.argsort(-preds, stable=True)
+
+    preds = preds[desc_score_indices]
+    target = target[desc_score_indices]
+
+    weight = sample_weights[desc_score_indices] if sample_weights is not None else 1.0
+
+    # indices of distinct score values (+ curve endpoint)
+    distinct_value_indices = jnp.nonzero(preds[1:] - preds[:-1])[0]
+    threshold_idxs = jnp.pad(distinct_value_indices, (0, 1), constant_values=target.shape[0] - 1)
+    target = (target == pos_label).astype(jnp.int32)
+    tps = jnp.cumsum(target * weight, axis=0)[threshold_idxs]
+
+    if sample_weights is not None:
+        fps = jnp.cumsum((1 - target) * weight, axis=0)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+
+    return fps, tps, preds[threshold_idxs]
+
+
+def _precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[Array, Array, int, Optional[int]]:
+    """Normalize inputs for curve computation (reference ``precision_recall_curve.py:64``)."""
+    if preds.ndim == target.ndim:
+        if pos_label is None:
+            pos_label = 1
+        if num_classes is not None and num_classes != 1:
+            # multilabel
+            if num_classes != preds.shape[1]:
+                raise ValueError(
+                    f"Argument `num_classes` was set to {num_classes} in"
+                    f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                    " number of classes from predictions"
+                )
+            preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).swapaxes(0, 1)
+            target = jnp.swapaxes(target, 0, 1).reshape(num_classes, -1).swapaxes(0, 1)
+        else:
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+            num_classes = 1
+    elif preds.ndim == target.ndim + 1:
+        if pos_label is not None:
+            rank_zero_warn(
+                "Argument `pos_label` should be `None` when running"
+                f" multiclass precision recall curve. Got {pos_label}"
+            )
+        if num_classes != preds.shape[1]:
+            raise ValueError(
+                f"Argument `num_classes` was set to {num_classes} in"
+                f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                " number of classes from predictions"
+            )
+        preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).swapaxes(0, 1)
+        target = target.reshape(-1)
+    else:
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+
+    return preds, target, num_classes, pos_label
+
+
+def _precision_recall_curve_compute_single_class(
+    preds: Array,
+    target: Array,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[Array, Array, Array]:
+    """Reference ``precision_recall_curve.py:124``."""
+    fps, tps, thresholds = _binary_clf_curve(preds, target, sample_weights, pos_label)
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
+
+    # stop when full recall attained; reverse so recall is decreasing
+    last_ind = int(jnp.nonzero(tps == tps[-1])[0][0])
+    sl = slice(0, last_ind + 1)
+
+    precision = jnp.concatenate([precision[sl][::-1], jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([recall[sl][::-1], jnp.zeros(1, dtype=recall.dtype)])
+    thresholds = thresholds[sl][::-1]
+
+    return precision, recall, thresholds
+
+
+def _precision_recall_curve_compute_multi_class(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[List[Array], List[Array], List[Array]]:
+    """Per-class recursion (reference ``precision_recall_curve.py:160``)."""
+    precision, recall, thresholds = [], [], []
+    for cls in range(num_classes):
+        preds_cls = preds[:, cls]
+        prc_args = dict(preds=preds_cls, target=target, num_classes=1, pos_label=cls, sample_weights=sample_weights)
+        if target.ndim > 1:
+            prc_args.update(dict(target=target[:, cls], pos_label=1))
+        res = precision_recall_curve(**prc_args)
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds.append(res[2])
+    return precision, recall, thresholds
+
+
+def _precision_recall_curve_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Reference ``precision_recall_curve.py:202``."""
+    if num_classes == 1 and preds.ndim == 1:
+        if pos_label is None:
+            pos_label = 1
+        return _precision_recall_curve_compute_single_class(preds, target, pos_label, sample_weights)
+    return _precision_recall_curve_compute_multi_class(preds, target, num_classes, sample_weights)
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Precision-recall pairs at all distinct thresholds
+    (reference ``precision_recall_curve.py:231``)."""
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -14,6 +14,8 @@ import jax.numpy as jnp
 
 Array = jax.Array
 
+METRIC_EPS = 1e-6  # reference ``torchmetrics/utilities/data.py`` METRIC_EPS
+
 
 def is_tracing(*xs: Any) -> bool:
     """True if any input is an abstract tracer (we are inside jit/vmap/scan)."""

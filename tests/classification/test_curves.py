@@ -1,0 +1,281 @@
+"""Curve metric tests vs sklearn: ROC/PRC/AUROC/AUC/AveragePrecision + binned
+variants + CalibrationError/HingeLoss/KLDivergence (mirrors the reference's
+``tests/classification/test_{roc,precision_recall_curve,auroc,auc,average_precision,
+binned_precision_recall,calibration_error,hinge,kl_divergence}.py``)."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_average_precision
+from sklearn.metrics import hinge_loss as sk_hinge_loss
+from sklearn.metrics import precision_recall_curve as sk_precision_recall_curve
+from sklearn.metrics import roc_auc_score as sk_roc_auc
+from sklearn.metrics import roc_curve as sk_roc_curve
+
+from metrics_tpu import (
+    AUC,
+    AUROC,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    CalibrationError,
+    HingeLoss,
+    KLDivergence,
+    PrecisionRecallCurve,
+    ROC,
+)
+from metrics_tpu.functional import (
+    auc,
+    auroc,
+    average_precision,
+    calibration_error,
+    dice_score,
+    hinge_loss,
+    kl_divergence,
+    precision_recall_curve,
+    roc,
+)
+from tests.classification.inputs import _input_binary_prob, _input_multiclass_prob
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _sk_auroc_binary(preds, target):
+    return sk_roc_auc(np.asarray(target).reshape(-1), np.asarray(preds).reshape(-1))
+
+
+def _sk_auroc_multiclass(preds, target, average="macro"):
+    p = np.asarray(preds).reshape(-1, NUM_CLASSES)
+    t = np.asarray(target).reshape(-1)
+    return sk_roc_auc(t, p, multi_class="ovr", average=average, labels=list(range(NUM_CLASSES)))
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+class TestAUROC(MetricTester):
+    def test_auroc_binary(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_binary_prob.preds,
+            target=_input_binary_prob.target,
+            metric_class=AUROC,
+            sk_metric=_sk_auroc_binary,
+            check_batch=True,
+        )
+
+    def test_auroc_multiclass(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=AUROC,
+            sk_metric=partial(_sk_auroc_multiclass, average="macro"),
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+            check_batch=True,
+        )
+
+
+def test_auroc_functional_max_fpr():
+    preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+    res = auroc(preds, target, max_fpr=0.5)
+    sk = sk_roc_auc(np.asarray(target), np.asarray(preds), max_fpr=0.5)
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+
+
+def test_roc_binary_matches_sklearn():
+    preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+    fpr, tpr, thr = roc(preds, target)
+    sk_fpr, sk_tpr, sk_thr = sk_roc_curve(np.asarray(target), np.asarray(preds), drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+
+def test_roc_module_binary():
+    m = ROC()
+    for i in range(3):
+        m.update(_input_binary_prob.preds[i], _input_binary_prob.target[i])
+    fpr, tpr, thr = m.compute()
+    all_p = np.concatenate([np.asarray(_input_binary_prob.preds[i]) for i in range(3)])
+    all_t = np.concatenate([np.asarray(_input_binary_prob.target[i]) for i in range(3)])
+    sk_fpr, sk_tpr, _ = sk_roc_curve(all_t, all_p, drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+
+def _sk_prc_trimmed(t, p):
+    """sklearn PRC with the reference's stop-at-full-recall trim: modern
+    sklearn keeps every threshold, the reference keeps only the highest
+    threshold that attains recall==1 (``precision_recall_curve.py:146-150``)."""
+    sk_p, sk_r, sk_t = sk_precision_recall_curve(t, p, drop_intermediate=False)
+    m = int(np.argmax(sk_r < 1.0))  # first index with recall < 1 (recall is decreasing)
+    start = max(m - 1, 0)
+    return sk_p[start:], sk_r[start:], sk_t[start:]
+
+
+def test_precision_recall_curve_binary():
+    preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+    precision, recall, thresholds = precision_recall_curve(preds, target)
+    sk_p, sk_r, sk_t = _sk_prc_trimmed(np.asarray(target), np.asarray(preds))
+    np.testing.assert_allclose(np.asarray(precision), sk_p, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(recall), sk_r, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(thresholds), sk_t, atol=1e-6)
+
+
+def test_precision_recall_curve_module_multiclass():
+    m = PrecisionRecallCurve(num_classes=NUM_CLASSES)
+    for i in range(3):
+        m.update(_input_multiclass_prob.preds[i], _input_multiclass_prob.target[i])
+    precision, recall, thresholds = m.compute()
+    assert len(precision) == NUM_CLASSES
+    all_p = np.concatenate([np.asarray(_input_multiclass_prob.preds[i]) for i in range(3)])
+    all_t = np.concatenate([np.asarray(_input_multiclass_prob.target[i]) for i in range(3)])
+    for c in range(NUM_CLASSES):
+        sk_p, sk_r, _ = _sk_prc_trimmed(all_t == c, all_p[:, c])
+        np.testing.assert_allclose(np.asarray(precision[c]), sk_p, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(recall[c]), sk_r, atol=1e-6)
+
+
+def test_average_precision_binary():
+    preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+    res = average_precision(preds, target)
+    sk = sk_average_precision(np.asarray(target), np.asarray(preds))
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+    m = AveragePrecision()
+    m.update(preds, target)
+    np.testing.assert_allclose(np.asarray(m.compute()), sk, atol=1e-6)
+
+
+def test_average_precision_multiclass_macro():
+    preds, target = _input_multiclass_prob.preds[0], _input_multiclass_prob.target[0]
+    res = average_precision(preds, target, num_classes=NUM_CLASSES, average="macro")
+    t_onehot = np.eye(NUM_CLASSES)[np.asarray(target)]
+    sk = sk_average_precision(t_onehot, np.asarray(preds), average="macro")
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+
+
+def test_auc():
+    x = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+    y = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+    np.testing.assert_allclose(np.asarray(auc(x, y)), 4.0)
+    np.testing.assert_allclose(np.asarray(auc(x[::-1], y[::-1])), -4.0 * -1, atol=1e-6)  # decreasing direction
+    m = AUC()
+    m.update(x[:2], y[:2])
+    m.update(x[2:], y[2:])
+    np.testing.assert_allclose(np.asarray(m.compute()), 4.0)
+
+
+def test_binned_pr_curve_close_to_exact():
+    """Binned curve with fine thresholds approximates the exact AP."""
+    preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+    m = BinnedAveragePrecision(num_classes=1, thresholds=1001)
+    m.update(preds, target)
+    res = m.compute()
+    sk = sk_average_precision(np.asarray(target), np.asarray(preds))
+    np.testing.assert_allclose(np.asarray(res), sk, atol=0.01)
+
+
+def test_binned_pr_curve_is_jittable():
+    m = BinnedPrecisionRecallCurve(num_classes=1, thresholds=11)
+    m.update(_input_binary_prob.preds[0], _input_binary_prob.target[0])
+    assert not m._jit_failed
+    m.update(_input_binary_prob.preds[1], _input_binary_prob.target[1])
+    p, r, t = m.compute()
+    assert p.shape == (12,) and r.shape == (12,) and t.shape == (11,)
+
+
+def test_binned_reference_example():
+    """Reference doctest (``binned_precision_recall.py:76-88``)."""
+    pred = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+    target = jnp.asarray([0, 1, 1, 0])
+    pr_curve = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
+    precision, recall, thresholds = pr_curve(pred, target)
+    np.testing.assert_allclose(np.asarray(precision), [0.5, 0.5, 1.0, 1.0, 1.0, 1.0], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(recall), [1.0, 0.5, 0.5, 0.5, 0.0, 0.0], atol=1e-5)
+
+
+def test_calibration_error():
+    preds, target = _input_multiclass_prob.preds[0], _input_multiclass_prob.target[0]
+    for norm in ("l1", "l2", "max"):
+        res = calibration_error(preds, target, n_bins=15, norm=norm)
+        assert 0 <= float(res) <= 1
+    # reference-style histogram oracle for l1 (ECE)
+    p, t = np.asarray(preds), np.asarray(target)
+    conf, pred_cls = p.max(1), p.argmax(1)
+    acc = (pred_cls == t).astype(float)
+    bins = np.linspace(0, 1, 16)
+    ece = 0.0
+    for lo, hi in zip(bins[:-1], bins[1:]):
+        in_bin = (conf > lo) & (conf <= hi)
+        if in_bin.sum() > 0:
+            ece += abs(acc[in_bin].mean() - conf[in_bin].mean()) * in_bin.mean()
+    np.testing.assert_allclose(np.asarray(calibration_error(preds, target, norm="l1")), ece, atol=1e-6)
+    m = CalibrationError(n_bins=15, norm="l1")
+    m.update(preds, target)
+    np.testing.assert_allclose(np.asarray(m.compute()), ece, atol=1e-6)
+
+
+def test_hinge_binary_matches_sklearn():
+    preds = jnp.asarray([-2.2, 2.4, 0.1, -1.0])
+    target = jnp.asarray([0, 1, 1, 0])
+    res = hinge_loss(preds, target)
+    sk = sk_hinge_loss(np.asarray(target) * 2 - 1, np.asarray(preds))  # sklearn wants ±1 labels
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+    m = HingeLoss()
+    m.update(preds[:2], target[:2])
+    m.update(preds[2:], target[2:])
+    np.testing.assert_allclose(np.asarray(m.compute()), sk, atol=1e-6)
+    assert not m._jit_failed
+
+
+def test_hinge_multiclass_modes():
+    preds = _input_multiclass_prob.preds[0] * 4 - 2  # spread to logit-ish range
+    target = _input_multiclass_prob.target[0]
+    r1 = hinge_loss(preds, target)
+    r2 = hinge_loss(preds, target, multiclass_mode="one-vs-all")
+    assert float(r1) >= 0 and r2.shape == (NUM_CLASSES,)
+    sk = sk_hinge_loss(np.asarray(target), np.asarray(preds), labels=list(range(NUM_CLASSES)))
+    np.testing.assert_allclose(np.asarray(r1), sk, atol=1e-6)
+
+
+def test_kl_divergence():
+    from scipy.stats import entropy
+
+    p = jnp.asarray([[0.36, 0.48, 0.16], [0.2, 0.3, 0.5]])
+    q = jnp.asarray([[1 / 3, 1 / 3, 1 / 3], [0.5, 0.3, 0.2]])
+    res = kl_divergence(p, q)
+    sk = np.mean([entropy(np.asarray(p)[i], np.asarray(q)[i]) for i in range(2)])
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-5)
+    m = KLDivergence()
+    m.update(p, q)
+    np.testing.assert_allclose(np.asarray(m.compute()), sk, atol=1e-5)
+    assert not m._jit_failed
+
+
+def test_dice_score():
+    pred = jnp.asarray(
+        [[0.85, 0.05, 0.05, 0.05], [0.05, 0.85, 0.05, 0.05], [0.05, 0.05, 0.85, 0.05], [0.05, 0.05, 0.05, 0.85]]
+    )
+    target = jnp.asarray([0, 1, 3, 2])
+    np.testing.assert_allclose(np.asarray(dice_score(pred, target)), 0.3333, atol=1e-4)
+
+
+def test_recall_at_fixed_precision():
+    """Regression: lexicographic (recall, precision, threshold) tie-break —
+    on a recall plateau the HIGHEST qualifying threshold must be returned."""
+    from metrics_tpu import BinnedRecallAtFixedPrecision
+
+    pred = jnp.asarray([0.0, 0.2, 0.5, 0.8])
+    target = jnp.asarray([0, 1, 1, 0])
+    m = BinnedRecallAtFixedPrecision(num_classes=1, thresholds=10, min_precision=0.5)
+    r, t = m(pred, target)
+    np.testing.assert_allclose(np.asarray(r), 1.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(t), 0.11111, atol=1e-4)  # reference doctest values
+
+    # plateau case: recall ties must resolve to the higher threshold
+    from metrics_tpu.classification.binned_precision_recall import _recall_at_precision
+
+    precision = jnp.asarray([0.5, 0.9, 1.0])
+    recall = jnp.asarray([1.0, 1.0, 0.0])
+    thresholds = jnp.asarray([0.1, 0.6])
+    max_r, best_t = _recall_at_precision(precision, recall, thresholds, min_precision=0.4)
+    np.testing.assert_allclose(np.asarray(max_r), 1.0)
+    np.testing.assert_allclose(np.asarray(best_t), 0.6)
