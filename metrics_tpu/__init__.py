@@ -39,6 +39,19 @@ from metrics_tpu.classification import (  # noqa: E402,F401
 )
 from metrics_tpu.collections import MetricCollection  # noqa: E402,F401
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402,F401
+from metrics_tpu.regression import (  # noqa: E402,F401
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+)
 
 __all__ = [
     "AUC",
@@ -53,6 +66,8 @@ __all__ = [
     "CohenKappa",
     "CompositionalMetric",
     "ConfusionMatrix",
+    "CosineSimilarity",
+    "ExplainedVariance",
     "F1Score",
     "FBetaScore",
     "HammingDistance",
@@ -61,15 +76,24 @@ __all__ = [
     "KLDivergence",
     "MatthewsCorrCoef",
     "MaxMetric",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
     "MeanMetric",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
     "Metric",
     "MetricCollection",
     "MinMetric",
+    "PearsonCorrCoef",
     "Precision",
     "PrecisionRecallCurve",
+    "R2Score",
     "ROC",
     "Recall",
+    "SpearmanCorrCoef",
     "Specificity",
     "StatScores",
     "SumMetric",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
 ]

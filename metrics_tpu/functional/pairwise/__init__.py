@@ -1,0 +1,12 @@
+"""Pairwise metrics (parity: reference ``torchmetrics/functional/pairwise/``)."""
+from metrics_tpu.functional.pairwise.cosine import pairwise_cosine_similarity  # noqa: F401
+from metrics_tpu.functional.pairwise.euclidean import pairwise_euclidean_distance  # noqa: F401
+from metrics_tpu.functional.pairwise.linear import pairwise_linear_similarity  # noqa: F401
+from metrics_tpu.functional.pairwise.manhattan import pairwise_manhattan_distance  # noqa: F401
+
+__all__ = [
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhattan_distance",
+]

@@ -1,0 +1,31 @@
+"""Pairwise linear (dot-product) similarity.
+
+Parity: reference ``torchmetrics/functional/pairwise/linear.py``
+(``_pairwise_linear_similarity_update`` :21, ``pairwise_linear_similarity`` :40).
+"""
+from typing import Optional
+
+import jax
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_linear_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = x @ y.T
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_linear_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise dot-product similarity between rows of ``x`` (``[N,d]``) and ``y`` (``[M,d]``)."""
+    distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,34 @@
+"""Mean absolute percentage error kernel.
+
+Parity: reference ``torchmetrics/functional/regression/mape.py``
+(``_mean_absolute_percentage_error_update`` :22, ``..._compute`` :47,
+``mean_absolute_percentage_error`` :63). Epsilon matches sklearn's MAPE.
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_absolute_percentage_error_update(
+    preds: Array,
+    target: Array,
+    epsilon: float = 1.17e-06,
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Union[int, Array]) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Mean absolute percentage error."""
+    sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

@@ -1,0 +1,32 @@
+"""Mean squared error kernel.
+
+Parity: reference ``torchmetrics/functional/regression/mse.py``
+(``_mean_squared_error_update`` :22, ``_mean_squared_error_compute`` :36,
+``mean_squared_error`` :56).
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff)
+    return sum_squared_error, target.size
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, n_obs: Union[int, Array], squared: bool = True) -> Array:
+    mse = sum_squared_error / n_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """Mean squared error; RMSE when ``squared=False``."""
+    sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
+    return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)

@@ -1,0 +1,36 @@
+"""Symmetric mean absolute percentage error kernel.
+
+Parity: reference ``torchmetrics/functional/regression/symmetric_mape.py``
+(``_symmetric_mean_absolute_percentage_error_update`` :22, ``..._compute`` :49,
+``symmetric_mean_absolute_percentage_error`` :66).
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array,
+    target: Array,
+    epsilon: float = 1.17e-06,
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return 2 * jnp.sum(abs_per_error), target.size
+
+
+def _symmetric_mean_absolute_percentage_error_compute(
+    sum_abs_per_error: Array, num_obs: Union[int, Array]
+) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Symmetric mean absolute percentage error (``2*|y-ŷ| / (|y|+|ŷ|)`` averaged)."""
+    sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

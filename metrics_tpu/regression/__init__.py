@@ -1,0 +1,26 @@
+"""Regression module metrics (parity: reference ``torchmetrics/regression/``)."""
+from metrics_tpu.regression.cosine_similarity import CosineSimilarity  # noqa: F401
+from metrics_tpu.regression.explained_variance import ExplainedVariance  # noqa: F401
+from metrics_tpu.regression.log_mse import MeanSquaredLogError  # noqa: F401
+from metrics_tpu.regression.mae import MeanAbsoluteError  # noqa: F401
+from metrics_tpu.regression.mape import MeanAbsolutePercentageError  # noqa: F401
+from metrics_tpu.regression.mse import MeanSquaredError  # noqa: F401
+from metrics_tpu.regression.pearson import PearsonCorrCoef  # noqa: F401
+from metrics_tpu.regression.r2 import R2Score  # noqa: F401
+from metrics_tpu.regression.spearman import SpearmanCorrCoef  # noqa: F401
+from metrics_tpu.regression.symmetric_mape import SymmetricMeanAbsolutePercentageError  # noqa: F401
+from metrics_tpu.regression.tweedie_deviance import TweedieDevianceScore  # noqa: F401
+
+__all__ = [
+    "CosineSimilarity",
+    "ExplainedVariance",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "PearsonCorrCoef",
+    "R2Score",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+]

@@ -1,0 +1,55 @@
+"""PearsonCorrCoef module metric.
+
+Parity: reference ``torchmetrics/regression/pearson.py:57`` with the
+cross-replica ``_final_aggregation`` (:25-54) — here a vectorized raw-moment
+merge instead of a sequential Chan fold.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.pearson import (
+    _final_aggregation,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PearsonCorrCoef(Metric):
+    """Pearson correlation coefficient over a stream of 1D batches.
+
+    States are running moments with ``dist_reduce_fx=None``: sync *stacks* each
+    replica's statistics and ``compute`` merges them with the parallel-variance
+    identity — the canonical custom cross-replica merge (SURVEY §2.3).
+    """
+
+    is_differentiable = True
+    higher_is_better = None  # both -1 and 1 are optimal
+    full_state_update = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("mean_x", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("mean_y", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("var_x", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("var_y", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("corr_xy", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("n_total", default=jnp.asarray(0.0), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+        )
+
+    def compute(self) -> Array:
+        if jnp.ndim(self.mean_x) >= 1 and jnp.size(self.mean_x) > 1:  # post-sync: stacked per-replica stats
+            var_x, var_y, corr_xy, n_total = _final_aggregation(
+                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+            )
+        else:
+            var_x, var_y, corr_xy, n_total = self.var_x, self.var_y, self.corr_xy, self.n_total
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)

@@ -1,0 +1,73 @@
+"""Pairwise metrics vs sklearn oracles
+(mirrors reference ``tests/pairwise/test_pairwise_distance.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import (
+    cosine_similarity as sk_cosine,
+    euclidean_distances as sk_euclidean,
+    linear_kernel as sk_linear,
+    manhattan_distances as sk_manhattan,
+)
+
+from metrics_tpu.functional import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+
+_rng = np.random.RandomState(7)
+_x = jnp.asarray(_rng.rand(10, 4).astype(np.float64))
+_y = jnp.asarray(_rng.rand(8, 4).astype(np.float64))
+
+
+@pytest.mark.parametrize(
+    "metric_fn, sk_fn",
+    [
+        (pairwise_cosine_similarity, sk_cosine),
+        (pairwise_euclidean_distance, sk_euclidean),
+        (pairwise_linear_similarity, sk_linear),
+        (pairwise_manhattan_distance, sk_manhattan),
+    ],
+    ids=["cosine", "euclidean", "linear", "manhattan"],
+)
+@pytest.mark.parametrize("reduction", [None, "mean", "sum"])
+class TestPairwise:
+    def test_two_inputs(self, metric_fn, sk_fn, reduction):
+        res = metric_fn(_x, _y, reduction=reduction)
+        expected = sk_fn(np.asarray(_x), np.asarray(_y))
+        if reduction == "mean":
+            expected = expected.mean(-1)
+        elif reduction == "sum":
+            expected = expected.sum(-1)
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+    def test_single_input(self, metric_fn, sk_fn, reduction):
+        """With only x, the diagonal is zeroed by default."""
+        res = metric_fn(_x, reduction=reduction)
+        expected = sk_fn(np.asarray(_x), np.asarray(_x))
+        np.fill_diagonal(expected, 0)
+        if reduction == "mean":
+            expected = expected.mean(-1)
+        elif reduction == "sum":
+            expected = expected.sum(-1)
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+def test_pairwise_raises():
+    with pytest.raises(ValueError, match="Expected argument `x`.*"):
+        pairwise_cosine_similarity(_x.reshape(-1))
+    with pytest.raises(ValueError, match="Expected argument `y`.*"):
+        pairwise_cosine_similarity(_x, _y[:, :2])
+    with pytest.raises(ValueError, match="Expected reduction.*"):
+        pairwise_cosine_similarity(_x, _y, reduction="bad")
+
+
+def test_jit_and_grad():
+    import jax
+
+    f = jax.jit(pairwise_euclidean_distance)
+    np.testing.assert_allclose(np.asarray(f(_x, _y)), sk_euclidean(np.asarray(_x), np.asarray(_y)), atol=1e-6)
+    g = jax.grad(lambda x: pairwise_cosine_similarity(x, _y).sum())(_x)
+    assert np.isfinite(np.asarray(g)).all()

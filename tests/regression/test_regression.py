@@ -1,0 +1,264 @@
+"""Regression metrics vs sklearn/scipy oracles
+(mirrors reference ``tests/regression/test_{mean_error,r2,explained_variance,
+pearson,spearman,cosine_similarity,tweedie_deviance}.py``)."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score as sk_explained_variance,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance as sk_tweedie,
+    r2_score as sk_r2,
+)
+
+from metrics_tpu import (
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+)
+from metrics_tpu.functional import (
+    cosine_similarity,
+    explained_variance,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    pearson_corrcoef,
+    r2_score,
+    spearman_corrcoef,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+)
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+_rng = np.random.RandomState(42)
+
+_single_target = {
+    "preds": jnp.asarray(_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float64)),
+    "target": jnp.asarray(_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float64)),
+}
+_multi_target = {
+    "preds": jnp.asarray(_rng.rand(NUM_BATCHES, BATCH_SIZE, 3).astype(np.float64)),
+    "target": jnp.asarray(_rng.rand(NUM_BATCHES, BATCH_SIZE, 3).astype(np.float64)),
+}
+
+
+def _sk_rmse(preds, target):
+    return np.sqrt(sk_mse(target, preds))
+
+
+def _sk_smape(preds, target):
+    return np.mean(2 * np.abs(preds - target) / (np.abs(target) + np.abs(preds)))
+
+
+def _sk_pearson(preds, target):
+    return pearsonr(target.reshape(-1), preds.reshape(-1))[0]
+
+
+def _sk_spearman(preds, target):
+    return spearmanr(target.reshape(-1), preds.reshape(-1))[0]
+
+
+def _sk_cosine_sum(preds, target):
+    num = (preds * target).sum(-1)
+    den = np.linalg.norm(preds, axis=-1) * np.linalg.norm(target, axis=-1)
+    return (num / den).sum()
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+@pytest.mark.parametrize(
+    "metric_class, metric_fn, sk_fn, metric_args, inputs",
+    [
+        (MeanSquaredError, mean_squared_error, lambda p, t: sk_mse(t, p), {}, _single_target),
+        (MeanSquaredError, partial(mean_squared_error, squared=False), _sk_rmse, {"squared": False}, _single_target),
+        (MeanAbsoluteError, mean_absolute_error, lambda p, t: sk_mae(t, p), {}, _single_target),
+        (MeanAbsolutePercentageError, mean_absolute_percentage_error, lambda p, t: sk_mape(t, p), {}, _single_target),
+        (
+            SymmetricMeanAbsolutePercentageError,
+            symmetric_mean_absolute_percentage_error,
+            _sk_smape,
+            {},
+            _single_target,
+        ),
+        (MeanSquaredLogError, mean_squared_log_error, lambda p, t: sk_msle(t, p), {}, _single_target),
+        (ExplainedVariance, explained_variance, lambda p, t: sk_explained_variance(t, p), {}, _single_target),
+        (
+            ExplainedVariance,
+            partial(explained_variance, multioutput="raw_values"),
+            lambda p, t: sk_explained_variance(t, p, multioutput="raw_values"),
+            {"multioutput": "raw_values"},
+            _multi_target,
+        ),
+        (PearsonCorrCoef, pearson_corrcoef, _sk_pearson, {}, _single_target),
+        (SpearmanCorrCoef, spearman_corrcoef, _sk_spearman, {}, _single_target),
+        (CosineSimilarity, cosine_similarity, _sk_cosine_sum, {}, _multi_target),
+        (
+            TweedieDevianceScore,
+            tweedie_deviance_score,
+            lambda p, t: sk_tweedie(t, p, power=0.0),
+            {},
+            _single_target,
+        ),
+        (
+            TweedieDevianceScore,
+            partial(tweedie_deviance_score, power=1.0),
+            lambda p, t: sk_tweedie(t, p, power=1.0),
+            {"power": 1.0},
+            _single_target,
+        ),
+    ],
+    ids=[
+        "mse",
+        "rmse",
+        "mae",
+        "mape",
+        "smape",
+        "msle",
+        "explained_variance",
+        "explained_variance_raw",
+        "pearson",
+        "spearman",
+        "cosine_similarity",
+        "tweedie_p0",
+        "tweedie_p1",
+    ],
+)
+class TestRegressionMetrics(MetricTester):
+    atol = 1e-5
+
+    def test_class_metric(self, ddp, metric_class, metric_fn, sk_fn, metric_args, inputs):
+        self.run_class_metric_test(
+            ddp,
+            inputs["preds"],
+            inputs["target"],
+            metric_class,
+            sk_metric=lambda p, t: sk_fn(p, t),
+            metric_args=metric_args,
+        )
+
+    def test_functional_metric(self, ddp, metric_class, metric_fn, sk_fn, metric_args, inputs):
+        if ddp:
+            pytest.skip("functional path has no ddp axis")
+        self.run_functional_metric_test(
+            inputs["preds"],
+            inputs["target"],
+            metric_fn,
+            sk_metric=lambda p, t: sk_fn(p, t),
+        )
+
+    def test_differentiability(self, ddp, metric_class, metric_fn, sk_fn, metric_args, inputs):
+        if ddp:
+            pytest.skip("differentiability has no ddp axis")
+        self.run_differentiability_test(
+            inputs["preds"], inputs["target"], metric_class, metric_fn, metric_args=metric_args
+        )
+
+
+@pytest.mark.parametrize("adjusted", [0, 5])
+@pytest.mark.parametrize("multioutput", ["uniform_average", "raw_values", "variance_weighted"])
+@pytest.mark.parametrize("ddp", [False, True])
+def test_r2(ddp, adjusted, multioutput):
+    """R2Score vs sklearn, single- and multi-output (reference ``tests/regression/test_r2.py``)."""
+    inputs = _multi_target if multioutput == "raw_values" else _single_target
+    num_outputs = 3 if multioutput == "raw_values" else 1
+
+    def sk_fn(p, t):
+        r2 = sk_r2(t, p, multioutput=multioutput)
+        if adjusted:
+            n = t.shape[0]
+            r2 = 1 - (1 - r2) * (n - 1) / (n - adjusted - 1)
+        return r2
+
+    tester = MetricTester()
+    tester.atol = 1e-5
+    tester.run_class_metric_test(
+        ddp,
+        inputs["preds"],
+        inputs["target"],
+        R2Score,
+        sk_metric=sk_fn,
+        metric_args={"adjusted": adjusted, "multioutput": multioutput, "num_outputs": num_outputs},
+        check_batch=not adjusted,  # batch-level n differs from the epoch-level n the oracle uses
+        check_jit=not adjusted,
+        check_state_merge=not adjusted,
+    )
+
+
+def test_r2_raises():
+    with pytest.raises(ValueError, match="Needs at least two samples.*"):
+        r2_score(jnp.asarray([0.0]), jnp.asarray([1.0]))
+    with pytest.raises(ValueError, match="Invalid input to argument `multioutput`.*"):
+        R2Score(multioutput="fail")
+    with pytest.raises(ValueError, match="`adjusted` parameter should be an integer.*"):
+        R2Score(adjusted=-1)
+
+
+def test_pearson_merge_matches_serial():
+    """Two independently accumulated PearsonCorrCoef replicas merged via the
+    stacked-stats aggregation equal the serial result (reference
+    ``regression/pearson.py:25-54`` semantics)."""
+    preds, target = _single_target["preds"], _single_target["target"]
+    m_a, m_b, m_full = PearsonCorrCoef(), PearsonCorrCoef(), PearsonCorrCoef()
+    half = NUM_BATCHES // 2
+    for i in range(half):
+        m_a.update(preds[i], target[i])
+    for i in range(half, NUM_BATCHES):
+        m_b.update(preds[i], target[i])
+    for i in range(NUM_BATCHES):
+        m_full.update(preds[i], target[i])
+
+    from metrics_tpu.functional.regression.pearson import _final_aggregation, _pearson_corrcoef_compute
+
+    var_x, var_y, corr_xy, n = _final_aggregation(
+        jnp.stack([m_a.mean_x, m_b.mean_x]),
+        jnp.stack([m_a.mean_y, m_b.mean_y]),
+        jnp.stack([m_a.var_x, m_b.var_x]),
+        jnp.stack([m_a.var_y, m_b.var_y]),
+        jnp.stack([m_a.corr_xy, m_b.corr_xy]),
+        jnp.stack([m_a.n_total, m_b.n_total]),
+    )
+    merged = _pearson_corrcoef_compute(var_x, var_y, corr_xy, n)
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(m_full.compute()), atol=1e-6)
+
+
+def test_spearman_ties():
+    """Tie handling must match scipy's fractional ranking."""
+    p = jnp.asarray([1.0, 1.0, 2.0, 3.0, 3.0, 3.0, 4.0])
+    t = jnp.asarray([2.0, 2.0, 1.0, 5.0, 5.0, 6.0, 7.0])
+    res = spearman_corrcoef(p, t)
+    ref = spearmanr(np.asarray(t), np.asarray(p))[0]
+    np.testing.assert_allclose(np.asarray(res), ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["sum", "mean", "none"])
+def test_cosine_similarity_reductions(reduction):
+    preds, target = _multi_target["preds"], _multi_target["target"]
+    m = CosineSimilarity(reduction=reduction)
+    for i in range(NUM_BATCHES):
+        m.update(preds[i], target[i])
+    p = np.asarray(preds).reshape(-1, 3)
+    t = np.asarray(target).reshape(-1, 3)
+    sim = (p * t).sum(-1) / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1))
+    expected = {"sum": sim.sum(), "mean": sim.mean(), "none": sim}[reduction]
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-6)
+
+
+def test_tweedie_domain_errors():
+    with pytest.raises(ValueError, match="Deviance Score is not defined for power=0.5"):
+        TweedieDevianceScore(power=0.5)
+    with pytest.raises(ValueError):
+        tweedie_deviance_score(jnp.asarray([-1.0, 2.0]), jnp.asarray([1.0, 2.0]), power=1.0)
