@@ -1,0 +1,117 @@
+"""Headline benchmark: streaming classification-metric throughput.
+
+Workload = BASELINE.md configs 1-2: an ``Accuracy`` + ``ConfusionMatrix`` +
+``F1Score`` collection streaming 10-class logits, the reference's README-level
+hot loop. We measure samples/sec of the jitted update path on the live JAX
+backend (TPU when present) and compare against the reference-style torch
+implementation of the identical update (argmax → bincount confusion matrix →
+stat-scores) running on CPU — the reference's own kernels are pure torch
+tensor programs (SURVEY §2.1), so this is the faithful baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+
+BATCH = 8192
+NUM_CLASSES = 10
+STEPS = 50
+WARMUP = 3
+
+_rng = np.random.RandomState(0)
+_preds = _rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+_target = _rng.randint(0, NUM_CLASSES, size=(BATCH,)).astype(np.int32)
+
+
+def bench_ours() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, ConfusionMatrix, F1Score
+
+    metrics = [
+        Accuracy(num_classes=NUM_CLASSES),
+        ConfusionMatrix(num_classes=NUM_CLASSES),
+        F1Score(num_classes=NUM_CLASSES, average="macro"),
+    ]
+
+    @jax.jit
+    def step(states, p, t):
+        return tuple(m.update_state(s, p, t) for m, s in zip(metrics, states))
+
+    p = jnp.asarray(_preds)
+    t = jnp.asarray(_target)
+    states = tuple(m.init_state() for m in metrics)
+    for _ in range(WARMUP):
+        states = step(states, p, t)
+    jax.block_until_ready(states)
+
+    states = tuple(m.init_state() for m in metrics)
+    start = time.perf_counter()
+    for _ in range(STEPS):
+        states = step(states, p, t)
+    jax.block_until_ready(states)
+    elapsed = time.perf_counter() - start
+    # sanity: results are real
+    vals = [m.compute_state(s) for m, s in zip(metrics, states)]
+    assert all(np.isfinite(np.asarray(jax.tree_util.tree_leaves(v)[0])).all() for v in vals)
+    return STEPS * BATCH / elapsed
+
+
+def bench_reference() -> float:
+    """Reference-pattern torch CPU implementation of the same three updates."""
+    import torch
+
+    p = torch.from_numpy(_preds)
+    t = torch.from_numpy(_target).long()
+
+    def step(correct, total, confmat, tp, fp, fn):
+        pred_lab = p.argmax(dim=1)
+        correct = correct + (pred_lab == t).sum()
+        total = total + t.numel()
+        unique = t * NUM_CLASSES + pred_lab
+        confmat = confmat + torch.bincount(unique, minlength=NUM_CLASSES**2).reshape(
+            NUM_CLASSES, NUM_CLASSES
+        )
+        oh_p = torch.nn.functional.one_hot(pred_lab, NUM_CLASSES)
+        oh_t = torch.nn.functional.one_hot(t, NUM_CLASSES)
+        tp = tp + (oh_p * oh_t).sum(0)
+        fp = fp + (oh_p * (1 - oh_t)).sum(0)
+        fn = fn + ((1 - oh_p) * oh_t).sum(0)
+        return correct, total, confmat, tp, fp, fn
+
+    zeros = lambda *shape: torch.zeros(*shape, dtype=torch.long)  # noqa: E731
+    state = (zeros(1), zeros(1), zeros(NUM_CLASSES, NUM_CLASSES), zeros(NUM_CLASSES), zeros(NUM_CLASSES), zeros(NUM_CLASSES))
+    for _ in range(WARMUP):
+        state = step(*state)
+    state = (zeros(1), zeros(1), zeros(NUM_CLASSES, NUM_CLASSES), zeros(NUM_CLASSES), zeros(NUM_CLASSES), zeros(NUM_CLASSES))
+    start = time.perf_counter()
+    for _ in range(STEPS):
+        state = step(*state)
+    elapsed = time.perf_counter() - start
+    return STEPS * BATCH / elapsed
+
+
+def main() -> None:
+    ours = bench_ours()
+    try:
+        baseline = bench_reference()
+    except Exception:
+        baseline = float("nan")
+    vs = ours / baseline if baseline == baseline else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "classification_collection_update_throughput",
+                "value": round(ours, 1),
+                "unit": "samples/sec",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

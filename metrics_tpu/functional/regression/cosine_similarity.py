@@ -20,6 +20,8 @@ def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array
 
 
 def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    if reduction not in ("sum", "mean", "none", None):
+        raise ValueError(f"Expected argument `reduction` to be one of ('sum', 'mean', 'none', None) but got {reduction}")
     dot_product = jnp.sum(preds * target, axis=-1)
     preds_norm = jnp.linalg.norm(preds, axis=-1)
     target_norm = jnp.linalg.norm(target, axis=-1)
