@@ -39,6 +39,17 @@ from metrics_tpu.classification import (  # noqa: E402,F401
 )
 from metrics_tpu.collections import MetricCollection  # noqa: E402,F401
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402,F401
+from metrics_tpu.retrieval import (  # noqa: E402,F401
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalMetric,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRPrecision,
+    RetrievalRecall,
+)
 from metrics_tpu.regression import (  # noqa: E402,F401
     CosineSimilarity,
     ExplainedVariance,
@@ -90,6 +101,15 @@ __all__ = [
     "R2Score",
     "ROC",
     "Recall",
+    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalMAP",
+    "RetrievalMRR",
+    "RetrievalMetric",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalRPrecision",
+    "RetrievalRecall",
     "SpearmanCorrCoef",
     "Specificity",
     "StatScores",

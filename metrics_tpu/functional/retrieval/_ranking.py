@@ -1,0 +1,82 @@
+"""Shared ranking machinery for retrieval metrics.
+
+The reference groups rows per query with a Python dict loop
+(``utilities/data.py:216`` ``get_group_indexes``) and evaluates each group in
+a Python ``for`` (``retrieval/base.py:124-153``). Here grouping is a single
+lexicographic sort (query asc, score desc) plus segment reductions — every
+retrieval metric becomes a handful of ``segment_sum`` calls over the flat
+stream, vectorized across all queries at once (SURVEY §7 stage 6).
+"""
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class GroupedRanking(NamedTuple):
+    """Flat per-element view of all queries, sorted by (query, -score)."""
+
+    target: Array  # target re-ordered by (query, descending score)
+    seg: Array  # dense segment id per element (0..num_segments-1)
+    rank: Array  # 0-based rank of the element within its query
+    sizes: Array  # [Q] number of elements per query
+    num_segments: int
+
+
+def _group_by_query(preds: Array, target: Array, indexes: Array, num_segments: Optional[int] = None) -> GroupedRanking:
+    """Sort the flat stream by (query, descending score) and derive segment ids,
+    within-query ranks and query sizes. ``num_segments`` must be concrete (the
+    number of distinct queries); when ``None`` it is read from the data (host
+    path only)."""
+    order = jnp.lexsort((-preds, indexes))
+    idx_s = indexes[order]
+    t_s = target[order]
+    n = idx_s.shape[0]
+
+    newseg = jnp.concatenate([jnp.ones(1, dtype=bool), idx_s[1:] != idx_s[:-1]])
+    seg = jnp.cumsum(newseg) - 1
+    pos = jnp.arange(n)
+    # group-start position, propagated to every element of the group
+    seg_start = jax.lax.associative_scan(jnp.maximum, jnp.where(newseg, pos, 0))
+    rank = pos - seg_start
+
+    if num_segments is None:
+        num_segments = int(seg[-1]) + 1
+    sizes = jax.ops.segment_sum(jnp.ones_like(seg), seg, num_segments)
+    return GroupedRanking(t_s, seg, rank, sizes, num_segments)
+
+
+def _segment_sum(x: Array, g: GroupedRanking) -> Array:
+    return jax.ops.segment_sum(x, g.seg, g.num_segments)
+
+
+def _within_group_cumsum(x: Array, g: GroupedRanking) -> Array:
+    """Inclusive cumulative sum restarting at each query boundary."""
+    c = jnp.cumsum(x)
+    start = jnp.arange(x.shape[0]) - g.rank  # position of the group start
+    return c - (c[start] - x[start])
+
+
+def _k_mask(g: GroupedRanking, k: Optional[int]) -> Array:
+    """Per-element mask of "within the top-k of its query" (k=None: whole query)."""
+    if k is None:
+        return jnp.ones_like(g.rank, dtype=bool)
+    return g.rank < k
+
+
+def _validate_k(k: Optional[int]) -> None:
+    if k is not None and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+
+
+def _sorted_by_scores(preds: Array, target: Array) -> Array:
+    """Single-query view: target re-ordered by descending prediction score."""
+    return target[jnp.argsort(-preds)]
+
+
+def _ideal_grouping(target: Array, indexes: Array, num_segments: Optional[int] = None) -> GroupedRanking:
+    """Grouping sorted by (query, descending *target*) — the ideal ranking used
+    by NDCG's normalizer."""
+    return _group_by_query(target.astype(jnp.float32), target, indexes, num_segments)

@@ -1,0 +1,36 @@
+"""Retrieval average precision.
+
+Parity: reference ``torchmetrics/functional/retrieval/average_precision.py:20``.
+Branch-free (empty queries produce 0.0 via ``where``) so it jits and vmaps.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._ranking import (
+    GroupedRanking,
+    _segment_sum,
+    _sorted_by_scores,
+    _within_group_cumsum,
+)
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_average_precision(preds: Array, target: Array) -> Array:
+    """AP for a single query: mean of precision-at-hit over relevant documents."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    st = _sorted_by_scores(preds, target).astype(jnp.float32)
+    hits = jnp.cumsum(st)
+    precision_at = hits / jnp.arange(1, st.shape[0] + 1)
+    total = jnp.sum(st)
+    return jnp.where(total > 0, jnp.sum(precision_at * st) / jnp.clip(total, min=1.0), 0.0)
+
+
+def _average_precision_grouped(g: GroupedRanking) -> Array:
+    """[Q] AP values over all queries at once."""
+    t = g.target.astype(jnp.float32)
+    hits = _within_group_cumsum(t, g)
+    contrib = t * hits / (g.rank + 1)
+    n_pos = _segment_sum(t, g)
+    return jnp.where(n_pos > 0, _segment_sum(contrib, g) / jnp.clip(n_pos, min=1.0), 0.0)

@@ -1,0 +1,39 @@
+"""Retrieval fall-out@k (fraction of non-relevant documents in the top-k).
+
+Parity: reference ``torchmetrics/functional/retrieval/fall_out.py:21``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._ranking import (
+    GroupedRanking,
+    _k_mask,
+    _segment_sum,
+    _sorted_by_scores,
+    _validate_k,
+)
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fraction of all non-relevant documents retrieved among the top-k."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_k(k)
+    n = preds.shape[-1]
+    k = n if k is None else k
+    neg = 1 - target
+    st = _sorted_by_scores(preds, neg).astype(jnp.float32)
+    irrelevant = jnp.sum(st[: min(k, n)])
+    total = jnp.sum(st)
+    return jnp.where(total > 0, irrelevant / jnp.clip(total, min=1.0), 0.0)
+
+
+def _fall_out_grouped(g: GroupedRanking, k: Optional[int] = None) -> Array:
+    neg = (1 - g.target).astype(jnp.float32)
+    irrelevant = _segment_sum(neg * _k_mask(g, k), g)
+    n_neg = _segment_sum(neg, g)
+    return jnp.where(n_neg > 0, irrelevant / jnp.clip(n_neg, min=1.0), 0.0)

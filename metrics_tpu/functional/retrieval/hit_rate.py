@@ -1,0 +1,36 @@
+"""Retrieval hit-rate@k.
+
+Parity: reference ``torchmetrics/functional/retrieval/hit_rate.py:21``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._ranking import (
+    GroupedRanking,
+    _k_mask,
+    _segment_sum,
+    _sorted_by_scores,
+    _validate_k,
+)
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """1.0 if at least one relevant document is in the top-k, else 0.0."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_k(k)
+    n = preds.shape[-1]
+    k = n if k is None else k
+    st = _sorted_by_scores(preds, target).astype(jnp.float32)
+    relevant = jnp.sum(st[: min(k, n)])
+    return (relevant > 0).astype(jnp.float32)
+
+
+def _hit_rate_grouped(g: GroupedRanking, k: Optional[int] = None) -> Array:
+    t = g.target.astype(jnp.float32)
+    relevant = _segment_sum(t * _k_mask(g, k), g)
+    return (relevant > 0).astype(jnp.float32)

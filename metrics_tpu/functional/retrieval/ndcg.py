@@ -1,0 +1,47 @@
+"""Retrieval normalized discounted cumulative gain.
+
+Parity: reference ``torchmetrics/functional/retrieval/ndcg.py:28`` (including
+``_dcg`` :20). Targets may be graded (non-binary) relevance scores.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._ranking import (
+    GroupedRanking,
+    _k_mask,
+    _segment_sum,
+    _sorted_by_scores,
+    _validate_k,
+)
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def _dcg(target: Array) -> Array:
+    denom = jnp.log2(jnp.arange(target.shape[-1]) + 2.0)
+    return jnp.sum(target / denom, axis=-1)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """DCG of the predicted ranking normalized by the ideal ranking's DCG."""
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    _validate_k(k)
+    n = preds.shape[-1]
+    k = n if k is None else min(k, n)
+    sorted_target = _sorted_by_scores(preds, target)[:k]
+    ideal_target = jnp.sort(target)[::-1][:k]
+    ideal_dcg = _dcg(ideal_target)
+    target_dcg = _dcg(sorted_target)
+    return jnp.where(ideal_dcg > 0, target_dcg / jnp.where(ideal_dcg > 0, ideal_dcg, 1.0), 0.0)
+
+
+def _ndcg_grouped(g: GroupedRanking, g_ideal: GroupedRanking, k: Optional[int] = None) -> Array:
+    """[Q] NDCG; ``g`` is sorted by predicted score, ``g_ideal`` by target."""
+    disc = 1.0 / jnp.log2(g.rank + 2.0)
+    dcg = _segment_sum(g.target.astype(jnp.float32) * disc * _k_mask(g, k), g)
+    disc_i = 1.0 / jnp.log2(g_ideal.rank + 2.0)
+    idcg = _segment_sum(g_ideal.target.astype(jnp.float32) * disc_i * _k_mask(g_ideal, k), g_ideal)
+    return jnp.where(idcg > 0, dcg / jnp.where(idcg > 0, idcg, 1.0), 0.0)

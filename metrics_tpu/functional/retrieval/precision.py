@@ -1,0 +1,39 @@
+"""Retrieval precision@k.
+
+Parity: reference ``torchmetrics/functional/retrieval/precision.py:20``
+(note: the denominator is the *requested* ``k``, not ``min(k, n)``).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._ranking import (
+    GroupedRanking,
+    _k_mask,
+    _segment_sum,
+    _sorted_by_scores,
+    _validate_k,
+)
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fraction of the top-k documents that are relevant."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_k(k)
+    n = preds.shape[-1]
+    k = n if k is None else k
+    st = _sorted_by_scores(preds, target).astype(jnp.float32)
+    relevant = jnp.sum(st[: min(k, n)])
+    return jnp.where(jnp.sum(st) > 0, relevant / k, 0.0)
+
+
+def _precision_grouped(g: GroupedRanking, k: Optional[int] = None) -> Array:
+    t = g.target.astype(jnp.float32)
+    relevant = _segment_sum(t * _k_mask(g, k), g)
+    denom = g.sizes if k is None else jnp.full_like(g.sizes, k)
+    n_pos = _segment_sum(t, g)
+    return jnp.where(n_pos > 0, relevant / denom, 0.0)

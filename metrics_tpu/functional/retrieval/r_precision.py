@@ -1,0 +1,29 @@
+"""Retrieval R-precision (precision at rank R = number of relevant documents).
+
+Parity: reference ``torchmetrics/functional/retrieval/r_precision.py:20``. The
+reference slices ``[:relevant_number]`` (data-dependent); here the slice is a
+``rank < n_pos`` mask — branch-free and jittable.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._ranking import GroupedRanking, _segment_sum, _sorted_by_scores
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """Fraction of the top-R documents that are relevant, R = total relevant."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    st = _sorted_by_scores(preds, target).astype(jnp.float32)
+    n_pos = jnp.sum(st)
+    relevant = jnp.sum(st * (jnp.arange(st.shape[0]) < n_pos))
+    return jnp.where(n_pos > 0, relevant / jnp.clip(n_pos, min=1.0), 0.0)
+
+
+def _r_precision_grouped(g: GroupedRanking) -> Array:
+    t = g.target.astype(jnp.float32)
+    n_pos = _segment_sum(t, g)
+    relevant = _segment_sum(t * (g.rank < n_pos[g.seg]), g)
+    return jnp.where(n_pos > 0, relevant / jnp.clip(n_pos, min=1.0), 0.0)

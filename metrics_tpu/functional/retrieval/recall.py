@@ -1,0 +1,38 @@
+"""Retrieval recall@k.
+
+Parity: reference ``torchmetrics/functional/retrieval/recall.py:21``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._ranking import (
+    GroupedRanking,
+    _k_mask,
+    _segment_sum,
+    _sorted_by_scores,
+    _validate_k,
+)
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fraction of all relevant documents found in the top-k."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_k(k)
+    n = preds.shape[-1]
+    k = n if k is None else k
+    st = _sorted_by_scores(preds, target).astype(jnp.float32)
+    relevant = jnp.sum(st[: min(k, n)])
+    total = jnp.sum(st)
+    return jnp.where(total > 0, relevant / jnp.clip(total, min=1.0), 0.0)
+
+
+def _recall_grouped(g: GroupedRanking, k: Optional[int] = None) -> Array:
+    t = g.target.astype(jnp.float32)
+    relevant = _segment_sum(t * _k_mask(g, k), g)
+    n_pos = _segment_sum(t, g)
+    return jnp.where(n_pos > 0, relevant / jnp.clip(n_pos, min=1.0), 0.0)

@@ -1,0 +1,29 @@
+"""Retrieval mean reciprocal rank.
+
+Parity: reference ``torchmetrics/functional/retrieval/reciprocal_rank.py:20``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._ranking import GroupedRanking, _segment_sum, _sorted_by_scores
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """1 / rank of the first relevant document (0.0 when none)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    st = _sorted_by_scores(preds, target)
+    first_pos = jnp.argmax(st)  # first index of the max: first hit for binary targets
+    return jnp.where(jnp.sum(st) > 0, 1.0 / (first_pos + 1.0), 0.0)
+
+
+def _reciprocal_rank_grouped(g: GroupedRanking) -> Array:
+    t = g.target
+    n = t.shape[0]
+    # per-query minimum rank of a hit (n when the query has no hit)
+    hit_rank = jnp.where(t > 0, g.rank, n)
+    first = jax.ops.segment_min(hit_rank, g.seg, g.num_segments)
+    n_pos = _segment_sum(t.astype(jnp.float32), g)
+    return jnp.where(n_pos > 0, 1.0 / (first + 1.0), 0.0)

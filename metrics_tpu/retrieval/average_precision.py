@@ -1,0 +1,15 @@
+"""RetrievalMAP (parity: reference ``torchmetrics/retrieval/average_precision.py:20``)."""
+import jax
+
+from metrics_tpu.functional.retrieval._ranking import GroupedRanking
+from metrics_tpu.functional.retrieval.average_precision import _average_precision_grouped
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision over queries."""
+
+    def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
+        return _average_precision_grouped(g)

@@ -1,0 +1,102 @@
+"""RetrievalMetric base: grouped (per-query) streaming metrics.
+
+Parity: reference ``torchmetrics/retrieval/base.py:27``. Behavior is the same
+(buffer indexes/preds/target, group by query at compute, apply
+``empty_target_action``), but the per-query evaluation is a single vectorized
+segment-reduction pass (see ``functional/retrieval/_ranking.py``) instead of
+the reference's Python loop over ``get_group_indexes``
+(``retrieval/base.py:124-153``).
+"""
+from abc import ABC, abstractmethod
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._ranking import GroupedRanking, _group_by_query, _segment_sum
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class RetrievalMetric(Metric, ABC):
+    """Base for metrics computed per query then averaged over queries.
+
+    ``update`` accepts ``(preds, target, indexes)`` where ``indexes`` maps each
+    prediction to its query. Subclasses implement ``_metric_grouped`` returning
+    a ``[Q]`` vector of per-query values.
+
+    Args:
+        empty_target_action: what an "empty" query (no positive target — or no
+            negative for fall-out) contributes: ``'neg'``→0.0, ``'pos'``→1.0,
+            ``'skip'``→excluded from the mean, ``'error'``→raise.
+        ignore_index: drop elements whose target equals this value.
+    """
+
+    higher_is_better = True
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.allow_non_binary_target = False
+
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        self.add_state("indexes", default=[], dist_reduce_fx="cat")
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target, ignore_index=self.ignore_index
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _empty_query_mask(self, g: GroupedRanking) -> Array:
+        """[Q] True where the query has no positive target (fall-out overrides)."""
+        return _segment_sum(g.target.astype(jnp.float32), g) == 0
+
+    def _empty_query_error(self) -> str:
+        return "`compute` method was provided with a query with no positive target."
+
+    def compute(self) -> Array:
+        indexes = dim_zero_cat(self.indexes).reshape(-1)
+        preds = dim_zero_cat(self.preds).reshape(-1)
+        target = dim_zero_cat(self.target).reshape(-1)
+
+        g = _group_by_query(preds, target, indexes)
+        values = self._metric_grouped(preds, target, indexes, g)
+        empty = self._empty_query_mask(g)
+
+        if self.empty_target_action == "error":
+            if bool(jnp.any(empty)):
+                raise ValueError(self._empty_query_error())
+            return jnp.mean(values)
+        if self.empty_target_action == "skip":
+            keep = ~empty
+            n_keep = jnp.sum(keep)
+            return jnp.where(n_keep > 0, jnp.sum(jnp.where(keep, values, 0.0)) / jnp.clip(n_keep, min=1), 0.0)
+        fill = 1.0 if self.empty_target_action == "pos" else 0.0
+        return jnp.mean(jnp.where(empty, fill, values))
+
+    @abstractmethod
+    def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
+        """Per-query metric values ``[Q]`` (empty queries may hold any value —
+        the base overwrites them per ``empty_target_action``)."""

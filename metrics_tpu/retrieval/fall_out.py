@@ -1,0 +1,30 @@
+"""RetrievalFallOut (parity: reference ``torchmetrics/retrieval/fall_out.py:22``)."""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._ranking import GroupedRanking, _segment_sum
+from metrics_tpu.functional.retrieval.fall_out import _fall_out_grouped
+from metrics_tpu.retrieval._topk_base import _TopKRetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalFallOut(_TopKRetrievalMetric):
+    """Mean fall-out@k over queries. Lower is better; a query is "empty" when
+    it has no *negative* targets (reference ``fall_out.py:120-133``)."""
+
+    higher_is_better = False
+
+    def __init__(self, empty_target_action: str = "pos", ignore_index: Optional[int] = None, k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, k=k, **kwargs)
+
+    def _empty_query_mask(self, g: GroupedRanking) -> Array:
+        return _segment_sum((1 - g.target).astype(jnp.float32), g) == 0
+
+    def _empty_query_error(self) -> str:
+        return "`compute` method was provided with a query with no negative target."
+
+    def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
+        return _fall_out_grouped(g, self.k)

@@ -1,0 +1,15 @@
+"""RetrievalHitRate (parity: reference ``torchmetrics/retrieval/hit_rate.py:20``)."""
+import jax
+
+from metrics_tpu.functional.retrieval._ranking import GroupedRanking
+from metrics_tpu.functional.retrieval.hit_rate import _hit_rate_grouped
+from metrics_tpu.retrieval._topk_base import _TopKRetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalHitRate(_TopKRetrievalMetric):
+    """Mean hit-rate@k over queries."""
+
+    def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
+        return _hit_rate_grouped(g, self.k)

@@ -1,0 +1,22 @@
+"""RetrievalNormalizedDCG (parity: reference ``torchmetrics/retrieval/ndcg.py:20``)."""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.retrieval._ranking import GroupedRanking, _ideal_grouping
+from metrics_tpu.functional.retrieval.ndcg import _ndcg_grouped
+from metrics_tpu.retrieval._topk_base import _TopKRetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalNormalizedDCG(_TopKRetrievalMetric):
+    """Mean NDCG@k over queries; targets may be graded relevance scores."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None, k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, k=k, **kwargs)
+        self.allow_non_binary_target = True
+
+    def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
+        g_ideal = _ideal_grouping(target, indexes, g.num_segments)
+        return _ndcg_grouped(g, g_ideal, self.k)

@@ -1,0 +1,15 @@
+"""RetrievalPrecision (parity: reference ``torchmetrics/retrieval/precision.py:20``)."""
+import jax
+
+from metrics_tpu.functional.retrieval._ranking import GroupedRanking
+from metrics_tpu.functional.retrieval.precision import _precision_grouped
+from metrics_tpu.retrieval._topk_base import _TopKRetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalPrecision(_TopKRetrievalMetric):
+    """Mean precision@k over queries."""
+
+    def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
+        return _precision_grouped(g, self.k)

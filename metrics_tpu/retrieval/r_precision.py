@@ -1,0 +1,15 @@
+"""RetrievalRPrecision (parity: reference ``torchmetrics/retrieval/r_precision.py:20``)."""
+import jax
+
+from metrics_tpu.functional.retrieval._ranking import GroupedRanking
+from metrics_tpu.functional.retrieval.r_precision import _r_precision_grouped
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """Mean R-precision over queries."""
+
+    def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
+        return _r_precision_grouped(g)

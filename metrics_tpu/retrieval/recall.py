@@ -1,0 +1,15 @@
+"""RetrievalRecall (parity: reference ``torchmetrics/retrieval/recall.py:20``)."""
+import jax
+
+from metrics_tpu.functional.retrieval._ranking import GroupedRanking
+from metrics_tpu.functional.retrieval.recall import _recall_grouped
+from metrics_tpu.retrieval._topk_base import _TopKRetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRecall(_TopKRetrievalMetric):
+    """Mean recall@k over queries."""
+
+    def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
+        return _recall_grouped(g, self.k)

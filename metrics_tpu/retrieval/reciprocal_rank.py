@@ -1,0 +1,15 @@
+"""RetrievalMRR (parity: reference ``torchmetrics/retrieval/reciprocal_rank.py:20``)."""
+import jax
+
+from metrics_tpu.functional.retrieval._ranking import GroupedRanking
+from metrics_tpu.functional.retrieval.reciprocal_rank import _reciprocal_rank_grouped
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank over queries."""
+
+    def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
+        return _reciprocal_rank_grouped(g)

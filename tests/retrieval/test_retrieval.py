@@ -1,0 +1,231 @@
+"""Retrieval metrics vs per-query numpy/sklearn oracles
+(mirrors reference ``tests/retrieval/`` with its grouped-input fixtures and
+``empty_target_action`` cases; the oracle loops queries in Python — the
+framework must match it with its vectorized segment-reduction path)."""
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_ap, ndcg_score as sk_ndcg
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRPrecision,
+    RetrievalRecall,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from tests.helpers.testers import NUM_BATCHES, MetricTester
+
+BATCH = 32
+NUM_QUERIES = 6
+
+_rng = np.random.RandomState(13)
+_preds = jnp.asarray(_rng.rand(NUM_BATCHES, BATCH).astype(np.float64))
+_target = jnp.asarray(_rng.randint(0, 2, size=(NUM_BATCHES, BATCH)))
+_indexes = jnp.asarray(_rng.randint(0, NUM_QUERIES, size=(NUM_BATCHES, BATCH)))
+# graded relevance for NDCG
+_target_graded = jnp.asarray(_rng.randint(0, 4, size=(NUM_BATCHES, BATCH)))
+
+
+# -- per-query numpy oracles ------------------------------------------------
+def _np_ap(p, t):
+    return sk_ap(t, p)
+
+
+def _np_mrr(p, t):
+    order = np.argsort(-p)
+    t = t[order]
+    pos = np.nonzero(t)[0]
+    return 1.0 / (pos[0] + 1) if len(pos) else 0.0
+
+
+def _np_precision(p, t, k=None):
+    k_eff = len(p) if k is None else k
+    st = t[np.argsort(-p)]
+    return st[: min(k_eff, len(p))].sum() / k_eff
+
+
+def _np_recall(p, t, k=None):
+    k_eff = len(p) if k is None else k
+    st = t[np.argsort(-p)]
+    return st[:k_eff].sum() / t.sum()
+
+
+def _np_fall_out(p, t, k=None):
+    return _np_recall(p, 1 - t, k)
+
+
+def _np_hit_rate(p, t, k=None):
+    k_eff = len(p) if k is None else k
+    st = t[np.argsort(-p)]
+    return float(st[:k_eff].sum() > 0)
+
+
+def _np_r_precision(p, t):
+    r = int(t.sum())
+    st = t[np.argsort(-p)]
+    return st[:r].sum() / r
+
+
+def _np_ndcg(p, t, k=None):
+    return sk_ndcg(np.asarray([t]), np.asarray([p]), k=k)
+
+
+def _grouped_oracle(
+    per_query: Callable, empty_target_action: str = "neg", empty_on: str = "pos", **kwargs
+) -> Callable:
+    """Loop queries in numpy, applying the empty-query policy like the reference."""
+
+    def fn(preds, target, indexes):
+        res = []
+        for q in np.unique(indexes):
+            mask = indexes == q
+            p, t = preds[mask], target[mask]
+            empty = (1 - t).sum() == 0 if empty_on == "neg" else t.sum() == 0
+            if empty:
+                if empty_target_action == "pos":
+                    res.append(1.0)
+                elif empty_target_action == "neg":
+                    res.append(0.0)
+                # skip: drop
+            else:
+                res.append(per_query(p, t, **kwargs))
+        return np.mean(res) if res else 0.0
+
+    return fn
+
+
+_CASES = [
+    (RetrievalMAP, retrieval_average_precision, _np_ap, {}, False),
+    (RetrievalMRR, retrieval_reciprocal_rank, _np_mrr, {}, False),
+    (RetrievalPrecision, retrieval_precision, _np_precision, {"k": 3}, False),
+    (RetrievalPrecision, retrieval_precision, _np_precision, {}, False),
+    (RetrievalRecall, retrieval_recall, _np_recall, {"k": 3}, False),
+    (RetrievalHitRate, retrieval_hit_rate, _np_hit_rate, {"k": 2}, False),
+    (RetrievalRPrecision, retrieval_r_precision, _np_r_precision, {}, False),
+    (RetrievalNormalizedDCG, retrieval_normalized_dcg, _np_ndcg, {"k": 5}, True),
+    (RetrievalNormalizedDCG, retrieval_normalized_dcg, _np_ndcg, {}, True),
+]
+_IDS = ["map", "mrr", "precision@3", "precision", "recall@3", "hit@2", "r_precision", "ndcg@5", "ndcg"]
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+@pytest.mark.parametrize("metric_class, metric_fn, oracle, args, graded", _CASES, ids=_IDS)
+class TestRetrieval(MetricTester):
+    atol = 1e-6
+
+    def test_class_metric(self, ddp, metric_class, metric_fn, oracle, args, graded):
+        target = _target_graded if graded else _target
+        self.run_class_metric_test(
+            ddp,
+            _preds,
+            target,
+            metric_class,
+            sk_metric=_grouped_oracle(oracle, **args),
+            metric_args=args,
+            indexes=_indexes,
+        )
+
+    def test_functional_single_query(self, ddp, metric_class, metric_fn, oracle, args, graded):
+        if ddp:
+            pytest.skip("functional path has no ddp axis")
+        target = _target_graded if graded else _target
+        for i in range(NUM_BATCHES):
+            t = np.asarray(target[i])
+            if not graded and (t.sum() == 0 or t.sum() == len(t)):
+                continue
+            res = metric_fn(_preds[i], target[i], **args)
+            np.testing.assert_allclose(
+                np.asarray(res), oracle(np.asarray(_preds[i]), t, **args), atol=1e-6, err_msg=f"batch {i}"
+            )
+
+
+def test_fall_out():
+    """Fall-out's empty queries are those with no NEGATIVE targets, default action 'pos'."""
+    tester = MetricTester()
+    tester.atol = 1e-6
+    for k in (None, 3):
+        tester.run_class_metric_test(
+            False,
+            _preds,
+            _target,
+            RetrievalFallOut,
+            sk_metric=_grouped_oracle(_np_fall_out, empty_target_action="pos", empty_on="neg", k=k),
+            metric_args={"k": k},
+            indexes=_indexes,
+        )
+
+
+@pytest.mark.parametrize("action", ["neg", "pos", "skip", "error"])
+def test_empty_target_actions(action):
+    preds = jnp.asarray([0.1, 0.9, 0.6, 0.4])
+    target = jnp.asarray([0, 0, 1, 0])  # query 0 empty, query 1 has a positive
+    indexes = jnp.asarray([0, 0, 1, 1])
+    m = RetrievalMAP(empty_target_action=action)
+    m.update(preds, target, indexes)
+    if action == "error":
+        with pytest.raises(ValueError, match="no positive target"):
+            m.compute()
+        return
+    q1 = 1.0  # positive ranked first within its query
+    expected = {"neg": (0.0 + q1) / 2, "pos": (1.0 + q1) / 2, "skip": q1}[action]
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-6)
+
+
+def test_retrieval_raises():
+    with pytest.raises(ValueError, match="Argument `empty_target_action`.*"):
+        RetrievalMAP(empty_target_action="fail")
+    with pytest.raises(ValueError, match="Argument `ignore_index`.*"):
+        RetrievalMAP(ignore_index="q")
+    with pytest.raises(ValueError, match="`k` has to be a positive integer.*"):
+        RetrievalPrecision(k=-1)
+    with pytest.raises(ValueError, match="`k` has to be a positive integer.*"):
+        retrieval_precision(jnp.asarray([0.5, 0.2]), jnp.asarray([1, 0]), k=0)
+    m = RetrievalMAP()
+    with pytest.raises(ValueError, match="`indexes` cannot be None"):
+        m.update(jnp.asarray([0.5]), jnp.asarray([1]), None)
+
+
+def test_ignore_index():
+    preds = jnp.asarray([0.9, 0.8, 0.7, 0.6])
+    target = jnp.asarray([1, -100, 0, 1])
+    indexes = jnp.asarray([0, 0, 0, 0])
+    m = RetrievalMAP(ignore_index=-100)
+    m.update(preds, target, indexes)
+    # stream without the ignored row: targets [1, 0, 1] sorted by score
+    expected = np.mean([1 / 1, 2 / 3])
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-6)
+
+
+def test_grouped_matches_jit():
+    """The grouped segment path must jit with a static query count."""
+    import jax
+
+    from metrics_tpu.functional.retrieval._ranking import _group_by_query
+    from metrics_tpu.functional.retrieval.average_precision import _average_precision_grouped
+
+    def fn(p, t, idx):
+        g = _group_by_query(p, t, idx, num_segments=NUM_QUERIES)
+        return _average_precision_grouped(g)
+
+    res = jax.jit(fn)(_preds[0], _target[0], _indexes[0])
+    oracle = [
+        _np_ap(np.asarray(_preds[0])[np.asarray(_indexes[0]) == q], np.asarray(_target[0])[np.asarray(_indexes[0]) == q])
+        for q in range(NUM_QUERIES)
+    ]
+    np.testing.assert_allclose(np.asarray(res), oracle, atol=1e-6)
