@@ -1,0 +1,115 @@
+"""BLEU score (parity: reference ``torchmetrics/functional/text/bleu.py``).
+
+N-gram counting runs on host (inputs are Python strings); the accumulated
+``numerator/denominator/preds_len/target_len`` counters are device arrays so
+streaming accumulation and cross-device sync stay in the jittable path.
+"""
+from collections import Counter
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _count_ngram(tokens: Sequence[str], n_gram: int) -> Counter:
+    """Multiset of all 1..n_gram-grams of ``tokens``."""
+    counts: Counter = Counter()
+    for n in range(1, n_gram + 1):
+        for j in range(len(tokens) - n + 1):
+            counts[tuple(tokens[j : j + n])] += 1
+    return counts
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[np.ndarray, np.ndarray, int, int]:
+    """Clipped n-gram matches vs the multi-reference union, per BLEU order.
+
+    Returns host numpy deltas ``(numerator, denominator, preds_len,
+    target_len)``; the target length uses the closest-reference-length rule.
+    """
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    preds_len = 0
+    target_len = 0
+    target_tokens: List[List[Sequence[str]]] = [[tokenizer(line) if line else [] for line in t] for t in target]
+    preds_tokens: List[Sequence[str]] = [tokenizer(line) if line else [] for line in preds]
+
+    for pred, refs in zip(preds_tokens, target_tokens):
+        preds_len += len(pred)
+        ref_lens = [len(ref) for ref in refs]
+        closest = min(ref_lens, key=lambda x: (abs(len(pred) - x), x))
+        target_len += closest
+
+        pred_counter = _count_ngram(pred, n_gram)
+        ref_counter: Counter = Counter()
+        for ref in refs:
+            ref_counter |= _count_ngram(ref, n_gram)
+        clipped = pred_counter & ref_counter
+        for ngram, cnt in clipped.items():
+            numerator[len(ngram) - 1] += cnt
+        for ngram, cnt in pred_counter.items():
+            denominator[len(ngram) - 1] += cnt
+    return numerator, denominator, preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """Geometric mean of n-gram precisions with brevity penalty — a pure
+    jittable function of the four counters."""
+    if float(jnp.min(numerator)) == 0.0:
+        return jnp.asarray(0.0, dtype=jnp.float32)
+    if smooth:
+        precision = (numerator + 1.0) / (denominator + 1.0)
+        precision = precision.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision = numerator / denominator
+    log_precision = (1.0 / n_gram) * jnp.log(precision)
+    geometric_mean = jnp.exp(jnp.sum(log_precision))
+    brevity = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - target_len / preds_len))
+    return (brevity * geometric_mean).astype(jnp.float32)
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """BLEU score of machine-translated text against one or more references.
+
+    Example:
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> round(float(bleu_score(preds, target)), 4)
+        0.7598
+    """
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    numerator, denominator, preds_len, target_len = _bleu_score_update(preds_, target_, n_gram)
+    return _bleu_score_compute(
+        jnp.asarray(preds_len, dtype=jnp.float32),
+        jnp.asarray(target_len, dtype=jnp.float32),
+        jnp.asarray(numerator),
+        jnp.asarray(denominator),
+        n_gram,
+        smooth,
+    )

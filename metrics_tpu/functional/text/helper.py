@@ -1,0 +1,37 @@
+"""Host-side sequence-alignment helpers shared by the text metrics.
+
+Parity target: reference ``torchmetrics/functional/text/helper.py`` (plain
+``_edit_distance`` used by WER/CER/MER/WIL/WIP). Strings never touch the
+device: per SURVEY.md §7 the tokenize/align work runs on host and only the
+resulting scalar counters enter the jitted accumulation path. The DP inner
+loop is vectorized with numpy (one ``minimum.accumulate`` per row) instead of
+the reference's pure-Python cell loop.
+"""
+from typing import Sequence
+
+import numpy as np
+
+
+def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence) -> int:
+    """Word/character-level Levenshtein distance with unit costs.
+
+    Vectorized row-DP: for each prediction token the new DP row is
+    ``min(delete, substitute)`` computed elementwise, then the left-to-right
+    insertion dependency ``cur[j] = min(cur[j], cur[j-1]+1)`` is resolved in
+    one pass with the ``minimum.accumulate(cur - j) + j`` identity.
+    """
+    m, n = len(prediction_tokens), len(reference_tokens)
+    if m == 0:
+        return n
+    if n == 0:
+        return m
+    ref = np.asarray(reference_tokens, dtype=object)
+    offsets = np.arange(1, n + 1)
+    prev = np.arange(n + 1)
+    for i, pred_tok in enumerate(prediction_tokens, start=1):
+        cost = (ref != pred_tok).astype(np.int64)
+        cur_tail = np.minimum(prev[1:] + 1, prev[:-1] + cost)
+        cur = np.concatenate(([i], cur_tail))
+        cur = np.minimum.accumulate(cur - np.arange(n + 1)) + np.arange(n + 1)
+        prev = cur
+    return int(prev[-1])

@@ -1,0 +1,362 @@
+"""Translation edit rate (parity: reference ``torchmetrics/functional/text/ter.py``).
+
+TER (Snover et al. 2006): minimum number of edits — insertions, deletions,
+substitutions, and phrase *shifts* — needed to turn a hypothesis into a
+reference, normalized by average reference length. Implemented from the
+published tercom/sacrebleu algorithm description: greedy shift search ranked
+by (edit-gain, span length, earliest hypothesis position, earliest target
+position), repeated until no shift reduces the word-level Levenshtein
+distance. We use an exact trace-producing DP (the reference approximates with
+a beam, ``functional/text/helper.py:136``); host-side work, scalar counter
+states.
+"""
+import re
+from functools import lru_cache
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+_MAX_SHIFT_SIZE = 10
+_MAX_SHIFT_DIST = 50
+_MAX_SHIFT_CANDIDATES = 1000
+
+# edit operations in the alignment trace
+_OP_MATCH, _OP_SUB, _OP_INS, _OP_DEL = "A", "S", "I", "D"
+
+
+class _TercomTokenizer:
+    """Tercom normalization: lowercase, optional western/asian tokenization,
+    optional punctuation removal (following the public tercom Normalizer.java
+    spec as mirrored by sacrebleu's tokenizer_ter)."""
+
+    _ASIAN_PUNCTUATION = r"([、。〈-】〔-〟｡-･・])"
+    _FULL_WIDTH_PUNCTUATION = r"([．，？：；！＂（）])"
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+    ) -> None:
+        self.normalize = normalize
+        self.no_punctuation = no_punctuation
+        self.lowercase = lowercase
+        self.asian_support = asian_support
+
+    @lru_cache(maxsize=2**16)
+    def __call__(self, sentence: str) -> str:
+        if not sentence:
+            return ""
+        if self.lowercase:
+            sentence = sentence.lower()
+        if self.normalize:
+            sentence = self._normalize_general_and_western(sentence)
+            if self.asian_support:
+                sentence = self._normalize_asian(sentence)
+        if self.no_punctuation:
+            sentence = self._remove_punct(sentence)
+            if self.asian_support:
+                sentence = self._remove_asian_punct(sentence)
+        return " ".join(sentence.split())
+
+    @staticmethod
+    def _normalize_general_and_western(sentence: str) -> str:
+        sentence = f" {sentence} "
+        rules = [
+            (r"\n-", ""),
+            (r"\n", " "),
+            (r"&quot;", '"'),
+            (r"&amp;", "&"),
+            (r"&lt;", "<"),
+            (r"&gt;", ">"),
+            (r"([{-~[-` -&(-+:-@/])", r" \1 "),
+            (r"'s ", r" 's "),
+            (r"'s$", r" 's"),
+            (r"([^0-9])([\.,])", r"\1 \2 "),
+            (r"([\.,])([^0-9])", r" \1 \2"),
+            (r"([0-9])(-)", r"\1 \2 "),
+        ]
+        for pattern, replacement in rules:
+            sentence = re.sub(pattern, replacement, sentence)
+        return sentence
+
+    @classmethod
+    def _normalize_asian(cls, sentence: str) -> str:
+        sentence = re.sub(r"([一-鿿㐀-䶿])", r" \1 ", sentence)
+        sentence = re.sub(r"([㇀-㇯⺀-⻿])", r" \1 ", sentence)
+        sentence = re.sub(r"([㌀-㏿豈-﫿︰-﹏])", r" \1 ", sentence)
+        sentence = re.sub(r"([㈀-㼢])", r" \1 ", sentence)
+        sentence = re.sub(r"(^|^[぀-ゟ])([぀-ゟ]+)(?=$|^[぀-ゟ])", r"\1 \2 ", sentence)
+        sentence = re.sub(r"(^|^[゠-ヿ])([゠-ヿ]+)(?=$|^[゠-ヿ])", r"\1 \2 ", sentence)
+        sentence = re.sub(r"(^|^[ㇰ-ㇿ])([ㇰ-ㇿ]+)(?=$|^[ㇰ-ㇿ])", r"\1 \2 ", sentence)
+        sentence = re.sub(cls._ASIAN_PUNCTUATION, r" \1 ", sentence)
+        sentence = re.sub(cls._FULL_WIDTH_PUNCTUATION, r" \1 ", sentence)
+        return sentence
+
+    @staticmethod
+    def _remove_punct(sentence: str) -> str:
+        return re.sub(r"[\.,\?:;!\"\(\)]", "", sentence)
+
+    @classmethod
+    def _remove_asian_punct(cls, sentence: str) -> str:
+        sentence = re.sub(cls._ASIAN_PUNCTUATION, r"", sentence)
+        return re.sub(cls._FULL_WIDTH_PUNCTUATION, r"", sentence)
+
+
+def _edit_distance_with_trace(hyp: Tuple[str, ...], ref: Tuple[str, ...]) -> Tuple[int, str]:
+    """Word-level Levenshtein distance plus an alignment trace.
+
+    Trace ops (hypothesis vs reference): ``A`` match, ``S`` substitute,
+    ``I`` hypothesis-only word (insertion), ``D`` reference-only word
+    (deletion). Backtrace prefers diagonal moves, then insertions.
+    """
+    m, n = len(hyp), len(ref)
+    dist = np.zeros((m + 1, n + 1), dtype=np.int64)
+    dist[:, 0] = np.arange(m + 1)
+    dist[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        sub = dist[i - 1, :-1] + np.array([hyp[i - 1] != r for r in ref], dtype=np.int64)
+        ins = dist[i - 1, 1:] + 1
+        row = np.minimum(sub, ins)
+        row = np.concatenate(([i], row))
+        row = np.minimum.accumulate(row - np.arange(n + 1)) + np.arange(n + 1)
+        dist[i] = row
+    ops: List[str] = []
+    i, j = m, n
+    while i > 0 or j > 0:
+        if i > 0 and j > 0 and dist[i, j] == dist[i - 1, j - 1] + (hyp[i - 1] != ref[j - 1]):
+            ops.append(_OP_MATCH if hyp[i - 1] == ref[j - 1] else _OP_SUB)
+            i, j = i - 1, j - 1
+        elif i > 0 and dist[i, j] == dist[i - 1, j] + 1:
+            ops.append(_OP_INS)
+            i -= 1
+        else:
+            ops.append(_OP_DEL)
+            j -= 1
+    return int(dist[m, n]), "".join(reversed(ops))
+
+
+def _trace_to_alignment(trace: str) -> Tuple[Dict[int, int], List[int], List[int]]:
+    """Map reference positions to aligned hypothesis positions and mark
+    per-position errors on both sides."""
+    pos_hyp, pos_ref = -1, -1
+    alignments: Dict[int, int] = {-1: -1}
+    hyp_errors: List[int] = []
+    ref_errors: List[int] = []
+    for op in trace:
+        if op == _OP_MATCH:
+            pos_hyp += 1
+            pos_ref += 1
+            alignments[pos_ref] = pos_hyp
+            hyp_errors.append(0)
+            ref_errors.append(0)
+        elif op == _OP_SUB:
+            pos_hyp += 1
+            pos_ref += 1
+            alignments[pos_ref] = pos_hyp
+            hyp_errors.append(1)
+            ref_errors.append(1)
+        elif op == _OP_INS:
+            pos_hyp += 1
+            hyp_errors.append(1)
+        else:  # deletion: reference word with no hypothesis counterpart
+            pos_ref += 1
+            alignments[pos_ref] = pos_hyp
+            ref_errors.append(1)
+    return alignments, ref_errors, hyp_errors
+
+
+def _find_shifted_pairs(hyp_words: List[str], ref_words: List[str]) -> Iterator[Tuple[int, int, int]]:
+    """All (hyp_start, ref_start, length) spans where the word sequences
+    agree, bounded by the tercom shift-size/distance limits."""
+    for hyp_start in range(len(hyp_words)):
+        for ref_start in range(len(ref_words)):
+            if abs(ref_start - hyp_start) > _MAX_SHIFT_DIST:
+                continue
+            for length in range(1, _MAX_SHIFT_SIZE):
+                if hyp_words[hyp_start + length - 1] != ref_words[ref_start + length - 1]:
+                    break
+                yield hyp_start, ref_start, length
+                if len(hyp_words) == hyp_start + length or len(ref_words) == ref_start + length:
+                    break
+
+
+def _perform_shift(words: List[str], start: int, length: int, target: int) -> List[str]:
+    """Move ``words[start:start+length]`` so it lands at position ``target``."""
+    if target < start:
+        return words[:target] + words[start : start + length] + words[target:start] + words[start + length :]
+    if target > start + length:
+        return words[:start] + words[start + length : target] + words[start : start + length] + words[target:]
+    return (
+        words[:start]
+        + words[start + length : length + target]
+        + words[start : start + length]
+        + words[length + target :]
+    )
+
+
+class _CachedEditDistance:
+    """Memoized trace DP against a fixed reference."""
+
+    def __init__(self, ref_words: List[str]) -> None:
+        self._ref = tuple(ref_words)
+        self._cache: Dict[Tuple[str, ...], Tuple[int, str]] = {}
+
+    def __call__(self, hyp_words: List[str]) -> Tuple[int, str]:
+        key = tuple(hyp_words)
+        if key not in self._cache:
+            self._cache[key] = _edit_distance_with_trace(key, self._ref)
+        return self._cache[key]
+
+
+def _shift_words(
+    hyp_words: List[str],
+    ref_words: List[str],
+    cached_edit_distance: _CachedEditDistance,
+    checked_candidates: int,
+) -> Tuple[int, List[str], int]:
+    """One round of the tercom greedy shift search: returns the best edit-
+    distance gain, the shifted hypothesis, and the running candidate count."""
+    edit_distance, trace = cached_edit_distance(hyp_words)
+    alignments, ref_errors, hyp_errors = _trace_to_alignment(trace)
+
+    best: Optional[Tuple[int, int, int, int, List[str]]] = None
+    for hyp_start, ref_start, length in _find_shifted_pairs(hyp_words, ref_words):
+        # only shift spans that are wrong in place and whose target is wrong too
+        if sum(hyp_errors[hyp_start : hyp_start + length]) == 0:
+            continue
+        if sum(ref_errors[ref_start : ref_start + length]) == 0:
+            continue
+        if hyp_start <= alignments[ref_start] < hyp_start + length:
+            continue
+
+        prev_idx = -1
+        for offset in range(-1, length):
+            if ref_start + offset == -1:
+                idx = 0
+            elif ref_start + offset in alignments:
+                idx = alignments[ref_start + offset] + 1
+            else:
+                break
+            if idx == prev_idx:
+                continue
+            prev_idx = idx
+            shifted_words = _perform_shift(hyp_words, hyp_start, length, idx)
+            candidate = (
+                edit_distance - cached_edit_distance(shifted_words)[0],
+                length,
+                -hyp_start,
+                -idx,
+                shifted_words,
+            )
+            checked_candidates += 1
+            if best is None or candidate > best:
+                best = candidate
+        if checked_candidates >= _MAX_SHIFT_CANDIDATES:
+            break
+
+    if best is None:
+        return 0, hyp_words, checked_candidates
+    return best[0], best[4], checked_candidates
+
+
+def _translation_edit_rate(hyp_words: List[str], ref_words: List[str]) -> int:
+    """Edits (shifts + word edits) to turn hypothesis into one reference."""
+    if len(ref_words) == 0:
+        return 0
+    cached = _CachedEditDistance(ref_words)
+    num_shifts = 0
+    checked_candidates = 0
+    words = list(hyp_words)
+    while True:
+        delta, new_words, checked_candidates = _shift_words(words, ref_words, cached, checked_candidates)
+        if checked_candidates >= _MAX_SHIFT_CANDIDATES or delta <= 0:
+            break
+        num_shifts += 1
+        words = new_words
+    edit_distance, _ = cached(words)
+    return num_shifts + edit_distance
+
+
+def _compute_sentence_statistics(hyp_words: List[str], ref_sentences: List[List[str]]) -> Tuple[float, float]:
+    """Best (lowest) edit count over references, and average reference length."""
+    total_ref_len = 0.0
+    best_num_edits = float("inf")
+    for ref_words in ref_sentences:
+        total_ref_len += len(ref_words)
+        num_edits = _translation_edit_rate(hyp_words, ref_words)
+        if num_edits < best_num_edits:
+            best_num_edits = num_edits
+    return best_num_edits, total_ref_len / len(ref_sentences)
+
+
+def _compute_ter_score_from_statistics(num_edits: Array, tgt_length: Array) -> Array:
+    return jnp.where(
+        tgt_length > 0,
+        num_edits / jnp.maximum(tgt_length, 1e-16),
+        jnp.where(num_edits > 0, 1.0, 0.0),
+    ).astype(jnp.float32)
+
+
+def _ter_update(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    tokenizer: _TercomTokenizer,
+) -> Tuple[float, float, List[float]]:
+    """Per-batch (total_num_edits, total_tgt_length, sentence_scores)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    target = [[t] if isinstance(t, str) else list(t) for t in target]
+    if len(preds) != len(target):
+        raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
+
+    total_num_edits = 0.0
+    total_tgt_length = 0.0
+    sentence_scores: List[float] = []
+    for pred, refs in zip(preds, target):
+        hyp_words = tokenizer(pred).split()
+        ref_sentences = [tokenizer(ref).split() for ref in refs]
+        num_edits, avg_len = _compute_sentence_statistics(hyp_words, ref_sentences)
+        total_num_edits += num_edits
+        total_tgt_length += avg_len
+        if avg_len > 0 and num_edits > 0:
+            sentence_scores.append(num_edits / avg_len)
+        elif avg_len == 0 and num_edits > 0:
+            sentence_scores.append(1.0)
+        else:
+            sentence_scores.append(0.0)
+    return total_num_edits, total_tgt_length, sentence_scores
+
+
+def _ter_compute(total_num_edits: Array, total_tgt_length: Array) -> Array:
+    return _compute_ter_score_from_statistics(total_num_edits, total_tgt_length)
+
+
+def translation_edit_rate(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    normalize: bool = False,
+    no_punctuation: bool = False,
+    lowercase: bool = True,
+    asian_support: bool = False,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Translation edit rate: word edits plus phrase shifts over reference length.
+
+    Example:
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> round(float(translation_edit_rate(preds, target)), 4)
+        0.1538
+    """
+    tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+    total_num_edits, total_tgt_length, sentence_scores = _ter_update(preds, target, tokenizer)
+    corpus = _ter_compute(jnp.asarray(total_num_edits), jnp.asarray(total_tgt_length))
+    if return_sentence_level_score:
+        return corpus, jnp.asarray(sentence_scores, dtype=jnp.float32)
+    return corpus

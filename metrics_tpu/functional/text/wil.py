@@ -1,0 +1,29 @@
+"""Word information lost (parity: reference ``torchmetrics/functional/text/wil.py``)."""
+from typing import List, Tuple, Union
+
+import jax
+
+from metrics_tpu.functional.text.wip import _wip_update
+
+Array = jax.Array
+
+
+def _wil_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> tuple:
+    return _wip_update(preds, target)
+
+
+def _wil_compute(hits: Array, target_total: Array, preds_total: Array) -> Array:
+    return 1 - (hits / target_total) * (hits / preds_total)
+
+
+def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information lost: ``1 - (H/N_ref) * (H/N_hyp)``.
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(word_information_lost(preds, target)), 4)
+        0.6528
+    """
+    hits, target_total, preds_total = _wil_update(preds, target)
+    return _wil_compute(hits, target_total, preds_total)

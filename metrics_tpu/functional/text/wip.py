@@ -1,0 +1,53 @@
+"""Word information preserved (parity: reference ``torchmetrics/functional/text/wip.py``).
+
+The reference accumulates ``errors - total`` — the *negated* hit count, whose
+sign cancels in the squared compute (``wip.py:54-66``). We store the positive
+hit count ``hits = max_len - edit_distance`` directly; the math is identical.
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+
+def _wip_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array, Array]:
+    """Accumulate word hits and total word counts on both sides."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    hits = 0
+    target_total = 0
+    preds_total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        hits += max(len(tgt_tokens), len(pred_tokens)) - _edit_distance(pred_tokens, tgt_tokens)
+        target_total += len(tgt_tokens)
+        preds_total += len(pred_tokens)
+    return (
+        jnp.asarray(hits, dtype=jnp.float32),
+        jnp.asarray(target_total, dtype=jnp.float32),
+        jnp.asarray(preds_total, dtype=jnp.float32),
+    )
+
+
+def _wip_compute(hits: Array, target_total: Array, preds_total: Array) -> Array:
+    return (hits / target_total) * (hits / preds_total)
+
+
+def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information preserved: ``(H/N_ref) * (H/N_hyp)``.
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(word_information_preserved(preds, target)), 4)
+        0.3472
+    """
+    hits, target_total, preds_total = _wip_update(preds, target)
+    return _wip_compute(hits, target_total, preds_total)

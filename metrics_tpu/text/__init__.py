@@ -1,0 +1,28 @@
+"""Text-domain module metrics (parity: reference ``torchmetrics/text/``)."""
+from metrics_tpu.text.bleu import BLEUScore  # noqa: F401
+from metrics_tpu.text.cer import CharErrorRate  # noqa: F401
+from metrics_tpu.text.chrf import CHRFScore  # noqa: F401
+from metrics_tpu.text.eed import ExtendedEditDistance  # noqa: F401
+from metrics_tpu.text.mer import MatchErrorRate  # noqa: F401
+from metrics_tpu.text.rouge import ROUGEScore  # noqa: F401
+from metrics_tpu.text.sacre_bleu import SacreBLEUScore  # noqa: F401
+from metrics_tpu.text.squad import SQuAD  # noqa: F401
+from metrics_tpu.text.ter import TranslationEditRate  # noqa: F401
+from metrics_tpu.text.wer import WordErrorRate  # noqa: F401
+from metrics_tpu.text.wil import WordInfoLost  # noqa: F401
+from metrics_tpu.text.wip import WordInfoPreserved  # noqa: F401
+
+__all__ = [
+    "BLEUScore",
+    "CHRFScore",
+    "CharErrorRate",
+    "ExtendedEditDistance",
+    "MatchErrorRate",
+    "ROUGEScore",
+    "SQuAD",
+    "SacreBLEUScore",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

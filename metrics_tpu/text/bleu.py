@@ -1,0 +1,46 @@
+"""BLEUScore module metric (parity: reference ``torchmetrics/text/bleu.py:29``)."""
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BLEUScore(Metric):
+    """Streaming corpus-level BLEU with device-array n-gram counters."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, n_gram: int = 4, smooth: bool = False, **kwargs: Any) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        self.tokenizer = _tokenize_fn
+        self.add_state("preds_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", default=jnp.zeros(n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", default=jnp.zeros(n_gram), dist_reduce_fx="sum")
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+        if len(preds_) != len(target_):
+            raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+        numerator, denominator, preds_len, target_len = _bleu_score_update(
+            preds_, target_, self.n_gram, self.tokenizer
+        )
+        self.preds_len = self.preds_len + preds_len
+        self.target_len = self.target_len + target_len
+        self.numerator = self.numerator + jnp.asarray(numerator)
+        self.denominator = self.denominator + jnp.asarray(denominator)
+
+    def compute(self) -> Array:
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator, self.n_gram, self.smooth
+        )

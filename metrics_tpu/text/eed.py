@@ -1,0 +1,59 @@
+"""ExtendedEditDistance module metric (parity: reference ``torchmetrics/text/eed.py:24``)."""
+from typing import Any, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.eed import _eed_compute, _eed_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class ExtendedEditDistance(Metric):
+    """Streaming EED with a per-sentence score buffer."""
+
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        for param_name, param in zip(("alpha", "rho", "deletion", "insertion"), (alpha, rho, deletion, insertion)):
+            if not isinstance(param, float) or param < 0:
+                raise ValueError(f"Parameter `{param_name}` is expected to be a non-negative float.")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+        self.add_state("sentence_eed", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        scores = _eed_update(preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion)
+        if scores:
+            self.sentence_eed.append(jnp.asarray(scores, dtype=jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        s = self.sentence_eed
+        if isinstance(s, list):
+            if len(s) == 0:
+                average = _eed_compute([])
+                return (average, jnp.zeros(0)) if self.return_sentence_level_score else average
+            s = jnp.concatenate([jnp.atleast_1d(x) for x in s])
+        average = _eed_compute(s)
+        if self.return_sentence_level_score:
+            return average, s
+        return average

@@ -1,0 +1,31 @@
+"""MatchErrorRate module metric (parity: reference ``torchmetrics/text/mer.py:24``)."""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.mer import _mer_compute, _mer_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MatchErrorRate(Metric):
+    """Streaming match error rate over transcript batches."""
+
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, total = _mer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _mer_compute(self.errors, self.total)

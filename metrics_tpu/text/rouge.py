@@ -1,0 +1,95 @@
+"""ROUGEScore module metric (parity: reference ``torchmetrics/text/rouge.py:31``)."""
+from typing import Any, Dict, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.rouge import (
+    ALLOWED_ACCUMULATE_VALUES,
+    ALLOWED_ROUGE_KEYS,
+    _rouge_score_compute,
+    _rouge_score_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.imports import _NLTK_AVAILABLE
+
+Array = jax.Array
+
+
+class ROUGEScore(Metric):
+    """Streaming ROUGE with per-sample score buffers (one list state per
+    ``<key>_<stat>`` pair, mirroring reference ``text/rouge.py:131``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        if use_stemmer and not _NLTK_AVAILABLE:
+            raise ModuleNotFoundError("Stemmer requires that `nltk` is installed. Use `pip install nltk`.")
+        if not isinstance(rouge_keys, tuple):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS)}")
+        if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+            raise ValueError(
+                f"Got unknown accumulate value {accumulate}. Expected one of {ALLOWED_ACCUMULATE_VALUES}"
+            )
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+        self.accumulate = accumulate
+        self.use_stemmer = use_stemmer
+        self._stemmer = None
+        if use_stemmer:
+            import nltk
+
+            self._stemmer = nltk.stem.porter.PorterStemmer()
+        for key in self.rouge_keys:
+            for stat in ("fmeasure", "precision", "recall"):
+                self.add_state(f"{key}_{stat}", default=[], dist_reduce_fx=None)
+
+    def update(
+        self,
+        preds: Union[str, Sequence[str]],
+        target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    ) -> None:
+        if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+            target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+        results = _rouge_score_update(preds, target, self.rouge_keys_values, self.accumulate, self._stemmer)
+        for key_name, key_value in zip(self.rouge_keys, self.rouge_keys_values):
+            for row in results[key_value]:
+                for stat, value in row.items():
+                    getattr(self, f"{key_name}_{stat}").append(jnp.asarray(value))
+
+    def compute(self) -> Dict[str, Array]:
+        output = {
+            f"{key}_{stat}": getattr(self, f"{key}_{stat}")
+            for key in self.rouge_keys
+            for stat in ("fmeasure", "precision", "recall")
+        }
+        return _rouge_score_compute(output)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = super().__getstate__()
+        state.pop("_stemmer", None)  # PorterStemmer caches are not picklable targets
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        super().__setstate__(state)
+        self._stemmer = None
+        if self.use_stemmer and _NLTK_AVAILABLE:
+            import nltk
+
+            self._stemmer = nltk.stem.porter.PorterStemmer()

@@ -1,0 +1,20 @@
+"""SacreBLEUScore module metric (parity: reference ``torchmetrics/text/sacre_bleu.py:32``)."""
+from typing import Any, Sequence
+
+from metrics_tpu.functional.text.sacre_bleu import _SacreBLEUTokenizer
+from metrics_tpu.text.bleu import BLEUScore
+
+
+class SacreBLEUScore(BLEUScore):
+    """Streaming corpus-level SacreBLEU: BLEU with canonical tokenization."""
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, **kwargs)
+        self.tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)

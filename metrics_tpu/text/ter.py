@@ -1,0 +1,59 @@
+"""TranslationEditRate module metric (parity: reference ``torchmetrics/text/ter.py:24``)."""
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class TranslationEditRate(Metric):
+    """Streaming corpus-level TER with scalar edit/length counters."""
+
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        for name, value in (
+            ("normalize", normalize),
+            ("no_punctuation", no_punctuation),
+            ("lowercase", lowercase),
+            ("asian_support", asian_support),
+        ):
+            if not isinstance(value, bool):
+                raise ValueError(f"Expected argument `{name}` to be a boolean.")
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+        self.add_state("total_num_edits", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_tgt_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        if self.return_sentence_level_score:
+            self.add_state("sentence_ter", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        num_edits, tgt_length, sentence_scores = _ter_update(preds, target, self.tokenizer)
+        self.total_num_edits = self.total_num_edits + num_edits
+        self.total_tgt_len = self.total_tgt_len + tgt_length
+        if self.return_sentence_level_score:
+            self.sentence_ter.append(jnp.asarray(sentence_scores, dtype=jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        corpus = _ter_compute(self.total_num_edits, self.total_tgt_len)
+        if self.return_sentence_level_score:
+            s = self.sentence_ter
+            if isinstance(s, list):
+                s = jnp.concatenate([jnp.atleast_1d(x) for x in s])
+            return corpus, s
+        return corpus

@@ -1,0 +1,31 @@
+"""WordErrorRate module metric (parity: reference ``torchmetrics/text/wer.py:23``)."""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.wer import _wer_compute, _wer_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class WordErrorRate(Metric):
+    """Streaming word error rate over transcript batches."""
+
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("jit_update", False)  # string inputs never trace
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, total = _wer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _wer_compute(self.errors, self.total)

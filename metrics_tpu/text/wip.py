@@ -1,0 +1,33 @@
+"""WordInfoPreserved module metric (parity: reference ``torchmetrics/text/wip.py:23``)."""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.wip import _wip_compute, _wip_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class WordInfoPreserved(Metric):
+    """Streaming word-information-preserved score over transcript batches."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        self.add_state("hits", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        hits, target_total, preds_total = _wip_update(preds, target)
+        self.hits = self.hits + hits
+        self.target_total = self.target_total + target_total
+        self.preds_total = self.preds_total + preds_total
+
+    def compute(self) -> Array:
+        return _wip_compute(self.hits, self.target_total, self.preds_total)

@@ -1,0 +1,110 @@
+"""Text-metric test harness (JAX analog of reference ``tests/text/helpers.py``).
+
+Same invariants as ``tests/helpers/testers.MetricTester`` but for host-string
+inputs: batch-wise forward vs oracle, corpus compute vs oracle on all data,
+and emulated-DDP (per-rank instances + injected gather) equality with the
+oracle on the rank-major concatenation.
+"""
+import pickle
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from tests.helpers.testers import _assert_allclose, _fake_gather_factory
+
+NUM_BATCHES = 4
+
+
+def _flatten(batches: Sequence[Sequence]) -> List:
+    return [item for batch in batches for item in batch]
+
+
+class TextTester:
+    atol: float = 1e-6
+
+    def run_functional_metric_test(
+        self,
+        preds: Sequence[Sequence[str]],
+        targets: Sequence[Sequence],
+        metric_functional: Callable,
+        reference_metric: Callable,
+        metric_args: Optional[dict] = None,
+        key: Optional[str] = None,
+    ) -> None:
+        metric_args = metric_args or {}
+        for p_batch, t_batch in zip(preds, targets):
+            res = metric_functional(p_batch, t_batch, **metric_args)
+            ref = reference_metric(p_batch, t_batch)
+            _assert_allclose(res, ref, atol=self.atol, key=key)
+
+    def run_class_metric_test(
+        self,
+        ddp: bool,
+        preds: Sequence[Sequence[str]],
+        targets: Sequence[Sequence],
+        metric_class: type,
+        reference_metric: Callable,
+        metric_args: Optional[dict] = None,
+        check_batch: bool = True,
+        key: Optional[str] = None,
+    ) -> None:
+        metric_args = metric_args or {}
+        if ddp:
+            self._ddp_test(preds, targets, metric_class, reference_metric, metric_args, key)
+        else:
+            self._serial_test(preds, targets, metric_class, reference_metric, metric_args, check_batch, key)
+
+    def _serial_test(
+        self,
+        preds: Sequence[Sequence[str]],
+        targets: Sequence[Sequence],
+        metric_class: type,
+        reference_metric: Callable,
+        metric_args: dict,
+        check_batch: bool,
+        key: Optional[str],
+    ) -> None:
+        metric = metric_class(**metric_args)
+        metric = pickle.loads(pickle.dumps(metric))  # pickling round-trip
+
+        for p_batch, t_batch in zip(preds, targets):
+            batch_result = metric(p_batch, t_batch)
+            if check_batch:
+                ref = reference_metric(p_batch, t_batch)
+                _assert_allclose(batch_result, ref, atol=self.atol, key=key)
+
+        result = metric.compute()
+        ref_total = reference_metric(_flatten(preds), _flatten(targets))
+        _assert_allclose(result, ref_total, atol=self.atol, key=key)
+
+        # compute() is cached and repeatable
+        _assert_allclose(metric.compute(), result, atol=self.atol, key=key)
+        metric.reset()
+        assert metric._update_count == 0
+
+    def _ddp_test(
+        self,
+        preds: Sequence[Sequence[str]],
+        targets: Sequence[Sequence],
+        metric_class: type,
+        reference_metric: Callable,
+        metric_args: dict,
+        key: Optional[str],
+    ) -> None:
+        world_size = 2
+        rank_metrics = [metric_class(**metric_args) for _ in range(world_size)]
+        for rank, metric in enumerate(rank_metrics):
+            for i in range(rank, len(preds), world_size):
+                metric.update(preds[i], targets[i])
+
+        gather = _fake_gather_factory(rank_metrics)
+        m0 = rank_metrics[0]
+        m0.dist_sync_fn = gather
+        m0._distributed_available_fn = lambda: True
+        result = m0.compute()
+
+        order = [i for rank in range(world_size) for i in range(rank, len(preds), world_size)]
+        all_preds = _flatten([preds[i] for i in order])
+        all_targets = _flatten([targets[i] for i in order])
+        ref_total = reference_metric(all_preds, all_targets)
+        _assert_allclose(result, ref_total, atol=self.atol, key=key)

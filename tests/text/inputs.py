@@ -1,0 +1,55 @@
+"""Deterministic text fixtures (mirrors reference ``tests/text/inputs.py``):
+batched hypothesis/reference bundles with single and multiple references."""
+from collections import namedtuple
+
+TextInput = namedtuple("TextInput", ["preds", "targets"])
+
+# 4 batches x 2 sentences, 2 references each
+_preds_multi = [
+    ["the cat is on the mat", "hello there general kenobi"],
+    ["master kenobi you are a bold one", "there is a tower of strength"],
+    ["the quick brown fox jumps over the lazy dog", "a stitch in time saves nine"],
+    ["my hovercraft is full of eels", "it was the best of times"],
+]
+_targets_multi = [
+    [
+        ["there is a cat on the mat", "a cat is on the mat"],
+        ["hello there general kenobi", "hello there!"],
+    ],
+    [
+        ["general kenobi you are a bold one", "you are such a bold one master"],
+        ["there is a tower of strength in him", "a tower of strength stands there"],
+    ],
+    [
+        ["the quick brown fox jumped over the lazy dog", "a quick brown fox jumps over a lazy dog"],
+        ["a stitch in time saves nine", "one stitch in time may save nine"],
+    ],
+    [
+        ["my hovercraft is full of eels", "the hovercraft was full of eels"],
+        ["it was the worst of times", "those were the best of times"],
+    ],
+]
+
+_inputs_multiple_references = TextInput(preds=_preds_multi, targets=_targets_multi)
+
+# same corpus with a single reference each (first ref)
+_inputs_single_reference = TextInput(
+    preds=_preds_multi,
+    targets=[[refs[0] for refs in batch] for batch in _targets_multi],
+)
+
+# error-rate style inputs: plain (pred, target) string pairs
+_inputs_error_rate_batch_size_2 = TextInput(
+    preds=[
+        ["this is the prediction", "there is an other sample"],
+        ["hello world once more", "the rain in spain stays mainly"],
+        ["nothing matches here at all", "an exact match of everything"],
+        ["partial overlap with some words", "word salad with extra dressing"],
+    ],
+    targets=[
+        ["this is the reference", "there is another one"],
+        ["hello beautiful world", "the rain in spain falls mainly on the plain"],
+        ["completely different sentence", "an exact match of everything"],
+        ["partial overlap with other words", "fresh word salad with dressing"],
+    ],
+)

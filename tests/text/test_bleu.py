@@ -1,0 +1,86 @@
+"""BLEU / SacreBLEU vs the sacrebleu package
+(mirrors reference ``tests/text/test_{bleu,sacre_bleu}.py``)."""
+from functools import partial
+
+import pytest
+from sacrebleu.metrics import BLEU
+
+from metrics_tpu import BLEUScore, SacreBLEUScore
+from metrics_tpu.functional import bleu_score, sacre_bleu_score
+from tests.text.helpers import TextTester
+from tests.text.inputs import _inputs_multiple_references
+from metrics_tpu.utils.imports import _REGEX_AVAILABLE
+
+TOKENIZERS = ["none", "13a", "char"] + (["intl"] if _REGEX_AVAILABLE else [])
+
+
+def _sacrebleu_oracle(preds, targets, tokenize, lowercase):
+    """sacrebleu wants ref streams: one list per reference position."""
+    n_refs = len(targets[0])
+    ref_streams = [[refs[i] for refs in targets] for i in range(n_refs)]
+    bleu = BLEU(tokenize=tokenize, lowercase=lowercase)
+    return bleu.corpus_score(preds, ref_streams).score / 100
+
+
+class TestSacreBLEU(TextTester):
+    atol = 1e-4  # float32 counters vs sacrebleu float64
+
+    @pytest.mark.parametrize("tokenize", TOKENIZERS)
+    @pytest.mark.parametrize("lowercase", [False, True])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, tokenize, lowercase, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_inputs_multiple_references.preds,
+            targets=_inputs_multiple_references.targets,
+            metric_class=SacreBLEUScore,
+            reference_metric=partial(_sacrebleu_oracle, tokenize=tokenize, lowercase=lowercase),
+            metric_args={"tokenize": tokenize, "lowercase": lowercase},
+            check_batch=False,  # sacrebleu smooths empty n-gram batches differently
+        )
+
+    @pytest.mark.parametrize("tokenize", TOKENIZERS)
+    def test_functional(self, tokenize):
+        preds = [p for batch in _inputs_multiple_references.preds for p in batch]
+        targets = [t for batch in _inputs_multiple_references.targets for t in batch]
+        res = float(sacre_bleu_score(preds, targets, tokenize=tokenize))
+        ref = _sacrebleu_oracle(preds, targets, tokenize, False)
+        assert res == pytest.approx(ref, abs=1e-4)
+
+
+class TestBLEU(TextTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        # plain whitespace tokenization == sacrebleu tokenize="none"
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_inputs_multiple_references.preds,
+            targets=_inputs_multiple_references.targets,
+            metric_class=BLEUScore,
+            reference_metric=partial(_sacrebleu_oracle, tokenize="none", lowercase=False),
+            check_batch=False,
+        )
+
+    def test_known_value(self):
+        preds = ["the cat is on the mat"]
+        target = [["there is a cat on the mat", "a cat is on the mat"]]
+        assert float(bleu_score(preds, target)) == pytest.approx(0.7598, abs=1e-4)
+
+    def test_smooth(self):
+        preds = ["the cat is on the mat"]
+        target = [["there is a cat on the mat"]]
+        # zero matches at any order short-circuits to 0 even when smoothing
+        assert float(bleu_score(preds, target, smooth=True, n_gram=4)) == 0.0
+        smooth = float(bleu_score(preds, target, smooth=True, n_gram=2))
+        plain = float(bleu_score(preds, target, smooth=False, n_gram=2))
+        assert smooth > 0
+        assert smooth != plain
+
+    def test_zero_when_no_match(self):
+        assert float(bleu_score(["xyzzy"], [["hello world"]])) == 0.0
+
+    def test_corpus_size_mismatch(self):
+        with pytest.raises(ValueError, match="Corpus has different size"):
+            bleu_score(["a", "b"], [["a"]])

@@ -1,0 +1,102 @@
+"""CHRFScore vs sacrebleu CHRF(eps_smoothing=True)
+(mirrors reference ``tests/text/test_chrf.py``, same oracle configuration)."""
+from functools import partial
+
+import jax.numpy as jnp
+import pytest
+from sacrebleu.metrics import CHRF
+
+from metrics_tpu import CHRFScore
+from metrics_tpu.functional import chrf_score
+from tests.text.helpers import TextTester
+from tests.text.inputs import _inputs_multiple_references
+
+
+def _chrf_oracle(preds, targets, char_order, word_order, lowercase, whitespace):
+    n_refs = len(targets[0])
+    ref_streams = [[refs[i] for refs in targets] for i in range(n_refs)]
+    metric = CHRF(
+        char_order=char_order,
+        word_order=word_order,
+        lowercase=lowercase,
+        whitespace=whitespace,
+        eps_smoothing=True,
+    )
+    return metric.corpus_score(preds, ref_streams).score / 100
+
+
+@pytest.mark.parametrize(
+    ["char_order", "word_order", "lowercase", "whitespace"],
+    [
+        (6, 2, False, False),
+        (6, 2, False, True),
+        (4, 2, True, False),
+        (6, 0, True, False),
+        (6, 0, True, True),
+        (4, 0, False, True),
+    ],
+)
+class TestCHRFScore(TextTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, char_order, word_order, lowercase, whitespace, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_inputs_multiple_references.preds,
+            targets=_inputs_multiple_references.targets,
+            metric_class=CHRFScore,
+            reference_metric=partial(
+                _chrf_oracle,
+                char_order=char_order,
+                word_order=word_order,
+                lowercase=lowercase,
+                whitespace=whitespace,
+            ),
+            metric_args={
+                "n_char_order": char_order,
+                "n_word_order": word_order,
+                "lowercase": lowercase,
+                "whitespace": whitespace,
+            },
+        )
+
+    def test_functional(self, char_order, word_order, lowercase, whitespace):
+        preds = [p for batch in _inputs_multiple_references.preds for p in batch]
+        targets = [t for batch in _inputs_multiple_references.targets for t in batch]
+        res = float(
+            chrf_score(
+                preds,
+                targets,
+                n_char_order=char_order,
+                n_word_order=word_order,
+                lowercase=lowercase,
+                whitespace=whitespace,
+            )
+        )
+        ref = _chrf_oracle(preds, targets, char_order, word_order, lowercase, whitespace)
+        assert res == pytest.approx(ref, abs=1e-5)
+
+
+def test_sentence_level_scores():
+    metric = CHRFScore(return_sentence_level_score=True)
+    for p_batch, t_batch in zip(_inputs_multiple_references.preds, _inputs_multiple_references.targets):
+        metric.update(p_batch, t_batch)
+    corpus, sentences = metric.compute()
+    total = sum(len(b) for b in _inputs_multiple_references.preds)
+    assert sentences.shape == (total,)
+    assert jnp.all((sentences >= 0) & (sentences <= 1))
+
+
+def test_corpus_size_mismatch():
+    with pytest.raises(ValueError, match="Corpus has different size"):
+        chrf_score(["hello there", "foo bar"], [["hello there"]])
+
+
+def test_chrf_arg_validation():
+    with pytest.raises(ValueError):
+        CHRFScore(n_char_order=0)
+    with pytest.raises(ValueError):
+        CHRFScore(n_word_order=-1)
+    with pytest.raises(ValueError):
+        CHRFScore(beta=-1)

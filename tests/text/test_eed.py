@@ -1,0 +1,66 @@
+"""ExtendedEditDistance tests: pinned published values + structural
+properties (mirrors reference ``tests/text/test_eed.py``; no offline oracle
+package exists, so corpus values are pinned from the published EED examples)."""
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import ExtendedEditDistance
+from metrics_tpu.functional import extended_edit_distance
+from tests.text.helpers import TextTester
+from tests.text.inputs import _inputs_single_reference
+
+
+def _eed_mean_oracle(preds, targets):
+    """Average of independently-computed sentence scores — exercises that the
+    streaming buffer reproduces per-call scoring."""
+    scores = [float(extended_edit_distance([p], [[t] if isinstance(t, str) else t])) for p, t in zip(preds, targets)]
+    return sum(scores) / len(scores)
+
+
+class TestEED(TextTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_inputs_single_reference.preds,
+            targets=_inputs_single_reference.targets,
+            metric_class=ExtendedEditDistance,
+            reference_metric=_eed_mean_oracle,
+            check_batch=False,  # batch value is the running mean, not batch-local
+        )
+
+
+def test_known_value():
+    """Pinned from the published EED reference implementation example."""
+    preds = ["this is the prediction", "here is an other sample"]
+    target = ["this is the reference", "here is another one"]
+    assert float(extended_edit_distance(preds, target)) == pytest.approx(0.3078, abs=1e-4)
+
+
+def test_identity_is_near_zero():
+    # EED of identical sentences is small but nonzero: the coverage penalty
+    # counts never-visited grid cells even on a perfect diagonal alignment
+    score = float(extended_edit_distance(["same sentence"], [["same sentence"]]))
+    assert 0.0 <= score < 0.05
+
+
+def test_score_bounded():
+    score = extended_edit_distance(["xyzzy qwerty"], [["completely unrelated text here"]])
+    assert 0.0 <= float(score) <= 1.0
+
+
+def test_sentence_level():
+    avg, sentences = extended_edit_distance(
+        ["this is the prediction", "here is an other sample"],
+        ["this is the reference", "here is another one"],
+        return_sentence_level_score=True,
+    )
+    assert sentences.shape == (2,)
+    assert float(avg) == pytest.approx(float(jnp.mean(sentences)))
+
+
+def test_param_validation():
+    with pytest.raises(ValueError):
+        extended_edit_distance(["a"], [["a"]], alpha=-1.0)
+    with pytest.raises(ValueError):
+        ExtendedEditDistance(language="fr")

@@ -1,0 +1,67 @@
+"""ROUGEScore vs the rouge-score package
+(mirrors reference ``tests/text/test_rouge.py``, same oracle package)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from rouge_score import rouge_scorer
+
+from metrics_tpu import ROUGEScore
+from metrics_tpu.functional import rouge_score as tm_rouge_score
+from tests.text.helpers import TextTester
+from tests.text.inputs import _inputs_single_reference
+
+_KEYS = ("rouge1", "rouge2", "rougeL")
+
+
+def _rouge_oracle(preds, targets, use_stemmer=False):
+    """Mean per-sentence rouge-score results (single reference)."""
+    scorer = rouge_scorer.RougeScorer(list(_KEYS), use_stemmer=use_stemmer)
+    rows = [scorer.score(t, p) for p, t in zip(preds, targets)]
+    out = {}
+    for key in _KEYS:
+        out[f"{key}_fmeasure"] = np.mean([r[key].fmeasure for r in rows])
+        out[f"{key}_precision"] = np.mean([r[key].precision for r in rows])
+        out[f"{key}_recall"] = np.mean([r[key].recall for r in rows])
+    return out
+
+
+@pytest.mark.parametrize("use_stemmer", [False, True])
+class TestROUGEScore(TextTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, use_stemmer, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_inputs_single_reference.preds,
+            targets=_inputs_single_reference.targets,
+            metric_class=ROUGEScore,
+            reference_metric=partial(_rouge_oracle, use_stemmer=use_stemmer),
+            metric_args={"rouge_keys": _KEYS, "use_stemmer": use_stemmer},
+            check_batch=False,  # forward returns the running mean for list states
+        )
+
+    def test_functional(self, use_stemmer):
+        preds = [p for batch in _inputs_single_reference.preds for p in batch]
+        targets = [t for batch in _inputs_single_reference.targets for t in batch]
+        res = tm_rouge_score(preds, targets, rouge_keys=_KEYS, use_stemmer=use_stemmer)
+        ref = _rouge_oracle(preds, targets, use_stemmer=use_stemmer)
+        for k, v in ref.items():
+            assert float(res[k]) == pytest.approx(v, abs=1e-6), k
+
+
+def test_multi_reference_best_vs_avg():
+    preds = ["the cat sat on the mat"]
+    targets = [["the cat sat on the mat", "completely different words"]]
+    best = tm_rouge_score(preds, targets, accumulate="best", rouge_keys=("rouge1",))
+    avg = tm_rouge_score(preds, targets, accumulate="avg", rouge_keys=("rouge1",))
+    assert float(best["rouge1_fmeasure"]) == pytest.approx(1.0)
+    assert float(avg["rouge1_fmeasure"]) < 1.0
+
+
+def test_unknown_key_raises():
+    with pytest.raises(ValueError):
+        tm_rouge_score("a", "a", rouge_keys=("rouge42",))
+    with pytest.raises(ValueError):
+        ROUGEScore(rouge_keys=("rouge42",))

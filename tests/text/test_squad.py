@@ -1,0 +1,71 @@
+"""SQuAD EM/F1 tests vs hand-computed oracle values
+(mirrors reference ``tests/text/test_squad.py``)."""
+import pytest
+
+from metrics_tpu import SQuAD
+from metrics_tpu.functional import squad
+
+_BATCHES = [
+    {
+        "preds": [{"prediction_text": "1976", "id": "id1"}],
+        "target": [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "id1"}],
+        "em": 100.0,
+        "f1": 100.0,
+    },
+    {
+        "preds": [{"prediction_text": "the danish defence", "id": "id2"}],
+        "target": [{"answers": {"answer_start": [0], "text": ["The Danish Defence!"]}, "id": "id2"}],
+        "em": 100.0,  # normalization strips case, punctuation, articles
+        "f1": 100.0,
+    },
+    {
+        "preds": [{"prediction_text": "london calling", "id": "id3"}],
+        "target": [{"answers": {"answer_start": [0], "text": ["paris is calling"]}, "id": "id3"}],
+        "em": 0.0,
+        "f1": 100.0 * (2 * (1 / 2) * (1 / 3) / ((1 / 2) + (1 / 3))),
+    },
+]
+
+
+@pytest.mark.parametrize("case", _BATCHES)
+def test_squad_functional(case):
+    scores = squad(case["preds"], case["target"])
+    assert float(scores["exact_match"]) == pytest.approx(case["em"], abs=1e-4)
+    assert float(scores["f1"]) == pytest.approx(case["f1"], abs=1e-4)
+
+
+def test_squad_class_streaming():
+    metric = SQuAD()
+    for case in _BATCHES:
+        metric.update(case["preds"], case["target"])
+    scores = metric.compute()
+    assert float(scores["exact_match"]) == pytest.approx(sum(c["em"] for c in _BATCHES) / len(_BATCHES), abs=1e-4)
+    assert float(scores["f1"]) == pytest.approx(sum(c["f1"] for c in _BATCHES) / len(_BATCHES), abs=1e-4)
+
+
+def test_squad_multiple_answers_takes_max():
+    preds = [{"prediction_text": "forty two", "id": "q"}]
+    target = [{"answers": {"text": ["42", "forty two"]}, "id": "q"}]
+    scores = squad(preds, target)
+    assert float(scores["exact_match"]) == 100.0
+
+
+def test_squad_missing_keys_raise():
+    with pytest.raises(KeyError):
+        squad([{"wrong": "x", "id": "1"}], [{"answers": {"text": ["x"]}, "id": "1"}])
+    with pytest.raises(KeyError):
+        squad([{"prediction_text": "x", "id": "1"}], [{"id": "1"}])
+    with pytest.raises(KeyError):
+        squad([{"prediction_text": "x", "id": "1"}], [{"answers": {"answer_start": [0]}, "id": "1"}])
+
+
+def test_squad_unanswered_question_scores_zero():
+    with pytest.warns(UserWarning):
+        scores = squad(
+            [{"prediction_text": "a", "id": "known"}],
+            [
+                {"answers": {"text": ["a"]}, "id": "known"},
+                {"answers": {"text": ["b"]}, "id": "unknown"},
+            ],
+        )
+    assert float(scores["exact_match"]) == pytest.approx(50.0)

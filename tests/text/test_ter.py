@@ -1,0 +1,93 @@
+"""TranslationEditRate vs sacrebleu TER
+(mirrors reference ``tests/text/test_ter.py``, same oracle)."""
+from functools import partial
+
+import jax.numpy as jnp
+import pytest
+from sacrebleu.metrics import TER
+
+from metrics_tpu import TranslationEditRate
+from metrics_tpu.functional import translation_edit_rate
+from tests.text.helpers import TextTester
+from tests.text.inputs import _inputs_multiple_references
+
+
+def _ter_oracle(preds, targets, normalized, no_punct, lowercase, asian_support):
+    n_refs = len(targets[0])
+    ref_streams = [[refs[i] for refs in targets] for i in range(n_refs)]
+    metric = TER(
+        normalized=normalized,
+        no_punct=no_punct,
+        case_sensitive=not lowercase,
+        asian_support=asian_support,
+    )
+    return metric.corpus_score(preds, ref_streams).score / 100
+
+
+@pytest.mark.parametrize(
+    ["normalize", "no_punctuation", "lowercase", "asian_support"],
+    [
+        (False, False, True, False),
+        (True, False, True, False),
+        (False, True, True, False),
+        (False, False, False, False),
+        (True, True, True, True),
+    ],
+)
+class TestTER(TextTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, normalize, no_punctuation, lowercase, asian_support, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_inputs_multiple_references.preds,
+            targets=_inputs_multiple_references.targets,
+            metric_class=TranslationEditRate,
+            reference_metric=partial(
+                _ter_oracle,
+                normalized=normalize,
+                no_punct=no_punctuation,
+                lowercase=lowercase,
+                asian_support=asian_support,
+            ),
+            metric_args={
+                "normalize": normalize,
+                "no_punctuation": no_punctuation,
+                "lowercase": lowercase,
+                "asian_support": asian_support,
+            },
+        )
+
+    def test_functional(self, normalize, no_punctuation, lowercase, asian_support):
+        preds = [p for batch in _inputs_multiple_references.preds for p in batch]
+        targets = [t for batch in _inputs_multiple_references.targets for t in batch]
+        res = float(
+            translation_edit_rate(
+                preds,
+                targets,
+                normalize=normalize,
+                no_punctuation=no_punctuation,
+                lowercase=lowercase,
+                asian_support=asian_support,
+            )
+        )
+        ref = _ter_oracle(preds, targets, normalize, no_punctuation, lowercase, asian_support)
+        assert res == pytest.approx(ref, abs=1e-6)
+
+
+def test_shift_reduces_edits():
+    """A pure reorder should cost one shift, not multiple substitutions."""
+    score = translation_edit_rate(["b c a"], [["a b c"]])
+    assert float(score) == pytest.approx(1 / 3)
+
+
+def test_sentence_level_scores():
+    metric = TranslationEditRate(return_sentence_level_score=True)
+    metric.update(
+        ["the cat is on the mat", "hello there general kenobi"],
+        [["there is a cat on the mat"], ["hello there!"]],
+    )
+    corpus, sentences = metric.compute()
+    assert sentences.shape == (2,)
+    assert float(corpus) > 0

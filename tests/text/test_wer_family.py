@@ -1,0 +1,117 @@
+"""WER/CER/MER/WIL/WIP vs an independent full-matrix DP oracle
+(mirrors reference ``tests/text/test_{wer,cer,mer,wil,wip}.py``; the jiwer
+oracle is unavailable offline, so the oracle is a plain-python Levenshtein)."""
+import numpy as np
+import pytest
+
+from metrics_tpu import CharErrorRate, MatchErrorRate, WordErrorRate, WordInfoLost, WordInfoPreserved
+from metrics_tpu.functional import (
+    char_error_rate,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from tests.text.helpers import TextTester
+from tests.text.inputs import _inputs_error_rate_batch_size_2
+
+
+def _naive_edit_distance(a, b):
+    """Classic full-matrix Levenshtein, intentionally unrelated to the
+    library's vectorized row-DP."""
+    dp = [[0] * (len(b) + 1) for _ in range(len(a) + 1)]
+    for i in range(len(a) + 1):
+        dp[i][0] = i
+    for j in range(len(b) + 1):
+        dp[0][j] = j
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            dp[i][j] = min(
+                dp[i - 1][j] + 1,
+                dp[i][j - 1] + 1,
+                dp[i - 1][j - 1] + (a[i - 1] != b[j - 1]),
+            )
+    return dp[-1][-1]
+
+
+def _oracle_counts(preds, targets, tokenize):
+    errors = hits = tgt_total = pred_total = max_total = 0
+    for p, t in zip(preds, targets):
+        pt, tt = tokenize(p), tokenize(t)
+        d = _naive_edit_distance(pt, tt)
+        errors += d
+        hits += max(len(pt), len(tt)) - d
+        tgt_total += len(tt)
+        pred_total += len(pt)
+        max_total += max(len(pt), len(tt))
+    return errors, hits, tgt_total, pred_total, max_total
+
+
+def _oracle_wer(preds, targets):
+    e, _, t, _, _ = _oracle_counts(preds, targets, str.split)
+    return e / t
+
+
+def _oracle_cer(preds, targets):
+    e, _, t, _, _ = _oracle_counts(preds, targets, list)
+    return e / t
+
+
+def _oracle_mer(preds, targets):
+    e, _, _, _, m = _oracle_counts(preds, targets, str.split)
+    return e / m
+
+
+def _oracle_wip(preds, targets):
+    _, h, t, p, _ = _oracle_counts(preds, targets, str.split)
+    return (h / t) * (h / p)
+
+
+def _oracle_wil(preds, targets):
+    return 1 - _oracle_wip(preds, targets)
+
+
+_CASES = [
+    (WordErrorRate, word_error_rate, _oracle_wer),
+    (CharErrorRate, char_error_rate, _oracle_cer),
+    (MatchErrorRate, match_error_rate, _oracle_mer),
+    (WordInfoPreserved, word_information_preserved, _oracle_wip),
+    (WordInfoLost, word_information_lost, _oracle_wil),
+]
+
+
+@pytest.mark.parametrize("metric_class, metric_fn, oracle", _CASES)
+class TestErrorRates(TextTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, metric_class, metric_fn, oracle, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_inputs_error_rate_batch_size_2.preds,
+            targets=_inputs_error_rate_batch_size_2.targets,
+            metric_class=metric_class,
+            reference_metric=oracle,
+        )
+
+    def test_functional(self, metric_class, metric_fn, oracle):
+        self.run_functional_metric_test(
+            _inputs_error_rate_batch_size_2.preds,
+            _inputs_error_rate_batch_size_2.targets,
+            metric_fn,
+            oracle,
+        )
+
+
+def test_known_values():
+    """Pinned values from the published WER/MER/WIP/WIL examples."""
+    preds = ["this is the prediction", "there is an other sample"]
+    target = ["this is the reference", "there is another one"]
+    assert float(word_error_rate(preds, target)) == pytest.approx(0.5)
+    assert float(match_error_rate(preds, target)) == pytest.approx(0.4444, abs=1e-4)
+    assert float(word_information_preserved(preds, target)) == pytest.approx(0.3472, abs=1e-4)
+    assert float(word_information_lost(preds, target)) == pytest.approx(0.6528, abs=1e-4)
+    assert float(char_error_rate(preds, target)) == pytest.approx(0.3415, abs=1e-4)
+
+
+def test_single_string_input():
+    assert float(word_error_rate("hello world", "hello world")) == 0.0
+    assert float(char_error_rate("abcd", "abcd")) == 0.0
