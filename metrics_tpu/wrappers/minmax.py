@@ -1,0 +1,85 @@
+"""Track the running min/max of a wrapped metric's computed value.
+
+Parity target: reference ``torchmetrics/wrappers/minmax.py:23``
+(``MinMaxMetric``). The min/max trackers are plain host attributes (not
+registered states): they are derived from *computed* values, updated inside
+``compute``, and must survive the sync/unsync state-restoration cycle —
+exactly why the reference keeps them as buffers rather than metric states.
+"""
+from typing import Any, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MinMaxMetric(Metric):
+    """Return ``{"raw", "min", "max"}`` of the wrapped metric each compute."""
+
+    full_state_update = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        kwargs.setdefault("jit_update", False)  # update mutates the child metric
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.min_val = jnp.asarray(float("inf"))
+        self.max_val = jnp.asarray(float("-inf"))
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the underlying metric (reference ``minmax.py:76-78``)."""
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Compute the wrapped metric and fold it into the min/max trackers
+        (reference ``minmax.py:80-93``)."""
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(
+                f"Returned value from base metric should be a scalar (int, float or tensor of size 1, but got {val}"
+            )
+        val = jnp.asarray(val)
+        self.max_val = jnp.maximum(self.max_val, val)
+        self.min_val = jnp.minimum(self.min_val, val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Batch-local value from the base metric's forward, folded into the
+        trackers (matches the reference's observable forward semantics)."""
+        batch_val = self._base_metric(*args, **kwargs)
+        self._update_count += 1
+        self._computed = None
+        if batch_val is None or not self.compute_on_step:
+            return None
+        if not self._is_suitable_val(batch_val):
+            raise RuntimeError(
+                f"Returned value from base metric should be a scalar (int, float or tensor of size 1, but got {batch_val}"
+            )
+        batch_val = jnp.asarray(batch_val)
+        self.max_val = jnp.maximum(self.max_val, batch_val)
+        self.min_val = jnp.minimum(self.min_val, batch_val)
+        out = {"raw": batch_val, "max": self.max_val, "min": self.min_val}
+        self._forward_cache = out
+        return out
+
+    def reset(self) -> None:
+        """Reset trackers to their initialization bounds and the base metric
+        (reference ``minmax.py:95-98``)."""
+        super().reset()
+        self.min_val = jnp.asarray(float("inf"))
+        self.max_val = jnp.asarray(float("-inf"))
+        self._base_metric.reset()
+
+    @staticmethod
+    def _is_suitable_val(val: Union[int, float, Array]) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, (jax.Array, jnp.ndarray)):
+            return val.size == 1
+        return False

@@ -1,0 +1,116 @@
+"""Per-output cloning wrapper for metrics without native multioutput support.
+
+Parity target: reference ``torchmetrics/wrappers/multioutput.py:23``
+(``MultioutputWrapper``; NaN-row removal ``_get_nan_indices`` :11). NaN-row
+removal produces data-dependent shapes, so it runs host-side (numpy boolean
+indexing) and the clones update eagerly — the same host/device split the
+reference has implicitly (its ``index_select`` + mask also materializes on the
+update path, outside any compiled graph).
+"""
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import apply_to_collection
+
+Array = jax.Array
+_ARRAY_TYPES = (jax.Array, jnp.ndarray, np.ndarray)
+
+
+def _get_nan_indices(*arrays: np.ndarray) -> np.ndarray:
+    """Boolean mask of rows (dim 0) containing NaN in any input (reference
+    ``multioutput.py:11-20``)."""
+    if len(arrays) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel = arrays[0]
+    nan_idxs = np.zeros(len(sentinel), dtype=bool)
+    for arr in arrays:
+        flat = np.asarray(arr, dtype=np.float64).reshape(len(arr), -1)
+        nan_idxs |= np.any(np.isnan(flat), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(Metric):
+    """Compute one clone of ``base_metric`` per output dimension.
+
+    ``compute`` returns a list of per-output values — no aggregation across
+    outputs, mirroring the reference contract.
+    """
+
+    is_differentiable = False
+    full_state_update = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)  # update mutates the child clones
+        super().__init__(**kwargs)
+        self.metrics = [base_metric.clone() for _ in range(num_outputs)]
+        for m in self.metrics:
+            m.reset()
+            if remove_nans:
+                # NaN-row removal yields variable batch lengths, which would
+                # recompile each clone's jitted transition on every new length
+                m._enable_jit = False
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Any, **kwargs: Any) -> List[Tuple[list, dict]]:
+        """Slice inputs per output and (maybe) strip NaN rows (reference
+        ``multioutput.py:122-141``)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            def _select(x: Any, _i: int = i) -> np.ndarray:
+                return np.take(np.asarray(x), indices=[_i], axis=self.output_dim)
+
+            selected_args = list(apply_to_collection(args, _ARRAY_TYPES, _select))
+            selected_kwargs = apply_to_collection(kwargs, _ARRAY_TYPES, _select)
+            if self.remove_nans:
+                nan_idxs = _get_nan_indices(*(tuple(selected_args) + tuple(selected_kwargs.values())))
+                selected_args = [arg[~nan_idxs] for arg in selected_args]
+                selected_kwargs = {k: v[~nan_idxs] for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [np.squeeze(arg, axis=self.output_dim) for arg in selected_args]
+                selected_kwargs = {k: np.squeeze(v, axis=self.output_dim) for k, v in selected_kwargs.items()}
+            selected_args = [jnp.asarray(a) for a in selected_args]
+            selected_kwargs = {k: jnp.asarray(v) for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each clone with its output slice (reference ``multioutput.py:143-147``)."""
+        reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (sel_args, sel_kwargs) in zip(self.metrics, reshaped):
+            metric.update(*sel_args, **sel_kwargs)
+
+    def compute(self) -> List[Array]:
+        return [m.compute() for m in self.metrics]
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Forward each clone so their accumulated states advance too
+        (reference ``multioutput.py:154-165``)."""
+        results = []
+        reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (sel_args, sel_kwargs) in zip(self.metrics, reshaped):
+            results.append(metric(*sel_args, **sel_kwargs))
+        self._update_count += 1
+        self._computed = None
+        if results[0] is None:
+            return None
+        self._forward_cache = results
+        return results
+
+    def reset(self) -> None:
+        super().reset()
+        for metric in self.metrics:
+            metric.reset()

@@ -1,0 +1,92 @@
+"""Track a metric over time-steps (epochs) and query the best value.
+
+Parity target: reference ``torchmetrics/wrappers/tracker.py:23``
+(``MetricTracker`` — an ``nn.ModuleList`` of per-step clones with
+``increment``/``compute_all``/``best_metric``). Here it is a plain container
+(no module system to subclass); each ``increment()`` appends a fresh clone of
+the base metric and subsequent update/compute calls route to it.
+"""
+from typing import Any, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MetricTracker:
+    """Keep one metric instance per tracked step; route the standard
+    lifecycle methods to the newest one."""
+
+    def __init__(self, metric: Metric, maximize: bool = True) -> None:
+        if not isinstance(metric, Metric):
+            raise TypeError(f"metric arg need to be an instance of a metrics_tpu metric but got {metric}")
+        self._base_metric = metric
+        self.maximize = maximize
+        self._steps: List[Metric] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Number of times the tracker has been incremented."""
+        return len(self._steps)
+
+    def __len__(self) -> int:
+        return len(self._steps)
+
+    def __getitem__(self, idx: int) -> Metric:
+        return self._steps[idx]
+
+    def increment(self) -> None:
+        """Start tracking a new step with a fresh clone (reference
+        ``tracker.py:66-69``)."""
+        self._increment_called = True
+        clone = self._base_metric.clone()
+        clone.reset()
+        self._steps.append(clone)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._steps[-1](*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._steps[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._steps[-1].compute()
+
+    def compute_all(self) -> Array:
+        """Stacked metric values for every tracked step (reference
+        ``tracker.py:86-89``)."""
+        self._check_for_increment("compute_all")
+        return jnp.stack([jnp.asarray(m.compute()) for m in self._steps], axis=0)
+
+    def reset(self) -> None:
+        """Reset the current step's metric."""
+        self._check_for_increment("reset")
+        self._steps[-1].reset()
+
+    def reset_all(self) -> None:
+        for m in self._steps:
+            m.reset()
+
+    def best_metric(self, return_step: bool = False) -> Union[float, Tuple[int, float]]:
+        """Best value across steps, optionally with its step index
+        (reference ``tracker.py:99-112``)."""
+        vals = self.compute_all()
+        idx = int(jnp.argmax(vals) if self.maximize else jnp.argmin(vals))
+        best = float(vals[idx])
+        if return_step:
+            return idx, best
+        return best
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called")

@@ -1,0 +1,227 @@
+"""Wrapper tests (parity: reference ``tests/wrappers/test_{bootstrapping,minmax,multioutput,tracker}.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import mean_squared_error, r2_score
+
+from metrics_tpu import (
+    Accuracy,
+    BootStrapper,
+    MeanSquaredError,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    R2Score,
+)
+from metrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
+
+
+def _rand(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=shape))
+
+
+class TestBootStrapper:
+    @pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+    def test_bootstrap_mean_close_to_true(self, sampling_strategy):
+        preds, target = _rand((512,), 1), _rand((512,), 2)
+        bootstrap = BootStrapper(
+            MeanSquaredError(), num_bootstraps=20, raw=True, sampling_strategy=sampling_strategy
+        )
+        bootstrap.update(preds, target)
+        out = bootstrap.compute()
+        true_val = mean_squared_error(np.asarray(target), np.asarray(preds))
+        assert set(out) == {"mean", "std", "raw"}
+        assert out["raw"].shape == (20,)
+        # bootstrap mean should be near the point estimate, std small but nonzero
+        np.testing.assert_allclose(float(out["mean"]), true_val, rtol=0.15)
+        assert 0 < float(out["std"]) < 0.5 * true_val
+
+    def test_fast_path_engaged_and_matches_eager(self):
+        """Multinomial + jittable base metric → single-dispatch vmap path;
+        with identical host RNG seed it must agree with the eager clone path."""
+        preds, target = _rand((64,), 3), _rand((64,), 4)
+        fast = BootStrapper(MeanSquaredError(), num_bootstraps=8, sampling_strategy="multinomial", seed=7)
+        fast.update(preds, target)
+        assert fast._use_fast_path is True
+        out_fast = fast.compute()
+
+        eager = BootStrapper(MeanSquaredError(), num_bootstraps=8, sampling_strategy="multinomial", seed=7)
+        eager._use_fast_path = False
+        # consume RNG identically: fast path draws one (B, N) block, eager draws B N-blocks
+        eager.update(preds, target)
+        out_eager = eager.compute()
+        np.testing.assert_allclose(float(out_fast["mean"]), float(out_eager["mean"]), rtol=1e-5)
+        np.testing.assert_allclose(float(out_fast["std"]), float(out_eager["std"]), rtol=1e-4)
+
+    def test_quantile(self):
+        preds, target = _rand((256,), 5), _rand((256,), 6)
+        bootstrap = BootStrapper(MeanSquaredError(), num_bootstraps=16, quantile=jnp.asarray([0.05, 0.95]))
+        bootstrap.update(preds, target)
+        out = bootstrap.compute()
+        assert out["quantile"].shape == (2,)
+        assert float(out["quantile"][0]) <= float(out["quantile"][1])
+
+    def test_reset(self):
+        preds, target = _rand((32,), 7), _rand((32,), 8)
+        bootstrap = BootStrapper(MeanSquaredError(), num_bootstraps=4)
+        bootstrap.update(preds, target)
+        bootstrap.reset()
+        assert bootstrap._stacked_state is None
+        assert all(m._update_count == 0 for m in bootstrap.metrics)
+
+    def test_sampler_properties(self):
+        rng = np.random.default_rng(0)
+        idx_m = _bootstrap_sampler(rng, 100, "multinomial")
+        assert idx_m.shape == (100,)
+        assert idx_m.min() >= 0 and idx_m.max() < 100
+        idx_p = _bootstrap_sampler(rng, 1000, "poisson")
+        assert 800 < len(idx_p) < 1200  # Poisson(1) total ~ N
+        with pytest.raises(ValueError):
+            _bootstrap_sampler(rng, 10, "bogus")
+
+    def test_forward_updates_once(self):
+        """forward must accumulate each batch exactly once per replicate."""
+        preds, target = _rand((64,), 9), _rand((64,), 10)
+        bs = BootStrapper(MeanSquaredError(), num_bootstraps=4, sampling_strategy="poisson")
+        out = bs(preds, target)
+        assert set(out) == {"mean", "std"}
+        totals = [int(m.total) for m in bs.metrics]
+        # poisson resampling: each replicate saw ~N samples, not ~2N
+        assert all(t < 2 * 64 * 0.8 for t in totals)
+
+    def test_fast_path_error_propagates_after_engagement(self):
+        preds, target = _rand((32,), 11), _rand((32,), 12)
+        bs = BootStrapper(MeanSquaredError(), num_bootstraps=4, sampling_strategy="multinomial")
+        bs.update(preds, target)
+        assert bs._use_fast_path is True
+        with pytest.raises(Exception):
+            bs.update(preds)  # wrong arity: must NOT be swallowed
+        assert bs._use_fast_path is True  # accumulated state not stranded
+        out = bs.compute()
+        assert np.isfinite(float(out["mean"]))
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            BootStrapper(MeanSquaredError(), sampling_strategy="bogus")
+        with pytest.raises(ValueError):
+            BootStrapper("not a metric")
+
+
+class TestMinMax:
+    def test_tracks_min_max(self):
+        """Reference docstring scenario (``wrappers/minmax.py:31-46``)."""
+        mm = MinMaxMetric(Accuracy())
+        preds_1 = jnp.asarray([[0.1, 0.9], [0.2, 0.8]])
+        preds_2 = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+        labels = jnp.asarray([[0, 1], [0, 1]]).astype(jnp.int32)
+        out = mm(preds_1, labels)
+        assert float(out["raw"]) == 1.0 and float(out["min"]) == 1.0 and float(out["max"]) == 1.0
+        out = mm.compute()
+        assert float(out["raw"]) == 1.0
+        mm.update(preds_2, labels)
+        out = mm.compute()
+        assert float(out["max"]) == 1.0
+        np.testing.assert_allclose(float(out["min"]), 0.75)
+        np.testing.assert_allclose(float(out["raw"]), 0.75)
+
+    def test_reset(self):
+        mm = MinMaxMetric(Accuracy())
+        labels = jnp.asarray([[0, 1], [0, 1]]).astype(jnp.int32)
+        mm.update(jnp.asarray([[0.1, 0.9], [0.2, 0.8]]), labels)
+        mm.compute()
+        mm.reset()
+        assert float(mm.min_val) == float("inf")
+        assert float(mm.max_val) == float("-inf")
+        assert mm._base_metric._update_count == 0
+
+    def test_non_scalar_raises(self):
+        from metrics_tpu import ConfusionMatrix
+
+        mm = MinMaxMetric(ConfusionMatrix(num_classes=2))
+        mm.update(jnp.asarray([0, 1]), jnp.asarray([0, 1]))
+        with pytest.raises(RuntimeError):
+            mm.compute()
+        mm2 = MinMaxMetric(ConfusionMatrix(num_classes=2))
+        with pytest.raises(RuntimeError):
+            mm2(jnp.asarray([0, 1]), jnp.asarray([0, 1]))  # forward checks too
+
+    def test_requires_metric(self):
+        with pytest.raises(ValueError):
+            MinMaxMetric(lambda x: x)
+
+
+class TestMultioutput:
+    def test_r2_multioutput_vs_sklearn(self):
+        """Reference docstring scenario (``wrappers/multioutput.py:70-77``)."""
+        target = jnp.asarray([[0.5, 1.0], [-1.0, 1.0], [7.0, -6.0]])
+        preds = jnp.asarray([[0.0, 2.0], [-1.0, 2.0], [8.0, -5.0]])
+        wrapped = MultioutputWrapper(R2Score(), 2)
+        res = wrapped(preds, target)
+        sk = r2_score(np.asarray(target), np.asarray(preds), multioutput="raw_values")
+        np.testing.assert_allclose([float(r) for r in res], sk, atol=1e-5)
+        # streaming: compute over the accumulated state matches too
+        res2 = wrapped.compute()
+        np.testing.assert_allclose([float(r) for r in res2], sk, atol=1e-5)
+
+    def test_nan_removal(self):
+        rng = np.random.default_rng(0)
+        preds = rng.normal(size=(50, 2))
+        target = rng.normal(size=(50, 2))
+        target[::5, 0] = np.nan  # every 5th row NaN in output 0
+        wrapped = MultioutputWrapper(MeanSquaredError(), 2, remove_nans=True)
+        wrapped.update(jnp.asarray(preds), jnp.asarray(target))
+        res = wrapped.compute()
+        mask = ~np.isnan(target[:, 0])
+        np.testing.assert_allclose(
+            float(res[0]), mean_squared_error(target[mask, 0], preds[mask, 0]), atol=1e-6
+        )
+        np.testing.assert_allclose(float(res[1]), mean_squared_error(target[:, 1], preds[:, 1]), atol=1e-6)
+
+    def test_reset(self):
+        wrapped = MultioutputWrapper(MeanSquaredError(), 2)
+        wrapped.update(_rand((8, 2)), _rand((8, 2), 1))
+        wrapped.reset()
+        assert all(m._update_count == 0 for m in wrapped.metrics)
+
+
+class TestTracker:
+    def test_lifecycle(self):
+        """Reference docstring scenario (``wrappers/tracker.py:29-47``)."""
+        tracker = MetricTracker(Accuracy(num_classes=10), maximize=True)
+        rng = np.random.default_rng(42)
+        vals = []
+        for _ in range(5):
+            tracker.increment()
+            for _ in range(5):
+                preds = jnp.asarray(rng.integers(0, 10, size=100))
+                target = jnp.asarray(rng.integers(0, 10, size=100))
+                tracker.update(preds, target)
+            vals.append(float(tracker.compute()))
+        assert tracker.n_steps == 5
+        all_vals = tracker.compute_all()
+        np.testing.assert_allclose(np.asarray(all_vals), vals, atol=1e-6)
+        best_idx, best = tracker.best_metric(return_step=True)
+        assert best == max(vals)
+        assert best_idx == int(np.argmax(vals))
+
+    def test_minimize(self):
+        tracker = MetricTracker(MeanSquaredError(), maximize=False)
+        for seed in range(3):
+            tracker.increment()
+            tracker.update(_rand((32,), seed), _rand((32,), seed + 10))
+        vals = np.asarray(tracker.compute_all())
+        assert tracker.best_metric() == pytest.approx(vals.min())
+
+    def test_errors_before_increment(self):
+        tracker = MetricTracker(MeanSquaredError())
+        with pytest.raises(ValueError):
+            tracker.update(_rand((4,)), _rand((4,)))
+        with pytest.raises(ValueError):
+            tracker.compute()
+        with pytest.raises(ValueError):
+            tracker.reset()
+
+    def test_requires_metric(self):
+        with pytest.raises(TypeError):
+            MetricTracker("not a metric")
