@@ -1,0 +1,49 @@
+"""PermutationInvariantTraining module metric (parity: reference ``torchmetrics/audio/pit.py:23``)."""
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.pit import permutation_invariant_training
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PermutationInvariantTraining(Metric):
+    """Streaming mean of the best-permutation metric value.
+
+    Args:
+        metric_func: batch-mapped metric, ``metric_func(preds[:, i], target[:, j]) -> [batch]``.
+        eval_func: ``"max"`` or ``"min"``.
+        kwargs passed with ``metric_func`` are forwarded to it on every update.
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        eval_func: str = "max",
+        **kwargs: Dict[str, Any],
+    ) -> None:
+        base_kwargs = {
+            k: kwargs.pop(k)
+            for k in ("compute_on_step", "dist_sync_on_step", "process_group", "dist_sync_fn", "axis_name", "jit_update")
+            if k in kwargs
+        }
+        super().__init__(**base_kwargs)
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+        self.add_state("sum_pit_metric", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pit_metric = permutation_invariant_training(preds, target, self.metric_func, self.eval_func, **self.kwargs)[0]
+        self.sum_pit_metric = self.sum_pit_metric + jnp.sum(pit_metric)
+        self.total = self.total + pit_metric.size
+
+    def compute(self) -> Array:
+        return self.sum_pit_metric / self.total

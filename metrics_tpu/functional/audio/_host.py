@@ -1,0 +1,21 @@
+"""Shared host-side per-sample dispatch for C-backed audio algorithms
+(PESQ/STOI): numpy round-trip, flatten leading dims, loop, reshape back."""
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _host_per_sample(fn: Callable, preds: Array, target: Array) -> Array:
+    """Apply ``fn(target_1d, preds_1d) -> float`` over every leading index."""
+    preds_np = np.asarray(preds, dtype=np.float32)
+    target_np = np.asarray(target, dtype=np.float32)
+    if preds_np.ndim == 1:
+        return jnp.asarray(fn(target_np, preds_np), dtype=jnp.float32)
+    flat_p = preds_np.reshape(-1, preds_np.shape[-1])
+    flat_t = target_np.reshape(-1, target_np.shape[-1])
+    scores = np.array([fn(t, p) for p, t in zip(flat_p, flat_t)], dtype=np.float32)
+    return jnp.asarray(scores.reshape(preds_np.shape[:-1]))

@@ -1,0 +1,43 @@
+"""PESQ functional wrapper.
+
+Parity target: reference ``torchmetrics/functional/audio/pesq.py`` — like the
+reference, the ITU-T P.862 algorithm itself comes from the C-backed ``pesq``
+wheel and runs per-sample on the host CPU (numpy round-trip). The wheel is not
+part of the TPU image, so this surface is availability-gated with the same
+install-hint error contract the reference uses.
+"""
+import jax
+
+from metrics_tpu.functional.audio._host import _host_per_sample
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.imports import _PESQ_AVAILABLE
+
+Array = jax.Array
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+) -> Array:
+    """PESQ score per sample, shape ``[..., time] -> [...]`` (host-computed).
+
+    Args:
+        fs: sampling frequency, 8000 or 16000 Hz.
+        mode: ``"wb"`` (wide-band) or ``"nb"`` (narrow-band).
+    """
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed. Either install as `pip install metrics_tpu[audio]`"
+            " or `pip install pesq`."
+        )
+    import pesq as pesq_backend
+
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    _check_same_shape(preds, target)
+    return _host_per_sample(lambda t, p: pesq_backend.pesq(fs, t, p, mode), preds, target)

@@ -1,0 +1,34 @@
+"""SNR / SI-SNR functional kernels.
+
+Parity target: reference ``torchmetrics/functional/audio/snr.py``
+(``signal_noise_ratio`` :11, ``scale_invariant_signal_noise_ratio`` :77).
+Pure jittable reductions over the trailing time axis.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SNR = 10 log10(||target||^2 / ||target - preds||^2), shape ``[..., time] -> [...]``."""
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, dtype=jnp.result_type(preds, jnp.float32))
+    target = jnp.asarray(target, dtype=preds.dtype)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR — SI-SDR with mandatory zero-mean (reference ``snr.py:126``)."""
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)

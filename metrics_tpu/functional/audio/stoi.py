@@ -1,0 +1,32 @@
+"""STOI functional wrapper.
+
+Parity target: reference ``torchmetrics/functional/audio/stoi.py`` — the STOI
+algorithm comes from the ``pystoi`` wheel and runs per-sample on the host CPU,
+mirrored here with the same availability gate and install-hint error.
+"""
+import jax
+
+from metrics_tpu.functional.audio._host import _host_per_sample
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+
+def short_time_objective_intelligibility(
+    preds: Array,
+    target: Array,
+    fs: int,
+    extended: bool = False,
+    keep_same_device: bool = False,
+) -> Array:
+    """STOI score per sample, shape ``[..., time] -> [...]`` (host-computed)."""
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "STOI metric requires that pystoi is installed. Either install as `pip install metrics_tpu[audio]`"
+            " or `pip install pystoi`."
+        )
+    from pystoi import stoi as stoi_backend
+
+    _check_same_shape(preds, target)
+    return _host_per_sample(lambda t, p: stoi_backend(t, p, fs, extended), preds, target)

@@ -36,3 +36,5 @@ _TORCH_AVAILABLE = _package_available("torch")
 _ORBAX_AVAILABLE = _package_available("orbax")
 _NLTK_AVAILABLE = _package_available("nltk")
 _REGEX_AVAILABLE = _package_available("regex")
+_PESQ_AVAILABLE = _package_available("pesq")
+_PYSTOI_AVAILABLE = _package_available("pystoi")

@@ -1,0 +1,297 @@
+"""Audio metric tests.
+
+Parity: reference ``tests/audio/test_{snr,si_snr,si_sdr,sdr,pit}.py``. The
+reference validates against ``mir_eval``/``museval``/``fast_bss_eval`` wheels
+(absent here); oracles are independent numpy implementations of the published
+formulas, plus cross-metric identities (SDR with ``filter_length=1`` equals
+SI-SDR up to the scale-invariance construction).
+"""
+from itertools import permutations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from metrics_tpu.functional.audio import (
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+)
+from tests.helpers.testers import MetricTester
+
+NUM_BATCHES, BATCH_SIZE, TIME = 4, 8, 128
+
+
+def _inputs(seed=0, shape=(NUM_BATCHES, BATCH_SIZE, TIME)):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=shape)), jnp.asarray(rng.normal(size=shape))
+
+
+def _sk_snr(preds, target, zero_mean=False):
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    return np.mean(10 * np.log10(np.sum(target**2, -1) / np.sum((target - preds) ** 2, -1)))
+
+
+def _sk_si_sdr(preds, target, zero_mean=False):
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    alpha = np.sum(preds * target, -1, keepdims=True) / np.sum(target**2, -1, keepdims=True)
+    t_s = alpha * target
+    return np.mean(10 * np.log10(np.sum(t_s**2, -1) / np.sum((t_s - preds) ** 2, -1)))
+
+
+class TestSNR(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_snr(self, ddp, zero_mean):
+        preds, target = _inputs()
+        self.run_class_metric_test(
+            ddp, preds, target, SignalNoiseRatio,
+            lambda p, t: _sk_snr(p, t, zero_mean), metric_args={"zero_mean": zero_mean},
+        )
+
+    def test_functional(self):
+        preds, target = _inputs(1)
+        self.run_functional_metric_test(
+            preds, target, lambda p, t: jnp.mean(signal_noise_ratio(p, t)), _sk_snr
+        )
+
+    def test_differentiability(self):
+        preds, target = _inputs(2)
+        self.run_differentiability_test(preds, target, SignalNoiseRatio, signal_noise_ratio)
+
+
+class TestSISNR(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_si_snr(self, ddp):
+        preds, target = _inputs(3)
+        self.run_class_metric_test(
+            ddp, preds, target, ScaleInvariantSignalNoiseRatio, lambda p, t: _sk_si_sdr(p, t, zero_mean=True)
+        )
+
+    def test_scale_invariance(self):
+        preds, target = _inputs(4, (BATCH_SIZE, TIME))
+        base = scale_invariant_signal_noise_ratio(preds, target)
+        scaled = scale_invariant_signal_noise_ratio(preds, 5.0 * target)
+        np.testing.assert_allclose(np.asarray(base), np.asarray(scaled), atol=1e-4)
+
+
+class TestSISDR(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_si_sdr(self, ddp, zero_mean):
+        preds, target = _inputs(5)
+        self.run_class_metric_test(
+            ddp, preds, target, ScaleInvariantSignalDistortionRatio,
+            lambda p, t: _sk_si_sdr(p, t, zero_mean), metric_args={"zero_mean": zero_mean},
+        )
+
+    def test_reference_value(self):
+        """Reference doctest value (``functional/audio/sdr.py:238-242``)."""
+        target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        np.testing.assert_allclose(
+            float(scale_invariant_signal_distortion_ratio(preds, target)), 18.4030, atol=1e-3
+        )
+        np.testing.assert_allclose(float(signal_noise_ratio(preds, target)), 16.1805, atol=1e-3)
+        np.testing.assert_allclose(float(scale_invariant_signal_noise_ratio(preds, target)), 15.0918, atol=1e-3)
+
+
+class TestSDR(MetricTester):
+    atol = 1e-3
+
+    def test_matches_sisdr_at_filter_length_one(self):
+        """With a length-1 filter the optimal-filter SDR reduces to the
+        scale-invariant projection ratio = SI-SDR (no zero-mean)."""
+        preds, target = _inputs(6, (BATCH_SIZE, TIME))
+        sdr1 = signal_distortion_ratio(preds, target, filter_length=1)
+        si = scale_invariant_signal_distortion_ratio(preds, target)
+        np.testing.assert_allclose(np.asarray(sdr1), np.asarray(si), atol=1e-3)
+
+    def test_filtered_target_high_sdr(self):
+        """SDR is invariant to short linear filtering of the target: a
+        prediction that is a filtered version of the target scores huge."""
+        rng = np.random.default_rng(7)
+        target = rng.normal(size=(4, 2000))
+        h = np.array([0.8, -0.3, 0.2])
+        # causal filtering (delays only) — the filter class SDR optimizes over
+        preds = np.stack([np.convolve(t, h, mode="full")[: target.shape[-1]] for t in target])
+        val = signal_distortion_ratio(jnp.asarray(preds), jnp.asarray(target), filter_length=64)
+        assert np.all(np.asarray(val) > 40.0), np.asarray(val)
+        # but plain SI-SDR (no filter freedom) is much lower
+        si = scale_invariant_signal_distortion_ratio(jnp.asarray(preds), jnp.asarray(target))
+        assert np.all(np.asarray(val) > np.asarray(si) + 20.0)
+
+    def test_streaming_module(self):
+        preds, target = _inputs(8)
+        metric = SignalDistortionRatio(filter_length=32)
+        vals = []
+        for i in range(NUM_BATCHES):
+            vals.append(np.asarray(signal_distortion_ratio(preds[i], target[i], filter_length=32)))
+            metric.update(preds[i], target[i])
+        np.testing.assert_allclose(float(metric.compute()), np.concatenate(vals).mean(), atol=1e-4)
+
+    def test_default_filter_length_short_signal(self):
+        """filter_length clamps to the signal length: the default 512 must not
+        crash (or overfit to rank-deficiency) on short clips."""
+        preds, target = _inputs(15, (4, 100))
+        val = signal_distortion_ratio(preds, target)  # default filter_length=512
+        assert np.all(np.isfinite(np.asarray(val)))
+        clamped = signal_distortion_ratio(preds, target, filter_length=100)
+        np.testing.assert_allclose(np.asarray(val), np.asarray(clamped), atol=1e-6)
+
+    def test_load_diag(self):
+        preds, target = _inputs(9, (BATCH_SIZE, TIME))
+        v1 = signal_distortion_ratio(preds, target, filter_length=16, load_diag=1e-3)
+        v2 = signal_distortion_ratio(preds, target, filter_length=16)
+        assert np.all(np.isfinite(np.asarray(v1)))
+        # loading perturbs the solution slightly but not wildly
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=1.0)
+
+
+def _np_pit_oracle(preds, target, metric_np, maximize):
+    """Brute-force permutation search per sample (reference ``tests/audio/test_pit.py``
+    ``naive_implementation``)."""
+    batch, spk = target.shape[:2]
+    best_vals, best_perms = [], []
+    for b in range(batch):
+        best, best_perm = None, None
+        for perm in permutations(range(spk)):
+            val = np.mean([metric_np(preds[b, perm[j]], target[b, j]) for j in range(spk)])
+            if best is None or (val > best if maximize else val < best):
+                best, best_perm = val, perm
+        best_vals.append(best)
+        best_perms.append(best_perm)
+    return np.asarray(best_vals), np.asarray(best_perms)
+
+
+def _np_si_sdr_single(pred, tgt):
+    alpha = np.sum(pred * tgt) / np.sum(tgt**2)
+    t_s = alpha * tgt
+    return 10 * np.log10(np.sum(t_s**2) / np.sum((t_s - pred) ** 2))
+
+
+class TestPIT:
+    @pytest.mark.parametrize("spk", [2, 3])
+    def test_vs_bruteforce(self, spk):
+        rng = np.random.default_rng(10)
+        preds = rng.normal(size=(6, spk, 100))
+        target = rng.normal(size=(6, spk, 100))
+        best_metric, best_perm = permutation_invariant_training(
+            jnp.asarray(preds), jnp.asarray(target), scale_invariant_signal_distortion_ratio, "max"
+        )
+        oracle_val, oracle_perm = _np_pit_oracle(preds, target, _np_si_sdr_single, maximize=True)
+        np.testing.assert_allclose(np.asarray(best_metric), oracle_val, atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(best_perm), oracle_perm)
+
+    def test_min_mode(self):
+        rng = np.random.default_rng(11)
+        preds = rng.normal(size=(4, 2, 50))
+        target = rng.normal(size=(4, 2, 50))
+
+        def neg_mse(p, t):
+            return jnp.mean((p - t) ** 2, axis=-1)
+
+        best_metric, best_perm = permutation_invariant_training(
+            jnp.asarray(preds), jnp.asarray(target), neg_mse, "min"
+        )
+        oracle_val, oracle_perm = _np_pit_oracle(
+            preds, target, lambda p, t: np.mean((p - t) ** 2), maximize=False
+        )
+        np.testing.assert_allclose(np.asarray(best_metric), oracle_val, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(best_perm), oracle_perm)
+
+    def test_permutate_identity(self):
+        """Permuted preds aligned with target must reproduce best per-pair scores."""
+        rng = np.random.default_rng(12)
+        target = rng.normal(size=(3, 2, 64))
+        true_perm = np.asarray([[1, 0], [0, 1], [1, 0]])
+        preds = np.stack([t[p] for t, p in zip(target, np.argsort(true_perm, axis=1))])
+        best_metric, best_perm = permutation_invariant_training(
+            jnp.asarray(preds), jnp.asarray(target), scale_invariant_signal_distortion_ratio, "max"
+        )
+        aligned = pit_permutate(jnp.asarray(preds), best_perm)
+        np.testing.assert_allclose(np.asarray(aligned), target, atol=1e-6)
+
+    def test_lsa_path_matches_exhaustive(self):
+        """Force the Hungarian path and check agreement with exhaustive search."""
+        import metrics_tpu.functional.audio.pit as pit_mod
+
+        rng = np.random.default_rng(13)
+        preds = jnp.asarray(rng.normal(size=(4, 3, 80)))
+        target = jnp.asarray(rng.normal(size=(4, 3, 80)))
+        m_ex, p_ex = permutation_invariant_training(
+            preds, target, scale_invariant_signal_distortion_ratio, "max"
+        )
+        old = pit_mod._EXHAUSTIVE_MAX_SPK
+        try:
+            pit_mod._EXHAUSTIVE_MAX_SPK = 0
+            m_lsa, p_lsa = permutation_invariant_training(
+                preds, target, scale_invariant_signal_distortion_ratio, "max"
+            )
+        finally:
+            pit_mod._EXHAUSTIVE_MAX_SPK = old
+        np.testing.assert_allclose(np.asarray(m_ex), np.asarray(m_lsa), atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(p_ex), np.asarray(p_lsa))
+
+    def test_module_streaming(self):
+        rng = np.random.default_rng(14)
+        metric = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, "max")
+        all_best = []
+        for _ in range(3):
+            preds = jnp.asarray(rng.normal(size=(4, 2, 60)))
+            target = jnp.asarray(rng.normal(size=(4, 2, 60)))
+            all_best.append(
+                np.asarray(
+                    permutation_invariant_training(preds, target, scale_invariant_signal_distortion_ratio, "max")[0]
+                )
+            )
+            metric.update(preds, target)
+        np.testing.assert_allclose(float(metric.compute()), np.concatenate(all_best).mean(), atol=1e-5)
+
+    def test_invalid_args(self):
+        p = jnp.zeros((2, 2, 10))
+        with pytest.raises(ValueError):
+            permutation_invariant_training(p, p, scale_invariant_signal_distortion_ratio, "bogus")
+        with pytest.raises(ValueError):
+            permutation_invariant_training(jnp.zeros(5), jnp.zeros(5), scale_invariant_signal_distortion_ratio)
+
+
+class TestGatedWheels:
+    def test_pesq_gated(self):
+        from metrics_tpu import PerceptualEvaluationSpeechQuality
+        from metrics_tpu.utils.imports import _PESQ_AVAILABLE
+
+        if not _PESQ_AVAILABLE:
+            with pytest.raises(ModuleNotFoundError):
+                PerceptualEvaluationSpeechQuality(16000, "wb")
+
+    def test_stoi_gated(self):
+        from metrics_tpu import ShortTimeObjectiveIntelligibility
+        from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
+
+        if not _PYSTOI_AVAILABLE:
+            with pytest.raises(ModuleNotFoundError):
+                ShortTimeObjectiveIntelligibility(16000)
