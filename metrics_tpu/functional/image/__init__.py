@@ -1,0 +1,14 @@
+"""Image functional metrics (parity: reference ``torchmetrics/functional/image/``)."""
+from metrics_tpu.functional.image.gradients import image_gradients  # noqa: F401
+from metrics_tpu.functional.image.psnr import peak_signal_noise_ratio  # noqa: F401
+from metrics_tpu.functional.image.ssim import (  # noqa: F401
+    multiscale_structural_similarity_index_measure,
+    structural_similarity_index_measure,
+)
+
+__all__ = [
+    "image_gradients",
+    "multiscale_structural_similarity_index_measure",
+    "peak_signal_noise_ratio",
+    "structural_similarity_index_measure",
+]

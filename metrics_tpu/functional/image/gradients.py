@@ -1,0 +1,25 @@
+"""Image gradients (parity: reference ``torchmetrics/functional/image/gradients.py:21-87``)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Finite-difference dy/dx, zero-padded on the trailing row/column."""
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Gradients ``(dy, dx)`` of an ``(N, C, H, W)`` image batch."""
+    if not isinstance(img, (jax.Array, jnp.ndarray)):
+        raise TypeError(f"The `img` expects a value of <Array> type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError("The `img` expects a 4D tensor")
+    return _compute_image_gradients(img)

@@ -1,0 +1,20 @@
+"""Image module metrics (parity: reference ``torchmetrics/image/``)."""
+from metrics_tpu.image.fid import FrechetInceptionDistance  # noqa: F401
+from metrics_tpu.image.inception import InceptionScore  # noqa: F401
+from metrics_tpu.image.kid import KernelInceptionDistance  # noqa: F401
+from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity  # noqa: F401
+from metrics_tpu.image.psnr import PeakSignalNoiseRatio  # noqa: F401
+from metrics_tpu.image.ssim import (  # noqa: F401
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+
+__all__ = [
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "StructuralSimilarityIndexMeasure",
+]

@@ -1,0 +1,191 @@
+"""Fréchet Inception Distance.
+
+Parity target: reference ``torchmetrics/image/fid.py`` (``_compute_fid``
+:100-126, ``FrechetInceptionDistance`` :129, feature buffers :251-252,
+float64 compute :272-275, scipy ``sqrtm`` host boundary :61-106).
+
+TPU-native design differences:
+
+* **Pluggable feature extractor.** The reference hard-depends on the
+  ``torch-fidelity`` InceptionV3 wheel + downloaded weights; here any callable
+  ``imgs -> [N, d]`` (e.g. a jitted Flax module) is a first-class extractor,
+  and the Inception default is availability-gated (no network egress on TPU
+  pods to fetch weights).
+
+* **Streaming sufficient statistics.** When ``feature_dim`` is known the
+  states are ``(sum x, sum x x^T, n)`` per distribution — O(d^2) constant
+  memory instead of the reference's unbounded feature buffers (whose memory
+  footprint its own docs warn about, ``image/fid.py:227-231``), and
+  distributed sync is a plain ``psum`` instead of a gather. Without
+  ``feature_dim`` the reference's buffer-of-features fallback is used.
+
+* **Matrix square root via symmetric eigendecomposition.** The trace of
+  ``sqrtm(S1 @ S2)`` equals the trace of ``sqrtm(S1^1/2 S2 S1^1/2)``, which is
+  symmetric PSD — two ``eigh`` calls replace the reference's general (and
+  CPU-only scipy) ``sqrtm``. The final reduction runs on host in float64
+  (same host boundary the reference has, ``image/fid.py:61-106``).
+"""
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+Array = jax.Array
+
+
+def _no_default_extractor(feature: int) -> None:
+    raise ModuleNotFoundError(
+        "The default InceptionV3 feature extractor requires pretrained weights that are not"
+        " bundled with metrics_tpu (no download at metric-construction time on TPU pods)."
+        f" Pass `feature=<callable imgs -> [N, {feature}] array>` instead — e.g. a jitted"
+        " Flax module — together with `feature_dim` for O(d^2) streaming statistics."
+    )
+
+
+def _validate_features(features: Array) -> Array:
+    """Extractor output must be ``[N, d]``."""
+    if features.ndim != 2:
+        raise MetricsUserError(
+            f"Expected the feature extractor to return a [N, d] array, got shape {features.shape}"
+        )
+    return features
+
+
+def _sqrtm_psd(mat: np.ndarray) -> np.ndarray:
+    """Symmetric PSD square root via eigendecomposition (host, float64)."""
+    vals, vecs = np.linalg.eigh(mat)
+    vals = np.clip(vals, 0.0, None)
+    return (vecs * np.sqrt(vals)) @ vecs.T
+
+
+def _compute_fid(
+    mu1: np.ndarray, sigma1: np.ndarray, mu2: np.ndarray, sigma2: np.ndarray, eps: float = 1e-6
+) -> float:
+    """d^2 = |mu1 - mu2|^2 + Tr(S1 + S2 - 2 sqrt(S1 S2)) (reference ``fid.py:100-126``)."""
+    diff = mu1 - mu2
+    s1_half = _sqrtm_psd(sigma1)
+    inner = s1_half @ sigma2 @ s1_half
+    vals = np.linalg.eigvalsh(inner)
+    if not np.all(np.isfinite(vals)):
+        offset = np.eye(sigma1.shape[0]) * eps
+        s1_half = _sqrtm_psd(sigma1 + offset)
+        inner = s1_half @ (sigma2 + offset) @ s1_half
+        vals = np.linalg.eigvalsh(inner)
+    tr_covmean = np.sum(np.sqrt(np.clip(vals, 0.0, None)))
+    return float(diff @ diff + np.trace(sigma1) + np.trace(sigma2) - 2 * tr_covmean)
+
+
+class FrechetInceptionDistance(Metric):
+    """FID between the feature distributions of real and generated images.
+
+    Args:
+        feature: an int (reference API — selects the gated default InceptionV3
+            layer of that dimensionality) or a callable ``imgs -> [N, d]``.
+        feature_dim: dimensionality ``d`` of the extractor output; enables the
+            O(d^2) streaming-statistics states.
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(
+        self,
+        feature: Union[int, Callable] = 2048,
+        feature_dim: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)  # extractor call is user code
+        kwargs.setdefault("compute_on_step", False)  # reference ``fid.py:215``
+        super().__init__(**kwargs)
+        if isinstance(feature, int):
+            _no_default_extractor(feature)
+        if not callable(feature):
+            raise TypeError("Got unknown input to argument `feature`")
+        self.inception = feature
+        self.feature_dim = feature_dim
+
+        if feature_dim is not None:
+            d = int(feature_dim)
+            # float64 when x64 is on; otherwise compensated (Kahan) float32
+            # pairs — the `_c` states carry the rounding error of each `+=` so
+            # the host-side float64 reconstruction at compute() keeps ~2x the
+            # f32 mantissa. Both halves are plain sums, so psum sync is valid.
+            acc_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            for prefix in ("real", "fake"):
+                self.add_state(f"{prefix}_sum", default=jnp.zeros((d,), acc_dtype), dist_reduce_fx="sum")
+                self.add_state(f"{prefix}_sum_c", default=jnp.zeros((d,), acc_dtype), dist_reduce_fx="sum")
+                self.add_state(f"{prefix}_outer", default=jnp.zeros((d, d), acc_dtype), dist_reduce_fx="sum")
+                self.add_state(f"{prefix}_outer_c", default=jnp.zeros((d, d), acc_dtype), dist_reduce_fx="sum")
+                self.add_state(f"{prefix}_n", default=jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            self.add_state("real_features", default=[], dist_reduce_fx="cat")
+            self.add_state("fake_features", default=[], dist_reduce_fx="cat")
+
+    def update(self, imgs: Array, real: bool = True) -> None:
+        """Extract features and fold them into the tracked distribution."""
+        features = _validate_features(jnp.asarray(self.inception(imgs)))
+        if self.feature_dim is not None:
+            if features.shape[1] != self.feature_dim:
+                raise MetricsUserError(
+                    f"Feature extractor returned dim {features.shape[1]}, expected feature_dim={self.feature_dim}"
+                )
+            f = features.astype(self.real_sum.dtype)
+            prefix = "real" if real else "fake"
+            # HIGHEST precision: the TPU MXU's default multi-pass bf16 matmul
+            # rounds the second moment before Kahan can compensate for it
+            outer = jnp.matmul(f.T, f, precision=jax.lax.Precision.HIGHEST)
+            for name, delta in ((f"{prefix}_sum", jnp.sum(f, axis=0)), (f"{prefix}_outer", outer)):
+                acc = getattr(self, name)
+                new = acc + delta
+                # two-sum error term: exact in f32, zero in f64 (harmless)
+                setattr(self, f"{name}_c", getattr(self, f"{name}_c") + ((acc - new) + delta))
+                setattr(self, name, new)
+            setattr(self, f"{prefix}_n", getattr(self, f"{prefix}_n") + features.shape[0])
+        elif real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    @staticmethod
+    def _stats_from_moments(s: np.ndarray, outer: np.ndarray, n: int) -> tuple:
+        mu = s / n
+        cov = (outer - n * np.outer(mu, mu)) / (n - 1)
+        return mu, cov
+
+    @staticmethod
+    def _stats_from_features(features: np.ndarray) -> tuple:
+        n = features.shape[0]
+        mu = features.mean(axis=0)
+        diff = features - mu
+        cov = diff.T @ diff / (n - 1)
+        return mu, cov
+
+    def compute(self) -> Array:
+        """FID from accumulated statistics, in float64 on host (the compute is
+        extremely precision-sensitive, reference ``fid.py:272-275``)."""
+        if self.feature_dim is not None:
+            if int(self.real_n) < 2 or int(self.fake_n) < 2:
+                raise MetricsUserError("FID requires at least two samples in each distribution")
+            mu1, cov1 = self._stats_from_moments(
+                np.asarray(self.real_sum, np.float64) + np.asarray(self.real_sum_c, np.float64),
+                np.asarray(self.real_outer, np.float64) + np.asarray(self.real_outer_c, np.float64),
+                int(self.real_n),
+            )
+            mu2, cov2 = self._stats_from_moments(
+                np.asarray(self.fake_sum, np.float64) + np.asarray(self.fake_sum_c, np.float64),
+                np.asarray(self.fake_outer, np.float64) + np.asarray(self.fake_outer_c, np.float64),
+                int(self.fake_n),
+            )
+        else:
+            real = np.asarray(dim_zero_cat(self.real_features), np.float64)
+            fake = np.asarray(dim_zero_cat(self.fake_features), np.float64)
+            if real.shape[0] < 2 or fake.shape[0] < 2:
+                raise MetricsUserError("FID requires at least two samples in each distribution")
+            mu1, cov1 = self._stats_from_features(real)
+            mu2, cov2 = self._stats_from_features(fake)
+        return jnp.asarray(_compute_fid(mu1, cov1, mu2, cov2), dtype=jnp.float32)

@@ -1,0 +1,72 @@
+"""Inception Score.
+
+Parity target: reference ``torchmetrics/image/inception.py:28``
+(``InceptionScore``; logits buffer :150, KL-per-split compute :162-186).
+The classifier producing logits is pluggable (see ``metrics_tpu/image/fid.py``
+for the gating rationale).
+"""
+from typing import Any, Callable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.image.fid import _no_default_extractor, _validate_features
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class InceptionScore(Metric):
+    """IS = exp(E_x KL(p(y|x) || p(y))), mean/std over ``splits`` chunks.
+
+    Args:
+        feature: callable ``imgs -> [N, num_classes]`` logits (the Inception
+            default is availability-gated).
+        splits: number of chunks to compute the score over.
+        seed: host RNG seed for the pre-split shuffle.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        feature: Union[int, str, Callable] = "logits_unbiased",
+        splits: int = 10,
+        seed: int = 42,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)  # extractor call is user code
+        kwargs.setdefault("compute_on_step", False)  # reference ``inception.py:117``
+        super().__init__(**kwargs)
+        if isinstance(feature, (int, str)):
+            _no_default_extractor(1008 if isinstance(feature, str) else feature)
+        if not callable(feature):
+            raise TypeError("Got unknown input to argument `feature`")
+        self.inception = feature
+        self.splits = splits
+        self._seed = seed
+        self.add_state("features", default=[], dist_reduce_fx="cat")
+
+    def update(self, imgs: Array) -> None:
+        features = _validate_features(jnp.asarray(self.inception(imgs)))
+        self.features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        features = dim_zero_cat(self.features)
+        idx = jnp.asarray(np.random.default_rng(self._seed).permutation(features.shape[0]))
+        features = features[idx]
+
+        prob = jax.nn.softmax(features, axis=1)
+        log_prob = jax.nn.log_softmax(features, axis=1)
+
+        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
+        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+
+        mean_prob = [jnp.mean(p, axis=0, keepdims=True) for p in prob_chunks]
+        kl_ = [p * (lp - jnp.log(m)) for p, lp, m in zip(prob_chunks, log_prob_chunks, mean_prob)]
+        kl = jnp.stack([jnp.mean(jnp.sum(k, axis=1)) for k in kl_])
+        score = jnp.exp(kl)
+        return score.mean(), score.std(ddof=1)

@@ -1,0 +1,68 @@
+"""Learned Perceptual Image Patch Similarity.
+
+Parity target: reference ``torchmetrics/image/lpip.py:29``
+(``LearnedPerceptualImagePatchSimilarity``; wraps the ``lpips`` wheel's
+pretrained nets :34-37, ``sum_scores/total`` states). The perceptual network
+is pluggable: any callable ``(img1, img2) -> [N]`` distances — e.g. a jitted
+Flax VGG with user-supplied weights — because the pretrained ``lpips`` nets
+cannot be downloaded on an egress-less TPU pod.
+"""
+from typing import Any, Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """Streaming mean LPIPS distance.
+
+    Args:
+        net: callable ``(img1, img2) -> [N]`` perceptual distances, or one of
+            the reference net names (``"alex"/"vgg"/"squeeze"`` — gated, since
+            their pretrained weights require network access).
+        normalize: if True inputs are expected in ``[0, 1]`` and are shifted
+            to the net's ``[-1, 1]`` convention before the forward.
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+
+    def __init__(
+        self,
+        net: Union[str, Callable] = "alex",
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)  # net call is user code
+        super().__init__(**kwargs)
+        if isinstance(net, str):
+            if net not in ("alex", "vgg", "squeeze"):
+                raise ValueError(f"Argument `net` must be one of 'alex', 'vgg', 'squeeze' or a callable, got {net}")
+            raise ModuleNotFoundError(
+                f"The pretrained '{net}' LPIPS network requires downloaded weights that are not"
+                " bundled with metrics_tpu. Pass `net=<callable (img1, img2) -> [N] distances>`"
+                " instead — e.g. a jitted Flax perceptual net with user-supplied weights."
+            )
+        if not callable(net):
+            raise TypeError("Got unknown input to argument `net`")
+        self.net = net
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+        self.normalize = normalize
+        self.add_state("sum_scores", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        if self.normalize:  # [0, 1] -> [-1, 1]
+            img1 = 2 * img1 - 1
+            img2 = 2 * img2 - 1
+        loss = jnp.asarray(self.net(img1, img2)).squeeze()
+        self.sum_scores = self.sum_scores + jnp.sum(loss)
+        self.total = self.total + jnp.atleast_1d(loss).shape[0]
+
+    def compute(self) -> Array:
+        return self.sum_scores / self.total

@@ -1,0 +1,410 @@
+"""Image metric tests.
+
+Parity: reference ``tests/image/test_{psnr,ssim,ms_ssim,fid,kid,inception,lpips}.py``.
+The reference validates against skimage / torch-fidelity / lpips wheels (absent
+here); oracles are independent numpy implementations (scipy.ndimage SSIM,
+scipy.linalg.sqrtm FID) plus structural identities.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.linalg
+import scipy.ndimage
+
+from metrics_tpu import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    StructuralSimilarityIndexMeasure,
+)
+from metrics_tpu.functional.image import (
+    image_gradients,
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    structural_similarity_index_measure,
+)
+from tests.helpers.testers import MetricTester
+
+NUM_BATCHES, BATCH_SIZE = 4, 8
+
+
+def _imgs(seed=0, shape=(NUM_BATCHES, BATCH_SIZE, 3, 32, 32), scale=1.0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.uniform(0, scale, size=shape).astype(np.float32))
+
+
+# ---------------------------------------------------------------- PSNR
+def _np_psnr(preds, target, data_range=None, base=10.0):
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    if data_range is None:
+        data_range = target.max() - target.min()
+    mse = np.mean((preds - target) ** 2)
+    return (2 * np.log(data_range) - np.log(mse)) * (10 / np.log(base))
+
+
+class TestPSNR(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("data_range", [None, 1.0])
+    def test_psnr(self, ddp, data_range):
+        preds, target = _imgs(0), _imgs(1)
+        self.run_class_metric_test(
+            ddp, preds, target, PeakSignalNoiseRatio,
+            lambda p, t: _np_psnr(p, t, data_range), metric_args={"data_range": data_range},
+        )
+
+    def test_reference_value(self):
+        """Reference doctest (``functional/image/psnr.py:24-56``)."""
+        preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        np.testing.assert_allclose(float(peak_signal_noise_ratio(preds, target)), 2.5527, atol=1e-4)
+
+    def test_dim(self):
+        """Per-image PSNR with dim set, then mean-reduced."""
+        preds, target = _imgs(2, (BATCH_SIZE, 3, 16, 16)), _imgs(3, (BATCH_SIZE, 3, 16, 16))
+        val = peak_signal_noise_ratio(preds, target, data_range=1.0, dim=(1, 2, 3))
+        per_img = [
+            _np_psnr(np.asarray(preds[i]), np.asarray(target[i]), 1.0) for i in range(BATCH_SIZE)
+        ]
+        np.testing.assert_allclose(float(val), np.mean(per_img), atol=1e-4)
+        # module path with list states
+        m = PeakSignalNoiseRatio(data_range=1.0, dim=(1, 2, 3))
+        m.update(preds, target)
+        m.update(target, preds)
+        assert np.isfinite(float(m.compute()))
+
+    def test_dim_requires_data_range(self):
+        with pytest.raises(ValueError):
+            PeakSignalNoiseRatio(dim=1)
+        with pytest.raises(ValueError):
+            peak_signal_noise_ratio(jnp.zeros((2, 3)), jnp.ones((2, 3)), dim=1)
+
+
+# ---------------------------------------------------------------- SSIM
+def _np_gaussian_kernel(size, sigma):
+    dist = np.arange((1 - size) / 2, (1 + size) / 2, 1.0)
+    g = np.exp(-((dist / sigma) ** 2) / 2)
+    g /= g.sum()
+    return np.outer(g, g)
+
+
+def _np_ssim(preds, target, data_range=None, kernel_size=11, sigma=1.5, k1=0.01, k2=0.03):
+    """Independent SSIM oracle: scipy.ndimage correlation with mirror padding."""
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    if data_range is None:
+        data_range = max(preds.max() - preds.min(), target.max() - target.min())
+    c1, c2 = (k1 * data_range) ** 2, (k2 * data_range) ** 2
+    kern = _np_gaussian_kernel(kernel_size, sigma)
+
+    def filt(x):
+        return scipy.ndimage.correlate(x, kern, mode="mirror")
+
+    vals = []
+    for b in range(preds.shape[0]):
+        for c in range(preds.shape[1]):
+            p, t = preds[b, c], target[b, c]
+            mu_p, mu_t = filt(p), filt(t)
+            s_pp = filt(p * p) - mu_p**2
+            s_tt = filt(t * t) - mu_t**2
+            s_pt = filt(p * t) - mu_p * mu_t
+            ssim_map = ((2 * mu_p * mu_t + c1) * (2 * s_pt + c2)) / (
+                (mu_p**2 + mu_t**2 + c1) * (s_pp + s_tt + c2)
+            )
+            vals.append(ssim_map)
+    return np.mean(vals)
+
+
+class TestSSIM(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_ssim(self, ddp):
+        preds, target = _imgs(4, (NUM_BATCHES, 4, 1, 24, 24)), _imgs(5, (NUM_BATCHES, 4, 1, 24, 24))
+        self.run_class_metric_test(
+            ddp, preds, target, StructuralSimilarityIndexMeasure,
+            lambda p, t: _np_ssim(p, t, data_range=1.0), metric_args={"data_range": 1.0},
+            check_jit=False,
+        )
+
+    def test_functional_multichannel(self):
+        preds, target = _imgs(6, (4, 3, 28, 28)), _imgs(7, (4, 3, 28, 28))
+        res = structural_similarity_index_measure(preds, target, data_range=1.0)
+        np.testing.assert_allclose(float(res), _np_ssim(preds, target, 1.0), atol=1e-5)
+
+    def test_identity(self):
+        x = _imgs(8, (2, 3, 20, 20))
+        np.testing.assert_allclose(float(structural_similarity_index_measure(x, x)), 1.0, atol=1e-6)
+
+    def test_reference_value(self):
+        """Reference doctest (``functional/image/ssim.py:108-117``): preds =
+        0.75 * target on uniform [0,1] images gives ~0.9219."""
+        rng = np.random.default_rng(42)
+        preds = jnp.asarray(rng.uniform(size=(16, 1, 16, 16)).astype(np.float32))
+        target = preds * 0.75
+        val = float(structural_similarity_index_measure(preds, target))
+        assert 0.90 <= val <= 0.94
+
+    def test_invalid_inputs(self):
+        with pytest.raises(ValueError):
+            structural_similarity_index_measure(jnp.zeros((2, 3, 8, 8)), jnp.zeros((2, 3, 8, 8)), kernel_size=(4, 4))
+        with pytest.raises(ValueError):
+            structural_similarity_index_measure(jnp.zeros((2, 8, 8)), jnp.zeros((2, 8, 8)))
+        with pytest.raises(TypeError):
+            structural_similarity_index_measure(
+                jnp.zeros((2, 3, 8, 8), jnp.float32), jnp.zeros((2, 3, 8, 8), jnp.float64)
+            )
+
+
+class TestMSSSIM:
+    def test_identity(self):
+        x = _imgs(9, (2, 1, 176, 176))
+        val = multiscale_structural_similarity_index_measure(x, x, data_range=1.0)
+        np.testing.assert_allclose(float(val), 1.0, atol=1e-5)
+
+    def test_single_scale_equals_ssim(self):
+        preds, target = _imgs(10, (4, 1, 48, 48)), _imgs(11, (4, 1, 48, 48))
+        ms = multiscale_structural_similarity_index_measure(preds, target, data_range=1.0, betas=(1.0,))
+        ssim = structural_similarity_index_measure(preds, target, data_range=1.0)
+        np.testing.assert_allclose(float(ms), float(ssim), atol=1e-5)
+
+    def test_monotonic_degradation(self):
+        target = _imgs(12, (2, 1, 176, 176))
+        rng = np.random.default_rng(13)
+        vals = []
+        for noise in (0.01, 0.1, 0.3):
+            preds = jnp.clip(target + noise * jnp.asarray(rng.normal(size=target.shape)), 0, 1).astype(jnp.float32)
+            vals.append(float(multiscale_structural_similarity_index_measure(preds, target, data_range=1.0)))
+        assert vals[0] > vals[1] > vals[2]
+
+    def test_module_matches_functional(self):
+        preds, target = _imgs(14, (2, 2, 1, 176, 176)), _imgs(15, (2, 2, 1, 176, 176))
+        m = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        for i in range(2):
+            m.update(preds[i], target[i])
+        direct = multiscale_structural_similarity_index_measure(
+            jnp.concatenate(list(preds)), jnp.concatenate(list(target)), data_range=1.0
+        )
+        np.testing.assert_allclose(float(m.compute()), float(direct), atol=1e-6)
+
+    def test_too_small_image_raises(self):
+        with pytest.raises(ValueError):
+            multiscale_structural_similarity_index_measure(jnp.zeros((1, 1, 16, 16)), jnp.zeros((1, 1, 16, 16)))
+
+
+# ---------------------------------------------------------------- gradients
+class TestImageGradients:
+    def test_reference_doctest(self):
+        """Reference doctest (``functional/image/gradients.py:40-60``)."""
+        image = jnp.arange(0, 25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+        dy, dx = image_gradients(image)
+        assert np.all(np.asarray(dy[0, 0, :-1]) == 5.0)
+        assert np.all(np.asarray(dy[0, 0, -1]) == 0.0)
+        assert np.all(np.asarray(dx[0, 0, :, :-1]) == 1.0)
+        assert np.all(np.asarray(dx[0, 0, :, -1]) == 0.0)
+
+    def test_invalid(self):
+        with pytest.raises(RuntimeError):
+            image_gradients(jnp.zeros((5, 5)))
+        with pytest.raises(TypeError):
+            image_gradients([[1, 2]])
+
+
+# ---------------------------------------------------------------- FID
+def _toy_extractor(imgs):
+    """Deterministic [N, d] feature map standing in for Inception."""
+    imgs = jnp.asarray(imgs, jnp.float32)
+    flat = imgs.reshape(imgs.shape[0], -1)
+    d = 8
+    n_in = flat.shape[1]
+    proj = jnp.asarray(np.random.default_rng(99).normal(size=(n_in, d)).astype(np.float32)) / np.sqrt(n_in)
+    return flat @ proj
+
+
+def _np_fid(real, fake):
+    """Oracle via scipy.linalg.sqrtm (the reference's exact algorithm, ``image/fid.py:100-126``)."""
+    real, fake = np.asarray(real, np.float64), np.asarray(fake, np.float64)
+    mu1, mu2 = real.mean(0), fake.mean(0)
+    cov1, cov2 = np.cov(real, rowvar=False), np.cov(fake, rowvar=False)
+    covmean = scipy.linalg.sqrtm(cov1 @ cov2)
+    if np.iscomplexobj(covmean):
+        covmean = covmean.real
+    diff = mu1 - mu2
+    return float(diff @ diff + np.trace(cov1) + np.trace(cov2) - 2 * np.trace(covmean))
+
+
+class TestFID:
+    @pytest.mark.parametrize("streaming", [True, False])
+    def test_vs_scipy_oracle(self, streaming):
+        rng = np.random.default_rng(16)
+        real_imgs = jnp.asarray(rng.uniform(size=(3, 16, 1, 8, 8)).astype(np.float32))
+        fake_imgs = jnp.asarray(rng.uniform(0, 0.8, size=(3, 16, 1, 8, 8)).astype(np.float32))
+        fid = FrechetInceptionDistance(feature=_toy_extractor, feature_dim=8 if streaming else None)
+        for i in range(3):
+            fid.update(real_imgs[i], real=True)
+            fid.update(fake_imgs[i], real=False)
+        real_feats = np.concatenate([np.asarray(_toy_extractor(real_imgs[i])) for i in range(3)])
+        fake_feats = np.concatenate([np.asarray(_toy_extractor(fake_imgs[i])) for i in range(3)])
+        oracle = _np_fid(real_feats, fake_feats)
+        np.testing.assert_allclose(float(fid.compute()), oracle, rtol=1e-3, atol=1e-4)
+
+    def test_streaming_equals_buffered(self):
+        rng = np.random.default_rng(17)
+        imgs_r = jnp.asarray(rng.uniform(size=(32, 1, 8, 8)).astype(np.float32))
+        imgs_f = jnp.asarray(rng.uniform(size=(32, 1, 8, 8)).astype(np.float32))
+        f1 = FrechetInceptionDistance(feature=_toy_extractor, feature_dim=8)
+        f2 = FrechetInceptionDistance(feature=_toy_extractor)
+        for f in (f1, f2):
+            f.update(imgs_r, real=True)
+            f.update(imgs_f, real=False)
+        np.testing.assert_allclose(float(f1.compute()), float(f2.compute()), rtol=1e-3, atol=1e-4)
+
+    def test_same_distribution_near_zero(self):
+        rng = np.random.default_rng(18)
+        imgs = jnp.asarray(rng.uniform(size=(64, 1, 8, 8)).astype(np.float32))
+        fid = FrechetInceptionDistance(feature=_toy_extractor, feature_dim=8)
+        fid.update(imgs, real=True)
+        fid.update(imgs, real=False)
+        assert abs(float(fid.compute())) < 1e-4
+
+    def test_default_inception_gated(self):
+        with pytest.raises(ModuleNotFoundError):
+            FrechetInceptionDistance(feature=2048)
+
+    def test_too_few_samples(self):
+        from metrics_tpu.utils.exceptions import MetricsUserError
+
+        fid = FrechetInceptionDistance(feature=_toy_extractor, feature_dim=8)
+        fid.update(jnp.ones((1, 1, 8, 8)), real=True)
+        fid.update(jnp.ones((1, 1, 8, 8)), real=False)
+        with pytest.raises(MetricsUserError):
+            fid.compute()
+
+
+# ---------------------------------------------------------------- KID
+class TestKID:
+    def test_separates_distributions(self):
+        """Unbiased MMD has subset-sampling noise, so assert separation: the
+        same-distribution score must sit far below the shifted-distribution
+        score (and near zero relative to it)."""
+        rng = np.random.default_rng(19)
+        imgs = jnp.asarray(rng.uniform(size=(40, 1, 8, 8)).astype(np.float32))
+        kid_same = KernelInceptionDistance(feature=_toy_extractor, subsets=5, subset_size=16)
+        kid_same.update(imgs, real=True)
+        kid_same.update(imgs, real=False)
+        mean_same, std_same = kid_same.compute()
+        assert float(std_same) >= 0
+
+        fake = jnp.asarray(rng.uniform(0.5, 1.5, size=(40, 1, 8, 8)).astype(np.float32))
+        kid_diff = KernelInceptionDistance(feature=_toy_extractor, subsets=5, subset_size=16)
+        kid_diff.update(imgs, real=True)
+        kid_diff.update(fake, real=False)
+        mean_diff, _ = kid_diff.compute()
+        assert float(mean_diff) > 10 * abs(float(mean_same))
+
+    def test_subset_size_validation(self):
+        kid = KernelInceptionDistance(feature=_toy_extractor, subsets=2, subset_size=100)
+        kid.update(jnp.ones((10, 1, 8, 8)), real=True)
+        kid.update(jnp.ones((10, 1, 8, 8)), real=False)
+        with pytest.raises(ValueError):
+            kid.compute()
+
+    @pytest.mark.parametrize(
+        "kwargs", [{"subsets": 0}, {"subset_size": -1}, {"degree": 0}, {"gamma": -1.0}, {"coef": -1.0}]
+    )
+    def test_invalid_args(self, kwargs):
+        with pytest.raises(ValueError):
+            KernelInceptionDistance(feature=_toy_extractor, **kwargs)
+
+    def test_default_inception_gated(self):
+        with pytest.raises(ModuleNotFoundError):
+            KernelInceptionDistance()
+
+    def test_malformed_features_rejected(self):
+        from metrics_tpu.utils.exceptions import MetricsUserError
+
+        kid = KernelInceptionDistance(feature=lambda x: jnp.ones((x.shape[0],)), subsets=2, subset_size=4)
+        with pytest.raises(MetricsUserError):
+            kid.update(jnp.ones((8, 1, 4, 4)), real=True)
+        is_metric = InceptionScore(feature=lambda x: jnp.ones((x.shape[0],)))
+        with pytest.raises(MetricsUserError):
+            is_metric.update(jnp.ones((8, 1, 4, 4)))
+
+
+# ---------------------------------------------------------------- IS
+class TestInceptionScore:
+    def test_uniform_logits_score_one(self):
+        """Identical logits for every image → p(y|x) == p(y) → IS = 1."""
+        is_metric = InceptionScore(feature=lambda x: jnp.zeros((x.shape[0], 10)), splits=2)
+        is_metric.update(jnp.ones((20, 1, 4, 4)))
+        mean, std = is_metric.compute()
+        np.testing.assert_allclose(float(mean), 1.0, atol=1e-5)
+
+    def test_confident_distinct_classes_high_score(self):
+        """Each image strongly predicts a different class → IS ≈ num_classes."""
+
+        def logits_fn(x):
+            n = x.shape[0]
+            return 50.0 * jax.nn.one_hot(jnp.arange(n) % 10, 10)
+
+        # splits=1: the post-shuffle class marginal is exactly uniform -> IS = 10
+        is_metric = InceptionScore(feature=logits_fn, splits=1)
+        is_metric.update(jnp.ones((40, 1, 4, 4)))
+        mean, _ = is_metric.compute()
+        np.testing.assert_allclose(float(mean), 10.0, rtol=1e-4)
+        # splits=2: shuffling unbalances per-split marginals, score drops but stays high
+        is_metric2 = InceptionScore(feature=logits_fn, splits=2)
+        is_metric2.update(jnp.ones((40, 1, 4, 4)))
+        mean2, _ = is_metric2.compute()
+        assert 6.0 < float(mean2) <= 10.0
+
+    def test_default_inception_gated(self):
+        with pytest.raises(ModuleNotFoundError):
+            InceptionScore()
+
+
+# ---------------------------------------------------------------- LPIPS
+class TestLPIPS:
+    def test_streaming_mean(self):
+        def toy_net(a, b):
+            return jnp.mean(jnp.abs(a - b), axis=(1, 2, 3))
+
+        lpips = LearnedPerceptualImagePatchSimilarity(net=toy_net)
+        rng = np.random.default_rng(21)
+        all_scores = []
+        for _ in range(3):
+            a = jnp.asarray(rng.uniform(-1, 1, size=(8, 3, 16, 16)).astype(np.float32))
+            b = jnp.asarray(rng.uniform(-1, 1, size=(8, 3, 16, 16)).astype(np.float32))
+            all_scores.append(np.asarray(toy_net(a, b)))
+            lpips.update(a, b)
+        np.testing.assert_allclose(float(lpips.compute()), np.concatenate(all_scores).mean(), atol=1e-6)
+
+    def test_normalize(self):
+        seen = {}
+
+        def toy_net(a, b):
+            seen["min"], seen["max"] = float(a.min()), float(a.max())
+            return jnp.mean(jnp.abs(a - b), axis=(1, 2, 3))
+
+        lpips = LearnedPerceptualImagePatchSimilarity(net=toy_net, normalize=True)
+        rng = np.random.default_rng(22)
+        a = jnp.asarray(rng.uniform(0, 1, size=(4, 3, 8, 8)).astype(np.float32))
+        b = jnp.asarray(rng.uniform(0, 1, size=(4, 3, 8, 8)).astype(np.float32))
+        lpips.update(a, b)
+        # the net must have received the [-1, 1]-shifted inputs
+        np.testing.assert_allclose(seen["min"], 2 * float(a.min()) - 1, atol=1e-6)
+        np.testing.assert_allclose(seen["max"], 2 * float(a.max()) - 1, atol=1e-6)
+        assert seen["min"] < 0
+        # and the value equals the net applied to shifted inputs (2x the raw diff)
+        expected = float(jnp.mean(jnp.abs(2 * a - 2 * b)))
+        np.testing.assert_allclose(float(lpips.compute()), expected, atol=1e-6)
+
+    def test_pretrained_gated(self):
+        with pytest.raises(ModuleNotFoundError):
+            LearnedPerceptualImagePatchSimilarity(net="alex")
+        with pytest.raises(ValueError):
+            LearnedPerceptualImagePatchSimilarity(net="bogus")
