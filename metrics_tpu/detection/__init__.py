@@ -1,0 +1,5 @@
+"""Detection module metrics (parity: reference ``torchmetrics/detection/``)."""
+from metrics_tpu.detection._box_ops import box_area, box_convert, box_iou  # noqa: F401
+from metrics_tpu.detection.map import MAP, MeanAveragePrecision  # noqa: F401
+
+__all__ = ["MAP", "MeanAveragePrecision", "box_area", "box_convert", "box_iou"]

@@ -1,0 +1,55 @@
+"""Jittable box primitives.
+
+The reference delegates these to ``torchvision.ops.box_{convert,area,iou}``
+(``detection/map.py:26``); here they are pure jnp programs usable on device
+(and under vmap/jit) as well as from the host-side COCO evaluation loop.
+Boxes are ``[N, 4]`` in xyxy (Pascal VOC) unless stated otherwise.
+"""
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str) -> Array:
+    """Convert between ``xyxy``/``xywh``/``cxcywh`` (torchvision semantics)."""
+    allowed = ("xyxy", "xywh", "cxcywh")
+    if in_fmt not in allowed or out_fmt not in allowed:
+        raise ValueError(f"Unsupported box format conversion {in_fmt} -> {out_fmt}")
+    if in_fmt == out_fmt:
+        return boxes
+    boxes = jnp.asarray(boxes, dtype=jnp.result_type(boxes, jnp.float32))
+    if in_fmt == "xywh":
+        x, y, w, h = jnp.split(boxes, 4, axis=-1)
+        xyxy = jnp.concatenate([x, y, x + w, y + h], axis=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+        xyxy = jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    else:
+        xyxy = boxes
+    if out_fmt == "xyxy":
+        return xyxy
+    x1, y1, x2, y2 = jnp.split(xyxy, 4, axis=-1)
+    if out_fmt == "xywh":
+        return jnp.concatenate([x1, y1, x2 - x1, y2 - y1], axis=-1)
+    return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+
+
+def box_area(boxes: Array) -> Array:
+    """Area of xyxy boxes, shape ``[N]``."""
+    boxes = jnp.asarray(boxes)
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """Pairwise IoU matrix ``[N, M]`` for xyxy boxes (torchvision ``box_iou``)."""
+    boxes1 = jnp.asarray(boxes1, dtype=jnp.result_type(boxes1, jnp.float32))
+    boxes2 = jnp.asarray(boxes2, dtype=boxes1.dtype)
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)

@@ -124,7 +124,11 @@ def _ssim_compute(
     ssim_idx = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
 
     if return_contrast_sensitivity:
-        return _reduce(ssim_idx, reduction), _reduce(upper / lower, reduction)
+        # per-image reduction: MS-SSIM combines scales per image before any
+        # batch reduction (the reference passes `reduction` through here,
+        # collapsing the batch at every scale — a known flaw of the snapshot;
+        # for N=1 or homogeneous batches the results coincide)
+        return jnp.mean(ssim_idx, axis=(1, 2, 3)), jnp.mean(upper / lower, axis=(1, 2, 3))
     return _reduce(ssim_idx, reduction)
 
 
@@ -183,6 +187,8 @@ def _multiscale_ssim_compute(
     sim_list: List[Array] = []
     cs_list: List[Array] = []
     for _ in range(len(betas)):
+        # per-image sim/cs at each scale; the batch reduction happens once,
+        # after the scales are combined per image
         sim, cs = _ssim_compute(
             preds, target, kernel_size, sigma, reduction, data_range, k1, k2, return_contrast_sensitivity=True
         )
@@ -194,17 +200,18 @@ def _multiscale_ssim_compute(
         preds = _avg_pool2d(preds)
         target = _avg_pool2d(target)
 
-    sim_stack = jnp.stack(sim_list)
+    sim_stack = jnp.stack(sim_list)  # [n_scales, N]
     cs_stack = jnp.stack(cs_list)
 
     if normalize == "simple":
         sim_stack = (sim_stack + 1) / 2
         cs_stack = (cs_stack + 1) / 2
 
-    betas_arr = jnp.asarray(betas, dtype=sim_stack.dtype)
+    betas_arr = jnp.asarray(betas, dtype=sim_stack.dtype)[:, None]
     sim_stack = sim_stack**betas_arr
     cs_stack = cs_stack**betas_arr
-    return jnp.prod(cs_stack[:-1]) * sim_stack[-1]
+    per_image = jnp.prod(cs_stack[:-1], axis=0) * sim_stack[-1]  # [N]
+    return _reduce(per_image, reduction)
 
 
 def multiscale_structural_similarity_index_measure(

@@ -126,11 +126,17 @@ class KernelInceptionDistance(Metric):
         fake_idx = jnp.asarray(
             np.stack([rng.permutation(n_fake)[: self.subset_size] for _ in range(self.subsets)])
         )
-        f_real = real_features[real_idx]  # [subsets, subset_size, d]
-        f_fake = fake_features[fake_idx]
-        # lax.map runs one subset's kernel matrices at a time (~subset_size^2
-        # memory) instead of materializing all `subsets` of them at once
+        # lax.map gathers and evaluates ONE subset per step, so peak memory is
+        # a single [subset_size, d] slice pair + its kernel matrices instead
+        # of all `subsets` of them at once
         kid_scores = jax.lax.map(
-            lambda ab: poly_mmd(ab[0], ab[1], self.degree, self.gamma, self.coef), (f_real, f_fake)
+            lambda idx: poly_mmd(
+                jnp.take(real_features, idx[0], axis=0),
+                jnp.take(fake_features, idx[1], axis=0),
+                self.degree,
+                self.gamma,
+                self.coef,
+            ),
+            (real_idx, fake_idx),
         )
         return kid_scores.mean(), kid_scores.std(ddof=0)

@@ -1,0 +1,312 @@
+"""Detection mAP tests.
+
+Parity: reference ``tests/detection/test_map.py`` (which validates against
+pycocotools — absent here). Oracles: the reference's own doctest golden values
+(``detection/map.py:186-219``), hand-derived analytic cases, and box-op
+identities.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MeanAveragePrecision
+from metrics_tpu.detection import box_area, box_convert, box_iou
+
+
+class TestBoxOps:
+    def test_iou_hand_values(self):
+        a = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+        b = jnp.asarray([[0.0, 0.0, 10.0, 6.0], [20.0, 20.0, 30.0, 30.0], [0.0, 0.0, 10.0, 10.0]])
+        iou = np.asarray(box_iou(a, b))
+        np.testing.assert_allclose(iou[0], [0.6, 0.0, 1.0], atol=1e-6)
+
+    def test_area(self):
+        np.testing.assert_allclose(
+            np.asarray(box_area(jnp.asarray([[1.0, 2.0, 4.0, 6.0]]))), [12.0], atol=1e-6
+        )
+
+    @pytest.mark.parametrize("fmt", ["xywh", "cxcywh"])
+    def test_convert_roundtrip(self, fmt):
+        rng = np.random.default_rng(0)
+        xy = rng.uniform(0, 50, size=(10, 2))
+        wh = rng.uniform(1, 20, size=(10, 2))
+        xyxy = jnp.asarray(np.concatenate([xy, xy + wh], axis=1))
+        other = box_convert(xyxy, "xyxy", fmt)
+        back = box_convert(other, fmt, "xyxy")
+        np.testing.assert_allclose(np.asarray(back), np.asarray(xyxy), atol=1e-5)
+
+    def test_convert_known(self):
+        xywh = jnp.asarray([[10.0, 20.0, 5.0, 8.0]])
+        np.testing.assert_allclose(
+            np.asarray(box_convert(xywh, "xywh", "xyxy")), [[10.0, 20.0, 15.0, 28.0]], atol=1e-6
+        )
+        cxcywh = jnp.asarray([[12.5, 24.0, 5.0, 8.0]])
+        np.testing.assert_allclose(
+            np.asarray(box_convert(cxcywh, "cxcywh", "xyxy")), [[10.0, 20.0, 15.0, 28.0]], atol=1e-6
+        )
+
+
+def _preds_targets_reference():
+    """The reference doctest example (``detection/map.py:186-219``)."""
+    preds = [
+        dict(
+            boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+            scores=jnp.asarray([0.536]),
+            labels=jnp.asarray([0]),
+        )
+    ]
+    target = [
+        dict(
+            boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]),
+            labels=jnp.asarray([0]),
+        )
+    ]
+    return preds, target
+
+
+class TestMeanAveragePrecision:
+    def test_reference_doctest_golden(self):
+        """Must reproduce the reference's published doctest output exactly."""
+        preds, target = _preds_targets_reference()
+        metric = MeanAveragePrecision()
+        metric.update(preds, target)
+        res = {k: float(v) if v.ndim == 0 else np.asarray(v) for k, v in metric.compute().items()}
+        np.testing.assert_allclose(res["map"], 0.6, atol=1e-4)
+        np.testing.assert_allclose(res["map_50"], 1.0, atol=1e-4)
+        np.testing.assert_allclose(res["map_75"], 1.0, atol=1e-4)
+        np.testing.assert_allclose(res["map_large"], 0.6, atol=1e-4)
+        np.testing.assert_allclose(res["map_medium"], -1.0, atol=1e-4)
+        np.testing.assert_allclose(res["map_small"], -1.0, atol=1e-4)
+        np.testing.assert_allclose(res["mar_1"], 0.6, atol=1e-4)
+        np.testing.assert_allclose(res["mar_10"], 0.6, atol=1e-4)
+        np.testing.assert_allclose(res["mar_100"], 0.6, atol=1e-4)
+        np.testing.assert_allclose(res["mar_large"], 0.6, atol=1e-4)
+        np.testing.assert_allclose(res["map_per_class"], [-1.0], atol=1e-4)
+        np.testing.assert_allclose(res["mar_100_per_class"], [-1.0], atol=1e-4)
+
+    def test_perfect_detections(self):
+        rng = np.random.default_rng(1)
+        metric = MeanAveragePrecision()
+        for _ in range(3):
+            xy = rng.uniform(0, 200, size=(5, 2))
+            wh = rng.uniform(40, 80, size=(5, 2))
+            boxes = jnp.asarray(np.concatenate([xy, xy + wh], axis=1))
+            labels = jnp.asarray(rng.integers(0, 3, size=5))
+            metric.update(
+                [dict(boxes=boxes, scores=jnp.ones(5), labels=labels)],
+                [dict(boxes=boxes, labels=labels)],
+            )
+        res = metric.compute()
+        np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(float(res["mar_100"]), 1.0, atol=1e-6)
+
+    def test_analytic_partial_overlap(self):
+        """One det at IoU 0.6 (match for thr <= 0.6), one false positive with
+        lower score: AP = 1 for 3 of 10 thresholds -> map = 0.3."""
+        preds = [
+            dict(
+                boxes=jnp.asarray([[0.0, 0.0, 10.0, 6.0], [20.0, 20.0, 30.0, 30.0]]),
+                scores=jnp.asarray([0.9, 0.8]),
+                labels=jnp.asarray([0, 0]),
+            )
+        ]
+        target = [dict(boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), labels=jnp.asarray([0]))]
+        metric = MeanAveragePrecision()
+        metric.update(preds, target)
+        res = metric.compute()
+        np.testing.assert_allclose(float(res["map"]), 0.3, atol=1e-6)
+        np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(float(res["map_75"]), 0.0, atol=1e-6)
+        np.testing.assert_allclose(float(res["mar_100"]), 0.3, atol=1e-6)
+
+    def test_false_positive_lower_score_does_not_hurt_ap50(self):
+        """FP ranked below all TPs leaves AP@50 at 1 (precision envelope)."""
+        target = [dict(boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), labels=jnp.asarray([0]))]
+        preds = [
+            dict(
+                boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0], [50.0, 50.0, 60.0, 60.0]]),
+                scores=jnp.asarray([0.9, 0.1]),
+                labels=jnp.asarray([0, 0]),
+            )
+        ]
+        metric = MeanAveragePrecision(iou_thresholds=[0.5])
+        metric.update(preds, target)
+        np.testing.assert_allclose(float(metric.compute()["map"]), 1.0, atol=1e-6)
+
+    def test_max_detection_threshold_limits(self):
+        """mar_1 counts only the single highest-score detection."""
+        target = [
+            dict(boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 0.0, 30.0, 10.0]]), labels=jnp.asarray([0, 0]))
+        ]
+        preds = [
+            dict(
+                boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 0.0, 30.0, 10.0]]),
+                scores=jnp.asarray([0.9, 0.8]),
+                labels=jnp.asarray([0, 0]),
+            )
+        ]
+        metric = MeanAveragePrecision()
+        metric.update(preds, target)
+        res = metric.compute()
+        np.testing.assert_allclose(float(res["mar_1"]), 0.5, atol=1e-6)
+        np.testing.assert_allclose(float(res["mar_100"]), 1.0, atol=1e-6)
+
+    def test_empty_preds_and_targets(self):
+        metric = MeanAveragePrecision()
+        metric.update(
+            [dict(boxes=jnp.zeros((0, 4)), scores=jnp.zeros(0), labels=jnp.zeros(0, jnp.int32))],
+            [dict(boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), labels=jnp.asarray([0]))],
+        )
+        res = metric.compute()
+        np.testing.assert_allclose(float(res["map"]), 0.0, atol=1e-6)  # missed gt
+        metric2 = MeanAveragePrecision()
+        metric2.update(
+            [dict(boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))],
+            [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros(0, jnp.int32))],
+        )
+        res2 = metric2.compute()  # only false positives, no gts -> undefined (-1)
+        np.testing.assert_allclose(float(res2["map"]), -1.0, atol=1e-6)
+
+    def test_class_metrics(self):
+        preds = [
+            dict(
+                boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 0.0, 30.0, 10.0]]),
+                scores=jnp.asarray([0.9, 0.8]),
+                labels=jnp.asarray([0, 1]),
+            )
+        ]
+        target = [
+            dict(
+                boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0], [50.0, 50.0, 60.0, 60.0]]),
+                labels=jnp.asarray([0, 1]),
+            )
+        ]
+        metric = MeanAveragePrecision(class_metrics=True)
+        metric.update(preds, target)
+        res = metric.compute()
+        per_class = np.asarray(res["map_per_class"])
+        assert per_class.shape == (2,)
+        np.testing.assert_allclose(per_class[0], 1.0, atol=1e-6)  # class 0 perfect
+        np.testing.assert_allclose(per_class[1], 0.0, atol=1e-6)  # class 1 missed
+        np.testing.assert_allclose(float(res["map"]), 0.5, atol=1e-6)
+
+    def test_box_format_xywh(self):
+        """Same boxes given as xywh must produce identical results."""
+        preds_xyxy, target_xyxy = _preds_targets_reference()
+        m1 = MeanAveragePrecision()
+        m1.update(preds_xyxy, target_xyxy)
+
+        def to_xywh(b):
+            b = np.asarray(b)
+            return jnp.asarray(np.concatenate([b[:, :2], b[:, 2:] - b[:, :2]], axis=1))
+
+        preds_xywh = [dict(boxes=to_xywh(preds_xyxy[0]["boxes"]), scores=preds_xyxy[0]["scores"], labels=preds_xyxy[0]["labels"])]
+        target_xywh = [dict(boxes=to_xywh(target_xyxy[0]["boxes"]), labels=target_xyxy[0]["labels"])]
+        m2 = MeanAveragePrecision(box_format="xywh")
+        m2.update(preds_xywh, target_xywh)
+        r1, r2 = m1.compute(), m2.compute()
+        for k in r1:
+            np.testing.assert_allclose(np.asarray(r1[k]), np.asarray(r2[k]), atol=1e-6, err_msg=k)
+
+    def test_area_range_attribution(self):
+        """A 20x20 gt is 'small' (400 < 1024); its AP must land in map_small."""
+        target = [dict(boxes=jnp.asarray([[0.0, 0.0, 20.0, 20.0]]), labels=jnp.asarray([0]))]
+        preds = [
+            dict(boxes=jnp.asarray([[0.0, 0.0, 20.0, 20.0]]), scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))
+        ]
+        metric = MeanAveragePrecision()
+        metric.update(preds, target)
+        res = metric.compute()
+        np.testing.assert_allclose(float(res["map_small"]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(float(res["map_medium"]), -1.0, atol=1e-6)
+        np.testing.assert_allclose(float(res["map_large"]), -1.0, atol=1e-6)
+
+    def test_input_validation(self):
+        metric = MeanAveragePrecision()
+        with pytest.raises(ValueError):
+            metric.update([dict(boxes=jnp.zeros((1, 4)))], [dict(boxes=jnp.zeros((1, 4)), labels=jnp.zeros(1))])
+        with pytest.raises(ValueError):
+            metric.update([], [dict(boxes=jnp.zeros((1, 4)), labels=jnp.zeros(1))])
+        with pytest.raises(ValueError):
+            MeanAveragePrecision(box_format="bogus")
+        with pytest.raises(ValueError):
+            MeanAveragePrecision(class_metrics="yes")
+
+    def test_ddp_ragged_sync(self):
+        """Emulated 2-rank sync: per-image structure must survive the gather
+        and the merged result must equal a single-process run on all data."""
+        rng = np.random.default_rng(7)
+
+        def rand_sample():
+            n = int(rng.integers(1, 5))
+            xy = rng.uniform(0, 100, size=(n, 2))
+            wh = rng.uniform(10, 60, size=(n, 2))
+            gt = np.concatenate([xy, xy + wh], axis=1)
+            det = gt + rng.normal(0, 4, size=gt.shape)
+            det[:, 2:] = np.maximum(det[:, 2:], det[:, :2] + 1)
+            return (
+                dict(boxes=jnp.asarray(det), scores=jnp.asarray(rng.uniform(size=n)), labels=jnp.asarray(rng.integers(0, 2, n))),
+                dict(boxes=jnp.asarray(gt), labels=jnp.asarray(rng.integers(0, 2, n))),
+            )
+
+        samples = [rand_sample() for _ in range(6)]
+        rank0, rank1 = MeanAveragePrecision(), MeanAveragePrecision()
+        for i, (p, t) in enumerate(samples):
+            (rank0 if i % 2 == 0 else rank1).update([p], [t])
+
+        # fake 2-rank gather replaying each rank's flat/length pairs in call order
+        calls = {"i": 0}
+        rank_payloads = []
+        for m in (rank0, rank1):
+            payload = []
+            for name, width in MeanAveragePrecision._STATE_WIDTHS.items():
+                local = getattr(m, name)
+                cols = width if width else 1
+                flat = np.concatenate([np.asarray(x).reshape(-1, cols) for x in local], axis=0) if local else np.zeros((0, cols))
+                payload.append(jnp.asarray(flat))
+                payload.append(jnp.asarray([int(x.shape[0]) for x in local], dtype=jnp.int32))
+            rank_payloads.append(payload)
+
+        def fake_gather(x, group=None):
+            i = calls["i"] % len(rank_payloads[0])
+            calls["i"] += 1
+            return [rank_payloads[0][i], rank_payloads[1][i]]
+
+        rank0.dist_sync_fn = fake_gather
+        rank0._distributed_available_fn = lambda: True
+        synced = rank0.compute()
+
+        serial = MeanAveragePrecision()
+        order = [i for r in range(2) for i in range(r, 6, 2)]
+        serial.update([samples[i][0] for i in order], [samples[i][1] for i in order])
+        expected = serial.compute()
+        for k in expected:
+            np.testing.assert_allclose(np.asarray(synced[k]), np.asarray(expected[k]), atol=1e-6, err_msg=k)
+        # after unsync, the local rank state must be restored (3 images)
+        assert len(rank0.detection_boxes) == 3
+
+    def test_streaming_equals_single_update(self):
+        rng = np.random.default_rng(3)
+
+        def rand_sample():
+            n = int(rng.integers(1, 6))
+            xy = rng.uniform(0, 100, size=(n, 2))
+            wh = rng.uniform(10, 60, size=(n, 2))
+            gt = np.concatenate([xy, xy + wh], axis=1)
+            jitter = rng.normal(0, 5, size=gt.shape)
+            det = gt + jitter
+            det[:, 2:] = np.maximum(det[:, 2:], det[:, :2] + 1)
+            return (
+                dict(boxes=jnp.asarray(det), scores=jnp.asarray(rng.uniform(size=n)), labels=jnp.asarray(rng.integers(0, 2, n))),
+                dict(boxes=jnp.asarray(gt), labels=jnp.asarray(rng.integers(0, 2, n))),
+            )
+
+        samples = [rand_sample() for _ in range(6)]
+        m_stream, m_once = MeanAveragePrecision(), MeanAveragePrecision()
+        for p, t in samples:
+            m_stream.update([p], [t])
+        m_once.update([p for p, _ in samples], [t for _, t in samples])
+        r1, r2 = m_stream.compute(), m_once.compute()
+        for k in r1:
+            np.testing.assert_allclose(np.asarray(r1[k]), np.asarray(r2[k]), atol=1e-6, err_msg=k)

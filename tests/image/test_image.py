@@ -191,6 +191,20 @@ class TestMSSSIM:
         )
         np.testing.assert_allclose(float(m.compute()), float(direct), atol=1e-6)
 
+    def test_per_image_combination(self):
+        """MS-SSIM of a heterogeneous batch equals the mean of per-image
+        MS-SSIM values (scales combine per image, not per batch-mean)."""
+        rng = np.random.default_rng(30)
+        target = _imgs(31, (2, 1, 176, 176))
+        noise = jnp.asarray(rng.normal(size=target.shape))
+        preds = jnp.clip(target + jnp.asarray([[[[0.02]]], [[[0.3]]]]) * noise, 0, 1).astype(jnp.float32)
+        batch_val = multiscale_structural_similarity_index_measure(preds, target, data_range=1.0)
+        per_img = [
+            float(multiscale_structural_similarity_index_measure(preds[i : i + 1], target[i : i + 1], data_range=1.0))
+            for i in range(2)
+        ]
+        np.testing.assert_allclose(float(batch_val), np.mean(per_img), atol=1e-6)
+
     def test_too_small_image_raises(self):
         with pytest.raises(ValueError):
             multiscale_structural_similarity_index_measure(jnp.zeros((1, 1, 16, 16)), jnp.zeros((1, 1, 16, 16)))
