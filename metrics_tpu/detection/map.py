@@ -164,17 +164,22 @@ class MeanAveragePrecision(Metric):
         for name, width in self._STATE_WIDTHS.items():
             local = getattr(self, name)
             cols = width if width else 1
+            dtype = np.int64 if "labels" in name else np.float64
             lengths = jnp.asarray([int(x.shape[0]) for x in local], dtype=jnp.int32)
             flat_np = (
-                np.concatenate([np.asarray(x).reshape(-1, cols) for x in local], axis=0)
+                np.concatenate([np.asarray(x, dtype).reshape(-1, cols) for x in local], axis=0)
                 if local
-                else np.zeros((0, cols))
+                else np.zeros((0, cols), dtype)
             )
-            gathered_flat = gather(jnp.asarray(flat_np), group=group)
+            # ship the 8-byte values as raw bytes: jnp would truncate float64
+            # and int64 to 32-bit without jax_enable_x64, silently rounding
+            # box coordinates before the gather
+            byte_rows = np.ascontiguousarray(flat_np).view(np.uint8).reshape(flat_np.shape[0], cols * 8)
+            gathered_flat = gather(jnp.asarray(byte_rows), group=group)
             gathered_len = gather(lengths, group=group)
             new_list: List[np.ndarray] = []
             for fl, ln in zip(gathered_flat, gathered_len):
-                fl_np = np.asarray(fl, dtype=np.int64 if "labels" in name else np.float64)
+                fl_np = np.ascontiguousarray(np.asarray(fl, np.uint8)).view(dtype).reshape(-1, cols)
                 ln_np = np.asarray(ln, dtype=np.int64)
                 offsets = np.cumsum(ln_np)[:-1] if ln_np.size else []
                 for part in np.split(fl_np, offsets):

@@ -1,4 +1,5 @@
 """Text-domain functional metrics (parity: reference ``torchmetrics/functional/text/``)."""
+from metrics_tpu.functional.text.bert import bert_score  # noqa: F401
 from metrics_tpu.functional.text.bleu import bleu_score  # noqa: F401
 from metrics_tpu.functional.text.cer import char_error_rate  # noqa: F401
 from metrics_tpu.functional.text.chrf import chrf_score  # noqa: F401
@@ -13,6 +14,7 @@ from metrics_tpu.functional.text.wil import word_information_lost  # noqa: F401
 from metrics_tpu.functional.text.wip import word_information_preserved  # noqa: F401
 
 __all__ = [
+    "bert_score",
     "bleu_score",
     "char_error_rate",
     "chrf_score",

@@ -1,0 +1,238 @@
+"""BERTScore.
+
+Parity target: reference ``torchmetrics/functional/text/bert.py``
+(``bert_score`` :458; tokenization/dataset plumbing :140-258; embedding +
+idf extraction ``_get_embeddings_and_idf_scale`` :262-356; greedy cosine
+matching ``_get_precision_recall_f1`` :358-383; idf weighting
+``_get_tokens_idf`` :188-206; special-token masking :90-106) and the
+own-model contract of ``tm_examples/bert_score-own_model.py``.
+
+TPU-native design:
+
+* The contextual encoder is a **user-supplied callable**
+  ``model(input_ids [N, L], attention_mask [N, L]) -> embeddings [N, L, d]``
+  — e.g. a jitted Flax/HF-Flax forward. The HF default is availability-gated
+  (pretrained weights need network access the TPU pod does not have); with
+  ``transformers`` installed and a cached model, ``model_name_or_path`` works.
+* Tokenization and idf statistics run on host (they are string work, exactly
+  as in the reference); the embedding forward and the batched cosine matching
+  ``einsum('bpd, brd -> bpr')`` run on device in one shot — no DataLoader
+  loop, XLA fuses normalize + matmul + masked max/sum.
+"""
+import math
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+
+Array = jax.Array
+
+
+def _simple_tokenizer_call(tokenizer: Any, text: List[str], max_length: int) -> Dict[str, np.ndarray]:
+    """Call either an HF-style tokenizer (kwargs API) or the reference's
+    own-tokenizer contract ``tokenizer(text, max_length)`` (reference
+    ``bert.py:70-79``)."""
+    if hasattr(tokenizer, "batch_encode_plus") or getattr(tokenizer, "is_fast", None) is not None:
+        out = tokenizer(text, padding="max_length", max_length=max_length, truncation=True, return_tensors="np")
+        return {"input_ids": np.asarray(out["input_ids"]), "attention_mask": np.asarray(out["attention_mask"])}
+    out = tokenizer(text, max_length)
+    return {"input_ids": np.asarray(out["input_ids"]), "attention_mask": np.asarray(out["attention_mask"])}
+
+
+def _get_tokens_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+    """idf(t) = log((N + 1) / (df(t) + 1)) over the reference corpus
+    (reference ``bert.py:188-206``)."""
+    num_sentences = len(input_ids)
+    counter: Counter = Counter()
+    for ids, mask in zip(input_ids, attention_mask):
+        counter.update(set(ids[mask.astype(bool)].tolist()))
+    default = math.log((num_sentences + 1) / 1)
+    idf = {int(idx): math.log((num_sentences + 1) / (occ + 1)) for idx, occ in counter.items()}
+    return {**idf, -1: default}  # -1 holds the unseen-token default
+
+
+def _idf_scale(input_ids: np.ndarray, tokens_idf: Optional[Dict[int, float]]) -> np.ndarray:
+    if tokens_idf is None:
+        return np.ones_like(input_ids, dtype=np.float64)
+    default = tokens_idf.get(-1, 0.0)
+    return np.vectorize(lambda t: tokens_idf.get(int(t), default))(input_ids).astype(np.float64)
+
+
+def _process_attention_mask_for_special_tokens(attention_mask: np.ndarray) -> np.ndarray:
+    """Zero out [CLS] (first) and [SEP] (last attended) positions (reference
+    ``bert.py:90-106``)."""
+    attention_mask = attention_mask.copy()
+    if attention_mask.shape[1] == 0:
+        return attention_mask
+    attention_mask[:, 0] = 0
+    sep_pos = np.argmax(np.cumsum(attention_mask - 0.1, axis=-1), axis=-1)
+    attention_mask[np.arange(attention_mask.shape[0]), sep_pos] = 0
+    return attention_mask
+
+
+def _get_precision_recall_f1(
+    preds_emb: Array,
+    target_emb: Array,
+    preds_mask: Array,
+    target_mask: Array,
+    preds_idf: Array,
+    target_idf: Array,
+) -> Dict[str, Array]:
+    """Greedy cosine matching with idf weighting, fully batched on device
+    (reference ``bert.py:358-383``)."""
+    # L2-normalize token embeddings; masked tokens zeroed
+    def _norm(emb: Array, mask: Array) -> Array:
+        emb = emb * mask[..., None]
+        denom = jnp.linalg.norm(emb, axis=-1, keepdims=True)
+        return emb / jnp.where(denom > 0, denom, 1.0)
+
+    p = _norm(preds_emb, preds_mask)
+    t = _norm(target_emb, target_mask)
+    # HIGHEST: the MXU's default multi-pass bf16 matmul costs ~5e-4 of cosine
+    # accuracy, visible at BERTScore's discrimination scale
+    cos_sim = jnp.einsum("bpd, brd -> bpr", p, t, precision=jax.lax.Precision.HIGHEST)
+    # invalid pairs get -inf so the max ignores them
+    pair_mask = preds_mask[:, :, None] * target_mask[:, None, :]
+    cos_sim = jnp.where(pair_mask > 0, cos_sim, -jnp.inf)
+
+    p_weights = preds_idf * preds_mask
+    t_weights = target_idf * target_mask
+    # a sentence with no matchable tokens on the OTHER side contributes 0, not
+    # the -inf that an all-masked max would produce
+    has_target = jnp.any(target_mask > 0, axis=1)[:, None]
+    has_pred = jnp.any(preds_mask > 0, axis=1)[:, None]
+    best_for_pred = jnp.where((preds_mask > 0) & has_target, jnp.max(cos_sim, axis=2), 0.0)
+    best_for_target = jnp.where((target_mask > 0) & has_pred, jnp.max(cos_sim, axis=1), 0.0)
+    precision = jnp.sum(best_for_pred * p_weights, axis=1) / jnp.maximum(jnp.sum(p_weights, axis=1), 1e-12)
+    recall = jnp.sum(best_for_target * t_weights, axis=1) / jnp.maximum(jnp.sum(t_weights, axis=1), 1e-12)
+    f1 = 2 * precision * recall / (precision + recall)
+    f1 = jnp.where(jnp.isnan(f1), 0.0, f1)
+    return {"precision": precision, "recall": recall, "f1": f1}
+
+
+def _default_hf_model(model_name_or_path: Optional[str], max_length: int):
+    """Gated HF-Flax default encoder + tokenizer."""
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`bert_score` metric with default models requires `transformers` package be installed."
+            " Either install with `pip install transformers>=4.0` or `pip install metrics_tpu[text]`."
+        )
+    from transformers import AutoTokenizer, FlaxAutoModel
+
+    name = model_name_or_path or "roberta-large"
+    try:
+        tokenizer = AutoTokenizer.from_pretrained(name)
+        model = FlaxAutoModel.from_pretrained(name)
+    except Exception as err:
+        raise ModuleNotFoundError(
+            f"Could not load pretrained model/tokenizer {name!r} (no local cache and no network"
+            " egress on TPU pods?). Pass `user_model` + `user_tokenizer` callables instead —"
+            " see the own-model contract in the docstring."
+        ) from err
+
+    def forward(input_ids: np.ndarray, attention_mask: np.ndarray) -> Array:
+        out = model(input_ids=jnp.asarray(input_ids), attention_mask=jnp.asarray(attention_mask))
+        return out.last_hidden_state
+
+    return forward, tokenizer
+
+
+def bert_score(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Callable] = None,
+    user_tokenizer: Optional[Any] = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+    max_length: int = 512,
+    batch_size: int = 64,
+    num_threads: int = 4,
+    return_hash: bool = False,
+    device: Optional[Any] = None,
+) -> Dict[str, Union[List[float], str]]:
+    """BERTScore precision/recall/F1 between candidate and reference sentences.
+
+    Args:
+        preds / target: candidate and reference sentences.
+        model: user encoder ``(input_ids, attention_mask) -> [N, L, d]``
+            (a jitted Flax forward); with ``None`` the gated HF default loads
+            ``model_name_or_path``.
+        user_tokenizer: tokenizer — HF-style, or the own-model contract
+            ``tokenizer(text, max_length) -> {input_ids, attention_mask}``.
+        idf: weight tokens by inverse document frequency over the references.
+        max_length: padded sequence length.
+        rescale_with_baseline / baseline_*: accepted for API parity; baseline
+            CSVs require network access and are not supported here.
+
+    Returns:
+        dict with per-sentence ``precision``/``recall``/``f1`` lists.
+    """
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError("Number of predicted and reference sentences must be the same!")
+    if rescale_with_baseline:
+        raise ValueError(
+            "`rescale_with_baseline` requires downloading baseline CSVs, which needs network access"
+            " not available here."
+        )
+    forward = model or user_forward_fn
+    tokenizer = user_tokenizer
+    if forward is None:
+        if tokenizer is not None:
+            raise ValueError("a user `model` must be provided together with `user_tokenizer`")
+        forward, tokenizer = _default_hf_model(model_name_or_path, max_length)
+    elif tokenizer is None:
+        raise ValueError("`user_tokenizer` must be provided together with a user `model`")
+
+    preds_tok = _simple_tokenizer_call(tokenizer, list(preds), max_length)
+    target_tok = _simple_tokenizer_call(tokenizer, list(target), max_length)
+
+    tokens_idf = _get_tokens_idf(target_tok["input_ids"], target_tok["attention_mask"]) if idf else None
+
+    # special tokens do not participate in matching (reference ``bert.py:312-315``)
+    preds_mask = _process_attention_mask_for_special_tokens(preds_tok["attention_mask"])
+    target_mask = _process_attention_mask_for_special_tokens(target_tok["attention_mask"])
+    preds_idf_scale = _idf_scale(preds_tok["input_ids"], tokens_idf)
+    target_idf_scale = _idf_scale(target_tok["input_ids"], tokens_idf)
+
+    # sentence pairs are independent, so encode + match in batch_size chunks —
+    # the corpus-level forward and [N, L, L] similarity never materialize at
+    # once (the reference achieves the same with its DataLoader loop)
+    n = len(preds)
+    chunks: List[Dict[str, Array]] = []
+    for start in range(0, n, batch_size):
+        sl = slice(start, start + batch_size)
+        preds_emb = jnp.asarray(forward(preds_tok["input_ids"][sl], preds_tok["attention_mask"][sl]))
+        target_emb = jnp.asarray(forward(target_tok["input_ids"][sl], target_tok["attention_mask"][sl]))
+        chunks.append(
+            _get_precision_recall_f1(
+                preds_emb,
+                target_emb,
+                jnp.asarray(preds_mask[sl], preds_emb.dtype),
+                jnp.asarray(target_mask[sl], target_emb.dtype),
+                jnp.asarray(preds_idf_scale[sl], preds_emb.dtype),
+                jnp.asarray(target_idf_scale[sl], target_emb.dtype),
+            )
+        )
+    out = {k: np.concatenate([np.asarray(c[k]) for c in chunks]) for k in chunks[0]} if chunks else {
+        "precision": np.zeros(0), "recall": np.zeros(0), "f1": np.zeros(0)
+    }
+    result: Dict[str, Union[List[float], str]] = {k: np.asarray(v).tolist() for k, v in out.items()}
+    if return_hash:
+        result["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
+    return result

@@ -1,4 +1,5 @@
 """Text-domain module metrics (parity: reference ``torchmetrics/text/``)."""
+from metrics_tpu.text.bert import BERTScore  # noqa: F401
 from metrics_tpu.text.bleu import BLEUScore  # noqa: F401
 from metrics_tpu.text.cer import CharErrorRate  # noqa: F401
 from metrics_tpu.text.chrf import CHRFScore  # noqa: F401
@@ -13,6 +14,7 @@ from metrics_tpu.text.wil import WordInfoLost  # noqa: F401
 from metrics_tpu.text.wip import WordInfoPreserved  # noqa: F401
 
 __all__ = [
+    "BERTScore",
     "BLEUScore",
     "CHRFScore",
     "CharErrorRate",

@@ -1,0 +1,124 @@
+"""BERTScore module metric (parity: reference ``torchmetrics/text/bert.py:40``).
+
+States are the TOKENIZED sentences (cat buffers of ``input_ids`` /
+``attention_mask``, reference ``text/bert.py:199-202``) — storing token arrays
+rather than strings is what makes distributed sync possible. The encoder
+forward happens once, at ``compute`` time, over the whole accumulated corpus.
+"""
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.text.bert import _default_hf_model, _simple_tokenizer_call, bert_score
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BERTScore(Metric):
+    """Streaming BERTScore.
+
+    Args:
+        model: user encoder ``(input_ids, attention_mask) -> [N, L, d]``; with
+            ``None`` the gated HF default loads ``model_name_or_path``.
+        user_tokenizer: tokenizer (HF-style or the own-model contract).
+        idf: idf-weight tokens over the accumulated references.
+        max_length: padded sequence length (fixed padding keeps the cat
+            states rectangular for sync).
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Callable] = None,
+        user_tokenizer: Optional[Any] = None,
+        user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
+        max_length: int = 512,
+        batch_size: int = 64,
+        return_hash: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)  # host-side tokenization
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.num_layers = num_layers
+        self.all_layers = all_layers
+        self._forward = model or user_forward_fn
+        self.idf = idf
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.return_hash = return_hash
+        self.lang = lang
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_path = baseline_path
+        self.baseline_url = baseline_url
+
+        if user_tokenizer is not None:
+            self.tokenizer = user_tokenizer
+            if self._forward is None:
+                raise ValueError("a user `model` must be provided together with `user_tokenizer`")
+        elif self._forward is not None:
+            raise ValueError("`user_tokenizer` must be provided together with a user `model`")
+        else:
+            self._forward, self.tokenizer = _default_hf_model(model_name_or_path, max_length)
+
+        self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+
+    def update(self, preds: List[str], target: List[str]) -> None:
+        """Tokenize and buffer (reference ``text/bert.py:205-228``)."""
+        if len(preds) != len(target):
+            raise ValueError("Number of predicted and reference sentences must be the same!")
+        preds_tok = _simple_tokenizer_call(self.tokenizer, list(preds), self.max_length)
+        target_tok = _simple_tokenizer_call(self.tokenizer, list(target), self.max_length)
+        self.preds_input_ids.append(jnp.asarray(preds_tok["input_ids"]))
+        self.preds_attention_mask.append(jnp.asarray(preds_tok["attention_mask"]))
+        self.target_input_ids.append(jnp.asarray(target_tok["input_ids"]))
+        self.target_attention_mask.append(jnp.asarray(target_tok["attention_mask"]))
+
+    def compute(self) -> Dict[str, Any]:
+        """One encoder pass + matching over the accumulated corpus."""
+        preds_ids = np.concatenate([np.asarray(x) for x in self.preds_input_ids])
+        preds_mask = np.concatenate([np.asarray(x) for x in self.preds_attention_mask])
+        target_ids = np.concatenate([np.asarray(x) for x in self.target_input_ids])
+        target_mask = np.concatenate([np.asarray(x) for x in self.target_attention_mask])
+
+        class _PreTokenized:
+            """Replay buffered token arrays through the functional tokenizer slot."""
+
+            calls = [  # (input_ids, attention_mask) served in call order
+                {"input_ids": preds_ids, "attention_mask": preds_mask},
+                {"input_ids": target_ids, "attention_mask": target_mask},
+            ]
+
+            def __call__(self, text: List[str], max_length: int) -> Dict[str, np.ndarray]:
+                return self.calls.pop(0)
+
+        n = len(preds_ids)
+        return bert_score(
+            preds=[""] * n,
+            target=[""] * n,
+            model=self._forward,
+            user_tokenizer=_PreTokenized(),
+            idf=self.idf,
+            max_length=self.max_length,
+            batch_size=self.batch_size,
+            return_hash=self.return_hash,
+            model_name_or_path=self.model_name_or_path,
+            num_layers=self.num_layers,
+        )

@@ -263,8 +263,14 @@ class TestMeanAveragePrecision:
             for name, width in MeanAveragePrecision._STATE_WIDTHS.items():
                 local = getattr(m, name)
                 cols = width if width else 1
-                flat = np.concatenate([np.asarray(x).reshape(-1, cols) for x in local], axis=0) if local else np.zeros((0, cols))
-                payload.append(jnp.asarray(flat))
+                dtype = np.int64 if "labels" in name else np.float64
+                flat = (
+                    np.concatenate([np.asarray(x, dtype).reshape(-1, cols) for x in local], axis=0)
+                    if local
+                    else np.zeros((0, cols), dtype)
+                )
+                # same byte wire format _sync_dist ships (f64 survives intact)
+                payload.append(jnp.asarray(np.ascontiguousarray(flat).view(np.uint8).reshape(flat.shape[0], cols * 8)))
                 payload.append(jnp.asarray([int(x.shape[0]) for x in local], dtype=jnp.int32))
             rank_payloads.append(payload)
 

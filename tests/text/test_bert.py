@@ -1,0 +1,181 @@
+"""BERTScore tests.
+
+Parity: reference ``tests/text/test_bertscore.py`` (which validates against the
+``bert_score`` wheel + downloaded weights — absent here). The own-model
+contract (reference ``tm_examples/bert_score-own_model.py``) is first-class:
+a deterministic toy tokenizer + embedding table, validated against an
+independent numpy implementation of idf-weighted greedy cosine matching.
+"""
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import BERTScore
+from metrics_tpu.functional.text.bert import bert_score
+
+MAX_LEN = 8
+VOCAB = {"[PAD]": 0, "[CLS]": 1, "[SEP]": 2}
+for w in "the cat sat on a mat dog ran fast hello world good morning night".split():
+    VOCAB[w] = len(VOCAB)
+DIM = 16
+
+
+def toy_tokenizer(text: List[str], max_length: int) -> Dict[str, np.ndarray]:
+    """Own-tokenizer contract: ``tokenizer(text, max_length) -> dict``."""
+    ids = np.zeros((len(text), max_length), dtype=np.int64)
+    mask = np.zeros((len(text), max_length), dtype=np.int64)
+    for i, sentence in enumerate(text):
+        tokens = [1] + [VOCAB.get(w, 3) for w in sentence.lower().split()][: max_length - 2] + [2]
+        ids[i, : len(tokens)] = tokens
+        mask[i, : len(tokens)] = 1
+    return {"input_ids": ids, "attention_mask": mask}
+
+
+_EMB_TABLE = np.random.default_rng(0).normal(size=(len(VOCAB) + 1, DIM))
+
+
+def toy_model(input_ids, attention_mask):
+    """Deterministic 'contextual' embedding: table lookup + positional mix."""
+    ids = np.asarray(input_ids)
+    emb = _EMB_TABLE[ids]
+    pos = np.sin(np.arange(ids.shape[1]))[None, :, None] * 0.1
+    return jnp.asarray((emb + pos) * np.asarray(attention_mask)[..., None])
+
+
+def _np_bertscore(preds, target, idf=False):
+    """Independent numpy oracle of idf-weighted greedy matching."""
+    p_tok, t_tok = toy_tokenizer(preds, MAX_LEN), toy_tokenizer(target, MAX_LEN)
+    p_emb = np.asarray(toy_model(p_tok["input_ids"], p_tok["attention_mask"]))
+    t_emb = np.asarray(toy_model(t_tok["input_ids"], t_tok["attention_mask"]))
+
+    def special_mask(mask):
+        m = mask.copy()
+        for i in range(len(m)):
+            attended = np.where(m[i])[0]
+            m[i, attended[0]] = 0  # CLS
+            m[i, attended[-1]] = 0  # SEP
+        return m
+
+    p_mask, t_mask = special_mask(p_tok["attention_mask"]), special_mask(t_tok["attention_mask"])
+    if idf:
+        n = len(target)
+        from collections import Counter
+
+        df = Counter()
+        for ids, mask in zip(t_tok["input_ids"], t_tok["attention_mask"]):
+            df.update(set(ids[mask.astype(bool)].tolist()))
+        idf_map = {t: np.log((n + 1) / (c + 1)) for t, c in df.items()}
+        default = np.log(n + 1)
+
+        def w(ids):
+            return np.vectorize(lambda t: idf_map.get(int(t), default))(ids)
+
+    else:
+
+        def w(ids):
+            return np.ones_like(ids, dtype=float)
+
+    P, R, F = [], [], []
+    for i in range(len(preds)):
+        pi = p_emb[i][p_mask[i].astype(bool)]
+        ti = t_emb[i][t_mask[i].astype(bool)]
+        pi = pi / np.linalg.norm(pi, axis=-1, keepdims=True)
+        ti = ti / np.linalg.norm(ti, axis=-1, keepdims=True)
+        sim = pi @ ti.T
+        wp = w(p_tok["input_ids"][i][p_mask[i].astype(bool)])
+        wt = w(t_tok["input_ids"][i][t_mask[i].astype(bool)])
+        prec = float((sim.max(1) * wp).sum() / wp.sum())
+        rec = float((sim.max(0) * wt).sum() / wt.sum())
+        P.append(prec)
+        R.append(rec)
+        F.append(2 * prec * rec / (prec + rec) if prec + rec else 0.0)
+    return {"precision": P, "recall": R, "f1": F}
+
+
+PREDS = ["the cat sat on a mat", "hello world", "good morning"]
+TARGETS = ["a cat sat on the mat", "hello good world", "good night"]
+
+
+class TestBertScoreFunctional:
+    @pytest.mark.parametrize("idf", [False, True])
+    def test_vs_numpy_oracle(self, idf):
+        res = bert_score(PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer, idf=idf, max_length=MAX_LEN)
+        oracle = _np_bertscore(PREDS, TARGETS, idf=idf)
+        for k in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(res[k], oracle[k], atol=1e-5, err_msg=k)
+
+    def test_identical_sentences_score_one(self):
+        res = bert_score(PREDS, PREDS, model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN)
+        np.testing.assert_allclose(res["f1"], np.ones(len(PREDS)), atol=1e-5)
+        np.testing.assert_allclose(res["precision"], np.ones(len(PREDS)), atol=1e-5)
+
+    def test_return_hash(self):
+        res = bert_score(
+            PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN, return_hash=True
+        )
+        assert "hash" in res
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            bert_score(["a", "b"], ["a"], model=toy_model, user_tokenizer=toy_tokenizer)
+        with pytest.raises(ValueError):
+            bert_score(PREDS, TARGETS, model=toy_model)  # tokenizer missing
+        with pytest.raises(ValueError):
+            bert_score(PREDS, TARGETS, user_tokenizer=toy_tokenizer)  # model missing
+        with pytest.raises(ValueError):
+            bert_score(PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer, rescale_with_baseline=True)
+
+    def test_empty_sentence_finite(self):
+        """Empty references/candidates must give finite scores, not -inf."""
+        res = bert_score(["hello world", ""], ["", "hello world"],
+                         model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN)
+        for k in ("precision", "recall", "f1"):
+            assert np.all(np.isfinite(res[k])), (k, res[k])
+
+    def test_batch_size_chunking_exact(self):
+        """Chunked encoding must give identical results to one big batch."""
+        res1 = bert_score(PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer,
+                          max_length=MAX_LEN, batch_size=1)
+        res64 = bert_score(PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer,
+                           max_length=MAX_LEN, batch_size=64)
+        for k in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(res1[k], res64[k], atol=1e-6)
+
+
+class TestBertScoreModule:
+    def test_streaming_matches_functional(self):
+        metric = BERTScore(model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN)
+        metric.update(PREDS[:2], TARGETS[:2])
+        metric.update(PREDS[2:], TARGETS[2:])
+        res = metric.compute()
+        direct = bert_score(PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN)
+        for k in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(res[k], direct[k], atol=1e-6, err_msg=k)
+
+    def test_idf_over_accumulated_corpus(self):
+        """idf statistics must span ALL accumulated references, not per-batch."""
+        metric = BERTScore(model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN, idf=True)
+        for i in range(len(PREDS)):
+            metric.update(PREDS[i : i + 1], TARGETS[i : i + 1])
+        res = metric.compute()
+        oracle = _np_bertscore(PREDS, TARGETS, idf=True)
+        np.testing.assert_allclose(res["f1"], oracle["f1"], atol=1e-5)
+
+    def test_reset(self):
+        metric = BERTScore(model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN)
+        metric.update(PREDS, TARGETS)
+        metric.reset()
+        assert metric.preds_input_ids == []
+
+    def test_mismatched_lengths(self):
+        metric = BERTScore(model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN)
+        with pytest.raises(ValueError):
+            metric.update(["a"], ["a", "b"])
+
+    def test_model_without_tokenizer_raises(self):
+        """A user model must never be silently replaced by the HF default."""
+        with pytest.raises(ValueError):
+            BERTScore(model=toy_model)
