@@ -1,0 +1,69 @@
+"""Example: BERTScore with your OWN tokenizer and Flax encoder.
+
+Analog of reference ``tm_examples/bert_score-own_model.py`` — the own-model
+contract lets BERTScore run without any pretrained-weight download:
+
+* tokenizer: ``tokenizer(text, max_length) -> {"input_ids", "attention_mask"}``
+* model: ``model(input_ids, attention_mask) -> [N, L, d]`` embeddings
+  (here a jitted Flax self-attention encoder with random weights).
+
+Run: ``python examples/bert_score-own_model.py``
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # repo-root run
+
+from typing import Dict, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import BERTScore
+
+MAX_LEN = 16
+VOCAB_SIZE = 1000
+
+
+def tokenizer(text: List[str], max_length: int) -> Dict[str, np.ndarray]:
+    ids = np.zeros((len(text), max_length), dtype=np.int64)
+    mask = np.zeros_like(ids)
+    for i, sentence in enumerate(text):
+        tokens = [1] + [hash(w) % (VOCAB_SIZE - 100) + 100 for w in sentence.lower().split()]
+        tokens = tokens[: max_length - 1] + [2]
+        ids[i, : len(tokens)] = tokens
+        mask[i, : len(tokens)] = 1
+    return {"input_ids": ids, "attention_mask": mask}
+
+
+class Encoder(nn.Module):
+    dim: int = 64
+
+    @nn.compact
+    def __call__(self, ids: jax.Array, mask: jax.Array) -> jax.Array:
+        x = nn.Embed(VOCAB_SIZE, self.dim)(ids)
+        x = x + nn.Embed(MAX_LEN, self.dim)(jnp.arange(ids.shape[1])[None, :])
+        attn = nn.SelfAttention(num_heads=4)(x, mask=mask[:, None, None, :].astype(bool))
+        return nn.LayerNorm()(x + attn)
+
+
+def main() -> None:
+    encoder = Encoder()
+    params = encoder.init(
+        jax.random.PRNGKey(0), jnp.ones((1, MAX_LEN), jnp.int32), jnp.ones((1, MAX_LEN), jnp.int32)
+    )
+    forward = jax.jit(lambda ids, mask: encoder.apply(params, jnp.asarray(ids), jnp.asarray(mask)))
+
+    metric = BERTScore(model=forward, user_tokenizer=tokenizer, max_length=MAX_LEN, idf=True)
+    metric.update(
+        ["the quick brown fox jumps", "hello world"],
+        ["the fast brown fox leaps", "hello there world"],
+    )
+    for name, values in metric.compute().items():
+        print(f"{name:>10}: {np.asarray(values).round(4)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Example: COCO mAP over streamed detection results.
+
+Analog of reference ``tm_examples/detection_map.py`` — shows the
+list-of-dicts input contract and the 12 COCO scalars.
+
+Run: ``python examples/detection_map.py``
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # repo-root run
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import MeanAveragePrecision
+
+
+def main() -> None:
+    metric = MeanAveragePrecision(class_metrics=True)
+    rng = np.random.default_rng(0)
+
+    for _step in range(4):  # e.g. one eval-loader pass
+        preds, targets = [], []
+        for _img in range(8):
+            n = int(rng.integers(1, 6))
+            xy = rng.uniform(0, 300, size=(n, 2))
+            wh = rng.uniform(20, 120, size=(n, 2))
+            gt_boxes = np.concatenate([xy, xy + wh], axis=1)
+            det_boxes = gt_boxes + rng.normal(0, 5, size=gt_boxes.shape)
+            det_boxes[:, 2:] = np.maximum(det_boxes[:, 2:], det_boxes[:, :2] + 1)
+            labels = rng.integers(0, 3, size=n)
+            preds.append(
+                dict(
+                    boxes=jnp.asarray(det_boxes),
+                    scores=jnp.asarray(rng.uniform(0.2, 1.0, size=n)),
+                    labels=jnp.asarray(labels),
+                )
+            )
+            targets.append(dict(boxes=jnp.asarray(gt_boxes), labels=jnp.asarray(labels)))
+        metric.update(preds, targets)
+
+    results = metric.compute()
+    for name, value in results.items():
+        print(f"{name:>22}: {np.asarray(value).round(4)}")
+
+
+if __name__ == "__main__":
+    main()

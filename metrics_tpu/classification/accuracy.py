@@ -30,6 +30,8 @@ class Accuracy(StatScores):
     is_differentiable = False
     higher_is_better = True
 
+    _dynamic_state_attrs = ('mode',)  # learned during update; included in checkpoints
+
     def __init__(
         self,
         threshold: float = 0.5,

@@ -22,6 +22,8 @@ class AUROC(Metric):
     is_differentiable = False
     higher_is_better = True
 
+    _dynamic_state_attrs = ('mode',)  # learned during update; included in checkpoints
+
     def __init__(
         self,
         num_classes: Optional[int] = None,

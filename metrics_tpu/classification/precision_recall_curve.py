@@ -25,6 +25,8 @@ class PrecisionRecallCurve(Metric):
     is_differentiable = False
     higher_is_better = None
 
+    _dynamic_state_attrs = ('num_classes', 'pos_label')  # learned during update; included in checkpoints
+
     def __init__(
         self,
         num_classes: Optional[int] = None,

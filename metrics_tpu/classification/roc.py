@@ -21,6 +21,8 @@ class ROC(Metric):
     is_differentiable = False
     higher_is_better = None
 
+    _dynamic_state_attrs = ('num_classes', 'pos_label')  # learned during update; included in checkpoints
+
     def __init__(
         self,
         num_classes: Optional[int] = None,

@@ -1,0 +1,131 @@
+"""Checkpoint/resume helpers.
+
+The reference persists metric state through the ``nn.Module`` state-dict
+protocol (``metric.py:513-551``; tested ``tests/bases/test_metric.py:212-251``).
+The TPU-native equivalent (SURVEY §5): metric state is a pytree — serialize it
+with orbax, the standard JAX checkpointing library, so metric states ride the
+same checkpoint as model/optimizer state.
+
+Two layers:
+
+* ``save_metric_state`` / ``load_metric_state`` — orbax round-trip of one
+  metric's (or ``MetricCollection``'s) full state snapshot, including list
+  buffers and the update counter.
+* ``metric_state_pytree`` / ``restore_metric_state_pytree`` — extract/restore
+  a plain pytree so callers can embed metric state in their OWN orbax/msgpack
+  checkpoint alongside train state.
+"""
+import enum
+import json
+import os
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils import enums as _enums
+from metrics_tpu.utils.imports import _ORBAX_AVAILABLE
+
+__all__ = [
+    "load_metric_state",
+    "metric_state_pytree",
+    "restore_metric_state_pytree",
+    "save_metric_state",
+]
+
+
+def metric_state_pytree(metric: Metric) -> Dict[str, Any]:
+    """Serializable snapshot: every registered state (numpy leaves; list
+    buffers become sub-dicts keyed by index) plus the update counter."""
+    out: Dict[str, Any] = {"_update_count": metric._update_count}
+    for name in metric._defaults:
+        value = getattr(metric, name)
+        if isinstance(value, list):
+            out[name] = {str(i): np.asarray(v) for i, v in enumerate(value)}
+            out[f"_{name}_is_list"] = True
+        else:
+            out[name] = np.asarray(value)
+    # attributes learned during update (e.g. AUROC.mode, curve num_classes):
+    # declared per class via `_dynamic_state_attrs`, shipped as JSON (never
+    # pickle — a checkpoint must not be able to execute code on load)
+    dyn_attrs = getattr(metric, "_dynamic_state_attrs", ())
+    if dyn_attrs:
+        dyn = {a: _encode_dynamic(getattr(metric, a)) for a in dyn_attrs}
+        out["_dynamic"] = np.frombuffer(json.dumps(dyn).encode("utf-8"), dtype=np.uint8)
+    return out
+
+
+def _encode_dynamic(value: Any) -> Any:
+    """JSON-safe encoding for dynamic attrs (str/int/None/enums)."""
+    if isinstance(value, enum.Enum):
+        return {"$enum": type(value).__name__, "value": value.value}
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    raise TypeError(f"Dynamic state attr of type {type(value)} is not checkpointable")
+
+
+def _decode_dynamic(value: Any) -> Any:
+    if isinstance(value, dict) and "$enum" in value:
+        return getattr(_enums, value["$enum"])(value["value"])
+    return value
+
+
+def restore_metric_state_pytree(metric: Metric, tree: Dict[str, Any]) -> Metric:
+    """Inverse of :func:`metric_state_pytree` (in place)."""
+    metric._update_count = int(tree["_update_count"])
+    for name in metric._defaults:
+        value = tree[name]
+        if tree.get(f"_{name}_is_list", False) or isinstance(value, dict):
+            items = sorted(value.items(), key=lambda kv: int(kv[0]))
+            setattr(metric, name, [jnp.asarray(v) for _, v in items])
+        else:
+            setattr(metric, name, jnp.asarray(value))
+    if "_dynamic" in tree:
+        dyn = json.loads(bytes(np.asarray(tree["_dynamic"], np.uint8)).decode("utf-8"))
+        for attr, value in dyn.items():
+            setattr(metric, attr, _decode_dynamic(value))
+    metric._computed = None
+    metric._is_synced = False
+    metric._cache = None
+    return metric
+
+
+def _collection_tree(obj: Any) -> Dict[str, Any]:
+    from metrics_tpu.collections import MetricCollection
+
+    if isinstance(obj, MetricCollection):
+        return {name: metric_state_pytree(m) for name, m in obj.items()}
+    return metric_state_pytree(obj)
+
+
+def _restore_collection_tree(obj: Any, tree: Dict[str, Any]) -> Any:
+    from metrics_tpu.collections import MetricCollection
+
+    if isinstance(obj, MetricCollection):
+        for name, m in obj.items():
+            restore_metric_state_pytree(m, tree[name])
+        return obj
+    return restore_metric_state_pytree(obj, tree)
+
+
+def save_metric_state(path: str, metric: Any) -> None:
+    """Write the metric's (or collection's) state to an orbax checkpoint dir."""
+    if not _ORBAX_AVAILABLE:
+        raise ModuleNotFoundError("`save_metric_state` requires the `orbax-checkpoint` package")
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as checkpointer:
+        # force: periodic checkpointing re-saves to the same path every epoch
+        checkpointer.save(os.path.abspath(path), _collection_tree(metric), force=True)
+
+
+def load_metric_state(path: str, metric: Any) -> Any:
+    """Restore states saved by :func:`save_metric_state` into ``metric``."""
+    if not _ORBAX_AVAILABLE:
+        raise ModuleNotFoundError("`load_metric_state` requires the `orbax-checkpoint` package")
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as checkpointer:
+        tree = checkpointer.restore(os.path.abspath(path))
+    return _restore_collection_tree(metric, tree)

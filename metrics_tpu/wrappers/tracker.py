@@ -6,24 +6,39 @@ Parity target: reference ``torchmetrics/wrappers/tracker.py:23``
 (no module system to subclass); each ``increment()`` appends a fresh clone of
 the base metric and subsequent update/compute calls route to it.
 """
-from typing import Any, List, Tuple, Union
+from typing import Any, Dict, List, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.collections import MetricCollection
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
 
 
 class MetricTracker:
-    """Keep one metric instance per tracked step; route the standard
-    lifecycle methods to the newest one."""
+    """Keep one metric (or collection) instance per tracked step; route the
+    standard lifecycle methods to the newest one. With a ``MetricCollection``
+    base, ``compute_all``/``best_metric`` return per-member dicts."""
 
-    def __init__(self, metric: Metric, maximize: bool = True) -> None:
-        if not isinstance(metric, Metric):
+    def __init__(
+        self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True
+    ) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
             raise TypeError(f"metric arg need to be an instance of a metrics_tpu metric but got {metric}")
         self._base_metric = metric
+        if isinstance(maximize, list):
+            if not isinstance(metric, MetricCollection):
+                raise ValueError("A list of `maximize` values requires a MetricCollection base")
+            keys = list(metric.keys())
+            if len(maximize) != len(keys):
+                raise ValueError(
+                    f"`maximize` list length {len(maximize)} must match the collection size {len(keys)}"
+                )
+            self._maximize_per_key = dict(zip(keys, maximize))
+        else:
+            self._maximize_per_key = None
         self.maximize = maximize
         self._steps: List[Metric] = []
         self._increment_called = False
@@ -62,11 +77,14 @@ class MetricTracker:
         self._check_for_increment("compute")
         return self._steps[-1].compute()
 
-    def compute_all(self) -> Array:
+    def compute_all(self) -> Union[Array, Dict[str, Array]]:
         """Stacked metric values for every tracked step (reference
-        ``tracker.py:86-89``)."""
+        ``tracker.py:86-89``); a dict of stacks for collections."""
         self._check_for_increment("compute_all")
-        return jnp.stack([jnp.asarray(m.compute()) for m in self._steps], axis=0)
+        vals = [m.compute() for m in self._steps]
+        if isinstance(self._base_metric, MetricCollection):
+            return {k: jnp.stack([jnp.asarray(v[k]) for v in vals], axis=0) for k in vals[0]}
+        return jnp.stack([jnp.asarray(v) for v in vals], axis=0)
 
     def reset(self) -> None:
         """Reset the current step's metric."""
@@ -77,10 +95,21 @@ class MetricTracker:
         for m in self._steps:
             m.reset()
 
-    def best_metric(self, return_step: bool = False) -> Union[float, Tuple[int, float]]:
+    def best_metric(self, return_step: bool = False) -> Any:
         """Best value across steps, optionally with its step index
-        (reference ``tracker.py:99-112``)."""
+        (reference ``tracker.py:99-112``); per-member dicts for collections."""
         vals = self.compute_all()
+        if isinstance(vals, dict):
+            def _key_max(k: str) -> bool:
+                if self._maximize_per_key is not None:
+                    return self._maximize_per_key[k]
+                return bool(self.maximize)
+
+            idx = {k: int(jnp.argmax(v) if _key_max(k) else jnp.argmin(v)) for k, v in vals.items()}
+            best = {k: float(v[idx[k]]) for k, v in vals.items()}
+            if return_step:
+                return idx, best
+            return best
         idx = int(jnp.argmax(vals) if self.maximize else jnp.argmin(vals))
         best = float(vals[idx])
         if return_step:

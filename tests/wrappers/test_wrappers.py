@@ -213,6 +213,34 @@ class TestTracker:
         vals = np.asarray(tracker.compute_all())
         assert tracker.best_metric() == pytest.approx(vals.min())
 
+    def test_collection_mixed_directions(self):
+        """Per-member maximize: acc is maximized while mse is minimized."""
+        from metrics_tpu import MetricCollection
+
+        tracker = MetricTracker(
+            MetricCollection({"acc": Accuracy(), "mse": MeanSquaredError()}), maximize=[True, False]
+        )
+        rng = np.random.default_rng(20)
+        accs, mses = [], []
+        for _ in range(3):
+            tracker.increment()
+            p, t = rng.integers(0, 2, 64), rng.integers(0, 2, 64)
+            tracker.update(jnp.asarray(p), jnp.asarray(t))
+            vals = tracker.compute()
+            accs.append(float(vals["acc"]))
+            mses.append(float(vals["mse"]))
+        best = tracker.best_metric()
+        assert best["acc"] == pytest.approx(max(accs))
+        assert best["mse"] == pytest.approx(min(mses))
+
+    def test_maximize_list_validation(self):
+        from metrics_tpu import MetricCollection
+
+        with pytest.raises(ValueError):
+            MetricTracker(MeanSquaredError(), maximize=[True])
+        with pytest.raises(ValueError):
+            MetricTracker(MetricCollection({"a": Accuracy()}), maximize=[True, False])
+
     def test_errors_before_increment(self):
         tracker = MetricTracker(MeanSquaredError())
         with pytest.raises(ValueError):
