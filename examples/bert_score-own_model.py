@@ -14,6 +14,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # repo-root run
 
+import zlib
 from typing import Dict, List
 
 import flax.linen as nn
@@ -31,7 +32,8 @@ def tokenizer(text: List[str], max_length: int) -> Dict[str, np.ndarray]:
     ids = np.zeros((len(text), max_length), dtype=np.int64)
     mask = np.zeros_like(ids)
     for i, sentence in enumerate(text):
-        tokens = [1] + [hash(w) % (VOCAB_SIZE - 100) + 100 for w in sentence.lower().split()]
+        # stable hash: Python's builtin hash() is salted per process
+        tokens = [1] + [zlib.crc32(w.encode()) % (VOCAB_SIZE - 100) + 100 for w in sentence.lower().split()]
         tokens = tokens[: max_length - 1] + [2]
         ids[i, : len(tokens)] = tokens
         mask[i, : len(tokens)] = 1

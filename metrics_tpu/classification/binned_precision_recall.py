@@ -18,6 +18,7 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute_with_precision_recall,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.binned_counts import binned_stat_counts
 from metrics_tpu.utils.data import METRIC_EPS, to_onehot
 
 Array = jax.Array
@@ -93,13 +94,12 @@ class BinnedPrecisionRecallCurve(Metric):
             )
             preds = jnp.moveaxis(preds, 1, -1).reshape(-1, self.num_classes)
 
-        target = target == 1
-        # one broadcast compare over all thresholds: [N, C, T]
-        predictions = preds[:, :, None] >= self.thresholds[None, None, :]
-        target_e = target[:, :, None]
-        self.TPs = self.TPs + jnp.sum(target_e & predictions, axis=0)
-        self.FPs = self.FPs + jnp.sum(~target_e & predictions, axis=0)
-        self.FNs = self.FNs + jnp.sum(target_e & ~predictions, axis=0)
+        # single source of truth for the threshold counters (XLA path by
+        # default; a Pallas variant lives behind use_pallas=True there)
+        tp, fp, fn, _ = binned_stat_counts(preds, (target == 1).astype(jnp.int32), self.thresholds)
+        self.TPs = self.TPs + tp.astype(self.TPs.dtype)
+        self.FPs = self.FPs + fp.astype(self.FPs.dtype)
+        self.FNs = self.FNs + fn.astype(self.FNs.dtype)
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
         """Reference ``binned_precision_recall.py:177-190``."""

@@ -44,6 +44,7 @@ def _deprecated_alias(name: str, target: Type) -> Type:
             f"`{name}` was renamed to `{target.__name__}` in the reference API and will be"
             " removed; use the new name.",
             DeprecationWarning,
+            stacklevel=2,
         )
         target.__init__(self, *args, **kwargs)
 

@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for hot metric ops (XLA fallbacks included)."""
+from metrics_tpu.ops.binned_counts import binned_stat_counts  # noqa: F401
+
+__all__ = ["binned_stat_counts"]

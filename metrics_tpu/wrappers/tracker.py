@@ -28,7 +28,11 @@ class MetricTracker:
         if not isinstance(metric, (Metric, MetricCollection)):
             raise TypeError(f"metric arg need to be an instance of a metrics_tpu metric but got {metric}")
         self._base_metric = metric
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError(f"Argument `maximize` should be a bool or list of bools, got {maximize!r}")
         if isinstance(maximize, list):
+            if not all(isinstance(m, bool) for m in maximize):
+                raise ValueError("Every element of a `maximize` list must be a bool")
             if not isinstance(metric, MetricCollection):
                 raise ValueError("A list of `maximize` values requires a MetricCollection base")
             keys = list(metric.keys())
@@ -83,7 +87,16 @@ class MetricTracker:
         self._check_for_increment("compute_all")
         vals = [m.compute() for m in self._steps]
         if isinstance(self._base_metric, MetricCollection):
-            return {k: jnp.stack([jnp.asarray(v[k]) for v in vals], axis=0) for k in vals[0]}
+            out: Dict[str, Any] = {}
+            for k in vals[0]:
+                per_step = [v[k] for v in vals]
+                try:
+                    out[k] = jnp.stack([jnp.asarray(v) for v in per_step], axis=0)
+                except (TypeError, ValueError):
+                    # non-scalar member (dict/ragged result, e.g. mAP, ROC):
+                    # keep the raw per-step values rather than failing the rest
+                    out[k] = per_step
+            return out
         return jnp.stack([jnp.asarray(v) for v in vals], axis=0)
 
     def reset(self) -> None:
@@ -105,8 +118,9 @@ class MetricTracker:
                     return self._maximize_per_key[k]
                 return bool(self.maximize)
 
-            idx = {k: int(jnp.argmax(v) if _key_max(k) else jnp.argmin(v)) for k, v in vals.items()}
-            best = {k: float(v[idx[k]]) for k, v in vals.items()}
+            scalar_keys = [k for k, v in vals.items() if not isinstance(v, list) and jnp.ndim(v) == 1]
+            idx = {k: int(jnp.argmax(vals[k]) if _key_max(k) else jnp.argmin(vals[k])) for k in scalar_keys}
+            best = {k: float(vals[k][idx[k]]) for k in scalar_keys}
             if return_step:
                 return idx, best
             return best
