@@ -38,13 +38,11 @@ from metrics_tpu.utils.exceptions import MetricsUserError
 Array = jax.Array
 
 
-def _no_default_extractor(feature: int) -> None:
-    raise ModuleNotFoundError(
-        "The default InceptionV3 feature extractor requires pretrained weights that are not"
-        " bundled with metrics_tpu (no download at metric-construction time on TPU pods)."
-        f" Pass `feature=<callable imgs -> [N, {feature}] array>` instead — e.g. a jitted"
-        " Flax module — together with `feature_dim` for O(d^2) streaming statistics."
-    )
+def _resolve_feature_extractor(feature, weights_path):
+    """int/str feature -> default InceptionV3 extractor (local weights)."""
+    from metrics_tpu.image.networks.inception import resolve_inception_extractor
+
+    return resolve_inception_extractor(feature, weights_path)
 
 
 def _validate_features(features: Array) -> Array:
@@ -84,10 +82,16 @@ class FrechetInceptionDistance(Metric):
     """FID between the feature distributions of real and generated images.
 
     Args:
-        feature: an int (reference API — selects the gated default InceptionV3
-            layer of that dimensionality) or a callable ``imgs -> [N, d]``.
+        feature: an int (reference API — selects the default InceptionV3 tap of
+            that dimensionality, built from ``weights_path``) or a callable
+            ``imgs -> [N, d]``.
         feature_dim: dimensionality ``d`` of the extractor output; enables the
-            O(d^2) streaming-statistics states.
+            O(d^2) streaming-statistics states. Auto-set when ``feature`` is an
+            int.
+        weights_path: local ``.npz`` InceptionV3 weights (see
+            ``metrics_tpu.image.networks.convert_torch_inception_checkpoint``);
+            falls back to ``$METRICS_TPU_INCEPTION_WEIGHTS``. Only used when
+            ``feature`` is an int.
     """
 
     is_differentiable = False
@@ -97,13 +101,16 @@ class FrechetInceptionDistance(Metric):
         self,
         feature: Union[int, Callable] = 2048,
         feature_dim: Optional[int] = None,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)  # extractor call is user code
         kwargs.setdefault("compute_on_step", False)  # reference ``fid.py:215``
         super().__init__(**kwargs)
         if isinstance(feature, int):
-            _no_default_extractor(feature)
+            feature = _resolve_feature_extractor(feature, weights_path)
+            if feature_dim is None:
+                feature_dim = feature.feature_dim  # O(d^2) streaming stats
         if not callable(feature):
             raise TypeError("Got unknown input to argument `feature`")
         self.inception = feature

@@ -5,15 +5,16 @@ Parity target: reference ``torchmetrics/image/inception.py:28``
 The classifier producing logits is pluggable (see ``metrics_tpu/image/fid.py``
 for the gating rationale).
 """
-from typing import Any, Callable, Tuple, Union
+from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.image.fid import _no_default_extractor, _validate_features
+from metrics_tpu.image.fid import _resolve_feature_extractor, _validate_features
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.exceptions import MetricsUserError
 
 Array = jax.Array
 
@@ -22,10 +23,12 @@ class InceptionScore(Metric):
     """IS = exp(E_x KL(p(y|x) || p(y))), mean/std over ``splits`` chunks.
 
     Args:
-        feature: callable ``imgs -> [N, num_classes]`` logits (the Inception
-            default is availability-gated).
+        feature: callable ``imgs -> [N, num_classes]`` logits, or the
+            reference's ``"logits_unbiased"``/int selecting the default
+            InceptionV3 tap (built from ``weights_path``, see FID).
         splits: number of chunks to compute the score over.
         seed: host RNG seed for the pre-split shuffle.
+        weights_path: local InceptionV3 ``.npz`` weights for the default.
     """
 
     is_differentiable = False
@@ -36,13 +39,19 @@ class InceptionScore(Metric):
         feature: Union[int, str, Callable] = "logits_unbiased",
         splits: int = 10,
         seed: int = 42,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)  # extractor call is user code
         kwargs.setdefault("compute_on_step", False)  # reference ``inception.py:117``
         super().__init__(**kwargs)
+        if isinstance(feature, str) and feature not in ("logits", "logits_unbiased"):
+            raise ValueError(
+                f"Input to argument `feature` must be one of ('logits', 'logits_unbiased'), an int"
+                f" feature dimensionality, or a callable, but got {feature!r}"
+            )
         if isinstance(feature, (int, str)):
-            _no_default_extractor(1008 if isinstance(feature, str) else feature)
+            feature = _resolve_feature_extractor(feature, weights_path)
         if not callable(feature):
             raise TypeError("Got unknown input to argument `feature`")
         self.inception = feature
@@ -62,8 +71,15 @@ class InceptionScore(Metric):
         prob = jax.nn.softmax(features, axis=1)
         log_prob = jax.nn.log_softmax(features, axis=1)
 
-        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
-        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+        # torch.chunk semantics (reference ``inception.py:170``): ceil-sized
+        # chunks, never empty — jnp.array_split would emit empty chunks when
+        # n < splits and poison the means with NaN
+        n = prob.shape[0]
+        if n == 0:
+            raise MetricsUserError("InceptionScore requires at least one sample before `compute`")
+        chunk = -(-n // self.splits)
+        prob_chunks = [prob[i : i + chunk] for i in range(0, n, chunk)]
+        log_prob_chunks = [log_prob[i : i + chunk] for i in range(0, n, chunk)]
 
         mean_prob = [jnp.mean(p, axis=0, keepdims=True) for p in prob_chunks]
         kl_ = [p * (lp - jnp.log(m)) for p, lp, m in zip(prob_chunks, log_prob_chunks, mean_prob)]

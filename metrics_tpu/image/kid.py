@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.image.fid import _no_default_extractor, _validate_features
+from metrics_tpu.image.fid import _resolve_feature_extractor, _validate_features
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.exceptions import MetricsUserError
@@ -54,11 +54,12 @@ class KernelInceptionDistance(Metric):
     """KID: mean/std of polynomial MMD over random feature subsets.
 
     Args:
-        feature: callable ``imgs -> [N, d]`` (the int Inception default is
-            availability-gated, see FID).
+        feature: callable ``imgs -> [N, d]``, or an int selecting the default
+            InceptionV3 tap (built from ``weights_path``, see FID).
         subsets / subset_size: resampling configuration.
         degree / gamma / coef: polynomial kernel parameters.
         seed: host RNG seed for subset sampling.
+        weights_path: local InceptionV3 ``.npz`` weights for the int default.
     """
 
     is_differentiable = False
@@ -73,13 +74,14 @@ class KernelInceptionDistance(Metric):
         gamma: Optional[float] = None,
         coef: float = 1.0,
         seed: int = 42,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)  # extractor call is user code
         kwargs.setdefault("compute_on_step", False)  # reference ``kid.py:219``
         super().__init__(**kwargs)
         if isinstance(feature, int):
-            _no_default_extractor(feature)
+            feature = _resolve_feature_extractor(feature, weights_path)
         if not callable(feature):
             raise TypeError("Got unknown input to argument `feature`")
         self.inception = feature

@@ -1,0 +1,28 @@
+"""Feature-extractor networks for embedding-based image metrics.
+
+The reference delegates these forwards to external wheels (``torch-fidelity``
+for InceptionV3, ``lpips`` for the perceptual nets — reference
+``torchmetrics/image/fid.py:31-58``, ``image/lpip.py:27-37``). Here they are
+first-class TPU programs: pure-JAX inference networks with local-weights
+loaders (no network egress on TPU pods) plus converters for the canonical
+torch checkpoints.
+"""
+from metrics_tpu.image.networks.inception import (
+    InceptionV3Features,
+    convert_torch_inception_checkpoint,
+    inception_param_spec,
+    inception_v3,
+    load_inception_weights,
+    random_inception_params,
+    save_inception_weights,
+)
+
+__all__ = [
+    "InceptionV3Features",
+    "convert_torch_inception_checkpoint",
+    "inception_param_spec",
+    "inception_v3",
+    "load_inception_weights",
+    "random_inception_params",
+    "save_inception_weights",
+]

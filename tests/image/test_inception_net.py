@@ -1,0 +1,358 @@
+"""InceptionV3 (FID variant) network tests.
+
+The reference gets this network from the ``torch-fidelity`` wheel
+(``torchmetrics/image/fid.py:31-58``); its pretrained weights cannot be
+downloaded here, so the oracle is a torch mirror of the canonical
+architecture (torch is available CPU-only): random weights are shared between
+the JAX network and the torch mirror and every feature tap must agree. This
+validates conv/BN/pool semantics and block wiring — the things FID goldens
+depend on — independently of the weight values.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+from metrics_tpu import FrechetInceptionDistance, InceptionScore, KernelInceptionDistance
+from metrics_tpu.image.networks.inception import (
+    InceptionV3Features,
+    convert_torch_inception_checkpoint,
+    inception_param_spec,
+    inception_v3,
+    load_inception_weights,
+    preprocess_inception_input,
+    random_inception_params,
+    resize_bilinear_tf1,
+    save_inception_weights,
+)
+
+
+# ---------------------------------------------------------------- torch mirror
+class TBasic(nn.Module):
+    def __init__(self, cin, cout, **kw):
+        super().__init__()
+        self.conv = nn.Conv2d(cin, cout, bias=False, **kw)
+        self.bn = nn.BatchNorm2d(cout, eps=0.001)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _avgp(x):
+    return F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+
+
+class TBlockA(nn.Module):
+    def __init__(self, cin, pool):
+        super().__init__()
+        self.branch1x1 = TBasic(cin, 64, kernel_size=1)
+        self.branch5x5_1 = TBasic(cin, 48, kernel_size=1)
+        self.branch5x5_2 = TBasic(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = TBasic(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = TBasic(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = TBasic(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = TBasic(cin, pool, kernel_size=1)
+
+    def forward(self, x):
+        b5 = self.branch5x5_2(self.branch5x5_1(x))
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        return torch.cat([self.branch1x1(x), b5, bd, self.branch_pool(_avgp(x))], 1)
+
+
+class TBlockB(nn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3 = TBasic(cin, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = TBasic(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = TBasic(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = TBasic(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        return torch.cat([self.branch3x3(x), bd, F.max_pool2d(x, 3, 2)], 1)
+
+
+class TBlockC(nn.Module):
+    def __init__(self, c7):
+        super().__init__()
+        self.branch1x1 = TBasic(768, 192, kernel_size=1)
+        self.branch7x7_1 = TBasic(768, c7, kernel_size=1)
+        self.branch7x7_2 = TBasic(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = TBasic(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = TBasic(768, c7, kernel_size=1)
+        self.branch7x7dbl_2 = TBasic(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = TBasic(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = TBasic(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = TBasic(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = TBasic(768, 192, kernel_size=1)
+
+    def forward(self, x):
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_1(x)
+        bd = self.branch7x7dbl_3(self.branch7x7dbl_2(bd))
+        bd = self.branch7x7dbl_5(self.branch7x7dbl_4(bd))
+        return torch.cat([self.branch1x1(x), b7, bd, self.branch_pool(_avgp(x))], 1)
+
+
+class TBlockD(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.branch3x3_1 = TBasic(768, 192, kernel_size=1)
+        self.branch3x3_2 = TBasic(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = TBasic(768, 192, kernel_size=1)
+        self.branch7x7x3_2 = TBasic(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = TBasic(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = TBasic(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3_2(self.branch3x3_1(x))
+        b7 = self.branch7x7x3_4(self.branch7x7x3_3(self.branch7x7x3_2(self.branch7x7x3_1(x))))
+        return torch.cat([b3, b7, F.max_pool2d(x, 3, 2)], 1)
+
+
+class TBlockE(nn.Module):
+    def __init__(self, cin, pool):
+        super().__init__()
+        self.pool = pool
+        self.branch1x1 = TBasic(cin, 320, kernel_size=1)
+        self.branch3x3_1 = TBasic(cin, 384, kernel_size=1)
+        self.branch3x3_2a = TBasic(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = TBasic(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = TBasic(cin, 448, kernel_size=1)
+        self.branch3x3dbl_2 = TBasic(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = TBasic(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = TBasic(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch_pool = TBasic(cin, 192, kernel_size=1)
+
+    def forward(self, x):
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+        pooled = F.max_pool2d(x, 3, 1, 1) if self.pool == "max" else _avgp(x)
+        return torch.cat([self.branch1x1(x), b3, bd, self.branch_pool(pooled)], 1)
+
+
+class TInceptionFID(nn.Module):
+    """Torch mirror of the FID InceptionV3 (same module paths as the canonical
+    ``pt_inception-2015-12-05`` checkpoint)."""
+
+    def __init__(self):
+        super().__init__()
+        self.Conv2d_1a_3x3 = TBasic(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = TBasic(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = TBasic(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = TBasic(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = TBasic(80, 192, kernel_size=3)
+        self.Mixed_5b = TBlockA(192, 32)
+        self.Mixed_5c = TBlockA(256, 64)
+        self.Mixed_5d = TBlockA(288, 64)
+        self.Mixed_6a = TBlockB(288)
+        self.Mixed_6b = TBlockC(128)
+        self.Mixed_6c = TBlockC(160)
+        self.Mixed_6d = TBlockC(160)
+        self.Mixed_6e = TBlockC(192)
+        self.Mixed_7a = TBlockD()
+        self.Mixed_7b = TBlockE(1280, "avg")
+        self.Mixed_7c = TBlockE(2048, "max")
+        self.fc = nn.Linear(2048, 1008)
+
+    def forward(self, x):
+        out = {}
+        x = self.Conv2d_2b_3x3(self.Conv2d_2a_3x3(self.Conv2d_1a_3x3(x)))
+        x = F.max_pool2d(x, 3, 2)
+        out["64"] = x.mean((2, 3))
+        x = self.Conv2d_4a_3x3(self.Conv2d_3b_1x1(x))
+        x = F.max_pool2d(x, 3, 2)
+        out["192"] = x.mean((2, 3))
+        x = self.Mixed_5d(self.Mixed_5c(self.Mixed_5b(x)))
+        x = self.Mixed_6e(self.Mixed_6d(self.Mixed_6c(self.Mixed_6b(self.Mixed_6a(x)))))
+        out["768"] = x.mean((2, 3))
+        x = self.Mixed_7c(self.Mixed_7b(self.Mixed_7a(x)))
+        feats = x.mean((2, 3))
+        out["2048"] = feats
+        out["logits_unbiased"] = feats @ self.fc.weight.T
+        out["logits"] = out["logits_unbiased"] + self.fc.bias
+        return out
+
+
+def _torch_state_dict(params):
+    """JAX param pytree -> canonical torch state_dict (the converter's inverse)."""
+    sd = {}
+    for mod, g in params.items():
+        if mod == "fc":
+            sd["fc.weight"] = torch.tensor(np.asarray(g["kernel"]).T.copy())
+            sd["fc.bias"] = torch.tensor(np.asarray(g["bias"]))
+        else:
+            sd[f"{mod}.conv.weight"] = torch.tensor(np.ascontiguousarray(np.asarray(g["kernel"]).transpose(3, 2, 0, 1)))
+            sd[f"{mod}.bn.weight"] = torch.tensor(np.asarray(g["scale"]))
+            sd[f"{mod}.bn.bias"] = torch.tensor(np.asarray(g["bias"]))
+            sd[f"{mod}.bn.running_mean"] = torch.tensor(np.asarray(g["mean"]))
+            sd[f"{mod}.bn.running_var"] = torch.tensor(np.asarray(g["var"]))
+            sd[f"{mod}.bn.num_batches_tracked"] = torch.tensor(0)
+    return sd
+
+
+@pytest.fixture(scope="module")
+def params():
+    return random_inception_params(seed=7)
+
+
+@pytest.fixture(scope="module")
+def torch_net(params):
+    net = TInceptionFID()
+    net.load_state_dict(_torch_state_dict(params))
+    net.eval()
+    return net
+
+
+# ---------------------------------------------------------------- tests
+def test_param_spec_matches_torch_mirror(params):
+    """Every canonical checkpoint entry maps onto the spec and vice versa."""
+    sd = _torch_state_dict(params)
+    spec_keys = set()
+    for mod, group in inception_param_spec().items():
+        for name in group:
+            spec_keys.add(f"{mod}.{name}")
+    torch_keys = {k for k in sd if not k.endswith("num_batches_tracked")}
+    assert len(torch_keys) == len(spec_keys)
+
+
+def test_forward_matches_torch_mirror(params, torch_net):
+    """All feature taps agree with the canonical torch architecture."""
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(2, 3, 299, 299), dtype=np.uint8)
+    x_t = (torch.tensor(imgs).float() - 128.0) / 128.0
+    with torch.no_grad():
+        ref = torch_net(x_t)
+
+    x_j = preprocess_inception_input(jnp.asarray(imgs), resize_input=False)
+    got = inception_v3(params, x_j, ("64", "192", "768", "2048", "logits_unbiased", "logits"))
+
+    for key in ref:
+        r = ref[key].numpy()
+        g = np.asarray(got[key], np.float32)
+        assert g.shape == r.shape, key
+        np.testing.assert_allclose(g, r, rtol=1e-3, atol=2e-3, err_msg=key)
+
+
+def test_tf1_resize_matches_naive_oracle():
+    """Matmul-form TF1 bilinear == per-pixel src = dst * scale interpolation."""
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 255, size=(1, 5, 7, 3)).astype(np.float32)
+    out = np.asarray(resize_bilinear_tf1(jnp.asarray(x), (11, 4)))
+
+    def naive(img, hw):
+        h_in, w_in = img.shape[0], img.shape[1]
+        res = np.zeros((hw[0], hw[1], img.shape[2]), np.float64)
+        for i in range(hw[0]):
+            for j in range(hw[1]):
+                sy, sx = i * h_in / hw[0], j * w_in / hw[1]
+                y0, x0 = int(np.floor(sy)), int(np.floor(sx))
+                y1, x1 = min(y0 + 1, h_in - 1), min(x0 + 1, w_in - 1)
+                fy, fx = sy - y0, sx - x0
+                top = img[y0, x0] * (1 - fx) + img[y0, x1] * fx
+                bot = img[y1, x0] * (1 - fx) + img[y1, x1] * fx
+                res[i, j] = top * (1 - fy) + bot * fy
+        return res
+
+    np.testing.assert_allclose(out[0], naive(x[0], (11, 4)), rtol=1e-5, atol=1e-4)
+
+
+def test_checkpoint_conversion_roundtrip(params, tmp_path):
+    """torch .pth -> converter -> .npz -> load == original params."""
+    pth = tmp_path / "pt_inception.pth"
+    npz = tmp_path / "inception.npz"
+    torch.save(_torch_state_dict(params), str(pth))
+    convert_torch_inception_checkpoint(str(pth), str(npz))
+    loaded = load_inception_weights(str(npz))
+    for mod, group in params.items():
+        for name, val in group.items():
+            np.testing.assert_allclose(np.asarray(loaded[mod][name]), np.asarray(val), rtol=1e-6, err_msg=f"{mod}.{name}")
+
+
+def test_extractor_taps_and_resize(params):
+    """Extractor resizes arbitrary input sizes and returns the right dims."""
+    rng = np.random.default_rng(2)
+    imgs = rng.integers(0, 256, size=(3, 3, 32, 32), dtype=np.uint8)
+    for feature, dim in ((64, 64), (192, 192)):
+        ext = InceptionV3Features(params, feature)
+        feats = np.asarray(ext(jnp.asarray(imgs)))
+        assert feats.shape == (3, dim)
+        assert np.all(np.isfinite(feats))
+
+
+@pytest.fixture(scope="module")
+def weights_file(params, tmp_path_factory):
+    path = tmp_path_factory.mktemp("weights") / "inception.npz"
+    save_inception_weights(params, str(path))
+    return str(path)
+
+
+def test_fid_default_extractor_end_to_end(weights_file):
+    """FID(feature=64, weights_path=...) == numpy Frechet formula on the
+    features the extractor itself produces."""
+    import scipy.linalg
+
+    rng = np.random.default_rng(3)
+    real = jnp.asarray(rng.integers(0, 256, size=(8, 3, 24, 24), dtype=np.uint8))
+    fake = jnp.asarray(rng.integers(0, 256, size=(8, 3, 24, 24), dtype=np.uint8))
+
+    fid = FrechetInceptionDistance(feature=64, weights_path=weights_file)
+    fid.update(real, real=True)
+    fid.update(fake, real=False)
+    got = float(fid.compute())
+
+    ext = fid.inception
+    fr = np.asarray(ext(real), np.float64)
+    ff = np.asarray(ext(fake), np.float64)
+    mu1, mu2 = fr.mean(0), ff.mean(0)
+    c1 = np.cov(fr, rowvar=False)
+    c2 = np.cov(ff, rowvar=False)
+    covmean = scipy.linalg.sqrtm(c1 @ c2)
+    expected = float(np.sum((mu1 - mu2) ** 2) + np.trace(c1 + c2 - 2 * covmean.real))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_is_and_kid_default_extractors(weights_file):
+    rng = np.random.default_rng(4)
+    imgs = jnp.asarray(rng.integers(0, 256, size=(10, 3, 24, 24), dtype=np.uint8))
+
+    inception = InceptionScore(feature="logits_unbiased", weights_path=weights_file)
+    inception.update(imgs)
+    mean, std = inception.compute()
+    assert np.isfinite(float(mean)) and np.isfinite(float(std))
+
+    kid = KernelInceptionDistance(feature=64, weights_path=weights_file, subsets=3, subset_size=4)
+    kid.update(imgs, real=True)
+    kid.update(imgs[::-1], real=False)
+    k_mean, k_std = kid.compute()
+    assert np.isfinite(float(k_mean)) and np.isfinite(float(k_std))
+
+
+def test_is_fewer_samples_than_splits(weights_file):
+    """torch.chunk semantics: n < splits must give finite (not NaN) scores."""
+    rng = np.random.default_rng(5)
+    imgs = jnp.asarray(rng.integers(0, 256, size=(6, 3, 24, 24), dtype=np.uint8))
+    inception = InceptionScore(feature="logits_unbiased", weights_path=weights_file, splits=10)
+    inception.update(imgs)
+    mean, std = inception.compute()
+    assert np.isfinite(float(mean)) and np.isfinite(float(std))
+
+
+def test_missing_weights_raises(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_INCEPTION_WEIGHTS", raising=False)
+    with pytest.raises(ModuleNotFoundError, match="local pretrained weights"):
+        FrechetInceptionDistance(feature=2048)
+    with pytest.raises(ValueError, match="must be one of"):
+        FrechetInceptionDistance(feature=77, weights_path="/nonexistent.npz")
+
+
+def test_is_empty_raises(weights_file):
+    inception = InceptionScore(feature="logits_unbiased", weights_path=weights_file)
+    inception.update(jnp.zeros((0, 3, 24, 24), jnp.uint8))
+    with pytest.raises(Exception, match="at least one sample"):
+        inception.compute()
