@@ -7,7 +7,7 @@ is pluggable: any callable ``(img1, img2) -> [N]`` distances — e.g. a jitted
 Flax VGG with user-supplied weights — because the pretrained ``lpips`` nets
 cannot be downloaded on an egress-less TPU pod.
 """
-from typing import Any, Callable, Union
+from typing import Any, Callable, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -22,10 +22,13 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
 
     Args:
         net: callable ``(img1, img2) -> [N]`` perceptual distances, or one of
-            the reference net names (``"alex"/"vgg"/"squeeze"`` — gated, since
-            their pretrained weights require network access).
+            the reference net names (``"alex"``/``"vgg"`` built natively from
+            ``weights_path``; ``"squeeze"`` not yet implemented).
         normalize: if True inputs are expected in ``[0, 1]`` and are shifted
             to the net's ``[-1, 1]`` convention before the forward.
+        weights_path: local ``.npz`` weights for the named nets (see
+            ``metrics_tpu.image.networks.convert_torch_lpips_checkpoint``);
+            falls back to ``$METRICS_TPU_LPIPS_WEIGHTS``.
     """
 
     is_differentiable = True
@@ -35,6 +38,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         self,
         net: Union[str, Callable] = "alex",
         normalize: bool = False,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)  # net call is user code
@@ -42,11 +46,14 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         if isinstance(net, str):
             if net not in ("alex", "vgg", "squeeze"):
                 raise ValueError(f"Argument `net` must be one of 'alex', 'vgg', 'squeeze' or a callable, got {net}")
-            raise ModuleNotFoundError(
-                f"The pretrained '{net}' LPIPS network requires downloaded weights that are not"
-                " bundled with metrics_tpu. Pass `net=<callable (img1, img2) -> [N] distances>`"
-                " instead — e.g. a jitted Flax perceptual net with user-supplied weights."
-            )
+            if net == "squeeze":
+                raise ModuleNotFoundError(
+                    "The 'squeeze' LPIPS backbone is not implemented natively yet; use 'alex',"
+                    " 'vgg', or pass `net=<callable (img1, img2) -> [N] distances>`."
+                )
+            from metrics_tpu.image.networks.lpips import resolve_lpips_network
+
+            net = resolve_lpips_network(net, weights_path)
         if not callable(net):
             raise TypeError("Got unknown input to argument `net`")
         self.net = net

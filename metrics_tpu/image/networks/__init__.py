@@ -16,13 +16,29 @@ from metrics_tpu.image.networks.inception import (
     random_inception_params,
     save_inception_weights,
 )
+from metrics_tpu.image.networks.lpips import (
+    LPIPSNetwork,
+    convert_torch_lpips_checkpoint,
+    load_lpips_weights,
+    lpips_distance,
+    lpips_param_spec,
+    random_lpips_params,
+    save_lpips_weights,
+)
 
 __all__ = [
     "InceptionV3Features",
+    "LPIPSNetwork",
     "convert_torch_inception_checkpoint",
+    "convert_torch_lpips_checkpoint",
     "inception_param_spec",
     "inception_v3",
     "load_inception_weights",
+    "load_lpips_weights",
+    "lpips_distance",
+    "lpips_param_spec",
     "random_inception_params",
+    "random_lpips_params",
     "save_inception_weights",
+    "save_lpips_weights",
 ]

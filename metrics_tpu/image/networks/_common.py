@@ -1,0 +1,42 @@
+"""Shared helpers for the inference networks (layout, pooling, weights IO)."""
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def to_nhwc(x: Array) -> Array:
+    """Accept NCHW (the reference's layout) or NHWC 3-channel batches.
+
+    An ambiguous ``[N, 3, H, 3]`` batch is treated as NCHW, matching the
+    layout every reference caller uses.
+    """
+    if x.ndim != 4:
+        raise ValueError(f"Expected 4D image batch, got shape {x.shape}")
+    if x.shape[1] == 3:
+        return jnp.transpose(x, (0, 2, 3, 1))
+    if x.shape[-1] == 3:
+        return x
+    raise ValueError(f"Could not infer channel axis from shape {x.shape} (need a 3-channel batch)")
+
+
+def max_pool(x: Array, window: int = 3, stride: int = 2, pad: int = 0) -> Array:
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        (1, window, window, 1),
+        (1, stride, stride, 1),
+        [(0, 0), (pad, pad), (pad, pad), (0, 0)],
+    )
+
+
+def npz_path(path: str) -> str:
+    """np.savez appends ``.npz`` to suffix-less paths; normalize so save, load,
+    and env-var values agree on the on-disk name."""
+    path = os.path.expanduser(path)
+    return path if path.endswith(".npz") else path + ".npz"

@@ -39,6 +39,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from metrics_tpu.image.networks._common import max_pool as _max_pool
+from metrics_tpu.image.networks._common import npz_path as _npz_path
+from metrics_tpu.image.networks._common import to_nhwc as _to_nhwc
+
 Array = jax.Array
 Params = Dict[str, Dict[str, Array]]
 
@@ -175,17 +179,6 @@ def _bconv(p: Mapping[str, Array], x: Array, stride: int = 1, pad: Tuple[int, in
     return jax.nn.relu(x * inv + (p["bias"] - p["mean"] * inv))
 
 
-def _max_pool(x: Array, window: int = 3, stride: int = 2, pad: int = 0) -> Array:
-    return lax.reduce_window(
-        x,
-        -jnp.inf,
-        lax.max,
-        (1, window, window, 1),
-        (1, stride, stride, 1),
-        [(0, 0), (pad, pad), (pad, pad), (0, 0)],
-    )
-
-
 def _avg_pool_excl(x: Array, window: int = 3, stride: int = 1, pad: int = 1) -> Array:
     """Average pool whose divisor counts only in-bounds taps.
 
@@ -295,18 +288,6 @@ def resize_bilinear_tf1(x: Array, size: Tuple[int, int]) -> Array:
     mw = _tf1_linear_matrix(x.shape[2], size[1])
     x = jnp.einsum("Oh,nhwc->nOwc", mh, x, precision=lax.Precision.HIGHEST)
     return jnp.einsum("Pw,nhwc->nhPc", mw, x, precision=lax.Precision.HIGHEST)
-
-
-def _to_nhwc(x: Array) -> Array:
-    if x.ndim != 4:
-        raise ValueError(f"Expected 4D image batch, got shape {x.shape}")
-    if x.shape[-1] == 3 and x.shape[1] != 3:
-        return x
-    if x.shape[1] == 3:  # NCHW (the reference's layout)
-        return jnp.transpose(x, (0, 2, 3, 1))
-    if x.shape[-1] == 3:
-        return x
-    raise ValueError(f"Could not infer channel axis from shape {x.shape} (need a 3-channel batch)")
 
 
 def preprocess_inception_input(imgs: Array, resize_input: bool = True) -> Array:
@@ -443,7 +424,7 @@ def _validate_params(params: Params) -> Params:
 def load_inception_weights(path: str, dtype: Any = jnp.float32) -> Params:
     """Load weights from a local ``.npz`` written by ``save_inception_weights``
     or ``convert_torch_inception_checkpoint`` (keys ``<module>.<param>``)."""
-    flat = np.load(os.path.expanduser(path))
+    flat = np.load(_npz_path(path))
     params: Params = {}
     for key in flat.files:
         mod, name = key.rsplit(".", 1)
@@ -453,7 +434,7 @@ def load_inception_weights(path: str, dtype: Any = jnp.float32) -> Params:
 
 def save_inception_weights(params: Params, path: str) -> None:
     flat = {f"{mod}.{name}": np.asarray(v) for mod, group in params.items() for name, v in group.items()}
-    np.savez(os.path.expanduser(path), **flat)
+    np.savez(_npz_path(path), **flat)
 
 
 def convert_torch_inception_checkpoint(src: str, dst: str) -> None:
@@ -487,7 +468,7 @@ def convert_torch_inception_checkpoint(src: str, dst: str) -> None:
         elif key.endswith(".bn.running_var"):
             flat[key[: -len(".bn.running_var")] + ".var"] = v
         # num_batches_tracked and aux-classifier (AuxLogits.*) entries are dropped
-    np.savez(os.path.expanduser(dst), **flat)
+    np.savez(_npz_path(dst), **flat)
 
 
 def resolve_inception_extractor(

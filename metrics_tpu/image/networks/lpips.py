@@ -1,0 +1,257 @@
+"""LPIPS perceptual network (VGG16 / AlexNet backbone + linear heads) in pure JAX.
+
+Parity target: the net the reference wraps from the ``lpips`` wheel
+(reference ``torchmetrics/image/lpip.py:27-37`` — Zhang et al.'s
+``LPIPS(net=...)`` with pretrained torchvision backbones and learned linear
+calibration heads). The pipeline is:
+
+1. scale inputs (already in ``[-1, 1]``) by the fixed ImageNet-ish shift/scale,
+2. run the backbone, tapping the canonical ReLU outputs
+   (VGG16: relu1_2/2_2/3_3/4_3/5_3; AlexNet: the five conv ReLUs),
+3. channel-unit-normalize each tap, take the squared difference between the
+   two images' activations,
+4. collapse channels with a learned non-negative 1x1 conv ("lin" head),
+   average spatially, and sum over taps.
+
+Same TPU-native stance as ``inception.py``: NHWC pure functions over an
+explicit param pytree, jitted end to end, weights from a local ``.npz`` with a
+converter from the canonical torch checkpoints (torchvision backbone state
+dict + lpips lin-head state dict) — no construction-time downloads.
+"""
+import os
+from functools import partial
+from typing import Any, Dict, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from metrics_tpu.image.networks._common import max_pool as _max_pool
+from metrics_tpu.image.networks._common import npz_path as _npz_path
+from metrics_tpu.image.networks._common import to_nhwc as _to_nhwc
+
+Array = jax.Array
+Params = Dict[str, Dict[str, Array]]
+
+# fixed input normalization (lpips ScalingLayer constants)
+_SHIFT = (-0.030, -0.088, -0.188)
+_SCALE = (0.458, 0.448, 0.450)
+
+# (conv index in torchvision features, out channels); taps after each group's ReLU
+_VGG16_CONVS: List[Tuple[int, int, int]] = [  # (torchvision idx, cin, cout)
+    (0, 3, 64), (2, 64, 64),
+    (5, 64, 128), (7, 128, 128),
+    (10, 128, 256), (12, 256, 256), (14, 256, 256),
+    (17, 256, 512), (19, 512, 512), (21, 512, 512),
+    (24, 512, 512), (26, 512, 512), (28, 512, 512),
+]
+# pool goes BEFORE these conv positions (torchvision MaxPool indices 4,9,16,23)
+_VGG16_POOL_BEFORE = {5, 10, 17, 24}
+# taps: ReLU outputs of these conv indices
+_VGG16_TAPS = (2, 7, 14, 21, 28)
+_VGG16_CHANNELS = (64, 128, 256, 512, 512)
+
+_ALEX_CONVS: List[Tuple[int, int, int, int, int, int]] = [  # (idx, cin, cout, k, stride, pad)
+    (0, 3, 64, 11, 4, 2),
+    (3, 64, 192, 5, 1, 2),
+    (6, 192, 384, 3, 1, 1),
+    (8, 384, 256, 3, 1, 1),
+    (10, 256, 256, 3, 1, 1),
+]
+_ALEX_POOL_BEFORE = {3, 6}  # MaxPool(3, 2) before these convs
+_ALEX_TAPS = (0, 3, 6, 8, 10)
+_ALEX_CHANNELS = (64, 192, 384, 256, 256)
+
+
+def lpips_param_spec(net: str = "vgg") -> Dict[str, Dict[str, Tuple[int, ...]]]:
+    """Shape spec keyed by torchvision-style conv path + ``lin0..lin4`` heads."""
+    spec: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+    if net == "vgg":
+        for idx, cin, cout in _VGG16_CONVS:
+            spec[f"features.{idx}"] = {"kernel": (3, 3, cin, cout), "bias": (cout,)}
+        channels = _VGG16_CHANNELS
+    elif net == "alex":
+        for idx, cin, cout, k, _, _ in _ALEX_CONVS:
+            spec[f"features.{idx}"] = {"kernel": (k, k, cin, cout), "bias": (cout,)}
+        channels = _ALEX_CHANNELS
+    else:
+        raise ValueError(f"Argument `net` must be 'vgg' or 'alex', got {net!r}")
+    for i, c in enumerate(channels):
+        spec[f"lin{i}"] = {"kernel": (c,)}  # non-negative 1x1 conv, no bias
+    return spec
+
+
+def random_lpips_params(net: str = "vgg", seed: int = 0, dtype: Any = jnp.float32) -> Params:
+    rng = np.random.default_rng(seed)
+    params: Params = {}
+    for mod, group in lpips_param_spec(net).items():
+        p: Dict[str, Array] = {}
+        for name, shape in group.items():
+            if mod.startswith("lin"):
+                arr = rng.uniform(0.0, 1.0, size=shape)  # heads are non-negative
+            elif name == "kernel":
+                fan_in = int(np.prod(shape[:-1]))
+                arr = rng.normal(0.0, 1.0 / np.sqrt(fan_in), size=shape)
+            else:
+                arr = rng.normal(0.0, 0.1, size=shape)
+            p[name] = jnp.asarray(arr, dtype)
+        params[mod] = p
+    return params
+
+
+def _conv_relu(p: Dict[str, Array], x: Array, stride: int = 1, pad: int = 1) -> Array:
+    x = lax.conv_general_dilated(
+        x, p["kernel"], (stride, stride), [(pad, pad), (pad, pad)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return jax.nn.relu(x + p["bias"])
+
+
+def _backbone_taps(params: Params, x: Array, net: str) -> List[Array]:
+    if net not in ("vgg", "alex"):
+        raise ValueError(f"Argument `net` must be 'vgg' or 'alex', got {net!r}")
+    taps = []
+    if net == "vgg":
+        for idx, _, _ in _VGG16_CONVS:
+            if idx in _VGG16_POOL_BEFORE:
+                x = _max_pool(x, 2, 2)
+            x = _conv_relu(params[f"features.{idx}"], x)
+            if idx in _VGG16_TAPS:
+                taps.append(x)
+    else:
+        for idx, _, _, _, stride, pad in _ALEX_CONVS:
+            if idx in _ALEX_POOL_BEFORE:
+                x = _max_pool(x, 3, 2)
+            x = _conv_relu(params[f"features.{idx}"], x, stride=stride, pad=pad)
+            if idx in _ALEX_TAPS:
+                taps.append(x)
+    return taps
+
+
+def _unit_normalize(x: Array, eps: float = 1e-10) -> Array:
+    norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    return x / (norm + eps)
+
+
+def lpips_distance(params: Params, img1: Array, img2: Array, net: str = "vgg") -> Array:
+    """Perceptual distance for NHWC image batches already in ``[-1, 1]``."""
+    shift = jnp.asarray(_SHIFT, img1.dtype)
+    scale = jnp.asarray(_SCALE, img1.dtype)
+    x1 = (img1 - shift) / scale
+    x2 = (img2 - shift) / scale
+    total = None
+    for i, (f1, f2) in enumerate(zip(_backbone_taps(params, x1, net), _backbone_taps(params, x2, net))):
+        diff = (_unit_normalize(f1) - _unit_normalize(f2)) ** 2
+        w = params[f"lin{i}"]["kernel"]
+        contrib = jnp.mean(jnp.sum(diff * w, axis=-1), axis=(1, 2))  # 1x1 conv + spatial mean
+        total = contrib if total is None else total + contrib
+    return total
+
+
+class LPIPSNetwork:
+    """Jitted ``(img1, img2) -> [N]`` distance callable, the default for
+    ``LearnedPerceptualImagePatchSimilarity``.
+
+    Accepts NCHW (the reference's layout) or NHWC inputs in ``[-1, 1]``.
+    """
+
+    def __init__(self, params: Params, net: str = "vgg"):
+        if net not in ("vgg", "alex"):
+            raise ValueError(f"Argument `net` must be 'vgg' or 'alex', got {net!r}")
+        self.net = net
+        self.params = params
+        self._forward = jax.jit(partial(_lpips_forward, net=net))
+
+    def __call__(self, img1: Array, img2: Array) -> Array:
+        return self._forward(self.params, img1, img2)
+
+
+def _lpips_forward(params: Params, img1: Array, img2: Array, net: str) -> Array:
+    return lpips_distance(params, _to_nhwc(img1).astype(jnp.float32), _to_nhwc(img2).astype(jnp.float32), net)
+
+
+# --------------------------------------------------------------------------
+# weights IO
+# --------------------------------------------------------------------------
+ENV_WEIGHTS_VAR = "METRICS_TPU_LPIPS_WEIGHTS"
+
+
+def _validate_params(params: Params, net: str) -> Params:
+    spec = lpips_param_spec(net)
+    missing = sorted(set(spec) - set(params))
+    if missing:
+        raise ValueError(f"LPIPS '{net}' weights are missing parameter groups: {missing[:5]}")
+    for mod, group in spec.items():
+        for name, shape in group.items():
+            got = tuple(params[mod][name].shape)
+            if got != shape:
+                raise ValueError(f"LPIPS weight {mod}.{name} has shape {got}, expected {shape}")
+    return params
+
+
+def load_lpips_weights(path: str, net: str = "vgg", dtype: Any = jnp.float32) -> Params:
+    flat = np.load(_npz_path(path))
+    params: Params = {}
+    for key in flat.files:
+        mod, name = key.rsplit(".", 1)
+        params.setdefault(mod, {})[name] = jnp.asarray(flat[key], dtype)
+    return _validate_params(params, net)
+
+
+def save_lpips_weights(params: Params, path: str) -> None:
+    flat = {f"{mod}.{name}": np.asarray(v) for mod, group in params.items() for name, v in group.items()}
+    np.savez(_npz_path(path), **flat)
+
+
+def convert_torch_lpips_checkpoint(backbone_src: str, lin_src: str, dst: str, net: str = "vgg") -> None:
+    """Convert the canonical torch checkpoints to the local ``.npz`` format.
+
+    Args:
+        backbone_src: torchvision backbone state dict (``vgg16-397923af.pth`` /
+            ``alexnet-owt-*.pth``) — keys ``features.<i>.weight/bias``.
+        lin_src: lpips-package linear-head state dict (``lpips/weights/v0.1/
+            {vgg,alex}.pth``) — keys ``lin<i>.model.1.weight`` of shape
+            ``[1, C, 1, 1]``.
+        dst: output ``.npz`` path for ``load_lpips_weights``.
+    """
+    import torch  # host-side, one-off conversion
+
+    spec = lpips_param_spec(net)
+    backbone = torch.load(backbone_src, map_location="cpu")
+    if hasattr(backbone, "state_dict"):
+        backbone = backbone.state_dict()
+    flat: Dict[str, np.ndarray] = {}
+    for mod in spec:
+        if not mod.startswith("features."):
+            continue
+        w = backbone[f"{mod}.weight"].detach().numpy()  # OIHW
+        flat[f"{mod}.kernel"] = w.transpose(2, 3, 1, 0)
+        flat[f"{mod}.bias"] = backbone[f"{mod}.bias"].detach().numpy()
+    lin = torch.load(lin_src, map_location="cpu")
+    if hasattr(lin, "state_dict"):
+        lin = lin.state_dict()
+    for i in range(5):
+        for key in (f"lin{i}.model.1.weight", f"lin.{i}.model.1.weight"):
+            if key in lin:
+                flat[f"lin{i}.kernel"] = lin[key].detach().numpy().reshape(-1)
+                break
+        else:
+            raise KeyError(f"Could not find lin{i} head in {lin_src}")
+    np.savez(_npz_path(dst), **flat)
+
+
+def resolve_lpips_network(net: str, weights_path: Union[str, None]) -> LPIPSNetwork:
+    """Build the default perceptual net from a local weights file (env-var
+    fallback ``METRICS_TPU_LPIPS_WEIGHTS``), mirroring the reference's gated
+    construction of the ``lpips`` wheel's net (``image/lpip.py:34-37``)."""
+    path = weights_path or os.environ.get(ENV_WEIGHTS_VAR)
+    if path is None:
+        raise ModuleNotFoundError(
+            f"The pretrained '{net}' LPIPS network needs local weights (TPU pods have no network"
+            " egress to download them). Convert the canonical checkpoints once with"
+            " `metrics_tpu.image.networks.convert_torch_lpips_checkpoint(backbone, lin, dst)` and"
+            f" pass `weights_path=dst` (or set ${ENV_WEIGHTS_VAR}). Alternatively pass"
+            " `net=<callable (img1, img2) -> [N] distances>`."
+        )
+    return LPIPSNetwork(load_lpips_weights(path, net), net)

@@ -1,0 +1,137 @@
+"""LPIPS network tests.
+
+The reference wraps the ``lpips`` wheel (``torchmetrics/image/lpip.py:27-37``);
+neither the wheel nor torchvision's pretrained backbones are available here, so
+the oracle is a torch mirror of the canonical LPIPS pipeline (scaling layer ->
+backbone taps -> unit-normalize -> squared diff -> non-negative 1x1 heads ->
+spatial mean -> sum) sharing random weights with the JAX network.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+from metrics_tpu import LearnedPerceptualImagePatchSimilarity
+from metrics_tpu.image.networks.lpips import (
+    _ALEX_CONVS,
+    _ALEX_POOL_BEFORE,
+    _VGG16_CONVS,
+    _VGG16_POOL_BEFORE,
+    _VGG16_TAPS,
+    _ALEX_TAPS,
+    _SCALE,
+    _SHIFT,
+    LPIPSNetwork,
+    convert_torch_lpips_checkpoint,
+    load_lpips_weights,
+    lpips_param_spec,
+    random_lpips_params,
+    save_lpips_weights,
+)
+
+
+def _torch_backbone_taps(params, x, net):
+    """Torch mirror of the backbone using the shared param pytree."""
+    taps = []
+    pool_before = _VGG16_POOL_BEFORE if net == "vgg" else _ALEX_POOL_BEFORE
+    tap_idx = _VGG16_TAPS if net == "vgg" else _ALEX_TAPS
+    for row in (_VGG16_CONVS if net == "vgg" else _ALEX_CONVS):
+        if net == "vgg":
+            idx = row[0]
+            stride, pad, pool_k, pool_s = 1, 1, 2, 2
+        else:
+            idx, _, _, _, stride, pad = row
+            pool_k, pool_s = 3, 2
+        if idx in pool_before:
+            x = F.max_pool2d(x, pool_k, pool_s)
+        w = torch.tensor(np.ascontiguousarray(np.asarray(params[f"features.{idx}"]["kernel"]).transpose(3, 2, 0, 1)))
+        b = torch.tensor(np.asarray(params[f"features.{idx}"]["bias"]))
+        x = F.relu(F.conv2d(x, w, b, stride=stride, padding=pad))
+        if idx in tap_idx:
+            taps.append(x)
+    return taps
+
+
+def _torch_lpips(params, img1, img2, net):
+    shift = torch.tensor(_SHIFT).view(1, 3, 1, 1)
+    scale = torch.tensor(_SCALE).view(1, 3, 1, 1)
+    x1, x2 = (img1 - shift) / scale, (img2 - shift) / scale
+    total = None
+    for i, (f1, f2) in enumerate(zip(_torch_backbone_taps(params, x1, net), _torch_backbone_taps(params, x2, net))):
+        n1 = f1 / (f1.pow(2).sum(1, keepdim=True).sqrt() + 1e-10)
+        n2 = f2 / (f2.pow(2).sum(1, keepdim=True).sqrt() + 1e-10)
+        diff = (n1 - n2) ** 2
+        w = torch.tensor(np.asarray(params[f"lin{i}"]["kernel"])).view(1, -1, 1, 1)
+        contrib = (diff * w).sum(1).mean((1, 2))
+        total = contrib if total is None else total + contrib
+    return total
+
+
+@pytest.mark.parametrize("net", ["vgg", "alex"])
+def test_lpips_matches_torch_mirror(net):
+    params = random_lpips_params(net, seed=11)
+    rng = np.random.default_rng(0)
+    img1 = rng.uniform(-1, 1, size=(2, 3, 64, 64)).astype(np.float32)
+    img2 = rng.uniform(-1, 1, size=(2, 3, 64, 64)).astype(np.float32)
+
+    with torch.no_grad():
+        ref = _torch_lpips(params, torch.tensor(img1), torch.tensor(img2), net).numpy()
+    got = np.asarray(LPIPSNetwork(params, net)(jnp.asarray(img1), jnp.asarray(img2)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lpips_identical_images_zero():
+    params = random_lpips_params("alex", seed=3)
+    img = jnp.asarray(np.random.default_rng(1).uniform(-1, 1, size=(2, 3, 64, 64)).astype(np.float32))
+    d = np.asarray(LPIPSNetwork(params, "alex")(img, img))
+    np.testing.assert_allclose(d, 0.0, atol=1e-6)
+
+
+def test_lpips_checkpoint_conversion_roundtrip(tmp_path):
+    """torchvision-backbone + lpips-lin state dicts -> converter -> load."""
+    params = random_lpips_params("alex", seed=5)
+    backbone_sd = {}
+    for idx, cin, cout, k, _, _ in _ALEX_CONVS:
+        g = params[f"features.{idx}"]
+        backbone_sd[f"features.{idx}.weight"] = torch.tensor(
+            np.ascontiguousarray(np.asarray(g["kernel"]).transpose(3, 2, 0, 1))
+        )
+        backbone_sd[f"features.{idx}.bias"] = torch.tensor(np.asarray(g["bias"]))
+    lin_sd = {
+        f"lin{i}.model.1.weight": torch.tensor(np.asarray(params[f"lin{i}"]["kernel"]).reshape(1, -1, 1, 1))
+        for i in range(5)
+    }
+    torch.save(backbone_sd, str(tmp_path / "alexnet.pth"))
+    torch.save(lin_sd, str(tmp_path / "lin.pth"))
+    convert_torch_lpips_checkpoint(str(tmp_path / "alexnet.pth"), str(tmp_path / "lin.pth"), str(tmp_path / "l.npz"), net="alex")
+    loaded = load_lpips_weights(str(tmp_path / "l.npz"), "alex")
+    for mod, group in params.items():
+        for name, val in group.items():
+            np.testing.assert_allclose(np.asarray(loaded[mod][name]), np.asarray(val), rtol=1e-6, err_msg=f"{mod}.{name}")
+
+
+def test_lpips_metric_default_net(tmp_path, monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_LPIPS_WEIGHTS", raising=False)
+    params = random_lpips_params("vgg", seed=9)
+    path = tmp_path / "vgg.npz"
+    save_lpips_weights(params, str(path))
+
+    metric = LearnedPerceptualImagePatchSimilarity(net="vgg", weights_path=str(path))
+    rng = np.random.default_rng(2)
+    img1 = jnp.asarray(rng.uniform(-1, 1, size=(4, 3, 32, 32)).astype(np.float32))
+    img2 = jnp.asarray(rng.uniform(-1, 1, size=(4, 3, 32, 32)).astype(np.float32))
+    metric.update(img1, img2)
+    got = float(metric.compute())
+
+    expected = float(np.mean(np.asarray(LPIPSNetwork(params, "vgg")(img1, img2))))
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+    with pytest.raises(ModuleNotFoundError, match="local weights"):
+        LearnedPerceptualImagePatchSimilarity(net="alex")
+    with pytest.raises(ModuleNotFoundError, match="not implemented"):
+        LearnedPerceptualImagePatchSimilarity(net="squeeze")
+    with pytest.raises(ValueError, match="must be one of"):
+        LearnedPerceptualImagePatchSimilarity(net="resnet")
