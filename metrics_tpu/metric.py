@@ -80,8 +80,10 @@ class Metric:
         compute_on_step: return the batch-local metric value from ``forward``.
         dist_sync_on_step: synchronize the batch value across processes inside
             ``forward`` (expensive; reference ``metric.py:85``).
-        process_group: host-level process subset to sync over (reserved; the
-            TPU analog of a subgroup is a mesh-axis subset, see ``axis_name``).
+        process_group: host-level process subset to sync over. Only honored by
+            a custom ``dist_sync_fn``; the default gather spans all processes
+            and raises on a non-None group (the TPU analog of a subgroup is a
+            mesh-axis subset, see ``axis_name``).
         dist_sync_fn: override for the host-level gather (signature
             ``fn(array, group) -> list[array]``), default
             :func:`metrics_tpu.parallel.comm.gather_all_arrays`.

@@ -110,7 +110,20 @@ def gather_all_arrays(x: Array, group: Optional[Any] = None) -> List[Array]:
     Mirror of reference ``gather_all_tensors`` (``utilities/distributed.py:96``)
     including the uneven-shape path: gather per-rank shapes, pad to max,
     gather, trim (``:133-145``).
+
+    ``group`` (the reference's ``process_group`` subgroup communicator,
+    ``metric.py:88``) is **not supported** by the default multihost gather —
+    ``multihost_utils`` always spans every process. Rather than silently
+    syncing over the world, a non-None group raises: pass a custom
+    ``dist_sync_fn`` that understands your subgroup, or use in-trace sync
+    over a mesh-axis subset (``axis_name``), the TPU-native subgroup analog.
     """
+    if group is not None:
+        raise ValueError(
+            "`process_group` subgroups are not supported by the default host-level gather"
+            " (multihost_utils spans all processes). Provide a custom `dist_sync_fn`, or use"
+            " the pure state API inside shard_map with `axis_name` naming a mesh-axis subset."
+        )
     if not distributed_available():
         return [x]
     x = jnp.atleast_1d(jnp.asarray(x))

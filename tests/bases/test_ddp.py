@@ -135,3 +135,16 @@ def test_host_gather_single_process_noop():
     assert len(out) == 1
     np.testing.assert_allclose(np.asarray(out[0]), np.arange(4.0))
     assert not comm.distributed_available()
+
+
+def test_process_group_rejected_by_default_gather():
+    """The default host gather spans all processes; a subgroup must not be
+    silently ignored (reference honors `process_group`, `metric.py:88`)."""
+    with pytest.raises(ValueError, match="process_group"):
+        comm.gather_all_arrays(jnp.arange(3.0), group="subgroup")
+
+    m = DummyMetricSum(process_group="subgroup")
+    m.update(jnp.asarray(1.0))
+    m._distributed_available_fn = lambda: True
+    with pytest.raises(ValueError, match="process_group"):
+        m.compute()

@@ -1,0 +1,146 @@
+"""Worker for the real 2-process distributed test lane.
+
+The JAX analog of the reference's gloo pool (``tests/helpers/testers.py:47-59``,
+``tests/bases/test_ddp.py:104-112``): N OS processes on one machine,
+``jax.distributed.initialize`` over a localhost coordinator, CPU devices with
+Gloo cross-process collectives. Unlike the in-trace shard_map lane, this
+executes the *actual* host-level sync path — ``parallel/comm.gather_all_arrays``
+(even and pad/trim uneven shapes) and ``Metric._sync_dist`` — end to end.
+
+Run: ``python tests/helpers/mp_worker.py <rank> <world> <port> <outdir>``.
+Each rank runs every scenario on its ``rank::world`` shard of the shared
+deterministic inputs and writes ``compute()`` results to ``<outdir>/rank<r>.npz``;
+the parent test compares them against the serial oracle (same scenarios, all
+data, single process). In-worker asserts cover the raw comm layer.
+"""
+import sys
+
+import numpy as np
+
+
+def make_inputs():
+    """Deterministic inputs shared by workers and the parent oracle."""
+    rng = np.random.default_rng(1234)
+    data = {
+        # even counters: 6 batches of multiclass probs
+        "acc_preds": rng.random((6, 32, 5)),
+        "acc_target": rng.integers(0, 5, (6, 32)),
+        # cat buffers with UNEVEN batch counts across ranks: 5 batches
+        "sp_preds": rng.normal(size=(5, 20)),
+        "sp_target": rng.normal(size=(5, 20)),
+        # dist_reduce_fx=None stack path (Chan merge)
+        "pe_preds": rng.normal(size=(6, 24)),
+        "pe_target": rng.normal(size=(6, 24)),
+    }
+    # ragged detection inputs: 4 images, variable box counts; predictions are
+    # jittered copies of the ground truth (plus one spurious box) so mAP is
+    # non-trivial and the ragged sync actually moves scores
+    det = []
+    for i in range(4):
+        n_gt = int(rng.integers(1, 5))
+        gxy1 = rng.uniform(0, 50, (n_gt, 2))
+        gboxes = np.concatenate([gxy1, gxy1 + rng.uniform(10, 40, (n_gt, 2))], axis=1)
+        gt_labels = rng.integers(0, 2, n_gt)
+        boxes = gboxes + rng.uniform(-3, 3, gboxes.shape)
+        spurious = rng.uniform(0, 30, (1, 2))
+        boxes = np.concatenate([boxes, np.concatenate([spurious, spurious + 8.0], axis=1)], axis=0)
+        det.append(
+            dict(
+                boxes=boxes,
+                scores=rng.random(n_gt + 1),
+                labels=np.concatenate([gt_labels, rng.integers(0, 2, 1)]),
+                gt_boxes=gboxes,
+                gt_labels=gt_labels,
+            )
+        )
+    data["det"] = det
+    return data
+
+
+def run_scenarios(rank: int, world: int):
+    """Run all scenarios on this rank's shard; rank=0, world=1 is the serial oracle."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import (
+        Accuracy,
+        MeanAveragePrecision,
+        PearsonCorrCoef,
+        SpearmanCorrCoef,
+    )
+
+    data = make_inputs()
+    out = {}
+
+    acc = Accuracy(num_classes=5)
+    for i in range(rank, len(data["acc_preds"]), world):
+        acc.update(jnp.asarray(data["acc_preds"][i]), jnp.asarray(data["acc_target"][i]))
+    out["accuracy"] = np.asarray(acc.compute())
+
+    sp = SpearmanCorrCoef()
+    for i in range(rank, len(data["sp_preds"]), world):  # 5 batches -> uneven cat buffers
+        sp.update(jnp.asarray(data["sp_preds"][i]), jnp.asarray(data["sp_target"][i]))
+    out["spearman"] = np.asarray(sp.compute())
+
+    pe = PearsonCorrCoef()
+    for i in range(rank, len(data["pe_preds"]), world):
+        pe.update(jnp.asarray(data["pe_preds"][i]), jnp.asarray(data["pe_target"][i]))
+    out["pearson"] = np.asarray(pe.compute())
+
+    det = MeanAveragePrecision()
+    for i in range(rank, len(data["det"]), world):
+        d = data["det"][i]
+        det.update(
+            [dict(boxes=jnp.asarray(d["boxes"]), scores=jnp.asarray(d["scores"]), labels=jnp.asarray(d["labels"]))],
+            [dict(boxes=jnp.asarray(d["gt_boxes"]), labels=jnp.asarray(d["gt_labels"]))],
+        )
+    res = det.compute()
+    res = dict(res) if not isinstance(res, dict) else res
+    for key in sorted(res):
+        val = np.asarray(res[key])
+        if val.ndim == 0:
+            out[f"map_{key}"] = val
+    return out
+
+
+def _comm_layer_asserts(rank: int, world: int):
+    """Direct invariants on gather_all_arrays (even + uneven paths)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.parallel import comm
+
+    assert comm.distributed_available(), "expected multi-process JAX"
+    assert comm.world_size() == world and comm.process_index() == rank
+
+    # even shapes
+    gathered = comm.gather_all_arrays(jnp.arange(4) + 100 * rank)
+    assert len(gathered) == world
+    for r in range(world):
+        np.testing.assert_array_equal(np.asarray(gathered[r]), np.arange(4) + 100 * r)
+
+    # uneven leading dim: rank r contributes 2 + 3r rows (pad-to-max + trim)
+    local = jnp.full((2 + 3 * rank, 2), float(rank))
+    gathered = comm.gather_all_arrays(local)
+    for r in range(world):
+        np.testing.assert_array_equal(np.asarray(gathered[r]), np.full((2 + 3 * r, 2), float(r)))
+
+    # host_reduce cat over the uneven buffers
+    cat = comm.host_reduce(local, "cat")
+    assert cat.shape[0] == sum(2 + 3 * r for r in range(world))
+
+
+def main():
+    rank, world, port, outdir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.distributed.initialize(f"localhost:{port}", num_processes=world, process_id=rank)
+
+    _comm_layer_asserts(rank, world)
+    out = run_scenarios(rank, world)
+    np.savez(f"{outdir}/rank{rank}.npz", **out)
+
+
+if __name__ == "__main__":
+    main()
