@@ -1,0 +1,65 @@
+"""Low-precision (bf16) value tests across domains.
+
+Parity target: reference ``tests/helpers/testers.py:469-525`` (fp16 tests that
+*compare values*, not just smoke-run). bf16 is the TPU-native half type; each
+metric's bf16 result must agree with its own full-precision run within bf16
+tolerances (``MetricTester.precision_atol/rtol``).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+import metrics_tpu.functional as F
+from tests.helpers import seed_all
+from tests.helpers.testers import MetricTester
+
+seed_all(42)
+_rng = np.random.default_rng(42)
+
+_N = 64
+_probs = jnp.asarray(_rng.random((1, _N, 5)))
+_labels = jnp.asarray(_rng.integers(0, 5, (1, _N)))
+_reg_preds = jnp.asarray(_rng.normal(size=(1, _N)))
+# correlated target: keeps Pearson/R2/SNR well away from the degenerate ~0
+# region so tight tolerances are meaningful
+_reg_target = jnp.asarray(0.7 * np.asarray(_reg_preds) + 0.3 * _rng.normal(size=(1, _N)))
+_imgs_a = jnp.asarray(_rng.random((1, 4, 3, 32, 32)))
+_imgs_b = jnp.asarray(_rng.random((1, 4, 3, 32, 32)))
+
+
+CASES = [
+    # (id, preds, target, metric_class, functional, args, tester overrides)
+    ("accuracy", _probs, _labels, M.Accuracy, F.accuracy, {"num_classes": 5}, {}),
+    ("stat_scores", _probs, _labels, M.StatScores, F.stat_scores, {"num_classes": 5, "reduce": "macro"}, {}),
+    ("confusion_matrix", _probs, _labels, M.ConfusionMatrix, F.confusion_matrix, {"num_classes": 5}, {}),
+    ("f1", _probs, _labels, M.F1Score, F.f1_score, {"num_classes": 5, "average": "macro"}, {}),
+    ("mse", _reg_preds, _reg_target, M.MeanSquaredError, F.mean_squared_error, {}, {}),
+    ("mae", _reg_preds, _reg_target, M.MeanAbsoluteError, F.mean_absolute_error, {}, {}),
+    ("r2", _reg_preds, _reg_target, M.R2Score, F.r2_score, {}, {"rtol": 5e-2}),
+    ("pearson", _reg_preds, _reg_target, M.PearsonCorrCoef, F.pearson_corrcoef, {}, {"rtol": 5e-2}),
+    ("cosine", _reg_preds.reshape(1, 8, 8), _reg_target.reshape(1, 8, 8), M.CosineSimilarity, F.cosine_similarity, {}, {}),
+    ("psnr", _imgs_a, _imgs_b, M.PeakSignalNoiseRatio, F.peak_signal_noise_ratio, {"data_range": 1.0}, {}),
+    ("ssim", _imgs_a, _imgs_b, M.StructuralSimilarityIndexMeasure,
+     F.structural_similarity_index_measure, {"data_range": 1.0}, {}),
+    ("snr", _reg_preds, _reg_target, M.SignalNoiseRatio, F.signal_noise_ratio, {}, {"rtol": 5e-2}),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+def test_bf16_matches_full_precision(case):
+    _, preds, target, cls, fn, args, tol = case
+
+    class T(MetricTester):
+        precision_rtol = tol.get("rtol", MetricTester.precision_rtol)
+        precision_atol = tol.get("atol", MetricTester.precision_atol)
+
+    T().run_precision_test(preds, target, cls, fn, metric_args=args)
+
+
+def test_aggregation_bf16():
+    m = M.MeanMetric()
+    vals = jnp.asarray(_rng.random(256), jnp.bfloat16)
+    m.update(vals)
+    got = float(m.compute())
+    np.testing.assert_allclose(got, float(np.asarray(vals, np.float64).mean()), rtol=2e-2)

@@ -1,0 +1,185 @@
+"""x32 correctness lane: core metrics without float64.
+
+The main suite runs under ``jax_enable_x64=True`` (``tests/conftest.py``), but
+real TPU programs run x32/bf16 — float64 pockets (FID's compensated moments,
+Pearson's Chan merge, mAP accumulation) are *designed* for f32 and must be
+*validated* there. Every test here runs construction+update+compute inside
+``jax.enable_x64(False)`` and compares against float64 numpy oracles with
+f32-appropriate tolerances.
+"""
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.stats
+
+import metrics_tpu as M
+
+_rng = np.random.default_rng(7)
+
+
+@contextmanager
+def x32():
+    with jax.enable_x64(False):
+        yield
+
+
+def test_x32_is_actually_x32():
+    with x32():
+        assert jnp.zeros(2).dtype == jnp.float32
+        assert jnp.asarray(1.5).dtype == jnp.float32
+
+
+def test_accuracy_x32():
+    probs = _rng.random((10, 64, 5))
+    labels = _rng.integers(0, 5, (10, 64))
+    with x32():
+        m = M.Accuracy(num_classes=5)
+        for p, t in zip(probs, labels):
+            m.update(jnp.asarray(p), jnp.asarray(t))
+        got = float(m.compute())
+    expected = float(np.mean(probs.argmax(-1) == labels))
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_stat_scores_macro_x32():
+    probs = _rng.random((6, 48, 5))
+    labels = _rng.integers(0, 5, (6, 48))
+    with x32():
+        m = M.StatScores(num_classes=5, reduce="macro")
+        for p, t in zip(probs, labels):
+            m.update(jnp.asarray(p), jnp.asarray(t))
+        got = np.asarray(m.compute())
+    pred_lbl = probs.argmax(-1).reshape(-1)
+    true_lbl = labels.reshape(-1)
+    exp = []
+    for c in range(5):
+        tp = int(((pred_lbl == c) & (true_lbl == c)).sum())
+        fp = int(((pred_lbl == c) & (true_lbl != c)).sum())
+        tn = int(((pred_lbl != c) & (true_lbl != c)).sum())
+        fn = int(((pred_lbl != c) & (true_lbl == c)).sum())
+        exp.append([tp, fp, tn, fn, tp + fn])
+    np.testing.assert_array_equal(got, np.asarray(exp))
+
+
+def test_mean_metric_x32_large_stream():
+    """f32 accumulation over a long stream of values ~1e3."""
+    vals = _rng.random((50, 512)) * 1e3
+    with x32():
+        m = M.MeanMetric()
+        for v in vals:
+            m.update(jnp.asarray(v, jnp.float32))
+        got = float(m.compute())
+    np.testing.assert_allclose(got, vals.astype(np.float64).mean(), rtol=1e-5)
+
+
+def test_pearson_merge_x32():
+    """Chan parallel-merge of running moments in f32 (reference
+    ``regression/pearson.py:25-54`` is the f64-pocket analog)."""
+    preds = _rng.normal(size=(8, 128)) * 3 + 50  # offset stresses cancellation
+    target = 0.7 * preds + _rng.normal(size=(8, 128))
+    with x32():
+        m = M.PearsonCorrCoef()
+        for p, t in zip(preds, target):
+            m.update(jnp.asarray(p, jnp.float32), jnp.asarray(t, jnp.float32))
+        got = float(m.compute())
+    expected = float(scipy.stats.pearsonr(preds.reshape(-1), target.reshape(-1))[0])
+    np.testing.assert_allclose(got, expected, rtol=1e-4)
+
+
+def test_r2_x32():
+    preds = _rng.normal(size=(8, 128)) + 10
+    target = 0.5 * preds + _rng.normal(size=(8, 128)) * 0.1
+    with x32():
+        m = M.R2Score()
+        for p, t in zip(preds, target):
+            m.update(jnp.asarray(p, jnp.float32), jnp.asarray(t, jnp.float32))
+        got = float(m.compute())
+    t = target.reshape(-1)
+    p = preds.reshape(-1)
+    expected = 1 - ((t - p) ** 2).sum() / ((t - t.mean()) ** 2).sum()
+    np.testing.assert_allclose(got, expected, rtol=1e-4)
+
+
+def test_fid_streaming_kahan_x32():
+    """The compensated-f32 streaming moments (designed for exactly this mode)
+    must reproduce the f64 buffer-based FID."""
+    import scipy.linalg
+
+    d = 16
+    feats_real = _rng.normal(size=(12, 32, d)) * 2 + 1
+    feats_fake = _rng.normal(size=(12, 32, d)) * 2.2 + 0.8
+
+    with x32():
+        fid = M.FrechetInceptionDistance(feature=lambda x: x, feature_dim=d)
+        for fr, ff in zip(feats_real, feats_fake):
+            fid.update(jnp.asarray(fr, jnp.float32), real=True)
+            fid.update(jnp.asarray(ff, jnp.float32), real=False)
+        got = float(fid.compute())
+
+    real = feats_real.reshape(-1, d).astype(np.float64)
+    fake = feats_fake.reshape(-1, d).astype(np.float64)
+    mu1, mu2 = real.mean(0), fake.mean(0)
+    c1, c2 = np.cov(real, rowvar=False), np.cov(fake, rowvar=False)
+    covmean = scipy.linalg.sqrtm(c1 @ c2).real
+    expected = float(((mu1 - mu2) ** 2).sum() + np.trace(c1 + c2 - 2 * covmean))
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_ssim_x32():
+    a = _rng.random((4, 3, 48, 48))
+    b = np.clip(a + _rng.normal(size=a.shape) * 0.05, 0, 1)
+    with x32():
+        m = M.StructuralSimilarityIndexMeasure(data_range=1.0)
+        m.update(jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32))
+        got32 = float(m.compute())
+    # oracle: same kernel in the x64 lane (SSIM vs scipy is covered in tests/image)
+    m64 = M.StructuralSimilarityIndexMeasure(data_range=1.0)
+    m64.update(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(got32, float(m64.compute()), rtol=1e-4)
+
+
+def test_map_x32():
+    """Detection mAP end to end in x32 (accumulation + matching)."""
+    det_rng = np.random.default_rng(3)
+    with x32():
+        m = M.MeanAveragePrecision()
+        for _ in range(4):
+            n_gt = int(det_rng.integers(1, 5))
+            xy = det_rng.uniform(0, 50, (n_gt, 2))
+            g = np.concatenate([xy, xy + det_rng.uniform(10, 30, (n_gt, 2))], 1)
+            lbl = det_rng.integers(0, 2, n_gt)
+            p = g + det_rng.uniform(-2, 2, g.shape)
+            m.update(
+                [dict(boxes=jnp.asarray(p, jnp.float32), scores=jnp.asarray(det_rng.random(n_gt), jnp.float32),
+                      labels=jnp.asarray(lbl))],
+                [dict(boxes=jnp.asarray(g, jnp.float32), labels=jnp.asarray(lbl))],
+            )
+        res = m.compute()
+        assert 0.0 <= float(res["map_50"]) <= 1.0
+        assert float(res["map_50"]) > 0.5  # jittered copies must mostly match
+
+
+def test_binned_curves_x32():
+    from sklearn.metrics import average_precision_score
+
+    probs = _rng.random((6, 64))
+    labels = _rng.integers(0, 2, (6, 64))
+    with x32():
+        m = M.BinnedAveragePrecision(num_classes=1, thresholds=201)
+        for p, t in zip(probs, labels):
+            m.update(jnp.asarray(p, jnp.float32), jnp.asarray(t))
+        got = float(m.compute())
+    expected = average_precision_score(labels.reshape(-1), probs.reshape(-1))
+    np.testing.assert_allclose(got, expected, atol=2e-2)  # binned approximation
+
+
+def test_wrappers_x32():
+    with x32():
+        boot = M.BootStrapper(M.MeanSquaredError(), num_bootstraps=5)
+        p = jnp.asarray(_rng.random(64), jnp.float32)
+        t = jnp.asarray(_rng.random(64), jnp.float32)
+        boot.update(p, t)
+        out = boot.compute()
+        assert np.isfinite(float(out["mean"])) and np.isfinite(float(out["std"]))
