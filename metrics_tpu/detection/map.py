@@ -242,25 +242,37 @@ class MeanAveragePrecision(Metric):
         det_matches = np.zeros((nb_iou_thrs, nb_det), dtype=bool)
         det_ignore = np.zeros((nb_iou_thrs, nb_det), dtype=bool)
 
-        for idx_iou, thr in enumerate(self.iou_thresholds):
+        # Greedy matching, vectorized across all IoU thresholds at once: only
+        # the detection loop is inherently sequential (each det consumes a gt).
+        # Per det the scan picks the highest-IoU *unmatched* gt with
+        # iou >= thr, ties to the highest gt index, preferring real gts over
+        # ignore gts (the scan-order semantics of the reference triple loop,
+        # ``map.py:456-490``, and of pycocotools).
+        if nb_gt and nb_det:
+            thr_eff = np.minimum(np.asarray(self.iou_thresholds, np.float64), 1 - 1e-10)
+            iou_t = ious  # [D, G]
+            is_ignore = gt_ignore[None, :]  # [1, G]
+            rev = slice(None, None, -1)
             for idx_det in range(nb_det):
-                # best unmatched gt above threshold; an ignore-gt is only
-                # taken if no real gt matched (gts are sorted ignore-last)
-                best_iou = min(thr, 1 - 1e-10)
-                m = -1
-                for idx_gt in range(nb_gt):
-                    if gt_matches[idx_iou, idx_gt]:
-                        continue
-                    if m > -1 and not gt_ignore[m] and gt_ignore[idx_gt]:
-                        break
-                    if ious[idx_det, idx_gt] < best_iou:
-                        continue
-                    best_iou = ious[idx_det, idx_gt]
-                    m = idx_gt
-                if m != -1:
-                    det_ignore[idx_iou, idx_det] = gt_ignore[m]
-                    det_matches[idx_iou, idx_det] = True
-                    gt_matches[idx_iou, m] = True
+                iou_row = iou_t[idx_det]  # [G]
+                cand = (iou_row[None, :] >= thr_eff[:, None]) & ~gt_matches  # [T, G]
+
+                def _pick(mask: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+                    has = mask.any(axis=1)
+                    vals = np.where(mask, iou_row[None, :], -np.inf)
+                    best = vals.max(axis=1)
+                    # ties go to the LAST gt index (scan keeps updating on ==)
+                    m = nb_gt - 1 - np.argmax(vals[:, rev] == best[:, None], axis=1)
+                    return has, m
+
+                has_real, m_real = _pick(cand & ~is_ignore)
+                has_ign, m_ign = _pick(cand & is_ignore)
+                m = np.where(has_real, m_real, np.where(has_ign, m_ign, 0))
+                matched = has_real | has_ign
+                det_matches[:, idx_det] = matched
+                det_ignore[:, idx_det] = matched & gt_ignore[m]
+                rows = np.nonzero(matched)[0]
+                gt_matches[rows, m[rows]] = True
 
         # unmatched detections outside the area range are ignored
         det_areas = cache["det_areas"]

@@ -1,0 +1,188 @@
+"""Independent numpy implementation of COCO detection evaluation.
+
+A from-scratch, loop-based transcription of the published COCO evaluation
+algorithm (the pycocotools ``COCOeval`` bbox protocol), deliberately written
+in the straightforward nested-loop style so it shares no code or structure
+with ``metrics_tpu/detection/map.py`` (which is vectorized). Used as the
+randomized-parity oracle the reference gets from pycocotools
+(``/root/reference/tests/detection/test_map.py``).
+
+Inputs are per-image dicts of numpy arrays (xyxy boxes).
+"""
+from typing import Dict, List, Optional
+
+import numpy as np
+
+IOU_THRS = np.linspace(0.5, 0.95, 10)
+REC_THRS = np.linspace(0.0, 1.0, 101)
+AREA_RANGES = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0**2),
+    "medium": (32.0**2, 96.0**2),
+    "large": (96.0**2, 1e10),
+}
+MAX_DETS = (1, 10, 100)
+
+
+def _iou_single(a: np.ndarray, b: np.ndarray) -> float:
+    ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+    ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+    iw, ih = max(ix2 - ix1, 0.0), max(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = (a[2] - a[0]) * (a[3] - a[1])
+    area_b = (b[2] - b[0]) * (b[3] - b[1])
+    union = area_a + area_b - inter
+    return inter / union if union > 0 else 0.0
+
+
+def _area(box: np.ndarray) -> float:
+    return float((box[2] - box[0]) * (box[3] - box[1]))
+
+
+def _evaluate_img(preds, gts, class_id, area_rng, max_det):
+    """Per-(image, class, area, maxdet) matching; returns dt/gt match records."""
+    dt = [i for i in range(len(preds["labels"])) if preds["labels"][i] == class_id]
+    gt = [i for i in range(len(gts["labels"])) if gts["labels"][i] == class_id]
+    if not dt and not gt:
+        return None
+
+    g_ignore = [not (area_rng[0] <= _area(gts["boxes"][i]) <= area_rng[1]) for i in gt]
+    # sort gts: non-ignore first (stable)
+    gt_order = sorted(range(len(gt)), key=lambda i: g_ignore[i])
+    gt = [gt[i] for i in gt_order]
+    g_ignore = [g_ignore[i] for i in gt_order]
+
+    # sort detections by descending score, keep top max_det
+    dt_order = sorted(range(len(dt)), key=lambda i: -preds["scores"][dt[i]])
+    dt = [dt[i] for i in dt_order][:max_det]
+
+    T, D, G = len(IOU_THRS), len(dt), len(gt)
+    dtm = -np.ones((T, D), dtype=np.int64)
+    gtm = -np.ones((T, G), dtype=np.int64)
+    dt_ig = np.zeros((T, D), dtype=bool)
+
+    for t, thr in enumerate(IOU_THRS):
+        for d in range(D):
+            iou = min(thr, 1 - 1e-10)
+            m = -1
+            for g in range(G):
+                if gtm[t, g] >= 0:
+                    continue
+                if m > -1 and not g_ignore[m] and g_ignore[g]:
+                    break
+                ov = _iou_single(preds["boxes"][dt[d]], gts["boxes"][gt[g]])
+                if ov < iou:
+                    continue
+                iou = ov
+                m = g
+            if m == -1:
+                continue
+            dt_ig[t, d] = g_ignore[m]
+            dtm[t, d] = m
+            gtm[t, m] = d
+
+    # unmatched detections out of area range are ignored
+    for d in range(D):
+        a = _area(preds["boxes"][dt[d]])
+        out = not (area_rng[0] <= a <= area_rng[1])
+        for t in range(T):
+            if dtm[t, d] == -1 and out:
+                dt_ig[t, d] = True
+
+    return {
+        "scores": np.asarray([preds["scores"][i] for i in dt], np.float64),
+        "matched": dtm >= 0,
+        "dt_ignore": dt_ig,
+        "num_gt": sum(1 for ig in g_ignore if not ig),
+    }
+
+
+def coco_eval(preds: List[Dict[str, np.ndarray]], gts: List[Dict[str, np.ndarray]],
+              class_metrics: bool = False) -> Dict[str, float]:
+    """Full COCO bbox evaluation -> the 12 standard scalars."""
+    classes = sorted(
+        set(int(c) for p in preds for c in p["labels"]) | set(int(c) for g in gts for c in g["labels"])
+    )
+    T, R, K = len(IOU_THRS), len(REC_THRS), len(classes)
+    A, M = len(AREA_RANGES), len(MAX_DETS)
+    precision = -np.ones((T, R, K, A, M))
+    recall = -np.ones((T, K, A, M))
+
+    for k, cls in enumerate(classes):
+        for a, rng in enumerate(AREA_RANGES.values()):
+            for m, max_det in enumerate(MAX_DETS):
+                records = [
+                    _evaluate_img(p, g, cls, rng, max_det) for p, g in zip(preds, gts)
+                ]
+                records = [r for r in records if r is not None]
+                if not records:
+                    continue
+                npig = sum(r["num_gt"] for r in records)
+                if npig == 0:
+                    continue
+                scores = np.concatenate([r["scores"] for r in records])
+                order = np.argsort(-scores, kind="mergesort")
+                matched = np.concatenate([r["matched"] for r in records], axis=1)[:, order]
+                ignored = np.concatenate([r["dt_ignore"] for r in records], axis=1)[:, order]
+
+                for t in range(T):
+                    tp = fp = 0
+                    tps, fps = [], []
+                    for d in range(matched.shape[1]):
+                        if ignored[t, d]:
+                            continue
+                        if matched[t, d]:
+                            tp += 1
+                        else:
+                            fp += 1
+                        tps.append(tp)
+                        fps.append(fp)
+                    nd = len(tps)
+                    rc = [x / npig for x in tps]
+                    pr = [tps[i] / (tps[i] + fps[i] + np.spacing(1)) for i in range(nd)]
+                    recall[t, k, a, m] = rc[-1] if nd else 0.0
+                    # envelope
+                    for i in range(nd - 1, 0, -1):
+                        if pr[i] > pr[i - 1]:
+                            pr[i - 1] = pr[i]
+                    q = np.zeros(R)
+                    inds = np.searchsorted(rc, REC_THRS, side="left")
+                    for ri, pi in enumerate(inds):
+                        if pi < nd:
+                            q[ri] = pr[pi]
+                    precision[:, :, k, a, m][t] = q
+
+    def _summ(ap: bool, iou: Optional[float] = None, area: str = "all", max_det: int = 100) -> float:
+        a = list(AREA_RANGES).index(area)
+        m = MAX_DETS.index(max_det)
+        s = precision[:, :, :, a, m] if ap else recall[:, :, a, m]
+        if iou is not None:
+            (ti,) = np.nonzero(np.isclose(IOU_THRS, iou))
+            s = s[ti]
+        s = s[s > -1]
+        return float(s.mean()) if s.size else -1.0
+
+    out = {
+        "map": _summ(True),
+        "map_50": _summ(True, iou=0.5),
+        "map_75": _summ(True, iou=0.75),
+        "map_small": _summ(True, area="small"),
+        "map_medium": _summ(True, area="medium"),
+        "map_large": _summ(True, area="large"),
+        "mar_1": _summ(False, max_det=1),
+        "mar_10": _summ(False, max_det=10),
+        "mar_100": _summ(False, max_det=100),
+        "mar_small": _summ(False, area="small"),
+        "mar_medium": _summ(False, area="medium"),
+        "mar_large": _summ(False, area="large"),
+    }
+    if class_metrics:
+        out["map_per_class"] = [
+            float(v.mean()) if (v := precision[:, :, k, 0, M - 1][precision[:, :, k, 0, M - 1] > -1]).size else -1.0
+            for k in range(K)
+        ]
+        out["mar_100_per_class"] = [
+            float(v.mean()) if (v := recall[:, k, 0, M - 1][recall[:, k, 0, M - 1] > -1]).size else -1.0
+            for k in range(K)
+        ]
+    return out
