@@ -1,4 +1,9 @@
-"""ShortTimeObjectiveIntelligibility module metric (parity: reference ``torchmetrics/audio/stoi.py:23``)."""
+"""ShortTimeObjectiveIntelligibility module metric (parity: reference ``torchmetrics/audio/stoi.py:23``).
+
+Unlike the reference (which gates on the ``pystoi`` wheel and runs per-sample
+on host CPU), the STOI pipeline here is a native, jittable JAX program
+(``functional/audio/stoi.py``) — no optional dependency, runs on device.
+"""
 from typing import Any
 
 import jax
@@ -6,26 +11,19 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
 
 Array = jax.Array
 
 
 class ShortTimeObjectiveIntelligibility(Metric):
-    """Streaming mean STOI. CPU-bound: the algorithm runs per-sample on host
-    (reference ``functional/audio/stoi.py``), only the accumulation is on
-    device."""
+    """Streaming mean STOI/ESTOI over batches of (preds, target) signals."""
 
     is_differentiable = False
     higher_is_better = True
 
     def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        kwargs.setdefault("jit_update", False)  # resample plan depends on fs; fn jits internally
         super().__init__(**kwargs)
-        if not _PYSTOI_AVAILABLE:
-            raise ModuleNotFoundError(
-                "ShortTimeObjectiveIntelligibility metric requires that pystoi is installed."
-                " Either install as `pip install metrics_tpu[audio]` or `pip install pystoi`."
-            )
         self.fs = fs
         self.extended = extended
         self.add_state("sum_stoi", default=jnp.asarray(0.0), dist_reduce_fx="sum")

@@ -12,7 +12,7 @@ states.
 """
 import re
 from functools import lru_cache
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
+from typing import Dict, List, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -20,9 +20,13 @@ import numpy as np
 
 Array = jax.Array
 
-_MAX_SHIFT_SIZE = 10
-_MAX_SHIFT_DIST = 50
-_MAX_SHIFT_CANDIDATES = 1000
+# tercom search limits (algorithm constants from Snover et al. / tercom):
+# spans longer than _SPAN_LIMIT-1 words are never shifted, spans may not move
+# further than _OFFSET_LIMIT positions, and the greedy search gives up after
+# _CANDIDATE_BUDGET evaluated relocations.
+_SPAN_LIMIT = 10
+_OFFSET_LIMIT = 50
+_CANDIDATE_BUDGET = 1000
 
 # edit operations in the alignment trace
 _OP_MATCH, _OP_SUB, _OP_INS, _OP_DEL = "A", "S", "I", "D"
@@ -141,146 +145,192 @@ def _edit_distance_with_trace(hyp: Tuple[str, ...], ref: Tuple[str, ...]) -> Tup
     return int(dist[m, n]), "".join(reversed(ops))
 
 
-def _trace_to_alignment(trace: str) -> Tuple[Dict[int, int], List[int], List[int]]:
-    """Map reference positions to aligned hypothesis positions and mark
-    per-position errors on both sides."""
-    pos_hyp, pos_ref = -1, -1
-    alignments: Dict[int, int] = {-1: -1}
-    hyp_errors: List[int] = []
-    ref_errors: List[int] = []
-    for op in trace:
-        if op == _OP_MATCH:
-            pos_hyp += 1
-            pos_ref += 1
-            alignments[pos_ref] = pos_hyp
-            hyp_errors.append(0)
-            ref_errors.append(0)
-        elif op == _OP_SUB:
-            pos_hyp += 1
-            pos_ref += 1
-            alignments[pos_ref] = pos_hyp
-            hyp_errors.append(1)
-            ref_errors.append(1)
-        elif op == _OP_INS:
-            pos_hyp += 1
-            hyp_errors.append(1)
-        else:  # deletion: reference word with no hypothesis counterpart
-            pos_ref += 1
-            alignments[pos_ref] = pos_hyp
-            ref_errors.append(1)
-    return alignments, ref_errors, hyp_errors
+class _Alignment:
+    """Array view of an alignment trace.
+
+    ``ref_to_hyp[p]`` is the hypothesis index aligned with reference position
+    ``p`` (index 0 stands for ref position -1, mapped to hyp -1, so lookups are
+    shifted by one). ``hyp_err_cum``/``ref_err_cum`` are prefix sums of the
+    per-position error indicators, so any span's error count is a difference
+    of two entries.
+    """
+
+    __slots__ = ("ref_to_hyp", "hyp_err_cum", "ref_err_cum")
+
+    def __init__(self, trace: str) -> None:
+        ops = np.frombuffer(trace.encode(), dtype=np.uint8)
+        in_hyp = (ops != ord(_OP_DEL))  # ops that consume a hypothesis word
+        in_ref = (ops != ord(_OP_INS))  # ops that consume a reference word
+        err = (ops != ord(_OP_MATCH))
+        # hypothesis cursor value after each op, then select the ops that
+        # consume a reference word to get the ref->hyp position map
+        hyp_cursor = np.cumsum(in_hyp) - 1
+        self.ref_to_hyp = np.concatenate(([-1], hyp_cursor[in_ref]))
+        self.hyp_err_cum = np.concatenate(([0], np.cumsum(err[in_hyp])))
+        self.ref_err_cum = np.concatenate(([0], np.cumsum(err[in_ref])))
 
 
-def _find_shifted_pairs(hyp_words: List[str], ref_words: List[str]) -> Iterator[Tuple[int, int, int]]:
-    """All (hyp_start, ref_start, length) spans where the word sequences
-    agree, bounded by the tercom shift-size/distance limits."""
-    for hyp_start in range(len(hyp_words)):
-        for ref_start in range(len(ref_words)):
-            if abs(ref_start - hyp_start) > _MAX_SHIFT_DIST:
-                continue
-            for length in range(1, _MAX_SHIFT_SIZE):
-                if hyp_words[hyp_start + length - 1] != ref_words[ref_start + length - 1]:
-                    break
-                yield hyp_start, ref_start, length
-                if len(hyp_words) == hyp_start + length or len(ref_words) == ref_start + length:
-                    break
+def _span_table(hyp_ids: np.ndarray, ref_ids: np.ndarray) -> np.ndarray:
+    """Enumerate every common word span as an ``[K, 3]`` array of
+    ``(hyp_start, ref_start, length)`` rows, ordered like tercom's scan
+    (hypothesis position, then reference position, then growing length).
+
+    Built from a run-length matrix: ``runs[i, j]`` = length of the longest
+    common prefix of ``hyp[i:]`` and ``ref[j:]``, computed with one vector op
+    per hypothesis position.
+    """
+    m, n = len(hyp_ids), len(ref_ids)
+    if m == 0 or n == 0:
+        return np.empty((0, 3), dtype=np.int64)
+    eq = hyp_ids[:, None] == ref_ids[None, :]
+    runs = np.zeros((m + 1, n + 1), dtype=np.int64)
+    for i in range(m - 1, -1, -1):
+        runs[i, :n] = eq[i] * (1 + runs[i + 1, 1:])
+    # distance gate + span-length cap
+    offside = np.abs(np.arange(m)[:, None] - np.arange(n)[None, :]) > _OFFSET_LIMIT
+    capped = np.where(offside, 0, np.minimum(runs[:m, :n], _SPAN_LIMIT - 1))
+    starts = np.argwhere(capped > 0)
+    if starts.size == 0:
+        return np.empty((0, 3), dtype=np.int64)
+    # expand each (i, j) into rows for lengths 1..capped[i, j]
+    counts = capped[starts[:, 0], starts[:, 1]]
+    rows = np.repeat(starts, counts, axis=0)
+    lengths = np.concatenate([np.arange(1, c + 1) for c in counts])
+    return np.column_stack([rows, lengths])
 
 
-def _perform_shift(words: List[str], start: int, length: int, target: int) -> List[str]:
-    """Move ``words[start:start+length]`` so it lands at position ``target``."""
-    if target < start:
-        return words[:target] + words[start : start + length] + words[target:start] + words[start + length :]
-    if target > start + length:
-        return words[:start] + words[start + length : target] + words[start : start + length] + words[target:]
-    return (
-        words[:start]
-        + words[start + length : length + target]
-        + words[start : start + length]
-        + words[length + target :]
-    )
+def _relocate(ids: np.ndarray, start: int, length: int, dest: int) -> np.ndarray:
+    """Return ``ids`` with the block ``[start, start+length)`` moved so that it
+    begins at original-coordinate position ``dest``."""
+    span = ids[start : start + length]
+    rest = np.delete(ids, np.s_[start : start + length])
+    at = dest - length if dest > start + length else dest
+    return np.concatenate([rest[:at], span, rest[at:]])
 
 
-class _CachedEditDistance:
-    """Memoized trace DP against a fixed reference."""
+class _TraceDistance:
+    """Levenshtein-with-trace against a fixed reference, memoized on the
+    hypothesis token ids (every search round re-queries shifted variants)."""
 
     def __init__(self, ref_words: List[str]) -> None:
         self._ref = tuple(ref_words)
-        self._cache: Dict[Tuple[str, ...], Tuple[int, str]] = {}
+        self._memo: Dict[Tuple[str, ...], Tuple[int, str]] = {}
 
-    def __call__(self, hyp_words: List[str]) -> Tuple[int, str]:
+    def __call__(self, hyp_words: Sequence[str]) -> Tuple[int, str]:
         key = tuple(hyp_words)
-        if key not in self._cache:
-            self._cache[key] = _edit_distance_with_trace(key, self._ref)
-        return self._cache[key]
+        if key not in self._memo:
+            self._memo[key] = _edit_distance_with_trace(key, self._ref)
+        return self._memo[key]
 
 
-def _shift_words(
+def _candidate_shifts(
+    spans: np.ndarray, align: "_Alignment", budget: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Filter the span table down to legal tercom shifts and expand each span
+    into its candidate landing positions.
+
+    Returns parallel arrays ``(hyp_start, length, dest, span_row)`` truncated
+    to ``budget`` entries. A span is shiftable only if it is misaligned on both
+    sides (at least one error inside the span in the hypothesis AND at the
+    reference landing zone) and does not already overlap its own destination.
+    Landing positions come from the alignment of the reference words just
+    before/inside the span's reference window, deduplicated when consecutive
+    offsets alias to the same hypothesis slot.
+    """
+    hs, rs, ln = spans[:, 0], spans[:, 1], spans[:, 2]
+    n_ref = len(align.ref_to_hyp) - 1
+
+    hyp_wrong = (align.hyp_err_cum[hs + ln] - align.hyp_err_cum[hs]) > 0
+    ref_wrong = (align.ref_err_cum[rs + ln] - align.ref_err_cum[rs]) > 0
+    anchor = align.ref_to_hyp[rs + 1]  # hyp position aligned to the span's ref start
+    outside = ~((hs <= anchor) & (anchor < hs + ln))
+    keep = hyp_wrong & ref_wrong & outside
+    if not keep.any():
+        empty = np.empty(0, dtype=np.int64)
+        return empty, empty, empty, empty
+
+    spans = spans[keep]
+    out_h, out_l, out_d, out_row = [], [], [], []
+    for row, (h, r, l) in enumerate(spans):
+        # reference offsets r-1 .. r+l-1 (stop at the reference end), shifted
+        # +1 into ref_to_hyp's padded indexing; +1 again: land *after* the
+        # aligned word
+        upper = min(r + l, n_ref)
+        dests = align.ref_to_hyp[r : upper + 1] + 1
+        dests = dests[np.concatenate(([True], dests[1:] != dests[:-1]))]
+        out_h.append(np.full(len(dests), h))
+        out_l.append(np.full(len(dests), l))
+        out_d.append(dests)
+        out_row.append(np.full(len(dests), row))
+    hyp_start = np.concatenate(out_h)
+    length = np.concatenate(out_l)
+    dest = np.concatenate(out_d)
+    span_row = np.concatenate(out_row)
+    if len(dest) > budget:
+        # spend at most the remaining candidate budget, in scan order
+        hyp_start, length, dest, span_row = (
+            hyp_start[:budget], length[:budget], dest[:budget], span_row[:budget]
+        )
+    return hyp_start, length, dest, span_row
+
+
+def _best_shift(
     hyp_words: List[str],
     ref_words: List[str],
-    cached_edit_distance: _CachedEditDistance,
-    checked_candidates: int,
+    distance: _TraceDistance,
+    vocab: Dict[str, int],
+    budget: int,
 ) -> Tuple[int, List[str], int]:
-    """One round of the tercom greedy shift search: returns the best edit-
-    distance gain, the shifted hypothesis, and the running candidate count."""
-    edit_distance, trace = cached_edit_distance(hyp_words)
-    alignments, ref_errors, hyp_errors = _trace_to_alignment(trace)
+    """Evaluate every legal shift of the current hypothesis in one batch and
+    return (edit-distance gain, shifted hypothesis, candidates spent).
 
-    best: Optional[Tuple[int, int, int, int, List[str]]] = None
-    for hyp_start, ref_start, length in _find_shifted_pairs(hyp_words, ref_words):
-        # only shift spans that are wrong in place and whose target is wrong too
-        if sum(hyp_errors[hyp_start : hyp_start + length]) == 0:
-            continue
-        if sum(ref_errors[ref_start : ref_start + length]) == 0:
-            continue
-        if hyp_start <= alignments[ref_start] < hyp_start + length:
-            continue
+    Ranking follows tercom: largest gain, then longest span, then earliest
+    span in the hypothesis, then earliest landing position.
+    """
+    base_distance, trace = distance(hyp_words)
+    align = _Alignment(trace)
+    hyp_ids = np.array([vocab[w] for w in hyp_words], dtype=np.int64)
+    ref_ids = np.array([vocab.setdefault(w, len(vocab)) for w in ref_words], dtype=np.int64)
 
-        prev_idx = -1
-        for offset in range(-1, length):
-            if ref_start + offset == -1:
-                idx = 0
-            elif ref_start + offset in alignments:
-                idx = alignments[ref_start + offset] + 1
-            else:
-                break
-            if idx == prev_idx:
-                continue
-            prev_idx = idx
-            shifted_words = _perform_shift(hyp_words, hyp_start, length, idx)
-            candidate = (
-                edit_distance - cached_edit_distance(shifted_words)[0],
-                length,
-                -hyp_start,
-                -idx,
-                shifted_words,
-            )
-            checked_candidates += 1
-            if best is None or candidate > best:
-                best = candidate
-        if checked_candidates >= _MAX_SHIFT_CANDIDATES:
-            break
+    spans = _span_table(hyp_ids, ref_ids)
+    hs, ln, dest, _ = _candidate_shifts(spans, align, budget)
+    used = len(dest)
+    if used == 0:
+        return 0, hyp_words, 0
 
-    if best is None:
-        return 0, hyp_words, checked_candidates
-    return best[0], best[4], checked_candidates
+    id_to_word = [""] * len(vocab)
+    for word, wid in vocab.items():
+        id_to_word[wid] = word
+    variants = [
+        [id_to_word[i] for i in _relocate(hyp_ids, int(h), int(l), int(d))]
+        for h, l, d in zip(hs, ln, dest)
+    ]
+    gains = np.array([base_distance - distance(v)[0] for v in variants], dtype=np.int64)
+    best = np.lexsort((dest, hs, -ln, -gains))[0]
+    return int(gains[best]), variants[best], used
 
 
 def _translation_edit_rate(hyp_words: List[str], ref_words: List[str]) -> int:
     """Edits (shifts + word edits) to turn hypothesis into one reference."""
     if len(ref_words) == 0:
         return 0
-    cached = _CachedEditDistance(ref_words)
-    num_shifts = 0
-    checked_candidates = 0
+    distance = _TraceDistance(ref_words)
+    vocab: Dict[str, int] = {}
+    for w in hyp_words:
+        vocab.setdefault(w, len(vocab))
+    shifts = 0
+    spent = 0
     words = list(hyp_words)
     while True:
-        delta, new_words, checked_candidates = _shift_words(words, ref_words, cached, checked_candidates)
-        if checked_candidates >= _MAX_SHIFT_CANDIDATES or delta <= 0:
+        gain, words_next, used = _best_shift(words, ref_words, distance, vocab, _CANDIDATE_BUDGET - spent)
+        spent += used
+        # a shift found on the round that drains the budget is not applied —
+        # tercom gives up as soon as the candidate allowance runs out
+        if spent >= _CANDIDATE_BUDGET or gain <= 0:
             break
-        num_shifts += 1
-        words = new_words
-    edit_distance, _ = cached(words)
-    return num_shifts + edit_distance
+        shifts += 1
+        words = words_next
+    return shifts + distance(words)[0]
 
 
 def _compute_sentence_statistics(hyp_words: List[str], ref_sentences: List[List[str]]) -> Tuple[float, float]:

@@ -26,6 +26,9 @@ class InceptionScore(Metric):
         feature: callable ``imgs -> [N, num_classes]`` logits, or the
             reference's ``"logits_unbiased"``/int selecting the default
             InceptionV3 tap (built from ``weights_path``, see FID).
+            ``"logits"`` (raw, bias-included head output) is an intentional
+            extension over the reference API, which accepts only
+            ``"logits_unbiased"`` among strings (reference ``inception.py:137``).
         splits: number of chunks to compute the score over.
         seed: host RNG seed for the pre-split shuffle.
         weights_path: local InceptionV3 ``.npz`` weights for the default.

@@ -413,6 +413,9 @@ def _validate_params(params: Params) -> Params:
     missing = sorted(set(spec) - set(params))
     if missing:
         raise ValueError(f"Inception weights are missing parameter groups: {missing[:5]}...")
+    unknown = sorted(set(params) - set(spec))
+    if unknown:
+        raise ValueError(f"Inception weights contain unknown parameter groups: {unknown[:5]}")
     for mod, group in spec.items():
         for name, shape in group.items():
             got = tuple(params[mod][name].shape)
@@ -427,6 +430,10 @@ def load_inception_weights(path: str, dtype: Any = jnp.float32) -> Params:
     flat = np.load(_npz_path(path))
     params: Params = {}
     for key in flat.files:
+        if "." not in key:
+            raise ValueError(
+                f"Malformed Inception weights file: key {key!r} is not of the form '<module>.<param>'"
+            )
         mod, name = key.rsplit(".", 1)
         params.setdefault(mod, {})[name] = jnp.asarray(flat[key], dtype)
     return _validate_params(params)

@@ -109,6 +109,14 @@ class Metric:
         self._device = None
         self.compute_on_step = compute_on_step
         self.dist_sync_on_step = dist_sync_on_step
+        if process_group is not None and dist_sync_fn is None:
+            # fail at construction, not deep inside the first distributed
+            # compute(): the default host gather cannot honor subgroups
+            raise ValueError(
+                "`process_group` requires a custom `dist_sync_fn` (the default host-level"
+                " gather always spans every process). Alternatively use the pure state API"
+                " inside shard_map with `axis_name` naming a mesh-axis subset."
+            )
         self.process_group = process_group
         self.dist_sync_fn = dist_sync_fn
         self.axis_name = axis_name

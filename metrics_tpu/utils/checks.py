@@ -28,28 +28,28 @@ def _check_same_shape(preds: Array, target: Array) -> None:
     """Reference ``checks.py:23``."""
     if preds.shape != target.shape:
         raise RuntimeError(
-            f"Predictions and targets are expected to have the same shape, "
-            f"but got {preds.shape} and {target.shape}."
+            f"`preds` and `target` shapes must match exactly; received "
+            f"preds{preds.shape} vs target{target.shape}."
         )
 
 
 def _basic_input_validation(preds: Array, target: Array, threshold: float, multiclass: Optional[bool]) -> None:
     """Static + (eager-only) value validation. Reference ``checks.py:29``."""
     if _is_floating(target):
-        raise ValueError("The `target` has to be an integer array.")
+        raise ValueError("`target` carries class labels and must therefore use an integer dtype, not floating point.")
     preds_float = _is_floating(preds)
     if preds.shape[:1] != target.shape[:1]:
-        raise ValueError("The `preds` and `target` should have the same first dimension.")
+        raise ValueError("`preds` and `target` disagree on the batch (first) dimension.")
     if is_tracing(preds, target):
         return  # value checks require concrete data
     if jnp.min(target) < 0:
-        raise ValueError("The `target` has to be a non-negative array.")
+        raise ValueError("Negative values found in `target`; class labels must be >= 0.")
     if not preds_float and jnp.min(preds) < 0:
-        raise ValueError("If `preds` are integers, they have to be non-negative.")
+        raise ValueError("Integer `preds` encode class labels and must be >= 0; negative entries found.")
     if multiclass is False and jnp.max(target) > 1:
-        raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+        raise ValueError("`multiclass=False` promises binary-style labels, yet `target` contains values above 1.")
     if multiclass is False and not preds_float and jnp.max(preds) > 1:
-        raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
+        raise ValueError("`multiclass=False` with integer `preds` requires every prediction to be 0 or 1.")
 
 
 def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[DataType, int]:
@@ -59,12 +59,12 @@ def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[Data
     if preds.ndim == target.ndim:
         if preds.shape != target.shape:
             raise ValueError(
-                "The `preds` and `target` should have the same shape, "
-                f"got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+                "When `preds` and `target` have equal rank their shapes must match; "
+                f"received preds{preds.shape} vs target{target.shape}."
             )
         if preds_float and not is_tracing(target) and jnp.max(target) > 1:
             raise ValueError(
-                "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+                "Float `preds` with an equal-shaped `target` means probability inputs, so `target` may only hold 0s and 1s."
             )
         if preds.ndim == 1 and preds_float:
             case = DataType.BINARY
@@ -77,18 +77,18 @@ def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[Data
         implied_classes = int(jnp.size(preds[0])) if preds.ndim > 1 else 1
     elif preds.ndim == target.ndim + 1:
         if not preds_float:
-            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float array.")
+            raise ValueError("`preds` with an extra dimension relative to `target` are read as per-class scores and must be floating point.")
         if preds.shape[2:] != target.shape[1:]:
             raise ValueError(
-                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
-                " (N, C, ...), and the shape of `target` should be (N, ...)."
+                "Per-class `preds` must be laid out (N, C, ...) against a (N, ...) `target`; "
+                "trailing dimensions do not line up."
             )
         implied_classes = preds.shape[1]
         case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
     else:
         raise ValueError(
-            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
-            " and `preds` should be (N, C, ...)."
+            "Unrecognized input layout: supported forms are matching (N, ...) arrays, "
+            "or (N, C, ...) scores in `preds` against (N, ...) labels in `target`."
         )
     return case, implied_classes
 
@@ -96,17 +96,16 @@ def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[Data
 def _check_num_classes_binary(num_classes: int, multiclass: Optional[bool]) -> None:
     """Reference ``checks.py:109``."""
     if num_classes > 2:
-        raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+        raise ValueError("Inputs were detected as binary, which is incompatible with `num_classes` > 2.")
     if num_classes == 2 and not multiclass:
         raise ValueError(
-            "Your data is binary and `num_classes=2`, but `multiclass` is not True."
-            " Set it to True if you want to transform binary data to multi-class format."
+            "Binary inputs with `num_classes=2` only make sense when `multiclass=True` "
+            "(i.e. you want the 2-class one-hot expansion)."
         )
     if num_classes == 1 and multiclass:
         raise ValueError(
-            "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
-            " Either set `multiclass=None` (default) or set `num_classes=2`"
-            " to transform binary data to multi-class format."
+            "`multiclass=True` asks for the 2-class expansion of binary data, but `num_classes=1` "
+            "forbids it. Drop `multiclass` (leave it None) or raise `num_classes` to 2."
         )
 
 
@@ -116,51 +115,48 @@ def _check_num_classes_mc(
     """Reference ``checks.py:127``."""
     if num_classes == 1 and multiclass is not False:
         raise ValueError(
-            "You have set `num_classes=1`, but predictions are integers."
-            " If you want to convert (multi-dimensional) multi-class data with 2 classes"
-            " to binary/multi-label, set `multiclass=False`."
+            "`num_classes=1` cannot describe integer label predictions. To fold 2-class "
+            "(multi-dim) multi-class inputs down to binary/multi-label, pass `multiclass=False` instead."
         )
     if num_classes > 1:
         if multiclass is False and implied_classes != num_classes:
             raise ValueError(
-                "You have set `multiclass=False`, but the implied number of classes "
-                "(from shape of inputs) does not match `num_classes`."
+                "With `multiclass=False` the class count implied by the input shapes must equal "
+                "`num_classes`, and here it does not."
             )
         if not is_tracing(target) and num_classes <= int(jnp.max(target)):
-            raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+            raise ValueError("`target` contains a label outside the valid range [0, num_classes).")
         if preds.shape != target.shape and num_classes != implied_classes:
-            raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+            raise ValueError("`preds` has a class dimension of different size than `num_classes`.")
 
 
 def _check_num_classes_ml(num_classes: int, multiclass: Optional[bool], implied_classes: int) -> None:
     """Reference ``checks.py:158``."""
     if multiclass and num_classes != 2:
         raise ValueError(
-            "You have set `multiclass=True`, but `num_classes` is not equal to 2."
-            " If you are trying to transform multi-label data to 2 class multi-dimensional"
-            " multi-class, you should set `num_classes` to either 2 or None."
+            "Multi-label inputs with `multiclass=True` describe a 2-class multi-dim multi-class "
+            "conversion, so `num_classes` must be 2 (or left as None)."
         )
     if not multiclass and num_classes != implied_classes:
-        raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+        raise ValueError("`num_classes` disagrees with the label count implied by the multi-label input shapes.")
 
 
 def _check_top_k(top_k: int, case: DataType, implied_classes: int, multiclass: Optional[bool], preds_float: bool) -> None:
     """Reference ``checks.py:172``."""
     if case == DataType.BINARY:
-        raise ValueError("You can not use `top_k` parameter with binary data.")
+        raise ValueError("`top_k` is meaningless for binary inputs and must not be set.")
     if not isinstance(top_k, int) or top_k <= 0:
-        raise ValueError("The `top_k` has to be an integer larger than 0.")
+        raise ValueError("`top_k` must be a positive integer.")
     if not preds_float:
-        raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+        raise ValueError("`top_k` selection requires probability/logit `preds`; integer label predictions cannot be ranked.")
     if multiclass is False:
-        raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+        raise ValueError("`top_k` cannot be combined with `multiclass=False`.")
     if case == DataType.MULTILABEL and multiclass:
         raise ValueError(
-            "If you want to transform multi-label data to 2 class multi-dimensional"
-            " multi-class data using `multiclass=True`, you can not use `top_k`."
+            "`top_k` is unsupported for multi-label inputs being expanded via `multiclass=True`."
         )
     if top_k >= implied_classes:
-        raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+        raise ValueError("`top_k` must be strictly less than the number of classes in `preds`.")
 
 
 def _check_classification_inputs(
@@ -178,12 +174,12 @@ def _check_classification_inputs(
     if preds.shape != target.shape:
         if multiclass is False and implied_classes != 2:
             raise ValueError(
-                "You have set `multiclass=False`, but have more than 2 classes in your data,"
-                " based on the C dimension of `preds`."
+                "`multiclass=False` requires a 2-wide class dimension in `preds`, "
+                "but the inputs carry more than 2 classes."
             )
         if not is_tracing(target) and int(jnp.max(target)) >= implied_classes:
             raise ValueError(
-                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+                "`target` references a class index beyond the class dimension of `preds`."
             )
 
     if num_classes:
@@ -284,7 +280,7 @@ def _input_format_classification_one_hot(
 ) -> Tuple[Array, Array]:
     """One-hot ``[C, -1]`` layout. Reference ``checks.py:435``."""
     if preds.ndim not in (target.ndim, target.ndim + 1):
-        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+        raise ValueError("one-hot formatting accepts equal-rank preds/target, or preds with exactly one extra (class) dimension")
 
     if preds.ndim == target.ndim + 1:
         preds = jnp.argmax(preds, axis=1)
@@ -311,11 +307,11 @@ def _check_retrieval_target_and_prediction_types(
         or target.dtype == jnp.bool_
         or jnp.issubdtype(target.dtype, jnp.floating)
     ):
-        raise ValueError("`target` must be an array of booleans, integers or floats")
+        raise ValueError("retrieval `target` must be boolean, integer, or float typed")
     if not _is_floating(preds):
-        raise ValueError("`preds` must be an array of floats")
+        raise ValueError("retrieval `preds` must be floating-point relevance scores")
     if not allow_non_binary_target and not is_tracing(target) and (jnp.max(target) > 1 or jnp.min(target) < 0):
-        raise ValueError("`target` must contain `binary` values")
+        raise ValueError("retrieval `target` must be binary (0/1) unless the metric explicitly allows graded relevance")
     target = target.astype(jnp.float32) if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.int32)
     preds = preds.astype(jnp.float32)
     return preds.reshape(-1), target.reshape(-1)
@@ -326,9 +322,9 @@ def _check_retrieval_functional_inputs(
 ) -> Tuple[Array, Array]:
     """Reference ``checks.py:484``."""
     if preds.shape != target.shape:
-        raise ValueError("`preds` and `target` must be of the same shape")
+        raise ValueError("retrieval `preds` and `target` must share one shape")
     if preds.size == 0 or preds.ndim == 0:
-        raise ValueError("`preds` and `target` must be non-empty and non-scalar arrays")
+        raise ValueError("retrieval inputs must be non-scalar and contain at least one element")
     return _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
 
 
@@ -342,9 +338,9 @@ def _check_retrieval_inputs(
     """Reference ``checks.py:514``. The ``ignore_index`` filter uses boolean
     masking and is therefore host-side (concrete arrays) only."""
     if indexes.shape != preds.shape or preds.shape != target.shape:
-        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+        raise ValueError("retrieval `indexes`, `preds` and `target` must all share one shape")
     if not jnp.issubdtype(indexes.dtype, jnp.integer):
-        raise ValueError("`indexes` must be an array of integers")
+        raise ValueError("retrieval `indexes` must be integer typed (they identify queries)")
 
     if ignore_index is not None:
         valid = target != ignore_index
@@ -353,7 +349,7 @@ def _check_retrieval_inputs(
         target = target[valid]
 
     if indexes.size == 0 or indexes.ndim == 0:
-        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar arrays")
+        raise ValueError("after `ignore_index` filtering, retrieval inputs must still be non-scalar with at least one element")
 
     preds, target = _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
     return indexes.astype(jnp.int32).reshape(-1), preds, target

@@ -37,4 +37,7 @@ _ORBAX_AVAILABLE = _package_available("orbax")
 _NLTK_AVAILABLE = _package_available("nltk")
 _REGEX_AVAILABLE = _package_available("regex")
 _PESQ_AVAILABLE = _package_available("pesq")
+# informational only: STOI is implemented natively (functional/audio/stoi.py);
+# the flag remains for API parity with the reference's gate list and lets
+# users cross-check against the wheel when it is present
 _PYSTOI_AVAILABLE = _package_available("pystoi")

@@ -288,10 +288,9 @@ class TestGatedWheels:
             with pytest.raises(ModuleNotFoundError):
                 PerceptualEvaluationSpeechQuality(16000, "wb")
 
-    def test_stoi_gated(self):
+    def test_stoi_not_gated(self):
+        # STOI is native JAX now (functional/audio/stoi.py) — constructing it
+        # must not require the pystoi wheel (value tests: tests/audio/test_stoi.py)
         from metrics_tpu import ShortTimeObjectiveIntelligibility
-        from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
 
-        if not _PYSTOI_AVAILABLE:
-            with pytest.raises(ModuleNotFoundError):
-                ShortTimeObjectiveIntelligibility(16000)
+        ShortTimeObjectiveIntelligibility(16000)

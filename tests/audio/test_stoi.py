@@ -1,0 +1,121 @@
+"""Native STOI value tests vs a vendored numpy oracle.
+
+The oracle (``tests/helpers/stoi_oracle.py``) is a faithful host
+implementation of the published STOI/ESTOI algorithm following pystoi (the
+wheel the reference's CI compares against, ``tests/audio/test_stoi.py``
+there); the JAX pipeline under test is an independent static-shape
+formulation (conv resampler, scatter compaction, masked segments).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.signal import resample_poly
+
+from metrics_tpu import ShortTimeObjectiveIntelligibility
+from metrics_tpu.functional.audio.stoi import (
+    _resample,
+    short_time_objective_intelligibility,
+)
+from tests.helpers import seed_all
+from tests.helpers.stoi_oracle import resample_filter, stoi_oracle
+
+seed_all(7)
+
+_X64 = jax.config.jax_enable_x64
+_ATOL = 1e-7 if _X64 else 2e-4
+
+
+def _speechlike(n, fs, rng, silent_gap=False):
+    """Modulated noise with band structure — enough spectral variety for STOI."""
+    t = np.arange(n) / fs
+    env = 0.5 + 0.5 * np.sin(2 * np.pi * 3.1 * t)
+    carrier = rng.randn(n) + 0.3 * np.sin(2 * np.pi * 440 * t)
+    x = env * carrier
+    if silent_gap:
+        lo, hi = int(0.35 * n), int(0.55 * n)
+        x[lo:hi] *= 1e-4  # below the 40 dB dynamic range -> frames dropped
+    return x.astype(np.float64)
+
+
+@pytest.mark.parametrize("fs", [10000, 16000, 8000])
+def test_resampler_matches_scipy(fs):
+    if fs == 10000:
+        pytest.skip("no resampling at the native rate")
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, fs)  # 1 second
+    h = resample_filter(10000, fs)
+    want = np.stack([resample_poly(row, 10000, fs, window=h / h.sum()) for row in x])
+    got = np.asarray(_resample(jnp.asarray(x), fs))
+    np.testing.assert_allclose(got, want, atol=1e-6 if _X64 else 1e-4, rtol=1e-5)
+
+
+@pytest.mark.parametrize("fs", [10000, 16000, 8000])
+@pytest.mark.parametrize("extended", [False, True])
+@pytest.mark.parametrize("silent_gap", [False, True])
+def test_stoi_matches_oracle(fs, extended, silent_gap):
+    rng = np.random.RandomState(11)
+    n = 2 * fs  # 2 seconds
+    target = _speechlike(n, fs, rng, silent_gap=silent_gap)
+    noise = 0.5 * rng.randn(n)
+    preds = target + noise * (np.abs(target).mean() / np.abs(noise).mean())
+
+    got = float(short_time_objective_intelligibility(
+        jnp.asarray(preds), jnp.asarray(target), fs, extended=extended
+    ))
+    want = stoi_oracle(target, preds, fs, extended=extended)
+    np.testing.assert_allclose(got, want, atol=_ATOL, rtol=1e-4 if _X64 else 1e-3)
+
+
+def test_stoi_perfect_signal():
+    rng = np.random.RandomState(5)
+    x = _speechlike(20000, 10000, rng)
+    score = float(short_time_objective_intelligibility(jnp.asarray(x), jnp.asarray(x), 10000))
+    assert score > 0.999
+
+
+def test_stoi_too_short_returns_sentinel():
+    x = jnp.asarray(np.random.RandomState(0).randn(1000))  # < 30 frames
+    score = float(short_time_objective_intelligibility(x, x, 10000))
+    assert score == pytest.approx(1e-5)
+
+
+def test_stoi_batched_and_jitted():
+    rng = np.random.RandomState(9)
+    target = np.stack([_speechlike(16000, 8000, rng) for _ in range(3)])
+    preds = target + 0.3 * rng.randn(*target.shape)
+
+    fn = jax.jit(lambda p, t: short_time_objective_intelligibility(p, t, 8000))
+    batched = np.asarray(fn(jnp.asarray(preds), jnp.asarray(target)))
+    singles = [
+        float(short_time_objective_intelligibility(jnp.asarray(p), jnp.asarray(t), 8000))
+        for p, t in zip(preds, target)
+    ]
+    np.testing.assert_allclose(batched, singles, atol=1e-6 if _X64 else 1e-4)
+    assert batched.shape == (3,)
+
+
+def test_stoi_integer_pcm_target():
+    """int16-style PCM target with float preds must promote, not truncate."""
+    rng = np.random.RandomState(21)
+    clean = (_speechlike(20000, 10000, rng) * 8000).astype(np.int32)
+    preds = clean.astype(np.float64) + 400 * rng.randn(20000)
+    got = float(short_time_objective_intelligibility(jnp.asarray(preds), jnp.asarray(clean), 10000))
+    want = stoi_oracle(clean.astype(np.float64), preds, 10000)
+    np.testing.assert_allclose(got, want, atol=_ATOL, rtol=1e-3)
+
+
+def test_stoi_module_streaming():
+    rng = np.random.RandomState(13)
+    target = np.stack([_speechlike(10000, 10000, rng) for _ in range(4)])
+    preds = target + 0.4 * rng.randn(*target.shape)
+
+    metric = ShortTimeObjectiveIntelligibility(fs=10000)
+    metric.update(jnp.asarray(preds[:2]), jnp.asarray(target[:2]))
+    metric.update(jnp.asarray(preds[2:]), jnp.asarray(target[2:]))
+    streamed = float(metric.compute())
+
+    per_sample = np.asarray(
+        short_time_objective_intelligibility(jnp.asarray(preds), jnp.asarray(target), 10000)
+    )
+    np.testing.assert_allclose(streamed, per_sample.mean(), atol=1e-6 if _X64 else 1e-4)

@@ -143,8 +143,10 @@ def test_process_group_rejected_by_default_gather():
     with pytest.raises(ValueError, match="process_group"):
         comm.gather_all_arrays(jnp.arange(3.0), group="subgroup")
 
-    m = DummyMetricSum(process_group="subgroup")
-    m.update(jnp.asarray(1.0))
-    m._distributed_available_fn = lambda: True
+    # with the default gather the rejection happens already at construction
     with pytest.raises(ValueError, match="process_group"):
-        m.compute()
+        DummyMetricSum(process_group="subgroup")
+
+    # a custom dist_sync_fn may understand subgroups, so this must construct
+    m = DummyMetricSum(process_group="subgroup", dist_sync_fn=lambda x, group: [x])
+    m.update(jnp.asarray(1.0))

@@ -11,6 +11,7 @@ detection mAP ragged sync. The in-worker asserts additionally cover the raw
 comm layer (even + pad/trim uneven gathers).
 """
 import os
+import pathlib
 import socket
 import subprocess
 import sys
@@ -59,13 +60,15 @@ def worker_results(tmp_path_factory):
     except subprocess.TimeoutExpired:
         for q in procs:
             q.kill()
-        logs = "\n".join(open(p, errors="replace").read()[-2000:] for p in log_paths)
+        logs = "\n".join(
+            pathlib.Path(p).read_text(errors="replace")[-2000:] for p in log_paths
+        )
         pytest.fail(f"multi-process workers timed out (possible hung collective):\n{logs}")
     finally:
         for f in log_files:
             f.close()
     for rank, p in enumerate(procs):
-        log = open(log_paths[rank], errors="replace").read()
+        log = pathlib.Path(log_paths[rank]).read_text(errors="replace")
         assert p.returncode == 0, f"rank {rank} failed:\n{log[-4000:]}"
     return [dict(np.load(os.path.join(outdir, f"rank{r}.npz"))) for r in range(WORLD)]
 

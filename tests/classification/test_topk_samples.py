@@ -1,0 +1,363 @@
+"""Depth tests for the highest-branching classification paths: ``top_k``
+selection, ``average="samples"``, and ``mdmc_average in {global, samplewise}``.
+
+Mirrors the parametrization of reference
+``tests/classification/test_precision_recall.py`` / ``test_accuracy.py``
+(top_k and mdmc cases) with sklearn/numpy oracles, run through the full
+``run_class_metric_test`` lifecycle with ddp both ways.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score as sk_fbeta
+from sklearn.metrics import precision_score as sk_precision
+from sklearn.metrics import recall_score as sk_recall
+
+from metrics_tpu import Accuracy, FBetaScore, Precision, Recall, StatScores
+from metrics_tpu.functional import accuracy, precision
+from tests.classification.inputs import (
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+_LABELS = list(range(NUM_CLASSES))
+
+
+# ---------------------------------------------------------------------------
+# top_k oracles: expand preds to a multi-hot top-k matrix and the target to
+# one-hot, then score in sklearn's multilabel regime
+# ---------------------------------------------------------------------------
+def _topk_multihot(probs: np.ndarray, k: int) -> np.ndarray:
+    order = np.argsort(-probs, axis=1)[:, :k]
+    out = np.zeros_like(probs, dtype=int)
+    np.put_along_axis(out, order, 1, axis=1)
+    return out
+
+
+def _onehot(labels: np.ndarray) -> np.ndarray:
+    return np.eye(NUM_CLASSES, dtype=int)[labels]
+
+
+def _sk_topk_accuracy(preds, target, k=1, average="micro"):
+    p = _topk_multihot(np.asarray(preds), k)
+    t = _onehot(np.asarray(target))
+    if average == "micro":
+        return (p * t).sum() / t.sum()
+    # macro: per-class recall-style accuracy, absent classes dropped
+    tp = (p * t).sum(0)
+    fp = (p * (1 - t)).sum(0)
+    fn = ((1 - p) * t).sum(0)
+    present = (tp + fp + fn) > 0
+    score = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1), 0.0)
+    return score[present].mean()
+
+
+def _sk_topk_precision(preds, target, k=1, average="micro"):
+    p = _topk_multihot(np.asarray(preds), k)
+    t = _onehot(np.asarray(target))
+    return sk_precision(t, p, average=average, zero_division=0)
+
+
+def _sk_topk_recall(preds, target, k=1, average="micro"):
+    p = _topk_multihot(np.asarray(preds), k)
+    t = _onehot(np.asarray(target))
+    return sk_recall(t, p, average=average, zero_division=0)
+
+
+def _sk_topk_fbeta(preds, target, k=1, average="micro", beta=0.5):
+    p = _topk_multihot(np.asarray(preds), k)
+    t = _onehot(np.asarray(target))
+    return sk_fbeta(t, p, beta=beta, average=average, zero_division=0)
+
+
+@pytest.mark.parametrize("top_k", [1, 2, 3])
+@pytest.mark.parametrize("average", ["micro", "macro"])
+@pytest.mark.parametrize("ddp", [False, True])
+class TestTopK(MetricTester):
+    """top_k over multiclass probability inputs (the only case allowing it)."""
+
+    def test_accuracy_top_k(self, ddp, top_k, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=Accuracy,
+            sk_metric=partial(_sk_topk_accuracy, k=top_k, average=average),
+            metric_args={"num_classes": NUM_CLASSES, "top_k": top_k, "average": average},
+        )
+
+    def test_precision_top_k(self, ddp, top_k, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=Precision,
+            sk_metric=partial(_sk_topk_precision, k=top_k, average=average),
+            metric_args={"num_classes": NUM_CLASSES, "top_k": top_k, "average": average},
+        )
+
+    def test_recall_top_k(self, ddp, top_k, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=Recall,
+            sk_metric=partial(_sk_topk_recall, k=top_k, average=average),
+            metric_args={"num_classes": NUM_CLASSES, "top_k": top_k, "average": average},
+        )
+
+    def test_fbeta_top_k(self, ddp, top_k, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=FBetaScore,
+            sk_metric=partial(_sk_topk_fbeta, k=top_k, average=average, beta=0.5),
+            metric_args={"num_classes": NUM_CLASSES, "top_k": top_k, "average": average, "beta": 0.5},
+        )
+
+
+def test_functional_top_k_matches_class():
+    p, t = _input_multiclass_prob.preds[0], _input_multiclass_prob.target[0]
+    for k in (1, 2, 3):
+        np.testing.assert_allclose(
+            np.asarray(accuracy(p, t, top_k=k)),
+            _sk_topk_accuracy(p, t, k=k),
+            atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(precision(p, t, top_k=k, num_classes=NUM_CLASSES)),
+            _sk_topk_precision(p, t, k=k),
+            atol=1e-8,
+        )
+
+
+# ---------------------------------------------------------------------------
+# average="samples" over multilabel inputs
+# ---------------------------------------------------------------------------
+def _binarize(preds: np.ndarray) -> np.ndarray:
+    preds = np.asarray(preds)
+    if np.issubdtype(preds.dtype, np.floating):
+        return (preds >= THRESHOLD).astype(int)
+    return preds
+
+
+def _sk_samples_precision(preds, target):
+    return sk_precision(np.asarray(target), _binarize(preds), average="samples", zero_division=0)
+
+
+def _sk_samples_recall(preds, target):
+    return sk_recall(np.asarray(target), _binarize(preds), average="samples", zero_division=0)
+
+
+def _sk_samples_fbeta(preds, target, beta=2.0):
+    return sk_fbeta(np.asarray(target), _binarize(preds), beta=beta, average="samples", zero_division=0)
+
+
+def _sk_samples_accuracy(preds, target):
+    # multilabel per-sample accuracy: (tp+tn)/(all), then sample mean
+    p, t = _binarize(preds), np.asarray(target)
+    return (p == t).mean(axis=1).mean()
+
+
+def _sk_samples_stat_scores(preds, target):
+    p, t = _binarize(preds), np.asarray(target)
+    tp = ((p == 1) & (t == 1)).sum(1)
+    fp = ((p == 1) & (t == 0)).sum(1)
+    tn = ((p == 0) & (t == 0)).sum(1)
+    fn = ((p == 0) & (t == 1)).sum(1)
+    return np.stack([tp, fp, tn, fn, tp + fn], axis=1)
+
+
+# integer (N, C) inputs are inferred as 2-class multi-dim multi-class (same
+# inference as the reference, checks.py case table); `multiclass=False` folds
+# them back to the multilabel reading the oracle uses
+_SAMPLES_CASES = [
+    (_input_multilabel_prob.preds, _input_multilabel_prob.target, {}),
+    (_input_multilabel.preds, _input_multilabel.target, {"multiclass": False}),
+]
+
+
+@pytest.mark.parametrize("preds, target, extra", _SAMPLES_CASES)
+@pytest.mark.parametrize("ddp", [False, True])
+class TestSamplesAverage(MetricTester):
+    def test_precision_samples(self, ddp, preds, target, extra):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Precision,
+            sk_metric=_sk_samples_precision,
+            metric_args={"num_classes": NUM_CLASSES, "average": "samples", "threshold": THRESHOLD, **extra},
+        )
+
+    def test_recall_samples(self, ddp, preds, target, extra):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Recall,
+            sk_metric=_sk_samples_recall,
+            metric_args={"num_classes": NUM_CLASSES, "average": "samples", "threshold": THRESHOLD, **extra},
+        )
+
+    def test_fbeta_samples(self, ddp, preds, target, extra):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=FBetaScore,
+            sk_metric=partial(_sk_samples_fbeta, beta=2.0),
+            metric_args={
+                "num_classes": NUM_CLASSES, "average": "samples", "beta": 2.0, "threshold": THRESHOLD, **extra,
+            },
+        )
+
+    def test_accuracy_samples(self, ddp, preds, target, extra):
+        if extra:
+            # int multilabel folded via multiclass=False keeps the MDMC mode
+            # flag, which routes accuracy to tp/(tp+fn) — reference does the
+            # same; the (tp+tn)/all oracle below only applies to true
+            # multilabel (float) inputs
+            pytest.skip("accuracy multilabel-samples semantics require probability inputs")
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=_sk_samples_accuracy,
+            metric_args={"num_classes": NUM_CLASSES, "average": "samples", "threshold": THRESHOLD},
+        )
+
+    def test_stat_scores_samples(self, ddp, preds, target, extra):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=StatScores,
+            sk_metric=_sk_samples_stat_scores,
+            metric_args={"num_classes": NUM_CLASSES, "reduce": "samples", "threshold": THRESHOLD, **extra},
+        )
+
+
+# ---------------------------------------------------------------------------
+# mdmc_average in {global, samplewise} over (N, C, X) / (N, X) inputs
+# ---------------------------------------------------------------------------
+def _to_labels(preds: np.ndarray) -> np.ndarray:
+    preds = np.asarray(preds)
+    if preds.ndim == 3:  # [B, C, X] probabilities
+        return preds.argmax(axis=1)
+    return preds
+
+
+def _sk_mdmc(preds, target, per_slice_fn, mdmc_average):
+    p, t = _to_labels(preds), np.asarray(target)
+    if mdmc_average == "global":
+        return per_slice_fn(t.reshape(-1), p.reshape(-1))
+    return np.mean([per_slice_fn(ti, pi) for pi, ti in zip(p, t)])
+
+
+def _slice_accuracy_micro(t, p):
+    return (t == p).mean()
+
+
+def _slice_accuracy_macro(t, p, drop_absent):
+    scores = []
+    for c in _LABELS:
+        tp = ((p == c) & (t == c)).sum()
+        fp = ((p == c) & (t != c)).sum()
+        fn = ((p != c) & (t == c)).sum()
+        if drop_absent and tp + fp + fn == 0:
+            continue
+        scores.append(tp / (tp + fn) if tp + fn > 0 else 0.0)
+    return np.mean(scores)
+
+
+def _sk_mdmc_accuracy(preds, target, average="micro", mdmc_average="global"):
+    if average == "micro":
+        fn = _slice_accuracy_micro
+    else:
+        # global drops entirely-absent classes; samplewise keeps them at 0
+        fn = partial(_slice_accuracy_macro, drop_absent=(mdmc_average == "global"))
+    return _sk_mdmc(preds, target, fn, mdmc_average)
+
+
+def _sk_mdmc_precision(preds, target, average="micro", mdmc_average="global"):
+    fn = partial(_sk_wrap, sk=sk_precision, average=average)
+    return _sk_mdmc(preds, target, fn, mdmc_average)
+
+
+def _sk_mdmc_recall(preds, target, average="micro", mdmc_average="global"):
+    fn = partial(_sk_wrap, sk=sk_recall, average=average)
+    return _sk_mdmc(preds, target, fn, mdmc_average)
+
+
+def _sk_mdmc_fbeta(preds, target, average="micro", mdmc_average="global", beta=0.5):
+    fn = partial(_sk_wrap, sk=partial(sk_fbeta, beta=beta), average=average)
+    return _sk_mdmc(preds, target, fn, mdmc_average)
+
+
+def _sk_wrap(t, p, sk, average):
+    return sk(t, p, average=average, labels=_LABELS, zero_division=0)
+
+
+_MDMC_CASES = [
+    (_input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target),
+    (_input_multidim_multiclass.preds, _input_multidim_multiclass.target),
+]
+
+
+@pytest.mark.parametrize("preds, target", _MDMC_CASES)
+@pytest.mark.parametrize("average", ["micro", "macro"])
+@pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
+@pytest.mark.parametrize("ddp", [False, True])
+class TestMDMCAverage(MetricTester):
+    def test_accuracy_mdmc(self, ddp, preds, target, average, mdmc_average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=partial(_sk_mdmc_accuracy, average=average, mdmc_average=mdmc_average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average, "mdmc_average": mdmc_average},
+        )
+
+    def test_precision_mdmc(self, ddp, preds, target, average, mdmc_average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Precision,
+            sk_metric=partial(_sk_mdmc_precision, average=average, mdmc_average=mdmc_average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average, "mdmc_average": mdmc_average},
+        )
+
+    def test_recall_mdmc(self, ddp, preds, target, average, mdmc_average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Recall,
+            sk_metric=partial(_sk_mdmc_recall, average=average, mdmc_average=mdmc_average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average, "mdmc_average": mdmc_average},
+        )
+
+    def test_fbeta_mdmc(self, ddp, preds, target, average, mdmc_average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=FBetaScore,
+            sk_metric=partial(_sk_mdmc_fbeta, average=average, mdmc_average=mdmc_average, beta=0.5),
+            metric_args={
+                "num_classes": NUM_CLASSES,
+                "average": average,
+                "mdmc_average": mdmc_average,
+                "beta": 0.5,
+            },
+        )

@@ -1,0 +1,155 @@
+"""NumPy STOI oracle for value-testing the native JAX implementation.
+
+A faithful host-side implementation of the published STOI / ESTOI algorithms
+(Taal et al., "An Algorithm for Intelligibility Prediction of Time-Frequency
+Weighted Noisy Speech", 2011; Jensen & Taal, "An Algorithm for Predicting the
+Intelligibility of Speech Masked by Modulated Noise Maskers", 2016), following
+the de-facto reference implementation (the ``pystoi`` wheel the reference
+gates on, ``torchmetrics/functional/audio/stoi.py``) so values line up with
+the reference's CI oracle: Octave-style polyphase resampling, 40 dB
+silent-frame removal, 512-point STFT at 10 kHz, 15 one-third octave bands,
+384 ms segments, -15 dB clipped correlation.
+
+Deviations from pystoi: the random-epsilon dithering in ESTOI's
+row/column normalization is replaced with a deterministic epsilon on the
+norms (pystoi adds ``EPS * randn`` purely to avoid 0/0; values agree to ~1e-9
+on non-degenerate audio).
+"""
+import numpy as np
+from scipy.signal import resample_poly
+
+FS = 10000
+N_FRAME = 256
+NFFT = 512
+NUMBAND = 15
+MINFREQ = 150
+N_SEG = 30
+BETA = -15.0
+DYN_RANGE = 40
+EPS = np.finfo(np.float64).eps
+
+
+def resample_filter(up: int, down: int) -> np.ndarray:
+    """Octave-compatible Kaiser-windowed sinc anti-aliasing filter (the
+    design pystoi ports from Octave's ``resample``)."""
+    g = np.gcd(up, down)
+    up, down = up // g, down // g
+    log10_rejection = -3.0
+    stopband_cutoff_f = 1.0 / (2 * max(up, down))
+    roll_off_width = stopband_cutoff_f / 10
+    rejection_db = -20 * log10_rejection
+    half_len = int(np.ceil(rejection_db / (22 * roll_off_width)))
+    t = np.arange(-half_len, half_len + 1)
+    ideal = 2 * up * stopband_cutoff_f * np.sinc(2 * stopband_cutoff_f * t)
+    if 21 <= rejection_db <= 50:
+        beta = 0.5842 * (rejection_db - 21) ** 0.4 + 0.07886 * (rejection_db - 21)
+    elif rejection_db > 50:
+        beta = 0.1102 * (rejection_db - 8.7)
+    else:
+        beta = 0.0
+    h = np.kaiser(2 * half_len + 1, beta) * ideal
+    return h
+
+
+def resample_oct(x: np.ndarray, up: int, down: int) -> np.ndarray:
+    h = resample_filter(up, down)
+    return resample_poly(x, up, down, window=h / np.sum(h))
+
+
+def thirdoct(fs: int, nfft: int, num_bands: int, min_freq: float):
+    """One-third octave band matrix [num_bands, nfft//2+1]."""
+    f = np.linspace(0, fs, nfft + 1)[: nfft // 2 + 1]
+    k = np.arange(num_bands, dtype=float)
+    cf = 2.0 ** (k / 3.0) * min_freq
+    freq_low = min_freq * 2.0 ** ((2 * k - 1) / 6)
+    freq_high = min_freq * 2.0 ** ((2 * k + 1) / 6)
+    obm = np.zeros((num_bands, len(f)))
+    for i in range(num_bands):
+        lo = int(np.argmin(np.square(f - freq_low[i])))
+        hi = int(np.argmin(np.square(f - freq_high[i])))
+        obm[i, lo:hi] = 1
+    return obm, cf
+
+
+def _frames(x: np.ndarray, framelen: int, hop: int, last_inclusive: bool) -> np.ndarray:
+    end = len(x) - framelen + 1 if last_inclusive else len(x) - framelen
+    starts = range(0, max(end, 0), hop)
+    return np.array([x[i : i + framelen] for i in starts])
+
+
+def remove_silent_frames(x, y, dyn_range=DYN_RANGE, framelen=N_FRAME, hop=N_FRAME // 2):
+    w = np.hanning(framelen + 2)[1:-1]
+    x_frames = _frames(x, framelen, hop, last_inclusive=True) * w
+    y_frames = _frames(y, framelen, hop, last_inclusive=True) * w
+    energies = 20 * np.log10(np.linalg.norm(x_frames, axis=1) + EPS)
+    mask = (np.max(energies) - dyn_range - energies) < 0
+    x_frames, y_frames = x_frames[mask], y_frames[mask]
+    if len(x_frames) == 0:
+        return np.zeros(0), np.zeros(0)
+    n_sil = (len(x_frames) - 1) * hop + framelen
+    x_sil, y_sil = np.zeros(n_sil), np.zeros(n_sil)
+    for i in range(len(x_frames)):
+        x_sil[i * hop : i * hop + framelen] += x_frames[i]
+        y_sil[i * hop : i * hop + framelen] += y_frames[i]
+    return x_sil, y_sil
+
+
+def _stft(x: np.ndarray) -> np.ndarray:
+    """[n_frames, nfft//2+1] complex spectrogram, hop = N_FRAME/2. Mirrors the
+    pystoi framing convention (last frame start strictly below len-framelen)."""
+    w = np.hanning(N_FRAME + 2)[1:-1]
+    frames = _frames(x, N_FRAME, N_FRAME // 2, last_inclusive=False)
+    if len(frames) == 0:
+        return np.zeros((0, NFFT // 2 + 1), dtype=complex)
+    return np.fft.rfft(frames * w, n=NFFT)
+
+
+def stoi_oracle(x: np.ndarray, y: np.ndarray, fs_sig: int, extended: bool = False) -> float:
+    """STOI(clean=x, processed=y)."""
+    x, y = np.asarray(x, float), np.asarray(y, float)
+    if x.shape != y.shape:
+        raise ValueError("x and y must have the same length")
+    if fs_sig != FS:
+        x = resample_oct(x, FS, fs_sig)
+        y = resample_oct(y, FS, fs_sig)
+    x, y = remove_silent_frames(x, y)
+    x_spec = _stft(x).T  # [F, T]
+    y_spec = _stft(y).T
+    if x_spec.shape[1] < N_SEG:
+        return 1e-5  # not enough frames: pystoi warns and returns 1e-5
+
+    obm, _ = thirdoct(FS, NFFT, NUMBAND, MINFREQ)
+    x_tob = np.sqrt(obm @ np.abs(x_spec) ** 2)  # [J, T]
+    y_tob = np.sqrt(obm @ np.abs(y_spec) ** 2)
+
+    n_seg = x_tob.shape[1] - N_SEG + 1
+    x_segs = np.array([x_tob[:, m : m + N_SEG] for m in range(n_seg)])  # [M, J, N]
+    y_segs = np.array([y_tob[:, m : m + N_SEG] for m in range(n_seg)])
+
+    if extended:
+        x_n = _row_col_normalize(x_segs)
+        y_n = _row_col_normalize(y_segs)
+        return float(np.sum(x_n * y_n / N_SEG) / x_n.shape[0])
+
+    norm_const = np.linalg.norm(x_segs, axis=2, keepdims=True) / (
+        np.linalg.norm(y_segs, axis=2, keepdims=True) + EPS
+    )
+    y_norm = y_segs * norm_const
+    clip_value = 10 ** (-BETA / 20)
+    y_prime = np.minimum(y_norm, x_segs * (1 + clip_value))
+
+    y_prime = y_prime - np.mean(y_prime, axis=2, keepdims=True)
+    x_segs = x_segs - np.mean(x_segs, axis=2, keepdims=True)
+    y_prime = y_prime / (np.linalg.norm(y_prime, axis=2, keepdims=True) + EPS)
+    x_segs = x_segs / (np.linalg.norm(x_segs, axis=2, keepdims=True) + EPS)
+
+    return float(np.sum(x_segs * y_prime) / (x_segs.shape[0] * x_segs.shape[1]))
+
+
+def _row_col_normalize(x: np.ndarray) -> np.ndarray:
+    """ESTOI row-then-column mean/norm normalization (deterministic EPS)."""
+    x = x - np.mean(x, axis=-1, keepdims=True)
+    x = x / (np.linalg.norm(x, axis=-1, keepdims=True) + EPS)
+    x = x - np.mean(x, axis=1, keepdims=True)
+    x = x / (np.linalg.norm(x, axis=1, keepdims=True) + EPS)
+    return x

@@ -29,8 +29,16 @@ NUM_CLASSES = 5
 EXTRA_DIM = 3
 THRESHOLD = 0.5
 
+# x32 lane (METRICS_TPU_TEST_X32=1, see tests/conftest.py): oracles stay f64
+# numpy/sklearn while our kernels run in float32, so comparisons get a
+# tolerance floor instead of the f64-lane defaults.
+X32_LANE = not jax.config.jax_enable_x64
+_ATOL_FLOOR = 1e-5 if X32_LANE else 0.0
+_RTOL_FLOOR = 1e-4 if X32_LANE else 0.0
+
 
 def _assert_allclose(res1: Any, res2: Any, atol: float = 1e-8, key: Optional[str] = None, rtol: float = 1e-5) -> None:
+    atol, rtol = max(atol, _ATOL_FLOOR), max(rtol, _RTOL_FLOOR)
     if isinstance(res1, dict):
         if key is not None:
             res1 = res1[key]
