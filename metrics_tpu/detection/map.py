@@ -193,163 +193,200 @@ class MeanAveragePrecision(Metric):
             )
         return []
 
-    def _prepare_image_class(self, img_id: int, class_id: int, max_det: int) -> Optional[Dict[str, np.ndarray]]:
-        """Area-range-independent work for one (image, class) pair: class
-        filtering, score sort, IoU matrix, gt areas. Computed ONCE and reused
-        across the four area ranges (the reference recomputes the IoU per
-        range via its ``ious`` dict only partially; pycocotools hoists it)."""
-        gt_mask = self.groundtruth_labels[img_id] == class_id
-        det_mask = self.detection_labels[img_id] == class_id
-        if len(gt_mask) == 0 and len(det_mask) == 0:
-            return None
-        gt = self.groundtruth_boxes[img_id][gt_mask]
-        det = self.detection_boxes[img_id][det_mask]
-        if len(gt) == 0 and len(det) == 0:
-            return None
-        scores = self.detection_scores[img_id][det_mask]
-        dtind = np.argsort(-scores, kind="stable")[:max_det]
-        det = det[dtind]
-        scores_sorted = scores[dtind]
-        return {
-            "gt": gt,
-            "det": det,
-            "scores": scores_sorted,
-            "ious": _np_box_iou(det, gt) if len(det) and len(gt) else np.zeros((len(det), len(gt))),
-            "gt_areas": _np_box_area(gt) if len(gt) else np.zeros((0,)),
-            "det_areas": _np_box_area(det) if len(det) else np.zeros((0,)),
-        }
+    def _calculate_class(
+        self,
+        prec_out: np.ndarray,
+        rec_out: np.ndarray,
+        d_boxes: np.ndarray,
+        d_scores: np.ndarray,
+        d_img: np.ndarray,
+        g_boxes: np.ndarray,
+        g_img: np.ndarray,
+    ) -> None:
+        """All precision/recall cells of ONE class, as a single padded numpy
+        program (the batched form of reference ``map.py:379-490`` + ``:620-686``).
 
-    def _evaluate_image(
-        self, cache: Optional[Dict[str, np.ndarray]], area_range: Tuple[float, float]
-    ) -> Optional[Dict[str, np.ndarray]]:
-        """Greedy COCO matching for one prepared (image, class) pair at every
-        IoU threshold (reference ``map.py:379-454``)."""
-        if cache is None:
-            return None
-        gt, det = cache["gt"], cache["det"]
-        scores_sorted = cache["scores"]
+        Every image holding this class becomes one row of padded
+        ``[pairs, dets]`` / ``[pairs, gts]`` tensors; the greedy COCO matching
+        then runs vectorized over (pairs, area ranges, IoU thresholds) at
+        once — only the per-detection scan, which is order-dependent by
+        definition (each detection consumes a ground-truth), remains a loop,
+        bounded by ``max_detection_thresholds[-1]`` iterations regardless of
+        how many images are in the batch. ``prec_out [T,R,A,M]`` and
+        ``rec_out [T,A,M]`` are filled in place.
+        """
+        n_thr = len(self.iou_thresholds)
+        rec_thrs = np.asarray(self.rec_thresholds, np.float64)
+        area_values = np.asarray(list(_AREA_RANGES.values()), np.float64)  # [A, 2]
+        n_area = area_values.shape[0]
+        max_det_overall = self.max_detection_thresholds[-1]
 
-        gt_ignore_area = (cache["gt_areas"] < area_range[0]) | (cache["gt_areas"] > area_range[1])
-        # gts sorted ignore-last (stable); IoU columns reindexed to match
-        gtind = np.argsort(gt_ignore_area, kind="stable")
-        gt = gt[gtind]
-        gt_ignore = gt_ignore_area[gtind]
-        ious = cache["ious"][:, gtind]
+        pair_imgs = np.union1d(np.unique(d_img), np.unique(g_img))
+        n_pair = len(pair_imgs)
+        if n_pair == 0:
+            return
+        d_pair = np.searchsorted(pair_imgs, d_img)
+        g_pair = np.searchsorted(pair_imgs, g_img)
 
-        nb_iou_thrs = len(self.iou_thresholds)
-        nb_gt, nb_det = len(gt), len(det)
-        gt_matches = np.zeros((nb_iou_thrs, nb_gt), dtype=bool)
-        det_matches = np.zeros((nb_iou_thrs, nb_det), dtype=bool)
-        det_ignore = np.zeros((nb_iou_thrs, nb_det), dtype=bool)
+        # score-descending stable order within each pair, computed in one pass
+        order = np.lexsort((-d_scores, d_pair))
+        d_pair, d_boxes, d_scores = d_pair[order], d_boxes[order], d_scores[order]
 
-        # Greedy matching, vectorized across all IoU thresholds at once: only
-        # the detection loop is inherently sequential (each det consumes a gt).
-        # Per det the scan picks the highest-IoU *unmatched* gt with
-        # iou >= thr, ties to the highest gt index, preferring real gts over
-        # ignore gts (the scan-order semantics of the reference triple loop,
-        # ``map.py:456-490``, and of pycocotools).
-        if nb_gt and nb_det:
+        def ragged_to_padded(pair_ids: np.ndarray, cap: Optional[int]) -> Tuple[np.ndarray, np.ndarray, int]:
+            """Position of each element within its pair + keep mask + pad width."""
+            counts = np.bincount(pair_ids, minlength=n_pair)
+            width = int(counts.max()) if counts.size else 0
+            if cap is not None:
+                width = min(width, cap)
+            offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+            pos = np.arange(len(pair_ids)) - offsets[pair_ids]
+            return pos, pos < width, width
+
+        d_pos, d_keep, n_det = ragged_to_padded(d_pair, max_det_overall)
+        g_pos, g_keep, n_gt = ragged_to_padded(g_pair, None)
+
+        valid_d = np.zeros((n_pair, n_det), bool)
+        valid_d[d_pair[d_keep], d_pos[d_keep]] = True
+        valid_g = np.zeros((n_pair, n_gt), bool)
+        valid_g[g_pair[g_keep], g_pos[g_keep]] = True
+        boxes_d = np.zeros((n_pair, n_det, 4))
+        boxes_d[d_pair[d_keep], d_pos[d_keep]] = d_boxes[d_keep]
+        scores_d = np.zeros((n_pair, n_det))
+        scores_d[d_pair[d_keep], d_pos[d_keep]] = d_scores[d_keep]
+        boxes_g = np.zeros((n_pair, n_gt, 4))
+        boxes_g[g_pair[g_keep], g_pos[g_keep]] = g_boxes[g_keep]
+        areas_d = _np_box_area(boxes_d.reshape(-1, 4)).reshape(n_pair, n_det)
+        areas_g = _np_box_area(boxes_g.reshape(-1, 4)).reshape(n_pair, n_gt)
+
+        # batched IoU [P, D, G]
+        if n_det and n_gt:
+            lt = np.maximum(boxes_d[:, :, None, :2], boxes_g[:, None, :, :2])
+            rb = np.minimum(boxes_d[:, :, None, 2:], boxes_g[:, None, :, 2:])
+            wh = np.clip(rb - lt, 0, None)
+            inter = wh[..., 0] * wh[..., 1]
+            union = areas_d[:, :, None] + areas_g[:, None, :] - inter
+            ious = inter / np.where(union > 0, union, 1.0)
+
+        lo = area_values[:, 0][None, :, None]
+        hi = area_values[:, 1][None, :, None]
+        # [P, A, G]; padded gt slots are permanently ignored
+        gt_ig = (areas_g[:, None, :] < lo) | (areas_g[:, None, :] > hi) | ~valid_g[:, None, :]
+
+        # Greedy matching, vectorized over (pair, area, threshold): each
+        # detection takes the highest-IoU still-unmatched gt with iou >= thr,
+        # preferring non-ignored gts, ties to the highest gt index (the
+        # scan-order semantics of the reference loop ``map.py:456-490`` and of
+        # pycocotools; the reference's ignore-last gt sort is equivalent to
+        # the two-group preference used here).
+        gt_matched = np.zeros((n_pair, n_area, n_thr, n_gt), bool)
+        det_match = np.zeros((n_pair, n_area, n_thr, n_det), bool)
+        det_ign = np.zeros((n_pair, n_area, n_thr, n_det), bool)
+        if n_det and n_gt:
             thr_eff = np.minimum(np.asarray(self.iou_thresholds, np.float64), 1 - 1e-10)
-            iou_t = ious  # [D, G]
-            is_ignore = gt_ignore[None, :]  # [1, G]
-            rev = slice(None, None, -1)
-            for idx_det in range(nb_det):
-                iou_row = iou_t[idx_det]  # [G]
-                cand = (iou_row[None, :] >= thr_eff[:, None]) & ~gt_matches  # [T, G]
-
-                def _pick(mask: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-                    has = mask.any(axis=1)
-                    vals = np.where(mask, iou_row[None, :], -np.inf)
-                    best = vals.max(axis=1)
-                    # ties go to the LAST gt index (scan keeps updating on ==)
-                    m = nb_gt - 1 - np.argmax(vals[:, rev] == best[:, None], axis=1)
-                    return has, m
-
-                has_real, m_real = _pick(cand & ~is_ignore)
-                has_ign, m_ign = _pick(cand & is_ignore)
-                m = np.where(has_real, m_real, np.where(has_ign, m_ign, 0))
-                matched = has_real | has_ign
-                det_matches[:, idx_det] = matched
-                det_ignore[:, idx_det] = matched & gt_ignore[m]
-                rows = np.nonzero(matched)[0]
-                gt_matches[rows, m[rows]] = True
+            thr_b = thr_eff[None, None, :, None]  # [1,1,T,1]
+            ig_b = gt_ig[:, :, None, :]  # [P,A,1,G]
+            gt_ig_bcast = np.broadcast_to(ig_b, gt_matched.shape)
+            gm_flat = gt_matched.reshape(-1, n_gt)  # view: writes land in gt_matched
+            for d in range(n_det):
+                iou_d = ious[:, d, :][:, None, None, :]  # [P,1,1,G]
+                cand = (iou_d >= thr_b) & ~gt_matched
+                cand &= valid_d[:, d][:, None, None, None] & valid_g[:, None, None, :]
+                has_any = np.zeros((n_pair, n_area, n_thr), bool)
+                m_idx = np.zeros((n_pair, n_area, n_thr), np.int64)
+                for group in (cand & ~ig_b, cand & ig_b):
+                    has = group.any(-1)
+                    vals = np.where(group, iou_d, -np.inf)
+                    best = vals.max(-1)
+                    # ties go to the LAST gt index (the scan updates on ==)
+                    idx = n_gt - 1 - np.argmax(vals[..., ::-1] == best[..., None], axis=-1)
+                    m_idx = np.where(has & ~has_any, idx, m_idx)
+                    has_any |= has
+                det_match[:, :, :, d] = has_any
+                det_ign[:, :, :, d] = has_any & np.take_along_axis(
+                    gt_ig_bcast, m_idx[..., None], axis=-1
+                )[..., 0]
+                rows = np.nonzero(has_any.reshape(-1))[0]
+                gm_flat[rows, m_idx.reshape(-1)[rows]] = True
 
         # unmatched detections outside the area range are ignored
-        det_areas = cache["det_areas"]
-        det_out_of_range = (det_areas < area_range[0]) | (det_areas > area_range[1])
-        det_ignore |= (~det_matches) & det_out_of_range[None, :]
+        d_out = (areas_d[:, None, :] < lo) | (areas_d[:, None, :] > hi)  # [P, A, D]
+        det_ign |= (~det_match) & d_out[:, :, None, :]
 
-        return {
-            "dtMatches": det_matches,
-            "dtScores": scores_sorted,
-            "gtIgnore": gt_ignore,
-            "dtIgnore": det_ignore,
-        }
+        # ---- accumulation (batched form of reference ``map.py:620-686``) ----
+        # flatten back to (image-ascending, score-descending) order, the exact
+        # concatenation order of the reference, then one global mergesort
+        flat_valid = valid_d.reshape(-1)
+        sel = np.nonzero(flat_valid)[0]
+        glob_order = np.argsort(-scores_d.reshape(-1)[sel], kind="mergesort")
+        sel = sel[glob_order]
+        pos_sorted = (sel % n_det) if n_det else sel
+        match_flat = det_match.transpose(1, 2, 0, 3).reshape(n_area, n_thr, -1)[:, :, sel]
+        ign_flat = det_ign.transpose(1, 2, 0, 3).reshape(n_area, n_thr, -1)[:, :, sel]
+        npig_per_area = (~gt_ig).sum(axis=(0, 2))  # [A]
 
-    def _accumulate(
-        self, eval_imgs: List[Optional[Dict[str, np.ndarray]]], max_det: int
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        """Precision/recall curves for one (class, area, max_det) cell —
-        vectorized form of reference ``map.py:620-686``.
-
-        Returns ``precision [T, R]`` and ``recall [T]`` (-1 where undefined).
-        """
-        nb_iou_thrs = len(self.iou_thresholds)
-        nb_rec_thrs = len(self.rec_thresholds)
-        precision = -np.ones((nb_iou_thrs, nb_rec_thrs))
-        recall = -np.ones((nb_iou_thrs,))
-
-        evals = [e for e in eval_imgs if e is not None]
-        if not evals:
-            return precision, recall
-        det_scores = np.concatenate([e["dtScores"][:max_det] for e in evals])
-        inds = np.argsort(-det_scores, kind="mergesort")  # matlab-consistent (reference ``map.py:647``)
-        det_matches = np.concatenate([e["dtMatches"][:, :max_det] for e in evals], axis=1)[:, inds]
-        det_ignore = np.concatenate([e["dtIgnore"][:, :max_det] for e in evals], axis=1)[:, inds]
-        gt_ignore = np.concatenate([e["gtIgnore"] for e in evals])
-        npig = np.count_nonzero(~gt_ignore)
-        if npig == 0:
-            return precision, recall
-
-        tps = det_matches & ~det_ignore
-        fps = ~det_matches & ~det_ignore
-        tp_sum = np.cumsum(tps, axis=1, dtype=np.float64)
-        fp_sum = np.cumsum(fps, axis=1, dtype=np.float64)
-        nd = tp_sum.shape[1]
-        rc = tp_sum / npig
-        pr = tp_sum / (fp_sum + tp_sum + np.finfo(np.float64).eps)
-
-        recall[:] = rc[:, -1] if nd else 0.0
-        # monotone (zigzag-free) precision envelope, all thresholds at once
-        pr_env = np.maximum.accumulate(pr[:, ::-1], axis=1)[:, ::-1]
-        # precision at each recall threshold (searchsorted per iou threshold)
-        for t in range(nb_iou_thrs):
-            idx = np.searchsorted(rc[t], self.rec_thresholds, side="left")
-            valid = idx < nd
-            prec_t = np.zeros((nb_rec_thrs,))
-            prec_t[valid] = pr_env[t, idx[valid]]
-            precision[t] = prec_t
-        return precision, recall
+        eps = np.finfo(np.float64).eps
+        for idx_area in range(n_area):
+            npig = int(npig_per_area[idx_area])
+            if npig == 0:
+                continue  # cell stays -1, as in the reference
+            for idx_m, max_det in enumerate(self.max_detection_thresholds):
+                keep = pos_sorted < max_det
+                matches = match_flat[idx_area][:, keep]  # [T, n]
+                ignores = ign_flat[idx_area][:, keep]
+                tp_sum = np.cumsum(matches & ~ignores, axis=1, dtype=np.float64)
+                fp_sum = np.cumsum(~matches & ~ignores, axis=1, dtype=np.float64)
+                nd = tp_sum.shape[1]
+                rc = tp_sum / npig
+                pr = tp_sum / (fp_sum + tp_sum + eps)
+                rec_out[:, idx_area, idx_m] = rc[:, -1] if nd else 0.0
+                # monotone (zigzag-free) precision envelope, all thresholds at once
+                pr_env = np.maximum.accumulate(pr[:, ::-1], axis=1)[:, ::-1]
+                prec = np.zeros((n_thr, len(rec_thrs)))
+                for t in range(n_thr):
+                    idx = np.searchsorted(rc[t], rec_thrs, side="left")
+                    ok = idx < nd
+                    prec[t, ok] = pr_env[t, idx[ok]]
+                prec_out[:, :, idx_area, idx_m] = prec
 
     def _calculate(self, class_ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
         """Full precision [T,R,K,A,M] / recall [T,K,A,M] grids (reference
-        ``map.py:532-618``)."""
+        ``map.py:532-618``), one batched `_calculate_class` program per class
+        instead of the reference's class x image x area Python loop nest."""
         nb_imgs = len(self.groundtruth_boxes)
-        max_det_overall = self.max_detection_thresholds[-1]
-        area_values = list(_AREA_RANGES.values())
-        nb = (len(self.iou_thresholds), len(self.rec_thresholds), len(class_ids), len(area_values),
-              len(self.max_detection_thresholds))
+        nb = (len(self.iou_thresholds), len(self.rec_thresholds), len(class_ids),
+              len(_AREA_RANGES), len(self.max_detection_thresholds))
         precision = -np.ones(nb)
         recall = -np.ones((nb[0], nb[2], nb[3], nb[4]))
+        if nb_imgs == 0 or not class_ids:
+            return precision, recall
+
+        def flat(parts: List[np.ndarray], width: int) -> np.ndarray:
+            if not parts:
+                return np.zeros((0, width) if width else (0,))
+            return np.concatenate([p.reshape(-1, width) if width else p.reshape(-1) for p in parts])
+
+        det_counts = [x.shape[0] for x in self.detection_scores]
+        gt_counts = [x.shape[0] for x in self.groundtruth_labels]
+        det_img = np.repeat(np.arange(len(det_counts)), det_counts)
+        gt_img = np.repeat(np.arange(len(gt_counts)), gt_counts)
+        det_boxes = flat(self.detection_boxes, 4)
+        det_scores = flat(self.detection_scores, 0)
+        det_labels = flat(self.detection_labels, 0).astype(np.int64)
+        gt_boxes = flat(self.groundtruth_boxes, 4)
+        gt_labels = flat(self.groundtruth_labels, 0).astype(np.int64)
 
         for idx_cls, class_id in enumerate(class_ids):
-            caches = [self._prepare_image_class(i, class_id, max_det_overall) for i in range(nb_imgs)]
-            for idx_area, area_range in enumerate(area_values):
-                eval_imgs = [self._evaluate_image(c, area_range) for c in caches]
-                for idx_max_det, max_det in enumerate(self.max_detection_thresholds):
-                    prec, rec = self._accumulate(eval_imgs, max_det)
-                    precision[:, :, idx_cls, idx_area, idx_max_det] = prec
-                    recall[:, idx_cls, idx_area, idx_max_det] = rec
+            dsel = det_labels == class_id
+            gsel = gt_labels == class_id
+            self._calculate_class(
+                precision[:, :, idx_cls],
+                recall[:, idx_cls],
+                det_boxes[dsel],
+                det_scores[dsel],
+                det_img[dsel],
+                gt_boxes[gsel],
+                gt_img[gsel],
+            )
         return precision, recall
 
     def _summarize(
