@@ -1,16 +1,37 @@
-"""Headline benchmark: streaming classification-metric throughput.
+"""BASELINE.md benchmark suite — all five configs + the sync-overhead target.
 
-Workload = BASELINE.md configs 1-2: an ``Accuracy`` + ``ConfusionMatrix`` +
-``F1Score`` collection streaming 10-class logits, the reference's README-level
-hot loop. We measure samples/sec of the jitted update path on the live JAX
-backend (TPU when present) and compare against the reference-style torch
-implementation of the identical update (argmax → bincount confusion matrix →
-stat-scores) running on CPU — the reference's own kernels are pure torch
-tensor programs (SURVEY §2.1), so this is the faithful baseline.
+One JSON line per config, headline LAST (the driver parses the final line):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+1-2. (headline) ``Accuracy``+``ConfusionMatrix``+``F1Score`` collection update
+     throughput, jitted on the live backend, vs the reference-pattern torch-CPU
+     implementation of the identical update (the reference's kernels are pure
+     torch tensor programs, SURVEY §2.1).
+3.   FID: jitted InceptionV3 forward over CIFAR-shaped uint8 images streamed
+     through ``FrechetInceptionDistance.update`` (TF1 resize included), vs the
+     torch mirror of the same network (``tests/image/test_inception_net.py``)
+     on CPU; plus ``compute()`` latency (streaming stats -> host sqrtm).
+4.   BERTScore: bert-base-scale (12x768x12, L=512) Flax encoder with random
+     weights through the own-model contract, update+compute end-to-end, vs the
+     same-shape ``torch.nn.TransformerEncoder`` forward on CPU.
+5.   mAP: 5k synthetic COCO-scale images (80 classes) through
+     ``MeanAveragePrecision``, vs the ACTUAL reference implementation
+     (``/root/reference`` torchmetrics, executed via three faithful shims:
+     ``deprecate``, ``pkg_resources``, ``torchvision.ops`` box primitives),
+     with a same-data parity delta.
++    sync-overhead: 8-virtual-device CPU mesh (subprocess), jitted
+     scan-of-updates epoch with in-trace ``sync_state`` psum at the end vs the
+     identical program without the sync — the BASELINE "<5% overhead" target.
++    ``compute()`` latency of the module-API collection on the live backend.
+
+Sizes auto-shrink off-TPU (override: METRICS_TPU_BENCH_FULL=1 /
+METRICS_TPU_BENCH_SMALL=1) so dev runs stay bounded; each line carries ``n``.
+Config failures emit an ``error`` line — the headline always prints.
 """
 import json
+import os
+import subprocess
+import sys
+import tempfile
 import time
 
 import numpy as np
@@ -25,6 +46,27 @@ _preds = _rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
 _target = _rng.randint(0, NUM_CLASSES, size=(BATCH,)).astype(np.int32)
 
 
+def emit(obj) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.devices()[0].platform != "cpu"
+
+
+def _small() -> bool:
+    if os.environ.get("METRICS_TPU_BENCH_FULL") == "1":
+        return False
+    if os.environ.get("METRICS_TPU_BENCH_SMALL") == "1":
+        return True
+    return not _on_tpu()
+
+
+# ---------------------------------------------------------------------------
+# configs 1-2 (headline): classification collection update throughput
+# ---------------------------------------------------------------------------
 def bench_ours() -> float:
     import jax
     import jax.numpy as jnp
@@ -97,22 +139,580 @@ def bench_reference() -> float:
     return STEPS * BATCH / elapsed
 
 
+# ---------------------------------------------------------------------------
+# config 3: FID — InceptionV3 forward throughput + compute() latency
+# ---------------------------------------------------------------------------
+def bench_fid() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import FrechetInceptionDistance
+    from metrics_tpu.image.networks.inception import InceptionV3Features, random_inception_params
+
+    small = _small()
+    n_images = 1_000 if small else 50_000
+    batch = 125 if small else 250
+
+    extractor = InceptionV3Features(random_inception_params(0), feature="2048")
+    fid = FrechetInceptionDistance(feature=extractor, feature_dim=2048)
+
+    rng = np.random.RandomState(1)
+
+    def batch_imgs():
+        return jnp.asarray(rng.randint(0, 256, size=(batch, 3, 32, 32), dtype=np.uint8))
+
+    # warmup/compile
+    fid.update(batch_imgs(), real=True)
+    fid.update(batch_imgs(), real=False)
+    jax.block_until_ready(fid.real_outer)
+    fid.reset()
+
+    n_batches = n_images // batch
+    start = time.perf_counter()
+    for i in range(n_batches):
+        fid.update(batch_imgs(), real=(i % 2 == 0))
+    jax.block_until_ready((fid.real_outer, fid.fake_outer))
+    elapsed = time.perf_counter() - start
+
+    t0 = time.perf_counter()
+    value = float(fid.compute())
+    compute_ms = (time.perf_counter() - t0) * 1000
+    assert np.isfinite(value)
+
+    # reference-pattern baseline: the torch mirror of the same network, CPU
+    baseline = None
+    try:
+        import torch
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tests.image.test_inception_net import TInceptionFID
+
+        net = TInceptionFID().eval()
+        tb = 8 if small else 25
+        x = torch.randn(tb, 3, 299, 299)
+        with torch.no_grad():
+            net(x)  # warmup
+            t0 = time.perf_counter()
+            reps = 1 if small else 2
+            for _ in range(reps):
+                net(x)
+            baseline = reps * tb / (time.perf_counter() - t0)
+    except Exception:
+        baseline = None
+
+    ours = n_batches * batch / elapsed
+    return {
+        "metric": "fid_inception_update_throughput",
+        "value": round(ours, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(ours / baseline, 3) if baseline else None,
+        "n": n_batches * batch,
+        "compute_ms": round(compute_ms, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# config 4: BERTScore — bert-base-scale encoder, own-model contract
+# ---------------------------------------------------------------------------
+_BERT_LAYERS, _BERT_DIM, _BERT_HEADS, _BERT_FFN = 12, 768, 12, 3072
+_BERT_VOCAB, _BERT_LEN = 30522, 512
+
+_WORDS = [f"w{i}" for i in range(4096)]
+
+
+def _synth_sentences(rng: np.random.RandomState, n: int, length: int) -> list:
+    return [" ".join(_WORDS[j] for j in rng.randint(0, len(_WORDS), length)) for i in range(n)]
+
+
+def _hash_tokenizer(text, max_length):
+    import zlib
+
+    ids = np.zeros((len(text), max_length), dtype=np.int64)
+    mask = np.zeros_like(ids)
+    for i, sentence in enumerate(text):
+        tokens = [101] + [
+            zlib.crc32(w.encode()) % (_BERT_VOCAB - 1000) + 999 for w in sentence.split()
+        ]
+        tokens = tokens[: max_length - 1] + [102]
+        ids[i, : len(tokens)] = tokens
+        mask[i, : len(tokens)] = 1
+    return {"input_ids": ids, "attention_mask": mask}
+
+
+def bench_bertscore() -> dict:
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import BERTScore
+
+    small = _small()
+    n_pairs = 16 if small else 512
+    batch_size = 8 if small else 64
+
+    class BertEncoder(nn.Module):
+        @nn.compact
+        def __call__(self, ids, mask):
+            x = nn.Embed(_BERT_VOCAB, _BERT_DIM)(ids)
+            x = x + nn.Embed(_BERT_LEN, _BERT_DIM)(jnp.arange(ids.shape[1])[None, :])
+            x = nn.LayerNorm()(x)
+            attn_mask = mask[:, None, None, :].astype(bool)
+            for _ in range(_BERT_LAYERS):
+                a = nn.SelfAttention(num_heads=_BERT_HEADS)(x, mask=attn_mask)
+                x = nn.LayerNorm()(x + a)
+                h = nn.Dense(_BERT_FFN)(x)
+                h = nn.gelu(h)
+                h = nn.Dense(_BERT_DIM)(h)
+                x = nn.LayerNorm()(x + h)
+            return x
+
+    encoder = BertEncoder()
+    ones = jnp.ones((1, _BERT_LEN), jnp.int32)
+    params = jax.eval_shape(encoder.init, jax.random.PRNGKey(0), ones, ones)
+    # materialize random-normal params without a full init pass
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    rng = np.random.RandomState(2)
+    leaves = [jnp.asarray(rng.normal(0, 0.02, l.shape).astype(np.float32)) for l in leaves]
+    params = jax.tree_util.tree_unflatten(treedef, leaves)
+    # params as a runtime argument — closed-over they'd be baked into the HLO
+    # as 400MB of constants (the axon remote-compile path rejects that)
+    jit_apply = jax.jit(lambda prm, ids, m: encoder.apply(prm, ids, m))
+    forward = lambda ids, m: jit_apply(params, jnp.asarray(np.asarray(ids)), jnp.asarray(np.asarray(m)))  # noqa: E731
+
+    metric = BERTScore(
+        model=forward,
+        user_tokenizer=_hash_tokenizer,
+        max_length=_BERT_LEN,
+        batch_size=batch_size,
+        idf=True,
+    )
+    sent_rng = np.random.RandomState(3)
+    preds = _synth_sentences(sent_rng, n_pairs, 420)
+    target = _synth_sentences(sent_rng, n_pairs, 420)
+
+    # warmup: compile the encoder at the matching batch shape
+    jax.block_until_ready(forward(np.zeros((batch_size, _BERT_LEN), np.int64), np.ones((batch_size, _BERT_LEN), np.int64)))
+
+    start = time.perf_counter()
+    metric.update(preds, target)
+    res = metric.compute()
+    f1 = np.asarray(res["f1"])  # forces host transfer
+    elapsed = time.perf_counter() - start
+    assert np.all(np.isfinite(f1))
+
+    baseline = None
+    try:
+        import torch
+
+        layer = torch.nn.TransformerEncoderLayer(
+            _BERT_DIM, _BERT_HEADS, _BERT_FFN, batch_first=True, activation="gelu"
+        )
+        net = torch.nn.TransformerEncoder(layer, _BERT_LAYERS).eval()
+        emb = torch.nn.Embedding(_BERT_VOCAB, _BERT_DIM)
+        tb = 4
+        ids = torch.randint(0, _BERT_VOCAB, (tb, _BERT_LEN))
+        with torch.no_grad():
+            net(emb(ids))  # warmup: thread pools, allocator, lazy kernels
+            t0 = time.perf_counter()
+            net(emb(ids))
+            baseline = tb / (time.perf_counter() - t0)
+    except Exception:
+        baseline = None
+
+    # end-to-end sentence encodings: preds + targets each pass the encoder
+    ours = 2 * n_pairs / elapsed
+    return {
+        "metric": "bertscore_update_compute_throughput",
+        "value": round(ours, 2),
+        "unit": "sentences/sec",
+        "vs_baseline": round(ours / baseline, 3) if baseline else None,
+        "n": n_pairs,
+        "seq_len": _BERT_LEN,
+    }
+
+
+# ---------------------------------------------------------------------------
+# config 5: mAP at COCO scale vs the ACTUAL reference implementation
+# ---------------------------------------------------------------------------
+def _synth_detection_scene(rng: np.random.RandomState, n_classes: int = 80):
+    n_gt = rng.randint(3, 15)
+    xy = rng.rand(n_gt, 2) * 400
+    wh = rng.rand(n_gt, 2) * 100 + 8
+    g_boxes = np.concatenate([xy, xy + wh], 1)
+    g_labels = rng.randint(0, n_classes, n_gt)
+    db, ds, dl = [], [], []
+    for b, l in zip(g_boxes, g_labels):
+        for _ in range(rng.randint(1, 4)):
+            db.append(b + rng.randn(4) * 6)
+            ds.append(rng.rand())
+            dl.append(l)
+    for _ in range(rng.randint(3, 10)):
+        xy1 = rng.rand(2) * 400
+        wh1 = rng.rand(2) * 100 + 8
+        db.append(np.concatenate([xy1, xy1 + wh1]))
+        ds.append(rng.rand())
+        dl.append(rng.randint(0, n_classes))
+    pred = dict(
+        boxes=np.asarray(db, np.float64).reshape(-1, 4),
+        scores=np.asarray(ds, np.float64),
+        labels=np.asarray(dl, np.int64),
+    )
+    gt = dict(boxes=g_boxes, labels=g_labels)
+    return pred, gt
+
+
+def _install_reference_shims() -> None:
+    """Make `/root/reference` torchmetrics importable: stub `deprecate` and
+    `pkg_resources` (absent here), and provide faithful pure-torch
+    `torchvision.ops` box primitives. All evaluation logic stays reference."""
+    import importlib.machinery
+    import types
+
+    import torch
+
+    def _mod(name: str) -> types.ModuleType:
+        m = types.ModuleType(name)
+        # a real ModuleSpec so importlib.util.find_spec-based availability
+        # probes in the reference see a well-formed module
+        m.__spec__ = importlib.machinery.ModuleSpec(name, loader=None)
+        return m
+
+    dep = _mod("deprecate")
+
+    def _deprecated(*dargs, **dkw):
+        def deco(fn):
+            return fn
+
+        if len(dargs) == 1 and callable(dargs[0]) and not dkw:
+            return dargs[0]
+        return deco
+
+    dep.deprecated = _deprecated
+    dep.void = lambda *a, **k: None
+    sys.modules.setdefault("deprecate", dep)
+
+    pkgr = _mod("pkg_resources")
+
+    class DistributionNotFound(Exception):
+        pass
+
+    def get_distribution(name):
+        raise DistributionNotFound(name)
+
+    pkgr.DistributionNotFound = DistributionNotFound
+    pkgr.get_distribution = get_distribution
+    sys.modules.setdefault("pkg_resources", pkgr)
+
+    tv = _mod("torchvision")
+    ops = _mod("torchvision.ops")
+
+    def box_area(boxes):
+        return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+    def box_iou(boxes1, boxes2):
+        area1, area2 = box_area(boxes1), box_area(boxes2)
+        lt = torch.max(boxes1[:, None, :2], boxes2[None, :, :2])
+        rb = torch.min(boxes1[:, None, 2:], boxes2[None, :, 2:])
+        wh = (rb - lt).clamp(min=0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+
+    def box_convert(boxes, in_fmt, out_fmt):
+        if in_fmt == out_fmt:
+            return boxes
+        if in_fmt == "xywh":
+            x, y, w, h = boxes.unbind(-1)
+            return torch.stack([x, y, x + w, y + h], dim=-1)
+        cx, cy, w, h = boxes.unbind(-1)
+        return torch.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], dim=-1)
+
+    ops.box_area, ops.box_iou, ops.box_convert = box_area, box_iou, box_convert
+    tv.ops = ops
+    tv.__version__ = "0.9.0"
+    sys.modules.setdefault("torchvision", tv)
+    sys.modules.setdefault("torchvision.ops", ops)
+    if "/root/reference" not in sys.path:
+        sys.path.append("/root/reference")
+
+
+def bench_map() -> dict:
+    from metrics_tpu import MeanAveragePrecision
+
+    small = _small()
+    n_img = 400 if small else 5_000
+    n_ref = 25 if small else 100
+
+    rng = np.random.RandomState(4)
+    scenes = [_synth_detection_scene(rng) for _ in range(n_img)]
+
+    metric = MeanAveragePrecision()
+    start = time.perf_counter()
+    for pred, gt in scenes:
+        metric.update([pred], [gt])
+    res = metric.compute()
+    elapsed = time.perf_counter() - start
+    ours_ips = n_img / elapsed
+    ours_map = float(res["map"])
+
+    baseline_ips = None
+    parity_delta = None
+    baseline_error = None
+    try:
+        _install_reference_shims()
+        import torch
+        from torchmetrics.detection.map import MeanAveragePrecision as RefMAP
+
+        def to_torch(d):
+            return {k: torch.from_numpy(np.asarray(v, np.float32 if k != "labels" else np.int64)) for k, v in d.items()}
+
+        ref = RefMAP()
+        t0 = time.perf_counter()
+        for pred, gt in scenes[:n_ref]:
+            ref.update([to_torch(pred)], [to_torch(gt)])
+        ref_res = ref.compute()
+        baseline_ips = n_ref / (time.perf_counter() - t0)
+
+        sub = MeanAveragePrecision()
+        for pred, gt in scenes[:n_ref]:
+            sub.update([pred], [gt])
+        parity_delta = abs(float(sub.compute()["map"]) - float(ref_res["map"]))
+    except Exception as err:  # noqa: BLE001 — baseline is best-effort
+        baseline_error = f"{type(err).__name__}: {err}"[:120]
+
+    out = {
+        "metric": "map_coco_scale_throughput",
+        "value": round(ours_ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(ours_ips / baseline_ips, 3) if baseline_ips else None,
+        "n": n_img,
+        "map": round(ours_map, 4),
+        "baseline_n": n_ref,
+        "parity_delta_vs_reference": round(parity_delta, 5) if parity_delta is not None else None,
+    }
+    if baseline_error:
+        out["baseline_error"] = baseline_error
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sync overhead: in-trace distributed sync vs identical program without it
+# ---------------------------------------------------------------------------
+_SYNC_SCRIPT = r"""
+import json, os, time
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import Accuracy, ConfusionMatrix, F1Score
+
+NUM_CLASSES, K, B = 10, 100, 8192
+metrics = [
+    Accuracy(num_classes=NUM_CLASSES),
+    ConfusionMatrix(num_classes=NUM_CLASSES),
+    F1Score(num_classes=NUM_CLASSES, average="macro"),
+]
+rng = np.random.RandomState(0)
+p_all = jnp.asarray(rng.rand(K, B, NUM_CLASSES).astype(np.float32))
+t_all = jnp.asarray(rng.randint(0, NUM_CLASSES, size=(K, B)).astype(np.int32))
+mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+
+def make_epoch(sync):
+    def shard_body(p_sh, t_sh):
+        def body(states, batch):
+            p, t = batch
+            return tuple(m.update_state(s, p, t) for m, s in zip(metrics, states)), None
+        init = tuple(m.init_state() for m in metrics)
+        states, _ = jax.lax.scan(body, init, (p_sh, t_sh))
+        if sync:
+            states = tuple(m.sync_state(s, axis_name="dp") for m, s in zip(metrics, states))
+        return tuple(m.compute_state(s) for m, s in zip(metrics, states))
+    kw = dict(mesh=mesh, in_specs=(P(None, "dp"), P(None, "dp")), out_specs=P())
+    try:
+        fn = jax.shard_map(shard_body, check_vma=False, **kw)
+    except TypeError:  # older jax spells it check_rep
+        fn = jax.shard_map(shard_body, check_rep=False, **kw)
+    return jax.jit(fn)
+
+fns = {"nosync": make_epoch(False), "sync": make_epoch(True)}
+times = {"nosync": [], "sync": []}
+results = {}
+for name, fn in fns.items():  # compile both first
+    out = fn(p_all, t_all); jax.block_until_ready(out)
+    results[name + "_acc"] = float(jax.tree_util.tree_leaves(out[0])[0])
+for _ in range(5):  # interleave reps so machine-load drift cancels
+    for name, fn in fns.items():
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(p_all, t_all))
+        times[name].append(time.perf_counter() - t0)
+for name in fns:
+    results[name] = sorted(times[name])[len(times[name]) // 2]
+
+overhead = 100.0 * (results["sync"] - results["nosync"]) / results["nosync"]
+print(json.dumps({"overhead_pct": round(overhead, 2),
+                  "t_sync_s": round(results["sync"], 4),
+                  "t_nosync_s": round(results["nosync"], 4),
+                  "synced_accuracy": round(results["sync_acc"], 6)}))
+"""
+
+
+def bench_sync_overhead() -> dict:
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(_SYNC_SCRIPT)
+        path = f.name
+    try:
+        repo_root = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, path],
+            capture_output=True,
+            text=True,
+            timeout=900,
+            cwd=repo_root,
+            env=env,
+        )
+        lines = out.stdout.strip().splitlines()
+        if not lines:
+            raise RuntimeError(f"sync subprocess rc={out.returncode}: {out.stderr.strip()[-200:]}")
+        data = json.loads(lines[-1])
+    finally:
+        os.unlink(path)
+    return {
+        "metric": "dist_sync_overhead",
+        "value": data["overhead_pct"],
+        "unit": "pct_vs_single_device",
+        "vs_baseline": 5.0,  # the BASELINE.md "<5%" bar
+        "t_sync_s": data["t_sync_s"],
+        "t_nosync_s": data["t_nosync_s"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# config 2 extension: fused collection update vs per-member dispatch
+# ---------------------------------------------------------------------------
+def bench_collection_fused() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import (
+        Accuracy,
+        ConfusionMatrix,
+        F1Score,
+        MetricCollection,
+        Precision,
+        Recall,
+        Specificity,
+    )
+
+    steps = 30
+    rng = np.random.RandomState(5)
+    p = jnp.asarray(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, NUM_CLASSES, size=(BATCH,)))
+
+    def members():
+        return {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "prec": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "rec": Recall(num_classes=NUM_CLASSES, average="macro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "spec": Specificity(num_classes=NUM_CLASSES, average="macro"),
+            "confmat": ConfusionMatrix(num_classes=NUM_CLASSES),
+        }
+
+    def run(fused: bool) -> float:
+        mc = MetricCollection(members())
+        if not fused:
+            mc._fused_failed = True  # force the reference-style per-member path
+        mc.update(p, t)  # compile
+        mc.reset()
+        start = time.perf_counter()
+        for _ in range(steps):
+            mc.update(p, t)
+        jax.block_until_ready([m._snapshot_state() for _, m in mc.items(keep_base=True)])
+        return steps * BATCH / (time.perf_counter() - start)
+
+    fused = run(True)
+    per_member = run(False)
+    return {
+        "metric": "collection_fused_update_throughput",
+        "value": round(fused, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(fused / per_member, 3),  # vs per-member dispatch (reference pattern)
+        "members": 6,
+    }
+
+
+# ---------------------------------------------------------------------------
+# module-API compute() latency on the live backend
+# ---------------------------------------------------------------------------
+def bench_compute_latency() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, MetricCollection
+
+    mc = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "confmat": ConfusionMatrix(num_classes=NUM_CLASSES),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    p = jnp.asarray(_preds)
+    t = jnp.asarray(_target)
+    mc.update(p, t)
+    jax.block_until_ready(mc.compute()["acc"])  # warmup compiles
+    times = []
+    for _ in range(7):
+        mc.update(p, t)  # invalidates the compute cache
+        t0 = time.perf_counter()
+        out = mc.compute()
+        jax.block_until_ready(out["acc"])
+        times.append((time.perf_counter() - t0) * 1000)
+    return {
+        "metric": "collection_compute_latency",
+        "value": round(float(np.median(times)), 3),
+        "unit": "ms",
+        "vs_baseline": None,
+    }
+
+
 def main() -> None:
+    # headline measured FIRST (clean backend, comparable across rounds),
+    # emitted LAST (the driver parses the final line)
     ours = bench_ours()
     try:
         baseline = bench_reference()
         vs = round(ours / baseline, 3)
-    except ImportError:
-        vs = None  # no torch available: report "no baseline ran", not parity
-    print(
-        json.dumps(
-            {
-                "metric": "classification_collection_update_throughput",
-                "value": round(ours, 1),
-                "unit": "samples/sec",
-                "vs_baseline": vs,
-            }
-        )
+    except Exception:  # noqa: BLE001 — a baseline failure must not kill the headline
+        vs = None  # report "no baseline ran", not parity
+
+    for fn in (
+        bench_fid,
+        bench_bertscore,
+        bench_map,
+        bench_sync_overhead,
+        bench_collection_fused,
+        bench_compute_latency,
+    ):
+        try:
+            emit(fn())
+        except Exception as err:  # noqa: BLE001 — a config failure must not kill the headline
+            emit({"metric": fn.__name__, "error": f"{type(err).__name__}: {err}"[:200]})
+
+    emit(
+        {
+            "metric": "classification_collection_update_throughput",
+            "value": round(ours, 1),
+            "unit": "samples/sec",
+            "vs_baseline": vs,
+        }
     )
 
 

@@ -3,12 +3,24 @@
 Parity: reference ``torchmetrics/collections.py:28-237`` (there an
 ``nn.ModuleDict`` subclass; here a plain ordered container — JAX has no module
 registry to hook into, and metric states are already self-managed pytrees).
+
+Beyond parity (SURVEY §7 hard-part 5): ``update`` fuses every jit-compatible
+member into ONE compiled state transition. The reference dispatches each
+member independently (``collections.py:106-112``), so N stat-scores-family
+members re-validate and re-format the same ``(preds, target)`` N times; here
+the members' updates are traced into a single XLA program, whose common
+subexpressions (input formatting, ``_stat_scores_update``, confusion-matrix
+bincounts, ...) the compiler deduplicates — same API, one pass over the
+inputs. Members that can't jit (list states, host-side updates) keep the
+reference's per-member eager dispatch.
 """
 from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
-from metrics_tpu.metric import Metric
+import jax
+
+from metrics_tpu.metric import _JIT_FALLBACK_ERRORS, Metric
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -34,6 +46,9 @@ class MetricCollection:
         self._modules: "OrderedDict[str, Metric]" = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
+        self._fused_keys: Tuple[str, ...] = ()
+        self._fused_fn: Optional[Any] = None
+        self._fused_failed = False
         self.add_metrics(metrics, *additional_metrics)
 
     # -- lifecycle ------------------------------------------------------
@@ -45,9 +60,78 @@ class MetricCollection:
         return self.forward(*args, **kwargs)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        for _, m in self.items(keep_base=True):
+        done = self._fused_update(args, kwargs)
+        for k, m in self.items(keep_base=True):
+            if k in done:
+                continue
             m_kwargs = m._filter_kwargs(**kwargs)
             m.update(*args, **m_kwargs)
+
+    # -- fused update (one XLA program for all jit-compatible members) ---
+    def _fusable_keys(self) -> Tuple[str, ...]:
+        keys = []
+        seen_ids = set()
+        for k, m in self._modules.items():
+            if not (m._enable_jit and not m._jit_failed and not m._has_list_state()):
+                continue
+            # the same instance under two keys must update twice; the fused
+            # transition would restore the later key's pre-update snapshot
+            # over the earlier one's result, so only the first occurrence
+            # fuses — later aliases take the eager path on the fused output
+            if id(m) in seen_ids:
+                continue
+            seen_ids.add(id(m))
+            keys.append(k)
+        # a single fusable member gains nothing over its own auto-jit path
+        return tuple(keys) if len(keys) >= 2 else ()
+
+    def _fused_update(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[str, ...]:
+        """Run all fusable members' updates as one jitted state transition.
+
+        Returns the keys that were handled; on any jit-incompatibility the
+        states are rolled back, the fused path is disabled, and the caller
+        falls through to the reference-style per-member dispatch.
+        """
+        if self._fused_failed:
+            return ()
+        keys = self._fusable_keys()
+        if not keys:
+            return ()
+        if keys != self._fused_keys:
+            self._fused_keys = keys
+            self._fused_fn = None
+        members = [self._modules[k] for k in keys]
+        states = {k: m._snapshot_state() for k, m in zip(keys, members)}
+        member_kwargs = {k: m._filter_kwargs(**kwargs) for k, m in zip(keys, members)}
+
+        if self._fused_fn is None:
+
+            def transition(st: Dict[str, Any], a: Tuple[Any, ...], kw: Dict[str, Any]) -> Dict[str, Any]:
+                new: Dict[str, Any] = {}
+                for key, member in zip(keys, members):
+                    member._restore_state(st[key])
+                    member._inner_update(*a, **kw[key])
+                    new[key] = member._snapshot_state()
+                return new
+
+            self._fused_fn = jax.jit(transition)
+
+        try:
+            new_states = self._fused_fn(states, args, member_kwargs)
+        except _JIT_FALLBACK_ERRORS:
+            self._fused_failed = True
+            for k, m in zip(keys, members):
+                m._restore_state(states[k])
+            return ()
+        except Exception:
+            for k, m in zip(keys, members):
+                m._restore_state(states[k])
+            raise
+        for k, m in zip(keys, members):
+            m._restore_state(new_states[k])
+            m._update_count += 1
+            m._computed = None
+        return keys
 
     def compute(self) -> Dict[str, Any]:
         return {k: m.compute() for k, m in self.items(keep_base=False)}
@@ -108,6 +192,11 @@ class MetricCollection:
                 " with mapping input."
             )
 
+        # member set changed: rebuild (and re-allow) the fused update program
+        self._fused_keys = ()
+        self._fused_fn = None
+        self._fused_failed = False
+
         if isinstance(metrics, dict):
             for name in sorted(metrics.keys()):
                 metric = metrics[name]
@@ -134,6 +223,12 @@ class MetricCollection:
                 self._modules[name] = metric
         else:
             raise ValueError("Unknown input to MetricCollection.")
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # compiled functions don't pickle/deepcopy; rebuilt lazily on use
+        state = self.__dict__.copy()
+        state["_fused_fn"] = None
+        return state
 
     @staticmethod
     def _check_arg(arg: Optional[str], name: str) -> Optional[str]:

@@ -1,0 +1,206 @@
+"""Fused MetricCollection update: one XLA program for jit-compatible members.
+
+The SURVEY §7 hard-part-5 promise: a collection must not re-run input
+formatting per member the way the reference does
+(``torchmetrics/collections.py:106-112``). Correctness contract: fused
+results == standalone per-metric results, with graceful per-member fallback
+for list-state and jit-incompatible members.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    ConfusionMatrix,
+    F1Score,
+    MeanMetric,
+    MetricCollection,
+    Precision,
+    Recall,
+)
+from metrics_tpu.metric import Metric
+
+NUM_CLASSES = 5
+
+
+def _batches(n=4, batch=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(batch, NUM_CLASSES).astype(np.float32)),
+            jnp.asarray(rng.randint(0, NUM_CLASSES, size=(batch,))),
+        )
+        for _ in range(n)
+    ]
+
+
+def _stat_collection():
+    return MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "prec": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "rec": Recall(num_classes=NUM_CLASSES, average="macro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "confmat": ConfusionMatrix(num_classes=NUM_CLASSES),
+        }
+    )
+
+
+def test_fused_matches_standalone():
+    mc = _stat_collection()
+    singles = _stat_collection()  # fresh members, updated one by one
+
+    for p, t in _batches():
+        mc.update(p, t)
+        for _, m in singles.items(keep_base=True):
+            m.update(p, t)
+
+    got = mc.compute()
+    want = {k: m.compute() for k, m in singles.items(keep_base=False)}
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), rtol=1e-6, err_msg=k)
+
+
+def test_fused_path_engages():
+    mc = _stat_collection()
+    p, t = _batches(n=1)[0]
+    mc.update(p, t)
+    assert not mc._fused_failed
+    assert mc._fused_fn is not None
+    assert set(mc._fused_keys) == {"acc", "prec", "rec", "f1", "confmat"}
+    for _, m in mc.items(keep_base=True):
+        assert m._update_count == 1
+
+
+def test_list_state_member_excluded_but_correct():
+    """AUROC buffers exact-curve list states — it must be dispatched eagerly
+    while the rest still fuse, and every result must match standalone runs."""
+    mc = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "auroc": AUROC(num_classes=NUM_CLASSES),
+        }
+    )
+    ref = {
+        "acc": Accuracy(num_classes=NUM_CLASSES),
+        "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+        "auroc": AUROC(num_classes=NUM_CLASSES),
+    }
+    rng = np.random.RandomState(1)
+    for _ in range(3):
+        p = jnp.asarray(rng.rand(32, NUM_CLASSES).astype(np.float32))
+        p = p / p.sum(axis=1, keepdims=True)
+        t = jnp.asarray(rng.randint(0, NUM_CLASSES, size=(32,)))
+        mc.update(p, t)
+        for m in ref.values():
+            m.update(p, t)
+
+    assert "auroc" not in mc._fused_keys
+    assert set(mc._fused_keys) == {"acc", "f1"}
+    got = mc.compute()
+    for k, m in ref.items():
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(m.compute()), rtol=1e-6, err_msg=k)
+
+
+class _HostOnlyMean(Metric):
+    """Update that genuinely cannot trace (data-dependent Python branch)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds, target):
+        if float(jnp.max(preds)) > -1:  # concretizes a traced value
+            self.total = self.total + jnp.sum(preds)
+            self.count = self.count + preds.shape[0] * preds.shape[1]
+
+    def compute(self):
+        return self.total / self.count
+
+
+def test_incompatible_member_falls_back_whole_collection_correct():
+    mc = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "hostmean": _HostOnlyMean(),
+        }
+    )
+    batches = _batches(n=3, seed=2)
+    for p, t in batches:
+        mc.update(p, t)
+    # fused trace hit the concretization error once, then disabled itself
+    assert mc._fused_failed
+
+    acc = Accuracy(num_classes=NUM_CLASSES)
+    f1 = F1Score(num_classes=NUM_CLASSES, average="macro")
+    total = sum(float(jnp.sum(p)) for p, _ in batches)
+    count = sum(int(p.size) for p, _ in batches)
+    for p, t in batches:
+        acc.update(p, t)
+        f1.update(p, t)
+    got = mc.compute()
+    np.testing.assert_allclose(np.asarray(got["acc"]), np.asarray(acc.compute()), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["f1"]), np.asarray(f1.compute()), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["hostmean"]), total / count, rtol=1e-6)
+
+
+def test_add_metrics_rebuilds_fused_program():
+    mc = MetricCollection({"acc": Accuracy(num_classes=NUM_CLASSES)})
+    p, t = _batches(n=1)[0]
+    mc.update(p, t)
+    assert mc._fused_fn is None  # single member: nothing to fuse
+    mc.add_metrics({"f1": F1Score(num_classes=NUM_CLASSES, average="macro")})
+    mc.update(p, t)
+    assert set(mc._fused_keys) == {"acc", "f1"}
+    # counts diverge by design: acc saw 2 updates, f1 saw 1
+    assert mc["acc"]._update_count == 2
+    assert mc["f1"]._update_count == 1
+
+
+def test_fused_collection_deepcopy_and_clone():
+    mc = _stat_collection()
+    p, t = _batches(n=1)[0]
+    mc.update(p, t)
+    clone = mc.clone(prefix="c_")
+    import copy
+
+    dc = copy.deepcopy(mc)
+    dc.update(p, t)
+    clone.update(p, t)
+    got = dc.compute()
+    np.testing.assert_allclose(
+        np.asarray(got["acc"]), np.asarray(clone.compute()["c_acc"]), rtol=1e-6
+    )
+
+
+def test_same_instance_under_two_keys_updates_twice():
+    """One Metric object registered under two keys must accumulate two
+    updates per collection update — only the first alias may fuse."""
+    shared = Accuracy(num_classes=NUM_CLASSES)
+    mc = MetricCollection(
+        {"a": shared, "b": shared, "f1": F1Score(num_classes=NUM_CLASSES, average="macro")}
+    )
+    p, t = _batches(n=1)[0]
+    mc.update(p, t)
+    ref = Accuracy(num_classes=NUM_CLASSES)
+    ref.update(p, t)
+    ref.update(p, t)
+    assert shared._update_count == 2
+    np.testing.assert_array_equal(np.asarray(shared.tp), np.asarray(ref.tp))
+    assert "b" not in mc._fused_keys
+
+
+def test_forward_unchanged_semantics():
+    """forward() keeps per-member dispatch; batch values still correct."""
+    mc = _stat_collection()
+    p, t = _batches(n=1)[0]
+    out = mc(p, t)
+    single = Accuracy(num_classes=NUM_CLASSES)
+    batch_val = single(p, t)
+    np.testing.assert_allclose(np.asarray(out["acc"]), np.asarray(batch_val), rtol=1e-6)
