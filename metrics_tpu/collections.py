@@ -60,12 +60,20 @@ class MetricCollection:
         return self.forward(*args, **kwargs)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
+        was_failed = self._fused_failed
         done = self._fused_update(args, kwargs)
-        for k, m in self.items(keep_base=True):
-            if k in done:
-                continue
-            m_kwargs = m._filter_kwargs(**kwargs)
-            m.update(*args, **m_kwargs)
+        try:
+            for k, m in self.items(keep_base=True):
+                if k in done:
+                    continue
+                m_kwargs = m._filter_kwargs(**kwargs)
+                m.update(*args, **m_kwargs)
+        except Exception:
+            # the eager retry raised too: that's a call-site error (bad args),
+            # not trace incompatibility — don't let it permanently disable the
+            # fused path for later, correct, updates
+            self._fused_failed = was_failed
+            raise
 
     # -- fused update (one XLA program for all jit-compatible members) ---
     def _fusable_keys(self) -> Tuple[str, ...]:

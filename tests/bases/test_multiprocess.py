@@ -90,9 +90,14 @@ def test_all_ranks_agree(worker_results):
 
 @pytest.mark.parametrize("scenario", ["accuracy", "spearman", "pearson"])
 def test_distributed_equals_serial(worker_results, serial_oracle, scenario):
+    # x32 lane: the gathered-shard accumulation order differs from serial, so
+    # f32 rounding shows up at ~1e-6 relative; f64 stays near-exact
+    from tests.helpers.testers import X32_LANE
+
+    rtol, atol = (2e-5, 1e-6) if X32_LANE else (1e-9, 1e-10)
     for rank in range(WORLD):
         np.testing.assert_allclose(
-            worker_results[rank][scenario], serial_oracle[scenario], rtol=1e-9, atol=1e-10,
+            worker_results[rank][scenario], serial_oracle[scenario], rtol=rtol, atol=atol,
             err_msg=f"{scenario} rank{rank}",
         )
 

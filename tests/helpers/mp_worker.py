@@ -131,10 +131,14 @@ def _comm_layer_asserts(rank: int, world: int):
 def main():
     rank, world, port, outdir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
 
+    import os
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_enable_x64", True)
+    # follow the parent's dtype lane (tests/conftest.py): the serial oracle
+    # runs in-process, so worker and oracle must use the same precision
+    jax.config.update("jax_enable_x64", os.environ.get("METRICS_TPU_TEST_X32", "") != "1")
     jax.distributed.initialize(f"localhost:{port}", num_processes=world, process_id=rank)
 
     _comm_layer_asserts(rank, world)

@@ -155,8 +155,10 @@ class TestSSIM(MetricTester):
         with pytest.raises(ValueError):
             structural_similarity_index_measure(jnp.zeros((2, 8, 8)), jnp.zeros((2, 8, 8)))
         with pytest.raises(TypeError):
+            # bfloat16 keeps the dtype mismatch real in the x32 lane too,
+            # where a float64 request silently truncates to float32
             structural_similarity_index_measure(
-                jnp.zeros((2, 3, 8, 8), jnp.float32), jnp.zeros((2, 3, 8, 8), jnp.float64)
+                jnp.zeros((2, 3, 8, 8), jnp.float32), jnp.zeros((2, 3, 8, 8), jnp.bfloat16)
             )
 
 
