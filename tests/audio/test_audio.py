@@ -28,7 +28,7 @@ from metrics_tpu.functional.audio import (
     signal_distortion_ratio,
     signal_noise_ratio,
 )
-from tests.helpers.testers import MetricTester
+from tests.helpers.testers import MetricTester, _assert_allclose
 
 NUM_BATCHES, BATCH_SIZE, TIME = 4, 8, 128
 
@@ -203,7 +203,8 @@ class TestPIT:
             jnp.asarray(preds), jnp.asarray(target), scale_invariant_signal_distortion_ratio, "max"
         )
         oracle_val, oracle_perm = _np_pit_oracle(preds, target, _np_si_sdr_single, maximize=True)
-        np.testing.assert_allclose(np.asarray(best_metric), oracle_val, atol=1e-4)
+        # lane-aware tolerance: f32 SI-SDR at ~-34 dB rounds at ~1e-5 relative
+        _assert_allclose(np.asarray(best_metric), oracle_val, atol=1e-4)
         np.testing.assert_array_equal(np.asarray(best_perm), oracle_perm)
 
     def test_min_mode(self):

@@ -1,0 +1,29 @@
+"""Execute every ```python block in docs/ — the examples are tested code.
+
+The JAX analog of the reference's doctest'd rst pages
+(``docs/source/pages/*.rst`` run under sphinx doctest in its CI).
+"""
+import pathlib
+import re
+
+import pytest
+
+DOCS = pathlib.Path(__file__).resolve().parent.parent / "docs"
+_BLOCK = re.compile(r"```python\n(.*?)```", re.S)
+
+
+def _collect():
+    cases = []
+    for path in sorted(DOCS.rglob("*.md")):
+        for i, match in enumerate(_BLOCK.findall(path.read_text())):
+            cases.append(pytest.param(match, id=f"{path.relative_to(DOCS)}[{i}]"))
+    return cases
+
+
+_CASES = _collect()
+assert _CASES, "docs/ must contain python examples"
+
+
+@pytest.mark.parametrize("code", _CASES)
+def test_docs_example_runs(code):
+    exec(compile(code, "<docs-example>", "exec"), {"__name__": "__docs__"})
