@@ -1,0 +1,193 @@
+"""Pallas TPU kernel: fused pairwise distance + row reduction.
+
+The hot op behind ``pairwise_{euclidean_distance,cosine_similarity}`` with
+``reduction="sum"|"mean"`` (reference
+``torchmetrics/functional/pairwise/{euclidean,cosine}.py`` — there the
+``[N, M]`` matrix is always materialized and then reduced).
+
+This kernel computes MXU tiles of the implicit matrix in VMEM, applies the
+epilogue (clip, sqrt, padding/diagonal masks) on-chip, and accumulates
+per-row sums across the column-tile grid — the ``[N, M]`` matrix never
+exists.
+
+**Measured verdict (v5e, N=M=8192, d=256, chained-scan timing with a host
+fetch per repetition — ``python -m metrics_tpu.ops.pairwise_reduce``):
+XLA 0.239 ms/step vs Pallas 0.268 ms/step — XLA WINS.** The hypothesis
+(XLA materializes [N, M] through HBM before reducing) is false on TPU: XLA
+output-fuses the sqrt+mask+reduce epilogue into the dot, so the matrix never
+hits HBM there either, and its MXU schedule is better than this kernel's.
+Like ``ops/binned_counts.py``, the kernel therefore stays OFF by default
+(``METRICS_TPU_FORCE_PALLAS_PAIRWISE=1`` opts in; bit-compatible results,
+covered by tests) and the honest loss is recorded here. The winning kernel
+this template produced is ``ops/select_topk.py``, where XLA's sort-based
+lowering genuinely loses.
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+_BLOCK_N = 1024
+_BLOCK_M = 1024
+_MAX_D = 4096  # x/y tiles must fit VMEM comfortably
+
+
+def _kernel(x_ref, y_ref, out_ref, *, op: str, n: int, m: int, zero_diagonal: bool, block_m: int):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    x = x_ref[...].astype(jnp.float32)  # [BN, d]
+    y = y_ref[...].astype(jnp.float32)  # [BM, d]
+    # one-pass bf16 multiply with f32 accumulation — the same precision XLA's
+    # default dot uses for f32 operands on TPU, at 1/3 the MXU passes of a
+    # full-f32 product
+    dot = jax.lax.dot_general(
+        x.astype(jnp.bfloat16),
+        y.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [BN, BM] on the MXU
+    if op == "euclidean":
+        x_norm = jnp.sum(x * x, axis=1)[:, None]
+        y_norm = jnp.sum(y * y, axis=1)[None, :]
+        vals = jnp.sqrt(jnp.maximum(x_norm + y_norm - 2.0 * dot, 0.0))
+    else:  # cosine: inputs pre-normalized outside, the tile dot IS the similarity
+        vals = dot
+
+    rows = i * x.shape[0] + jax.lax.broadcasted_iota(jnp.int32, vals.shape, 0)
+    cols = j * block_m + jax.lax.broadcasted_iota(jnp.int32, vals.shape, 1)
+    mask = (rows < n) & (cols < m)
+    if zero_diagonal:
+        mask &= rows != cols
+    vals = jnp.where(mask, vals, 0.0)
+    out_ref[...] += jnp.sum(vals, axis=1, keepdims=True)  # [BN, 1]
+
+
+def _pad_rows(a: Array, block: int) -> Array:
+    pad = (-a.shape[0]) % block
+    if pad:
+        a = jnp.pad(a, ((0, pad), (0, 0)))
+    return a
+
+
+@functools.partial(jax.jit, static_argnames=("op", "zero_diagonal"))
+def _fused_row_sums(x: Array, y: Array, op: str, zero_diagonal: bool) -> Array:
+    n, m = x.shape[0], y.shape[0]
+    xp = _pad_rows(x.astype(jnp.float32), _BLOCK_N)
+    yp = _pad_rows(y.astype(jnp.float32), _BLOCK_M)
+    grid = (xp.shape[0] // _BLOCK_N, yp.shape[0] // _BLOCK_M)
+    kernel = functools.partial(
+        _kernel, op=op, n=n, m=m, zero_diagonal=zero_diagonal, block_m=_BLOCK_M
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_N, x.shape[1]), lambda i, j: (i, 0)),
+            pl.BlockSpec((_BLOCK_M, y.shape[1]), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((_BLOCK_N, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((xp.shape[0], 1), jnp.float32),
+    )(xp, yp)
+    return out[:n, 0]
+
+
+def fused_supported(x: Array, y: Array, force: bool = False) -> bool:
+    """Dispatch gate: TPU backend, supported dtype/size, big enough to win."""
+    if x.ndim != 2 or y.ndim != 2:
+        return False
+    if x.dtype not in (jnp.float32, jnp.bfloat16) or y.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    if x.shape[1] > _MAX_D:
+        return False
+    # measured loss vs XLA's fused dot (module docstring): opt-in only
+    return force
+
+
+def pairwise_reduce_rows(
+    x: Array,
+    y: Array,
+    op: str,
+    reduction: str,
+    zero_diagonal: bool,
+) -> Optional[Array]:
+    """Row-reduced pairwise op without materializing ``[N, M]``.
+
+    ``op``: ``"euclidean"`` (distances; norms fused in-kernel) or ``"cosine"``
+    (callers pass pre-normalized rows). Returns ``None`` when the fused path
+    doesn't apply — callers fall back to the XLA formulation.
+    """
+    import os
+
+    force = os.environ.get("METRICS_TPU_FORCE_PALLAS_PAIRWISE") == "1"
+    if reduction not in ("sum", "mean") or not fused_supported(x, y, force=force):
+        return None
+    sums = _fused_row_sums(x, y, op, zero_diagonal)
+    if reduction == "mean":
+        # jnp.mean over the last axis divides by M (zeroed diagonal included)
+        return sums / y.shape[0]
+    return sums
+
+
+def _bench() -> None:  # pragma: no cover - manual measurement entrypoint
+    import time
+
+    import numpy as np
+
+    n = m = 8192
+    d = 256
+    steps = 200
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(n, d).astype(np.float32))
+    y = jnp.asarray(rng.rand(m, d).astype(np.float32))
+
+    def xla_way(x, y):
+        xn = jnp.sum(x * x, axis=1, keepdims=True)
+        yn = jnp.sum(y * y, axis=1)[None, :]
+        dist = jnp.sqrt(jnp.clip(xn + yn - 2 * (x @ y.T), min=0))
+        return jnp.sum(dist, axis=-1)
+
+    def pallas_way(x, y):
+        return _fused_row_sums(x, y, op="euclidean", zero_diagonal=False)
+
+    for name, fn in (("xla", xla_way), ("pallas", pallas_way)):
+        # Chain dependent iterations inside ONE jit and force execution with a
+        # HOST FETCH of the scalar result: on deferred-execution backends
+        # (axon tunnel) block_until_ready returns immediately - only a fetch
+        # runs the graph. Two chain lengths difference out the fetch latency.
+        def loop_fn(length, fn=fn):
+            @jax.jit
+            def loop(x, y):
+                def body(carry, _):
+                    out = fn(carry, y)
+                    total = jnp.sum(out)  # consume EVERY row
+                    return carry + total * 1e-30, total
+                _, outs = jax.lax.scan(body, x, None, length=length)
+                return outs[-1]
+            return loop
+
+        short, long_ = loop_fn(2), loop_fn(2 + steps)
+        float(short(x, y)); float(long_(x, y))  # compile + warm both
+
+        def timed(fn2):
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                float(fn2(x, y))  # fetch forces execution
+                times.append(time.perf_counter() - t0)
+            return sorted(times)[len(times) // 2]
+
+        per_step_ms = 1e3 * (timed(long_) - timed(short)) / steps
+        print(name, f"{per_step_ms:.3f} ms/step")
+
+
+if __name__ == "__main__":
+    _bench()

@@ -1,0 +1,138 @@
+"""Pallas TPU kernel: top-k binarization without a sort.
+
+The hot op behind every ``top_k`` classification metric
+(``utils/data.select_topk``, reference ``utilities/data.py:91``): turn
+``[N, C]`` scores into a 0/1 mask marking each row's k largest entries.
+
+XLA lowers ``lax.top_k`` to a row sort (O(C log^2 C) bitonic passes) followed
+by a scatter — measured 0.64 ms for N=8192, C=1000, k=5 on v5e. But the mask
+doesn't need sorted values: k max-and-suppress sweeps over a VMEM-resident
+tile find the same entries in O(k*C) VPU work. Ties resolve to the lowest
+index, matching ``lax.top_k``'s documented tie-breaking.
+
+**Measured verdict (v5e, N=8192, C=1000, k=5, chained-scan timing with a
+host fetch per repetition — ``python -m metrics_tpu.ops.select_topk``):
+XLA sort+scatter 0.636 ms/step vs Pallas 0.336 ms/step (1.9x)**, with exact
+``lax.top_k`` parity including NaN rows (NaN ranks greatest), rows with
+fewer than k finite entries, and -0.0/0.0 ties. The dispatch in
+``utils/data.select_topk`` uses the kernel on TPU for k>1 and falls back to
+XLA elsewhere (including under ``interpret=True`` for CPU correctness
+tests).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+_BLOCK_N = 512
+_MAX_C = 4096  # [BN, C] f32 tile + mask must sit comfortably in VMEM
+_MAX_K = 64
+
+
+def _topk_mask_kernel(x_ref, out_ref, *, k: int):
+    vals = x_ref[...]  # [BN, C] f32
+    # NaN ranks greatest in lax.top_k: map it to +inf for the max sweeps and
+    # keep a preference mask so NaN still beats a real +inf at the same rank.
+    nan_mask = jnp.isnan(vals)
+    masked = jnp.where(nan_mask, jnp.full_like(vals, jnp.inf), vals)
+    neg_inf = jnp.full_like(vals, -jnp.inf)
+
+    # `taken` (not a value sentinel) marks suppressed entries, so genuine
+    # -inf values stay selectable: rows with fewer than k finite entries
+    # still produce exactly k picks, matching the lax.top_k fallback.
+    taken = jnp.zeros(vals.shape, dtype=jnp.bool_)
+    selected = jnp.zeros(vals.shape, dtype=jnp.int32)
+    cols = jax.lax.broadcasted_iota(jnp.int32, vals.shape, 1)
+    for _ in range(k):  # static unroll: k is small by construction
+        cur_max = jnp.max(masked, axis=1, keepdims=True)
+        eq = (masked == cur_max) & ~taken
+        # candidates score 1, NaN-preferred candidates 2: one argmax applies
+        # the NaN>inf rank AND the lowest-index tie-break (first max wins);
+        # f32 operand because that's the only dtype Mosaic's argmax lowers
+        score = eq.astype(jnp.float32) + (eq & nan_mask).astype(jnp.float32)
+        first = cols == jnp.argmax(score, axis=1)[:, None]
+        selected = selected | first.astype(jnp.int32)
+        taken = taken | first
+        masked = jnp.where(first, neg_inf, masked)
+    out_ref[...] = selected
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def _topk_mask(x: Array, k: int, interpret: bool = False) -> Array:
+    n, c = x.shape
+    pad_n = (-n) % _BLOCK_N
+    pad_c = (-c) % 128  # full lanes so the block never reads undefined data
+    xp = x.astype(jnp.float32)
+    if pad_n or pad_c:
+        # -inf padding columns can never be selected (k <= c real columns)
+        xp = jnp.pad(xp, ((0, pad_n), (0, pad_c)), constant_values=-jnp.inf)
+    grid = (xp.shape[0] // _BLOCK_N,)
+    out = pl.pallas_call(
+        functools.partial(_topk_mask_kernel, k=k),
+        grid=grid,
+        in_specs=[pl.BlockSpec((_BLOCK_N, xp.shape[1]), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_BLOCK_N, xp.shape[1]), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(xp.shape, jnp.int32),
+        interpret=interpret,
+    )(xp)
+    return out[:n, :c]
+
+
+def topk_mask_supported(x: Array, k: int, force: bool = False) -> bool:
+    """Dispatch gate for the sort-free kernel."""
+    if x.ndim != 2 or not (1 < k <= _MAX_K) or k > x.shape[1] or x.shape[1] > _MAX_C:
+        return False
+    if x.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+        return False
+    return force or jax.default_backend() == "tpu"
+
+
+def topk_mask(x: Array, k: int, interpret: bool = False) -> Array:
+    """0/1 int32 mask of each row's k largest entries (ties -> lowest index)."""
+    return _topk_mask(x, k, interpret=interpret)
+
+
+def _bench() -> None:  # pragma: no cover - manual measurement entrypoint
+    import time
+
+    n, c, k, steps = 8192, 1000, 5, 100
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(n, c).astype(np.float32))
+
+    def xla_way(v):
+        _, idx = jax.lax.top_k(v, k)
+        zeros = jnp.zeros_like(v, dtype=jnp.int32)
+        return jnp.put_along_axis(zeros, idx, 1, axis=-1, inplace=False)
+
+    def pallas_way(v):
+        return topk_mask(v, k)
+
+    for name, fn in (("xla", xla_way), ("pallas", pallas_way)):
+        # chained scan + host fetch: survives deferred-execution backends
+        def loop_fn(length, fn=fn):
+            @jax.jit
+            def loop(v):
+                def body(carry, _):
+                    out = fn(carry)
+                    total = jnp.sum(out)
+                    return carry + total.astype(carry.dtype) * 1e-30, total
+                _, outs = jax.lax.scan(body, v, None, length=length)
+                return outs[-1]
+            return loop
+
+        short, long_ = loop_fn(2), loop_fn(2 + steps)
+        float(short(x)); float(long_(x))
+        def timed(f):
+            ts = []
+            for _ in range(5):
+                t0 = time.perf_counter(); float(f(x)); ts.append(time.perf_counter() - t0)
+            return sorted(ts)[len(ts) // 2]
+        print(name, f"{1e3 * (timed(long_) - timed(short)) / steps:.3f} ms/step")
+
+
+if __name__ == "__main__":
+    _bench()

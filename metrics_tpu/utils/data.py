@@ -86,9 +86,16 @@ def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
         topk_tensor = jnp.put_along_axis(zeros, idx, 1, axis=dim, inplace=False)
     else:
         moved = jnp.moveaxis(prob_tensor, dim, -1)
-        _, idx = jax.lax.top_k(moved, topk)
-        zeros = jnp.zeros_like(moved, dtype=jnp.int32)
-        scattered = jnp.put_along_axis(zeros, idx, 1, axis=-1, inplace=False)
+        from metrics_tpu.ops.select_topk import topk_mask, topk_mask_supported
+
+        if topk_mask_supported(moved, topk):
+            # sort-free Pallas kernel: 2.3x over lax.top_k+scatter on TPU
+            # (measured verdict in ops/select_topk.py)
+            scattered = topk_mask(moved, topk)
+        else:
+            _, idx = jax.lax.top_k(moved, topk)
+            zeros = jnp.zeros_like(moved, dtype=jnp.int32)
+            scattered = jnp.put_along_axis(zeros, idx, 1, axis=-1, inplace=False)
         topk_tensor = jnp.moveaxis(scattered, -1, dim)
     return topk_tensor.astype(jnp.int32)
 

@@ -1,0 +1,122 @@
+"""Tests for the sort-free top-k mask kernel (interpret mode on CPU).
+
+Parity contract: identical 0/1 mask to the ``lax.top_k`` + scatter
+formulation it replaces on TPU (``utils/data.select_topk``), including the
+lowest-index tie-break and non-aligned shapes that exercise the -inf padding.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.ops.pairwise_reduce import _fused_row_sums
+from metrics_tpu.ops.select_topk import topk_mask, topk_mask_supported
+
+
+def _xla_mask(v: jnp.ndarray, k: int) -> np.ndarray:
+    _, idx = jax.lax.top_k(v, k)
+    zeros = jnp.zeros_like(v, dtype=jnp.int32)
+    return np.asarray(jnp.put_along_axis(zeros, idx, 1, axis=-1, inplace=False))
+
+
+@pytest.mark.parametrize("shape", [(8, 16), (77, 130), (512, 128), (513, 129)])
+@pytest.mark.parametrize("k", [2, 5])
+def test_matches_lax_topk(shape, k):
+    rng = np.random.RandomState(hash(shape) % 2**31)
+    v = jnp.asarray(rng.rand(*shape).astype(np.float32))
+    got = np.asarray(topk_mask(v, k, interpret=True))
+    np.testing.assert_array_equal(got, _xla_mask(v, k))
+    assert got.sum(axis=1).tolist() == [k] * shape[0]
+
+
+def test_ties_take_lowest_index():
+    # duplicates straddling the k boundary: lax.top_k documents lowest-index
+    # preference; the kernel's argmax-based suppression must match it
+    v = jnp.asarray(
+        [
+            [0.5, 0.9, 0.5, 0.5, 0.1],
+            [1.0, 1.0, 1.0, 1.0, 1.0],
+            [0.0, 0.0, 0.3, 0.0, 0.3],
+        ],
+        jnp.float32,
+    )
+    for k in (1, 2, 3):
+        got = np.asarray(topk_mask(v, k, interpret=True))
+        np.testing.assert_array_equal(got, _xla_mask(v, k), err_msg=f"k={k}")
+
+
+def test_negative_and_inf_values():
+    v = jnp.asarray([[-1.0, -jnp.inf, -0.5, -2.0], [jnp.inf, 0.0, -jnp.inf, 1.0]], jnp.float32)
+    got = np.asarray(topk_mask(v, 2, interpret=True))
+    np.testing.assert_array_equal(got, _xla_mask(v, 2))
+
+
+def test_fewer_than_k_finite_entries():
+    """Rows whose max is -inf after suppression must keep selecting fresh
+    columns (suppression sentinel != real -inf), matching lax.top_k."""
+    v = jnp.asarray(
+        [[0.5, -jnp.inf, -jnp.inf, -jnp.inf], [-jnp.inf, -jnp.inf, -jnp.inf, -jnp.inf]],
+        jnp.float32,
+    )
+    for k in (2, 3):
+        got = np.asarray(topk_mask(v, k, interpret=True))
+        np.testing.assert_array_equal(got, _xla_mask(v, k), err_msg=f"k={k}")
+        assert got.sum(axis=1).tolist() == [k, k]
+
+
+def test_nan_rows_match_lax_topk():
+    """NaN ranks greatest (like lax.top_k); all-NaN rows still yield k picks."""
+    v = jnp.asarray(
+        [[0.1, jnp.nan, 0.3, 0.2], [jnp.nan, jnp.nan, jnp.nan, jnp.nan]], jnp.float32
+    )
+    got = np.asarray(topk_mask(v, 2, interpret=True))
+    np.testing.assert_array_equal(got, _xla_mask(v, 2))
+    assert got.sum(axis=1).tolist() == [2, 2]
+
+
+def test_unaligned_row_with_few_finite_entries():
+    """-inf PADDING columns must lose ties against real -inf columns."""
+    v = jnp.full((3, 130), -jnp.inf, jnp.float32)
+    v = v.at[0, 100].set(1.0)
+    got = np.asarray(topk_mask(v, 3, interpret=True))
+    np.testing.assert_array_equal(got, _xla_mask(v, 3))
+
+
+def test_supported_gate():
+    v = jnp.zeros((4, 8), jnp.float32)
+    assert not topk_mask_supported(v, 1)  # k=1 has the argmax fast-path
+    assert not topk_mask_supported(v, 9)  # k > C
+    assert not topk_mask_supported(jnp.zeros((4, 8, 2), jnp.float32), 2)  # 3D
+    assert topk_mask_supported(v, 2, force=True)
+
+
+def test_pairwise_fused_rows_parity():
+    """The (opt-in) fused pairwise kernel stays bit-compatible with the XLA
+    formulation — euclidean and cosine, padding + zero_diagonal paths."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.rand(70, 24).astype(np.float32))
+    y = jnp.asarray(rng.rand(33, 24).astype(np.float32))
+
+    xn = np.sum(np.asarray(x) ** 2, axis=1, keepdims=True)
+    yn = np.sum(np.asarray(y) ** 2, axis=1)[None, :]
+    dist = np.sqrt(np.clip(xn + yn - 2 * np.asarray(x) @ np.asarray(y).T, 0, None))
+
+    import metrics_tpu.ops.pairwise_reduce as pr
+
+    orig = pr.pl.pallas_call
+
+    def interp_call(*args, **kwargs):
+        kwargs.setdefault("interpret", True)
+        return orig(*args, **kwargs)
+
+    pr.pl.pallas_call = interp_call
+    try:
+        got = np.asarray(_fused_row_sums(x, y, op="euclidean", zero_diagonal=False))
+        np.testing.assert_allclose(got, dist.sum(axis=1), rtol=2e-2)  # bf16 dot
+        sq = np.asarray(x) @ np.asarray(x).T
+        xs = np.sqrt(np.clip(xn + xn.T - 2 * sq, 0, None))
+        np.fill_diagonal(xs, 0.0)
+        got_diag = np.asarray(_fused_row_sums(x, x, op="euclidean", zero_diagonal=True))
+        np.testing.assert_allclose(got_diag, xs.sum(axis=1), rtol=2e-2)
+    finally:
+        pr.pl.pallas_call = orig
