@@ -26,6 +26,12 @@ One JSON line per config, headline LAST (the driver parses the final line):
 Sizes auto-shrink off-TPU (override: METRICS_TPU_BENCH_FULL=1 /
 METRICS_TPU_BENCH_SMALL=1) so dev runs stay bounded; each line carries ``n``.
 Config failures emit an ``error`` line — the headline always prints.
+
+Timing methodology: on deferred-execution backends (the axon TPU tunnel)
+``block_until_ready`` is a no-op — only host fetches run the enqueued graph.
+Every timed region therefore ends with a fetch (``_force``), and throughput
+numbers difference a long run against a short run so the fetch round-trip
+drops out.
 """
 import json
 import os
@@ -48,6 +54,20 @@ _target = _rng.randint(0, NUM_CLASSES, size=(BATCH,)).astype(np.int32)
 
 def emit(obj) -> None:
     print(json.dumps(obj), flush=True)
+
+
+def _force(x) -> None:
+    """Force execution with a host fetch.
+
+    On deferred-execution backends (the axon TPU tunnel)
+    ``jax.block_until_ready`` returns immediately — only fetching a result
+    runs the enqueued graph. Fetching one leaf forces the whole program that
+    produced it, so timed regions end with this instead of block_until_ready.
+    """
+    import jax
+    import numpy as _np
+
+    _np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0]))
 
 
 def _on_tpu() -> bool:
@@ -85,17 +105,19 @@ def bench_ours() -> float:
 
     p = jnp.asarray(_preds)
     t = jnp.asarray(_target)
-    states = tuple(m.init_state() for m in metrics)
-    for _ in range(WARMUP):
-        states = step(states, p, t)
-    jax.block_until_ready(states)
 
-    states = tuple(m.init_state() for m in metrics)
-    start = time.perf_counter()
-    for _ in range(STEPS):
-        states = step(states, p, t)
-    jax.block_until_ready(states)
-    elapsed = time.perf_counter() - start
+    def run(k):
+        states = tuple(m.init_state() for m in metrics)
+        start = time.perf_counter()
+        for _ in range(k):
+            states = step(states, p, t)
+        _force(states)  # host fetch: the only reliable sync on axon
+        return time.perf_counter() - start, states
+
+    run(WARMUP)  # compile + warm
+    t_small, _ = run(5)
+    t_big, states = run(STEPS + 5)
+    elapsed = t_big - t_small  # STEPS steps, fetch latency differenced out
     # sanity: results are real
     vals = [m.compute_state(s) for m, s in zip(metrics, states)]
     assert all(np.isfinite(np.asarray(jax.tree_util.tree_leaves(v)[0])).all() for v in vals)
@@ -157,22 +179,23 @@ def bench_fid() -> dict:
     fid = FrechetInceptionDistance(feature=extractor, feature_dim=2048)
 
     rng = np.random.RandomState(1)
+    batches = [
+        jnp.asarray(rng.randint(0, 256, size=(batch, 3, 32, 32), dtype=np.uint8))
+        for _ in range(8)
+    ]
 
-    def batch_imgs():
-        return jnp.asarray(rng.randint(0, 256, size=(batch, 3, 32, 32), dtype=np.uint8))
+    def run(k):
+        fid.reset()
+        start = time.perf_counter()
+        for i in range(k):
+            fid.update(batches[i % 8], real=(i % 2 == 0))
+        _force((fid.real_outer, fid.fake_outer))  # host fetch: see _force
+        return time.perf_counter() - start
 
-    # warmup/compile
-    fid.update(batch_imgs(), real=True)
-    fid.update(batch_imgs(), real=False)
-    jax.block_until_ready(fid.real_outer)
-    fid.reset()
-
+    run(2)  # compile + warm both branches
     n_batches = n_images // batch
-    start = time.perf_counter()
-    for i in range(n_batches):
-        fid.update(batch_imgs(), real=(i % 2 == 0))
-    jax.block_until_ready((fid.real_outer, fid.fake_outer))
-    elapsed = time.perf_counter() - start
+    t_small = run(4)
+    elapsed = run(n_batches + 4) - t_small  # fetch latency differenced out
 
     t0 = time.perf_counter()
     value = float(fid.compute())
@@ -636,26 +659,104 @@ def bench_collection_fused() -> dict:
             "confmat": ConfusionMatrix(num_classes=NUM_CLASSES),
         }
 
-    def run(fused: bool) -> float:
+    def run(fused: bool, forward: bool) -> float:
         mc = MetricCollection(members())
         if not fused:
             mc._fused_failed = True  # force the reference-style per-member path
-        mc.update(p, t)  # compile
-        mc.reset()
-        start = time.perf_counter()
-        for _ in range(steps):
-            mc.update(p, t)
-        jax.block_until_ready([m._snapshot_state() for _, m in mc.items(keep_base=True)])
-        return steps * BATCH / (time.perf_counter() - start)
+            mc._fused_fwd_failed = True
+        call = mc.forward if forward else mc.update
+        call(p, t)  # compile
+        _force([m._snapshot_state() for _, m in mc.items(keep_base=True)])
 
-    fused = run(True)
-    per_member = run(False)
+        def epoch(k):
+            mc.reset()
+            start = time.perf_counter()
+            for _ in range(k):
+                call(p, t)
+            # one fetch per member state group: forces every member's chain
+            for _, m in mc.items(keep_base=True):
+                _force(m._snapshot_state())
+            return time.perf_counter() - start
+
+        t_small = epoch(3)
+        elapsed = epoch(steps + 3) - t_small
+        return steps * BATCH / elapsed
+
+    fused = run(True, forward=False)
+    per_member = run(False, forward=False)
+    fwd_fused = run(True, forward=True)
+    fwd_per_member = run(False, forward=True)
     return {
         "metric": "collection_fused_update_throughput",
         "value": round(fused, 1),
         "unit": "samples/sec",
         "vs_baseline": round(fused / per_member, 3),  # vs per-member dispatch (reference pattern)
         "members": 6,
+        "forward_value": round(fwd_fused, 1),
+        "forward_vs_per_member": round(fwd_fused / fwd_per_member, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Pallas top-k kernel vs XLA sort+scatter (the select_topk hot path)
+# ---------------------------------------------------------------------------
+def bench_topk_kernel() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.ops.select_topk import topk_mask, topk_mask_supported
+
+    n, c, k = (1024, 200, 5) if _small() else (8192, 1000, 5)
+    steps = 20 if _small() else 100
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.rand(n, c).astype(np.float32))
+
+    def xla_way(v):
+        _, idx = jax.lax.top_k(v, k)
+        zeros = jnp.zeros_like(v, dtype=jnp.int32)
+        return jnp.put_along_axis(zeros, idx, 1, axis=-1, inplace=False)
+
+    use_kernel = topk_mask_supported(x, k)
+
+    def pallas_way(v):
+        return topk_mask(v, k)
+
+    def per_step(fn):
+        def loop_fn(length):
+            @jax.jit
+            def loop(v):
+                def body(carry, _):
+                    out = fn(carry)
+                    total = jnp.sum(out)
+                    return carry + total.astype(carry.dtype) * 1e-30, total
+                _, outs = jax.lax.scan(body, v, None, length=length)
+                return outs[-1]
+            return loop
+
+        short, long_ = loop_fn(2), loop_fn(2 + steps)
+        float(short(x)); float(long_(x))
+
+        def timed(f):
+            ts = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                float(f(x))  # fetch forces execution
+                ts.append(time.perf_counter() - t0)
+            return sorted(ts)[len(ts) // 2]
+
+        return (timed(long_) - timed(short)) / steps
+
+    t_xla = per_step(xla_way)
+    t_ours = per_step(pallas_way if use_kernel else xla_way)
+    return {
+        "metric": "select_topk_throughput",
+        "value": round(n / t_ours, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(t_xla / t_ours, 3),  # vs XLA lax.top_k+scatter
+        "n": n,
+        "num_classes": c,
+        "k": k,
+        "pallas_kernel": use_kernel,
     }
 
 
@@ -678,53 +779,88 @@ def bench_compute_latency() -> dict:
     p = jnp.asarray(_preds)
     t = jnp.asarray(_target)
     mc.update(p, t)
-    jax.block_until_ready(mc.compute()["acc"])  # warmup compiles
+    _force(mc.compute()["acc"])  # warmup compiles
     times = []
     for _ in range(7):
         mc.update(p, t)  # invalidates the compute cache
+        # drain the pending update so only compute() lands in the timed region
+        for _, m in mc.items(keep_base=True):
+            _force(m._snapshot_state())
         t0 = time.perf_counter()
         out = mc.compute()
-        jax.block_until_ready(out["acc"])
+        for v in out.values():
+            np.asarray(v)  # fetch every result: the user-visible latency
         times.append((time.perf_counter() - t0) * 1000)
     return {
         "metric": "collection_compute_latency",
         "value": round(float(np.median(times)), 3),
         "unit": "ms",
         "vs_baseline": None,
+        "includes_host_fetch": True,
     }
 
 
-def main() -> None:
-    # headline measured FIRST (clean backend, comparable across rounds),
-    # emitted LAST (the driver parses the final line)
+def _headline() -> dict:
     ours = bench_ours()
     try:
         baseline = bench_reference()
         vs = round(ours / baseline, 3)
     except Exception:  # noqa: BLE001 — a baseline failure must not kill the headline
         vs = None  # report "no baseline ran", not parity
+    return {
+        "metric": "classification_collection_update_throughput",
+        "value": round(ours, 1),
+        "unit": "samples/sec",
+        "vs_baseline": vs,
+    }
 
-    for fn in (
-        bench_fid,
-        bench_bertscore,
-        bench_map,
-        bench_sync_overhead,
-        bench_collection_fused,
-        bench_compute_latency,
-    ):
-        try:
-            emit(fn())
-        except Exception as err:  # noqa: BLE001 — a config failure must not kill the headline
-            emit({"metric": fn.__name__, "error": f"{type(err).__name__}: {err}"[:200]})
 
-    emit(
-        {
-            "metric": "classification_collection_update_throughput",
-            "value": round(ours, 1),
-            "unit": "samples/sec",
-            "vs_baseline": vs,
-        }
-    )
+# per-config hard deadlines: a wedged backend (the axon tunnel can hang a
+# fetch indefinitely) must cost one config an error line, not the whole run
+_CONFIGS = [
+    ("bench_fid", 1500),
+    ("bench_bertscore", 1500),
+    ("bench_map", 1200),
+    ("bench_sync_overhead", 1500),
+    ("bench_collection_fused", 1200),
+    ("bench_topk_kernel", 1200),
+    ("bench_compute_latency", 900),
+]
+
+
+def _run_isolated(name: str, timeout_s: int) -> dict:
+    """Run one config in a subprocess: isolation + a kill-capable timeout."""
+    env = dict(os.environ)
+    env["METRICS_TPU_BENCH_CONFIG"] = name
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"metric": name, "error": f"timeout after {timeout_s}s (wedged backend?)"}
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip().startswith("{")]
+    if out.returncode != 0 or not lines:
+        return {"metric": name, "error": f"rc={out.returncode}: {out.stderr.strip()[-200:]}"}
+    return json.loads(lines[-1])
+
+
+def main() -> None:
+    single = os.environ.get("METRICS_TPU_BENCH_CONFIG")
+    if single:  # child mode: run exactly one config
+        emit(_headline() if single == "bench_headline" else globals()[single]())
+        return
+
+    # headline measured FIRST (clean backend, comparable across rounds),
+    # emitted LAST (the driver parses the final line)
+    head = _run_isolated("bench_headline", 1200)
+    for name, timeout_s in _CONFIGS:
+        emit(_run_isolated(name, timeout_s))
+    emit(head)
 
 
 if __name__ == "__main__":

@@ -49,12 +49,31 @@ class MetricCollection:
         self._fused_keys: Tuple[str, ...] = ()
         self._fused_fn: Optional[Any] = None
         self._fused_failed = False
+        self._fused_fwd_keys: Tuple[str, ...] = ()
+        self._fused_fwd_fn: Optional[Any] = None
+        self._fused_fwd_failed = False
         self.add_metrics(metrics, *additional_metrics)
 
     # -- lifecycle ------------------------------------------------------
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Call every member's ``forward`` (reference ``collections.py:106-112``)."""
-        return {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=False)}
+        """Every member's ``forward`` (reference ``collections.py:106-112``),
+        with fast-path members fused into ONE compiled program computing each
+        batch value and merged accumulator state per step."""
+        was_failed = self._fused_fwd_failed
+        fused_vals = self._fused_forward(args, kwargs)
+        out: Dict[str, Any] = {}
+        try:
+            for base, m in self._modules.items():
+                if base in fused_vals:
+                    out[self._set_name(base)] = fused_vals[base]
+                else:
+                    out[self._set_name(base)] = m(*args, **m._filter_kwargs(**kwargs))
+        except Exception:
+            # the eager retry raised too: a call-site error, not trace
+            # incompatibility — don't let it permanently disable fusion
+            self._fused_fwd_failed = was_failed
+            raise
+        return out
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
@@ -92,6 +111,80 @@ class MetricCollection:
             keys.append(k)
         # a single fusable member gains nothing over its own auto-jit path
         return tuple(keys) if len(keys) >= 2 else ()
+
+    def _forward_fusable_keys(self) -> Tuple[str, ...]:
+        """Members whose whole forward (batch value + reduce-state merge) can
+        live in one traced program: the merge fast path of ``Metric.forward``
+        with a jittable update AND compute, no step-sync, no pending sync."""
+        keys = []
+        for k in self._fusable_keys():
+            m = self._modules[k]
+            use_dance = (
+                m.full_state_update if m.full_state_update is not None else not m._states_mergeable
+            )
+            if use_dance or not m.compute_on_step or m.dist_sync_on_step or m._is_synced:
+                continue
+            keys.append(k)
+        return tuple(keys) if len(keys) >= 2 else ()
+
+    def _fused_forward(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        """Run the merge-fast-path members' forwards as one jitted program.
+
+        Returns ``{base_key: batch_value}`` for the members handled; anything
+        not in the dict falls through to per-member dispatch. Mirrors
+        ``Metric._forward_reduce_state_update`` member-for-member: batch delta
+        on a fresh state, batch value from it, merge into the accumulator.
+        """
+        from metrics_tpu.metric import _squeeze_if_scalar
+
+        if self._fused_fwd_failed:
+            return {}
+        keys = self._forward_fusable_keys()
+        if not keys:
+            return {}
+        if keys != self._fused_fwd_keys:
+            self._fused_fwd_keys = keys
+            self._fused_fwd_fn = None
+        members = [self._modules[k] for k in keys]
+        states = {k: m._snapshot_state() for k, m in zip(keys, members)}
+        member_kwargs = {k: m._filter_kwargs(**kwargs) for k, m in zip(keys, members)}
+
+        if self._fused_fwd_fn is None:
+
+            def transition(st: Dict[str, Any], a: Tuple[Any, ...], kw: Dict[str, Any]):
+                vals: Dict[str, Any] = {}
+                merged: Dict[str, Any] = {}
+                for key, member in zip(keys, members):
+                    fresh = {n: member._default_value(n) for n in member._defaults}
+                    member._restore_state(fresh)
+                    member._inner_update(*a, **kw[key])
+                    batch_state = member._snapshot_state()
+                    vals[key] = member._compute_impl()
+                    merged[key] = member.merge_states(st[key], batch_state)
+                return vals, merged
+
+            self._fused_fwd_fn = jax.jit(transition)
+
+        try:
+            vals, merged = self._fused_fwd_fn(states, args, member_kwargs)
+        except _JIT_FALLBACK_ERRORS:
+            self._fused_fwd_failed = True
+            for k, m in zip(keys, members):
+                m._restore_state(states[k])
+            return {}
+        except Exception:
+            for k, m in zip(keys, members):
+                m._restore_state(states[k])
+            raise
+        out: Dict[str, Any] = {}
+        for k, m in zip(keys, members):
+            m._restore_state(merged[k])
+            m._update_count += 1
+            m._computed = None
+            value = _squeeze_if_scalar(vals[k])
+            m._forward_cache = value
+            out[k] = value
+        return out
 
     def _fused_update(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[str, ...]:
         """Run all fusable members' updates as one jitted state transition.
@@ -200,10 +293,13 @@ class MetricCollection:
                 " with mapping input."
             )
 
-        # member set changed: rebuild (and re-allow) the fused update program
+        # member set changed: rebuild (and re-allow) the fused programs
         self._fused_keys = ()
         self._fused_fn = None
         self._fused_failed = False
+        self._fused_fwd_keys = ()
+        self._fused_fwd_fn = None
+        self._fused_fwd_failed = False
 
         if isinstance(metrics, dict):
             for name in sorted(metrics.keys()):
@@ -236,6 +332,7 @@ class MetricCollection:
         # compiled functions don't pickle/deepcopy; rebuilt lazily on use
         state = self.__dict__.copy()
         state["_fused_fn"] = None
+        state["_fused_fwd_fn"] = None
         return state
 
     @staticmethod

@@ -31,5 +31,14 @@ def pairwise_cosine_similarity(
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
     """Pairwise cosine similarity between rows of ``x`` (``[N,d]``) and ``y`` (``[M,d]``)."""
+    if reduction in ("sum", "mean"):
+        from metrics_tpu.ops.pairwise_reduce import pairwise_reduce_rows
+
+        xc, yc, zero_diag = _check_input(x, y, zero_diagonal)
+        xn = xc / jnp.linalg.norm(xc, axis=1, keepdims=True)
+        yn = yc / jnp.linalg.norm(yc, axis=1, keepdims=True)
+        fused = pairwise_reduce_rows(xn, yn, "cosine", reduction, zero_diag)
+        if fused is not None:  # opt-in Pallas path (see ops/pairwise_reduce.py)
+            return fused
     distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

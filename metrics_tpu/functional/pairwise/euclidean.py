@@ -33,5 +33,12 @@ def pairwise_euclidean_distance(
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
     """Pairwise euclidean distance between rows of ``x`` (``[N,d]``) and ``y`` (``[M,d]``)."""
+    if reduction in ("sum", "mean"):
+        from metrics_tpu.ops.pairwise_reduce import pairwise_reduce_rows
+
+        xc, yc, zero_diag = _check_input(x, y, zero_diagonal)
+        fused = pairwise_reduce_rows(xc, yc, "euclidean", reduction, zero_diag)
+        if fused is not None:  # opt-in Pallas path (see ops/pairwise_reduce.py)
+            return fused
     distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

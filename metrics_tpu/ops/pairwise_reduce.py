@@ -16,11 +16,13 @@ XLA 0.239 ms/step vs Pallas 0.268 ms/step — XLA WINS.** The hypothesis
 (XLA materializes [N, M] through HBM before reducing) is false on TPU: XLA
 output-fuses the sqrt+mask+reduce epilogue into the dot, so the matrix never
 hits HBM there either, and its MXU schedule is better than this kernel's.
-Like ``ops/binned_counts.py``, the kernel therefore stays OFF by default
-(``METRICS_TPU_FORCE_PALLAS_PAIRWISE=1`` opts in; bit-compatible results,
-covered by tests) and the honest loss is recorded here. The winning kernel
-this template produced is ``ops/select_topk.py``, where XLA's sort-based
-lowering genuinely loses.
+Like ``ops/binned_counts.py``, the kernel therefore stays OFF by default —
+``METRICS_TPU_FORCE_PALLAS_PAIRWISE=1`` opts in through
+``pairwise_{euclidean_distance,cosine_similarity}(reduction="sum"|"mean")``
+(results agree with the XLA path to ~2e-2 relative: the kernel uses a
+one-pass bf16 dot; covered by tests) — and the honest loss is recorded here.
+The winning kernel this template produced is ``ops/select_topk.py``, where
+XLA's sort-based lowering genuinely loses.
 """
 import functools
 from typing import Optional
@@ -78,8 +80,8 @@ def _pad_rows(a: Array, block: int) -> Array:
     return a
 
 
-@functools.partial(jax.jit, static_argnames=("op", "zero_diagonal"))
-def _fused_row_sums(x: Array, y: Array, op: str, zero_diagonal: bool) -> Array:
+@functools.partial(jax.jit, static_argnames=("op", "zero_diagonal", "interpret"))
+def _fused_row_sums(x: Array, y: Array, op: str, zero_diagonal: bool, interpret: bool = False) -> Array:
     n, m = x.shape[0], y.shape[0]
     xp = _pad_rows(x.astype(jnp.float32), _BLOCK_N)
     yp = _pad_rows(y.astype(jnp.float32), _BLOCK_M)
@@ -96,6 +98,7 @@ def _fused_row_sums(x: Array, y: Array, op: str, zero_diagonal: bool) -> Array:
         ],
         out_specs=pl.BlockSpec((_BLOCK_N, 1), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((xp.shape[0], 1), jnp.float32),
+        interpret=interpret,
     )(xp, yp)
     return out[:n, 0]
 
@@ -130,7 +133,9 @@ def pairwise_reduce_rows(
     force = os.environ.get("METRICS_TPU_FORCE_PALLAS_PAIRWISE") == "1"
     if reduction not in ("sum", "mean") or not fused_supported(x, y, force=force):
         return None
-    sums = _fused_row_sums(x, y, op, zero_diagonal)
+    # off-TPU the mosaic kernel can't run natively: interpret mode keeps the
+    # forced path functional (slow, correctness-only) everywhere
+    sums = _fused_row_sums(x, y, op, zero_diagonal, interpret=jax.default_backend() != "tpu")
     if reduction == "mean":
         # jnp.mean over the last axis divides by M (zeroed diagonal included)
         return sums / y.shape[0]

@@ -89,7 +89,7 @@ def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
         from metrics_tpu.ops.select_topk import topk_mask, topk_mask_supported
 
         if topk_mask_supported(moved, topk):
-            # sort-free Pallas kernel: 2.3x over lax.top_k+scatter on TPU
+            # sort-free Pallas kernel: 1.9x over lax.top_k+scatter on TPU
             # (measured verdict in ops/select_topk.py)
             scattered = topk_mask(moved, topk)
         else:

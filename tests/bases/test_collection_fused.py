@@ -196,11 +196,94 @@ def test_same_instance_under_two_keys_updates_twice():
     assert "b" not in mc._fused_keys
 
 
-def test_forward_unchanged_semantics():
-    """forward() keeps per-member dispatch; batch values still correct."""
+def test_forward_fused_matches_per_member():
+    """Fused forward must return the same batch values AND leave the same
+    accumulated states as per-member dispatch, batch after batch."""
+    mc = _stat_collection()
+    ref = _stat_collection()
+    ref._fused_failed = ref._fused_fwd_failed = True  # reference-style path
+
+    for p, t in _batches(n=3, seed=7):
+        got = mc(p, t)
+        want = ref(p, t)
+        for k in want:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]), rtol=1e-6, err_msg=f"batch value {k}"
+            )
+    assert mc._fused_fwd_fn is not None and not mc._fused_fwd_failed
+    got_final = mc.compute()
+    want_final = ref.compute()
+    for k in want_final:
+        np.testing.assert_allclose(
+            np.asarray(got_final[k]), np.asarray(want_final[k]), rtol=1e-6, err_msg=f"final {k}"
+        )
+    for k, m in mc.items(keep_base=True):
+        assert m._update_count == 3
+        assert m._forward_cache is not None
+
+
+def test_forward_fused_matches_single_metric():
     mc = _stat_collection()
     p, t = _batches(n=1)[0]
     out = mc(p, t)
     single = Accuracy(num_classes=NUM_CLASSES)
     batch_val = single(p, t)
     np.testing.assert_allclose(np.asarray(out["acc"]), np.asarray(batch_val), rtol=1e-6)
+
+
+def test_forward_dance_and_no_step_members_excluded():
+    """compute_on_step=False and full-state-dance members keep per-member
+    forward; results stay correct."""
+    dance = Accuracy(num_classes=NUM_CLASSES)
+    dance.full_state_update = True  # force the save/reset/restore dance
+    mc = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "silent": Accuracy(num_classes=NUM_CLASSES, compute_on_step=False),
+            "dance": dance,
+        }
+    )
+    p, t = _batches(n=1)[0]
+    out = mc(p, t)
+    assert out["silent"] is None  # compute_on_step=False contract
+    assert set(mc._fused_fwd_keys) == {"acc", "f1"}  # dance + silent excluded
+    ref = Accuracy(num_classes=NUM_CLASSES)
+    batch_val = ref(p, t)
+    np.testing.assert_allclose(np.asarray(out["dance"]), np.asarray(batch_val), rtol=1e-6)
+    for key in ("silent", "dance"):
+        np.testing.assert_allclose(
+            np.asarray(mc[key].compute()), np.asarray(ref.compute()), rtol=1e-6, err_msg=key
+        )
+
+
+def test_forward_call_site_error_rearms_fusion():
+    """A bad forward call must raise AND not permanently disable fusion."""
+    mc = _stat_collection()
+    p, t = _batches(n=1)[0]
+    with pytest.raises(Exception):
+        mc(p)  # missing target
+    assert not mc._fused_fwd_failed
+    mc(p, t)
+    assert mc._fused_fwd_fn is not None and not mc._fused_fwd_failed
+
+
+def test_pairwise_forced_pallas_path(monkeypatch):
+    """METRICS_TPU_FORCE_PALLAS_PAIRWISE=1 must route reduced pairwise calls
+    through the fused kernel (interpret mode off-TPU) with close results."""
+    monkeypatch.setenv("METRICS_TPU_FORCE_PALLAS_PAIRWISE", "1")
+    from metrics_tpu.functional import pairwise_cosine_similarity, pairwise_euclidean_distance
+
+    rng = np.random.RandomState(9)
+    x = jnp.asarray(rng.rand(40, 16).astype(np.float32))
+    y = jnp.asarray(rng.rand(17, 16).astype(np.float32))
+    for red in ("sum", "mean"):
+        forced = pairwise_euclidean_distance(x, y, reduction=red)
+        monkeypatch.setenv("METRICS_TPU_FORCE_PALLAS_PAIRWISE", "0")
+        plain = pairwise_euclidean_distance(x, y, reduction=red)
+        monkeypatch.setenv("METRICS_TPU_FORCE_PALLAS_PAIRWISE", "1")
+        np.testing.assert_allclose(np.asarray(forced), np.asarray(plain), rtol=2e-2)
+    got = pairwise_cosine_similarity(x, reduction="sum")  # zero_diagonal default
+    monkeypatch.setenv("METRICS_TPU_FORCE_PALLAS_PAIRWISE", "0")
+    want = pairwise_cosine_similarity(x, reduction="sum")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=1e-4)

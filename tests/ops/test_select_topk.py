@@ -101,22 +101,10 @@ def test_pairwise_fused_rows_parity():
     yn = np.sum(np.asarray(y) ** 2, axis=1)[None, :]
     dist = np.sqrt(np.clip(xn + yn - 2 * np.asarray(x) @ np.asarray(y).T, 0, None))
 
-    import metrics_tpu.ops.pairwise_reduce as pr
-
-    orig = pr.pl.pallas_call
-
-    def interp_call(*args, **kwargs):
-        kwargs.setdefault("interpret", True)
-        return orig(*args, **kwargs)
-
-    pr.pl.pallas_call = interp_call
-    try:
-        got = np.asarray(_fused_row_sums(x, y, op="euclidean", zero_diagonal=False))
-        np.testing.assert_allclose(got, dist.sum(axis=1), rtol=2e-2)  # bf16 dot
-        sq = np.asarray(x) @ np.asarray(x).T
-        xs = np.sqrt(np.clip(xn + xn.T - 2 * sq, 0, None))
-        np.fill_diagonal(xs, 0.0)
-        got_diag = np.asarray(_fused_row_sums(x, x, op="euclidean", zero_diagonal=True))
-        np.testing.assert_allclose(got_diag, xs.sum(axis=1), rtol=2e-2)
-    finally:
-        pr.pl.pallas_call = orig
+    got = np.asarray(_fused_row_sums(x, y, op="euclidean", zero_diagonal=False, interpret=True))
+    np.testing.assert_allclose(got, dist.sum(axis=1), rtol=2e-2)  # bf16 dot
+    sq = np.asarray(x) @ np.asarray(x).T
+    xs = np.sqrt(np.clip(xn + xn.T - 2 * sq, 0, None))
+    np.fill_diagonal(xs, 0.0)
+    got_diag = np.asarray(_fused_row_sums(x, x, op="euclidean", zero_diagonal=True, interpret=True))
+    np.testing.assert_allclose(got_diag, xs.sum(axis=1), rtol=2e-2)
