@@ -74,7 +74,14 @@ class BaseAggregator(Metric):
 
 
 class MaxMetric(BaseAggregator):
-    """Running max (reference ``aggregation.py:112``)."""
+    """Running max (reference ``aggregation.py:112``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MaxMetric
+        >>> print(round(float(MaxMetric()(jnp.asarray([1.0, 5.0, 3.0]))), 4))
+        5.0
+    """
 
     full_state_update = True
 
@@ -88,7 +95,14 @@ class MaxMetric(BaseAggregator):
 
 
 class MinMetric(BaseAggregator):
-    """Running min (reference ``aggregation.py:177``)."""
+    """Running min (reference ``aggregation.py:177``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MinMetric
+        >>> print(round(float(MinMetric()(jnp.asarray([1.0, 5.0, 3.0]))), 4))
+        1.0
+    """
 
     full_state_update = True
 
@@ -102,7 +116,15 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum (reference ``aggregation.py:242``)."""
+    """Running sum (reference ``aggregation.py:242``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric
+        >>> total = SumMetric()
+        >>> print(round(float(total(jnp.asarray([1.0, 2.0, 3.0]))), 4))
+        6.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
@@ -114,7 +136,17 @@ class SumMetric(BaseAggregator):
 
 
 class CatMetric(BaseAggregator):
-    """Concatenate all seen values (reference ``aggregation.py:300``)."""
+    """Concatenate all seen values (reference ``aggregation.py:300``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CatMetric
+        >>> cat = CatMetric()
+        >>> cat.update(jnp.asarray([1.0, 2.0]))
+        >>> cat.update(jnp.asarray([3.0]))
+        >>> print(cat.compute().tolist())
+        [1.0, 2.0, 3.0]
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("cat", [], nan_strategy, **kwargs)
@@ -131,7 +163,16 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean (reference ``aggregation.py:363``)."""
+    """Weighted running mean (reference ``aggregation.py:363``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric
+        >>> mean = MeanMetric()
+        >>> mean.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> print(round(float(mean.compute()), 4))
+        2.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)

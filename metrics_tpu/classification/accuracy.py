@@ -25,7 +25,21 @@ Array = jax.Array
 
 class Accuracy(StatScores):
     """Accuracy over any classification input case
-    (reference ``classification/accuracy.py:31``)."""
+    (reference ``classification/accuracy.py:31``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> accuracy = Accuracy()
+        >>> print(round(float(accuracy(preds, target)), 4))
+        0.5
+        >>> probs = jnp.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+        >>> accuracy = Accuracy()
+        >>> print(round(float(accuracy(probs, jnp.asarray([1, 0, 0]))), 4))
+        0.6667
+    """
 
     is_differentiable = False
     higher_is_better = True

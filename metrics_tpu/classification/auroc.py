@@ -17,7 +17,16 @@ Array = jax.Array
 
 
 class AUROC(Metric):
-    """Area under the ROC curve (reference ``classification/auroc.py:30``)."""
+    """Area under the ROC curve (reference ``classification/auroc.py:30``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUROC
+        >>> auroc = AUROC()
+        >>> auroc.update(jnp.asarray([0.1, 0.4, 0.35, 0.8]), jnp.asarray([0, 0, 1, 1]))
+        >>> print(round(float(auroc.compute()), 4))
+        0.75
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -19,7 +19,16 @@ Array = jax.Array
 
 
 class AveragePrecision(Metric):
-    """Average precision score (reference ``classification/avg_precision.py:25``)."""
+    """Average precision score (reference ``classification/avg_precision.py:25``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AveragePrecision
+        >>> ap = AveragePrecision()
+        >>> ap.update(jnp.asarray([0.1, 0.4, 0.6, 0.9]), jnp.asarray([0, 0, 1, 1]))
+        >>> print(round(float(ap.compute()), 4))
+        1.0
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -116,7 +116,15 @@ class BinnedPrecisionRecallCurve(Metric):
 
 
 class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
-    """Average precision from the binned curve (reference ``:232``)."""
+    """Average precision from the binned curve (reference ``:232``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedAveragePrecision
+        >>> bap = BinnedAveragePrecision(num_classes=1, thresholds=5)
+        >>> print(round(float(bap(jnp.asarray([0.1, 0.4, 0.6, 0.9]), jnp.asarray([0, 0, 1, 1]))), 4))
+        1.0
+    """
 
     def compute(self) -> Union[List[Array], Array]:  # type: ignore[override]
         precisions, recalls, _ = super().compute()

@@ -18,7 +18,15 @@ Array = jax.Array
 
 
 class CalibrationError(Metric):
-    """Top-label calibration error (reference ``classification/calibration_error.py:23``)."""
+    """Top-label calibration error (reference ``classification/calibration_error.py:23``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CalibrationError
+        >>> ece = CalibrationError(n_bins=3)
+        >>> print(round(float(ece(jnp.asarray([0.3, 0.6, 0.9, 0.6]), jnp.asarray([0, 1, 1, 0]))), 4))
+        0.15
+    """
 
     is_differentiable = False
     higher_is_better = False

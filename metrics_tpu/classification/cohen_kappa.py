@@ -14,7 +14,15 @@ Array = jax.Array
 
 
 class CohenKappa(Metric):
-    """Cohen's kappa (reference ``classification/cohen_kappa.py:23``)."""
+    """Cohen's kappa (reference ``classification/cohen_kappa.py:23``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CohenKappa
+        >>> kappa = CohenKappa(num_classes=2)
+        >>> print(round(float(kappa(jnp.asarray([0, 1, 0, 1]), jnp.asarray([0, 1, 1, 1]))), 4))
+        0.5
+    """
 
     is_differentiable = False
     higher_is_better = True

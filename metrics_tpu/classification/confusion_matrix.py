@@ -19,7 +19,16 @@ Array = jax.Array
 
 
 class ConfusionMatrix(Metric):
-    """Streaming confusion matrix (reference ``classification/confusion_matrix.py:26``)."""
+    """Streaming confusion matrix (reference ``classification/confusion_matrix.py:26``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ConfusionMatrix
+        >>> confmat = ConfusionMatrix(num_classes=2)
+        >>> out = confmat(jnp.asarray([0, 1, 0, 1]), jnp.asarray([0, 1, 1, 1]))
+        >>> print(out.tolist())
+        [[1, 0], [1, 2]]
+    """
 
     is_differentiable = False
     higher_is_better = None

@@ -15,7 +15,15 @@ Array = jax.Array
 
 class FBetaScore(StatScores):
     """Weighted harmonic mean of precision and recall
-    (reference ``f_beta.py:26``)."""
+    (reference ``f_beta.py:26``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import FBetaScore
+        >>> fbeta = FBetaScore(num_classes=3, beta=0.5, average='macro')
+        >>> print(round(float(fbeta(jnp.asarray([0, 2, 1, 0]), jnp.asarray([0, 1, 2, 0]))), 4))
+        0.3333
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -54,7 +62,15 @@ class FBetaScore(StatScores):
 
 
 class F1Score(FBetaScore):
-    """F1 = FBeta(beta=1) (reference ``f_beta.py:176``)."""
+    """F1 = FBeta(beta=1) (reference ``f_beta.py:176``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import F1Score
+        >>> f1 = F1Score(num_classes=3, average='macro')
+        >>> print(round(float(f1(jnp.asarray([0, 2, 1, 0]), jnp.asarray([0, 1, 2, 0]))), 4))
+        0.3333
+    """
 
     is_differentiable = False
     higher_is_better = True

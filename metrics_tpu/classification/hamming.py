@@ -15,7 +15,15 @@ Array = jax.Array
 
 class HammingDistance(Metric):
     """Average Hamming distance/loss between targets and predictions
-    (reference ``classification/hamming.py:24``)."""
+    (reference ``classification/hamming.py:24``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HammingDistance
+        >>> hamming = HammingDistance()
+        >>> print(round(float(hamming(jnp.asarray([[0, 1], [1, 1]]), jnp.asarray([[0, 1], [0, 1]]))), 4))
+        0.25
+    """
 
     is_differentiable = False
     higher_is_better = False

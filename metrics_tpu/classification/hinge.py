@@ -15,7 +15,15 @@ Array = jax.Array
 
 class HingeLoss(Metric):
     """Mean hinge loss, binary / Crammer-Singer / one-vs-all
-    (reference ``classification/hinge.py:25``)."""
+    (reference ``classification/hinge.py:25``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HingeLoss
+        >>> hinge = HingeLoss()
+        >>> print(round(float(hinge(jnp.asarray([0.5, -1.0, 2.0]), jnp.asarray([1, 0, 1]))), 4))
+        0.1667
+    """
 
     is_differentiable = True
     higher_is_better = False

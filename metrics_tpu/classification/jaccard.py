@@ -15,7 +15,15 @@ Array = jax.Array
 
 class JaccardIndex(ConfusionMatrix):
     """Intersection-over-union from a streaming confusion matrix
-    (reference ``classification/jaccard.py:24``)."""
+    (reference ``classification/jaccard.py:24``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import JaccardIndex
+        >>> jaccard = JaccardIndex(num_classes=2)
+        >>> print(round(float(jaccard(jnp.asarray([0, 1, 0, 1]), jnp.asarray([0, 1, 1, 1]))), 4))
+        0.5833
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -15,7 +15,15 @@ Array = jax.Array
 
 
 class KLDivergence(Metric):
-    """KL divergence D_KL(P||Q) (reference ``classification/kl_divergence.py:24``)."""
+    """KL divergence D_KL(P||Q) (reference ``classification/kl_divergence.py:24``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import KLDivergence
+        >>> kl = KLDivergence()
+        >>> print(round(float(kl(jnp.asarray([[0.3, 0.7]]), jnp.asarray([[0.5, 0.5]]))), 4))
+        0.0823
+    """
 
     is_differentiable = True
     higher_is_better = False

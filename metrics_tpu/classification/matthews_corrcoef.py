@@ -17,7 +17,15 @@ Array = jax.Array
 
 
 class MatthewsCorrCoef(Metric):
-    """Matthews correlation coefficient (reference ``classification/matthews_corrcoef.py:22``)."""
+    """Matthews correlation coefficient (reference ``classification/matthews_corrcoef.py:22``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MatthewsCorrCoef
+        >>> mcc = MatthewsCorrCoef(num_classes=2)
+        >>> print(round(float(mcc(jnp.asarray([0, 1, 0, 1]), jnp.asarray([0, 1, 1, 1]))), 4))
+        0.5774
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -14,7 +14,17 @@ Array = jax.Array
 
 
 class Precision(StatScores):
-    """Precision = TP / (TP + FP) (reference ``precision_recall.py:26``)."""
+    """Precision = TP / (TP + FP) (reference ``precision_recall.py:26``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Precision
+        >>> preds = jnp.asarray([0, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> precision = Precision(num_classes=3, average='macro')
+        >>> print(round(float(precision(preds, target)), 4))
+        0.3333
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -51,7 +61,15 @@ class Precision(StatScores):
 
 
 class Recall(StatScores):
-    """Recall = TP / (TP + FN) (reference ``precision_recall.py:168``)."""
+    """Recall = TP / (TP + FN) (reference ``precision_recall.py:168``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Recall
+        >>> recall = Recall(num_classes=3, average='macro')
+        >>> print(round(float(recall(jnp.asarray([0, 2, 1, 0]), jnp.asarray([0, 1, 2, 0]))), 4))
+        0.3333
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -13,7 +13,15 @@ Array = jax.Array
 
 
 class Specificity(StatScores):
-    """Specificity = TN / (TN + FP) (reference ``specificity.py:26``)."""
+    """Specificity = TN / (TN + FP) (reference ``specificity.py:26``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Specificity
+        >>> spec = Specificity(num_classes=3, average='macro')
+        >>> print(round(float(spec(jnp.asarray([0, 2, 1, 0]), jnp.asarray([0, 1, 2, 0]))), 4))
+        0.7778
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -19,7 +19,17 @@ Array = jax.Array
 
 class StatScores(Metric):
     """Computes [tp, fp, tn, fn, support] with micro/macro/samples reduction
-    (reference ``classification/stat_scores.py:24``)."""
+    (reference ``classification/stat_scores.py:24``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import StatScores
+        >>> metric = StatScores()
+        >>> # binary labels count both classes under micro reduction
+        >>> out = metric(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 0, 1]))
+        >>> print(out.tolist())  # [tp, fp, tn, fn, support]
+        [3, 1, 3, 1, 4]
+    """
 
     is_differentiable = False
     higher_is_better = None

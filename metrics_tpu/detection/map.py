@@ -87,6 +87,18 @@ class MeanAveragePrecision(Metric):
     Boxes are Pascal VOC xyxy by default (``box_format`` converts). Returns
     the 12 COCO scalars plus optional per-class values, exactly as the
     reference's ``COCOMetricResults`` (``map.py:64``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAveragePrecision
+        >>> metric = MeanAveragePrecision()
+        >>> metric.update(
+        ...     [dict(boxes=jnp.asarray([[10.0, 10.0, 60.0, 60.0]]),
+        ...           scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))],
+        ...     [dict(boxes=jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), labels=jnp.asarray([0]))],
+        ... )
+        >>> print(round(float(metric.compute()['map']), 4))
+        1.0
     """
 
     is_differentiable = False

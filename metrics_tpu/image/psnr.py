@@ -17,6 +17,15 @@ class PeakSignalNoiseRatio(Metric):
     With ``dim=None`` the states are O(1) sum counters; with ``dim`` set the
     per-batch scores are buffered (cat states), mirroring the reference
     (``image/psnr.py:81-86``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PeakSignalNoiseRatio
+        >>> target = jnp.ones((1, 1, 8, 8)) * 0.5
+        >>> preds = target.at[0, 0, 0, 0].set(0.6)
+        >>> psnr = PeakSignalNoiseRatio(data_range=1.0)
+        >>> print(round(float(psnr(preds, target)), 2))
+        38.06
     """
 
     is_differentiable = True

@@ -20,7 +20,17 @@ Array = jax.Array
 class StructuralSimilarityIndexMeasure(Metric):
     """SSIM with full-stream exactness: preds/target are buffered so a
     ``data_range`` inferred from data spans the WHOLE stream, exactly like the
-    reference (``image/ssim.py:85-96``, which warns about the memory cost)."""
+    reference (``image/ssim.py:85-96``, which warns about the memory cost).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import StructuralSimilarityIndexMeasure
+        >>> target = jnp.ones((1, 1, 8, 8)) * 0.5
+        >>> preds = target.at[0, 0, 0, 0].set(0.6)
+        >>> ssim = StructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> print(round(float(ssim(preds, target)), 4))
+        0.9523
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -14,7 +14,15 @@ Array = jax.Array
 
 
 class CosineSimilarity(Metric):
-    """Row-wise cosine similarity, buffered so any reduction can apply at compute."""
+    """Row-wise cosine similarity, buffered so any reduction can apply at compute.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CosineSimilarity
+        >>> cosine = CosineSimilarity(reduction='mean')
+        >>> print(round(float(cosine(jnp.asarray([[1.0, 0.0]]), jnp.asarray([[0.6, 0.8]]))), 4))
+        0.6
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -15,7 +15,15 @@ Array = jax.Array
 
 
 class ExplainedVariance(Metric):
-    """Explained variance with streaming sum states."""
+    """Explained variance with streaming sum states.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ExplainedVariance
+        >>> ev = ExplainedVariance()
+        >>> print(round(float(ev(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4))
+        0.9572
+    """
 
     is_differentiable = True
     higher_is_better = True

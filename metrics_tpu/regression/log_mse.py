@@ -14,7 +14,15 @@ Array = jax.Array
 
 
 class MeanSquaredLogError(Metric):
-    """Mean squared logarithmic error."""
+    """Mean squared logarithmic error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredLogError
+        >>> msle = MeanSquaredLogError()
+        >>> print(round(float(msle(jnp.asarray([1.0, 2.0]), jnp.asarray([1.5, 2.5]))), 4))
+        0.0368
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -11,7 +11,15 @@ Array = jax.Array
 
 
 class MeanAbsoluteError(Metric):
-    """Mean absolute error."""
+    """Mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsoluteError
+        >>> mae = MeanAbsoluteError()
+        >>> print(round(float(mae(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4))
+        0.5
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -14,7 +14,15 @@ Array = jax.Array
 
 
 class MeanAbsolutePercentageError(Metric):
-    """Mean absolute percentage error."""
+    """Mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsolutePercentageError
+        >>> mape = MeanAbsolutePercentageError()
+        >>> print(round(float(mape(jnp.asarray([2.0, 4.0]), jnp.asarray([1.0, 5.0]))), 4))
+        0.6
+    """
 
     is_differentiable = True
     higher_is_better = False

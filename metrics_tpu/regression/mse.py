@@ -11,7 +11,17 @@ Array = jax.Array
 
 
 class MeanSquaredError(Metric):
-    """Mean squared error (RMSE with ``squared=False``)."""
+    """Mean squared error (RMSE with ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError
+        >>> mse = MeanSquaredError()
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> print(round(float(mse(preds, target)), 4))
+        0.375
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -11,7 +11,15 @@ Array = jax.Array
 
 
 class R2Score(Metric):
-    """R² score with per-output streaming sums."""
+    """R² score with per-output streaming sums.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import R2Score
+        >>> r2 = R2Score()
+        >>> print(round(float(r2(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4))
+        0.9486
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -12,7 +12,15 @@ Array = jax.Array
 
 
 class SpearmanCorrCoef(Metric):
-    """Spearman rank correlation; buffers the full stream (rank transform is global)."""
+    """Spearman rank correlation; buffers the full stream (rank transform is global).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SpearmanCorrCoef
+        >>> spearman = SpearmanCorrCoef()
+        >>> print(round(float(spearman(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4))
+        1.0
+    """
 
     is_differentiable = False
     higher_is_better = True

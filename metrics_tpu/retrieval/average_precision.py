@@ -9,7 +9,18 @@ Array = jax.Array
 
 
 class RetrievalMAP(RetrievalMetric):
-    """Mean average precision over queries."""
+    """Mean average precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.9, 0.3, 0.5, 0.8, 0.2])
+        >>> target = jnp.asarray([1, 0, 1, 0, 1])
+        >>> from metrics_tpu import RetrievalMAP
+        >>> rmap = RetrievalMAP()
+        >>> print(round(float(rmap(preds, target, indexes=indexes)), 4))
+        0.75
+    """
 
     def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
         return _average_precision_grouped(g)

@@ -11,7 +11,18 @@ Array = jax.Array
 
 
 class RetrievalNormalizedDCG(_TopKRetrievalMetric):
-    """Mean NDCG@k over queries; targets may be graded relevance scores."""
+    """Mean NDCG@k over queries; targets may be graded relevance scores.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.9, 0.3, 0.5, 0.8, 0.2])
+        >>> target = jnp.asarray([1, 0, 1, 0, 1])
+        >>> from metrics_tpu import RetrievalNormalizedDCG
+        >>> ndcg = RetrievalNormalizedDCG()
+        >>> print(round(float(ndcg(preds, target, indexes=indexes)), 4))
+        0.8155
+    """
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None, k: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, k=k, **kwargs)

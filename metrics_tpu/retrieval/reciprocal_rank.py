@@ -9,7 +9,18 @@ Array = jax.Array
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean reciprocal rank over queries."""
+    """Mean reciprocal rank over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.9, 0.3, 0.5, 0.8, 0.2])
+        >>> target = jnp.asarray([1, 0, 1, 0, 1])
+        >>> from metrics_tpu import RetrievalMRR
+        >>> mrr = RetrievalMRR()
+        >>> print(round(float(mrr(preds, target, indexes=indexes)), 4))
+        0.75
+    """
 
     def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
         return _reciprocal_rank_grouped(g)

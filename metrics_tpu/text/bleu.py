@@ -11,7 +11,15 @@ Array = jax.Array
 
 
 class BLEUScore(Metric):
-    """Streaming corpus-level BLEU with device-array n-gram counters."""
+    """Streaming corpus-level BLEU with device-array n-gram counters.
+
+    Example:
+        >>> from metrics_tpu import BLEUScore
+        >>> bleu = BLEUScore()
+        >>> score = bleu(['the quick brown fox jumps high'], [['the quick brown fox leaps high']])
+        >>> print(round(float(score), 4))
+        0.5373
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -11,7 +11,14 @@ Array = jax.Array
 
 
 class CharErrorRate(Metric):
-    """Streaming character error rate over transcript batches."""
+    """Streaming character error rate over transcript batches.
+
+    Example:
+        >>> from metrics_tpu import CharErrorRate
+        >>> cer = CharErrorRate()
+        >>> print(round(float(cer(['this is the prediction'], ['this is the reference'])), 4))
+        0.381
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -16,6 +16,12 @@ class CHRFScore(Metric):
     The reference registers one scalar state per (role, order) pair
     (``text/chrf.py:139-141``); here each role is a single ``[order]`` vector
     state, so sync is six collectives regardless of n-gram order.
+
+    Example:
+        >>> from metrics_tpu import CHRFScore
+        >>> chrf = CHRFScore()
+        >>> print(round(float(chrf(['the cat sat'], [['the fat cat sat']])), 4))
+        0.4906
     """
 
     is_differentiable = False

@@ -18,7 +18,15 @@ Array = jax.Array
 
 class ROUGEScore(Metric):
     """Streaming ROUGE with per-sample score buffers (one list state per
-    ``<key>_<stat>`` pair, mirroring reference ``text/rouge.py:131``)."""
+    ``<key>_<stat>`` pair, mirroring reference ``text/rouge.py:131``).
+
+    Example:
+        >>> from metrics_tpu import ROUGEScore
+        >>> rouge = ROUGEScore()
+        >>> scores = rouge(['My name is John'], ['Is your name John'])
+        >>> print(round(float(scores['rouge1_fmeasure']), 4))
+        0.75
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -17,7 +17,17 @@ Array = jax.Array
 
 
 class SQuAD(Metric):
-    """Streaming SQuAD exact-match / F1 over question-answering batches."""
+    """Streaming SQuAD exact-match / F1 over question-answering batches.
+
+    Example:
+        >>> from metrics_tpu import SQuAD
+        >>> squad = SQuAD()
+        >>> preds = [{'prediction_text': '1976', 'id': '56e10a3be3433e1400422b22'}]
+        >>> target = [{'answers': {'answer_start': [97], 'text': ['1976']}, 'id': '56e10a3be3433e1400422b22'}]
+        >>> out = squad(preds, target)
+        >>> print(round(float(out['exact_match']), 1), round(float(out['f1']), 1))
+        100.0 100.0
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -11,7 +11,14 @@ Array = jax.Array
 
 
 class TranslationEditRate(Metric):
-    """Streaming corpus-level TER with scalar edit/length counters."""
+    """Streaming corpus-level TER with scalar edit/length counters.
+
+    Example:
+        >>> from metrics_tpu import TranslationEditRate
+        >>> ter = TranslationEditRate()
+        >>> print(round(float(ter(['the cat sat on the mat'], [['the fat cat sat on a mat']])), 4))
+        0.2857
+    """
 
     is_differentiable = False
     higher_is_better = False

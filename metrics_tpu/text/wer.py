@@ -11,7 +11,16 @@ Array = jax.Array
 
 
 class WordErrorRate(Metric):
-    """Streaming word error rate over transcript batches."""
+    """Streaming word error rate over transcript batches.
+
+    Example:
+        >>> from metrics_tpu import WordErrorRate
+        >>> wer = WordErrorRate()
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> print(round(float(wer(preds, target)), 4))
+        0.5
+    """
 
     is_differentiable = False
     higher_is_better = False

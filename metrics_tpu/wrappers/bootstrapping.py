@@ -63,6 +63,14 @@ class BootStrapper(Metric):
             variable-length resamples) or ``"multinomial"`` (fixed-length,
             enables the single-dispatch vmap fast path).
         seed: host RNG seed for resampling.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BootStrapper, MeanSquaredError
+        >>> boot = BootStrapper(MeanSquaredError(), num_bootstraps=20)
+        >>> boot.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> print(sorted(boot.compute().keys()))
+        ['mean', 'std']
     """
 
     full_state_update = True

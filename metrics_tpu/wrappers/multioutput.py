@@ -38,6 +38,14 @@ class MultioutputWrapper(Metric):
 
     ``compute`` returns a list of per-output values — no aggregation across
     outputs, mirroring the reference contract.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError, MultioutputWrapper
+        >>> mo = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> out = mo(jnp.asarray([[1.0, 10.0], [2.0, 20.0]]), jnp.asarray([[1.0, 11.0], [2.0, 22.0]]))
+        >>> print([round(float(v), 2) for v in out])
+        [0.0, 2.5]
     """
 
     is_differentiable = False

@@ -1,0 +1,38 @@
+"""Run every ``Example::`` doctest in metrics_tpu docstrings.
+
+The reference runs sphinx doctests over its per-metric Example sections in
+CI; this is the same contract for the JAX build — docstring examples are
+executed code. Outputs are rounded in the examples so both dtype lanes print
+identically.
+"""
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import metrics_tpu
+
+_MODULES = sorted(
+    info.name
+    for info in pkgutil.walk_packages(metrics_tpu.__path__, prefix="metrics_tpu.")
+    if not info.ispkg
+)
+
+
+def _collect():
+    cases = []
+    finder = doctest.DocTestFinder(exclude_empty=True)
+    for name in _MODULES:
+        mod = importlib.import_module(name)
+        for test in finder.find(mod, module=mod):
+            if test.examples:
+                cases.append(pytest.param(test, id=test.name))
+    return cases
+
+
+@pytest.mark.parametrize("dtest", _collect())
+def test_docstring_example(dtest):
+    runner = doctest.DocTestRunner(optionflags=doctest.NORMALIZE_WHITESPACE | doctest.ELLIPSIS)
+    result = runner.run(dtest)
+    assert result.failed == 0, f"{dtest.name}: {result.failed} doctest failure(s)"
