@@ -17,6 +17,17 @@ class PermutationInvariantTraining(Metric):
         metric_func: batch-mapped metric, ``metric_func(preds[:, i], target[:, j]) -> [batch]``.
         eval_func: ``"max"`` or ``"min"``.
         kwargs passed with ``metric_func`` are forwarded to it on every update.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import PermutationInvariantTraining
+        >>> from metrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+        >>> target = jnp.asarray(np.random.RandomState(0).normal(size=(1, 2, 64)).astype(np.float32))
+        >>> preds = target[:, ::-1, :]  # speakers swapped
+        >>> pit = PermutationInvariantTraining(scale_invariant_signal_noise_ratio, eval_func='max')
+        >>> print(float(pit(preds, target)) > 40)  # perfect after permutation
+        True
     """
 
     is_differentiable = True

@@ -14,7 +14,15 @@ Array = jax.Array
 
 
 class AUC(Metric):
-    """Area under any accumulated curve (reference ``classification/auc.py:22``)."""
+    """Area under any accumulated curve (reference ``classification/auc.py:22``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUC
+        >>> auc = AUC()
+        >>> print(round(float(auc(jnp.asarray([0.0, 0.5, 1.0]), jnp.asarray([0.0, 0.5, 1.0]))), 4))
+        0.5
+    """
 
     is_differentiable = False
     higher_is_better = None

@@ -20,7 +20,17 @@ Array = jax.Array
 
 class PrecisionRecallCurve(Metric):
     """Precision-recall pairs at all distinct thresholds
-    (reference ``classification/precision_recall_curve.py:27``)."""
+    (reference ``classification/precision_recall_curve.py:27``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PrecisionRecallCurve
+        >>> prc = PrecisionRecallCurve()
+        >>> prc.update(jnp.asarray([0.1, 0.4, 0.6, 0.9]), jnp.asarray([0, 0, 1, 1]))
+        >>> precision, recall, thresholds = prc.compute()
+        >>> print([round(float(v), 2) for v in precision], [round(float(v), 2) for v in recall])
+        [1.0, 1.0, 1.0] [1.0, 0.5, 0.0]
+    """
 
     is_differentiable = False
     higher_is_better = None

@@ -16,7 +16,19 @@ Array = jax.Array
 
 
 class ROC(Metric):
-    """Receiver operating characteristic curve (reference ``classification/roc.py:25``)."""
+    """Receiver operating characteristic curve (reference ``classification/roc.py:25``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ROC
+        >>> roc = ROC()
+        >>> roc.update(jnp.asarray([0.1, 0.4, 0.35, 0.8]), jnp.asarray([0, 0, 1, 1]))
+        >>> fpr, tpr, thresholds = roc.compute()
+        >>> print([round(float(v), 2) for v in fpr])
+        [0.0, 0.0, 0.5, 0.5, 1.0]
+        >>> print([round(float(v), 2) for v in tpr])
+        [0.0, 0.5, 0.5, 1.0, 1.0]
+    """
 
     is_differentiable = False
     higher_is_better = None

@@ -34,6 +34,14 @@ class MetricCollection:
         additional_metrics: more metrics appended to a single/sequence input.
         prefix: string prepended to all result keys.
         postfix: string appended to all result keys.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, F1Score, MetricCollection
+        >>> mc = MetricCollection({'acc': Accuracy(), 'f1': F1Score(num_classes=2, average='macro')})
+        >>> out = mc(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 0, 1]))
+        >>> print({k: round(float(v), 4) for k, v in sorted(out.items())})
+        {'acc': 0.75, 'f1': 0.7333}
     """
 
     def __init__(

@@ -92,6 +92,19 @@ class FrechetInceptionDistance(Metric):
             ``metrics_tpu.image.networks.convert_torch_inception_checkpoint``);
             falls back to ``$METRICS_TPU_INCEPTION_WEIGHTS``. Only used when
             ``feature`` is an int.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import FrechetInceptionDistance
+        >>> def extractor(imgs):  # any callable imgs -> [N, d]
+        ...     return jnp.asarray(imgs, jnp.float32).reshape(imgs.shape[0], -1)[:, :8]
+        >>> fid = FrechetInceptionDistance(feature=extractor, feature_dim=8)
+        >>> rng = np.random.RandomState(0)
+        >>> fid.update(jnp.asarray(rng.rand(32, 3, 8, 8)), real=True)
+        >>> fid.update(jnp.asarray(rng.rand(32, 3, 8, 8)), real=False)
+        >>> print(round(float(fid.compute()), 2))
+        0.12
     """
 
     is_differentiable = False

@@ -17,7 +17,15 @@ Array = jax.Array
 
 
 class SymmetricMeanAbsolutePercentageError(Metric):
-    """Symmetric mean absolute percentage error."""
+    """Symmetric mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SymmetricMeanAbsolutePercentageError
+        >>> smape = SymmetricMeanAbsolutePercentageError()
+        >>> print(round(float(smape(jnp.asarray([2.0, 4.0]), jnp.asarray([1.0, 5.0]))), 4))
+        0.4444
+    """
 
     is_differentiable = True
     higher_is_better = False

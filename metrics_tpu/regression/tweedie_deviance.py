@@ -14,7 +14,15 @@ Array = jax.Array
 
 
 class TweedieDevianceScore(Metric):
-    """Tweedie deviance score (power 0=MSE, 1=Poisson, 2=Gamma, else compound)."""
+    """Tweedie deviance score (power 0=MSE, 1=Poisson, 2=Gamma, else compound).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import TweedieDevianceScore
+        >>> tweedie = TweedieDevianceScore(power=1.0)
+        >>> print(round(float(tweedie(jnp.asarray([2.0, 4.0]), jnp.asarray([1.0, 5.0]))), 4))
+        0.4226
+    """
 
     is_differentiable = True
     higher_is_better = False

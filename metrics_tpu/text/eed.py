@@ -11,7 +11,14 @@ Array = jax.Array
 
 
 class ExtendedEditDistance(Metric):
-    """Streaming EED with a per-sentence score buffer."""
+    """Streaming EED with a per-sentence score buffer.
+
+    Example:
+        >>> from metrics_tpu import ExtendedEditDistance
+        >>> eed = ExtendedEditDistance()
+        >>> print(round(float(eed(['this is a prediction'], [['this is a reference']])), 4))
+        0.4146
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -11,7 +11,14 @@ Array = jax.Array
 
 
 class MatchErrorRate(Metric):
-    """Streaming match error rate over transcript batches."""
+    """Streaming match error rate over transcript batches.
+
+    Example:
+        >>> from metrics_tpu import MatchErrorRate
+        >>> mer = MatchErrorRate()
+        >>> print(round(float(mer(['hello world'], ['hello there world'])), 4))
+        0.3333
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -6,7 +6,14 @@ from metrics_tpu.text.bleu import BLEUScore
 
 
 class SacreBLEUScore(BLEUScore):
-    """Streaming corpus-level SacreBLEU: BLEU with canonical tokenization."""
+    """Streaming corpus-level SacreBLEU: BLEU with canonical tokenization.
+
+    Example:
+        >>> from metrics_tpu import SacreBLEUScore
+        >>> sacre = SacreBLEUScore()
+        >>> print(round(float(sacre(['the quick brown fox jumps high'], [['the quick brown fox leaps high']])), 4))
+        0.5373
+    """
 
     def __init__(
         self,

@@ -11,7 +11,14 @@ Array = jax.Array
 
 
 class WordInfoLost(Metric):
-    """Streaming word-information-lost score over transcript batches."""
+    """Streaming word-information-lost score over transcript batches.
+
+    Example:
+        >>> from metrics_tpu import WordInfoLost
+        >>> wil = WordInfoLost()
+        >>> print(round(float(wil(['hello world'], ['hello there world'])), 4))
+        0.3333
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -11,7 +11,14 @@ Array = jax.Array
 
 
 class WordInfoPreserved(Metric):
-    """Streaming word-information-preserved score over transcript batches."""
+    """Streaming word-information-preserved score over transcript batches.
+
+    Example:
+        >>> from metrics_tpu import WordInfoPreserved
+        >>> wip = WordInfoPreserved()
+        >>> print(round(float(wip(['hello world'], ['hello there world'])), 4))
+        0.6667
+    """
 
     is_differentiable = False
     higher_is_better = True

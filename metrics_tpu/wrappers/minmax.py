@@ -17,7 +17,18 @@ Array = jax.Array
 
 
 class MinMaxMetric(Metric):
-    """Return ``{"raw", "min", "max"}`` of the wrapped metric each compute."""
+    """Return ``{"raw", "min", "max"}`` of the wrapped metric each compute.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric, MinMaxMetric
+        >>> mm = MinMaxMetric(MeanMetric())
+        >>> mm.update(jnp.asarray([1.0]))
+        >>> _ = mm.compute()
+        >>> mm.update(jnp.asarray([3.0]))
+        >>> print({k: round(float(v), 2) for k, v in mm.compute().items()})
+        {'raw': 2.0, 'max': 2.0, 'min': 1.0}
+    """
 
     full_state_update = True
 

@@ -20,7 +20,18 @@ Array = jax.Array
 class MetricTracker:
     """Keep one metric (or collection) instance per tracked step; route the
     standard lifecycle methods to the newest one. With a ``MetricCollection``
-    base, ``compute_all``/``best_metric`` return per-member dicts."""
+    base, ``compute_all``/``best_metric`` return per-member dicts.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError, MetricTracker
+        >>> tracker = MetricTracker(MeanSquaredError(), maximize=False)
+        >>> for noise in (0.5, 0.1, 0.3):
+        ...     tracker.increment()
+        ...     tracker.update(jnp.asarray([1.0 + noise]), jnp.asarray([1.0]))
+        >>> print(round(float(tracker.best_metric()), 4))
+        0.01
+    """
 
     def __init__(
         self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True
