@@ -14,7 +14,18 @@ Array = jax.Array
 
 
 class SignalDistortionRatio(Metric):
-    """Streaming mean filter-invariant SDR (states ``sum_sdr/total``)."""
+    """Streaming mean filter-invariant SDR (states ``sum_sdr/total``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> target = jnp.asarray(np.sin(np.arange(200) / 7.0).astype(np.float32))
+        >>> noise = jnp.asarray(np.cos(np.arange(200) / 3.0).astype(np.float32))
+        >>> from metrics_tpu import SignalDistortionRatio
+        >>> sdr = SignalDistortionRatio()
+        >>> print(round(float(sdr((target + 0.1 * noise)[None], target[None])), 2))
+        22.47
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -47,7 +58,18 @@ class SignalDistortionRatio(Metric):
 
 
 class ScaleInvariantSignalDistortionRatio(Metric):
-    """Streaming mean SI-SDR (reference ``audio/sdr.py:141``)."""
+    """Streaming mean SI-SDR (reference ``audio/sdr.py:141``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> target = jnp.asarray(np.sin(np.arange(200) / 7.0).astype(np.float32))
+        >>> noise = jnp.asarray(np.cos(np.arange(200) / 3.0).astype(np.float32))
+        >>> from metrics_tpu import ScaleInvariantSignalDistortionRatio
+        >>> si_sdr = ScaleInvariantSignalDistortionRatio()
+        >>> print(round(float(si_sdr(target + 0.1 * noise, target)), 4))
+        19.9175
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -134,7 +134,16 @@ class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
 
 
 class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
-    """Highest recall at a minimum precision (reference ``:285``)."""
+    """Highest recall at a minimum precision (reference ``:285``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedRecallAtFixedPrecision
+        >>> brfp = BinnedRecallAtFixedPrecision(num_classes=1, min_precision=0.5, thresholds=5)
+        >>> recall, threshold = brfp(jnp.asarray([0.1, 0.4, 0.6, 0.9]), jnp.asarray([0, 0, 1, 1]))
+        >>> print(round(float(recall), 4), round(float(threshold), 4))
+        1.0 0.5
+    """
 
     def __init__(
         self,

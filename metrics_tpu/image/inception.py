@@ -32,6 +32,17 @@ class InceptionScore(Metric):
         splits: number of chunks to compute the score over.
         seed: host RNG seed for the pre-split shuffle.
         weights_path: local InceptionV3 ``.npz`` weights for the default.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import InceptionScore
+        >>> constant_logits = lambda imgs: jnp.tile(jnp.asarray([[0.1, 0.9]]), (imgs.shape[0], 1))
+        >>> inception = InceptionScore(feature=constant_logits)
+        >>> inception.update(jnp.asarray(np.random.RandomState(0).rand(16, 3, 8, 8)))
+        >>> mean, std = inception.compute()  # constant predictions -> IS of 1
+        >>> print(round(float(mean), 4))
+        1.0
     """
 
     is_differentiable = False

@@ -13,7 +13,18 @@ Array = jax.Array
 
 class RetrievalFallOut(_TopKRetrievalMetric):
     """Mean fall-out@k over queries. Lower is better; a query is "empty" when
-    it has no *negative* targets (reference ``fall_out.py:120-133``)."""
+    it has no *negative* targets (reference ``fall_out.py:120-133``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalFallOut
+        >>> fallout = RetrievalFallOut(k=2)
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.9, 0.3, 0.5, 0.8, 0.2])
+        >>> target = jnp.asarray([1, 0, 1, 0, 1])
+        >>> print(round(float(fallout(preds, target, indexes=indexes)), 4))
+        0.5
+    """
 
     higher_is_better = False
 
