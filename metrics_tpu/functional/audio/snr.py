@@ -14,7 +14,16 @@ Array = jax.Array
 
 
 def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
-    """SNR = 10 log10(||target||^2 / ||target - preds||^2), shape ``[..., time] -> [...]``."""
+    """SNR = 10 log10(||target||^2 / ||target - preds||^2), shape ``[..., time] -> [...]``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu.functional import signal_noise_ratio
+        >>> target = jnp.asarray(np.sin(np.arange(100) / 5.0).astype(np.float32))
+        >>> print(round(float(signal_noise_ratio(target + 0.1, target)), 4))
+        16.8721
+    """
     _check_same_shape(preds, target)
     preds = jnp.asarray(preds, dtype=jnp.result_type(preds, jnp.float32))
     target = jnp.asarray(target, dtype=preds.dtype)

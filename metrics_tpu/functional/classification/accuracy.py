@@ -152,7 +152,14 @@ def accuracy(
     multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
 ) -> Array:
-    """Accuracy over any classification input case (reference ``accuracy.py:257-404``)."""
+    """Accuracy over any classification input case (reference ``accuracy.py:257-404``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import accuracy
+        >>> print(round(float(accuracy(jnp.asarray([0, 2, 1, 3]), jnp.asarray([0, 1, 2, 3]))), 4))
+        0.5
+    """
     allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
     if average not in allowed_average:
         raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")

@@ -133,6 +133,13 @@ def auroc(
     max_fpr: Optional[float] = None,
     sample_weights: Optional[Sequence] = None,
 ) -> Array:
-    """Area under the ROC curve (reference ``auroc.py:196``)."""
+    """Area under the ROC curve (reference ``auroc.py:196``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import auroc
+        >>> print(round(float(auroc(jnp.asarray([0.1, 0.4, 0.35, 0.8]), jnp.asarray([0, 0, 1, 1]))), 4))
+        0.75
+    """
     preds, target, mode = _auroc_update(preds, target)
     return _auroc_compute(preds, target, mode, num_classes, pos_label, average, max_fpr, sample_weights)

@@ -86,6 +86,14 @@ def confusion_matrix(
     multilabel: bool = False,
 ) -> Array:
     """Confusion matrix for binary/multiclass/multilabel inputs
-    (reference ``confusion_matrix.py:114``)."""
+    (reference ``confusion_matrix.py:114``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import confusion_matrix
+        >>> out = confusion_matrix(jnp.asarray([0, 1, 0, 1]), jnp.asarray([0, 1, 1, 1]), num_classes=2)
+        >>> print(out.tolist())
+        [[1, 0], [1, 2]]
+    """
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel)
     return _confusion_matrix_compute(confmat, normalize)

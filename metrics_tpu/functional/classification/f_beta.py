@@ -133,7 +133,14 @@ def f1_score(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """F1 = F-beta with beta=1 (reference ``f_beta.py:274``)."""
+    """F1 = F-beta with beta=1 (reference ``f_beta.py:274``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import f1_score
+        >>> print(round(float(f1_score(jnp.asarray([0, 2, 1, 0]), jnp.asarray([0, 1, 2, 0]), num_classes=3, average='macro')), 4))
+        0.3333
+    """
     return fbeta_score(
         preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass
     )

@@ -96,7 +96,14 @@ def precision(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """Precision = TP / (TP + FP) (reference ``precision_recall.py:75``)."""
+    """Precision = TP / (TP + FP) (reference ``precision_recall.py:75``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision
+        >>> print(round(float(precision(jnp.asarray([0, 2, 1, 0]), jnp.asarray([0, 1, 2, 0]), num_classes=3, average='macro')), 4))
+        0.3333
+    """
     _precision_recall_validate_args(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ("weighted", "none", None) else average
     tp, fp, tn, fn = _stat_scores_update(
@@ -124,7 +131,14 @@ def recall(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """Recall = TP / (TP + FN) (reference ``precision_recall.py:272``)."""
+    """Recall = TP / (TP + FN) (reference ``precision_recall.py:272``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import recall
+        >>> print(round(float(recall(jnp.asarray([0, 2, 1, 0]), jnp.asarray([0, 1, 2, 0]), num_classes=3, average='macro')), 4))
+        0.3333
+    """
     _precision_recall_validate_args(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ("weighted", "none", None) else average
     tp, fp, tn, fn = _stat_scores_update(

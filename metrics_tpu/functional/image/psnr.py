@@ -61,6 +61,14 @@ def peak_signal_noise_ratio(
         base: logarithm base.
         reduction: elementwise_mean / sum / none (applies when ``dim`` given).
         dim: dimensions to compute PSNR over; scores are reduced across the rest.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import peak_signal_noise_ratio
+        >>> target = jnp.ones((1, 1, 8, 8)) * 0.5
+        >>> preds = target.at[0, 0, 0, 0].set(0.6)
+        >>> print(round(float(peak_signal_noise_ratio(preds, target, data_range=1.0)), 2))
+        38.06
     """
     if dim is None and reduction != "elementwise_mean":
         rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")

@@ -142,7 +142,16 @@ def structural_similarity_index_measure(
     k1: float = 0.01,
     k2: float = 0.03,
 ) -> Array:
-    """SSIM over ``[N, C, H, W]`` images (reference ``ssim.py:175-228``)."""
+    """SSIM over ``[N, C, H, W]`` images (reference ``ssim.py:175-228``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import structural_similarity_index_measure
+        >>> target = jnp.ones((1, 1, 8, 8)) * 0.5
+        >>> preds = target.at[0, 0, 0, 0].set(0.6)
+        >>> print(round(float(structural_similarity_index_measure(preds, target, data_range=1.0)), 4))
+        0.9523
+    """
     preds, target = _ssim_check_inputs(preds, target)
     return _ssim_compute(preds, target, kernel_size, sigma, reduction, data_range, k1, k2)
 

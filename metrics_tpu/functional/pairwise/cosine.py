@@ -30,7 +30,16 @@ def pairwise_cosine_similarity(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Pairwise cosine similarity between rows of ``x`` (``[N,d]``) and ``y`` (``[M,d]``)."""
+    """Pairwise cosine similarity between rows of ``x`` (``[N,d]``) and ``y`` (``[M,d]``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_cosine_similarity
+        >>> x = jnp.asarray([[1.0, 0.0]])
+        >>> y = jnp.asarray([[0.6, 0.8]])
+        >>> print(round(float(pairwise_cosine_similarity(x, y)[0, 0]), 4))
+        0.6
+    """
     if reduction in ("sum", "mean"):
         from metrics_tpu.ops.pairwise_reduce import pairwise_reduce_rows
 

@@ -32,7 +32,15 @@ def pairwise_euclidean_distance(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Pairwise euclidean distance between rows of ``x`` (``[N,d]``) and ``y`` (``[M,d]``)."""
+    """Pairwise euclidean distance between rows of ``x`` (``[N,d]``) and ``y`` (``[M,d]``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_euclidean_distance
+        >>> x = jnp.asarray([[0.0, 0.0], [3.0, 4.0]])
+        >>> print(pairwise_euclidean_distance(x).tolist())
+        [[0.0, 5.0], [5.0, 0.0]]
+    """
     if reduction in ("sum", "mean"):
         from metrics_tpu.ops.pairwise_reduce import pairwise_reduce_rows
 

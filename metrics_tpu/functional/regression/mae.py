@@ -25,6 +25,13 @@ def _mean_absolute_error_compute(sum_abs_error: Array, n_obs: Union[int, Array])
 
 
 def mean_absolute_error(preds: Array, target: Array) -> Array:
-    """Mean absolute error."""
+    """Mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_absolute_error
+        >>> print(round(float(mean_absolute_error(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4))
+        0.5
+    """
     sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
     return _mean_absolute_error_compute(sum_abs_error, n_obs)

@@ -27,6 +27,13 @@ def _mean_squared_error_compute(sum_squared_error: Array, n_obs: Union[int, Arra
 
 
 def mean_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
-    """Mean squared error; RMSE when ``squared=False``."""
+    """Mean squared error; RMSE when ``squared=False``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_error
+        >>> print(round(float(mean_squared_error(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4))
+        0.375
+    """
     sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
     return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)

@@ -86,7 +86,14 @@ def _final_aggregation(
 
 
 def pearson_corrcoef(preds: Array, target: Array) -> Array:
-    """Pearson correlation coefficient between 1D ``preds`` and ``target``."""
+    """Pearson correlation coefficient between 1D ``preds`` and ``target``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pearson_corrcoef
+        >>> print(round(float(pearson_corrcoef(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4))
+        0.9849
+    """
     zero = jnp.zeros(1, dtype=preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32)
     _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
         preds, target, zero, zero, zero, zero, zero, zero

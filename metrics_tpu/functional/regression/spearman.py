@@ -53,6 +53,13 @@ def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -
 
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:
-    """Spearman rank correlation between 1D ``preds`` and ``target``."""
+    """Spearman rank correlation between 1D ``preds`` and ``target``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import spearman_corrcoef
+        >>> print(round(float(spearman_corrcoef(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4))
+        1.0
+    """
     preds, target = _spearman_corrcoef_update(preds, target)
     return _spearman_corrcoef_compute(preds, target)

@@ -18,7 +18,14 @@ Array = jax.Array
 
 
 def retrieval_average_precision(preds: Array, target: Array) -> Array:
-    """AP for a single query: mean of precision-at-hit over relevant documents."""
+    """AP for a single query: mean of precision-at-hit over relevant documents.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_average_precision
+        >>> print(round(float(retrieval_average_precision(jnp.asarray([0.9, 0.3, 0.5]), jnp.asarray([1, 0, 1]))), 4))
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     st = _sorted_by_scores(preds, target).astype(jnp.float32)
     hits = jnp.cumsum(st)
