@@ -27,6 +27,22 @@ class Accuracy(StatScores):
     """Accuracy over any classification input case
     (reference ``classification/accuracy.py:31``).
 
+    Args:
+        threshold: probability cutoff that binarizes probabilistic/logit inputs.
+        num_classes: number of classes; required by the macro/weighted averages.
+        average: reduction over classes — ``micro`` (global counts), ``macro``
+            (unweighted class mean), ``weighted`` (support-weighted mean),
+            ``samples`` (per-sample mean), ``none`` (per-class vector).
+        mdmc_average: how multidim-multiclass extra dims fold in — ``global``
+            flattens them into the sample axis, ``samplewise`` scores each
+            sample separately and averages.
+        ignore_index: class label excluded from scoring.
+        top_k: count a multiclass prediction as correct when the target sits in
+            the k highest probabilities (sort-free Pallas kernel on TPU).
+        multiclass: override the automatic binary/multiclass input inference.
+        subset_accuracy: for multilabel/multidim inputs, require EVERY label of
+            a sample to be correct for the sample to count.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import Accuracy

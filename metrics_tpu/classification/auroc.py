@@ -19,6 +19,14 @@ Array = jax.Array
 class AUROC(Metric):
     """Area under the ROC curve (reference ``classification/auroc.py:30``).
 
+    Args:
+        num_classes: number of classes for multiclass/multilabel inputs.
+        pos_label: the label treated as positive in the binary case.
+        average: ``macro`` / ``weighted`` / ``micro`` (multilabel only) /
+            ``none`` reduction over per-class areas.
+        max_fpr: restrict the area to the [0, max_fpr] range (binary only,
+            McClish standardization).
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import AUROC

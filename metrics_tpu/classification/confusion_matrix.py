@@ -21,6 +21,14 @@ Array = jax.Array
 class ConfusionMatrix(Metric):
     """Streaming confusion matrix (reference ``classification/confusion_matrix.py:26``).
 
+    Args:
+        num_classes: size C of the [C, C] matrix (rows = true, cols = predicted).
+        normalize: ``none`` raw counts, ``true`` rows sum to 1, ``pred`` columns
+            sum to 1, ``all`` the whole matrix sums to 1.
+        threshold: probability cutoff binarizing probabilistic inputs.
+        multilabel: treat inputs as [N, C] independent binary problems,
+            producing a [C, 2, 2] stack.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import ConfusionMatrix

@@ -17,6 +17,21 @@ class FBetaScore(StatScores):
     """Weighted harmonic mean of precision and recall
     (reference ``f_beta.py:26``).
 
+    Args:
+        beta: weight of recall relative to precision (beta < 1 favors precision).
+        threshold: probability cutoff that binarizes probabilistic/logit inputs.
+        num_classes: number of classes; required by the macro/weighted averages.
+        average: reduction over classes — ``micro`` (global counts), ``macro``
+            (unweighted class mean), ``weighted`` (support-weighted mean),
+            ``samples`` (per-sample mean), ``none`` (per-class vector).
+        mdmc_average: how multidim-multiclass extra dims fold in — ``global``
+            flattens them into the sample axis, ``samplewise`` scores each
+            sample separately and averages.
+        ignore_index: class label excluded from scoring.
+        top_k: count a multiclass prediction as correct when the target sits in
+            the k highest probabilities (sort-free Pallas kernel on TPU).
+        multiclass: override the automatic binary/multiclass input inference.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import FBetaScore
@@ -63,6 +78,20 @@ class FBetaScore(StatScores):
 
 class F1Score(FBetaScore):
     """F1 = FBeta(beta=1) (reference ``f_beta.py:176``).
+
+    Args:
+        threshold: probability cutoff that binarizes probabilistic/logit inputs.
+        num_classes: number of classes; required by the macro/weighted averages.
+        average: reduction over classes — ``micro`` (global counts), ``macro``
+            (unweighted class mean), ``weighted`` (support-weighted mean),
+            ``samples`` (per-sample mean), ``none`` (per-class vector).
+        mdmc_average: how multidim-multiclass extra dims fold in — ``global``
+            flattens them into the sample axis, ``samplewise`` scores each
+            sample separately and averages.
+        ignore_index: class label excluded from scoring.
+        top_k: count a multiclass prediction as correct when the target sits in
+            the k highest probabilities (sort-free Pallas kernel on TPU).
+        multiclass: override the automatic binary/multiclass input inference.
 
     Example:
         >>> import jax.numpy as jnp

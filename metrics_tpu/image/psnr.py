@@ -18,6 +18,13 @@ class PeakSignalNoiseRatio(Metric):
     per-batch scores are buffered (cat states), mirroring the reference
     (``image/psnr.py:81-86``).
 
+    Args:
+        data_range: value range of the inputs; inferred when None (required for
+            ``dim``-restricted reduction).
+        base: logarithm base of the dB scale.
+        reduction: ``elementwise_mean`` / ``sum`` / ``none``.
+        dim: axes to compute the metric over before reducing; None = global.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import PeakSignalNoiseRatio

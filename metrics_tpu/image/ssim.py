@@ -22,6 +22,13 @@ class StructuralSimilarityIndexMeasure(Metric):
     ``data_range`` inferred from data spans the WHOLE stream, exactly like the
     reference (``image/ssim.py:85-96``, which warns about the memory cost).
 
+    Args:
+        kernel_size: gaussian window size per spatial axis.
+        sigma: gaussian standard deviation per spatial axis.
+        data_range: value range of the inputs; inferred from data when None.
+        k1, k2: stability constants of the SSIM formula.
+        reduction: ``elementwise_mean`` / ``sum`` / ``none`` over the batch.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import StructuralSimilarityIndexMeasure

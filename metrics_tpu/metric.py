@@ -90,6 +90,25 @@ class Metric:
         axis_name: named mesh axis (or axes) for in-trace sync when the metric
             is used through the pure API inside ``shard_map``/``pmap``.
         jit_update: auto-jit the update transition (default True).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Metric
+        >>> class RootMeanSquaredError(Metric):
+        ...     def __init__(self, **kwargs):
+        ...         super().__init__(**kwargs)
+        ...         self.add_state('sum_sq', default=jnp.asarray(0.0), dist_reduce_fx='sum')
+        ...         self.add_state('count', default=jnp.asarray(0), dist_reduce_fx='sum')
+        ...     def update(self, preds, target):
+        ...         self.sum_sq = self.sum_sq + jnp.sum((preds - target) ** 2)
+        ...         self.count = self.count + preds.size
+        ...     def compute(self):
+        ...         return jnp.sqrt(self.sum_sq / self.count)
+        >>> rmse = RootMeanSquaredError()
+        >>> rmse.update(jnp.asarray([1.0, 2.0]), jnp.asarray([2.0, 4.0]))
+        >>> rmse.update(jnp.asarray([3.0]), jnp.asarray([3.0]))
+        >>> print(round(float(rmse.compute()), 4))  # sqrt(5/3)
+        1.291
     """
 
     __jit_ignored_attributes__ = ["device"]

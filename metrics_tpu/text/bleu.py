@@ -13,6 +13,10 @@ Array = jax.Array
 class BLEUScore(Metric):
     """Streaming corpus-level BLEU with device-array n-gram counters.
 
+    Args:
+        n_gram: largest n-gram order scored (default 4).
+        smooth: add-one smoothing of the n-gram precisions.
+
     Example:
         >>> from metrics_tpu import BLEUScore
         >>> bleu = BLEUScore()

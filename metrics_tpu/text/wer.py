@@ -13,6 +13,10 @@ Array = jax.Array
 class WordErrorRate(Metric):
     """Streaming word error rate over transcript batches.
 
+    Args:
+        (no arguments) — accumulates total edit distance over total reference
+            words; lower is better.
+
     Example:
         >>> from metrics_tpu import WordErrorRate
         >>> wer = WordErrorRate()

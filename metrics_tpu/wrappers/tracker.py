@@ -22,6 +22,11 @@ class MetricTracker:
     standard lifecycle methods to the newest one. With a ``MetricCollection``
     base, ``compute_all``/``best_metric`` return per-member dicts.
 
+    Args:
+        metric: the tracked ``Metric`` or ``MetricCollection``.
+        maximize: whether larger values are better for ``best_metric`` (a bool,
+            or a list of bools matching a collection's members).
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import MeanSquaredError, MetricTracker
