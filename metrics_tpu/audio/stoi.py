@@ -16,7 +16,19 @@ Array = jax.Array
 
 
 class ShortTimeObjectiveIntelligibility(Metric):
-    """Streaming mean STOI/ESTOI over batches of (preds, target) signals."""
+    """Streaming mean STOI/ESTOI over batches of (preds, target) signals.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import ShortTimeObjectiveIntelligibility
+        >>> rng = np.random.RandomState(3)
+        >>> target = jnp.asarray(rng.normal(size=20000).astype(np.float32))
+        >>> noise = jnp.asarray(rng.normal(size=20000).astype(np.float32))
+        >>> stoi = ShortTimeObjectiveIntelligibility(fs=10000)
+        >>> print(round(float(stoi(target + 0.3 * noise, target)), 4))
+        0.9047
+    """
 
     is_differentiable = False
     higher_is_better = True

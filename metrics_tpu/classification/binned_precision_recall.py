@@ -53,7 +53,16 @@ def _recall_at_precision(
 
 class BinnedPrecisionRecallCurve(Metric):
     """Constant-memory PR curve over fixed thresholds
-    (reference ``binned_precision_recall.py:45``)."""
+    (reference ``binned_precision_recall.py:45``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedPrecisionRecallCurve
+        >>> bprc = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
+        >>> p, r, t = bprc(jnp.asarray([0.1, 0.4, 0.6, 0.9]), jnp.asarray([0, 0, 1, 1]))
+        >>> print([round(float(v), 2) for v in r])
+        [1.0, 1.0, 1.0, 0.5, 0.0, 0.0]
+    """
 
     is_differentiable = False
     higher_is_better = None

@@ -29,6 +29,16 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         weights_path: local ``.npz`` weights for the named nets (see
             ``metrics_tpu.image.networks.convert_torch_lpips_checkpoint``);
             falls back to ``$METRICS_TPU_LPIPS_WEIGHTS``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import LearnedPerceptualImagePatchSimilarity
+        >>> dist_net = lambda a, b: jnp.mean((a - b) ** 2, axis=(1, 2, 3))  # custom distance
+        >>> lpips = LearnedPerceptualImagePatchSimilarity(net=dist_net)
+        >>> imgs = jnp.asarray(np.random.RandomState(0).rand(4, 3, 16, 16).astype(np.float32))
+        >>> print(round(float(lpips(imgs, imgs)), 4))  # identical images -> 0
+        0.0
     """
 
     is_differentiable = True

@@ -80,7 +80,17 @@ class StructuralSimilarityIndexMeasure(Metric):
 
 
 class MultiScaleStructuralSimilarityIndexMeasure(Metric):
-    """MS-SSIM with the same buffered-stream semantics as SSIM."""
+    """MS-SSIM with the same buffered-stream semantics as SSIM.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import MultiScaleStructuralSimilarityIndexMeasure
+        >>> ms_ssim = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> imgs = jnp.asarray(np.random.RandomState(0).rand(1, 1, 176, 176).astype(np.float32))
+        >>> print(round(float(ms_ssim(imgs, imgs)), 4))  # identical images -> 1
+        1.0
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -801,7 +801,17 @@ def _neg(x: Array) -> Array:
 
 
 class CompositionalMetric(Metric):
-    """Lazy arithmetic composition of metrics (reference ``metric.py:704-814``)."""
+    """Lazy arithmetic composition of metrics (reference ``metric.py:704-814``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> acc = Accuracy()
+        >>> double = acc * 2  # lazy arithmetic over metric results
+        >>> acc.update(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 0, 1]))
+        >>> print(round(float(double.compute()), 4))
+        1.5
+    """
 
     def __init__(
         self,

@@ -9,7 +9,18 @@ Array = jax.Array
 
 
 class RetrievalPrecision(_TopKRetrievalMetric):
-    """Mean precision@k over queries."""
+    """Mean precision@k over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalPrecision
+        >>> rprec = RetrievalPrecision(k=2)
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.9, 0.3, 0.5, 0.8, 0.2])
+        >>> target = jnp.asarray([1, 0, 1, 0, 1])
+        >>> print(round(float(rprec(preds, target, indexes=indexes)), 4))
+        0.75
+    """
 
     def _metric_grouped(self, preds: Array, target: Array, indexes: Array, g: GroupedRanking) -> Array:
         return _precision_grouped(g, self.k)

@@ -27,6 +27,24 @@ class BERTScore(Metric):
         idf: idf-weight tokens over the accumulated references.
         max_length: padded sequence length (fixed padding keeps the cat
             states rectangular for sync).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import BERTScore
+        >>> def tokenizer(text, max_length):  # own-tokenizer contract
+        ...     ids = np.zeros((len(text), max_length), np.int64)
+        ...     mask = np.zeros_like(ids)
+        ...     for i, s in enumerate(text):
+        ...         toks = [hash(w) % 90 + 10 for w in s.split()][:max_length]
+        ...         ids[i, :len(toks)] = toks; mask[i, :len(toks)] = 1
+        ...     return {'input_ids': ids, 'attention_mask': mask}
+        >>> table = np.random.RandomState(0).normal(size=(100, 8))
+        >>> model = lambda ids, mask: jnp.asarray(table[np.asarray(ids)] * np.asarray(mask)[..., None])
+        >>> score = BERTScore(model=model, user_tokenizer=tokenizer, max_length=8)
+        >>> score.update(['the cat sat'], ['the cat sat'])
+        >>> print(round(float(np.asarray(score.compute()['f1'])[0]), 4))  # identical -> 1
+        1.0
     """
 
     is_differentiable = False
