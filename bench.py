@@ -828,6 +828,34 @@ _CONFIGS = [
 ]
 
 
+_PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp;"
+    "print(float(jnp.sum(jnp.ones((8, 8)))))"
+)
+
+
+def _backend_alive(timeout_s: int = 240):
+    """A tiny fetch proves the accelerator answers; a wedged tunnel hangs
+    forever, so probe in a kill-able subprocess before burning every
+    config's full deadline on a dead backend.
+
+    Returns ``None`` when healthy, else the error string to report — a probe
+    CRASH (broken env) and a probe TIMEOUT (wedged backend) are different
+    diagnoses."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return f"backend unreachable (probe fetch timed out after {timeout_s}s)"
+    if out.returncode != 0:
+        return f"backend probe crashed rc={out.returncode}: {out.stderr.strip()[-160:]}"
+    return None
+
+
 def _run_isolated(name: str, timeout_s: int) -> dict:
     """Run one config in a subprocess: isolation + a kill-capable timeout."""
     env = dict(os.environ)
@@ -853,6 +881,21 @@ def main() -> None:
     single = os.environ.get("METRICS_TPU_BENCH_CONFIG")
     if single:  # child mode: run exactly one config
         emit(_headline() if single == "bench_headline" else globals()[single]())
+        return
+
+    backend_error = _backend_alive()
+    if backend_error is not None:
+        # dead/wedged accelerator: report fast instead of serially burning
+        # every config's deadline; the CPU-only sync config still runs
+        for name, timeout_s in _CONFIGS:
+            if name == "bench_sync_overhead":
+                emit(_run_isolated(name, timeout_s))
+            else:
+                emit({"metric": name, "error": backend_error})
+        emit({
+            "metric": "classification_collection_update_throughput",
+            "error": backend_error,
+        })
         return
 
     # headline measured FIRST (clean backend, comparable across rounds),
