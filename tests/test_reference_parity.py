@@ -14,8 +14,8 @@ normalizations, kappa/MCC/hamming/jaccard/AUROC/AP/ECE/KL), regression (10),
 retrieval (8), text (9), audio (4) and image (2).
 """
 import importlib.util
-import os
 import pathlib
+import zlib
 
 import numpy as np
 import pytest
@@ -99,7 +99,7 @@ _CLS_CASES = [
 def test_classification_parity(tm, name, kwargs, data_kw):
     import metrics_tpu as M
 
-    rng = np.random.RandomState(hash(name + str(kwargs)) % 2**31)
+    rng = np.random.RandomState(zlib.crc32((name + str(kwargs)).encode()) % 2**31)
     got, want = _run_pair(
         getattr(M, name)(**kwargs), getattr(tm, name)(**kwargs), _cls_batches(rng, **data_kw)
     )
@@ -128,7 +128,7 @@ _REG = ["MeanSquaredError", "MeanAbsoluteError", "MeanAbsolutePercentageError",
 def test_regression_parity(tm, name):
     import metrics_tpu as M
 
-    rng = np.random.RandomState(hash(name) % 2**31)
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**31)
     shape = (8, 6) if name == "CosineSimilarity" else (32,)
     batches = [
         (rng.normal(size=shape).astype(np.float32), rng.normal(size=shape).astype(np.float32))
@@ -151,7 +151,7 @@ def test_retrieval_parity(tm, name, kwargs):
 
     import metrics_tpu as M
 
-    rng = np.random.RandomState(hash(name) % 2**31)
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**31)
     ours, ref = getattr(M, name)(**kwargs), getattr(tm, name)(**kwargs)
     for _ in range(3):
         idx = np.sort(rng.randint(0, 4, 24))
@@ -174,7 +174,7 @@ def _sent(rng, n):
 def test_text_rate_parity(tm, name):
     import metrics_tpu as M
 
-    rng = np.random.RandomState(hash(name) % 2**31)
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**31)
     preds = [_sent(rng, rng.randint(4, 10)) for _ in range(8)]
     target = [_sent(rng, rng.randint(4, 10)) for _ in range(8)]
     ours, ref = getattr(M, name)(), getattr(tm, name)()
@@ -183,11 +183,11 @@ def test_text_rate_parity(tm, name):
     _cmp(ours.compute(), ref.compute())
 
 
-@pytest.mark.parametrize("name", ["BLEUScore", "SacreBLEUScore", "CHRFScore", "TranslationEditRate"])
+@pytest.mark.parametrize("name", ["BLEUScore", "SacreBLEUScore", "CHRFScore"])
 def test_text_corpus_parity(tm, name):
     import metrics_tpu as M
 
-    rng = np.random.RandomState(hash(name) % 2**31)
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**31)
     preds = [_sent(rng, rng.randint(4, 10)) for _ in range(6)]
     refs = [[_sent(rng, rng.randint(4, 10)), _sent(rng, rng.randint(4, 10))] for _ in range(6)]
     ours, ref = getattr(M, name)(), getattr(tm, name)()
@@ -205,7 +205,7 @@ def test_text_corpus_parity(tm, name):
 def test_audio_parity(tm, name, kwargs):
     import metrics_tpu as M
 
-    rng = np.random.RandomState(hash(name + str(kwargs)) % 2**31)
+    rng = np.random.RandomState(zlib.crc32((name + str(kwargs)).encode()) % 2**31)
     batches = []
     for _ in range(2):
         t = rng.normal(size=(4, 256)).astype(np.float32)
@@ -218,7 +218,7 @@ def test_audio_parity(tm, name, kwargs):
 def test_image_parity(tm, name):
     import metrics_tpu as M
 
-    rng = np.random.RandomState(hash(name) % 2**31)
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**31)
     batches = []
     for _ in range(2):
         t = rng.rand(2, 3, 32, 32).astype(np.float32)
@@ -227,3 +227,35 @@ def test_image_parity(tm, name):
         getattr(M, name)(data_range=1.0), getattr(tm, name)(data_range=1.0), batches
     )
     _cmp(got, want, tol=1e-3)
+
+
+def test_ter_engine_parity_modulo_reference_arg_swap(tm):
+    """The reference's TER swaps hypothesis and reference: its
+    ``_compute_sentence_statistics`` calls
+    ``_translation_edit_rate(tgt_words, pred_words)``
+    (``/root/reference/torchmetrics/functional/text/ter.py:467``), so it
+    shifts the REFERENCE toward the prediction — diverging from
+    sacrebleu/tercom (which shift the hypothesis; our public API follows
+    them, value-pinned in ``tests/text``). The shift-search ENGINE itself is
+    behavior-identical: feeding our engine the reference's swapped argument
+    order reproduces the reference exactly on randomized corpora."""
+    import metrics_tpu  # noqa: F401 — jax configured by conftest
+
+    from metrics_tpu.functional.text.ter import _translation_edit_rate
+
+    rng = np.random.RandomState(123)
+    for _ in range(20):
+        preds = [_sent(rng, rng.randint(4, 10)) for _ in range(4)]
+        refs = [[_sent(rng, rng.randint(4, 10)), _sent(rng, rng.randint(4, 10))] for _ in range(4)]
+        ref_metric = tm.TranslationEditRate()
+        ref_metric.update(preds, refs)
+        want = float(ref_metric.compute())
+
+        total_edits = 0.0
+        total_len = 0.0
+        for pred, rr in zip(preds, refs):
+            pred_words = pred.split()
+            total_edits += min(_translation_edit_rate(x.split(), pred_words) for x in rr)
+            total_len += sum(len(x.split()) for x in rr) / len(rr)
+        got = total_edits / total_len
+        np.testing.assert_allclose(got, want, rtol=1e-6)
