@@ -259,3 +259,107 @@ def test_ter_engine_parity_modulo_reference_arg_swap(tm):
             total_len += sum(len(x.split()) for x in rr) / len(rr)
         got = total_edits / total_len
         np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_detection_map_parity(tm):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    from torchmetrics.detection.map import MeanAveragePrecision as RefMAP
+
+    rng = np.random.RandomState(42)
+    ours, ref = M.MeanAveragePrecision(), RefMAP()
+    for _ in range(8):
+        n_gt = rng.randint(1, 6)
+        xy = rng.rand(n_gt, 2) * 200
+        wh = rng.rand(n_gt, 2) * 60 + 5
+        g = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        gl = rng.randint(0, 3, n_gt)
+        d = (g + rng.randn(n_gt, 4) * 4).astype(np.float32)
+        ds = rng.rand(n_gt).astype(np.float32)
+        ours.update(
+            [dict(boxes=jnp.asarray(d), scores=jnp.asarray(ds), labels=jnp.asarray(gl))],
+            [dict(boxes=jnp.asarray(g), labels=jnp.asarray(gl))],
+        )
+        ref.update(
+            [dict(boxes=torch.from_numpy(d), scores=torch.from_numpy(ds), labels=torch.from_numpy(gl))],
+            [dict(boxes=torch.from_numpy(g), labels=torch.from_numpy(gl))],
+        )
+    got, want = ours.compute(), ref.compute()
+    for key in ("map", "map_50", "map_75", "map_small", "mar_1", "mar_10", "mar_100"):
+        _cmp(got[key], want[key], tol=1e-4)
+
+
+def test_binned_curves_parity(tm):
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(11)
+    batches = [(rng.rand(32).astype(np.float32), rng.randint(0, 2, 32)) for _ in range(3)]
+    got, want = _run_pair(
+        M.BinnedAveragePrecision(num_classes=1, thresholds=21),
+        tm.BinnedAveragePrecision(num_classes=1, thresholds=21),
+        batches,
+    )
+    _cmp(got, want, tol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["MeanMetric", "SumMetric", "MaxMetric", "MinMetric", "CatMetric"])
+@pytest.mark.parametrize("nan_strategy", ["warn", "ignore", 0.5])
+def test_aggregation_parity(tm, name, nan_strategy):
+    import warnings
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**31)
+    vals = [rng.normal(size=8).astype(np.float32) for _ in range(3)]
+    vals[1][2] = np.nan
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got, want = _run_pair(
+            getattr(M, name)(nan_strategy=nan_strategy),
+            getattr(tm, name)(nan_strategy=nan_strategy),
+            [(v,) for v in vals],
+        )
+    _cmp(got, want, tol=1e-5)
+
+
+def test_minmax_wrapper_parity(tm):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    ours = M.MinMaxMetric(M.MeanMetric())
+    ref = tm.MinMaxMetric(tm.MeanMetric())
+    for v in ([1.0, 3.0], [5.0], [0.5, 0.5]):
+        ours.update(jnp.asarray(v))
+        ref.update(torch.tensor(v))
+        got, want = ours.compute(), ref.compute()
+        for key in ("raw", "max", "min"):
+            _cmp(got[key], want[key], tol=1e-6)
+
+
+def test_multioutput_wrapper_parity(tm):
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(5)
+    batches = [
+        (rng.normal(size=(8, 3)).astype(np.float32), rng.normal(size=(8, 3)).astype(np.float32))
+        for _ in range(2)
+    ]
+    got, want = _run_pair(
+        M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=3),
+        tm.MultioutputWrapper(tm.MeanSquaredError(), num_outputs=3),
+        batches,
+    )
+    _cmp(np.asarray([np.asarray(g) for g in got]), torch_stack_or_np(want), tol=1e-5)
+
+
+def torch_stack_or_np(value):
+    import torch
+
+    if isinstance(value, (list, tuple)):
+        return torch.stack([v.reshape(()) for v in value])
+    return value
