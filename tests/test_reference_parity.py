@@ -363,3 +363,25 @@ def torch_stack_or_np(value):
     if isinstance(value, (list, tuple)):
         return torch.stack([v.reshape(()) for v in value])
     return value
+
+
+@pytest.mark.parametrize("name", ["ROC", "PrecisionRecallCurve"])
+def test_exact_curve_parity(tm, name):
+    """Exact curve OUTPUT parity: same thresholds, same points, element-wise."""
+    import warnings
+
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**31)
+    p = rng.rand(32).astype(np.float32)
+    t = rng.randint(0, 2, 32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ours, ref = getattr(M, name)(), getattr(tm, name)()
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.from_numpy(p), torch.from_numpy(t))
+        for got, want in zip(ours.compute(), ref.compute()):
+            _cmp(got, want, tol=1e-6)
