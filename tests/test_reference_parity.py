@@ -385,3 +385,104 @@ def test_exact_curve_parity(tm, name):
         ref.update(torch.from_numpy(p), torch.from_numpy(t))
         for got, want in zip(ours.compute(), ref.compute()):
             _cmp(got, want, tol=1e-6)
+
+
+def test_hinge_auc_squad_parity(tm):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(21)
+    # binary hinge
+    p = (rng.rand(24).astype(np.float32) * 4 - 2)
+    t = rng.randint(0, 2, 24)
+    got, want = _run_pair(M.HingeLoss(), tm.HingeLoss(), [(p, t)])
+    _cmp(got, want)
+    # AUC over a monotone curve
+    x = np.sort(rng.rand(16).astype(np.float32))
+    y = rng.rand(16).astype(np.float32)
+    got, want = _run_pair(M.AUC(), tm.AUC(), [(x, y)])
+    _cmp(got, want)
+    # SQuAD protocol
+    preds = [{"prediction_text": "the cat sat", "id": "a"},
+             {"prediction_text": "dog", "id": "b"}]
+    target = [{"answers": {"answer_start": [0], "text": ["the cat sat on the mat"]}, "id": "a"},
+              {"answers": {"answer_start": [0], "text": ["a dog ran"]}, "id": "b"}]
+    ours, ref = M.SQuAD(), tm.SQuAD()
+    ours.update(preds, target)
+    ref.update(preds, target)
+    go, gr = ours.compute(), ref.compute()
+    for key in ("exact_match", "f1"):
+        _cmp(go[key], gr[key])
+
+
+def test_bleu_variants_parity(tm):
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(31)
+    preds = [_sent(rng, rng.randint(4, 10)) for _ in range(5)]
+    refs = [[_sent(rng, rng.randint(4, 10))] for _ in range(5)]
+    for kw in (dict(n_gram=2), dict(smooth=True), dict(n_gram=3, smooth=True)):
+        ours, ref = M.BLEUScore(**kw), tm.BLEUScore(**kw)
+        ours.update(preds, refs)
+        ref.update(preds, refs)
+        _cmp(ours.compute(), ref.compute())
+
+
+def test_pairwise_functional_parity(tm):
+    import jax.numpy as jnp
+    import torch
+
+    import torchmetrics.functional as TF
+
+    from metrics_tpu.functional import (
+        pairwise_cosine_similarity,
+        pairwise_euclidean_distance,
+        pairwise_linear_similarity,
+        pairwise_manhattan_distance,
+    )
+
+    rng = np.random.RandomState(41)
+    x = rng.normal(size=(7, 5)).astype(np.float32)
+    y = rng.normal(size=(4, 5)).astype(np.float32)
+    pairs = [
+        (pairwise_cosine_similarity, TF.pairwise_cosine_similarity),
+        (pairwise_euclidean_distance, TF.pairwise_euclidean_distance),
+        (pairwise_linear_similarity, TF.pairwise_linear_similarity),
+        (pairwise_manhattan_distance, TF.pairwise_manhattan_distance),
+    ]
+    for ours_fn, ref_fn in pairs:
+        for reduction in (None, "mean", "sum"):
+            got = ours_fn(jnp.asarray(x), jnp.asarray(y), reduction=reduction)
+            want = ref_fn(torch.from_numpy(x), torch.from_numpy(y), reduction=reduction)
+            _cmp(got, want, tol=1e-4)
+        got = ours_fn(jnp.asarray(x))  # zero_diagonal default path
+        want = ref_fn(torch.from_numpy(x))
+        _cmp(got, want, tol=1e-4)
+
+
+def test_collection_keys_and_values_parity(tm):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(51)
+    p = rng.rand(32, 3).astype(np.float32)
+    p /= p.sum(1, keepdims=True)
+    t = rng.randint(0, 3, 32)
+    ours = M.MetricCollection(
+        {"acc": M.Accuracy(num_classes=3), "f1": M.F1Score(num_classes=3, average="macro")},
+        prefix="val_",
+    )
+    ref = tm.MetricCollection(
+        {"acc": tm.Accuracy(num_classes=3), "f1": tm.F1Score(num_classes=3, average="macro")},
+        prefix="val_",
+    )
+    ours.update(jnp.asarray(p), jnp.asarray(t))
+    ref.update(torch.from_numpy(p), torch.from_numpy(t))
+    got, want = ours.compute(), ref.compute()
+    assert sorted(got) == sorted(want)
+    for key in want:
+        _cmp(got[key], want[key])
