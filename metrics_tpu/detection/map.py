@@ -173,6 +173,8 @@ class MeanAveragePrecision(Metric):
         requires per-image structure."""
         gather = dist_sync_fn or comm.gather_all_arrays
         group = process_group or self.process_group
+
+        packed, meta = {}, {}
         for name, width in self._STATE_WIDTHS.items():
             local = getattr(self, name)
             cols = width if width else 1
@@ -187,8 +189,27 @@ class MeanAveragePrecision(Metric):
             # and int64 to 32-bit without jax_enable_x64, silently rounding
             # box coordinates before the gather
             byte_rows = np.ascontiguousarray(flat_np).view(np.uint8).reshape(flat_np.shape[0], cols * 8)
-            gathered_flat = gather(jnp.asarray(byte_rows), group=group)
-            gathered_len = gather(lengths, group=group)
+            packed[name] = {"flat": jnp.asarray(byte_rows), "len": lengths}
+            meta[name] = (cols, dtype, width)
+
+        from metrics_tpu.parallel.groups import ProcessGroup, gather_group_pytrees
+
+        if dist_sync_fn is None and isinstance(group, ProcessGroup):
+            # all ten (flat, lengths) leaves ride ONE KV exchange — one
+            # subset barrier per compute(), matching Metric._sync_dist
+            member_trees = gather_group_pytrees(packed, group)
+            gathered = {
+                name: ([t[name]["flat"] for t in member_trees], [t[name]["len"] for t in member_trees])
+                for name in packed
+            }
+        else:
+            gathered = {
+                name: (gather(v["flat"], group=group), gather(v["len"], group=group))
+                for name, v in packed.items()
+            }
+
+        for name, (gathered_flat, gathered_len) in gathered.items():
+            cols, dtype, width = meta[name]
             new_list: List[np.ndarray] = []
             for fl, ln in zip(gathered_flat, gathered_len):
                 fl_np = np.ascontiguousarray(np.asarray(fl, np.uint8)).view(dtype).reshape(-1, cols)

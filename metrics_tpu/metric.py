@@ -80,10 +80,13 @@ class Metric:
         compute_on_step: return the batch-local metric value from ``forward``.
         dist_sync_on_step: synchronize the batch value across processes inside
             ``forward`` (expensive; reference ``metric.py:85``).
-        process_group: host-level process subset to sync over. Only honored by
-            a custom ``dist_sync_fn``; the default gather spans all processes
-            and raises on a non-None group (the TPU analog of a subgroup is a
-            mesh-axis subset, see ``axis_name``).
+        process_group: host-level process subset to sync over — a
+            :class:`metrics_tpu.parallel.ProcessGroup` (compute-time state
+            sync then spans its member processes only, via the KV-store
+            subgroup gather), or any object a custom ``dist_sync_fn``
+            understands. ``None`` (default) syncs over all processes. The
+            in-trace analog of a subgroup is a mesh-axis subset, see
+            ``axis_name``.
         dist_sync_fn: override for the host-level gather (signature
             ``fn(array, group) -> list[array]``), default
             :func:`metrics_tpu.parallel.comm.gather_all_arrays`.
@@ -129,13 +132,18 @@ class Metric:
         self.compute_on_step = compute_on_step
         self.dist_sync_on_step = dist_sync_on_step
         if process_group is not None and dist_sync_fn is None:
+            from metrics_tpu.parallel.groups import ProcessGroup
+
             # fail at construction, not deep inside the first distributed
-            # compute(): the default host gather cannot honor subgroups
-            raise ValueError(
-                "`process_group` requires a custom `dist_sync_fn` (the default host-level"
-                " gather always spans every process). Alternatively use the pure state API"
-                " inside shard_map with `axis_name` naming a mesh-axis subset."
-            )
+            # compute(): the default gather only understands ProcessGroup
+            if not isinstance(process_group, ProcessGroup):
+                raise ValueError(
+                    f"Unsupported `process_group` type {type(process_group).__name__!r}:"
+                    " pass a metrics_tpu.parallel.ProcessGroup (host-level subgroup sync"
+                    " over its member processes), a custom `dist_sync_fn` that understands"
+                    " your group object, or use the pure state API inside shard_map with"
+                    " `axis_name` naming a mesh-axis subset."
+                )
         self.process_group = process_group
         self.dist_sync_fn = dist_sync_fn
         self.axis_name = axis_name
@@ -453,12 +461,21 @@ class Metric:
             if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
 
-        output_dict = apply_to_collection(
-            input_dict,
-            (jax.Array, jnp.ndarray),
-            gather,
-            group=process_group or self.process_group,
-        )
+        group = process_group or self.process_group
+        from metrics_tpu.parallel.groups import ProcessGroup, gather_group_pytrees
+
+        if dist_sync_fn is None and isinstance(group, ProcessGroup):
+            # batch the whole state dict into ONE KV exchange (one barrier per
+            # compute(), not one per state leaf)
+            member_trees = gather_group_pytrees(input_dict, group)
+            output_dict = jax.tree_util.tree_map(lambda *leaves: list(leaves), *member_trees)
+        else:
+            output_dict = apply_to_collection(
+                input_dict,
+                (jax.Array, jnp.ndarray),
+                gather,
+                group=group,
+            )
 
         for attr, reduction_fn in self._reductions.items():
             output = output_dict[attr]

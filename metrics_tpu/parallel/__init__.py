@@ -6,3 +6,8 @@ from metrics_tpu.parallel.comm import (  # noqa: F401
     reduce,
     sync_state_in_trace,
 )
+from metrics_tpu.parallel.groups import (  # noqa: F401
+    ProcessGroup,
+    gather_group_arrays,
+    new_group,
+)

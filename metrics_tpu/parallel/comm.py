@@ -112,17 +112,24 @@ def gather_all_arrays(x: Array, group: Optional[Any] = None) -> List[Array]:
     gather, trim (``:133-145``).
 
     ``group`` (the reference's ``process_group`` subgroup communicator,
-    ``metric.py:88``) is **not supported** by the default multihost gather —
-    ``multihost_utils`` always spans every process. Rather than silently
-    syncing over the world, a non-None group raises: pass a custom
-    ``dist_sync_fn`` that understands your subgroup, or use in-trace sync
-    over a mesh-axis subset (``axis_name``), the TPU-native subgroup analog.
+    ``metric.py:88``) may be a :class:`~metrics_tpu.parallel.groups.ProcessGroup`:
+    the gather then runs over the member processes only, via the
+    KV-store exchange in ``parallel/groups.py`` (payloads are
+    self-describing, so the uneven-shape dance below is not needed there).
+    Any other non-None group type raises — pass a custom ``dist_sync_fn``
+    that understands it, or use in-trace sync over a mesh-axis subset
+    (``axis_name``), the in-trace subgroup analog.
     """
     if group is not None:
+        from metrics_tpu.parallel.groups import ProcessGroup, gather_group_arrays
+
+        if isinstance(group, ProcessGroup):
+            return gather_group_arrays(x, group)
         raise ValueError(
-            "`process_group` subgroups are not supported by the default host-level gather"
-            " (multihost_utils spans all processes). Provide a custom `dist_sync_fn`, or use"
-            " the pure state API inside shard_map with `axis_name` naming a mesh-axis subset."
+            f"Unsupported `process_group` type {type(group).__name__!r}: pass a"
+            " metrics_tpu.parallel.ProcessGroup (host-level subgroup), provide a custom"
+            " `dist_sync_fn`, or use the pure state API inside shard_map with `axis_name`"
+            " naming a mesh-axis subset."
         )
     if not distributed_available():
         return [x]

@@ -1,0 +1,241 @@
+"""Host-level process subgroups over the ``jax.distributed`` key-value store.
+
+TPU-native analog of the reference's ``process_group`` constructor argument
+(``torch.distributed.new_group`` handles threaded through reference
+``metric.py:88`` into ``gather_all_tensors``, ``utilities/distributed.py:96``).
+
+JAX's stock host collectives (``multihost_utils``) always span every process,
+so subgroup semantics are built one level lower, on the distributed runtime's
+coordination service: every group member
+
+1. publishes its array bytes under a per-call key
+   (``key_value_set_bytes``),
+2. reads the other members' keys (``blocking_key_value_get_bytes``),
+3. joins a *subset* barrier (``wait_at_barrier(process_ids=group.ranks)``)
+   so nobody deletes a key a peer has not read yet, then
+4. deletes its own key.
+
+Only group members ever touch these primitives — processes outside the group
+are neither blocked nor contacted, matching ``torch.distributed`` subgroup
+collectives. Payloads carry their own dtype and shape, so uneven per-rank
+buffers need no pad-to-max/trim dance at all (unlike the world-spanning path
+in ``comm.gather_all_arrays``).
+
+Like ``torch.distributed.new_group``, groups must be created in the same
+order with the same ranks on every participating process: per-group call
+counters key the KV entries, and they stay aligned only when member processes
+issue the same sequence of group collectives (the usual SPMD contract).
+"""
+import itertools
+import json
+import struct
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+_KV_PREFIX = "metrics_tpu/pg"
+
+# per-group monotonic call counters; aligned across processes by the SPMD
+# same-order contract documented above
+_call_counters: Dict[str, "itertools.count"] = {}
+
+
+class ProcessGroup:
+    """A named subset of JAX process indices for host-level metric sync.
+
+    Pass as ``Metric(process_group=...)`` (or directly to
+    ``comm.gather_all_arrays``) to restrict the compute-time state sync to the
+    member processes. ``ranks`` are **process** indices
+    (``jax.process_index()``), not device ids.
+
+    Args:
+        ranks: member process indices; deduplicated and sorted.
+        name: optional stable identifier. Processes that should communicate
+            must use equal names; defaults to a name derived from ``ranks``.
+        timeout_s: per-exchange timeout for the KV gets and the group barrier.
+    """
+
+    def __init__(self, ranks: Sequence[int], name: Optional[str] = None, timeout_s: float = 120.0) -> None:
+        cleaned = sorted({int(r) for r in ranks})
+        if not cleaned:
+            raise ValueError("A ProcessGroup needs at least one member rank.")
+        if cleaned[0] < 0:
+            raise ValueError(f"Process ranks must be non-negative, got {cleaned}.")
+        self.ranks = tuple(cleaned)
+        self.name = name if name is not None else "r" + "_".join(str(r) for r in cleaned)
+        self.timeout_s = float(timeout_s)
+
+    @property
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def __contains__(self, rank: int) -> bool:
+        return int(rank) in self.ranks
+
+    def __repr__(self) -> str:
+        return f"ProcessGroup(name={self.name!r}, ranks={list(self.ranks)})"
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, ProcessGroup) and (self.name, self.ranks) == (other.name, other.ranks)
+
+    def __hash__(self) -> int:
+        return hash((self.name, self.ranks))
+
+    @property
+    def _kv_scope(self) -> str:
+        # identity is (name, ranks) — two groups sharing a name but not
+        # members must not share a key/epoch namespace
+        return f"{self.name}:{'-'.join(str(r) for r in self.ranks)}"
+
+
+def new_group(ranks: Sequence[int], name: Optional[str] = None, timeout_s: float = 120.0) -> ProcessGroup:
+    """Create a :class:`ProcessGroup` — mirror of ``torch.distributed.new_group``."""
+    return ProcessGroup(ranks, name=name, timeout_s=timeout_s)
+
+
+def _kv_client():
+    from jax._src import distributed
+
+    client = getattr(distributed.global_state, "client", None)
+    if client is None:
+        raise RuntimeError(
+            "ProcessGroup sync needs the JAX distributed runtime: call"
+            " jax.distributed.initialize(...) before the first grouped compute()."
+        )
+    return client
+
+
+def _encode(arr: np.ndarray) -> bytes:
+    """Self-describing wire format: length-prefixed JSON header + raw bytes.
+
+    ``dtype.name`` round-trips every dtype JAX hands to the host, including
+    the ml_dtypes extension types (``np.dtype('bfloat16')`` resolves once
+    ml_dtypes is imported, which importing jax guarantees).
+    """
+    arr = np.asarray(arr, order="C")  # not ascontiguousarray: that promotes 0-d to (1,)
+    # dtype.name drops byte order — normalize so non-native-endian numpy input
+    # can't be reinterpreted as garbage by the receiver's native _decode
+    arr = arr.astype(arr.dtype.newbyteorder("="), copy=False)
+    header = json.dumps({"dtype": arr.dtype.name, "shape": list(arr.shape)}).encode()
+    return struct.pack(">I", len(header)) + header + arr.tobytes()
+
+
+def _decode(payload: bytes) -> np.ndarray:
+    (header_len,) = struct.unpack(">I", payload[:4])
+    header = json.loads(payload[4 : 4 + header_len].decode())
+    import ml_dtypes  # noqa: F401  (registers bfloat16 & friends with numpy)
+
+    dtype = np.dtype(header["dtype"])
+    data = np.frombuffer(payload[4 + header_len :], dtype=dtype)
+    return data.reshape(header["shape"])
+
+
+def _exchange_bytes(payload: bytes, group: ProcessGroup, rank: int) -> List[bytes]:
+    """One publish/read-all/barrier round among group members; returns the
+    per-member payloads ordered by ``group.ranks``.
+
+    The post-read subset barrier guarantees nobody deletes a key a peer has
+    not read yet; cleanup of the member's own key runs even when a read or
+    the barrier times out, so failed exchanges don't leak coordination-service
+    entries.
+    """
+    client = _kv_client()
+    scope = group._kv_scope
+    epoch = next(_call_counters.setdefault(scope, itertools.count()))
+    timeout_ms = max(1, int(group.timeout_s * 1000))
+
+    own_key = f"{_KV_PREFIX}/{scope}/{epoch}/{rank}"
+    client.key_value_set_bytes(own_key, payload)
+    try:
+        payloads = [
+            payload
+            if member == rank
+            else client.blocking_key_value_get_bytes(f"{_KV_PREFIX}/{scope}/{epoch}/{member}", timeout_ms)
+            for member in group.ranks
+        ]
+        client.wait_at_barrier(f"{_KV_PREFIX}/{scope}/{epoch}/done", timeout_ms, process_ids=list(group.ranks))
+    finally:
+        client.key_value_delete(own_key)
+    return payloads
+
+
+def _membership_or_raise(group: ProcessGroup) -> Optional[int]:
+    """Validate this process against ``group``; None means single-process no-op."""
+    import jax
+
+    if jax.process_count() == 1:
+        # single-process fallback, mirroring gather_all_arrays' no-op path
+        if group.ranks != (0,):
+            raise ValueError(
+                f"{group!r} names ranks beyond the single running process; start"
+                " multi-process JAX (jax.distributed.initialize) to use subgroups."
+            )
+        return None
+    rank = jax.process_index()
+    if rank not in group:
+        raise ValueError(
+            f"Process {rank} is not a member of {group!r}; grouped sync must only"
+            " run on member processes (create the metric with a group containing"
+            " this rank, or skip compute() here)."
+        )
+    if group.ranks[-1] >= jax.process_count():
+        raise ValueError(
+            f"{group!r} names rank {group.ranks[-1]} but only"
+            f" {jax.process_count()} processes are running."
+        )
+    return rank
+
+
+def gather_group_arrays(x: Any, group: ProcessGroup) -> List[Any]:
+    """All-gather ``x`` across the member processes of ``group``.
+
+    Returns one array per member, ordered by ``group.ranks``. Must be called
+    by every member (and only members) — the grouped analog of the collective
+    contract in ``comm.gather_all_arrays``.
+    """
+    import jax.numpy as jnp
+
+    rank = _membership_or_raise(group)
+    if rank is None:
+        return [x]
+    payloads = _exchange_bytes(_encode(np.asarray(x)), group, rank)
+    return [jnp.asarray(_decode(p)) for p in payloads]
+
+
+def gather_group_pytrees(tree: Any, group: ProcessGroup) -> List[Any]:
+    """All-gather a whole state pytree in ONE KV exchange.
+
+    ``Metric._sync_dist`` uses this instead of per-leaf
+    :func:`gather_group_arrays` so a metric with k array states pays one
+    publish/read/barrier round per ``compute()``, not k. Returns one tree per
+    member, ordered by ``group.ranks``. Members must hold structurally
+    identical trees (the usual SPMD contract — leaf shapes may differ, the
+    per-leaf wire headers carry them).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rank = _membership_or_raise(group)
+    if rank is None:
+        return [tree]
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    blocks = [_encode(np.asarray(leaf)) for leaf in leaves]
+    payload = struct.pack(">I", len(blocks)) + b"".join(struct.pack(">Q", len(b)) + b for b in blocks)
+
+    member_trees = []
+    for member_payload in _exchange_bytes(payload, group, rank):
+        (count,) = struct.unpack(">I", member_payload[:4])
+        if count != len(leaves):
+            raise ValueError(
+                f"Group member sent {count} state leaves but this process holds"
+                f" {len(leaves)} — metric states must be structurally identical"
+                " across the members of a ProcessGroup."
+            )
+        offset, member_leaves = 4, []
+        for _ in range(count):
+            (size,) = struct.unpack(">Q", member_payload[offset : offset + 8])
+            offset += 8
+            member_leaves.append(jnp.asarray(_decode(member_payload[offset : offset + size])))
+            offset += size
+        member_trees.append(jax.tree_util.tree_unflatten(treedef, member_leaves))
+    return member_trees

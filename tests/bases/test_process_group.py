@@ -1,0 +1,112 @@
+"""ProcessGroup host-subgroup sync: single-process unit coverage.
+
+The live multi-member exchange is exercised in the real 2-process lane
+(``tests/helpers/mp_worker.py`` subgroup scenarios, via
+``tests/bases/test_multiprocess.py``); here we pin everything that doesn't
+need a second process: construction/validation, the single-process fallback,
+the self-describing wire format (including ml_dtypes extension types), and
+the ``Metric(process_group=...)`` constructor contract the reference exposes
+at ``metric.py:88``.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy
+from metrics_tpu.parallel import ProcessGroup, gather_all_arrays, new_group
+from metrics_tpu.parallel.groups import (
+    _decode,
+    _encode,
+    gather_group_arrays,
+    gather_group_pytrees,
+)
+
+
+def test_group_construction_normalizes_ranks():
+    g = new_group([2, 0, 2, 1])
+    assert g.ranks == (0, 1, 2) and g.size == 3
+    assert 1 in g and 5 not in g
+    assert g == ProcessGroup([0, 1, 2], name=g.name)
+    assert g != new_group([0, 1])
+    assert "ranks=[0, 1, 2]" in repr(g)
+
+
+def test_group_construction_rejects_bad_ranks():
+    with pytest.raises(ValueError, match="at least one"):
+        ProcessGroup([])
+    with pytest.raises(ValueError, match="non-negative"):
+        ProcessGroup([0, -1])
+
+
+def test_single_process_fallback_and_overreach():
+    # rank-0 singleton degrades to the identity gather, like the world path
+    g0 = new_group([0])
+    out = gather_group_arrays(jnp.arange(3.0), g0)
+    assert len(out) == 1
+    np.testing.assert_array_equal(np.asarray(out[0]), np.arange(3.0))
+    # same through the public dispatch
+    out = gather_all_arrays(jnp.arange(3.0), group=g0)
+    assert len(out) == 1
+
+    with pytest.raises(ValueError, match="beyond the single running process"):
+        gather_group_arrays(jnp.zeros(1), new_group([0, 1]))
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32", "bool", "bfloat16", "float16"])
+def test_wire_format_round_trip(dtype):
+    rng = np.random.default_rng(0)
+    arr = rng.normal(size=(3, 5)).astype(np.float64)
+    arr = np.asarray(jnp.asarray(arr, dtype=dtype))  # jax casts to ml_dtypes where needed
+    back = _decode(_encode(arr))
+    assert back.dtype == arr.dtype and back.shape == arr.shape
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_wire_format_zero_size_and_scalar():
+    for arr in (np.zeros((0, 4), np.float32), np.float32(3.5)):
+        back = _decode(_encode(np.asarray(arr)))
+        np.testing.assert_array_equal(back, np.asarray(arr))
+        assert back.shape == np.asarray(arr).shape
+
+
+def test_wire_format_normalizes_byte_order():
+    # dtype.name drops endianness; encode must normalize, not corrupt
+    back = _decode(_encode(np.arange(3, dtype=">f8")))
+    np.testing.assert_array_equal(back, np.arange(3.0))
+
+
+def test_distinct_rank_sets_get_distinct_kv_scopes():
+    # identity is (name, ranks): same-name groups with different members must
+    # not share a key/epoch namespace
+    a = ProcessGroup([0, 1], name="g")
+    b = ProcessGroup([1, 2], name="g")
+    assert a._kv_scope != b._kv_scope
+    assert ProcessGroup([0, 1], name="g")._kv_scope == a._kv_scope
+
+
+def test_pytree_gather_single_process_fallback():
+    tree = {"tp": jnp.arange(3.0), "buf": [jnp.ones((2, 2))], "empty": []}
+    out = gather_group_pytrees(tree, new_group([0]))
+    assert len(out) == 1 and out[0] is tree
+    with pytest.raises(ValueError, match="beyond the single running process"):
+        gather_group_pytrees(tree, new_group([0, 1]))
+
+
+def test_metric_accepts_process_group_without_custom_sync_fn():
+    m = Accuracy(process_group=new_group([0]))
+    m.update(jnp.asarray([[0.1, 0.9]]), jnp.asarray([1]))
+    assert float(m.compute()) == 1.0
+
+
+def test_metric_rejects_foreign_group_objects_at_construction():
+    with pytest.raises(ValueError, match="Unsupported `process_group` type"):
+        Accuracy(process_group=object())
+    # ...unless a custom dist_sync_fn takes responsibility for it
+    m = Accuracy(process_group=object(), dist_sync_fn=lambda x, group=None: [x])
+    m.update(jnp.asarray([[0.1, 0.9]]), jnp.asarray([1]))
+    assert float(m.compute()) == 1.0
+
+
+def test_public_gather_rejects_foreign_group_objects():
+    with pytest.raises(ValueError, match="Unsupported `process_group` type"):
+        gather_all_arrays(jnp.zeros(1), group="not-a-group")

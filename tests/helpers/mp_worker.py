@@ -99,6 +99,78 @@ def run_scenarios(rank: int, world: int):
         val = np.asarray(res[key])
         if val.ndim == 0:
             out[f"map_{key}"] = val
+
+    if world > 1:
+        out.update(_subgroup_scenarios(rank, world, data, out))
+    return out
+
+
+def _subgroup_scenarios(rank: int, world: int, data, base):
+    """ProcessGroup host-subgroup sync: the reference's ``process_group`` analog.
+
+    Two invariants, asserted where the expectation lives:
+
+    * a group spanning every process must reproduce the default world sync —
+      asserted in-worker against ``base`` (the default-sync results) AND
+      returned to the parent, which additionally checks rank agreement;
+    * a singleton group containing only this rank must reproduce the local
+      un-synced value (asserted in-worker — the value is rank-specific).
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MeanAveragePrecision, SpearmanCorrCoef
+    from metrics_tpu.parallel import new_group
+
+    out = {}
+    everyone = new_group(range(world), name="everyone")
+
+    acc = Accuracy(num_classes=5, process_group=everyone)
+    for i in range(rank, len(data["acc_preds"]), world):
+        acc.update(jnp.asarray(data["acc_preds"][i]), jnp.asarray(data["acc_target"][i]))
+    out["pg_world_accuracy"] = np.asarray(acc.compute())
+    np.testing.assert_allclose(
+        out["pg_world_accuracy"], base["accuracy"], rtol=1e-12, atol=0,
+        err_msg="world-spanning ProcessGroup must equal the default world sync",
+    )
+
+    # uneven cat buffers through the KV-store gather (no pad/trim needed there)
+    sp = SpearmanCorrCoef(process_group=everyone)
+    for i in range(rank, len(data["sp_preds"]), world):
+        sp.update(jnp.asarray(data["sp_preds"][i]), jnp.asarray(data["sp_target"][i]))
+    out["pg_world_spearman"] = np.asarray(sp.compute())
+    np.testing.assert_allclose(
+        out["pg_world_spearman"], base["spearman"], rtol=1e-12, atol=0,
+        err_msg="world-spanning ProcessGroup must equal the default world sync",
+    )
+
+    # ragged mAP states: ten (flat, lengths) leaves in ONE batched KV exchange
+    det = MeanAveragePrecision(process_group=everyone)
+    for i in range(rank, len(data["det"]), world):
+        d = data["det"][i]
+        det.update(
+            [dict(boxes=jnp.asarray(d["boxes"]), scores=jnp.asarray(d["scores"]), labels=jnp.asarray(d["labels"]))],
+            [dict(boxes=jnp.asarray(d["gt_boxes"]), labels=jnp.asarray(d["gt_labels"]))],
+        )
+    res = det.compute()
+    for key in sorted(res):
+        val = np.asarray(res[key])
+        if val.ndim == 0:
+            np.testing.assert_allclose(
+                val, base[f"map_{key}"], rtol=1e-12, atol=0,
+                err_msg=f"grouped mAP {key} must equal the default world sync",
+            )
+
+    solo = new_group([rank], name=f"solo{rank}")
+    acc_solo = Accuracy(num_classes=5, process_group=solo)
+    acc_plain = Accuracy(num_classes=5)
+    acc_plain._to_sync = False  # local value, no collective
+    for i in range(rank, len(data["acc_preds"]), world):
+        acc_solo.update(jnp.asarray(data["acc_preds"][i]), jnp.asarray(data["acc_target"][i]))
+        acc_plain.update(jnp.asarray(data["acc_preds"][i]), jnp.asarray(data["acc_target"][i]))
+    np.testing.assert_allclose(
+        np.asarray(acc_solo.compute()), np.asarray(acc_plain.compute()), rtol=1e-12, atol=0,
+        err_msg="singleton ProcessGroup must equal the local un-synced value",
+    )
     return out
 
 
@@ -126,6 +198,29 @@ def _comm_layer_asserts(rank: int, world: int):
     # host_reduce cat over the uneven buffers
     cat = comm.host_reduce(local, "cat")
     assert cat.shape[0] == sum(2 + 3 * r for r in range(world))
+
+    # raw subgroup gather: uneven shapes ride the self-describing KV payloads
+    from metrics_tpu.parallel import new_group
+    from metrics_tpu.parallel.groups import gather_group_arrays
+
+    everyone = new_group(range(world), name="comm_raw")
+    gathered = gather_group_arrays(jnp.full((1 + rank, 3), float(rank)), everyone)
+    assert len(gathered) == world
+    for pos, r in enumerate(everyone.ranks):
+        np.testing.assert_array_equal(np.asarray(gathered[pos]), np.full((1 + r, 3), float(r)))
+
+    # a second collective on the same group must not collide with the first
+    again = gather_group_arrays(jnp.asarray([rank + 7]), everyone)
+    np.testing.assert_array_equal(np.asarray(jnp.concatenate(again)), np.arange(world) + 7)
+
+    # non-member processes must be rejected, not wedged
+    other = new_group([(rank + 1) % world], name=f"not_mine{rank}")
+    try:
+        gather_group_arrays(jnp.zeros(1), other)
+    except ValueError as err:
+        assert "not a member" in str(err)
+    else:
+        raise AssertionError("expected non-member gather to raise")
 
 
 def main():

@@ -5,6 +5,7 @@ The JAX analog of the reference's doctest'd rst pages
 """
 import pathlib
 import re
+import textwrap
 
 import pytest
 
@@ -16,7 +17,8 @@ def _collect():
     cases = []
     for path in sorted(DOCS.rglob("*.md")):
         for i, match in enumerate(_BLOCK.findall(path.read_text())):
-            cases.append(pytest.param(match, id=f"{path.relative_to(DOCS)}[{i}]"))
+            # blocks nested under list items arrive indented — dedent to execute
+            cases.append(pytest.param(textwrap.dedent(match), id=f"{path.relative_to(DOCS)}[{i}]"))
     return cases
 
 

@@ -388,9 +388,6 @@ def test_exact_curve_parity(tm, name):
 
 
 def test_hinge_auc_squad_parity(tm):
-    import jax.numpy as jnp
-    import torch
-
     import metrics_tpu as M
 
     rng = np.random.RandomState(21)
