@@ -24,7 +24,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.parallel import comm
 
 Array = jax.Array
 
@@ -171,7 +170,6 @@ class MeanAveragePrecision(Metric):
         pre-concatenation (``metric.py:236-237``) would merge every image's
         boxes into one — the reference has the same hazard, pycocotools parity
         requires per-image structure."""
-        gather = dist_sync_fn or comm.gather_all_arrays
         group = process_group or self.process_group
 
         packed, meta = {}, {}
@@ -192,21 +190,15 @@ class MeanAveragePrecision(Metric):
             packed[name] = {"flat": jnp.asarray(byte_rows), "len": lengths}
             meta[name] = (cols, dtype, width)
 
-        from metrics_tpu.parallel.groups import ProcessGroup, gather_group_pytrees
+        from metrics_tpu.parallel.groups import gather_state_trees
 
-        if dist_sync_fn is None and isinstance(group, ProcessGroup):
-            # all ten (flat, lengths) leaves ride ONE KV exchange — one
-            # subset barrier per compute(), matching Metric._sync_dist
-            member_trees = gather_group_pytrees(packed, group)
-            gathered = {
-                name: ([t[name]["flat"] for t in member_trees], [t[name]["len"] for t in member_trees])
-                for name in packed
-            }
-        else:
-            gathered = {
-                name: (gather(v["flat"], group=group), gather(v["len"], group=group))
-                for name, v in packed.items()
-            }
+        # one tree per sync peer; under a ProcessGroup all ten (flat, lengths)
+        # leaves ride ONE KV exchange — one subset barrier per compute()
+        member_trees = gather_state_trees(packed, group, dist_sync_fn)
+        gathered = {
+            name: ([t[name]["flat"] for t in member_trees], [t[name]["len"] for t in member_trees])
+            for name in packed
+        }
 
         for name, (gathered_flat, gathered_len) in gathered.items():
             cols, dtype, width = meta[name]

@@ -453,7 +453,6 @@ class Metric:
     # ------------------------------------------------------------------
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
         """Gather+reduce every state across processes (reference ``metric.py:231-256``)."""
-        gather = dist_sync_fn or comm.gather_all_arrays
         input_dict = {attr: getattr(self, attr) for attr in self._reductions}
 
         for attr, reduction_fn in self._reductions.items():
@@ -462,20 +461,13 @@ class Metric:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
 
         group = process_group or self.process_group
-        from metrics_tpu.parallel.groups import ProcessGroup, gather_group_pytrees
+        from metrics_tpu.parallel.groups import gather_state_trees
 
-        if dist_sync_fn is None and isinstance(group, ProcessGroup):
-            # batch the whole state dict into ONE KV exchange (one barrier per
-            # compute(), not one per state leaf)
-            member_trees = gather_group_pytrees(input_dict, group)
-            output_dict = jax.tree_util.tree_map(lambda *leaves: list(leaves), *member_trees)
-        else:
-            output_dict = apply_to_collection(
-                input_dict,
-                (jax.Array, jnp.ndarray),
-                gather,
-                group=group,
-            )
+        # one tree per sync peer; a ProcessGroup with the default gather
+        # batches the whole state dict into ONE KV exchange (one barrier per
+        # compute(), not one per state leaf)
+        member_trees = gather_state_trees(input_dict, group, dist_sync_fn)
+        output_dict = jax.tree_util.tree_map(lambda *leaves: list(leaves), *member_trees)
 
         for attr, reduction_fn in self._reductions.items():
             output = output_dict[attr]

@@ -29,7 +29,8 @@ issue the same sequence of group collectives (the usual SPMD contract).
 import itertools
 import json
 import struct
-from typing import Any, Dict, List, Optional, Sequence
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -202,6 +203,44 @@ def gather_group_arrays(x: Any, group: ProcessGroup) -> List[Any]:
     return [jnp.asarray(_decode(p)) for p in payloads]
 
 
+def _tree_signature(treedef) -> int:
+    """Cheap structural fingerprint shipped with each payload, so peers whose
+    state trees differ in SHAPE (not just leaf count) fail loudly instead of
+    silently cross-assigning leaves — e.g. rank 0 holding ``{A: [x], B: []}``
+    against rank 1's ``{A: [], B: [y]}`` flattens to one leaf on both sides."""
+    return zlib.crc32(str(treedef).encode())
+
+
+def _encode_tree(tree: Any) -> bytes:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    blocks = [_encode(np.asarray(leaf)) for leaf in leaves]
+    header = struct.pack(">II", len(blocks), _tree_signature(treedef))
+    return header + b"".join(struct.pack(">Q", len(b)) + b for b in blocks)
+
+
+def _decode_tree(payload: bytes, treedef, n_leaves: int) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    count, sig = struct.unpack(">II", payload[:8])
+    if count != n_leaves or sig != _tree_signature(treedef):
+        raise ValueError(
+            f"Group member sent a state tree with {count} leaves (structure"
+            f" fingerprint {sig:#010x}) but this process holds {n_leaves}"
+            f" ({_tree_signature(treedef):#010x}) — metric states must be"
+            " structurally identical across the members of a ProcessGroup."
+        )
+    offset, member_leaves = 8, []
+    for _ in range(count):
+        (size,) = struct.unpack(">Q", payload[offset : offset + 8])
+        offset += 8
+        member_leaves.append(jnp.asarray(_decode(payload[offset : offset + size])))
+        offset += size
+    return jax.tree_util.tree_unflatten(treedef, member_leaves)
+
+
 def gather_group_pytrees(tree: Any, group: ProcessGroup) -> List[Any]:
     """All-gather a whole state pytree in ONE KV exchange.
 
@@ -210,32 +249,45 @@ def gather_group_pytrees(tree: Any, group: ProcessGroup) -> List[Any]:
     publish/read/barrier round per ``compute()``, not k. Returns one tree per
     member, ordered by ``group.ranks``. Members must hold structurally
     identical trees (the usual SPMD contract — leaf shapes may differ, the
-    per-leaf wire headers carry them).
+    per-leaf wire headers carry them; tree STRUCTURE is fingerprinted and
+    verified).
     """
     import jax
-    import jax.numpy as jnp
 
     rank = _membership_or_raise(group)
     if rank is None:
         return [tree]
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    blocks = [_encode(np.asarray(leaf)) for leaf in leaves]
-    payload = struct.pack(">I", len(blocks)) + b"".join(struct.pack(">Q", len(b)) + b for b in blocks)
+    payload = _encode_tree(tree)
+    return [
+        _decode_tree(member_payload, treedef, len(leaves))
+        for member_payload in _exchange_bytes(payload, group, rank)
+    ]
 
-    member_trees = []
-    for member_payload in _exchange_bytes(payload, group, rank):
-        (count,) = struct.unpack(">I", member_payload[:4])
-        if count != len(leaves):
-            raise ValueError(
-                f"Group member sent {count} state leaves but this process holds"
-                f" {len(leaves)} — metric states must be structurally identical"
-                " across the members of a ProcessGroup."
-            )
-        offset, member_leaves = 4, []
-        for _ in range(count):
-            (size,) = struct.unpack(">Q", member_payload[offset : offset + 8])
-            offset += 8
-            member_leaves.append(jnp.asarray(_decode(member_payload[offset : offset + size])))
-            offset += size
-        member_trees.append(jax.tree_util.tree_unflatten(treedef, member_leaves))
-    return member_trees
+
+def gather_state_trees(tree: Any, group: Optional[Any], dist_sync_fn: Optional[Callable] = None) -> List[Any]:
+    """Gather a whole state tree from every sync peer; one tree per member.
+
+    The single dispatch point shared by ``Metric._sync_dist`` and the
+    detection-mAP override: a :class:`ProcessGroup` with the default gather
+    takes the batched one-exchange path above; anything else (custom
+    ``dist_sync_fn``, world-spanning default) gathers per leaf and
+    transposes the results into per-member trees.
+    """
+    import jax
+
+    if dist_sync_fn is None and isinstance(group, ProcessGroup):
+        return gather_group_pytrees(tree, group)
+
+    from metrics_tpu.parallel import comm
+
+    gather = dist_sync_fn or comm.gather_all_arrays
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return [tree]
+    gathered = [gather(leaf, group=group) for leaf in leaves]  # [n_leaves][n_members]
+    n_members = len(gathered[0])
+    return [
+        jax.tree_util.tree_unflatten(treedef, [per_leaf[m] for per_leaf in gathered])
+        for m in range(n_members)
+    ]

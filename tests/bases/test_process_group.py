@@ -16,9 +16,12 @@ from metrics_tpu import Accuracy
 from metrics_tpu.parallel import ProcessGroup, gather_all_arrays, new_group
 from metrics_tpu.parallel.groups import (
     _decode,
+    _decode_tree,
     _encode,
+    _encode_tree,
     gather_group_arrays,
     gather_group_pytrees,
+    gather_state_trees,
 )
 
 
@@ -90,6 +93,39 @@ def test_pytree_gather_single_process_fallback():
     assert len(out) == 1 and out[0] is tree
     with pytest.raises(ValueError, match="beyond the single running process"):
         gather_group_pytrees(tree, new_group([0, 1]))
+
+
+def test_tree_wire_round_trip_and_structure_guard():
+    import jax
+
+    tree = {"tp": jnp.arange(3.0), "buf": [jnp.ones((2, 2))], "n": jnp.asarray(4)}
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    back = _decode_tree(_encode_tree(tree), treedef, len(leaves))
+    for a, b in zip(jax.tree_util.tree_leaves(back), leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # same leaf COUNT, different structure: {A:[x], B:[]} vs {A:[], B:[y]}
+    mine = {"A": [jnp.arange(2.0)], "B": []}
+    theirs = {"A": [], "B": [jnp.arange(2.0)]}
+    _, my_def = jax.tree_util.tree_flatten(mine)
+    with pytest.raises(ValueError, match="structurally identical"):
+        _decode_tree(_encode_tree(theirs), my_def, 1)
+    # plain count mismatch also refuses
+    with pytest.raises(ValueError, match="structurally identical"):
+        _decode_tree(_encode_tree({"A": jnp.zeros(1), "B": jnp.zeros(1)}), my_def, 1)
+
+
+def test_gather_state_trees_custom_fn_transposes_members():
+    # the shared dispatch: a custom dist_sync_fn takes the per-leaf path and
+    # results transpose into one tree per member
+    tree = {"a": jnp.arange(2.0), "b": [jnp.ones((1, 2))]}
+    fake = lambda x, group=None: [x, x + 1]
+    members = gather_state_trees(tree, None, fake)
+    assert len(members) == 2
+    np.testing.assert_array_equal(np.asarray(members[1]["a"]), np.arange(2.0) + 1)
+    np.testing.assert_array_equal(np.asarray(members[1]["b"][0]), np.ones((1, 2)) + 1)
+    # zero-leaf tree short-circuits
+    assert gather_state_trees({"empty": []}, None, fake)[0] == {"empty": []}
 
 
 def test_metric_accepts_process_group_without_custom_sync_fn():

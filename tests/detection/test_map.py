@@ -255,12 +255,14 @@ class TestMeanAveragePrecision:
         for i, (p, t) in enumerate(samples):
             (rank0 if i % 2 == 0 else rank1).update([p], [t])
 
-        # fake 2-rank gather replaying each rank's flat/length pairs in call order
+        # fake 2-rank gather replaying each rank's flat/length pairs in call
+        # order; _sync_dist gathers leaves in pytree order (sorted state name,
+        # then "flat" < "len" within each state)
         calls = {"i": 0}
         rank_payloads = []
         for m in (rank0, rank1):
             payload = []
-            for name, width in MeanAveragePrecision._STATE_WIDTHS.items():
+            for name, width in sorted(MeanAveragePrecision._STATE_WIDTHS.items()):
                 local = getattr(m, name)
                 cols = width if width else 1
                 dtype = np.int64 if "labels" in name else np.float64
