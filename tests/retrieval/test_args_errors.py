@@ -1,0 +1,255 @@
+"""Retrieval argument validation, error conditions, k sweeps, and
+``empty_target_action`` behavior across EVERY retrieval metric.
+
+Mirror of the reference's per-metric error matrices
+(``tests/retrieval/helpers.py:131-310`` ``_errors_test_*`` parameter sets,
+applied in each ``tests/retrieval/test_*.py``) — the reference runs every
+case against every metric; this module does the same via parametrization.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRPrecision,
+    RetrievalRecall,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from tests.retrieval.test_retrieval import (
+    _np_ap,
+    _np_fall_out,
+    _np_hit_rate,
+    _np_mrr,
+    _np_ndcg,
+    _np_precision,
+    _np_r_precision,
+    _np_recall,
+)
+
+_ALL_CLASSES = [
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalNormalizedDCG,
+]
+_TOPK_CLASSES = [RetrievalPrecision, RetrievalRecall, RetrievalFallOut, RetrievalHitRate, RetrievalNormalizedDCG]
+_ALL_FUNCTIONALS = [
+    retrieval_average_precision,
+    retrieval_reciprocal_rank,
+    retrieval_precision,
+    retrieval_recall,
+    retrieval_r_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+]
+_TOPK_FUNCTIONALS = [retrieval_precision, retrieval_recall, retrieval_fall_out, retrieval_hit_rate, retrieval_normalized_dcg]
+
+_PREDS = jnp.asarray([0.9, 0.3, 0.5, 0.8])
+_TARGET = jnp.asarray([1, 0, 1, 0])
+_INDEXES = jnp.asarray([0, 0, 1, 1])
+
+
+# ---------------------------------------------------------------------------
+# constructor validation — every class
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("metric_class", _ALL_CLASSES)
+def test_ctor_rejects_bad_empty_target_action(metric_class):
+    with pytest.raises(ValueError, match="`empty_target_action` received a wrong value `casual_argument`"):
+        metric_class(empty_target_action="casual_argument")
+
+
+@pytest.mark.parametrize("metric_class", _ALL_CLASSES)
+def test_ctor_rejects_non_int_ignore_index(metric_class):
+    with pytest.raises(ValueError, match="Argument `ignore_index` must be an integer or None."):
+        metric_class(ignore_index=-100.0)
+
+
+@pytest.mark.parametrize("metric_class", _TOPK_CLASSES)
+@pytest.mark.parametrize("k", [-10, 0, 4.0])
+def test_ctor_rejects_bad_k(metric_class, k):
+    with pytest.raises(ValueError, match="`k` has to be a positive integer or None"):
+        metric_class(k=k)
+
+
+# ---------------------------------------------------------------------------
+# update-time input validation — every class
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("metric_class", _ALL_CLASSES)
+def test_update_rejects_none_indexes(metric_class):
+    with pytest.raises(ValueError, match="`indexes` cannot be None"):
+        metric_class().update(_PREDS, _TARGET, None)
+
+
+@pytest.mark.parametrize("metric_class", _ALL_CLASSES)
+def test_update_rejects_shape_mismatch(metric_class):
+    with pytest.raises(ValueError, match="must all share one shape"):
+        metric_class().update(_PREDS, _TARGET[:3], _INDEXES[:3])
+
+
+@pytest.mark.parametrize("metric_class", _ALL_CLASSES)
+def test_update_rejects_non_integer_indexes(metric_class):
+    with pytest.raises(ValueError, match="`indexes` must be integer typed"):
+        metric_class().update(_PREDS, _TARGET, jnp.asarray([0.0, 0.0, 1.0, 1.0]))
+
+
+@pytest.mark.parametrize("metric_class", _ALL_CLASSES)
+def test_update_rejects_non_float_preds(metric_class):
+    with pytest.raises(ValueError, match="`preds` must be floating-point"):
+        metric_class().update(jnp.asarray([True, False, True, False]), _TARGET, _INDEXES)
+
+
+@pytest.mark.parametrize("metric_class", [c for c in _ALL_CLASSES if c is not RetrievalNormalizedDCG])
+def test_update_rejects_non_binary_target(metric_class):
+    with pytest.raises(ValueError, match="`target` must be binary"):
+        metric_class().update(_PREDS, jnp.asarray([0, 2, 1, 0]), _INDEXES)
+
+
+def test_ndcg_accepts_graded_target():
+    m = RetrievalNormalizedDCG()
+    m.update(_PREDS, jnp.asarray([0, 3, 1, 2]), _INDEXES)
+    assert np.isfinite(float(m.compute()))
+
+
+# ---------------------------------------------------------------------------
+# functional input validation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("metric_fn", _ALL_FUNCTIONALS)
+def test_functional_rejects_shape_mismatch(metric_fn):
+    with pytest.raises(ValueError, match="must share one shape"):
+        metric_fn(_PREDS, _TARGET[:3])
+
+
+@pytest.mark.parametrize("metric_fn", _ALL_FUNCTIONALS)
+def test_functional_rejects_empty(metric_fn):
+    with pytest.raises(ValueError, match="non-scalar and contain at least one element"):
+        metric_fn(jnp.asarray([]), jnp.asarray([]))
+
+
+@pytest.mark.parametrize("metric_fn", _ALL_FUNCTIONALS)
+def test_functional_rejects_non_float_preds(metric_fn):
+    with pytest.raises(ValueError, match="`preds` must be floating-point"):
+        metric_fn(jnp.asarray([True, False]), jnp.asarray([1, 0]))
+
+
+@pytest.mark.parametrize("metric_fn", _TOPK_FUNCTIONALS)
+@pytest.mark.parametrize("k", [-10, 0, 4.0])
+def test_functional_rejects_bad_k(metric_fn, k):
+    with pytest.raises(ValueError, match="`k` has to be a positive integer or None"):
+        metric_fn(_PREDS[:2], _TARGET[:2], k=k)
+
+
+# ---------------------------------------------------------------------------
+# k sweep vs numpy oracles — reference parametrizes k per metric
+# ---------------------------------------------------------------------------
+
+_K_ORACLES = {
+    retrieval_precision: _np_precision,
+    retrieval_recall: _np_recall,
+    retrieval_fall_out: _np_fall_out,
+    retrieval_hit_rate: _np_hit_rate,
+    retrieval_normalized_dcg: _np_ndcg,
+}
+
+
+@pytest.mark.parametrize("metric_fn", _TOPK_FUNCTIONALS, ids=lambda f: f.__name__)
+@pytest.mark.parametrize("k", [1, 2, 4, 10, None])
+def test_k_sweep_matches_oracle(metric_fn, k):
+    rng = np.random.RandomState(7)
+    oracle = _K_ORACLES[metric_fn]
+    for trial in range(8):
+        n = rng.randint(2, 20)
+        p = rng.rand(n)
+        t = rng.randint(0, 2, n)
+        if t.sum() == 0 or t.sum() == n:  # keep queries non-degenerate
+            t[rng.randint(n)] = 1 - t[0]
+        got = metric_fn(jnp.asarray(p), jnp.asarray(t), k=k)
+        want = oracle(p, t, k=k)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6, err_msg=f"{metric_fn.__name__} k={k} trial {trial}")
+
+
+# ---------------------------------------------------------------------------
+# empty_target_action across every metric class
+# ---------------------------------------------------------------------------
+
+_ETA_ORACLES = [
+    (RetrievalMAP, _np_ap, {}),
+    (RetrievalMRR, _np_mrr, {}),
+    (RetrievalPrecision, _np_precision, {}),
+    (RetrievalRecall, _np_recall, {}),
+    (RetrievalRPrecision, _np_r_precision, {}),
+    (RetrievalHitRate, _np_hit_rate, {}),
+    (RetrievalNormalizedDCG, _np_ndcg, {}),
+]
+
+
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+@pytest.mark.parametrize("metric_class, oracle, args", _ETA_ORACLES, ids=lambda v: getattr(v, "__name__", ""))
+def test_empty_target_action_every_metric(metric_class, oracle, args, action):
+    # query 0 has no positive target (empty); query 1 is well-formed
+    preds = jnp.asarray([0.1, 0.9, 0.6, 0.4, 0.7])
+    target = jnp.asarray([0, 0, 1, 0, 1])
+    indexes = jnp.asarray([0, 0, 1, 1, 1])
+    m = metric_class(empty_target_action=action, **args)
+    m.update(preds, target, indexes)
+    v1 = float(oracle(np.asarray(preds[2:]), np.asarray(target[2:]), **args))
+    expected = {"neg": (0.0 + v1) / 2, "pos": (1.0 + v1) / 2, "skip": v1}[action]
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-6)
+
+
+@pytest.mark.parametrize("metric_class", [c for c in _ALL_CLASSES if c is not RetrievalFallOut])
+def test_empty_target_error_message(metric_class):
+    m = metric_class(empty_target_action="error")
+    m.update(jnp.asarray([0.5, 0.2]), jnp.asarray([0, 0]), jnp.asarray([0, 0]))
+    with pytest.raises(ValueError, match="no positive target"):
+        m.compute()
+
+
+def test_fall_out_empty_is_all_positive():
+    # fall-out's "empty" query is one with no NEGATIVE targets
+    m = RetrievalFallOut(empty_target_action="error")
+    m.update(jnp.asarray([0.5, 0.2]), jnp.asarray([1, 1]), jnp.asarray([0, 0]))
+    with pytest.raises(ValueError, match="no negative target"):
+        m.compute()
+    for action, expected_fill in (("neg", 0.0), ("pos", 1.0)):
+        m = RetrievalFallOut(empty_target_action=action)
+        m.update(jnp.asarray([0.5, 0.2, 0.9, 0.1]), jnp.asarray([1, 1, 0, 1]), jnp.asarray([0, 0, 1, 1]))
+        v1 = _np_fall_out(np.asarray([0.9, 0.1]), np.asarray([0, 1]))
+        np.testing.assert_allclose(np.asarray(m.compute()), (expected_fill + v1) / 2, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# ignore_index across every metric class
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("metric_class, oracle, args", _ETA_ORACLES, ids=lambda v: getattr(v, "__name__", ""))
+def test_ignore_index_every_metric(metric_class, oracle, args):
+    preds = jnp.asarray([0.9, 0.8, 0.7, 0.6])
+    target = jnp.asarray([1, -100, 0, 1])
+    indexes = jnp.asarray([0, 0, 0, 0])
+    m = metric_class(ignore_index=-100, **args)
+    m.update(preds, target, indexes)
+    want = oracle(np.asarray([0.9, 0.7, 0.6]), np.asarray([1, 0, 1]), **args)
+    np.testing.assert_allclose(np.asarray(m.compute()), want, atol=1e-6)

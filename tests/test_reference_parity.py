@@ -8,10 +8,13 @@ tests, because the reference's own quirks (e.g. binary inputs counting both
 classes under micro reduction) are compared exactly. Skipped wholesale when
 the reference checkout is absent.
 
-75 comparisons across classification (every ``average``, ``top_k`` 1-3,
-``samples``, subset accuracy, stat-scores reductions, confusion-matrix
+130+ comparisons across classification (every ``average`` x every input
+archetype — binary/multilabel/multiclass/mdmc, probs and labels, ``top_k``
+1-3, ``samples``, subset accuracy, thresholds, ``ignore_index``,
+``multiclass=False``, stat-scores reductions, confusion-matrix
 normalizations, kappa/MCC/hamming/jaccard/AUROC/AP/ECE/KL), regression (10),
-retrieval (8), text (9), audio (4) and image (2).
+retrieval (8), text (9), audio (4) and image (2) — plus error-parity cases
+asserting both frameworks reject the same invalid configurations.
 """
 import importlib.util
 import pathlib
@@ -59,10 +62,24 @@ def _run_pair(ours, ref, batches):
     return ours.compute(), ref.compute()
 
 
-def _cls_batches(rng, n_batches=3, C=4, multilabel=False, probs=True):
+def _cls_batches(rng, n_batches=3, C=4, multilabel=False, probs=True, mode=None):
     out = []
     for _ in range(n_batches):
-        if multilabel:
+        if mode == "binary_prob":
+            out.append((rng.rand(16).astype(np.float32), rng.randint(0, 2, 16)))
+        elif mode == "binary":
+            out.append((rng.randint(0, 2, 16), rng.randint(0, 2, 16)))
+        elif mode == "multilabel_labels":
+            out.append((rng.randint(0, 2, (16, C)), rng.randint(0, 2, (16, C))))
+        elif mode == "multilabel_no_match":
+            p = rng.randint(0, 2, (16, C))
+            out.append((p, 1 - p))
+        elif mode == "mdmc_prob":
+            p = rng.rand(16, C, 8).astype(np.float32)
+            out.append((p / p.sum(1, keepdims=True), rng.randint(0, C, (16, 8))))
+        elif mode == "mdmc":
+            out.append((rng.randint(0, C, (16, 8)), rng.randint(0, C, (16, 8))))
+        elif multilabel:
             out.append((rng.rand(16, C).astype(np.float32), rng.randint(0, 2, (16, C))))
         elif probs:
             p = rng.rand(16, C).astype(np.float32)
@@ -91,6 +108,41 @@ _CLS_CASES = [
     ("AUROC", dict(num_classes=4), {}),
     ("AveragePrecision", dict(num_classes=4), {}),
     ("CalibrationError", {}, {}),
+    # --- input-archetype matrix: every reference input case through the
+    # stat-scores family (reference tests/classification/test_*.py tables) ---
+    *[(name, dict(num_classes=4, average=avg), dict(multilabel=True))
+      for avg in ("micro", "macro", "weighted")
+      for name in ("Accuracy", "Precision", "Recall", "F1Score", "Specificity")],
+    *[(name, {}, dict(mode="binary_prob")) for name in ("Accuracy", "Precision", "Recall", "F1Score")],
+    *[(name, {}, dict(mode="binary")) for name in ("Accuracy", "Precision", "Recall")],
+    *[(name, dict(threshold=0.3), dict(mode="binary_prob")) for name in ("Accuracy", "Precision")],
+    ("Accuracy", dict(num_classes=4, threshold=0.3), dict(multilabel=True)),
+    # int (N, C) binary inputs are read as multi-dim multi-class by both
+    # frameworks: Accuracy's mdmc_average defaults to "global" while
+    # Precision/Recall default to None (both raise without it) — cover the
+    # explicit-mdmc read AND the multiclass=False multilabel read
+    ("Accuracy", dict(num_classes=4, average="micro"), dict(mode="multilabel_labels")),
+    *[(name, dict(num_classes=4, average="micro", mdmc_average="global"), dict(mode="multilabel_labels"))
+      for name in ("Precision", "Recall")],
+    *[(name, dict(num_classes=4, average="micro", multiclass=False), dict(mode="multilabel_labels"))
+      for name in ("Precision", "Recall")],
+    *[(name, dict(num_classes=4, average=avg, mdmc_average="samplewise"), dict(mode="multilabel_no_match"))
+      for avg in ("micro", "macro") for name in ("Precision", "Recall")],
+    *[(name, dict(num_classes=4, average=avg, mdmc_average=mdmc), dict(mode="mdmc_prob"))
+      for avg in ("micro", "macro") for mdmc in ("global", "samplewise")
+      for name in ("Accuracy", "Precision", "Recall", "F1Score")],
+    *[("Accuracy", dict(num_classes=4, average="micro", mdmc_average=mdmc), dict(mode="mdmc"))
+      for mdmc in ("global", "samplewise")],
+    ("Accuracy", dict(num_classes=4, ignore_index=0), {}),
+    ("Precision", dict(num_classes=4, average="macro", ignore_index=1), {}),
+    ("Recall", dict(num_classes=4, average="weighted", ignore_index=2), {}),
+    ("StatScores", dict(reduce="samples"), {}),
+    ("StatScores", dict(reduce="macro", num_classes=4, mdmc_reduce="samplewise"), dict(mode="mdmc_prob")),
+    ("StatScores", dict(reduce="macro", num_classes=4, mdmc_reduce="global"), dict(mode="mdmc")),
+    ("HammingDistance", dict(threshold=0.3), dict(multilabel=True)),
+    *[("FBetaScore", dict(num_classes=4, average=avg, beta=2.0), {}) for avg in ("micro", "macro", "weighted")],
+    ("FBetaScore", dict(num_classes=4, average="macro", beta=0.5), dict(multilabel=True)),
+    ("Specificity", dict(num_classes=4, average="none", mdmc_average="global"), dict(mode="mdmc_prob")),
 ]
 
 
@@ -104,6 +156,35 @@ def test_classification_parity(tm, name, kwargs, data_kw):
         getattr(M, name)(**kwargs), getattr(tm, name)(**kwargs), _cls_batches(rng, **data_kw)
     )
     _cmp(got, want)
+
+
+_ERROR_PARITY_CASES = [
+    # (name, ctor kwargs, data mode): configurations BOTH frameworks must
+    # reject with a ValueError at construction or first update
+    ("Precision", dict(num_classes=4, average="micro"), "multilabel_labels"),  # mdmc without mdmc_average
+    ("Recall", dict(num_classes=4, average="micro"), "mdmc"),
+    ("Accuracy", dict(num_classes=4, top_k=2), "binary_prob"),  # top_k on binary
+    ("Accuracy", dict(num_classes=8), None),  # (N, 4) prob preds contradict num_classes=8
+    ("Precision", dict(num_classes=2, average="bad_avg"), None),
+]
+
+
+@pytest.mark.parametrize("name,kwargs,mode", _ERROR_PARITY_CASES,
+                         ids=[f"{n}-{i}" for i, (n, _, _) in enumerate(_ERROR_PARITY_CASES)])
+def test_classification_error_parity(tm, name, kwargs, mode):
+    """Invalid configurations raise in BOTH frameworks (messages may differ)."""
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(5)
+    (p, t) = _cls_batches(rng, n_batches=1, mode=mode)[0]
+    for lib, conv in ((M, jnp.asarray), (tm, torch.from_numpy)):
+        with pytest.raises((ValueError, RuntimeError)):
+            metric = getattr(lib, name)(**kwargs)
+            metric.update(conv(p), conv(t))
+            metric.compute()
 
 
 def test_kl_divergence_parity(tm):
