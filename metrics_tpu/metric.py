@@ -736,10 +736,12 @@ class Metric:
         return CompositionalMetric(jnp.floor_divide, other, self)
 
     def __mod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, self, other)
+        # fmod, not mod: the reference's ``torch.fmod`` (``metric.py:622``)
+        # keeps the dividend's sign, Python-style ``%`` the divisor's
+        return CompositionalMetric(jnp.fmod, self, other)
 
     def __rmod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, other, self)
+        return CompositionalMetric(jnp.fmod, other, self)
 
     def __pow__(self, other: Any) -> "CompositionalMetric":
         return CompositionalMetric(jnp.power, self, other)
@@ -799,7 +801,10 @@ class Metric:
         return CompositionalMetric(jnp.abs, self, None)
 
     def __invert__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.logical_not, self, None)
+        # bitwise (not logical) complement — matches the reference's
+        # ``torch.bitwise_not`` (``metric.py:684-688``): identical on bools,
+        # two's-complement on ints
+        return CompositionalMetric(jnp.bitwise_not, self, None)
 
     def __getitem__(self, idx: Any) -> "CompositionalMetric":
         return CompositionalMetric(lambda x: x[idx], self, None)

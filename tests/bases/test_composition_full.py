@@ -1,0 +1,139 @@
+"""Every CompositionalMetric operator, against every operand kind.
+
+Mirror of the reference's exhaustive operator suite
+(``tests/bases/test_composition.py`` — one parametrized test per dunder,
+with metric/int/float/tensor second operands and the reflected variants).
+``tests/bases/test_composition.py`` here covers lifecycle semantics
+(forward/reset/nesting); this module pins the full arithmetic surface.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.metric import CompositionalMetric
+
+
+class Const(Metric):
+    """Computes a constant — the reference's DummyMetric pattern."""
+
+    full_state_update = True
+
+    def __init__(self, val):
+        super().__init__(jit_update=False)
+        self._val = jnp.asarray(val)
+        self.add_state("n", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, *_):
+        self.n = self.n + 1
+
+    def compute(self):
+        return self._val
+
+
+def _value(comp):
+    comp.update()
+    return np.asarray(comp.compute())
+
+
+# (operator on composition, plain-value oracle, left value, right value)
+_BINARY_CASES = [
+    ("add", lambda a, b: a + b, 5.0, 3.0),
+    ("sub", lambda a, b: a - b, 5.0, 3.0),
+    ("mul", lambda a, b: a * b, 5.0, 3.0),
+    ("truediv", lambda a, b: a / b, 5.0, 3.0),
+    ("floordiv", lambda a, b: a // b, 5.0, 3.0),
+    ("mod", lambda a, b: a % b, 5.0, 3.0),
+    ("pow", lambda a, b: a**b, 5.0, 3.0),
+    ("and", lambda a, b: a & b, 6, 3),
+    ("or", lambda a, b: a | b, 6, 3),
+    ("xor", lambda a, b: a ^ b, 6, 3),
+    ("eq", lambda a, b: a == b, 3.0, 3.0),
+    ("ne", lambda a, b: a != b, 5.0, 3.0),
+    ("lt", lambda a, b: a < b, 5.0, 3.0),
+    ("le", lambda a, b: a <= b, 3.0, 3.0),
+    ("gt", lambda a, b: a > b, 5.0, 3.0),
+    ("ge", lambda a, b: a >= b, 5.0, 3.0),
+]
+
+_APPLY = {
+    "add": lambda a, b: a + b, "sub": lambda a, b: a - b, "mul": lambda a, b: a * b,
+    "truediv": lambda a, b: a / b, "floordiv": lambda a, b: a // b, "mod": lambda a, b: a % b,
+    "pow": lambda a, b: a**b, "and": lambda a, b: a & b, "or": lambda a, b: a | b,
+    "xor": lambda a, b: a ^ b, "eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b, "le": lambda a, b: a <= b, "gt": lambda a, b: a > b,
+    "ge": lambda a, b: a >= b,
+}
+
+
+@pytest.mark.parametrize("name, oracle, a, b", _BINARY_CASES, ids=[c[0] for c in _BINARY_CASES])
+@pytest.mark.parametrize("operand_kind", ["metric", "python", "array"])
+def test_binary_operator(name, oracle, a, b, operand_kind):
+    op = _APPLY[name]
+    rhs = {"metric": Const(b), "python": b, "array": jnp.asarray(b)}[operand_kind]
+    comp = op(Const(a), rhs)
+    assert isinstance(comp, CompositionalMetric)
+    expected = oracle(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(_value(comp), expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("name, oracle, a, b", _BINARY_CASES, ids=[c[0] for c in _BINARY_CASES])
+@pytest.mark.parametrize("operand_kind", ["python", "array"])
+def test_reflected_operator(name, oracle, a, b, operand_kind):
+    """`3 - metric` style: the non-metric operand on the LEFT."""
+    if name in ("eq", "ne", "lt", "le", "gt", "ge") and operand_kind == "python":
+        pytest.skip("python resolves scalar-vs-metric comparisons via the metric's own dunder")
+    op = _APPLY[name]
+    lhs = {"python": a, "array": jnp.asarray(a)}[operand_kind]
+    comp = op(lhs, Const(b))
+    assert isinstance(comp, CompositionalMetric)
+    expected = oracle(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(_value(comp), expected, rtol=1e-6)
+
+
+def test_matmul_and_rmatmul():
+    vec = jnp.asarray([1.0, 2.0, 3.0])
+    comp = Const([4.0, 5.0, 6.0]) @ vec
+    np.testing.assert_allclose(_value(comp), 32.0)
+    comp = vec @ Const([4.0, 5.0, 6.0])
+    np.testing.assert_allclose(_value(comp), 32.0)
+    comp = Const([1.0, 0.0]) @ Const([0.0, 1.0])
+    np.testing.assert_allclose(_value(comp), 0.0)
+
+
+@pytest.mark.parametrize(
+    "unary, val, expected",
+    [
+        (abs, -5.0, 5.0),
+        (lambda m: -m, 5.0, -5.0),
+        # the reference's __pos__ is torch.abs (metric.py:693-694), a
+        # deliberate quirk this framework reproduces
+        (lambda m: +m, -5.0, 5.0),
+        # __invert__ is BITWISE not (reference metric.py:684-688)
+        (lambda m: ~m, 6, ~np.int32(6)),
+        (lambda m: ~m, True, False),
+    ],
+    ids=["abs", "neg", "pos-is-abs", "invert-int", "invert-bool"],
+)
+def test_unary_operator(unary, val, expected):
+    comp = unary(Const(val))
+    assert isinstance(comp, CompositionalMetric)
+    np.testing.assert_allclose(_value(comp), np.asarray(expected))
+
+
+def test_getitem_indexing_variants():
+    base = [10.0, 20.0, 30.0, 40.0]
+    np.testing.assert_allclose(_value(Const(base)[1]), 20.0)
+    np.testing.assert_allclose(_value(Const(base)[1:3]), [20.0, 30.0])
+    np.testing.assert_allclose(_value(Const(base)[jnp.asarray([3, 0])]), [40.0, 10.0])
+
+
+def test_chained_expression_matches_plain_math():
+    a, b, c = 2.0, 7.0, 3.0
+    comp = (Const(a) + Const(b)) * Const(c) - Const(b) / Const(a)
+    np.testing.assert_allclose(_value(comp), (a + b) * c - b / a, rtol=1e-6)
+
+
+def test_composition_repr_mentions_op():
+    comp = Const(1.0) + Const(2.0)
+    assert "CompositionalMetric" in repr(comp)

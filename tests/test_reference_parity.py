@@ -187,6 +187,52 @@ def test_classification_error_parity(tm, name, kwargs, mode):
             metric.compute()
 
 
+def test_compositional_operator_parity(tm):
+    """Operator quirks must match the reference exactly: __pos__ is abs,
+    __invert__ is bitwise (not logical) complement, comparisons compose."""
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    class OursConst(M.Metric):
+        def __init__(self, val):
+            super().__init__(jit_update=False)
+            self.add_state("v", default=jnp.asarray(val), dist_reduce_fx="sum")
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return self.v
+
+    class RefConst(tm.Metric):
+        def __init__(self, val):
+            super().__init__()
+            self.add_state("v", default=torch.tensor(val), dist_reduce_fx="sum")
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return self.v
+
+    for build in (
+        lambda m: +m,           # abs, per the reference quirk
+        lambda m: ~m,           # bitwise (not logical) complement
+        lambda m: -m,
+        lambda m: abs(m),
+        lambda m: (m > 2) * 1.0,
+        lambda m: m % 4,
+        lambda m: 10 - m,
+        lambda m: 2 ** abs(m),
+    ):
+        ours, ref = build(OursConst(-6)), build(RefConst(-6))
+        ours.update()
+        ref.update()
+        _cmp(ours.compute(), ref.compute())
+
+
 def test_kl_divergence_parity(tm):
     import metrics_tpu as M
 
