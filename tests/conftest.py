@@ -34,6 +34,24 @@ X32_LANE = os.environ.get("METRICS_TPU_TEST_X32", "") == "1"
 jax.config.update("jax_enable_x64", not X32_LANE)
 
 
+@pytest.fixture(scope="session")
+def tm():
+    """The reference torchmetrics from ``/root/reference``, imported once per
+    session through the bench shims — shared by every ``test_*_parity`` module
+    (each carries its own skipif for an absent checkout)."""
+    import importlib.util
+    import pathlib
+
+    repo_root = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location("_bench_shims", repo_root / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._install_reference_shims()
+    import torchmetrics
+
+    return torchmetrics
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "x64only: test depends on float64 numerics; skipped in the x32 lane"

@@ -31,18 +31,6 @@ pytestmark = pytest.mark.skipif(
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 
-@pytest.fixture(scope="module")
-def tm():
-    """The reference torchmetrics package, imported through the bench shims."""
-    spec = importlib.util.spec_from_file_location("_bench_shims", REPO_ROOT / "bench.py")
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
-    bench._install_reference_shims()
-    import torchmetrics
-
-    return torchmetrics
-
-
 def _cmp(ours_val, ref_val, tol=1e-5):
     import jax
 

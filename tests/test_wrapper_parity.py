@@ -1,0 +1,125 @@
+"""Wrapper-layer behavioral parity against the ACTUAL reference.
+
+MinMaxMetric, MultioutputWrapper, and MetricTracker on identical streams
+(BootStrapper is excluded: its resampling draws from each framework's RNG, so
+cross-framework value equality is not defined). Reference:
+``torchmetrics/wrappers/{minmax,multioutput,tracker}.py``.
+"""
+import pathlib
+
+import numpy as np
+import pytest
+
+REFERENCE = pathlib.Path("/root/reference")
+pytestmark = pytest.mark.skipif(
+    not (REFERENCE / "torchmetrics").is_dir(), reason="reference checkout not present"
+)
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_minmax_tracks_extrema_identically_via_update(tm):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(11)
+    ours = M.MinMaxMetric(M.Accuracy(num_classes=3))
+    ref = tm.MinMaxMetric(tm.Accuracy(num_classes=3))
+    for _ in range(4):
+        p = rng.rand(16, 3).astype(np.float32)
+        t = rng.randint(0, 3, 16)
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.from_numpy(p), torch.from_numpy(t))
+        # epoch boundary: compare the running raw/min/max dicts
+        got, want = ours.compute(), ref.compute()
+        assert set(got) == set(want)
+        for key in want:
+            np.testing.assert_allclose(np.asarray(got[key]), want[key].numpy(), rtol=1e-6, err_msg=key)
+
+
+def test_minmax_forward_documented_divergence(tm):
+    """Reference bug, deliberately not reproduced: its ``MinMaxMetric.reset``
+    resets the base metric, and ``Metric.forward``'s save/reset/restore dance
+    (reference ``metric.py:207-229``) restores only the wrapper's OWN states —
+    so after any ``forward`` the reference's accumulated base state is gone
+    and ``raw`` is batch-local (its own docstring example pins this,
+    ``wrappers/minmax.py:52-60``). Ours keeps ``forward`` side-effect-free:
+    ``raw`` stays cumulative, matching every unwrapped metric's contract."""
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(11)
+    ours = M.MinMaxMetric(M.Accuracy(num_classes=3))
+    ref = tm.MinMaxMetric(tm.Accuracy(num_classes=3))
+    batches = [(rng.rand(16, 3).astype(np.float32), rng.randint(0, 3, 16)) for _ in range(2)]
+    accs = []
+    for p, t in batches:
+        ours(jnp.asarray(p), jnp.asarray(t))
+        ref(torch.from_numpy(p), torch.from_numpy(t))
+        solo = M.Accuracy(num_classes=3)
+        solo.update(jnp.asarray(p), jnp.asarray(t))
+        accs.append(float(solo.compute()))
+    cumulative = M.Accuracy(num_classes=3)
+    for p, t in batches:
+        cumulative.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(np.asarray(ours.compute()["raw"])), float(cumulative.compute()), rtol=1e-6)
+    np.testing.assert_allclose(float(ref.compute()["raw"]), accs[-1], rtol=1e-6)  # the reference lost batch 0
+
+
+def test_multioutput_wraps_per_column_identically(tm):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(12)
+    p = rng.rand(40, 3).astype(np.float64)
+    t = rng.rand(40, 3).astype(np.float64)
+    ours = M.MultioutputWrapper(M.R2Score(), num_outputs=3)
+    ref = tm.MultioutputWrapper(tm.R2Score(), num_outputs=3)
+    for sl in (slice(0, 25), slice(25, 40)):
+        ours.update(jnp.asarray(p[sl]), jnp.asarray(t[sl]))
+        ref.update(torch.from_numpy(p[sl]), torch.from_numpy(t[sl]))
+    got = np.asarray(ours.compute())
+    want = np.stack([v.numpy() for v in ref.compute()]) if isinstance(ref.compute(), list) else ref.compute().numpy()
+    np.testing.assert_allclose(got.reshape(-1), np.asarray(want).reshape(-1), rtol=1e-6)
+
+
+def test_tracker_best_metric_identically(tm):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(13)
+    ours = M.MetricTracker(M.Accuracy(num_classes=3), maximize=True)
+    ref = tm.MetricTracker(tm.Accuracy(num_classes=3), maximize=True)
+    for _ in range(3):
+        ours.increment()
+        ref.increment()
+        for _ in range(2):
+            p = rng.rand(16, 3).astype(np.float32)
+            t = rng.randint(0, 3, 16)
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(torch.from_numpy(p), torch.from_numpy(t))
+    assert ours.n_steps == ref.n_steps == 3
+    got_all, want_all = ours.compute_all(), ref.compute_all()
+    np.testing.assert_allclose(np.asarray(got_all), want_all.numpy(), rtol=1e-6)
+
+    # Reference bug, deliberately not reproduced: ``tracker.py:119-123``
+    # unpacks ``torch.max(values, 0)`` as ``idx, max`` — but torch returns
+    # (values, indices) — so its bare best_metric() hands back the argmax
+    # INDEX and return_step=True returns (value, step), swapped vs its
+    # documented ``Tuple[int, float]``. Ours follows the documented intent:
+    # bare -> the best VALUE; return_step -> (step, value).
+    best_np = np.asarray(want_all.numpy())
+    ref_best = float(ref.best_metric())
+    assert ref_best == float(np.argmax(best_np)), "reference returns the index"
+    np.testing.assert_allclose(float(np.asarray(ours.best_metric())), best_np.max(), rtol=1e-6)
+    ours_step, ours_val = ours.best_metric(return_step=True)
+    np.testing.assert_allclose(float(ours_val), best_np.max(), rtol=1e-6)
+    assert int(ours_step) == int(np.argmax(best_np))
