@@ -27,6 +27,17 @@ def perceptual_evaluation_speech_quality(
     Args:
         fs: sampling frequency, 8000 or 16000 Hz.
         mode: ``"wb"`` (wide-band) or ``"nb"`` (narrow-band).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional import perceptual_evaluation_speech_quality
+        >>> target = jax.random.normal(jax.random.PRNGKey(0), (16000,))
+        >>> preds = target + 0.01 * jax.random.normal(jax.random.PRNGKey(1), (16000,))
+        >>> perceptual_evaluation_speech_quality(preds, target, 16000, 'wb')  # doctest: +SKIP
+        Array(3.97..., dtype=float32)
+
+    (Skipped in CI: requires the optional ``pesq`` wheel, exactly like the
+    reference's gated example.)
     """
     if not _PESQ_AVAILABLE:
         raise ModuleNotFoundError(

@@ -76,6 +76,15 @@ def permutation_invariant_training(
     Returns:
         ``(best_metric [batch], best_perm [batch, spk])`` where
         ``best_perm[b, j]`` is the prediction index matched to target ``j``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import permutation_invariant_training, scale_invariant_signal_noise_ratio
+        >>> preds = jnp.asarray([[[-0.1, 0.2, 0.3], [0.4, -0.5, 0.6]]])
+        >>> target = jnp.asarray([[[0.4, -0.5, 0.6], [-0.1, 0.2, 0.3]]])
+        >>> best, perm = permutation_invariant_training(preds, target, scale_invariant_signal_noise_ratio, 'max')
+        >>> print(perm[0].tolist())
+        [1, 0]
     """
     _check_same_shape(preds, target)
     if eval_func not in ["max", "min"]:
@@ -92,6 +101,15 @@ def permutation_invariant_training(
 
 def pit_permutate(preds: Array, perm: Array) -> Array:
     """Rearrange ``preds`` by the permutation from PIT (reference ``pit.py:210``):
-    output ``[b, j] = preds[b, perm[b, j]]``."""
+    output ``[b, j] = preds[b, perm[b, j]]``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pit_permutate
+        >>> preds = jnp.asarray([[[1.0, 2.0], [3.0, 4.0]]])
+        >>> perm = jnp.asarray([[1, 0]])
+        >>> print(pit_permutate(preds, perm)[0].tolist())
+        [[3.0, 4.0], [1.0, 2.0]]
+    """
     perm_exp = perm.reshape(perm.shape + (1,) * (preds.ndim - 2))
     return jnp.take_along_axis(preds, perm_exp, axis=1)

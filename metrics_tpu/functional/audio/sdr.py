@@ -63,6 +63,15 @@ def signal_distortion_ratio(
         zero_mean: subtract per-signal means first.
         load_diag: Tikhonov loading added to the Toeplitz diagonal for
             stability when references can be (near-)zero.
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional import signal_distortion_ratio
+        >>> rng = jax.random.PRNGKey(0)
+        >>> target = jax.random.normal(rng, (1000,))
+        >>> preds = target + 0.01 * jax.random.normal(jax.random.PRNGKey(1), (1000,))
+        >>> print(float(signal_distortion_ratio(preds, target)) > 30.0)
+        True
+
     """
     _check_same_shape(preds, target)
     preds = jnp.asarray(preds, dtype=jnp.result_type(preds, jnp.float32))
@@ -95,7 +104,16 @@ def signal_distortion_ratio(
 
 
 def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
-    """SI-SDR (Le Roux et al. 2019), shape ``[..., time] -> [...]``."""
+    """SI-SDR (Le Roux et al. 2019), shape ``[..., time] -> [...]``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import scale_invariant_signal_distortion_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> print(round(float(scale_invariant_signal_distortion_ratio(preds, target)), 4))
+        18.403
+    """
     _check_same_shape(preds, target)
     preds = jnp.asarray(preds, dtype=jnp.result_type(preds, jnp.float32))
     target = jnp.asarray(target, dtype=preds.dtype)

@@ -39,5 +39,14 @@ def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> 
 
 
 def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
-    """SI-SNR — SI-SDR with mandatory zero-mean (reference ``snr.py:126``)."""
+    """SI-SNR — SI-SDR with mandatory zero-mean (reference ``snr.py:126``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import scale_invariant_signal_noise_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> print(round(float(scale_invariant_signal_noise_ratio(preds, target)), 4))
+        15.0918
+    """
     return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)

@@ -213,6 +213,16 @@ def short_time_objective_intelligibility(
     (the reference's argument order, ``functional/audio/stoi.py``).
     ``keep_same_device`` is accepted for API parity and ignored — the whole
     computation already runs on the input's device.
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional import short_time_objective_intelligibility
+        >>> rng = jax.random.PRNGKey(1)
+        >>> target = jax.random.normal(rng, (8000,))
+        >>> preds = target + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (8000,))
+        >>> val = short_time_objective_intelligibility(preds, target, 8000)
+        >>> print(float(val) > 0.5)
+        True
+
     """
     _check_same_shape(preds, target)
     preds = jnp.asarray(preds)

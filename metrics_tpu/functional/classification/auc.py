@@ -51,6 +51,13 @@ def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
 
 
 def auc(x: Array, y: Array, reorder: bool = False) -> Array:
-    """Area under any curve via trapezoid (reference ``auc.py:104``)."""
+    """Area under any curve via trapezoid (reference ``auc.py:104``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import auc
+        >>> print(round(float(auc(jnp.asarray([0.0, 1.0, 2.0]), jnp.asarray([0.0, 1.0, 1.0]))), 4))
+        1.5
+    """
     x, y = _auc_update(x, y)
     return _auc_compute(x, y, reorder=reorder)

@@ -99,6 +99,15 @@ def average_precision(
     average: Optional[str] = "macro",
     sample_weights: Optional[Sequence] = None,
 ) -> Union[List[Array], Array]:
-    """Average precision score (reference ``average_precision.py:180``)."""
+    """Average precision score (reference ``average_precision.py:180``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import average_precision
+        >>> preds = jnp.asarray([0.1, 0.8, 0.4, 0.9])
+        >>> target = jnp.asarray([0, 1, 0, 1])
+        >>> print(round(float(average_precision(preds, target)), 4))
+        1.0
+    """
     preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label, average)
     return _average_precision_compute(preds, target, num_classes, pos_label, average, sample_weights)

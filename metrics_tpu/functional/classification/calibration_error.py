@@ -90,7 +90,16 @@ def _ce_update(preds: Array, target: Array) -> Tuple[Array, Array]:
 
 
 def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str = "l1") -> Array:
-    """Top-label calibration error (reference ``calibration_error.py:113``)."""
+    """Top-label calibration error (reference ``calibration_error.py:113``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import calibration_error
+        >>> preds = jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> print(round(float(calibration_error(preds, target, n_bins=3)), 4))
+        0.29
+    """
     if norm not in ("l1", "l2", "max"):
         raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
     if not isinstance(n_bins, int) or n_bins <= 0:

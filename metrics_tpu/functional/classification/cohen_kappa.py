@@ -47,6 +47,15 @@ def cohen_kappa(
     weights: Optional[str] = None,
     threshold: float = 0.5,
 ) -> Array:
-    """Cohen's kappa inter-annotator agreement (reference ``cohen_kappa.py:69``)."""
+    """Cohen's kappa inter-annotator agreement (reference ``cohen_kappa.py:69``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cohen_kappa
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1])
+        >>> print(round(float(cohen_kappa(preds, target, num_classes=3)), 4))
+        0.6875
+    """
     confmat = _cohen_kappa_update(preds, target, num_classes, threshold)
     return _cohen_kappa_compute(confmat, weights)

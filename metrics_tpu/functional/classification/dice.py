@@ -22,7 +22,16 @@ def dice_score(
     no_fg_score: float = 0.0,
     reduction: str = "elementwise_mean",
 ) -> Array:
-    """Dice = 2·TP / (2·TP + FP + FN) per class (reference ``dice.py:61``)."""
+    """Dice = 2·TP / (2·TP + FP + FN) per class (reference ``dice.py:61``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import dice_score
+        >>> preds = jnp.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+        >>> target = jnp.asarray([1, 0, 0])
+        >>> print(round(float(dice_score(preds, target)), 4))
+        0.6667
+    """
     num_classes = preds.shape[1]
     bg_inv = 1 - int(bg)
     if preds.ndim == target.ndim + 1:

@@ -95,7 +95,16 @@ def fbeta_score(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """F-beta score (reference ``f_beta.py:113-246``)."""
+    """F-beta score (reference ``f_beta.py:113-246``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import fbeta_score
+        >>> preds = jnp.asarray([0, 2, 1, 2])
+        >>> target = jnp.asarray([0, 1, 2, 2])
+        >>> print(round(float(fbeta_score(preds, target, num_classes=3, beta=0.5, average='micro')), 4))
+        0.5
+    """
     allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
     if average not in allowed_average:
         raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")

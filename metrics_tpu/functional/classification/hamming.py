@@ -28,6 +28,15 @@ def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array
 
 
 def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
-    """Fraction of wrong labels over all labels (reference ``hamming.py:62``)."""
+    """Fraction of wrong labels over all labels (reference ``hamming.py:62``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hamming_distance
+        >>> preds = jnp.asarray([[0, 1], [1, 1]])
+        >>> target = jnp.asarray([[0, 1], [0, 1]])
+        >>> print(round(float(hamming_distance(preds, target)), 4))
+        0.25
+    """
     correct, total = _hamming_distance_update(preds, target, threshold)
     return _hamming_distance_compute(correct, total)

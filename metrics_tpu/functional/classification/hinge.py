@@ -93,6 +93,15 @@ def hinge_loss(
     squared: bool = False,
     multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
 ) -> Array:
-    """Mean hinge loss (reference ``hinge.py:146``)."""
+    """Mean hinge loss (reference ``hinge.py:146``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hinge_loss
+        >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> print(round(float(hinge_loss(preds, target)), 4))
+        0.3
+    """
     measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
     return _hinge_compute(measure, total)

@@ -48,6 +48,15 @@ def jaccard_index(
     threshold: float = 0.5,
     reduction: str = "elementwise_mean",
 ) -> Array:
-    """Jaccard index |A∩B| / |A∪B| (reference ``jaccard.py:69``)."""
+    """Jaccard index |A∩B| / |A∪B| (reference ``jaccard.py:69``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import jaccard_index
+        >>> preds = jnp.asarray([0, 1, 2, 2])
+        >>> target = jnp.asarray([0, 2, 2, 2])
+        >>> print(round(float(jaccard_index(preds, target, num_classes=3)), 4))
+        0.5556
+    """
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
     return _jaccard_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

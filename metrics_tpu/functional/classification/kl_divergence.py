@@ -44,6 +44,15 @@ def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean
 
 
 def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
-    """D_KL(P||Q) (reference ``kl_divergence.py:81``)."""
+    """D_KL(P||Q) (reference ``kl_divergence.py:81``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import kl_divergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> print(round(float(kl_divergence(p, q)), 4))
+        0.0853
+    """
     measures, total = _kld_update(p, q, log_prob)
     return _kld_compute(measures, jnp.asarray(total), reduction)

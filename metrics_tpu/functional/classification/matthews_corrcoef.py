@@ -36,6 +36,15 @@ def matthews_corrcoef(
     num_classes: int,
     threshold: float = 0.5,
 ) -> Array:
-    """Matthews correlation coefficient (reference ``matthews_corrcoef.py:52``)."""
+    """Matthews correlation coefficient (reference ``matthews_corrcoef.py:52``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import matthews_corrcoef
+        >>> preds = jnp.asarray([0, 1, 1, 1])
+        >>> target = jnp.asarray([0, 1, 0, 1])
+        >>> print(round(float(matthews_corrcoef(preds, target, num_classes=2)), 4))
+        0.5774
+    """
     confmat = _matthews_corrcoef_update(preds, target, num_classes, threshold)
     return _matthews_corrcoef_compute(confmat)

@@ -167,7 +167,17 @@ def precision_recall(
     multiclass: Optional[bool] = None,
 ) -> Tuple[Array, Array]:
     """Both precision and recall from one stat-scores pass
-    (reference ``precision_recall.py:418``)."""
+    (reference ``precision_recall.py:418``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision_recall
+        >>> preds = jnp.asarray([0, 2, 1, 2])
+        >>> target = jnp.asarray([0, 1, 2, 2])
+        >>> prec, rec = precision_recall(preds, target, num_classes=3, average='macro')
+        >>> print(round(float(prec), 4), round(float(rec), 4))
+        0.5 0.5
+    """
     _precision_recall_validate_args(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ("weighted", "none", None) else average
     tp, fp, tn, fn = _stat_scores_update(

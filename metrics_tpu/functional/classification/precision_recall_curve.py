@@ -164,6 +164,16 @@ def precision_recall_curve(
     sample_weights: Optional[Sequence] = None,
 ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
     """Precision-recall pairs at all distinct thresholds
-    (reference ``precision_recall_curve.py:231``)."""
+    (reference ``precision_recall_curve.py:231``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision_recall_curve
+        >>> preds = jnp.asarray([0.1, 0.4, 0.8, 0.9])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> precision, recall, thresholds = precision_recall_curve(preds, target)
+        >>> print(precision.tolist())
+        [1.0, 1.0, 1.0]
+    """
     preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
     return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

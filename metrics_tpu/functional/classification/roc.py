@@ -109,6 +109,16 @@ def roc(
     pos_label: Optional[int] = None,
     sample_weights: Optional[Sequence] = None,
 ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-    """fpr/tpr/thresholds (reference ``roc.py:202``)."""
+    """fpr/tpr/thresholds (reference ``roc.py:202``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import roc
+        >>> preds = jnp.asarray([0.1, 0.4, 0.8, 0.9])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> fpr, tpr, thresholds = roc(preds, target)
+        >>> print(fpr.tolist(), tpr.tolist())
+        [0.0, 0.0, 0.0, 0.5, 1.0] [0.0, 0.5, 1.0, 1.0, 1.0]
+    """
     preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
     return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -49,7 +49,16 @@ def specificity(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """Specificity score (reference ``specificity.py:70-186``)."""
+    """Specificity score (reference ``specificity.py:70-186``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import specificity
+        >>> preds = jnp.asarray([0, 2, 1, 2])
+        >>> target = jnp.asarray([0, 1, 2, 2])
+        >>> print(round(float(specificity(preds, target, num_classes=3, average='macro')), 4))
+        0.7222
+    """
     allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
     if average not in allowed_average:
         raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")

@@ -165,7 +165,16 @@ def stat_scores(
     multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
 ) -> Array:
-    """Compute [tp, fp, tn, fn, support] (reference ``stat_scores.py:240-341``)."""
+    """Compute [tp, fp, tn, fn, support] (reference ``stat_scores.py:240-341``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import stat_scores
+        >>> preds = jnp.asarray([1, 0, 1, 1])
+        >>> target = jnp.asarray([1, 1, 0, 1])
+        >>> print(stat_scores(preds, target, reduce='micro').tolist())
+        [2, 2, 2, 2, 4]
+    """
     if reduce not in ["micro", "macro", "samples"]:
         raise ValueError(f"The `reduce` {reduce} is not valid.")
     if mdmc_reduce not in [None, "samplewise", "global"]:

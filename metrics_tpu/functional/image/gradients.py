@@ -17,7 +17,16 @@ def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
 
 
 def image_gradients(img: Array) -> Tuple[Array, Array]:
-    """Gradients ``(dy, dx)`` of an ``(N, C, H, W)`` image batch."""
+    """Gradients ``(dy, dx)`` of an ``(N, C, H, W)`` image batch.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import image_gradients
+        >>> img = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+        >>> dy, dx = image_gradients(img)
+        >>> print(float(dy[0, 0, 0, 0]), float(dx[0, 0, 0, 0]))
+        4.0 1.0
+    """
     if not isinstance(img, (jax.Array, jnp.ndarray)):
         raise TypeError(f"The `img` expects a value of <Array> type but got {type(img)}")
     if img.ndim != 4:

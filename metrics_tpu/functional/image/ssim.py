@@ -235,7 +235,17 @@ def multiscale_structural_similarity_index_measure(
     betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
     normalize: Optional[str] = None,
 ) -> Array:
-    """MS-SSIM over ``[N, C, H, W]`` images (reference ``ssim.py:363-440``)."""
+    """MS-SSIM over ``[N, C, H, W]`` images (reference ``ssim.py:363-440``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional import multiscale_structural_similarity_index_measure
+        >>> rng = jax.random.PRNGKey(0)
+        >>> preds = jax.random.uniform(rng, (1, 1, 256, 256))
+        >>> target = preds * 0.9 + 0.05
+        >>> print(round(float(multiscale_structural_similarity_index_measure(preds, target, data_range=1.0)), 2))
+        1.0
+    """
     if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):
         raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
     if normalize is not None and normalize not in ("relu", "simple"):

@@ -26,6 +26,15 @@ def pairwise_linear_similarity(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Pairwise dot-product similarity between rows of ``x`` (``[N,d]``) and ``y`` (``[M,d]``)."""
+    """Pairwise dot-product similarity between rows of ``x`` (``[N,d]``) and ``y`` (``[M,d]``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_linear_similarity
+        >>> x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        >>> print(pairwise_linear_similarity(x).round(1))
+        [[ 0. 11.]
+         [11.  0.]]
+    """
     distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

@@ -27,6 +27,15 @@ def pairwise_manhattan_distance(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Pairwise L1 distance between rows of ``x`` (``[N,d]``) and ``y`` (``[M,d]``)."""
+    """Pairwise L1 distance between rows of ``x`` (``[N,d]``) and ``y`` (``[M,d]``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_manhattan_distance
+        >>> x = jnp.asarray([[1.0, 2.0], [3.0, 5.0]])
+        >>> print(pairwise_manhattan_distance(x).round(1))
+        [[0. 5.]
+         [5. 0.]]
+    """
     distance = _pairwise_manhattan_distance_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

@@ -36,6 +36,15 @@ def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[
 
 
 def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
-    """Row-wise cosine similarity between ``(N,d)`` preds and targets."""
+    """Row-wise cosine similarity between ``(N,d)`` preds and targets.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cosine_similarity
+        >>> preds = jnp.asarray([[3.0, 4.0], [1.0, 0.0]])
+        >>> target = jnp.asarray([[3.0, 4.0], [0.0, 1.0]])
+        >>> print(round(float(cosine_similarity(preds, target, reduction='mean')), 4))
+        0.5
+    """
     preds, target = _cosine_similarity_update(preds, target)
     return _cosine_similarity_compute(preds, target, reduction)

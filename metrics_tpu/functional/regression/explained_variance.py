@@ -66,7 +66,16 @@ def explained_variance(
     target: Array,
     multioutput: str = "uniform_average",
 ) -> Union[Array, Sequence[Array]]:
-    """Explained variance (reference ``explained_variance.py:89``)."""
+    """Explained variance (reference ``explained_variance.py:89``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import explained_variance
+        >>> preds = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> target = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> print(round(float(explained_variance(preds, target)), 4))
+        0.9645
+    """
     if multioutput not in _ALLOWED_MULTIOUTPUT:
         raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {_ALLOWED_MULTIOUTPUT}")
     n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)

@@ -25,6 +25,15 @@ def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs: Union[i
 
 
 def mean_squared_log_error(preds: Array, target: Array) -> Array:
-    """Mean squared logarithmic error."""
+    """Mean squared logarithmic error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_log_error
+        >>> preds = jnp.asarray([0.5, 1.0, 2.0])
+        >>> target = jnp.asarray([0.5, 2.0, 2.0])
+        >>> print(round(float(mean_squared_log_error(preds, target)), 4))
+        0.0548
+    """
     sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
     return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

@@ -29,6 +29,15 @@ def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: U
 
 
 def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """Mean absolute percentage error."""
+    """Mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_absolute_percentage_error
+        >>> preds = jnp.asarray([1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([1.0, 4.0, 3.0])
+        >>> print(round(float(mean_absolute_percentage_error(preds, target)), 4))
+        0.1667
+    """
     sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
     return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

@@ -31,6 +31,15 @@ def _symmetric_mean_absolute_percentage_error_compute(
 
 
 def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """Symmetric mean absolute percentage error (``2*|y-ŷ| / (|y|+|ŷ|)`` averaged)."""
+    """Symmetric mean absolute percentage error (``2*|y-ŷ| / (|y|+|ŷ|)`` averaged).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import symmetric_mean_absolute_percentage_error
+        >>> preds = jnp.asarray([1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([1.0, 4.0, 3.0])
+        >>> print(round(float(symmetric_mean_absolute_percentage_error(preds, target)), 4))
+        0.2222
+    """
     sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
     return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

@@ -60,6 +60,15 @@ def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations:
 
 
 def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
-    """Tweedie deviance: power 0=MSE, 1=Poisson, 2=Gamma, else compound."""
+    """Tweedie deviance: power 0=MSE, 1=Poisson, 2=Gamma, else compound.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import tweedie_deviance_score
+        >>> preds = jnp.asarray([2.0, 0.5, 1.0])
+        >>> target = jnp.asarray([1.5, 1.0, 1.0])
+        >>> print(round(float(tweedie_deviance_score(preds, target, power=0.0)), 4))
+        0.1667
+    """
     sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
     return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

@@ -20,7 +20,16 @@ Array = jax.Array
 
 
 def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """1.0 if at least one relevant document is in the top-k, else 0.0."""
+    """1.0 if at least one relevant document is in the top-k, else 0.0.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_hit_rate
+        >>> preds = jnp.asarray([0.9, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 0])
+        >>> print(round(float(retrieval_hit_rate(preds, target, k=2)), 4))
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _validate_k(k)
     n = preds.shape[-1]

@@ -26,7 +26,16 @@ def _dcg(target: Array) -> Array:
 
 
 def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """DCG of the predicted ranking normalized by the ideal ranking's DCG."""
+    """DCG of the predicted ranking normalized by the ideal ranking's DCG.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_normalized_dcg
+        >>> preds = jnp.asarray([0.9, 0.8, 0.4, 0.2])
+        >>> target = jnp.asarray([3, 1, 0, 2])
+        >>> print(round(float(retrieval_normalized_dcg(preds, target)), 4))
+        0.9434
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
     _validate_k(k)
     n = preds.shape[-1]

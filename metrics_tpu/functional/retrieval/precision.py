@@ -21,7 +21,16 @@ Array = jax.Array
 
 
 def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """Fraction of the top-k documents that are relevant."""
+    """Fraction of the top-k documents that are relevant.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_precision
+        >>> preds = jnp.asarray([0.9, 0.8, 0.4])
+        >>> target = jnp.asarray([1, 0, 1])
+        >>> print(round(float(retrieval_precision(preds, target, k=2)), 4))
+        0.5
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _validate_k(k)
     n = preds.shape[-1]

@@ -14,7 +14,16 @@ Array = jax.Array
 
 
 def retrieval_r_precision(preds: Array, target: Array) -> Array:
-    """Fraction of the top-R documents that are relevant, R = total relevant."""
+    """Fraction of the top-R documents that are relevant, R = total relevant.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_r_precision
+        >>> preds = jnp.asarray([0.9, 0.8, 0.4])
+        >>> target = jnp.asarray([1, 0, 1])
+        >>> print(round(float(retrieval_r_precision(preds, target)), 4))
+        0.5
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     st = _sorted_by_scores(preds, target).astype(jnp.float32)
     n_pos = jnp.sum(st)

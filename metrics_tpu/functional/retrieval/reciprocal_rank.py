@@ -12,7 +12,16 @@ Array = jax.Array
 
 
 def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
-    """1 / rank of the first relevant document (0.0 when none)."""
+    """1 / rank of the first relevant document (0.0 when none).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_reciprocal_rank
+        >>> preds = jnp.asarray([0.9, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 0])
+        >>> print(round(float(retrieval_reciprocal_rank(preds, target)), 4))
+        0.5
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     st = _sorted_by_scores(preds, target)
     first_pos = jnp.argmax(st)  # first index of the max: first hit for binary targets

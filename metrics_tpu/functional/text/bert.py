@@ -178,6 +178,18 @@ def bert_score(
 
     Returns:
         dict with per-sentence ``precision``/``recall``/``f1`` lists.
+
+    Example:
+        >>> from metrics_tpu.functional import bert_score
+        >>> preds = ["hello there", "general kenobi"]
+        >>> target = ["hello there", "master kenobi"]
+        >>> bert_score(preds, target, model=my_flax_encoder,
+        ...            user_tokenizer=my_tokenizer)  # doctest: +SKIP
+        {'precision': [1.0, 0.99...], 'recall': [1.0, 0.99...], 'f1': [1.0, 0.99...]}
+
+    (Skipped in CI: needs an encoder — the own-model contract above, or the
+    gated HF default via ``model_name_or_path``; see
+    ``examples/bert_score-own_model.py`` for a runnable end-to-end version.)
     """
     if isinstance(preds, str):
         preds = [preds]
