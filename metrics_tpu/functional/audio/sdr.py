@@ -63,6 +63,7 @@ def signal_distortion_ratio(
         zero_mean: subtract per-signal means first.
         load_diag: Tikhonov loading added to the Toeplitz diagonal for
             stability when references can be (near-)zero.
+
     Example:
         >>> import jax, jax.numpy as jnp
         >>> from metrics_tpu.functional import signal_distortion_ratio

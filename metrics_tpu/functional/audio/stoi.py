@@ -213,6 +213,7 @@ def short_time_objective_intelligibility(
     (the reference's argument order, ``functional/audio/stoi.py``).
     ``keep_same_device`` is accepted for API parity and ignored — the whole
     computation already runs on the input's device.
+
     Example:
         >>> import jax, jax.numpy as jnp
         >>> from metrics_tpu.functional import short_time_objective_intelligibility

@@ -344,6 +344,106 @@ def test_image_parity(tm, name):
     _cmp(got, want, tol=1e-3)
 
 
+@pytest.mark.parametrize("name,kwargs", [
+    ("PeakSignalNoiseRatio", dict(data_range=None)),         # range inferred from data
+    ("PeakSignalNoiseRatio", dict(data_range=1.0, base=2.0)),
+    ("PeakSignalNoiseRatio", dict(data_range=1.0, reduction="sum")),
+    ("StructuralSimilarityIndexMeasure", dict(data_range=1.0, kernel_size=(7, 7))),
+    ("StructuralSimilarityIndexMeasure", dict(data_range=1.0, sigma=(2.0, 2.0))),
+    ("StructuralSimilarityIndexMeasure", dict(data_range=1.0, k1=0.03, k2=0.05)),
+    ("MultiScaleStructuralSimilarityIndexMeasure", dict(data_range=1.0)),
+], ids=["psnr-auto-range", "psnr-base2", "psnr-sum", "ssim-k7", "ssim-sigma2", "ssim-k1k2", "ms-ssim"])
+def test_image_parameter_parity(tm, name, kwargs):
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(zlib.crc32((name + str(kwargs)).encode()) % 2**31)
+    size = 192 if name.startswith("MultiScale") else 32  # MS-SSIM: >160 px for 5 betas at kernel 11
+    batches = []
+    for _ in range(2):
+        t = rng.rand(2, 1, size, size).astype(np.float32)
+        batches.append((np.clip(t + 0.05 * rng.rand(2, 1, size, size).astype(np.float32), 0, 1), t))
+    got, want = _run_pair(getattr(M, name)(**kwargs), getattr(tm, name)(**kwargs), batches)
+    _cmp(got, want, tol=1e-3)
+
+
+def test_image_gradients_parity(tm):
+    import jax.numpy as jnp
+    import torch
+
+    from metrics_tpu.functional import image_gradients
+
+    rng = np.random.RandomState(40)
+    img = rng.rand(2, 3, 8, 8).astype(np.float32)
+    dy, dx = image_gradients(jnp.asarray(img))
+    rdy, rdx = tm.functional.image_gradients(torch.from_numpy(img))
+    _cmp(dy, rdy)
+    _cmp(dx, rdx)
+
+
+def test_pit_parity(tm):
+    """PIT with SI-SNR over 2 and 3 speakers: best metric AND permutation."""
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(41)
+    for spk in (2, 3):
+        t = rng.normal(size=(4, spk, 128)).astype(np.float32)
+        p = (t[:, ::-1] + 0.1 * rng.normal(size=t.shape)).astype(np.float32)
+        got_val, got_perm = M.functional.permutation_invariant_training(
+            jnp.asarray(p), jnp.asarray(t), M.functional.scale_invariant_signal_noise_ratio, "max"
+        )
+        want_val, want_perm = tm.functional.permutation_invariant_training(
+            torch.from_numpy(p), torch.from_numpy(t),
+            tm.functional.scale_invariant_signal_noise_ratio, "max",
+        )
+        _cmp(got_val, want_val, tol=1e-3)
+        _cmp(got_perm, want_perm)
+
+
+@pytest.mark.parametrize("name", [
+    "WordErrorRate", "CharErrorRate", "MatchErrorRate", "WordInfoLost", "WordInfoPreserved",
+])
+def test_wer_family_parity(tm, name):
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**31)
+    preds = [_sent(rng, rng.randint(3, 9)) for _ in range(8)]
+    target = [_sent(rng, rng.randint(3, 9)) for _ in range(8)]
+    # edges: an exact match, an insertion-only superset, an EMPTY hypothesis
+    preds[0] = target[0]
+    preds[1] = target[1] + " extra trailing words"
+    preds[2] = ""
+    got, want = _run_pair(getattr(M, name)(), getattr(tm, name)(), [(preds, target)])
+    _cmp(got, want, tol=1e-6)
+
+
+def test_rouge_parity(tm, monkeypatch):
+    import metrics_tpu as M
+
+    pytest.importorskip("rouge_score")
+    from torchmetrics.text.rouge import ROUGEScore as RefROUGEScore  # gated off tm.__all__
+
+    rng = np.random.RandomState(43)
+    preds = [_sent(rng, rng.randint(5, 12)) for _ in range(4)]
+    target = [_sent(rng, rng.randint(5, 12)) for _ in range(4)]
+    # the reference preprocesses the Lsum variant unconditionally, which needs
+    # nltk punkt data (no egress here); we compare only rouge1/2/L, which
+    # never touch the sentence splitter — stub it on the reference side
+    import torchmetrics.functional.text.rouge as ref_rouge_mod
+
+    monkeypatch.setattr(ref_rouge_mod, "_add_newline_to_end_of_each_sentence", lambda x: x)
+    keys = ("rouge1", "rouge2", "rougeL")
+    ours, ref = M.ROUGEScore(rouge_keys=keys), RefROUGEScore(rouge_keys=keys)
+    ours.update(preds, target)
+    ref.update(preds, target)
+    got, want = ours.compute(), ref.compute()
+    assert set(got) == set(want)
+    for key in want:
+        _cmp(got[key], want[key], tol=1e-5)
+
+
 def test_ter_engine_parity_modulo_reference_arg_swap(tm):
     """The reference's TER swaps hypothesis and reference: its
     ``_compute_sentence_statistics`` calls
