@@ -25,7 +25,9 @@ def _jaccard_from_confmat(
     """Per-class intersection-over-union from a confusion matrix
     (reference ``jaccard.py:24``)."""
     if ignore_index is not None and 0 <= ignore_index < num_classes:
-        confmat = confmat.at[ignore_index].set(0.0)
+        # the confmat carries integer counts — writing the row with a weak int
+        # keeps the dtype (a float literal would be an unsafe scatter cast)
+        confmat = confmat.at[ignore_index].set(0)
 
     intersection = jnp.diag(confmat)
     union = jnp.sum(confmat, axis=0) + jnp.sum(confmat, axis=1) - intersection

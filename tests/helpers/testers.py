@@ -61,7 +61,9 @@ def _assert_allclose(res1: Any, res2: Any, atol: float = 1e-8, key: Optional[str
 
 def _fake_gather_factory(rank_metrics: Sequence[Metric]):
     """Build a ``dist_sync_fn`` that replays each rank's state leaves in
-    registration/traversal order — the single-process stand-in for a real
+    pytree traversal order — the same order ``Metric._sync_dist`` gathers
+    them (``parallel/groups.gather_state_trees`` flattens the state dict, so
+    dict keys traverse SORTED) — the single-process stand-in for a real
     all-gather across processes."""
     per_rank_leaves = []
     for m in rank_metrics:
@@ -69,14 +71,7 @@ def _fake_gather_factory(rank_metrics: Sequence[Metric]):
         for attr in input_dict:
             if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
-        leaves: list = []
-
-        def _collect(x, _leaves=leaves):
-            _leaves.append(x)
-            return x
-
-        apply_to_collection(input_dict, (jax.Array, jnp.ndarray), _collect)
-        per_rank_leaves.append(leaves)
+        per_rank_leaves.append(jax.tree_util.tree_leaves(input_dict))
 
     n_leaves = len(per_rank_leaves[0])
     counter = {"i": 0}

@@ -253,6 +253,68 @@ def test_regression_parity(tm, name):
     _cmp(got, want, tol=1e-4)
 
 
+@pytest.mark.parametrize("name,kwargs,data_kw", [
+    ("AUROC", dict(num_classes=4, average="macro"), {}),
+    ("AUROC", dict(num_classes=4, average="weighted"), {}),
+    ("AUROC", {}, dict(mode="binary_prob")),
+    ("AveragePrecision", dict(num_classes=4, average="macro"), {}),
+    ("AveragePrecision", dict(num_classes=4, average=None), {}),
+    ("AveragePrecision", {}, dict(mode="binary_prob")),
+    ("BinnedPrecisionRecallCurve", dict(num_classes=4, thresholds=11), {}),
+    ("BinnedAveragePrecision", dict(num_classes=4, thresholds=11), {}),
+    ("CalibrationError", dict(n_bins=10, norm="l1"), dict(mode="binary_prob")),
+    ("CalibrationError", dict(n_bins=10, norm="max"), dict(mode="binary_prob")),
+    ("CohenKappa", dict(num_classes=4, weights="linear"), {}),
+    ("CohenKappa", dict(num_classes=4, weights="quadratic"), {}),
+    ("JaccardIndex", dict(num_classes=4, ignore_index=0), {}),
+    ("JaccardIndex", dict(num_classes=4, absent_score=0.5), {}),
+], ids=lambda v: str(v) if isinstance(v, str) else None)
+def test_curve_and_special_parity(tm, name, kwargs, data_kw):
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(zlib.crc32((name + str(kwargs)).encode()) % 2**31)
+    batches = _cls_batches(rng, **data_kw)
+    ours, ref = getattr(M, name)(**kwargs), getattr(tm, name)(**kwargs)
+    got, want = _run_pair(ours, ref, batches)
+    if isinstance(want, (list, tuple)):
+        assert len(got) == len(want), (len(got), len(want))
+        for g, w in zip(got, want):
+            if isinstance(w, (list, tuple)):
+                assert len(g) == len(w), (len(g), len(w))
+                for gg, ww in zip(g, w):
+                    _cmp(gg, ww, tol=1e-4)
+            else:
+                _cmp(g, w, tol=1e-4)
+    else:
+        _cmp(got, want, tol=1e-4)
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("TweedieDevianceScore", dict(power=1.5)),
+    ("TweedieDevianceScore", dict(power=2.0)),
+    ("TweedieDevianceScore", dict(power=3.0)),
+    ("ExplainedVariance", dict(multioutput="raw_values")),
+    ("ExplainedVariance", dict(multioutput="variance_weighted")),
+    ("CosineSimilarity", dict(reduction="none")),
+    ("MeanSquaredError", dict(squared=False)),
+], ids=lambda v: str(v))
+def test_regression_parameter_parity(tm, name, kwargs):
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(zlib.crc32((name + str(kwargs)).encode()) % 2**31)
+    multi = name in ("ExplainedVariance", "CosineSimilarity")
+    shape = (16, 3) if multi else (32,)
+    batches = []
+    for _ in range(3):
+        t = rng.normal(size=shape).astype(np.float32)
+        p = (t + 0.3 * rng.normal(size=shape)).astype(np.float32)
+        if name == "TweedieDevianceScore":  # strictly positive domain
+            p, t = np.abs(p) + 0.1, np.abs(t) + 0.1
+        batches.append((p, t))
+    got, want = _run_pair(getattr(M, name)(**kwargs), getattr(tm, name)(**kwargs), batches)
+    _cmp(got, want, tol=1e-4)
+
+
 _RETR = [("RetrievalMAP", {}), ("RetrievalMRR", {}), ("RetrievalPrecision", dict(k=2)),
          ("RetrievalRecall", dict(k=2)), ("RetrievalHitRate", dict(k=2)),
          ("RetrievalFallOut", dict(k=2)), ("RetrievalNormalizedDCG", {}),
