@@ -1,0 +1,117 @@
+"""Capacity-bounded sample buffers for the exact-curve metrics.
+
+The exact curve family (AUROC/ROC/PrecisionRecallCurve/AveragePrecision) is
+the reference's sample-buffer archetype: unbounded list states, eager
+updates (reference ``classification/auroc.py:152-153``). That design can't
+jit — XLA needs static shapes — which is why the binned variants are the
+TPU-native default here. This module adds the third option SURVEY §7 calls
+for: **exact** results with a **static** memory footprint.
+
+``buffer_capacity=N`` switches the metric's states to fixed arrays —
+``preds [N]`` or ``[N, C]``, ``target [N]``, and a true-sample ``count`` —
+appended via an out-of-bounds-dropping scatter, so ``update`` traces into a
+fixed XLA program and composes with ``jit``/``lax.scan``/``shard_map``
+through the pure state API. ``count`` keeps the TRUE number of samples seen;
+``compute`` raises if it ever exceeded the capacity (results would silently
+drop samples otherwise), so the bound is a contract, not a truncation.
+
+Distributed: the buffers register with ``dist_reduce_fx=None`` (per-rank
+stacking), and collection trims each rank's valid prefix before
+concatenation — no pad/trim protocol needed because the capacity IS the pad.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class _BoundedSampleBufferMixin:
+    """Mixin for curve metrics offering ``buffer_capacity``.
+
+    Host classes call exactly three methods, each branching internally on
+    whether a capacity was set: :meth:`_init_sample_states` from
+    ``__init__`` (after ``super().__init__``), :meth:`_append_samples` from
+    ``update``, and :meth:`_collect_samples` from ``compute`` — so the
+    bounded-vs-list dispatch lives in ONE place.
+    """
+
+    def _init_sample_states(self, capacity: Optional[int], num_classes: Optional[int]) -> None:
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        self.buffer_capacity = capacity
+        if capacity is not None:
+            self._init_bounded_buffers(capacity, num_classes)
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+            rank_zero_warn(
+                f"Metric `{type(self).__name__}` will save all targets and predictions in buffer."
+                " For large datasets this may lead to large memory footprint."
+            )
+
+    def _append_samples(self, preds_rows: Array, target_rows: Array) -> None:
+        if self.buffer_capacity is not None:
+            self._bounded_append(preds_rows, target_rows)
+        else:
+            self.preds.append(preds_rows)
+            self.target.append(target_rows)
+
+    def _collect_samples(self) -> Tuple[Array, Array]:
+        if self.buffer_capacity is not None:
+            return self._bounded_collect()
+        from metrics_tpu.utils.data import dim_zero_cat
+
+        return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+
+    def _init_bounded_buffers(self, capacity: int, num_classes: Optional[int]) -> None:
+        if not isinstance(capacity, int) or capacity <= 0:
+            raise ValueError(f"`buffer_capacity` must be a positive integer, got {capacity!r}.")
+        pred_shape = (capacity,) if not num_classes or num_classes == 1 else (capacity, num_classes)
+        self.add_state("preds", default=jnp.zeros(pred_shape, jnp.float32), dist_reduce_fx=None)
+        self.add_state("target", default=jnp.zeros((capacity,), jnp.int32), dist_reduce_fx=None)
+        self.add_state("count", default=jnp.asarray(0, jnp.int32), dist_reduce_fx=None)
+
+    def _bounded_append(self, preds_rows: Array, target_rows: Array) -> None:
+        """Write normalized sample rows at the current offset; rows beyond
+        the capacity are dropped by the scatter while ``count`` keeps the
+        true total, so overflow is detected at ``compute``."""
+        if preds_rows.ndim != self.preds.ndim or target_rows.ndim != self.target.ndim:
+            raise ValueError(
+                f"`buffer_capacity` mode was configured for "
+                f"{'binary' if self.preds.ndim == 1 else f'{self.preds.shape[1]}-class'} inputs,"
+                f" but update received normalized preds of rank {preds_rows.ndim} and"
+                f" target of rank {target_rows.ndim}."
+                " (Multi-label inputs are not supported with `buffer_capacity`; use the"
+                " Binned* variants for a jittable multi-label curve.)"
+            )
+        n = preds_rows.shape[0]
+        idx = self.count + jnp.arange(n)
+        self.preds = self.preds.at[idx].set(preds_rows.astype(self.preds.dtype), mode="drop")
+        self.target = self.target.at[idx].set(target_rows.astype(self.target.dtype), mode="drop")
+        self.count = self.count + n
+
+    def _bounded_collect(self) -> Tuple[Array, Array]:
+        """Valid samples, post- or pre-sync.
+
+        Pre-sync the states hold one rank's buffers; after the host-level
+        sync (``dist_reduce_fx=None`` stacks) they hold ``[world, ...]`` —
+        distinguished by ``count``'s rank. Runs eagerly (compute of the
+        exact curves is host-side by design), so trimming by the dynamic
+        count is fine.
+        """
+        # post-sync (dist_reduce_fx=None) the scalar count stacks to
+        # [world, 1] and the buffers to [world, capacity, ...]
+        counts = jnp.ravel(jnp.asarray(self.count))
+        if int(jnp.max(counts)) > self.buffer_capacity:
+            raise ValueError(
+                f"buffer_capacity exceeded: a rank saw {int(jnp.max(counts))} samples"
+                f" but the buffer holds {self.buffer_capacity}. Raise `buffer_capacity`"
+                " (results would otherwise silently drop samples)."
+            )
+        if self.count.ndim == 0:
+            return self.preds[: int(self.count)], self.target[: int(self.count)]
+        parts_p = [self.preds[r, : int(c)] for r, c in enumerate(counts)]
+        parts_t = [self.target[r, : int(c)] for r, c in enumerate(counts)]
+        return jnp.concatenate(parts_p, axis=0), jnp.concatenate(parts_t, axis=0)

@@ -9,14 +9,13 @@ from typing import Any, Optional
 import jax
 
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.classification._bounded import _BoundedSampleBufferMixin
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
-from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
 
-class AUROC(Metric):
+class AUROC(_BoundedSampleBufferMixin, Metric):
     """Area under the ROC curve (reference ``classification/auroc.py:30``).
 
     Args:
@@ -26,6 +25,13 @@ class AUROC(Metric):
             ``none`` reduction over per-class areas.
         max_fpr: restrict the area to the [0, max_fpr] range (binary only,
             McClish standardization).
+
+    Args:
+        buffer_capacity: fix the sample buffers to this many samples,
+            making ``update`` jittable with static memory (exact results,
+            checked overflow). Requires ``num_classes`` up front for
+            multiclass; multi-label is unsupported in this mode. ``None``
+            (default) keeps the reference's unbounded eager lists.
 
     Example:
         >>> import jax.numpy as jnp
@@ -47,6 +53,7 @@ class AUROC(Metric):
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
         max_fpr: Optional[float] = None,
+        buffer_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -64,18 +71,11 @@ class AUROC(Metric):
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
         self.mode = None
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
-
-        rank_zero_warn(
-            "Metric `AUROC` will save all targets and predictions in buffer."
-            " For large datasets this may lead to large memory footprint."
-        )
+        self._init_sample_states(buffer_capacity, num_classes)
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, mode = _auroc_update(preds, target)
-        self.preds.append(preds)
-        self.target.append(target)
+        self._append_samples(preds, target)
         if self.mode and self.mode != mode:
             raise ValueError(
                 "The mode of data (binary, multi-label, multi-class) should be constant, but changed"
@@ -86,8 +86,7 @@ class AUROC(Metric):
     def compute(self) -> Array:
         if not self.mode:
             raise RuntimeError("You have to have determined mode.")
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds, target = self._collect_samples()
         return _auroc_compute(
             preds, target, self.mode, self.num_classes, self.pos_label, self.average, self.max_fpr
         )

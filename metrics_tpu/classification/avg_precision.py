@@ -11,15 +11,24 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
+from metrics_tpu.classification._bounded import _BoundedSampleBufferMixin
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
-from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
 
-class AveragePrecision(Metric):
+class AveragePrecision(_BoundedSampleBufferMixin, Metric):
     """Average precision score (reference ``classification/avg_precision.py:25``).
+
+    Args:
+        buffer_capacity: fix the sample buffers to this many entries,
+            making ``update`` jittable with static memory (exact results,
+            checked overflow). Requires ``num_classes`` up front for
+            multiclass; multi-label is unsupported in this mode. With
+            ``average="micro"`` equal-rank inputs are flattened before
+            buffering, so the capacity is counted in flattened ELEMENTS
+            (``n_samples * n_labels``), not samples. ``None`` (default)
+            keeps the reference's unbounded eager lists.
 
     Example:
         >>> import jax.numpy as jnp
@@ -40,6 +49,7 @@ class AveragePrecision(Metric):
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
+        buffer_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -49,27 +59,21 @@ class AveragePrecision(Metric):
         if average not in allowed_average:
             raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
         self.average = average
+        self.buffer_capacity = buffer_capacity
 
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
-
-        rank_zero_warn(
-            "Metric `AveragePrecision` will save all targets and predictions in buffer."
-            " For large datasets this may lead to large memory footprint."
-        )
+        # micro flattens equal-rank inputs to 1-D before buffering
+        self._init_sample_states(buffer_capacity, None if average == "micro" else num_classes)
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _average_precision_update(
             preds, target, self.num_classes, self.pos_label, self.average
         )
-        self.preds.append(preds)
-        self.target.append(target)
+        self._append_samples(preds, target)
         self.num_classes = num_classes
         self.pos_label = pos_label
 
     def compute(self) -> Union[List[Array], Array]:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds, target = self._collect_samples()
         if not self.num_classes:
             raise ValueError(f"`num_classes` bas to be positive number, but got {self.num_classes}")
         average = None if self.average == "none" else self.average

@@ -1,26 +1,36 @@
 """PrecisionRecallCurve module metric.
 
 Parity: reference ``torchmetrics/classification/precision_recall_curve.py:27``
-(sample-buffer archetype).
+(sample-buffer archetype). ``buffer_capacity`` adds the capacity-bounded
+jittable variant (see ``classification/_bounded.py``) — an extension the
+reference does not have.
 """
 from typing import Any, List, Optional, Tuple, Union
 
 import jax
 
+from metrics_tpu.classification._bounded import _BoundedSampleBufferMixin
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
 )
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
-from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
 
-class PrecisionRecallCurve(Metric):
+class PrecisionRecallCurve(_BoundedSampleBufferMixin, Metric):
     """Precision-recall pairs at all distinct thresholds
     (reference ``classification/precision_recall_curve.py:27``).
+
+    Args:
+        num_classes: class count for multiclass score inputs.
+        pos_label: positive-class label for binary inputs.
+        buffer_capacity: fix the sample buffers to this many samples, making
+            ``update`` jittable with static memory (exact results, checked
+            overflow). Requires ``num_classes`` up front for multiclass;
+            multi-label is unsupported in this mode. ``None`` (default)
+            keeps the reference's unbounded eager lists.
 
     Example:
         >>> import jax.numpy as jnp
@@ -41,32 +51,24 @@ class PrecisionRecallCurve(Metric):
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        buffer_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
-
-        rank_zero_warn(
-            "Metric `PrecisionRecallCurve` will save all targets and predictions in buffer."
-            " For large datasets this may lead to large memory footprint."
-        )
+        self._init_sample_states(buffer_capacity, num_classes)
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _precision_recall_curve_update(
             preds, target, self.num_classes, self.pos_label
         )
-        self.preds.append(preds)
-        self.target.append(target)
+        self._append_samples(preds, target)
         self.num_classes = num_classes
         self.pos_label = pos_label
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds, target = self._collect_samples()
         if not self.num_classes:
             raise ValueError(f"`num_classes` bas to be positive number, but got {self.num_classes}")
         return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)

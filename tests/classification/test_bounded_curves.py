@@ -1,0 +1,140 @@
+"""Capacity-bounded exact-curve buffers (`buffer_capacity=...`).
+
+The third buffering option SURVEY §7 calls for, alongside unbounded eager
+lists (reference parity) and the binned approximations: exact results with
+static shapes, so update jits/scans. Every path is checked against the
+unbounded eager metric on the same data — results must be EXACT (same
+samples, same compute kernel), not approximately equal.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import AUROC, ROC, AveragePrecision, PrecisionRecallCurve
+
+_CLASSES = [AUROC, ROC, AveragePrecision, PrecisionRecallCurve]
+_IDS = ["auroc", "roc", "ap", "prc"]
+
+
+def _tree_assert_close(got, want, atol=1e-7):
+    if isinstance(want, (list, tuple)):
+        assert isinstance(got, (list, tuple)) and len(got) == len(want)
+        for g, w in zip(got, want):
+            _tree_assert_close(g, w, atol)
+    else:
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=atol)
+
+
+@pytest.mark.parametrize("metric_class", _CLASSES, ids=_IDS)
+def test_bounded_equals_unbounded_binary(metric_class):
+    rng = np.random.RandomState(0)
+    p, t = rng.rand(60).astype(np.float32), rng.randint(0, 2, 60)
+    bounded, plain = metric_class(buffer_capacity=64), metric_class()
+    for sl in (slice(0, 25), slice(25, 60)):
+        bounded.update(jnp.asarray(p[sl]), jnp.asarray(t[sl]))
+        plain.update(jnp.asarray(p[sl]), jnp.asarray(t[sl]))
+    _tree_assert_close(bounded.compute(), plain.compute())
+
+
+@pytest.mark.parametrize("metric_class", _CLASSES, ids=_IDS)
+def test_bounded_equals_unbounded_multiclass(metric_class):
+    rng = np.random.RandomState(1)
+    P = rng.rand(40, 3).astype(np.float32)
+    P /= P.sum(-1, keepdims=True)
+    T = rng.randint(0, 3, 40)
+    bounded = metric_class(num_classes=3, buffer_capacity=64)
+    plain = metric_class(num_classes=3)
+    for sl in (slice(0, 15), slice(15, 40)):
+        bounded.update(jnp.asarray(P[sl]), jnp.asarray(T[sl]))
+        plain.update(jnp.asarray(P[sl]), jnp.asarray(T[sl]))
+    _tree_assert_close(bounded.compute(), plain.compute())
+
+
+@pytest.mark.parametrize("metric_class", _CLASSES, ids=_IDS)
+def test_bounded_update_jits_and_scans(metric_class):
+    """The whole point: the pure state transition compiles into a fixed XLA
+    program and runs under lax.scan."""
+    rng = np.random.RandomState(2)
+    P = rng.rand(6, 8, 3).astype(np.float32)
+    P /= P.sum(-1, keepdims=True)
+    T = rng.randint(0, 3, (6, 8))
+    m = metric_class(num_classes=3, buffer_capacity=64)
+
+    def body(state, batch):
+        return m.update_state(state, batch[0], batch[1]), None
+
+    state, _ = jax.jit(lambda b: jax.lax.scan(body, m.init_state(), b))((jnp.asarray(P), jnp.asarray(T)))
+    assert int(state["count"]) == 48
+
+    ref = metric_class(num_classes=3)
+    for i in range(6):
+        ref.update(jnp.asarray(P[i]), jnp.asarray(T[i]))
+    _tree_assert_close(m.compute_state(state), ref.compute(), atol=1e-6)
+
+
+@pytest.mark.parametrize("metric_class", _CLASSES, ids=_IDS)
+def test_bounded_overflow_raises(metric_class):
+    rng = np.random.RandomState(3)
+    m = metric_class(buffer_capacity=8)
+    m.update(jnp.asarray(rng.rand(30).astype(np.float32)), jnp.asarray(rng.randint(0, 2, 30)))
+    with pytest.raises(ValueError, match="buffer_capacity exceeded"):
+        m.compute()
+
+
+def test_bounded_distributed_equals_serial():
+    """Host-level sync: dist_reduce_fx=None stacks per-rank buffers; compute
+    trims each rank's valid prefix — with UNEVEN per-rank counts."""
+    rng = np.random.RandomState(4)
+    p, t = rng.rand(50).astype(np.float32), rng.randint(0, 2, 50)
+    rank0, rank1 = AUROC(buffer_capacity=64), AUROC(buffer_capacity=64)
+    rank0.update(jnp.asarray(p[:18]), jnp.asarray(t[:18]))
+    rank1.update(jnp.asarray(p[18:]), jnp.asarray(t[18:]))
+
+    from tests.helpers.testers import _fake_gather_factory
+
+    rank0.dist_sync_fn = _fake_gather_factory([rank0, rank1])
+    rank0._distributed_available_fn = lambda: True
+    synced = rank0.compute()
+
+    serial = AUROC()
+    serial.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(np.asarray(synced), np.asarray(serial.compute()), atol=1e-7)
+    # unsync restored the local rank's buffers
+    assert int(rank0.count) == 18
+
+
+def test_bounded_reset_and_reuse():
+    m = PrecisionRecallCurve(buffer_capacity=16)
+    rng = np.random.RandomState(5)
+    m.update(jnp.asarray(rng.rand(10).astype(np.float32)), jnp.asarray(rng.randint(0, 2, 10)))
+    m.reset()
+    assert int(m.count) == 0
+    p, t = rng.rand(12).astype(np.float32), rng.randint(0, 2, 12)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    plain = PrecisionRecallCurve()
+    plain.update(jnp.asarray(p), jnp.asarray(t))
+    _tree_assert_close(m.compute(), plain.compute())
+
+
+def test_bounded_rejects_multilabel_and_bad_capacity():
+    with pytest.raises(ValueError, match="positive integer"):
+        AUROC(buffer_capacity=0)
+    m = AUROC(num_classes=None, buffer_capacity=16)
+    with pytest.raises(ValueError, match="Multi-label"):
+        m.update(jnp.asarray(np.random.rand(4, 3).astype(np.float32)), jnp.asarray(np.random.randint(0, 2, (4, 3))))
+
+
+def test_bounded_persistence_round_trip():
+    # num_classes pinned at construction: state_dict carries array states
+    # only (the dynamic-attr JSON sidecar is the orbax helpers' job)
+    m = AveragePrecision(num_classes=1, buffer_capacity=16)
+    rng = np.random.RandomState(6)
+    p, t = rng.rand(9).astype(np.float32), rng.randint(0, 2, 9)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    m.persistent(True)
+    sd = m.state_dict()
+    m2 = AveragePrecision(num_classes=1, buffer_capacity=16)
+    m2.persistent(True)
+    m2.load_state_dict(sd)
+    _tree_assert_close(m2.compute(), m.compute())
