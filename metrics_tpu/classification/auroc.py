@@ -9,7 +9,7 @@ from typing import Any, Optional
 import jax
 
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
-from metrics_tpu.classification._bounded import _BoundedSampleBufferMixin
+from metrics_tpu.utils.bounded import _BoundedSampleBufferMixin
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -25,8 +25,6 @@ class AUROC(_BoundedSampleBufferMixin, Metric):
             ``none`` reduction over per-class areas.
         max_fpr: restrict the area to the [0, max_fpr] range (binary only,
             McClish standardization).
-
-    Args:
         buffer_capacity: fix the sample buffers to this many samples,
             making ``update`` jittable with static memory (exact results,
             checked overflow). Requires ``num_classes`` up front for
@@ -41,6 +39,11 @@ class AUROC(_BoundedSampleBufferMixin, Metric):
         >>> print(round(float(auroc.compute()), 4))
         0.75
     """
+
+    _bounded_rank_hint = (
+        " (Multi-label inputs are not supported with `buffer_capacity`; use the"
+        " Binned* variants for a jittable multi-label curve.)"
+    )
 
     is_differentiable = False
     higher_is_better = True

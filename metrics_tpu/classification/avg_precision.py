@@ -11,7 +11,7 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
-from metrics_tpu.classification._bounded import _BoundedSampleBufferMixin
+from metrics_tpu.utils.bounded import _BoundedSampleBufferMixin
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -39,6 +39,11 @@ class AveragePrecision(_BoundedSampleBufferMixin, Metric):
         1.0
     """
 
+    _bounded_rank_hint = (
+        " (Multi-label inputs are not supported with `buffer_capacity`; use the"
+        " Binned* variants for a jittable multi-label curve.)"
+    )
+
     is_differentiable = False
     higher_is_better = True
 
@@ -59,8 +64,6 @@ class AveragePrecision(_BoundedSampleBufferMixin, Metric):
         if average not in allowed_average:
             raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
         self.average = average
-        self.buffer_capacity = buffer_capacity
-
         # micro flattens equal-rank inputs to 1-D before buffering
         self._init_sample_states(buffer_capacity, None if average == "micro" else num_classes)
 

@@ -2,14 +2,14 @@
 
 Parity: reference ``torchmetrics/classification/precision_recall_curve.py:27``
 (sample-buffer archetype). ``buffer_capacity`` adds the capacity-bounded
-jittable variant (see ``classification/_bounded.py``) — an extension the
+jittable variant (see ``utils/bounded.py``) — an extension the
 reference does not have.
 """
 from typing import Any, List, Optional, Tuple, Union
 
 import jax
 
-from metrics_tpu.classification._bounded import _BoundedSampleBufferMixin
+from metrics_tpu.utils.bounded import _BoundedSampleBufferMixin
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
@@ -41,6 +41,11 @@ class PrecisionRecallCurve(_BoundedSampleBufferMixin, Metric):
         >>> print([round(float(v), 2) for v in precision], [round(float(v), 2) for v in recall])
         [1.0, 1.0, 1.0] [1.0, 0.5, 0.0]
     """
+
+    _bounded_rank_hint = (
+        " (Multi-label inputs are not supported with `buffer_capacity`; use the"
+        " Binned* variants for a jittable multi-label curve.)"
+    )
 
     is_differentiable = False
     higher_is_better = None

@@ -56,6 +56,7 @@ _JIT_FALLBACK_ERRORS = (
     jax.errors.TracerArrayConversionError,
     jax.errors.TracerBoolConversionError,
     jax.errors.TracerIntegerConversionError,
+    jax.errors.NonConcreteBooleanIndexError,  # data-dependent masking (e.g. ignore_index filters)
     JitIncompatibleError,
     NotImplementedError,
     TypeError,

@@ -1,18 +1,23 @@
 """SpearmanCorrCoef module metric (parity: reference ``torchmetrics/regression/spearman.py:24``)."""
-from typing import Any
+from typing import Any, Optional
 
 import jax
 
 from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.bounded import _BoundedSampleBufferMixin
 
 Array = jax.Array
 
 
-class SpearmanCorrCoef(Metric):
+class SpearmanCorrCoef(_BoundedSampleBufferMixin, Metric):
     """Spearman rank correlation; buffers the full stream (rank transform is global).
+
+    Args:
+        buffer_capacity: fix the sample buffers to this many samples, making
+            ``update`` jittable with static memory (exact results, checked
+            overflow). ``None`` (default) keeps the reference's unbounded
+            eager lists.
 
     Example:
         >>> import jax.numpy as jnp
@@ -25,21 +30,17 @@ class SpearmanCorrCoef(Metric):
     is_differentiable = False
     higher_is_better = True
 
-    def __init__(self, **kwargs: Any) -> None:
+    def __init__(self, buffer_capacity: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        rank_zero_warn(
-            "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
-            " For large datasets, this may lead to large memory footprint."
+        self._init_sample_states(
+            buffer_capacity,
+            specs=(("preds", None, None), ("target", None, None)),  # lane-default float
         )
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target = _spearman_corrcoef_update(preds, target)
-        self.preds.append(preds)
-        self.target.append(target)
+        self._append_samples(preds, target)
 
     def compute(self) -> Array:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds, target = self._collect_samples()
         return _spearman_corrcoef_compute(preds, target)

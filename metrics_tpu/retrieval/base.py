@@ -15,13 +15,13 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.retrieval._ranking import GroupedRanking, _group_by_query, _segment_sum
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.bounded import _BoundedSampleBufferMixin
 from metrics_tpu.utils.checks import _check_retrieval_inputs
-from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
 
-class RetrievalMetric(Metric, ABC):
+class RetrievalMetric(_BoundedSampleBufferMixin, Metric, ABC):
     """Base for metrics computed per query then averaged over queries.
 
     ``update`` accepts ``(preds, target, indexes)`` where ``indexes`` maps each
@@ -33,6 +33,11 @@ class RetrievalMetric(Metric, ABC):
             negative for fall-out) contributes: ``'neg'``→0.0, ``'pos'``→1.0,
             ``'skip'``→excluded from the mean, ``'error'``→raise.
         ignore_index: drop elements whose target equals this value.
+        buffer_capacity: fix the three sample buffers to this many rows,
+            making ``update`` jittable with static memory (exact results,
+            checked overflow). Rows removed by ``ignore_index`` don't count
+            toward the capacity. ``None`` (default) keeps the reference's
+            unbounded eager lists.
     """
 
     higher_is_better = True
@@ -41,6 +46,7 @@ class RetrievalMetric(Metric, ABC):
         self,
         empty_target_action: str = "neg",
         ignore_index: Optional[int] = None,
+        buffer_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -55,9 +61,13 @@ class RetrievalMetric(Metric, ABC):
             raise ValueError("Argument `ignore_index` must be an integer or None.")
         self.ignore_index = ignore_index
 
-        self.add_state("indexes", default=[], dist_reduce_fx="cat")
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self._init_sample_states(
+            buffer_capacity,
+            # lane-default float for graded NDCG targets; int targets cast
+            # losslessly into float rows
+            specs=(("indexes", None, jnp.int32), ("preds", None, None), ("target", None, None)),
+            warn=False,  # the reference's retrieval base does not warn
+        )
 
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         if indexes is None:
@@ -65,9 +75,7 @@ class RetrievalMetric(Metric, ABC):
         indexes, preds, target = _check_retrieval_inputs(
             indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target, ignore_index=self.ignore_index
         )
-        self.indexes.append(indexes)
-        self.preds.append(preds)
-        self.target.append(target)
+        self._append_samples(indexes, preds, target)
 
     def _empty_query_mask(self, g: GroupedRanking) -> Array:
         """[Q] True where the query has no positive target (fall-out overrides)."""
@@ -77,9 +85,7 @@ class RetrievalMetric(Metric, ABC):
         return "`compute` method was provided with a query with no positive target."
 
     def compute(self) -> Array:
-        indexes = dim_zero_cat(self.indexes).reshape(-1)
-        preds = dim_zero_cat(self.preds).reshape(-1)
-        target = dim_zero_cat(self.target).reshape(-1)
+        indexes, preds, target = (x.reshape(-1) for x in self._collect_samples())
 
         g = _group_by_query(preds, target, indexes)
         values = self._metric_grouped(preds, target, indexes, g)

@@ -1,0 +1,146 @@
+"""Capacity-bounded sample buffers for list-state (sample-buffer) metrics.
+
+The reference's sample-buffer archetype (exact curves, Spearman, retrieval —
+e.g. ``classification/auroc.py:152-153``, ``retrieval/base.py:107-109``)
+keeps unbounded list states with eager appends. That design can't jit — XLA
+needs static shapes. This mixin adds the third option SURVEY §7 calls for,
+alongside eager lists (reference parity) and binned approximations:
+**exact** results with a **static** memory footprint.
+
+``buffer_capacity=N`` switches the metric's list states to fixed arrays
+(one ``[N]`` or ``[N, width]`` buffer per declared column, plus a
+true-sample ``count``), appended via an out-of-bounds-dropping scatter, so
+``update`` traces into a fixed XLA program and composes with
+``jit``/``lax.scan``/``shard_map`` through the pure state API. ``count``
+keeps the TRUE number of rows seen; collection raises if it ever exceeded
+the capacity (results would silently drop samples otherwise) — the bound is
+a checked contract, not a truncation.
+
+Distributed: bounded buffers register with ``dist_reduce_fx=None`` (per-rank
+stacking), and collection trims each rank's valid prefix before
+concatenation — no pad/trim protocol needed because the capacity IS the pad.
+"""
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# (state name, row width (None/1 -> 1-D buffer), dtype)
+BufferSpec = Tuple[str, Optional[int], Any]
+
+
+class _BoundedSampleBufferMixin:
+    """Mixin for sample-buffer metrics offering ``buffer_capacity``.
+
+    Host classes call exactly three methods, each branching internally on
+    whether a capacity was set: :meth:`_init_sample_states` from
+    ``__init__`` (after ``super().__init__``), :meth:`_append_samples` from
+    ``update``, and :meth:`_collect_samples` from ``compute`` — so the
+    bounded-vs-list dispatch lives in ONE place.
+    """
+
+    def _init_sample_states(
+        self,
+        capacity: Optional[int],
+        num_classes: Optional[int] = None,
+        specs: Optional[Sequence[BufferSpec]] = None,
+        warn: bool = True,
+    ) -> None:
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        if specs is None:  # the curve-metric default: scores + integer labels
+            specs = (("preds", num_classes, None), ("target", None, jnp.int32))
+        self._buffer_specs = tuple(specs)
+        self.buffer_capacity = capacity
+        if capacity is not None:
+            self._init_bounded_buffers(capacity, self._buffer_specs)
+        else:
+            for name, _, _ in self._buffer_specs:
+                self.add_state(name, default=[], dist_reduce_fx="cat")
+            if warn:  # the reference warns for curves/Spearman but not retrieval
+                rank_zero_warn(
+                    f"Metric `{type(self).__name__}` will save all targets and predictions in buffer."
+                    " For large datasets this may lead to large memory footprint."
+                )
+
+    def _append_samples(self, *rows: Array) -> None:
+        if self.buffer_capacity is not None:
+            self._bounded_append(*rows)
+        else:
+            for (name, _, _), value in zip(self._buffer_specs, rows):
+                getattr(self, name).append(value)
+
+    def _collect_samples(self) -> Tuple[Array, ...]:
+        if self.buffer_capacity is not None:
+            return self._bounded_collect()
+        from metrics_tpu.utils.data import dim_zero_cat
+
+        return tuple(dim_zero_cat(getattr(self, name)) for name, _, _ in self._buffer_specs)
+
+    # -- bounded internals ----------------------------------------------
+    def _init_bounded_buffers(self, capacity: int, specs: Sequence[BufferSpec]) -> None:
+        if not isinstance(capacity, int) or capacity <= 0:
+            raise ValueError(f"`buffer_capacity` must be a positive integer, got {capacity!r}.")
+        for name, width, dtype in specs:
+            shape = (capacity,) if not width or width == 1 else (capacity, width)
+            if dtype is None:
+                # the lane's default float (f64 under jax_enable_x64, else
+                # f32) — a hardcoded f32 would silently downgrade the f64
+                # lane relative to the unbounded lists
+                dtype = jnp.asarray(0.0).dtype
+            self.add_state(name, default=jnp.zeros(shape, dtype), dist_reduce_fx=None)
+        self.add_state("count", default=jnp.asarray(0, jnp.int32), dist_reduce_fx=None)
+
+    # host classes may extend the rank-mismatch error with a metric-specific
+    # pointer (the curve family points at its Binned* alternatives)
+    _bounded_rank_hint: str = ""
+
+    def _bounded_append(self, *rows: Array) -> None:
+        """Write normalized rows at the current offset; rows beyond the
+        capacity are dropped by the scatter while ``count`` keeps the true
+        total, so overflow is detected at collection."""
+        # single-sample updates squeeze to 0-d in some normalizers — promote,
+        # mirroring dim_zero_cat's handling on the unbounded list path
+        rows = tuple(jnp.atleast_1d(value) for value in rows)
+        for (name, _, _), value in zip(self._buffer_specs, rows):
+            buf = getattr(self, name)
+            if value.ndim != buf.ndim:
+                raise ValueError(
+                    f"`buffer_capacity` mode registered state `{name}` with rank {buf.ndim}"
+                    f" rows, but update produced rank-{value.ndim} rows."
+                    + self._bounded_rank_hint
+                )
+        n = rows[0].shape[0]
+        idx = self.count + jnp.arange(n)
+        for (name, _, _), value in zip(self._buffer_specs, rows):
+            buf = getattr(self, name)
+            setattr(self, name, buf.at[idx].set(value.astype(buf.dtype), mode="drop"))
+        self.count = self.count + n
+
+    def _bounded_collect(self) -> Tuple[Array, ...]:
+        """Valid rows per buffer, post- or pre-sync.
+
+        Pre-sync the states hold one rank's buffers; after the host-level
+        sync (``dist_reduce_fx=None`` stacks) they hold ``[world, ...]`` —
+        distinguished by ``count``'s rank. Runs eagerly (collection feeds
+        host-side compute kernels), so trimming by the dynamic count is fine.
+        """
+        # post-sync (dist_reduce_fx=None) the scalar count stacks to
+        # [world, 1] and the buffers to [world, capacity, ...]
+        counts = jnp.ravel(jnp.asarray(self.count))
+        if int(jnp.max(counts)) > self.buffer_capacity:
+            raise ValueError(
+                f"buffer_capacity exceeded: a rank saw {int(jnp.max(counts))} samples"
+                f" but the buffer holds {self.buffer_capacity}. Raise `buffer_capacity`"
+                " (results would otherwise silently drop samples)."
+            )
+        out = []
+        for name, _, _ in self._buffer_specs:
+            buf = getattr(self, name)
+            if self.count.ndim == 0:
+                out.append(buf[: int(self.count)])
+            else:
+                out.append(jnp.concatenate([buf[r, : int(c)] for r, c in enumerate(counts)], axis=0))
+        return tuple(out)

@@ -1,0 +1,131 @@
+"""Capacity-bounded buffers beyond the curve family: Spearman and retrieval.
+
+Complements ``tests/classification/test_bounded_curves.py`` — the same
+``buffer_capacity`` contract (exact vs the unbounded metric, jit/scan
+composition, checked overflow, distributed trim) on the other sample-buffer
+archetypes: ``SpearmanCorrCoef`` (two float buffers) and the grouped
+retrieval base (three buffers including integer query ids).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import RetrievalMAP, RetrievalNormalizedDCG, RetrievalPrecision, SpearmanCorrCoef
+
+
+def test_spearman_bounded_equals_unbounded():
+    rng = np.random.RandomState(0)
+    p, t = rng.normal(size=70), rng.normal(size=70)
+    bounded, plain = SpearmanCorrCoef(buffer_capacity=128), SpearmanCorrCoef()
+    for sl in (slice(0, 30), slice(30, 70)):
+        bounded.update(jnp.asarray(p[sl]), jnp.asarray(t[sl]))
+        plain.update(jnp.asarray(p[sl]), jnp.asarray(t[sl]))
+    np.testing.assert_allclose(np.asarray(bounded.compute()), np.asarray(plain.compute()), atol=1e-7)
+
+
+def test_spearman_bounded_accepts_single_sample_batches():
+    # size-1 batches squeeze to 0-d in the normalizer — the bounded append
+    # must promote like dim_zero_cat does on the list path
+    bounded, plain = SpearmanCorrCoef(buffer_capacity=16), SpearmanCorrCoef()
+    for v, w in ((0.5, 1.0), (0.2, 0.1), (0.9, 0.7), (0.1, 0.4)):
+        bounded.update(jnp.asarray([v]), jnp.asarray([w]))
+        plain.update(jnp.asarray([v]), jnp.asarray([w]))
+    np.testing.assert_allclose(np.asarray(bounded.compute()), np.asarray(plain.compute()), atol=1e-7)
+
+
+def test_spearman_bounded_scans():
+    rng = np.random.RandomState(1)
+    P, T = rng.normal(size=(5, 12)), rng.normal(size=(5, 12))
+    m = SpearmanCorrCoef(buffer_capacity=64)
+
+    def body(state, batch):
+        return m.update_state(state, batch[0], batch[1]), None
+
+    state, _ = jax.jit(lambda b: jax.lax.scan(body, m.init_state(), b))((jnp.asarray(P), jnp.asarray(T)))
+    assert int(state["count"]) == 60
+    plain = SpearmanCorrCoef()
+    plain.update(jnp.asarray(P.reshape(-1)), jnp.asarray(T.reshape(-1)))
+    np.testing.assert_allclose(
+        np.asarray(m.compute_state(state)), np.asarray(plain.compute()), atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("metric_class, kwargs", [
+    (RetrievalMAP, {}),
+    (RetrievalPrecision, dict(k=2)),
+    (RetrievalNormalizedDCG, {}),
+], ids=["map", "precision@2", "ndcg"])
+def test_retrieval_bounded_equals_unbounded(metric_class, kwargs):
+    rng = np.random.RandomState(2)
+    n = 60
+    idx = np.sort(rng.randint(0, 5, n))
+    p = rng.rand(n).astype(np.float32)
+    graded = metric_class is RetrievalNormalizedDCG
+    t = rng.randint(0, 4 if graded else 2, n)
+    bounded = metric_class(buffer_capacity=128, **kwargs)
+    plain = metric_class(**kwargs)
+    for sl in (slice(0, 25), slice(25, n)):
+        bounded.update(jnp.asarray(p[sl]), jnp.asarray(t[sl]), jnp.asarray(idx[sl]))
+        plain.update(jnp.asarray(p[sl]), jnp.asarray(t[sl]), jnp.asarray(idx[sl]))
+    np.testing.assert_allclose(np.asarray(bounded.compute()), np.asarray(plain.compute()), atol=1e-6)
+
+
+def test_retrieval_bounded_update_jits():
+    rng = np.random.RandomState(3)
+    m = RetrievalMAP(buffer_capacity=64)
+    P = rng.rand(4, 10).astype(np.float32)
+    T = rng.randint(0, 2, (4, 10))
+    IDX = rng.randint(0, 3, (4, 10))
+
+    def body(state, batch):
+        return m.update_state(state, batch[0], batch[1], batch[2]), None
+
+    state, _ = jax.jit(lambda b: jax.lax.scan(body, m.init_state(), b))(
+        (jnp.asarray(P), jnp.asarray(T), jnp.asarray(IDX))
+    )
+    assert int(state["count"]) == 40
+    plain = RetrievalMAP()
+    plain.update(jnp.asarray(P.reshape(-1)), jnp.asarray(T.reshape(-1)), jnp.asarray(IDX.reshape(-1)))
+    np.testing.assert_allclose(
+        np.asarray(m.compute_state(state)), np.asarray(plain.compute()), atol=1e-6
+    )
+
+
+def test_retrieval_bounded_overflow_and_distributed():
+    rng = np.random.RandomState(4)
+    m = RetrievalMAP(buffer_capacity=8)
+    m.update(jnp.asarray(rng.rand(20)), jnp.asarray(rng.randint(0, 2, 20)), jnp.asarray(np.zeros(20, np.int64)))
+    with pytest.raises(ValueError, match="buffer_capacity exceeded"):
+        m.compute()
+
+    # uneven two-rank sync through the stacked-buffer trim path
+    p, t = rng.rand(40).astype(np.float32), rng.randint(0, 2, 40)
+    idx = np.sort(rng.randint(0, 4, 40))
+    r0, r1 = RetrievalMAP(buffer_capacity=64), RetrievalMAP(buffer_capacity=64)
+    r0.update(jnp.asarray(p[:15]), jnp.asarray(t[:15]), jnp.asarray(idx[:15]))
+    r1.update(jnp.asarray(p[15:]), jnp.asarray(t[15:]), jnp.asarray(idx[15:]))
+
+    from tests.helpers.testers import _fake_gather_factory
+
+    r0.dist_sync_fn = _fake_gather_factory([r0, r1])
+    r0._distributed_available_fn = lambda: True
+    synced = r0.compute()
+    serial = RetrievalMAP()
+    serial.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+    np.testing.assert_allclose(np.asarray(synced), np.asarray(serial.compute()), atol=1e-7)
+
+
+def test_retrieval_bounded_ignore_index_stays_eager_but_exact():
+    # ignore_index filters rows (dynamic shape) — the auto-jit falls back to
+    # eager, and filtered rows must NOT consume capacity
+    rng = np.random.RandomState(5)
+    p = rng.rand(30).astype(np.float32)
+    t = rng.randint(0, 2, 30)
+    t[::3] = -100
+    idx = np.zeros(30, np.int64)
+    bounded = RetrievalMAP(buffer_capacity=20, ignore_index=-100)  # < 30 raw rows, >= kept rows
+    plain = RetrievalMAP(ignore_index=-100)
+    bounded.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+    plain.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+    np.testing.assert_allclose(np.asarray(bounded.compute()), np.asarray(plain.compute()), atol=1e-7)
