@@ -9,7 +9,7 @@ from typing import Any, Optional
 import jax
 
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
-from metrics_tpu.utils.bounded import _BoundedSampleBufferMixin
+from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -40,10 +40,7 @@ class AUROC(_BoundedSampleBufferMixin, Metric):
         0.75
     """
 
-    _bounded_rank_hint = (
-        " (Multi-label inputs are not supported with `buffer_capacity`; use the"
-        " Binned* variants for a jittable multi-label curve.)"
-    )
+    _bounded_rank_hint = CURVE_MULTILABEL_HINT
 
     is_differentiable = False
     higher_is_better = True

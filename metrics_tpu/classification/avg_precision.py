@@ -11,7 +11,7 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
-from metrics_tpu.utils.bounded import _BoundedSampleBufferMixin
+from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -39,10 +39,7 @@ class AveragePrecision(_BoundedSampleBufferMixin, Metric):
         1.0
     """
 
-    _bounded_rank_hint = (
-        " (Multi-label inputs are not supported with `buffer_capacity`; use the"
-        " Binned* variants for a jittable multi-label curve.)"
-    )
+    _bounded_rank_hint = CURVE_MULTILABEL_HINT
 
     is_differentiable = False
     higher_is_better = True

@@ -9,7 +9,7 @@ from typing import Any, List, Optional, Tuple, Union
 
 import jax
 
-from metrics_tpu.utils.bounded import _BoundedSampleBufferMixin
+from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
@@ -42,10 +42,7 @@ class PrecisionRecallCurve(_BoundedSampleBufferMixin, Metric):
         [1.0, 1.0, 1.0] [1.0, 0.5, 0.0]
     """
 
-    _bounded_rank_hint = (
-        " (Multi-label inputs are not supported with `buffer_capacity`; use the"
-        " Binned* variants for a jittable multi-label curve.)"
-    )
+    _bounded_rank_hint = CURVE_MULTILABEL_HINT
 
     is_differentiable = False
     higher_is_better = None

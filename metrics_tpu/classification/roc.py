@@ -8,7 +8,7 @@ from typing import Any, List, Optional, Tuple, Union
 import jax
 
 from metrics_tpu.functional.classification.roc import _roc_compute, _roc_update
-from metrics_tpu.utils.bounded import _BoundedSampleBufferMixin
+from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -36,10 +36,7 @@ class ROC(_BoundedSampleBufferMixin, Metric):
         [0.0, 0.5, 0.5, 1.0, 1.0]
     """
 
-    _bounded_rank_hint = (
-        " (Multi-label inputs are not supported with `buffer_capacity`; use the"
-        " Binned* variants for a jittable multi-label curve.)"
-    )
+    _bounded_rank_hint = CURVE_MULTILABEL_HINT
 
     is_differentiable = False
     higher_is_better = None

@@ -35,6 +35,11 @@ class SpearmanCorrCoef(_BoundedSampleBufferMixin, Metric):
         self._init_sample_states(
             buffer_capacity,
             specs=(("preds", None, None), ("target", None, None)),  # lane-default float
+            # the reference's exact warning text, 'SpearmanCorrcoef' spelling included
+            warn_message=(
+                "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
+                " For large datasets, this may lead to large memory footprint."
+            ),
         )
 
     def update(self, preds: Array, target: Array) -> None:

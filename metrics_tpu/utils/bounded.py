@@ -30,6 +30,13 @@ Array = jax.Array
 # (state name, row width (None/1 -> 1-D buffer), dtype)
 BufferSpec = Tuple[str, Optional[int], Any]
 
+# the curve family's pointer appended to rank-mismatch errors (shared by the
+# four host classes so the wording can't drift)
+CURVE_MULTILABEL_HINT = (
+    " (Multi-label inputs are not supported with `buffer_capacity`; use the"
+    " Binned* variants for a jittable multi-label curve.)"
+)
+
 
 class _BoundedSampleBufferMixin:
     """Mixin for sample-buffer metrics offering ``buffer_capacity``.
@@ -47,6 +54,7 @@ class _BoundedSampleBufferMixin:
         num_classes: Optional[int] = None,
         specs: Optional[Sequence[BufferSpec]] = None,
         warn: bool = True,
+        warn_message: Optional[str] = None,
     ) -> None:
         from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -61,7 +69,8 @@ class _BoundedSampleBufferMixin:
                 self.add_state(name, default=[], dist_reduce_fx="cat")
             if warn:  # the reference warns for curves/Spearman but not retrieval
                 rank_zero_warn(
-                    f"Metric `{type(self).__name__}` will save all targets and predictions in buffer."
+                    warn_message
+                    or f"Metric `{type(self).__name__}` will save all targets and predictions in buffer."
                     " For large datasets this may lead to large memory footprint."
                 )
 

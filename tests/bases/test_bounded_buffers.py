@@ -116,6 +116,43 @@ def test_retrieval_bounded_overflow_and_distributed():
     np.testing.assert_allclose(np.asarray(synced), np.asarray(serial.compute()), atol=1e-7)
 
 
+def test_bounded_in_trace_sync_equals_serial():
+    """Regime 1: bounded buffers inside shard_map — sync_state all-gathers
+    the per-device buffers and counts; compute_state trims each device's
+    valid prefix. 8 virtual devices, uneven per-device fill."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import AUROC
+
+    devices = np.array(jax.devices()[:8])
+    if devices.size < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = Mesh(devices, ("dp",))
+    rng = np.random.RandomState(6)
+    p = rng.rand(8, 12).astype(np.float32)
+    t = rng.randint(0, 2, (8, 12))
+
+    m = AUROC(buffer_capacity=16)
+
+    def shard_fn(pp, tt):
+        state = m.update_state(m.init_state(), pp[0], tt[0])
+        state = m.sync_state(state, axis_name="dp")
+        return state
+
+    kw = dict(mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+    try:
+        fn = jax.shard_map(shard_fn, check_vma=False, **kw)
+    except TypeError:
+        fn = jax.shard_map(shard_fn, check_rep=False, **kw)
+    state = jax.jit(fn)(jnp.asarray(p), jnp.asarray(t))
+
+    serial = AUROC()
+    serial.update(jnp.asarray(p.reshape(-1)), jnp.asarray(t.reshape(-1)))
+    np.testing.assert_allclose(
+        np.asarray(m.compute_state(state)), np.asarray(serial.compute()), atol=1e-6
+    )
+
+
 def test_retrieval_bounded_ignore_index_stays_eager_but_exact():
     # ignore_index filters rows (dynamic shape) — the auto-jit falls back to
     # eager, and filtered rows must NOT consume capacity

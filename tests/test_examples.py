@@ -1,0 +1,40 @@
+"""Execute every script in examples/ — examples are tested code.
+
+The reference ships ``tm_examples/`` without CI coverage; here each example
+runs as a subprocess (so its ``__main__`` path, imports, and prints are the
+real user experience) and must exit 0.
+"""
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
+REPO_ROOT = EXAMPLES.parent
+
+
+# some environments pre-import jax pointed at an accelerator before
+# JAX_PLATFORMS is consulted — force CPU through jax.config, the only
+# override that reliably wins (see tests/conftest.py)
+_RUNNER = (
+    "import jax; jax.config.update('jax_platforms', 'cpu');"
+    "import runpy, sys; runpy.run_path(sys.argv[1], run_name='__main__')"
+)
+
+
+@pytest.mark.parametrize(
+    "script", sorted(EXAMPLES.glob("*.py")), ids=lambda p: p.name
+)
+def test_example_runs(script):
+    out = subprocess.run(
+        [sys.executable, "-c", _RUNNER, str(script)],
+        cwd=REPO_ROOT,
+        env=dict(os.environ),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, f"{script.name} failed:\n{out.stdout[-1500:]}\n{out.stderr[-1500:]}"
+    assert out.stdout.strip(), f"{script.name} printed nothing"
