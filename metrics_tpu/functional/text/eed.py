@@ -46,15 +46,16 @@ def _eed_function(
             nxt = np.array([row[0] + 1.0])
         # propagate deletions left-to-right in one accumulate pass
         nxt = np.minimum.accumulate(nxt - idx_scaled) + idx_scaled
+        best = nxt.min()
         # first-minimum with a tolerance: the accumulate's (x - i*del) + i*del
         # round-trip adds ~1e-16 noise that would break the EXACT ties the
         # sequential formulation produces, visiting a different cell and
         # shifting the coverage penalty (distinct EED costs are O(0.1) apart,
         # so the tolerance can't conflate genuinely different cells)
-        visits[int(np.argmax(nxt <= nxt.min() + 1e-9))] += 1
+        visits[int(np.argmax(nxt <= best + 1e-9))] += 1
         # long jump: from the best cell anywhere, at word boundaries
         if ref_char == " ":
-            nxt = np.minimum(nxt, alpha + nxt.min())
+            nxt = np.minimum(nxt, alpha + best)
         row = nxt
 
     coverage = rho * float(np.where(visits >= 0, visits, 1).sum())

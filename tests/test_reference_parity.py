@@ -8,13 +8,19 @@ tests, because the reference's own quirks (e.g. binary inputs counting both
 classes under micro reduction) are compared exactly. Skipped wholesale when
 the reference checkout is absent.
 
-130+ comparisons across classification (every ``average`` x every input
-archetype — binary/multilabel/multiclass/mdmc, probs and labels, ``top_k``
+210+ comparisons: the full classification input-archetype matrix (every
+``average`` x binary/multilabel/multiclass/mdmc, probs and labels, ``top_k``
 1-3, ``samples``, subset accuracy, thresholds, ``ignore_index``,
 ``multiclass=False``, stat-scores reductions, confusion-matrix
-normalizations, kappa/MCC/hamming/jaccard/AUROC/AP/ECE/KL), regression (10),
-retrieval (8), text (9), audio (4) and image (2) — plus error-parity cases
-asserting both frameworks reject the same invalid configurations.
+normalizations, kappa weights, jaccard options, hinge modes, calibration
+norms, KL log-prob forms, curve averaging and the Binned* family),
+regression parameter sweeps, all 8 retrieval metrics, text (BLEU variants,
+chrF parameters, the WER family with empty-hypothesis edges, EED, ROUGE,
+SQuAD edges), audio (SNR family + PIT values and permutations), image
+(PSNR/SSIM/MS-SSIM parameter sweeps, per-image dim, image_gradients),
+detection mAP, aggregation NaN policies, wrappers, and compositional
+operators — plus error-parity cases asserting both frameworks reject the
+same invalid configurations.
 """
 import importlib.util
 import pathlib
@@ -360,6 +366,28 @@ def test_text_rate_parity(tm, name):
     _cmp(ours.compute(), ref.compute())
 
 
+@pytest.mark.parametrize("kwargs", [
+    dict(n_char_order=4, n_word_order=0),
+    dict(n_char_order=6, n_word_order=2, beta=3.0),
+    dict(lowercase=True),
+    dict(whitespace=True),
+    dict(return_sentence_level_score=True),
+], ids=["char4-word0", "beta3", "lowercase", "whitespace", "sentence-level"])
+def test_chrf_parameter_parity(tm, kwargs):
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(zlib.crc32(str(kwargs).encode()) % 2**31)
+    preds = [_sent(rng, rng.randint(4, 10)).capitalize() for _ in range(5)]
+    refs = [[_sent(rng, rng.randint(4, 10))] for _ in range(5)]
+    got, want = _run_pair(M.CHRFScore(**kwargs), tm.CHRFScore(**kwargs), [(preds, refs)])
+    if isinstance(want, tuple):
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            _cmp(g, w, tol=1e-5)
+    else:
+        _cmp(got, want, tol=1e-5)
+
+
 @pytest.mark.parametrize("name", ["BLEUScore", "SacreBLEUScore", "CHRFScore"])
 def test_text_corpus_parity(tm, name):
     import metrics_tpu as M
@@ -628,6 +656,78 @@ def test_binned_curves_parity(tm):
         batches,
     )
     _cmp(got, want, tol=1e-5)
+
+
+def test_binned_recall_at_fixed_precision_parity(tm):
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(12)
+    batches = [(rng.rand(48, 3).astype(np.float32), rng.randint(0, 2, (48, 3))) for _ in range(2)]
+    for min_precision in (0.3, 0.6):
+        got, want = _run_pair(
+            M.BinnedRecallAtFixedPrecision(num_classes=3, thresholds=31, min_precision=min_precision),
+            tm.BinnedRecallAtFixedPrecision(num_classes=3, thresholds=31, min_precision=min_precision),
+            batches,
+        )
+        # (recall [C], thresholds [C])
+        for g, w in zip(got, want):
+            _cmp(g, w, tol=1e-5)
+
+
+def test_hinge_variants_parity(tm):
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(13)
+    # binary squared
+    p = (rng.rand(24).astype(np.float32) * 4 - 2)
+    t = rng.randint(0, 2, 24)
+    got, want = _run_pair(M.HingeLoss(squared=True), tm.HingeLoss(squared=True), [(p, t)])
+    _cmp(got, want)
+    # multiclass crammer-singer (default) and one-vs-all
+    P = rng.rand(24, 3).astype(np.float32) * 4 - 2
+    T = rng.randint(0, 3, 24)
+    for mode in ("crammer-singer", "one-vs-all"):
+        for squared in (False, True):
+            got, want = _run_pair(
+                M.HingeLoss(multiclass_mode=mode, squared=squared),
+                tm.HingeLoss(multiclass_mode=mode, squared=squared),
+                [(P, T)],
+            )
+            _cmp(got, want, tol=1e-5)
+
+
+def test_kl_divergence_log_prob_parity(tm):
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(14)
+    a = rng.rand(16, 4).astype(np.float32)
+    b = rng.rand(16, 4).astype(np.float32)
+    a, b = a / a.sum(1, keepdims=True), b / b.sum(1, keepdims=True)
+    for log_prob, reduction in ((True, "mean"), (False, "sum"), (False, None)):
+        pa, pb = (np.log(a), np.log(b)) if log_prob else (a, b)
+        got, want = _run_pair(
+            M.KLDivergence(log_prob=log_prob, reduction=reduction),
+            tm.KLDivergence(log_prob=log_prob, reduction=reduction),
+            [(pa, pb)],
+        )
+        _cmp(got, want, tol=1e-5)
+
+
+def test_psnr_dim_parity(tm):
+    """dim= switches PSNR to per-image list states in both frameworks."""
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(15)
+    batches = []
+    for _ in range(2):
+        t = rng.rand(4, 3, 16, 16).astype(np.float32)
+        batches.append((np.clip(t + 0.1 * rng.rand(4, 3, 16, 16).astype(np.float32), 0, 1), t))
+    got, want = _run_pair(
+        M.PeakSignalNoiseRatio(data_range=1.0, dim=(1, 2, 3)),
+        tm.PeakSignalNoiseRatio(data_range=1.0, dim=(1, 2, 3)),
+        batches,
+    )
+    _cmp(got, want, tol=1e-4)
 
 
 @pytest.mark.parametrize("name", ["MeanMetric", "SumMetric", "MaxMetric", "MinMetric", "CatMetric"])
