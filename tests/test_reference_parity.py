@@ -645,6 +645,118 @@ def test_detection_map_parity(tm):
         _cmp(got[key], want[key], tol=1e-4)
 
 
+def _det_samples(rng, n_images=6, fmt="xyxy"):
+    """Shared synthetic detections; optionally re-encoded per box format."""
+    def enc(b):
+        if fmt == "xyxy":
+            return b.astype(np.float32)
+        w, h = b[:, 2] - b[:, 0], b[:, 3] - b[:, 1]
+        if fmt == "xywh":
+            return np.stack([b[:, 0], b[:, 1], w, h], 1).astype(np.float32)
+        return np.stack([b[:, 0] + w / 2, b[:, 1] + h / 2, w, h], 1).astype(np.float32)
+
+    out = []
+    for _ in range(n_images):
+        n_gt = rng.randint(2, 7)  # >1 box/image: exercises the reference's conversion gate
+        xy = rng.rand(n_gt, 2) * 200
+        wh = rng.rand(n_gt, 2) * 60 + 5
+        g_xyxy = np.concatenate([xy, xy + wh], 1)
+        d_xyxy = g_xyxy + rng.randn(n_gt, 4) * 4
+        out.append((enc(g_xyxy), enc(d_xyxy), rng.randint(0, 3, n_gt), rng.rand(n_gt).astype(np.float32)))
+    return out
+
+
+def _det_feed(metric, samples, to_arr):
+    for g, d, gl, ds in samples:
+        metric.update(
+            [dict(boxes=to_arr(d), scores=to_arr(ds), labels=to_arr(gl))],
+            [dict(boxes=to_arr(g), labels=to_arr(gl))],
+        )
+    return metric.compute()
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(iou_thresholds=[0.3, 0.55, 0.8]),
+    dict(max_detection_thresholds=[2, 5, 100]),
+    dict(class_metrics=True),
+], ids=["custom-ious", "custom-maxdet", "per-class"])
+def test_detection_map_parameter_parity(tm, kwargs):
+    """mAP options both frameworks support: custom IoU grids (map_50/map_75
+    become the -1 sentinel in both when 0.5/0.75 are absent), custom
+    max-detection caps containing 100, per-class results."""
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    from torchmetrics.detection.map import MeanAveragePrecision as RefMAP
+
+    rng = np.random.RandomState(zlib.crc32(str(kwargs).encode()) % 2**31)
+    samples = _det_samples(rng)
+    got = _det_feed(M.MeanAveragePrecision(**kwargs), samples, jnp.asarray)
+    want = _det_feed(RefMAP(**kwargs), samples, torch.from_numpy)
+    keys = [k for k in want if np.asarray(want[k]).ndim == 0]
+    assert keys
+    for key in keys:
+        _cmp(got[key], want[key], tol=1e-4)
+    if kwargs.get("class_metrics"):
+        for key in ("map_per_class", "mar_100_per_class"):
+            _cmp(got[key], want[key], tol=1e-4)
+
+
+@pytest.mark.parametrize("fmt", ["xywh", "cxcywh"])
+def test_detection_map_box_format_documented_divergence(tm, fmt):
+    """Reference bug, deliberately not reproduced: it converts non-xyxy boxes
+    only when an image holds EXACTLY one box
+    (``detection/map.py:323-326`` — ``if item["boxes"].size() == Size([1, 4])``),
+    so multi-box images evaluate raw xywh/cxcywh coordinates as xyxy and mAP
+    collapses. Ours converts always; its result is anchored to the
+    reference's own xyxy run on identical geometry."""
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    from torchmetrics.detection.map import MeanAveragePrecision as RefMAP
+
+    rng = np.random.RandomState(17)
+    state = rng.get_state()
+    samples_fmt = _det_samples(rng, fmt=fmt)
+    rng.set_state(state)
+    samples_xyxy = _det_samples(rng, fmt="xyxy")
+
+    got = _det_feed(M.MeanAveragePrecision(box_format=fmt), samples_fmt, jnp.asarray)
+    anchor = _det_feed(RefMAP(), samples_xyxy, torch.from_numpy)  # same geometry, xyxy
+    for key in ("map", "map_50", "mar_10", "mar_100"):
+        _cmp(got[key], anchor[key], tol=1e-4)
+    # pin the reference's collapse so this documentation notices if it heals
+    broken = _det_feed(RefMAP(box_format=fmt), samples_fmt, torch.from_numpy)
+    assert float(broken["map"]) < 0.5 * float(anchor["map"])
+
+
+def test_detection_map_maxdet_without_100_documented_divergence(tm):
+    """Reference bug, deliberately not reproduced: its ``map`` summarization
+    hard-requires 100 among ``max_detection_thresholds`` and returns the -1
+    sentinel otherwise. Ours evaluates at the largest provided cap; all other
+    scalars agree between the two."""
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    from torchmetrics.detection.map import MeanAveragePrecision as RefMAP
+
+    rng = np.random.RandomState(18)
+    samples = _det_samples(rng)
+    kwargs = dict(max_detection_thresholds=[2, 5, 50])
+    got = _det_feed(M.MeanAveragePrecision(**kwargs), samples, jnp.asarray)
+    want = _det_feed(RefMAP(**kwargs), samples, torch.from_numpy)
+    assert float(want["map"]) == -1.0  # the reference's sentinel
+    assert float(got["map"]) > 0.0
+    for key in [k for k in want if np.asarray(want[k]).ndim == 0 and k != "map"]:
+        _cmp(got[key], want[key], tol=1e-4)
+
+
 def test_binned_curves_parity(tm):
     import metrics_tpu as M
 
