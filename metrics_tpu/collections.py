@@ -16,7 +16,7 @@ reference's per-member eager dispatch.
 """
 from collections import OrderedDict
 from copy import deepcopy
-from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, Hashable, Iterable, Iterator, Optional, Sequence, Tuple, Union
 
 import jax
 

@@ -5,7 +5,7 @@ Parity: reference ``torchmetrics/functional/classification/calibration_error.py`
 reference's per-bin Python loop is replaced by a vectorized
 searchsorted + segment-sum binning that jits and maps onto the TPU VPU.
 """
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

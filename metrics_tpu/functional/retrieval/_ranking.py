@@ -7,7 +7,7 @@ lexicographic sort (query asc, score desc) plus segment reductions — every
 retrieval metric becomes a handful of ``segment_sum`` calls over the flat
 stream, vectorized across all queries at once (SURVEY §7 stage 6).
 """
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp

@@ -5,7 +5,7 @@ N-gram counting runs on host (inputs are Python strings); the accumulated
 streaming accumulation and cross-device sync stay in the jittable path.
 """
 from collections import Counter
-from typing import Callable, List, Optional, Sequence, Tuple, Union
+from typing import Callable, List, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp

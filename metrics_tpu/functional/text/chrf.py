@@ -8,8 +8,8 @@ reference keeps a ``Dict[int, Tensor]`` of scalars per order
 state, one collective on sync.
 """
 import string
-from collections import Counter, defaultdict
-from typing import Dict, List, Optional, Sequence, Tuple, Union
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp

@@ -6,8 +6,7 @@ BLEU with the canonical sacrebleu tokenizers (``none``/``13a``/``zh``/``intl``/
 unicode-property regexes and is gated on the optional ``regex`` package.
 """
 import re
-from functools import partial
-from typing import Optional, Sequence, Union
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

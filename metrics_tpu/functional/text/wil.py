@@ -1,5 +1,5 @@
 """Word information lost (parity: reference ``torchmetrics/functional/text/wil.py``)."""
-from typing import List, Tuple, Union
+from typing import List, Union
 
 import jax
 

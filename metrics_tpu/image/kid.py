@@ -16,7 +16,6 @@ import numpy as np
 from metrics_tpu.image.fid import _resolve_feature_extractor, _validate_features
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
-from metrics_tpu.utils.exceptions import MetricsUserError
 
 Array = jax.Array
 

@@ -1,6 +1,5 @@
 """Shared helpers for the inference networks (layout, pooling, weights IO)."""
 import os
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

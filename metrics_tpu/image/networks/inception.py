@@ -32,7 +32,7 @@ to ``(x - 128) / 128``.
 """
 import os
 from functools import partial
-from typing import Any, Callable, Dict, Iterable, Mapping, Sequence, Tuple, Union
+from typing import Any, Dict, Mapping, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp

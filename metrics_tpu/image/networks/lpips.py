@@ -20,7 +20,7 @@ dict + lpips lin-head state dict) — no construction-time downloads.
 """
 import os
 from functools import partial
-from typing import Any, Dict, List, Sequence, Tuple, Union
+from typing import Any, Dict, List, Tuple, Union
 
 import jax
 import jax.numpy as jnp

@@ -3,7 +3,6 @@
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
-import jax.numpy as jnp
 
 from metrics_tpu.functional.image.ssim import (
     _multiscale_ssim_compute,

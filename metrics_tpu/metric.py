@@ -45,7 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.parallel import comm
-from metrics_tpu.utils.data import _squeeze_if_scalar, apply_to_collection, dim_zero_cat
+from metrics_tpu.utils.data import _squeeze_if_scalar, dim_zero_cat
 from metrics_tpu.utils.exceptions import JitIncompatibleError, MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 

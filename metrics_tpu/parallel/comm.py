@@ -18,7 +18,6 @@ the sync dispatch in ``metric.py:231-256``). Two regimes:
 A single process with a single device is the graceful no-op fallback, mirroring
 ``jit_distributed_available`` (reference ``metric.py:41-42``).
 """
-import functools
 from typing import Any, Callable, List, Optional, Sequence, Union
 
 import jax

@@ -1,5 +1,5 @@
 """CHRFScore module metric (parity: reference ``torchmetrics/text/chrf.py:46``)."""
-from typing import Any, Optional, Sequence, Tuple, Union
+from typing import Any, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp

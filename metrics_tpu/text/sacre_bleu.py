@@ -1,5 +1,5 @@
 """SacreBLEUScore module metric (parity: reference ``torchmetrics/text/sacre_bleu.py:32``)."""
-from typing import Any, Sequence
+from typing import Any
 
 from metrics_tpu.functional.text.sacre_bleu import _SacreBLEUTokenizer
 from metrics_tpu.text.bleu import BLEUScore

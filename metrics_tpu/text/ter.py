@@ -1,5 +1,5 @@
 """TranslationEditRate module metric (parity: reference ``torchmetrics/text/ter.py:24``)."""
-from typing import Any, Optional, Sequence, Tuple, Union
+from typing import Any, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp

@@ -5,7 +5,6 @@ gates features on wheels like ``transformers``, ``torch-fidelity``, ``pesq``;
 our equivalents gate on what is baked into the TPU image.
 """
 import importlib.util
-from typing import Optional
 
 
 def _package_available(name: str) -> bool:

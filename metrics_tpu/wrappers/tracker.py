@@ -6,7 +6,7 @@ Parity target: reference ``torchmetrics/wrappers/tracker.py:23``
 (no module system to subclass); each ``increment()`` appends a fresh clone of
 the base metric and subsequent update/compute calls route to it.
 """
-from typing import Any, Dict, List, Tuple, Union
+from typing import Any, Dict, List, Union
 
 import jax
 import jax.numpy as jnp
