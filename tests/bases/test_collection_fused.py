@@ -287,3 +287,27 @@ def test_pairwise_forced_pallas_path(monkeypatch):
     monkeypatch.setenv("METRICS_TPU_FORCE_PALLAS_PAIRWISE", "0")
     want = pairwise_cosine_similarity(x, reduction="sum")
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=1e-4)
+
+
+def test_bounded_curve_member_fuses():
+    """A buffer_capacity curve metric has static array states, so it joins
+    the collection's single fused update program (list-state curves are
+    excluded) and still matches the unbounded serial oracle."""
+    rng = np.random.RandomState(33)
+    P = rng.rand(3, 32, 4).astype(np.float32)
+    P /= P.sum(-1, keepdims=True)
+    T = rng.randint(0, 4, (3, 32))
+    mc = MetricCollection(
+        {"acc": Accuracy(num_classes=4), "auroc": AUROC(num_classes=4, buffer_capacity=128)}
+    )
+    for i in range(3):
+        mc.update(jnp.asarray(P[i]), jnp.asarray(T[i]))
+    assert set(mc._fused_keys) == {"acc", "auroc"}
+
+    acc, auroc = Accuracy(num_classes=4), AUROC(num_classes=4)
+    for i in range(3):
+        acc.update(jnp.asarray(P[i]), jnp.asarray(T[i]))
+        auroc.update(jnp.asarray(P[i]), jnp.asarray(T[i]))
+    vals = mc.compute()
+    np.testing.assert_allclose(np.asarray(vals["acc"]), np.asarray(acc.compute()), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(vals["auroc"]), np.asarray(auroc.compute()), rtol=1e-6)
