@@ -842,6 +842,27 @@ def test_psnr_dim_parity(tm):
     _cmp(got, want, tol=1e-4)
 
 
+def test_weighted_mean_metric_parity(tm):
+    """MeanMetric's weight argument: element-wise weights and scalar broadcast."""
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(2)
+    ours, ref = M.MeanMetric(), tm.MeanMetric()
+    for _ in range(3):
+        v = rng.normal(size=6).astype(np.float32)
+        w = rng.rand(6).astype(np.float32)
+        ours.update(jnp.asarray(v), jnp.asarray(w))
+        ref.update(torch.from_numpy(v), torch.from_numpy(w))
+    _cmp(ours.compute(), ref.compute())
+    o2, r2 = M.MeanMetric(), tm.MeanMetric()
+    o2.update(jnp.asarray([1.0, 3.0]), 2.0)
+    r2.update(torch.tensor([1.0, 3.0]), 2.0)
+    _cmp(o2.compute(), r2.compute())
+
+
 @pytest.mark.parametrize("name", ["MeanMetric", "SumMetric", "MaxMetric", "MinMetric", "CatMetric"])
 @pytest.mark.parametrize("nan_strategy", ["warn", "ignore", 0.5])
 def test_aggregation_parity(tm, name, nan_strategy):
