@@ -850,13 +850,11 @@ def test_weighted_mean_metric_parity(tm):
     import metrics_tpu as M
 
     rng = np.random.RandomState(2)
-    ours, ref = M.MeanMetric(), tm.MeanMetric()
-    for _ in range(3):
-        v = rng.normal(size=6).astype(np.float32)
-        w = rng.rand(6).astype(np.float32)
-        ours.update(jnp.asarray(v), jnp.asarray(w))
-        ref.update(torch.from_numpy(v), torch.from_numpy(w))
-    _cmp(ours.compute(), ref.compute())
+    batches = [
+        (rng.normal(size=6).astype(np.float32), rng.rand(6).astype(np.float32)) for _ in range(3)
+    ]
+    got, want = _run_pair(M.MeanMetric(), tm.MeanMetric(), batches)
+    _cmp(got, want)
     o2, r2 = M.MeanMetric(), tm.MeanMetric()
     o2.update(jnp.asarray([1.0, 3.0]), 2.0)
     r2.update(torch.tensor([1.0, 3.0]), 2.0)
