@@ -155,6 +155,19 @@ def _exchange_bytes(payload: bytes, group: ProcessGroup, rank: int) -> List[byte
             for member in group.ranks
         ]
         client.wait_at_barrier(f"{_KV_PREFIX}/{scope}/{epoch}/done", timeout_ms, process_ids=list(group.ranks))
+    except Exception as err:
+        # the raw KV-get timeout names only an opaque key; re-raise with the
+        # group/epoch context so a desynced call sequence (members issuing
+        # grouped collectives in different orders, or a partial restart that
+        # reset one member's process-local epoch counter) is diagnosable
+        raise RuntimeError(
+            f"Grouped sync failed in {group!r} (scope={scope!r}, epoch={epoch},"
+            f" rank={rank}, timeout={group.timeout_s}s). If this is a KV-get"
+            " timeout: all members must issue grouped collectives in the same"
+            " order and count — a peer that is behind (different call order) or"
+            " ahead (restarted, epoch counter reset) publishes under a"
+            f" different epoch key and can never meet this one. Original error: {err}"
+        ) from err
     finally:
         client.key_value_delete(own_key)
     return payloads
@@ -273,6 +286,11 @@ def gather_state_trees(tree: Any, group: Optional[Any], dist_sync_fn: Optional[C
     takes the batched one-exchange path above; anything else (custom
     ``dist_sync_fn``, world-spanning default) gathers per leaf and
     transposes the results into per-member trees.
+
+    .. note:: leaves are visited in ``tree_flatten`` order — for a state
+       dict that is **sorted key order**, not ``add_state`` registration
+       order. A custom ``dist_sync_fn`` that replays recorded answers by
+       call order must record them against the sorted key sequence.
     """
     import jax
 
