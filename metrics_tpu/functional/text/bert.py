@@ -114,6 +114,39 @@ def _get_precision_recall_f1(
     return {"precision": precision, "recall": recall, "f1": f1}
 
 
+def _read_baseline_csv(baseline_path: str) -> np.ndarray:
+    """Load a rescale-baseline CSV from a local path.
+
+    Mirrors reference ``bert.py:396-404`` (``_read_csv_from_local_file``):
+    skip the header row, drop the leading layer-index column — rows are
+    per-layer ``[precision, recall, f1]`` baselines.
+    """
+    import csv
+
+    with open(baseline_path) as fname:
+        rows = [[float(item) for item in row] for idx, row in enumerate(csv.reader(fname)) if idx > 0]
+    baseline = np.asarray(rows, dtype=np.float64)
+    if baseline.ndim != 2 or baseline.shape[1] < 4:  # rescale reads 3 columns post-slice
+        raise ValueError(
+            f"Baseline CSV at {baseline_path!r} must have a header row and rows of"
+            " `layer_idx, precision, recall, f1` values."
+        )
+    return baseline[:, 1:]
+
+
+def _rescale_metrics_with_baseline(
+    out: Dict[str, np.ndarray], baseline: np.ndarray, num_layers: Optional[int]
+) -> Dict[str, np.ndarray]:
+    """``(score - baseline) / (1 - baseline)`` per metric, using the baseline
+    row of the scored layer (reference ``bert.py:438-455``; ``num_layers=None``
+    selects the last row, like the reference's ``-1`` default)."""
+    row = baseline[-1 if num_layers is None else num_layers]
+    return {
+        key: (np.asarray(out[key]) - row[i]) / (1.0 - row[i])
+        for i, key in enumerate(("precision", "recall", "f1"))
+    }
+
+
 def _default_hf_model(model_name_or_path: Optional[str], max_length: int):
     """Gated HF-Flax default encoder + tokenizer."""
     if not _TRANSFORMERS_AVAILABLE:
@@ -173,8 +206,13 @@ def bert_score(
             ``tokenizer(text, max_length) -> {input_ids, attention_mask}``.
         idf: weight tokens by inverse document frequency over the references.
         max_length: padded sequence length.
-        rescale_with_baseline / baseline_*: accepted for API parity; baseline
-            CSVs require network access and are not supported here.
+        rescale_with_baseline: rescale P/R/F1 as ``(score - b) / (1 - b)``
+            with the per-layer baseline ``b``; requires ``baseline_path``
+            (a local copy of the bert-score baseline CSV — the URL-download
+            path needs network access and raises here).
+        baseline_path: local baseline CSV (header row, then
+            ``layer, precision, recall, f1`` rows); the row used is
+            ``num_layers`` (last row when ``None``), as in the reference.
 
     Returns:
         dict with per-sentence ``precision``/``recall``/``f1`` lists.
@@ -197,11 +235,16 @@ def bert_score(
         target = [target]
     if len(preds) != len(target):
         raise ValueError("Number of predicted and reference sentences must be the same!")
+    baseline = None
     if rescale_with_baseline:
-        raise ValueError(
-            "`rescale_with_baseline` requires downloading baseline CSVs, which needs network access"
-            " not available here."
-        )
+        if baseline_path:
+            baseline = _read_baseline_csv(baseline_path)
+        else:
+            raise ValueError(
+                "`rescale_with_baseline` without a local `baseline_path` requires downloading"
+                " baseline CSVs, which needs network access not available here. Pass"
+                " `baseline_path` pointing at a local copy of the bert-score baseline file."
+            )
     forward = model or user_forward_fn
     tokenizer = user_tokenizer
     if forward is None:
@@ -244,6 +287,8 @@ def bert_score(
     out = {k: np.concatenate([np.asarray(c[k]) for c in chunks]) for k in chunks[0]} if chunks else {
         "precision": np.zeros(0), "recall": np.zeros(0), "f1": np.zeros(0)
     }
+    if baseline is not None:
+        out = _rescale_metrics_with_baseline(out, baseline, num_layers)
     result: Dict[str, Union[List[float], str]] = {k: np.asarray(v).tolist() for k, v in out.items()}
     if return_hash:
         result["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"

@@ -139,4 +139,8 @@ class BERTScore(Metric):
             return_hash=self.return_hash,
             model_name_or_path=self.model_name_or_path,
             num_layers=self.num_layers,
+            lang=self.lang,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline_path=self.baseline_path,
+            baseline_url=self.baseline_url,
         )

@@ -179,3 +179,100 @@ class TestBertScoreModule:
         """A user model must never be silently replaced by the HF default."""
         with pytest.raises(ValueError):
             BERTScore(model=toy_model)
+
+
+# ---------------------------------------------------------------------------
+# rescale_with_baseline from a local CSV (reference bert.py:373-404)
+# ---------------------------------------------------------------------------
+_BASELINE_ROWS = [  # per-layer (precision, recall, f1) baselines
+    (0.30, 0.35, 0.32),
+    (0.40, 0.45, 0.42),
+    (0.83, 0.85, 0.84),
+]
+
+
+def _write_baseline_csv(path):
+    with open(path, "w") as f:
+        f.write("LAYER,P,R,F\n")
+        for i, (p, r, f1) in enumerate(_BASELINE_ROWS):
+            f.write(f"{i},{p},{r},{f1}\n")
+    return str(path)
+
+
+class TestBertScoreRescaleBaseline:
+    def test_rescale_math_last_row_default(self, tmp_path):
+        """num_layers=None uses the LAST baseline row, scores transform as
+        (score - b) / (1 - b) per metric column."""
+        path = _write_baseline_csv(tmp_path / "baseline.csv")
+        raw = bert_score(PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN)
+        res = bert_score(
+            PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN,
+            rescale_with_baseline=True, baseline_path=path,
+        )
+        for col, key in enumerate(("precision", "recall", "f1")):
+            b = _BASELINE_ROWS[-1][col]
+            expected = (np.asarray(raw[key]) - b) / (1 - b)
+            np.testing.assert_allclose(res[key], expected, atol=1e-8, err_msg=key)
+
+    def test_rescale_num_layers_selects_row(self, tmp_path):
+        path = _write_baseline_csv(tmp_path / "baseline.csv")
+        raw = bert_score(PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN)
+        res = bert_score(
+            PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN,
+            rescale_with_baseline=True, baseline_path=path, num_layers=1,
+        )
+        for col, key in enumerate(("precision", "recall", "f1")):
+            b = _BASELINE_ROWS[1][col]
+            np.testing.assert_allclose(res[key], (np.asarray(raw[key]) - b) / (1 - b), atol=1e-8)
+
+    def test_rescale_without_path_still_raises(self):
+        """The URL-download path needs network access: still an error."""
+        with pytest.raises(ValueError, match="baseline_path"):
+            bert_score(PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer,
+                       rescale_with_baseline=True)
+
+    def test_module_api_routes_rescale(self, tmp_path):
+        path = _write_baseline_csv(tmp_path / "baseline.csv")
+        metric = BERTScore(model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN,
+                           rescale_with_baseline=True, baseline_path=path)
+        metric.update(PREDS, TARGETS)
+        res = metric.compute()
+        direct = bert_score(PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer,
+                            max_length=MAX_LEN, rescale_with_baseline=True, baseline_path=path)
+        for k in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(res[k], direct[k], atol=1e-6, err_msg=k)
+
+    def test_csv_reader_and_rescale_match_reference(self, tmp_path, tm):
+        """Our CSV parse + rescale pinned against the ACTUAL reference helpers
+        (`_read_csv_from_local_file` bert.py:396, `_rescale_metrics_with_baseline`
+        bert.py:438) on the same file and scores."""
+        import torch
+
+        from metrics_tpu.functional.text.bert import _read_baseline_csv, _rescale_metrics_with_baseline
+        from torchmetrics.functional.text.bert import (
+            _read_csv_from_local_file,
+            _rescale_metrics_with_baseline as ref_rescale,
+        )
+
+        path = _write_baseline_csv(tmp_path / "baseline.csv")
+        ours_baseline = _read_baseline_csv(path)
+        ref_baseline = _read_csv_from_local_file(path)
+        np.testing.assert_allclose(ours_baseline, ref_baseline.numpy(), atol=1e-6)
+
+        rng = np.random.default_rng(7)
+        scores = {k: rng.uniform(0.5, 1.0, size=5) for k in ("precision", "recall", "f1")}
+        for num_layers in (None, 0, 1):
+            ours = _rescale_metrics_with_baseline(scores, ours_baseline, num_layers)
+            ref_p, ref_r, ref_f = ref_rescale(
+                torch.from_numpy(scores["precision"]),
+                torch.from_numpy(scores["recall"]),
+                torch.from_numpy(scores["f1"]),
+                ref_baseline.double(),
+                num_layers=num_layers,
+                all_layers=False,
+            )
+            # 1e-6: the reference parses the CSV to float32 before its
+            # rescale; ours keeps float64 — the delta is parse precision
+            np.testing.assert_allclose(ours["precision"], ref_p.numpy(), atol=1e-6)
+            np.testing.assert_allclose(ours["recall"], ref_r.numpy(), atol=1e-6)
+            np.testing.assert_allclose(ours["f1"], ref_f.numpy(), atol=1e-6)
