@@ -9,7 +9,7 @@ from typing import Any, Optional
 import jax
 
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
-from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin
+from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin, curve_buffer_specs
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -28,8 +28,11 @@ class AUROC(_BoundedSampleBufferMixin, Metric):
         buffer_capacity: fix the sample buffers to this many samples,
             making ``update`` jittable with static memory (exact results,
             checked overflow). Requires ``num_classes`` up front for
-            multiclass; multi-label is unsupported in this mode. ``None``
-            (default) keeps the reference's unbounded eager lists.
+            multiclass; for multi-label inputs also pass ``multilabel=True``.
+            ``None`` (default) keeps the reference's unbounded eager lists.
+        multilabel: bounded-mode declaration that updates carry multi-label
+            ``[N, num_classes]`` targets, registering ``[capacity,
+            num_classes]`` buffer rows. Only valid with ``buffer_capacity``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -54,6 +57,7 @@ class AUROC(_BoundedSampleBufferMixin, Metric):
         average: Optional[str] = "macro",
         max_fpr: Optional[float] = None,
         buffer_capacity: Optional[int] = None,
+        multilabel: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -71,7 +75,9 @@ class AUROC(_BoundedSampleBufferMixin, Metric):
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
         self.mode = None
-        self._init_sample_states(buffer_capacity, num_classes)
+        self._init_sample_states(
+            buffer_capacity, num_classes, specs=curve_buffer_specs(num_classes, multilabel, buffer_capacity)
+        )
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, mode = _auroc_update(preds, target)

@@ -11,7 +11,7 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
-from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin
+from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin, curve_buffer_specs
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -24,11 +24,15 @@ class AveragePrecision(_BoundedSampleBufferMixin, Metric):
         buffer_capacity: fix the sample buffers to this many entries,
             making ``update`` jittable with static memory (exact results,
             checked overflow). Requires ``num_classes`` up front for
-            multiclass; multi-label is unsupported in this mode. With
-            ``average="micro"`` equal-rank inputs are flattened before
-            buffering, so the capacity is counted in flattened ELEMENTS
-            (``n_samples * n_labels``), not samples. ``None`` (default)
-            keeps the reference's unbounded eager lists.
+            multiclass; for multi-label inputs also pass ``multilabel=True``
+            (except with ``average="micro"``, whose flattened 1-D buffers
+            need no declaration). With ``average="micro"`` equal-rank inputs
+            are flattened before buffering, so the capacity is counted in
+            flattened ELEMENTS (``n_samples * n_labels``), not samples.
+            ``None`` (default) keeps the reference's unbounded eager lists.
+        multilabel: bounded-mode declaration that updates carry multi-label
+            ``[N, num_classes]`` targets, registering ``[capacity,
+            num_classes]`` buffer rows. Only valid with ``buffer_capacity``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -52,6 +56,7 @@ class AveragePrecision(_BoundedSampleBufferMixin, Metric):
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
         buffer_capacity: Optional[int] = None,
+        multilabel: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -61,8 +66,15 @@ class AveragePrecision(_BoundedSampleBufferMixin, Metric):
         if average not in allowed_average:
             raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
         self.average = average
-        # micro flattens equal-rank inputs to 1-D before buffering
-        self._init_sample_states(buffer_capacity, None if average == "micro" else num_classes)
+        # the declaration is validated regardless of average (consistent with
+        # the sibling curve classes); micro then flattens equal-rank inputs
+        # to 1-D before buffering, so its bounded buffers ignore the specs
+        ml_specs = curve_buffer_specs(num_classes, multilabel, buffer_capacity)
+        self._init_sample_states(
+            buffer_capacity,
+            None if average == "micro" else num_classes,
+            specs=None if average == "micro" else ml_specs,
+        )
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _average_precision_update(

@@ -9,7 +9,7 @@ from typing import Any, List, Optional, Tuple, Union
 
 import jax
 
-from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin
+from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin, curve_buffer_specs
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
@@ -54,12 +54,15 @@ class PrecisionRecallCurve(_BoundedSampleBufferMixin, Metric):
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
         buffer_capacity: Optional[int] = None,
+        multilabel: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self._init_sample_states(buffer_capacity, num_classes)
+        self._init_sample_states(
+            buffer_capacity, num_classes, specs=curve_buffer_specs(num_classes, multilabel, buffer_capacity)
+        )
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _precision_recall_curve_update(

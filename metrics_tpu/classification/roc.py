@@ -8,7 +8,7 @@ from typing import Any, List, Optional, Tuple, Union
 import jax
 
 from metrics_tpu.functional.classification.roc import _roc_compute, _roc_update
-from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin
+from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin, curve_buffer_specs
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -21,8 +21,13 @@ class ROC(_BoundedSampleBufferMixin, Metric):
         buffer_capacity: fix the sample buffers to this many samples,
             making ``update`` jittable with static memory (exact results,
             checked overflow). Requires ``num_classes`` up front for
-            multiclass; multi-label is unsupported in this mode. ``None``
-            (default) keeps the reference's unbounded eager lists.
+            multiclass; for multi-label inputs also pass ``multilabel=True``.
+            ``None`` (default) keeps the reference's unbounded eager lists.
+        multilabel: bounded-mode declaration that updates carry multi-label
+            ``[N, num_classes]`` targets, registering ``[capacity,
+            num_classes]`` buffer rows (static registration cannot infer the
+            layout from data the way the eager lists do). Only valid with
+            ``buffer_capacity``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -48,12 +53,15 @@ class ROC(_BoundedSampleBufferMixin, Metric):
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
         buffer_capacity: Optional[int] = None,
+        multilabel: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self._init_sample_states(buffer_capacity, num_classes)
+        self._init_sample_states(
+            buffer_capacity, num_classes, specs=curve_buffer_specs(num_classes, multilabel, buffer_capacity)
+        )
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _roc_update(preds, target, self.num_classes, self.pos_label)

@@ -35,7 +35,8 @@ class RetrievalMetric(_BoundedSampleBufferMixin, Metric, ABC):
         ignore_index: drop elements whose target equals this value.
         buffer_capacity: fix the three sample buffers to this many rows,
             making ``update`` jittable with static memory (exact results,
-            checked overflow). Rows removed by ``ignore_index`` don't count
+            checked overflow) — including with ``ignore_index`` set, whose
+            rows are dropped in-trace by the append scatter and don't count
             toward the capacity. ``None`` (default) keeps the reference's
             unbounded eager lists.
     """
@@ -72,6 +73,18 @@ class RetrievalMetric(_BoundedSampleBufferMixin, Metric, ABC):
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         if indexes is None:
             raise ValueError("Argument `indexes` cannot be None")
+        if self.buffer_capacity is not None and self.ignore_index is not None:
+            # bounded mode stays jittable: instead of boolean-mask filtering
+            # (dynamic shapes -> eager fallback), sanitize ignored rows to a
+            # benign target and drop them in-trace via the scatter's valid
+            # mask — they never land in the buffer nor consume capacity
+            valid = jnp.reshape(target != self.ignore_index, (-1,))
+            target = jnp.where(target == self.ignore_index, jnp.zeros_like(target), target)
+            indexes, preds, target = _check_retrieval_inputs(
+                indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target, ignore_index=None
+            )
+            self._append_samples(indexes, preds, target, valid=valid)
+            return
         indexes, preds, target = _check_retrieval_inputs(
             indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target, ignore_index=self.ignore_index
         )

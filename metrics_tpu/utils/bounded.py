@@ -33,9 +33,35 @@ BufferSpec = Tuple[str, Optional[int], Any]
 # the curve family's pointer appended to rank-mismatch errors (shared by the
 # four host classes so the wording can't drift)
 CURVE_MULTILABEL_HINT = (
-    " (Multi-label inputs are not supported with `buffer_capacity`; use the"
-    " Binned* variants for a jittable multi-label curve.)"
+    " (For multi-label inputs pass `multilabel=True` together with"
+    " `num_classes` so the bounded buffers register [capacity, num_classes]"
+    " target rows; the Binned* variants remain the constant-memory"
+    " approximation alternative.)"
 )
+
+
+def curve_buffer_specs(
+    num_classes: Optional[int], multilabel: bool, buffer_capacity: Optional[int]
+) -> Optional[Sequence[BufferSpec]]:
+    """Buffer specs for the curve family's ``(preds, target)`` states.
+
+    ``multilabel=False`` returns ``None`` (the mixin's default: ``[cap, C]``
+    float preds + ``[cap]`` int class-index targets). ``multilabel=True`` is a
+    bounded-mode declaration — static registration cannot infer the target
+    layout from data the way the eager lists do — and registers
+    ``[cap, num_classes]`` rows for BOTH preds and target.
+    """
+    if not multilabel:
+        return None
+    if buffer_capacity is None:
+        raise ValueError(
+            "`multilabel=True` is a `buffer_capacity` declaration: without a"
+            " capacity the unbounded lists infer multi-label layout from the"
+            " data and the flag must be omitted."
+        )
+    if not num_classes:
+        raise ValueError("Bounded multi-label buffers need `num_classes` up front.")
+    return (("preds", num_classes, None), ("target", num_classes, jnp.int32))
 
 
 class _BoundedSampleBufferMixin:
@@ -74,9 +100,9 @@ class _BoundedSampleBufferMixin:
                     " For large datasets this may lead to large memory footprint."
                 )
 
-    def _append_samples(self, *rows: Array) -> None:
+    def _append_samples(self, *rows: Array, valid: Optional[Array] = None) -> None:
         if self.buffer_capacity is not None:
-            self._bounded_append(*rows)
+            self._bounded_append(*rows, valid=valid)
         else:
             for (name, _, _), value in zip(self._buffer_specs, rows):
                 getattr(self, name).append(value)
@@ -106,10 +132,16 @@ class _BoundedSampleBufferMixin:
     # pointer (the curve family points at its Binned* alternatives)
     _bounded_rank_hint: str = ""
 
-    def _bounded_append(self, *rows: Array) -> None:
+    def _bounded_append(self, *rows: Array, valid: Optional[Array] = None) -> None:
         """Write normalized rows at the current offset; rows beyond the
         capacity are dropped by the scatter while ``count`` keeps the true
-        total, so overflow is detected at collection."""
+        total, so overflow is detected at collection.
+
+        ``valid`` (a ``[n]`` bool mask) drops rows IN-TRACE with static
+        shapes: invalid rows are routed to an out-of-bounds index (the
+        ``mode="drop"`` scatter discards them) and don't advance ``count`` —
+        the jittable replacement for boolean-mask filtering (which needs
+        concrete shapes and would force an eager fallback)."""
         # single-sample updates squeeze to 0-d in some normalizers — promote,
         # mirroring dim_zero_cat's handling on the unbounded list path
         rows = tuple(jnp.atleast_1d(value) for value in rows)
@@ -122,11 +154,18 @@ class _BoundedSampleBufferMixin:
                     + self._bounded_rank_hint
                 )
         n = rows[0].shape[0]
-        idx = self.count + jnp.arange(n)
+        if valid is None:
+            idx = self.count + jnp.arange(n)
+            n_new = n
+        else:
+            valid = jnp.atleast_1d(valid).reshape(-1).astype(bool)
+            kept_pos = self.count + jnp.cumsum(valid.astype(jnp.int32)) - 1
+            idx = jnp.where(valid, kept_pos, self.buffer_capacity)  # OOB -> dropped
+            n_new = jnp.sum(valid.astype(jnp.int32))
         for (name, _, _), value in zip(self._buffer_specs, rows):
             buf = getattr(self, name)
             setattr(self, name, buf.at[idx].set(value.astype(buf.dtype), mode="drop"))
-        self.count = self.count + n
+        self.count = self.count + n_new
 
     def _bounded_collect(self) -> Tuple[Array, ...]:
         """Valid rows per buffer, post- or pre-sync.

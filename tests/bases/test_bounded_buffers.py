@@ -153,9 +153,9 @@ def test_bounded_in_trace_sync_equals_serial():
     )
 
 
-def test_retrieval_bounded_ignore_index_stays_eager_but_exact():
-    # ignore_index filters rows (dynamic shape) — the auto-jit falls back to
-    # eager, and filtered rows must NOT consume capacity
+def test_retrieval_bounded_ignore_index_jits_and_is_exact():
+    # ignore_index rows are dropped in-trace by the append scatter (static
+    # shapes, no eager fallback) and must NOT consume capacity
     rng = np.random.RandomState(5)
     p = rng.rand(30).astype(np.float32)
     t = rng.randint(0, 2, 30)
@@ -165,4 +165,28 @@ def test_retrieval_bounded_ignore_index_stays_eager_but_exact():
     plain = RetrievalMAP(ignore_index=-100)
     bounded.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
     plain.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+    assert not bounded._jit_failed  # the auto-jit path must hold
     np.testing.assert_allclose(np.asarray(bounded.compute()), np.asarray(plain.compute()), atol=1e-7)
+    # capacity accounting: only the 20 kept rows count
+    assert int(bounded.count) == int(np.sum(t != -100))
+
+
+def test_retrieval_bounded_ignore_index_pure_api_under_jit():
+    """The pure state API with ignore_index composes with an explicit jit."""
+    import jax
+
+    rng = np.random.RandomState(6)
+    p = rng.rand(24).astype(np.float32)
+    t = rng.randint(0, 2, 24)
+    t[1::4] = -7
+    idx = np.repeat(np.arange(4), 6)
+    m = RetrievalMAP(buffer_capacity=32, ignore_index=-7)
+
+    @jax.jit
+    def step(state, p, t, i):
+        return m.update_state(state, p, t, i)
+
+    state = step(m.init_state(), jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+    oracle = RetrievalMAP(ignore_index=-7)
+    oracle.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+    np.testing.assert_allclose(np.asarray(m.compute_state(state)), np.asarray(oracle.compute()), atol=1e-7)

@@ -120,8 +120,10 @@ def test_bounded_reset_and_reuse():
 def test_bounded_rejects_multilabel_and_bad_capacity():
     with pytest.raises(ValueError, match="positive integer"):
         AUROC(buffer_capacity=0)
+    # multi-label rows against undeclared 1-D target buffers: the rank
+    # mismatch must point at the multilabel=True declaration
     m = AUROC(num_classes=None, buffer_capacity=16)
-    with pytest.raises(ValueError, match="Multi-label"):
+    with pytest.raises(ValueError, match="multilabel=True"):
         m.update(jnp.asarray(np.random.rand(4, 3).astype(np.float32)), jnp.asarray(np.random.randint(0, 2, (4, 3))))
 
 
@@ -138,3 +140,72 @@ def test_bounded_persistence_round_trip():
     m2.persistent(True)
     m2.load_state_dict(sd)
     _tree_assert_close(m2.compute(), m.compute())
+
+
+# ---------------------------------------------------------------------------
+# multi-label bounded buffers (`multilabel=True`): [capacity, C] target rows
+# ---------------------------------------------------------------------------
+def _ml_data(rng, n=40, c=3):
+    P = rng.rand(n, c).astype(np.float32)
+    T = rng.randint(0, 2, (n, c))
+    T[0] = 1  # every label has at least one positive -> curves well-defined
+    return P, T
+
+
+@pytest.mark.parametrize("metric_class", _CLASSES, ids=_IDS)
+def test_bounded_equals_unbounded_multilabel(metric_class):
+    rng = np.random.RandomState(7)
+    P, T = _ml_data(rng)
+    kwargs = {"average": None} if metric_class in (AUROC, AveragePrecision) else {}
+    bounded = metric_class(num_classes=3, buffer_capacity=64, multilabel=True, **kwargs)
+    plain = metric_class(num_classes=3, **kwargs)
+    for sl in (slice(0, 15), slice(15, 40)):
+        bounded.update(jnp.asarray(P[sl]), jnp.asarray(T[sl]))
+        plain.update(jnp.asarray(P[sl]), jnp.asarray(T[sl]))
+    assert not bounded._jit_failed  # static buffers must hold under auto-jit
+    _tree_assert_close(bounded.compute(), plain.compute())
+
+
+def test_bounded_multilabel_pure_api_scan():
+    """Multi-label bounded AUROC composes with jit+scan through the pure API."""
+    rng = np.random.RandomState(8)
+    P = rng.rand(5, 8, 3).astype(np.float32)
+    T = rng.randint(0, 2, (5, 8, 3))
+    T[:, 0] = 1
+    m = AUROC(num_classes=3, buffer_capacity=64, multilabel=True, average="macro")
+
+    def body(state, batch):
+        return m.update_state(state, batch[0], batch[1]), None
+
+    state, _ = jax.jit(lambda b: jax.lax.scan(body, m.init_state(), b))((jnp.asarray(P), jnp.asarray(T)))
+    assert int(state["count"]) == 40
+    plain = AUROC(num_classes=3, average="macro")
+    plain.update(jnp.asarray(P.reshape(-1, 3)), jnp.asarray(T.reshape(-1, 3)))
+    np.testing.assert_allclose(np.asarray(m.compute_state(state)), np.asarray(plain.compute()), atol=1e-6)
+
+
+def test_bounded_multilabel_overflow_checked():
+    rng = np.random.RandomState(9)
+    P, T = _ml_data(rng, n=40)
+    m = ROC(num_classes=3, buffer_capacity=16, multilabel=True)
+    m.update(jnp.asarray(P), jnp.asarray(T))
+    with pytest.raises(ValueError, match="buffer_capacity exceeded"):
+        m.compute()
+
+
+def test_multilabel_declaration_errors():
+    with pytest.raises(ValueError, match="buffer_capacity"):
+        ROC(num_classes=3, multilabel=True)  # declaration without a capacity
+    with pytest.raises(ValueError, match="num_classes"):
+        ROC(buffer_capacity=32, multilabel=True)  # layout needs num_classes
+
+
+def test_bounded_multilabel_micro_ap_needs_no_declaration():
+    """micro-AP flattens to 1-D buffers; multilabel data works without the flag."""
+    rng = np.random.RandomState(10)
+    P, T = _ml_data(rng)
+    bounded = AveragePrecision(num_classes=3, average="micro", buffer_capacity=256)
+    plain = AveragePrecision(num_classes=3, average="micro")
+    bounded.update(jnp.asarray(P), jnp.asarray(T))
+    plain.update(jnp.asarray(P), jnp.asarray(T))
+    np.testing.assert_allclose(np.asarray(bounded.compute()), np.asarray(plain.compute()), atol=1e-7)
