@@ -243,13 +243,21 @@ class MetricTester:
     ) -> None:
         world_size = NUM_PROCESSES
         rank_metrics = [
-            metric_class(**metric_args) for _ in range(world_size)
+            metric_class(dist_sync_on_step=dist_sync_on_step, **metric_args) for _ in range(world_size)
         ]
-        # each rank consumes batches rank::world_size (reference ``testers.py:177``)
-        for rank, metric in enumerate(rank_metrics):
-            for i in range(rank, NUM_BATCHES, world_size):
-                batch_kwargs = {k: v[i] if _is_batched(v) else v for k, v in kwargs_update.items()}
-                metric.update(preds[i], target[i], **batch_kwargs)
+        if dist_sync_on_step and check_dist_sync_on_step:
+            # lockstep forward on rank 0: the per-step batch value syncs
+            # across ranks, so it must equal the oracle on ALL ranks' step-s
+            # batches concatenated (reference ``testers.py:190-205``)
+            self._lockstep_sync_on_step(
+                preds, target, rank_metrics, sk_metric, metric_args, metric_class, check_batch, **kwargs_update
+            )
+        else:
+            # each rank consumes batches rank::world_size (reference ``testers.py:177``)
+            for rank, metric in enumerate(rank_metrics):
+                for i in range(rank, NUM_BATCHES, world_size):
+                    batch_kwargs = {k: v[i] if _is_batched(v) else v for k, v in kwargs_update.items()}
+                    metric.update(preds[i], target[i], **batch_kwargs)
 
         gather = _fake_gather_factory(rank_metrics)
         m0 = rank_metrics[0]
@@ -284,6 +292,71 @@ class MetricTester:
             },
         )
         _assert_allclose(local_result, sk_local, atol=self.atol)
+
+    def _lockstep_sync_on_step(
+        self,
+        preds: Any,
+        target: Any,
+        rank_metrics: Sequence[Metric],
+        sk_metric: Callable,
+        metric_args: dict,
+        metric_class: type,
+        check_batch: bool,
+        **kwargs_update: Any,
+    ) -> None:
+        """Drive all ranks step by step with ``dist_sync_on_step=True``.
+
+        At each step, rank 0's ``forward`` runs the full-state dance with a
+        gather that serves every rank's BATCH-only state (what each peer's
+        dance would publish at that moment); the returned batch value must
+        equal the oracle on the step's concatenated cross-rank batch. Other
+        ranks accumulate plainly, so the final ``compute`` sync (run by the
+        caller) still covers all batches.
+        """
+        world_size = len(rank_metrics)
+        steps = NUM_BATCHES // world_size
+        for s in range(steps):
+            batch_idx = [rank + s * world_size for rank in range(world_size)]
+            # per-rank BATCH-only metrics: their states are what each peer's
+            # forward dance would publish at this step, served through the
+            # same replay gather the final compute sync uses
+            batch_metrics = []
+            for i in batch_idx:
+                tmp = metric_class(**metric_args)
+                bk = {k: v[i] if _is_batched(v) else v for k, v in kwargs_update.items()}
+                tmp.update(preds[i], target[i], **bk)
+                batch_metrics.append(tmp)
+            gather = _fake_gather_factory(batch_metrics)
+
+            m0 = rank_metrics[0]
+            m0.dist_sync_fn = gather
+            m0._distributed_available_fn = lambda: True
+            bk0 = {k: v[batch_idx[0]] if _is_batched(v) else v for k, v in kwargs_update.items()}
+            batch_result = m0(preds[batch_idx[0]], target[batch_idx[0]], **bk0)
+            m0.dist_sync_fn = None
+            m0._distributed_available_fn = None
+
+            for rank in range(1, world_size):
+                i = batch_idx[rank]
+                bk = {k: v[i] if _is_batched(v) else v for k, v in kwargs_update.items()}
+                rank_metrics[rank].update(preds[i], target[i], **bk)
+
+            if check_batch:
+                step_kwargs = {
+                    k: (np.concatenate([np.asarray(v[i]) for i in batch_idx], axis=0) if _is_batched(v) else v)
+                    for k, v in kwargs_update.items()
+                }
+                sk_step = sk_metric(
+                    np.concatenate([np.asarray(preds[i]) for i in batch_idx], axis=0),
+                    np.concatenate([np.asarray(target[i]) for i in batch_idx], axis=0),
+                    **step_kwargs,
+                )
+                _assert_allclose(batch_result, sk_step, atol=self.atol)
+
+        for rank in range(world_size):  # leftover batches accumulate plainly
+            for i in range(steps * world_size + rank, NUM_BATCHES, world_size):
+                bk = {k: v[i] if _is_batched(v) else v for k, v in kwargs_update.items()}
+                rank_metrics[rank].update(preds[i], target[i], **bk)
 
     # bf16 has an 8-bit mantissa: value agreement with the full-precision
     # pipeline is asserted within these (overridable) tolerances
