@@ -59,10 +59,14 @@ class ConfusionMatrix(Metric):
         if normalize not in allowed_normalize:
             raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
 
+        # the lane's default int (int64 under jax_enable_x64, else int32):
+        # the bincount in update produces that dtype, and init/update dtype
+        # agreement is what lets the state ride a lax.scan carry unchanged
+        int_dtype = jnp.asarray(0).dtype
         default = (
-            jnp.zeros((num_classes, 2, 2), dtype=jnp.int32)
+            jnp.zeros((num_classes, 2, 2), dtype=int_dtype)
             if multilabel
-            else jnp.zeros((num_classes, num_classes), dtype=jnp.int32)
+            else jnp.zeros((num_classes, num_classes), dtype=int_dtype)
         )
         self.add_state("confmat", default=default, dist_reduce_fx="sum")
 

@@ -245,6 +245,42 @@ class MetricCollection:
     def compute(self) -> Dict[str, Any]:
         return {k: m.compute() for k, m in self.items(keep_base=False)}
 
+    # -- pure (explicitly state-passing) API — jit/shard_map friendly ----
+    def init_state(self) -> Dict[str, Dict[str, Any]]:
+        """Fresh per-member state pytrees, keyed like ``compute`` results.
+
+        The pure API is explicitly stateless: a metric instance registered
+        under two keys gets two independent states here (unlike the OO path,
+        where aliases share accumulation).
+        """
+        return {k: m.init_state() for k, m in self.items()}
+
+    def update_state(self, states: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
+        """Pure fused update: ``states, batch -> new states`` with per-member
+        kwarg routing. Wrap the caller in ``jax.jit`` (or use inside
+        ``lax.scan``/``shard_map``) to trace every member into one XLA
+        program — the pure analog of the fused OO ``update``."""
+        return {k: m.update_state(states[k], *args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
+
+    def sync_state(
+        self, states: Dict[str, Dict[str, Any]], axis_name: Union[str, Sequence[str]]
+    ) -> Dict[str, Dict[str, Any]]:
+        """In-trace cross-device sync of every member's state over a named
+        mesh axis, with the collectives packed ACROSS members: all
+        same-(reduction, dtype) leaves in the whole collection are raveled
+        into one flat buffer and synced by a single collective (jax binds
+        ``psum`` per leaf, so unpacked states would each be their own
+        all-reduce) — a collection costs one launch per (reduction, dtype)
+        bucket, the same as a single metric."""
+        from metrics_tpu.parallel import comm
+
+        reductions = {k: m._reductions for k, m in self.items()}
+        return comm.sync_state_trees(states, reductions, axis_name)
+
+    def compute_state(self, states: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+        """Pure compute: ``states -> {key: value}``. Safe inside jit."""
+        return {k: m.compute_state(states[k]) for k, m in self.items()}
+
     def reset(self) -> None:
         for _, m in self.items(keep_base=True):
             m.reset()

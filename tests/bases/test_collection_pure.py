@@ -1,0 +1,143 @@
+"""MetricCollection pure state API: fused update/sync/compute through
+jit/scan/shard_map, with collectives batched across members.
+
+The launch-count assertion is the point of the design: a whole collection's
+sync must cost ONE all-reduce launch per reduction kind (the same as a single
+metric), because launch overhead — not bytes — dominates metric-state sync.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, MetricCollection
+
+NUM_CLASSES = 5
+
+
+def _members():
+    return {
+        "acc": Accuracy(num_classes=NUM_CLASSES),
+        "confmat": ConfusionMatrix(num_classes=NUM_CLASSES),
+        "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+    }
+
+
+def _data(rng, batches, batch):
+    p = rng.rand(batches, batch, NUM_CLASSES).astype(np.float32)
+    t = rng.randint(0, NUM_CLASSES, (batches, batch))
+    return jnp.asarray(p), jnp.asarray(t)
+
+
+def test_pure_scan_epoch_matches_oo():
+    rng = np.random.RandomState(0)
+    P, T = _data(rng, 6, 16)
+    mc = MetricCollection(_members())
+
+    def body(states, batch):
+        return mc.update_state(states, batch[0], batch[1]), None
+
+    states, _ = jax.jit(lambda b: jax.lax.scan(body, mc.init_state(), b))((P, T))
+    pure = mc.compute_state(states)
+
+    oo = MetricCollection(_members())
+    for i in range(6):
+        oo.update(P[i], T[i])
+    expected = oo.compute()
+    assert set(pure) == set(expected)
+    for k in expected:
+        np.testing.assert_allclose(np.asarray(pure[k]), np.asarray(expected[k]), atol=1e-6, err_msg=k)
+
+
+def test_pure_sync_distributed_equals_serial():
+    from jax.sharding import Mesh, PartitionSpec as P_
+
+    rng = np.random.RandomState(1)
+    P, T = _data(rng, 8, 16)  # leading dim sharded over 8 devices
+    mc = MetricCollection(_members())
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+
+    def shard_fn(p, t):
+        states = mc.update_state(mc.init_state(), p[0], t[0])
+        states = mc.sync_state(states, axis_name="dp")
+        return mc.compute_state(states)
+
+    kw = dict(mesh=mesh, in_specs=(P_("dp"), P_("dp")), out_specs=P_())
+    try:
+        fn = jax.shard_map(shard_fn, check_vma=False, **kw)
+    except TypeError:
+        fn = jax.shard_map(shard_fn, check_rep=False, **kw)
+    dist = jax.jit(fn)(P, T)
+
+    serial = MetricCollection(_members())
+    serial.update(P.reshape(-1, NUM_CLASSES), T.reshape(-1))
+    expected = serial.compute()
+    for k in expected:
+        np.testing.assert_allclose(np.asarray(dist[k]), np.asarray(expected[k]), atol=1e-6, err_msg=k)
+
+
+def _count_collective_eqns(jaxpr, names=("psum", "pmean", "pmax", "pmin", "psum2", "all_reduce")) -> int:
+    count = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in names:
+            count += 1
+        for param in eqn.params.values():
+            inner = getattr(param, "jaxpr", None)
+            if inner is not None:
+                count += _count_collective_eqns(inner, names)
+    return count
+
+
+def test_collection_sync_launch_count_is_bucket_count():
+    """All members' same-(reduction, dtype) states pack into ONE collective
+    launch per bucket; the unpacked per-leaf lowering would cost one launch
+    per state tensor (jax binds psum per leaf even for a pytree argument)."""
+    mc = MetricCollection(_members())
+    rng = np.random.RandomState(2)
+    p = jnp.asarray(rng.rand(16, NUM_CLASSES).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, NUM_CLASSES, 16))
+    states = mc.update_state(mc.init_state(), p, t)
+
+    n_leaves = len(jax.tree_util.tree_leaves(states))
+    buckets = {
+        (m._reductions[name], jnp.asarray(states[k][name]).dtype)
+        for k, m in mc.items()
+        for name in states[k]
+    }
+    assert n_leaves > len(buckets)  # the packing must have something to pack
+
+    fused_jaxpr = jax.make_jaxpr(
+        lambda s: mc.sync_state(s, axis_name="dp"), axis_env=[("dp", 8)]
+    )(states)
+    fused = _count_collective_eqns(fused_jaxpr.jaxpr)
+    assert fused == len(buckets), (
+        f"expected one collective launch per (reduction, dtype) bucket"
+        f" ({len(buckets)} for {n_leaves} state leaves), found {fused}"
+    )
+
+
+def test_pure_update_routes_kwargs():
+    """Members only receive kwargs their update signature accepts."""
+
+    class KwargMetric(Accuracy):
+        def update(self, preds, target, flag: bool = False) -> None:  # noqa: D102
+            assert flag, "flag kwarg was not routed"
+            super().update(preds, target)
+
+    mc = MetricCollection({"plain": Accuracy(), "kw": KwargMetric()})
+    p = jnp.asarray([0.1, 0.9, 0.8, 0.2])
+    t = jnp.asarray([0, 1, 1, 0])
+    states = mc.update_state(mc.init_state(), p, t, flag=True)
+    out = mc.compute_state(states)
+    np.testing.assert_allclose(np.asarray(out["plain"]), 1.0)
+    np.testing.assert_allclose(np.asarray(out["kw"]), 1.0)
+
+
+def test_pure_api_respects_prefix_keys():
+    mc = MetricCollection({"acc": Accuracy()}, prefix="val_")
+    p = jnp.asarray([0.1, 0.9])
+    t = jnp.asarray([0, 1])
+    states = mc.update_state(mc.init_state(), p, t)
+    assert list(states) == ["val_acc"]
+    out = mc.compute_state(states)
+    assert list(out) == ["val_acc"]
