@@ -311,3 +311,82 @@ def test_bounded_curve_member_fuses():
     vals = mc.compute()
     np.testing.assert_allclose(np.asarray(vals["acc"]), np.asarray(acc.compute()), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(vals["auroc"]), np.asarray(auroc.compute()), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fused compute: jit-compatible members evaluated in ONE program + one fetch
+# ---------------------------------------------------------------------------
+def test_compute_fused_engages_and_matches_per_member():
+    mc = _stat_collection()
+    ref = _stat_collection()
+    ref._fused_cmp_failed = True  # reference-style per-member dispatch
+    for p, t in _batches():
+        mc.update(p, t)
+        ref.update(p, t)
+    got, want = mc.compute(), ref.compute()
+    assert mc._fused_cmp_fn is not None  # the fused program actually ran
+    assert ref._fused_cmp_fn is None
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), rtol=1e-7, err_msg=k)
+
+
+def test_compute_fused_caching_semantics():
+    mc = _stat_collection()
+    p, t = _batches(n=1)[0]
+    mc.update(p, t)
+    first = mc.compute()
+    # second compute returns the per-member caches (no fused re-run needed)
+    for _, m in mc.items(keep_base=True):
+        assert m._computed is not None
+    second = mc.compute()
+    for k in first:
+        np.testing.assert_allclose(np.asarray(first[k]), np.asarray(second[k]), err_msg=k)
+    # an update invalidates the caches; the recompute reflects the new data
+    p2, t2 = _batches(n=2, seed=3)[1]
+    mc.update(p2, t2)
+    ref = _stat_collection()
+    ref.update(p, t)
+    ref.update(p2, t2)
+    got, want = mc.compute(), ref.compute()
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), rtol=1e-7, err_msg=k)
+
+
+def test_compute_fused_mixes_with_list_state_member():
+    from metrics_tpu import AUROC
+
+    mc = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "auroc": AUROC(num_classes=NUM_CLASSES),  # list states -> per-member path
+        }
+    )
+    rng = np.random.RandomState(7)
+    p = jnp.asarray(rng.rand(64, NUM_CLASSES).astype(np.float32))
+    p = p / p.sum(-1, keepdims=True)
+    t = jnp.asarray(rng.randint(0, NUM_CLASSES, 64))
+    mc.update(p, t)
+    got = mc.compute()
+    singles = {
+        "acc": Accuracy(num_classes=NUM_CLASSES),
+        "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+        "auroc": AUROC(num_classes=NUM_CLASSES),
+    }
+    for m in singles.values():
+        m.update(p, t)
+    for k, m in singles.items():
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(m.compute()), rtol=1e-6, err_msg=k)
+
+
+def test_compute_fused_warns_before_update():
+    import warnings
+
+    mc = _stat_collection()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        # Accuracy legitimately raises before any update ("mode" unknown),
+        # exactly like the per-member path — but the warning must fire first
+        with pytest.raises(RuntimeError, match="determined mode"):
+            mc.compute()
+    assert any("was called before the ``update``" in str(w.message) for w in caught)

@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 
 from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, MetricCollection
+from metrics_tpu.metric import Metric
 
 NUM_CLASSES = 5
 
@@ -141,3 +142,75 @@ def test_pure_api_respects_prefix_keys():
     assert list(states) == ["val_acc"]
     out = mc.compute_state(states)
     assert list(out) == ["val_acc"]
+
+
+class _MixedReduce(Metric):
+    """Three states with distinct reductions: pins sync routing per leaf."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("peak", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+        self.add_state("trough", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.peak = jnp.maximum(self.peak, jnp.max(x))
+        self.trough = jnp.minimum(self.trough, jnp.min(x))
+
+    def compute(self):
+        return {"total": self.total, "peak": self.peak, "trough": self.trough}
+
+
+def test_collection_sync_values_equal_per_member_sync():
+    """Collection-level sync must route every leaf to ITS member's declared
+    reduction — value-compared against per-member sync_state on a mesh with
+    sum/max/min states in one collection."""
+    from jax.sharding import Mesh, PartitionSpec as P_
+
+    mc = MetricCollection({"m1": _MixedReduce(), "m2": _MixedReduce()})
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+
+    def shard_fn(xs):
+        states = {k: m.update_state(m.init_state(), xs[0]) for k, m in mc.items()}
+        via_collection = mc.sync_state(states, axis_name="dp")
+        via_members = {k: m.sync_state(states[k], axis_name="dp") for k, m in mc.items()}
+        return via_collection, via_members
+
+    kw = dict(mesh=mesh, in_specs=(P_("dp"),), out_specs=P_())
+    try:
+        fn = jax.shard_map(shard_fn, check_vma=False, **kw)
+    except TypeError:
+        fn = jax.shard_map(shard_fn, check_rep=False, **kw)
+    via_collection, via_members = jax.jit(fn)(x)
+    for k in via_members:
+        for name in via_members[k]:
+            np.testing.assert_array_equal(
+                np.asarray(via_collection[k][name]), np.asarray(via_members[k][name]),
+                err_msg=f"{k}.{name}",
+            )
+    # and the reductions are actually distinct (sum != max != min here)
+    assert float(via_collection["m1"]["total"]) == pytest.approx(float(jnp.sum(x)), rel=1e-5)
+    assert float(via_collection["m1"]["peak"]) == pytest.approx(float(jnp.max(x)), rel=1e-5)
+    assert float(via_collection["m1"]["trough"]) == pytest.approx(float(jnp.min(x)), rel=1e-5)
+
+
+def test_collection_merge_states_halves_equal_full():
+    mc = MetricCollection(_members())
+    rng = np.random.RandomState(4)
+    P, T = _data(rng, 4, 16)
+    sa = mc.init_state()
+    sb = mc.init_state()
+    full = mc.init_state()
+    for i in range(2):
+        sa = mc.update_state(sa, P[i], T[i])
+    for i in range(2, 4):
+        sb = mc.update_state(sb, P[i], T[i])
+    for i in range(4):
+        full = mc.update_state(full, P[i], T[i])
+    merged = mc.merge_states(sa, sb)
+    got, want = mc.compute_state(merged), mc.compute_state(full)
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), atol=1e-6, err_msg=k)
