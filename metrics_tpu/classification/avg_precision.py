@@ -66,15 +66,18 @@ class AveragePrecision(_BoundedSampleBufferMixin, Metric):
         if average not in allowed_average:
             raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
         self.average = average
-        # the declaration is validated regardless of average (consistent with
-        # the sibling curve classes); micro then flattens equal-rank inputs
-        # to 1-D before buffering, so its bounded buffers ignore the specs
-        ml_specs = curve_buffer_specs(num_classes, multilabel, buffer_capacity)
-        self._init_sample_states(
-            buffer_capacity,
-            None if average == "micro" else num_classes,
-            specs=None if average == "micro" else ml_specs,
-        )
+        # micro flattens equal-rank inputs to 1-D before buffering, so its
+        # bounded buffers need neither num_classes nor the multilabel specs —
+        # validating them anyway would reject the documented
+        # "micro needs no declaration" contract (advisor r4). The unbounded
+        # flag misuse still errors exactly like the sibling classes.
+        if average == "micro":
+            if multilabel and buffer_capacity is None:
+                curve_buffer_specs(None, multilabel, None)  # raises: flag needs a capacity
+            self._init_sample_states(buffer_capacity, None, specs=None)
+        else:
+            ml_specs = curve_buffer_specs(num_classes, multilabel, buffer_capacity)
+            self._init_sample_states(buffer_capacity, num_classes, specs=ml_specs)
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _average_precision_update(

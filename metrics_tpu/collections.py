@@ -63,6 +63,7 @@ class MetricCollection:
         self._fused_cmp_keys: Tuple[str, ...] = ()
         self._fused_cmp_fn: Optional[Any] = None
         self._fused_cmp_failed = False
+        self._fused_cmp_excluded: set = set()
         self.add_metrics(metrics, *additional_metrics)
 
     # -- lifecycle ------------------------------------------------------
@@ -266,8 +267,12 @@ class MetricCollection:
             return ()  # host-level sync must run per member inside compute
         keys = []
         for k, m in self._modules.items():
+            if k in self._fused_cmp_excluded:
+                continue
             if not (m._enable_jit and not m._jit_failed and not m._has_list_state()):
                 continue
+            if m._compute_is_host_side:
+                continue  # e.g. bounded sample buffers: compute branches on a concrete count
             if (
                 m._is_synced
                 or m.dist_sync_fn is not None
@@ -280,7 +285,7 @@ class MetricCollection:
             keys.append(k)
         return tuple(keys) if len(keys) >= 2 else ()
 
-    def _fused_compute(self) -> Dict[str, Any]:
+    def _fused_compute(self, _warn: bool = True) -> Dict[str, Any]:
         """Evaluate the fusable members' computes as one jitted program.
 
         Returns ``{base_key: value}`` for the members handled; anything not
@@ -300,7 +305,9 @@ class MetricCollection:
             self._fused_cmp_fn = None
         members = [self._modules[k] for k in keys]
         states = {k: m._snapshot_state() for k, m in zip(keys, members)}
-        for m in members:  # warn BEFORE computing, like the wrapped per-member path
+        for m in members if _warn else ():  # warn BEFORE computing, like the
+            # wrapped per-member path; suppressed on the offender-exclusion
+            # retry, which already warned for every member this call
             if m._update_count == 0:
                 rank_zero_warn(
                     f"The ``compute`` method of metric {m.__class__.__name__}"
@@ -323,9 +330,32 @@ class MetricCollection:
         try:
             vals = self._fused_cmp_fn(states)
         except _JIT_FALLBACK_ERRORS:
-            self._fused_cmp_failed = True
             for k, m in zip(keys, members):
                 m._restore_state(states[k])
+            # Find which member(s) can't trace (host-side compute that slipped
+            # past the static checks) and exclude only those, so one offender
+            # doesn't permanently defeat fused compute for the whole
+            # collection. Probing is trace-only (eval_shape: no compile, no
+            # execute). Only if no individual offender reproduces do we fall
+            # back to the collection-wide flag (interaction failure).
+            offenders = set()
+            for k, m in zip(keys, members):
+                def _probe(st, member=m):
+                    member._restore_state(st)
+                    return member._compute_impl()
+
+                try:
+                    jax.eval_shape(_probe, states[k])
+                except _JIT_FALLBACK_ERRORS:
+                    offenders.add(k)
+                finally:
+                    m._restore_state(states[k])
+            if offenders:
+                self._fused_cmp_excluded |= offenders
+                self._fused_cmp_keys = ()
+                self._fused_cmp_fn = None
+                return self._fused_compute(_warn=False)  # retry without the offenders
+            self._fused_cmp_failed = True
             return {}
         except Exception:
             for k, m in zip(keys, members):
@@ -447,6 +477,7 @@ class MetricCollection:
         self._fused_cmp_keys = ()
         self._fused_cmp_fn = None
         self._fused_cmp_failed = False
+        self._fused_cmp_excluded = set()
 
         if isinstance(metrics, dict):
             for name in sorted(metrics.keys()):

@@ -126,20 +126,42 @@ def _read_baseline_csv(baseline_path: str) -> np.ndarray:
     with open(baseline_path) as fname:
         rows = [[float(item) for item in row] for idx, row in enumerate(csv.reader(fname)) if idx > 0]
     baseline = np.asarray(rows, dtype=np.float64)
-    if baseline.ndim != 2 or baseline.shape[1] < 4:  # rescale reads 3 columns post-slice
+    # exactly 4 columns: extra trailing columns would be silently ignored
+    # while the error text promises this exact format (advisor r4)
+    if baseline.ndim != 2 or baseline.shape[1] != 4:
         raise ValueError(
             f"Baseline CSV at {baseline_path!r} must have a header row and rows of"
-            " `layer_idx, precision, recall, f1` values."
+            " exactly `layer_idx, precision, recall, f1` values"
+            f" (got {baseline.shape[1] if baseline.ndim == 2 else 'ragged'} columns)."
         )
-    return baseline[:, 1:]
+    return baseline[:, 1:4]
 
 
 def _rescale_metrics_with_baseline(
-    out: Dict[str, np.ndarray], baseline: np.ndarray, num_layers: Optional[int]
+    out: Dict[str, np.ndarray], baseline: np.ndarray, num_layers: Optional[int],
+    all_layers: bool = False,
 ) -> Dict[str, np.ndarray]:
     """``(score - baseline) / (1 - baseline)`` per metric, using the baseline
     row of the scored layer (reference ``bert.py:438-455``; ``num_layers=None``
-    selects the last row, like the reference's ``-1`` default)."""
+    selects the last row, like the reference's ``-1`` default).
+
+    With ``all_layers`` the scores are ``[num_layers, n]`` and each layer is
+    rescaled by its own baseline row (the reference's
+    ``baseline.unsqueeze(1)`` broadcast, ``bert.py:448-452``)."""
+    if all_layers:
+        n_layers = np.asarray(out["f1"]).shape[0]
+        # exact match, like the reference's broadcast (a baseline from a
+        # deeper model would otherwise silently rescale with wrong rows)
+        if baseline.shape[0] != n_layers:
+            raise ValueError(
+                f"`all_layers` rescale needs exactly one baseline row per layer: scores"
+                f" have {n_layers} layers but the baseline CSV has {baseline.shape[0]} rows."
+            )
+        return {
+            key: (np.asarray(out[key]) - baseline[:, i : i + 1])
+            / (1.0 - baseline[:, i : i + 1])
+            for i, key in enumerate(("precision", "recall", "f1"))
+        }
     row = baseline[-1 if num_layers is None else num_layers]
     return {
         key: (np.asarray(out[key]) - row[i]) / (1.0 - row[i])
@@ -147,8 +169,17 @@ def _rescale_metrics_with_baseline(
     }
 
 
-def _default_hf_model(model_name_or_path: Optional[str], max_length: int):
-    """Gated HF-Flax default encoder + tokenizer."""
+def _default_hf_model(
+    model_name_or_path: Optional[str],
+    max_length: int,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+):
+    """Gated HF-Flax default encoder + tokenizer.
+
+    ``num_layers`` selects the hidden-state layer to embed with (reference
+    ``bert.py:314-316``); ``all_layers`` stacks every hidden state to
+    ``[num_layers, n, L, d]`` (reference ``bert.py:322-325``)."""
     if not _TRANSFORMERS_AVAILABLE:
         raise ModuleNotFoundError(
             "`bert_score` metric with default models requires `transformers` package be installed."
@@ -168,8 +199,14 @@ def _default_hf_model(model_name_or_path: Optional[str], max_length: int):
         ) from err
 
     def forward(input_ids: np.ndarray, attention_mask: np.ndarray) -> Array:
-        out = model(input_ids=jnp.asarray(input_ids), attention_mask=jnp.asarray(attention_mask))
-        return out.last_hidden_state
+        out = model(
+            input_ids=jnp.asarray(input_ids),
+            attention_mask=jnp.asarray(attention_mask),
+            output_hidden_states=True,
+        )
+        if all_layers:
+            return jnp.stack(out.hidden_states, axis=0)
+        return out.hidden_states[num_layers if num_layers is not None else -1]
 
     return forward, tokenizer
 
@@ -202,6 +239,12 @@ def bert_score(
         model: user encoder ``(input_ids, attention_mask) -> [N, L, d]``
             (a jitted Flax forward); with ``None`` the gated HF default loads
             ``model_name_or_path``.
+        all_layers: score every encoder layer; outputs become
+            ``[num_layers, N]`` per metric. A user ``model`` must then return
+            ``[num_layers, N, L, d]`` (a superset of the reference, which
+            restricts ``all_layers`` to default transformers models —
+            ``bert.py:320-325``); the HF default stacks
+            ``output_hidden_states``.
         user_tokenizer: tokenizer — HF-style, or the own-model contract
             ``tokenizer(text, max_length) -> {input_ids, attention_mask}``.
         idf: weight tokens by inverse document frequency over the references.
@@ -250,7 +293,7 @@ def bert_score(
     if forward is None:
         if tokenizer is not None:
             raise ValueError("a user `model` must be provided together with `user_tokenizer`")
-        forward, tokenizer = _default_hf_model(model_name_or_path, max_length)
+        forward, tokenizer = _default_hf_model(model_name_or_path, max_length, num_layers, all_layers)
     elif tokenizer is None:
         raise ValueError("`user_tokenizer` must be provided together with a user `model`")
 
@@ -269,13 +312,25 @@ def bert_score(
     # the corpus-level forward and [N, L, L] similarity never materialize at
     # once (the reference achieves the same with its DataLoader loop)
     n = len(preds)
+    # per-layer scoring is the same program mapped over the leading layer
+    # axis; masks/idf are layer-invariant so they stay unbatched
+    score_fn = _get_precision_recall_f1
+    if all_layers:
+        score_fn = jax.vmap(_get_precision_recall_f1, in_axes=(0, 0, None, None, None, None))
     chunks: List[Dict[str, Array]] = []
     for start in range(0, n, batch_size):
         sl = slice(start, start + batch_size)
         preds_emb = jnp.asarray(forward(preds_tok["input_ids"][sl], preds_tok["attention_mask"][sl]))
         target_emb = jnp.asarray(forward(target_tok["input_ids"][sl], target_tok["attention_mask"][sl]))
+        want_ndim = 4 if all_layers else 3
+        if preds_emb.ndim != want_ndim:
+            raise ValueError(
+                f"With `all_layers={all_layers}` the encoder must return a rank-{want_ndim} array"
+                f" ({'[num_layers, n, seq_len, dim]' if all_layers else '[n, seq_len, dim]'}),"
+                f" got shape {tuple(preds_emb.shape)}."
+            )
         chunks.append(
-            _get_precision_recall_f1(
+            score_fn(
                 preds_emb,
                 target_emb,
                 jnp.asarray(preds_mask[sl], preds_emb.dtype),
@@ -284,11 +339,12 @@ def bert_score(
                 jnp.asarray(target_idf_scale[sl], target_emb.dtype),
             )
         )
-    out = {k: np.concatenate([np.asarray(c[k]) for c in chunks]) for k in chunks[0]} if chunks else {
+    # sentence axis is last in both layouts: [n] plain, [num_layers, n] stacked
+    out = {k: np.concatenate([np.asarray(c[k]) for c in chunks], axis=-1) for k in chunks[0]} if chunks else {
         "precision": np.zeros(0), "recall": np.zeros(0), "f1": np.zeros(0)
     }
     if baseline is not None:
-        out = _rescale_metrics_with_baseline(out, baseline, num_layers)
+        out = _rescale_metrics_with_baseline(out, baseline, num_layers, all_layers)
     result: Dict[str, Union[List[float], str]] = {k: np.asarray(v).tolist() for k, v in out.items()}
     if return_hash:
         result["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"

@@ -119,6 +119,12 @@ class Metric:
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = None
+    # True when ``compute`` needs concrete values (host-side control flow or
+    # numpy kernels) and therefore cannot be traced into a fused collection
+    # program. Subclasses/mixins with conditionally host-side computes may
+    # override this as a property (e.g. bounded sample buffers, whose
+    # collection branches on a concrete count).
+    _compute_is_host_side: bool = False
 
     def __init__(
         self,

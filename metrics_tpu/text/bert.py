@@ -91,7 +91,9 @@ class BERTScore(Metric):
         elif self._forward is not None:
             raise ValueError("`user_tokenizer` must be provided together with a user `model`")
         else:
-            self._forward, self.tokenizer = _default_hf_model(model_name_or_path, max_length)
+            self._forward, self.tokenizer = _default_hf_model(
+                model_name_or_path, max_length, num_layers, all_layers
+            )
 
         self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
         self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
@@ -139,6 +141,7 @@ class BERTScore(Metric):
             return_hash=self.return_hash,
             model_name_or_path=self.model_name_or_path,
             num_layers=self.num_layers,
+            all_layers=self.all_layers,
             lang=self.lang,
             rescale_with_baseline=self.rescale_with_baseline,
             baseline_path=self.baseline_path,

@@ -107,6 +107,14 @@ class _BoundedSampleBufferMixin:
             for (name, _, _), value in zip(self._buffer_specs, rows):
                 getattr(self, name).append(value)
 
+    @property
+    def _compute_is_host_side(self) -> bool:
+        """Bounded collection branches on the concrete ``count`` (overflow
+        check + trim in :meth:`_bounded_collect`), so compute cannot join a
+        fused collection trace; the unbounded list path is already excluded
+        from fusing by ``_has_list_state``."""
+        return getattr(self, "buffer_capacity", None) is not None
+
     def _collect_samples(self) -> Tuple[Array, ...]:
         if self.buffer_capacity is not None:
             return self._bounded_collect()

@@ -379,6 +379,111 @@ def test_compute_fused_mixes_with_list_state_member():
         np.testing.assert_allclose(np.asarray(got[k]), np.asarray(m.compute()), rtol=1e-6, err_msg=k)
 
 
+def test_compute_fused_survives_bounded_member():
+    """Advisor r4 (medium): a buffer_capacity member's compute is host-side
+    (concrete-count trim); it must be excluded from the fused compute UP FRONT
+    — not trip the trace and permanently disable fused compute for everyone."""
+    mc = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=4),
+            "f1": F1Score(num_classes=4, average="macro"),
+            "auroc": AUROC(num_classes=4, buffer_capacity=256),
+        }
+    )
+    rng = np.random.RandomState(11)
+    p = jnp.asarray(rng.rand(48, 4).astype(np.float32))
+    p = p / p.sum(-1, keepdims=True)
+    t = jnp.asarray(rng.randint(0, 4, 48))
+    mc.update(p, t)
+    got = mc.compute()
+    assert not mc._fused_cmp_failed  # the bounded member must not defeat fusing
+    assert mc._fused_cmp_fn is not None  # fused program ran for acc+f1
+    assert set(mc._fused_cmp_keys) == {"acc", "f1"}
+    singles = {
+        "acc": Accuracy(num_classes=4),
+        "f1": F1Score(num_classes=4, average="macro"),
+        "auroc": AUROC(num_classes=4),  # unbounded oracle
+    }
+    for m in singles.values():
+        m.update(p, t)
+    for k, m in singles.items():
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(m.compute()), rtol=1e-6, err_msg=k)
+
+
+class _HostComputeMean(Metric):
+    """Traceable update, but a compute that concretizes — the shape of a
+    host-side compute the static fusable checks can't see."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds, target):
+        self.total = self.total + jnp.sum(preds)
+        self.count = self.count + preds.size
+
+    def compute(self):
+        if float(self.count) == 0:  # concretizes under trace
+            return jnp.asarray(0.0)
+        return self.total / self.count
+
+
+def test_compute_fused_excludes_only_the_offender():
+    """When an unforeseen host-side compute breaks the fused trace, only that
+    member is excluded (probed via eval_shape); the rest keep the fused path
+    on the retry and on later computes."""
+    mc = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "host": _HostComputeMean(),
+        }
+    )
+    batches = _batches(n=2, seed=21)
+    p, t = batches[0]
+    mc.update(p, t)
+    got = mc.compute()
+    assert mc._fused_cmp_excluded == {"host"}
+    assert not mc._fused_cmp_failed
+    assert set(mc._fused_cmp_keys) == {"acc", "f1"}  # fused retry engaged
+    acc, f1 = Accuracy(num_classes=NUM_CLASSES), F1Score(num_classes=NUM_CLASSES, average="macro")
+    acc.update(p, t)
+    f1.update(p, t)
+    np.testing.assert_allclose(np.asarray(got["acc"]), np.asarray(acc.compute()), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["f1"]), np.asarray(f1.compute()), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(got["host"]), float(jnp.sum(p)) / p.size, rtol=1e-6
+    )
+    # later computes go straight to the fused program minus the offender
+    p2, t2 = batches[1]
+    mc.update(p2, t2)
+    acc.update(p2, t2)
+    got2 = mc.compute()
+    assert set(mc._fused_cmp_keys) == {"acc", "f1"}
+    np.testing.assert_allclose(np.asarray(got2["acc"]), np.asarray(acc.compute()), rtol=1e-6)
+
+
+def test_compute_fused_offender_retry_warns_once():
+    """The offender-exclusion retry must not duplicate the
+    compute-before-update warnings already emitted this call."""
+    import warnings
+
+    mc = MetricCollection(
+        {"m1": MeanMetric(), "m2": MeanMetric(), "host": _HostComputeMean()}
+    )
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        mc.compute()
+    assert mc._fused_cmp_excluded == {"host"}  # the retry actually happened
+    texts = [str(w.message) for w in caught if "was called before the ``update``" in str(w.message)]
+    # the retained members warn exactly once despite the retry; the offender
+    # may warn once more from its per-member fallback (two genuine attempts
+    # on the one transition call)
+    assert sum("MeanMetric" in t for t in texts) == 2, texts
+    assert 1 <= sum("_HostComputeMean" in t for t in texts) <= 2, texts
+
+
 def test_compute_fused_warns_before_update():
     import warnings
 

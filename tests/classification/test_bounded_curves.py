@@ -209,3 +209,26 @@ def test_bounded_multilabel_micro_ap_needs_no_declaration():
     bounded.update(jnp.asarray(P), jnp.asarray(T))
     plain.update(jnp.asarray(P), jnp.asarray(T))
     np.testing.assert_allclose(np.asarray(bounded.compute()), np.asarray(plain.compute()), atol=1e-7)
+
+
+def test_bounded_micro_ap_accepts_multilabel_flag_without_num_classes():
+    """Advisor r4: micro's 1-D buffers need no num_classes, so passing the
+    multilabel flag (with or without num_classes) must not trip the
+    non-micro spec validation."""
+    rng = np.random.RandomState(10)
+    P, T = _ml_data(rng)
+    # exact advisor reproduction: average='micro', buffer_capacity, multilabel=True
+    flagged = AveragePrecision(
+        num_classes=3, average="micro", buffer_capacity=256, multilabel=True
+    )
+    # and the documented contract taken at its word: no declaration at all
+    bare = AveragePrecision(average="micro", buffer_capacity=256, multilabel=True)
+    plain = AveragePrecision(num_classes=3, average="micro")
+    for m in (flagged, bare, plain):
+        m.update(jnp.asarray(P), jnp.asarray(T))
+    want = np.asarray(plain.compute())
+    np.testing.assert_allclose(np.asarray(flagged.compute()), want, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(bare.compute()), want, atol=1e-7)
+    # the unbounded flag misuse still errors exactly like the sibling classes
+    with pytest.raises(ValueError, match="buffer_capacity"):
+        AveragePrecision(average="micro", multilabel=True)

@@ -242,6 +242,20 @@ class TestBertScoreRescaleBaseline:
         for k in ("precision", "recall", "f1"):
             np.testing.assert_allclose(res[k], direct[k], atol=1e-6, err_msg=k)
 
+    def test_baseline_csv_extra_columns_rejected(self, tmp_path):
+        """Advisor r4: a 5+-column file must be rejected, not silently sliced —
+        the error text promises exactly `layer_idx, precision, recall, f1`."""
+        path = tmp_path / "malformed.csv"
+        with open(path, "w") as f:
+            f.write("LAYER,P,R,F,EXTRA\n")
+            for i, (p, r, f1) in enumerate(_BASELINE_ROWS):
+                f.write(f"{i},{p},{r},{f1},0.99\n")
+        with pytest.raises(ValueError, match="exactly"):
+            bert_score(
+                PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer, max_length=MAX_LEN,
+                rescale_with_baseline=True, baseline_path=str(path),
+            )
+
     def test_csv_reader_and_rescale_match_reference(self, tmp_path, tm):
         """Our CSV parse + rescale pinned against the ACTUAL reference helpers
         (`_read_csv_from_local_file` bert.py:396, `_rescale_metrics_with_baseline`
@@ -276,3 +290,130 @@ class TestBertScoreRescaleBaseline:
             np.testing.assert_allclose(ours["precision"], ref_p.numpy(), atol=1e-6)
             np.testing.assert_allclose(ours["recall"], ref_r.numpy(), atol=1e-6)
             np.testing.assert_allclose(ours["f1"], ref_f.numpy(), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# all_layers: per-layer scores + per-layer baseline rescale (reference
+# bert.py:320-325 stacking, :448-452 baseline broadcast)
+# ---------------------------------------------------------------------------
+N_LAYERS = len(_BASELINE_ROWS)
+
+
+def toy_model_layers(input_ids, attention_mask):
+    """Own-model all_layers contract: ``[num_layers, N, L, d]``. Layer k is a
+    deterministic distortion of the base embedding so layers score apart."""
+    base = np.asarray(toy_model(input_ids, attention_mask))
+    layers = [base * (1.0 + 0.3 * k) + 0.05 * k for k in range(N_LAYERS)]
+    return jnp.asarray(np.stack(layers, axis=0) * np.asarray(attention_mask)[None, ..., None])
+
+
+class TestBertScoreAllLayers:
+    def test_per_layer_scores_match_single_layer_runs(self):
+        """Row k of the stacked output == a plain run with an encoder that
+        returns layer k alone."""
+        res = bert_score(
+            PREDS, TARGETS, model=toy_model_layers, user_tokenizer=toy_tokenizer,
+            max_length=MAX_LEN, all_layers=True,
+        )
+        for key in ("precision", "recall", "f1"):
+            assert np.asarray(res[key]).shape == (N_LAYERS, len(PREDS))
+        for k in range(N_LAYERS):
+
+            def single(input_ids, attention_mask, _k=k):
+                return toy_model_layers(input_ids, attention_mask)[_k]
+
+            ref = bert_score(
+                PREDS, TARGETS, model=single, user_tokenizer=toy_tokenizer, max_length=MAX_LEN
+            )
+            for key in ("precision", "recall", "f1"):
+                np.testing.assert_allclose(
+                    np.asarray(res[key])[k], ref[key], atol=1e-6, err_msg=f"layer {k} {key}"
+                )
+
+    def test_all_layers_chunking_exact(self):
+        full = bert_score(PREDS, TARGETS, model=toy_model_layers, user_tokenizer=toy_tokenizer,
+                          max_length=MAX_LEN, all_layers=True)
+        chunked = bert_score(PREDS, TARGETS, model=toy_model_layers, user_tokenizer=toy_tokenizer,
+                             max_length=MAX_LEN, all_layers=True, batch_size=2)
+        for key in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(chunked[key], full[key], atol=1e-7, err_msg=key)
+
+    def test_all_layers_rescale_per_layer_rows(self, tmp_path):
+        """VERDICT r4 item 6: layer k rescales by baseline row k."""
+        path = _write_baseline_csv(tmp_path / "baseline.csv")
+        raw = bert_score(PREDS, TARGETS, model=toy_model_layers, user_tokenizer=toy_tokenizer,
+                         max_length=MAX_LEN, all_layers=True)
+        res = bert_score(PREDS, TARGETS, model=toy_model_layers, user_tokenizer=toy_tokenizer,
+                         max_length=MAX_LEN, all_layers=True,
+                         rescale_with_baseline=True, baseline_path=path)
+        for col, key in enumerate(("precision", "recall", "f1")):
+            for k in range(N_LAYERS):
+                b = _BASELINE_ROWS[k][col]
+                expected = (np.asarray(raw[key])[k] - b) / (1 - b)
+                np.testing.assert_allclose(
+                    np.asarray(res[key])[k], expected, atol=1e-8, err_msg=f"layer {k} {key}"
+                )
+
+    def test_all_layers_rescale_matches_reference(self, tmp_path, tm):
+        """Our all_layers rescale pinned against the ACTUAL reference
+        `_rescale_metrics_with_baseline(..., all_layers=True)` on the same
+        CSV and the same [num_layers, n] scores."""
+        import torch
+
+        from metrics_tpu.functional.text.bert import _read_baseline_csv, _rescale_metrics_with_baseline
+        from torchmetrics.functional.text.bert import (
+            _read_csv_from_local_file,
+            _rescale_metrics_with_baseline as ref_rescale,
+        )
+
+        path = _write_baseline_csv(tmp_path / "baseline.csv")
+        ours_baseline = _read_baseline_csv(path)
+        ref_baseline = _read_csv_from_local_file(path)
+        rng = np.random.default_rng(11)
+        scores = {k: rng.uniform(0.5, 1.0, size=(N_LAYERS, 5)) for k in ("precision", "recall", "f1")}
+        ours = _rescale_metrics_with_baseline(scores, ours_baseline, None, all_layers=True)
+        ref_p, ref_r, ref_f = ref_rescale(
+            torch.from_numpy(scores["precision"]),
+            torch.from_numpy(scores["recall"]),
+            torch.from_numpy(scores["f1"]),
+            ref_baseline.double(),
+            num_layers=None,
+            all_layers=True,
+        )
+        np.testing.assert_allclose(ours["precision"], ref_p.numpy(), atol=1e-6)
+        np.testing.assert_allclose(ours["recall"], ref_r.numpy(), atol=1e-6)
+        np.testing.assert_allclose(ours["f1"], ref_f.numpy(), atol=1e-6)
+
+    @pytest.mark.parametrize("n_rows", [1, 5])
+    def test_all_layers_baseline_row_mismatch_raises(self, tmp_path, n_rows):
+        """Exact row==layer match required either way: a too-LONG baseline
+        (e.g. from a deeper model) would silently rescale with wrong rows."""
+        path = tmp_path / "mismatch.csv"
+        with open(path, "w") as f:
+            f.write("LAYER,P,R,F\n")
+            for i in range(n_rows):  # != 3 layers
+                f.write(f"{i},0.3,0.35,0.32\n")
+        with pytest.raises(ValueError, match="baseline row per layer"):
+            bert_score(PREDS, TARGETS, model=toy_model_layers, user_tokenizer=toy_tokenizer,
+                       max_length=MAX_LEN, all_layers=True,
+                       rescale_with_baseline=True, baseline_path=str(path))
+
+    def test_all_layers_wrong_rank_raises(self):
+        with pytest.raises(ValueError, match="rank-4"):
+            bert_score(PREDS, TARGETS, model=toy_model, user_tokenizer=toy_tokenizer,
+                       max_length=MAX_LEN, all_layers=True)
+        with pytest.raises(ValueError, match="rank-3"):
+            bert_score(PREDS, TARGETS, model=toy_model_layers, user_tokenizer=toy_tokenizer,
+                       max_length=MAX_LEN)
+
+    def test_module_api_all_layers(self, tmp_path):
+        path = _write_baseline_csv(tmp_path / "baseline.csv")
+        metric = BERTScore(model=toy_model_layers, user_tokenizer=toy_tokenizer, max_length=MAX_LEN,
+                           all_layers=True, rescale_with_baseline=True, baseline_path=path)
+        metric.update(PREDS, TARGETS)
+        res = metric.compute()
+        direct = bert_score(PREDS, TARGETS, model=toy_model_layers, user_tokenizer=toy_tokenizer,
+                            max_length=MAX_LEN, all_layers=True,
+                            rescale_with_baseline=True, baseline_path=path)
+        for k in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(res[k], direct[k], atol=1e-6, err_msg=k)
