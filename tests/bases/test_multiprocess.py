@@ -1,14 +1,23 @@
-"""Real 2-process distributed sync tests.
+"""Real multi-process distributed sync tests (3 OS processes).
 
-Parity target: reference ``tests/bases/test_ddp.py:104-112`` +
-``tests/helpers/testers.py:47-59`` (2-process gloo pool). Spawns two OS
-processes running ``tests/helpers/mp_worker.py`` under
-``jax.distributed.initialize`` (CPU, Gloo collectives) and asserts the key
-invariant — distributed ``compute()`` == serial oracle — through the *actual*
-host-level gather (``parallel/comm.gather_all_arrays``), including uneven cat
-buffers, the ``dist_reduce_fx=None`` stack path (Pearson merge), and the
-detection mAP ragged sync. The in-worker asserts additionally cover the raw
-comm layer (even + pad/trim uneven gathers).
+Parity target: reference ``tests/bases/test_ddp.py:62-112`` +
+``tests/helpers/testers.py:47-59`` (2-process gloo pool; ours runs THREE
+processes so a proper-subset ``ProcessGroup`` can sync while a non-member
+rank runs concurrently). Spawns workers running ``tests/helpers/mp_worker.py``
+under ``jax.distributed.initialize`` (CPU, Gloo collectives) and asserts the
+key invariant — distributed ``compute()`` == serial oracle — through the
+*actual* host-level gather (``parallel/comm.gather_all_arrays``), across:
+
+1. even counter states (Accuracy),
+2. cat states with uneven batch counts (Spearman),
+3. cat states with different per-rank buffer LENGTHS (CatMetric, rank-major
+   order invariant),
+4. ``dist_reduce_fx=None`` stack path (Pearson parallel merge),
+5. ragged detection mAP sync,
+6. ``MetricCollection`` end-to-end (members sync inside one compute()),
+7. world-spanning / proper-subset / singleton ``ProcessGroup`` syncs, the
+   subset concurrent with a busy non-member,
+plus in-worker asserts on the raw comm layer (even + pad/trim uneven gathers).
 """
 import os
 import pathlib
@@ -19,11 +28,15 @@ import sys
 import numpy as np
 import pytest
 
-from tests.helpers.mp_worker import run_scenarios
+from tests.helpers.mp_worker import make_inputs, run_scenarios
 
-WORLD = 2
+WORLD = 3
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 WORKER = os.path.join(REPO_ROOT, "tests", "helpers", "mp_worker.py")
+
+# keys deliberately NOT present on (or equal across) every rank: the subset
+# scenario gives member and non-member ranks different keys by design
+_ASYMMETRIC_KEYS = {"pg_subset_accuracy", "pg_nonmember_accuracy"}
 
 
 def _free_port() -> int:
@@ -53,7 +66,7 @@ def worker_results(tmp_path_factory):
         )
         for rank in range(WORLD)
     ]
-    deadline = 600
+    deadline = 300 * WORLD  # ranks time-slice the 1-core build box
     try:
         for p in procs:
             p.wait(timeout=deadline)
@@ -78,27 +91,52 @@ def serial_oracle():
     return run_scenarios(rank=0, world=1)  # all data, single process
 
 
-def test_all_ranks_agree(worker_results):
-    """Post-sync compute() must be identical on every rank."""
-    keys = set(worker_results[0])
-    assert keys == set(worker_results[1]) and keys, keys
-    for key in keys:
-        np.testing.assert_allclose(
-            worker_results[0][key], worker_results[1][key], rtol=1e-12, atol=1e-12, err_msg=key
-        )
-
-
-@pytest.mark.parametrize("scenario", ["accuracy", "spearman", "pearson"])
-def test_distributed_equals_serial(worker_results, serial_oracle, scenario):
+def _tolerances():
     # x32 lane: the gathered-shard accumulation order differs from serial, so
     # f32 rounding shows up at ~1e-6 relative; f64 stays near-exact
     from tests.helpers.testers import X32_LANE
 
-    rtol, atol = (2e-5, 1e-6) if X32_LANE else (1e-9, 1e-10)
+    return (2e-5, 1e-6) if X32_LANE else (1e-9, 1e-10)
+
+
+def test_all_ranks_agree(worker_results):
+    """Post-sync compute() must be identical on every rank (the deliberately
+    rank-asymmetric subset keys excepted — they're asserted separately)."""
+    common = set.intersection(*(set(r) for r in worker_results))
+    assert common, [sorted(r) for r in worker_results]
+    for rank_result in worker_results:
+        assert set(rank_result) - common <= _ASYMMETRIC_KEYS, sorted(rank_result)
+    for key in common - _ASYMMETRIC_KEYS:
+        for rank in range(1, WORLD):
+            np.testing.assert_allclose(
+                worker_results[0][key], worker_results[rank][key],
+                rtol=1e-12, atol=1e-12, err_msg=key,
+            )
+
+
+@pytest.mark.parametrize("scenario", ["accuracy", "spearman", "pearson", "coll_acc", "coll_f1"])
+def test_distributed_equals_serial(worker_results, serial_oracle, scenario):
+    rtol, atol = _tolerances()
     for rank in range(WORLD):
         np.testing.assert_allclose(
             worker_results[rank][scenario], serial_oracle[scenario], rtol=rtol, atol=atol,
             err_msg=f"{scenario} rank{rank}",
+        )
+
+
+def test_cat_uneven_lengths_rank_major(worker_results):
+    """CatMetric rows have different lengths per batch, so every rank's total
+    buffer length differs; the synced result must be all rows in rank-major
+    batch order (the reference's cat-sync contract, test_ddp.py:62-80)."""
+    batches = make_inputs()["cat_batches"]
+    per_rank_len = [sum(len(batches[i]) for i in range(r, len(batches), WORLD)) for r in range(WORLD)]
+    assert len(set(per_rank_len)) > 1, per_rank_len  # lengths genuinely differ
+    order = [i for r in range(WORLD) for i in range(r, len(batches), WORLD)]
+    expected = np.concatenate([batches[i] for i in order])
+    rtol, atol = _tolerances()
+    for rank in range(WORLD):
+        np.testing.assert_allclose(
+            worker_results[rank]["cat"], expected, rtol=rtol, atol=atol, err_msg=f"rank{rank}"
         )
 
 
@@ -112,3 +150,38 @@ def test_map_ragged_sync_equals_serial(worker_results, serial_oracle):
                 worker_results[rank][key], serial_oracle[key], rtol=1e-9, atol=1e-10,
                 err_msg=f"{key} rank{rank}",
             )
+
+
+def test_subset_group_sync_with_concurrent_nonmember(worker_results):
+    """Ranks {0, 2} sync a pair ProcessGroup while rank 1 concurrently runs
+    its own singleton-group sync: members must agree and equal the oracle on
+    the members' shards only; the non-member must equal ITS shard's oracle."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+
+    data = make_inputs()
+    members, nonmember = [0, WORLD - 1], 1
+
+    def shard_oracle(ranks):
+        acc = Accuracy(num_classes=5)
+        acc._to_sync = False
+        for r in ranks:
+            for i in range(r, len(data["acc_preds"]), WORLD):
+                acc.update(jnp.asarray(data["acc_preds"][i]), jnp.asarray(data["acc_target"][i]))
+        return np.asarray(acc.compute())
+
+    rtol, atol = _tolerances()
+    want_members = shard_oracle(members)
+    got = [worker_results[r]["pg_subset_accuracy"] for r in members]
+    np.testing.assert_allclose(got[0], got[1], rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(got[0], want_members, rtol=rtol, atol=atol)
+    # the subset result must differ from the full-world sync (else the test
+    # would pass even if the group silently spanned everyone)
+    assert not np.allclose(got[0], worker_results[0]["accuracy"], rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(
+        worker_results[nonmember]["pg_nonmember_accuracy"],
+        shard_oracle([nonmember]),
+        rtol=rtol,
+        atol=atol,
+    )

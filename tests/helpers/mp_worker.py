@@ -31,6 +31,9 @@ def make_inputs():
         # dist_reduce_fx=None stack path (Chan merge)
         "pe_preds": rng.normal(size=(6, 24)),
         "pe_target": rng.normal(size=(6, 24)),
+        # cat-state rows of DIFFERENT lengths per batch: every rank's total
+        # buffer length differs, so the pad-to-max/trim gather is load-bearing
+        "cat_batches": [rng.normal(size=(3 + 2 * i,)) for i in range(6)],
     }
     # ragged detection inputs: 4 images, variable box counts; predictions are
     # jittered copies of the ground truth (plus one spurious box) so mAP is
@@ -63,7 +66,10 @@ def run_scenarios(rank: int, world: int):
 
     from metrics_tpu import (
         Accuracy,
+        CatMetric,
+        F1Score,
         MeanAveragePrecision,
+        MetricCollection,
         PearsonCorrCoef,
         SpearmanCorrCoef,
     )
@@ -75,6 +81,24 @@ def run_scenarios(rank: int, world: int):
     for i in range(rank, len(data["acc_preds"]), world):
         acc.update(jnp.asarray(data["acc_preds"][i]), jnp.asarray(data["acc_target"][i]))
     out["accuracy"] = np.asarray(acc.compute())
+
+    # cat-state gather with different per-rank total buffer lengths; the
+    # synced result is every rank's rows in rank-major batch order
+    cat = CatMetric()
+    for i in range(rank, len(data["cat_batches"]), world):
+        cat.update(jnp.asarray(data["cat_batches"][i]))
+    out["cat"] = np.asarray(cat.compute())
+
+    # MetricCollection end-to-end: ONE collection whose members sync through
+    # the real host-level path inside a single compute() call
+    coll = MetricCollection(
+        {"acc": Accuracy(num_classes=5), "f1": F1Score(num_classes=5, average="macro")}
+    )
+    for i in range(rank, len(data["acc_preds"]), world):
+        coll.update(jnp.asarray(data["acc_preds"][i]), jnp.asarray(data["acc_target"][i]))
+    coll_res = coll.compute()
+    out["coll_acc"] = np.asarray(coll_res["acc"])
+    out["coll_f1"] = np.asarray(coll_res["f1"])
 
     sp = SpearmanCorrCoef()
     for i in range(rank, len(data["sp_preds"]), world):  # 5 batches -> uneven cat buffers
@@ -171,6 +195,26 @@ def _subgroup_scenarios(rank: int, world: int, data, base):
         np.asarray(acc_solo.compute()), np.asarray(acc_plain.compute()), rtol=1e-12, atol=0,
         err_msg="singleton ProcessGroup must equal the local un-synced value",
     )
+
+    if world >= 3:
+        # PROPER subset sync with a non-member running concurrently
+        # (VERDICT r4 item 5): ranks {0, last} sync a pair group while the
+        # middle rank concurrently does its own singleton-group sync — the
+        # KV-store exchanges must not cross group boundaries, and neither
+        # side may block on the other.
+        members = [0, world - 1]
+        if rank in members:
+            pair = new_group(members, name="pair_edges")
+            acc_pair = Accuracy(num_classes=5, process_group=pair)
+            for i in range(rank, len(data["acc_preds"]), world):
+                acc_pair.update(jnp.asarray(data["acc_preds"][i]), jnp.asarray(data["acc_target"][i]))
+            out["pg_subset_accuracy"] = np.asarray(acc_pair.compute())
+        else:
+            mine = new_group([rank], name=f"concurrent_nonmember{rank}")
+            acc_mine = Accuracy(num_classes=5, process_group=mine)
+            for i in range(rank, len(data["acc_preds"]), world):
+                acc_mine.update(jnp.asarray(data["acc_preds"][i]), jnp.asarray(data["acc_target"][i]))
+            out["pg_nonmember_accuracy"] = np.asarray(acc_mine.compute())
     return out
 
 
@@ -239,6 +283,15 @@ def main():
     _comm_layer_asserts(rank, world)
     out = run_scenarios(rank, world)
     np.savez(f"{outdir}/rank{rank}.npz", **out)
+
+    # exit barrier: the subset scenario lets ranks finish at different times;
+    # a rank exiting while peers are still inside a KV gather would tear down
+    # the coordinator under them
+    import jax.numpy as jnp
+
+    from metrics_tpu.parallel import comm
+
+    comm.gather_all_arrays(jnp.zeros(1))
 
 
 if __name__ == "__main__":

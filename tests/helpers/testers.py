@@ -306,12 +306,14 @@ class MetricTester:
     ) -> None:
         """Drive all ranks step by step with ``dist_sync_on_step=True``.
 
-        At each step, rank 0's ``forward`` runs the full-state dance with a
-        gather that serves every rank's BATCH-only state (what each peer's
-        dance would publish at that moment); the returned batch value must
-        equal the oracle on the step's concatenated cross-rank batch. Other
-        ranks accumulate plainly, so the final ``compute`` sync (run by the
-        caller) still covers all batches.
+        At each step, EVERY rank's ``forward`` runs the full-state dance (the
+        reference implicitly runs the dance on every rank every step,
+        reference ``testers.py:177-213``) against a gather that serves every
+        rank's BATCH-only state (what each peer's dance publishes at that
+        moment). The per-step batch value syncs across ranks, so every rank's
+        returned value must equal the oracle on the step's concatenated
+        cross-rank batch — rank-asymmetric state bugs fail here where a
+        rank-0-only dance could not (VERDICT r4 item 4).
         """
         world_size = len(rank_metrics)
         steps = NUM_BATCHES // world_size
@@ -319,7 +321,8 @@ class MetricTester:
             batch_idx = [rank + s * world_size for rank in range(world_size)]
             # per-rank BATCH-only metrics: their states are what each peer's
             # forward dance would publish at this step, served through the
-            # same replay gather the final compute sync uses
+            # same replay gather the final compute sync uses (it cycles, so
+            # one snapshot serves all world_size dances of this step)
             batch_metrics = []
             for i in batch_idx:
                 tmp = metric_class(**metric_args)
@@ -328,18 +331,15 @@ class MetricTester:
                 batch_metrics.append(tmp)
             gather = _fake_gather_factory(batch_metrics)
 
-            m0 = rank_metrics[0]
-            m0.dist_sync_fn = gather
-            m0._distributed_available_fn = lambda: True
-            bk0 = {k: v[batch_idx[0]] if _is_batched(v) else v for k, v in kwargs_update.items()}
-            batch_result = m0(preds[batch_idx[0]], target[batch_idx[0]], **bk0)
-            m0.dist_sync_fn = None
-            m0._distributed_available_fn = None
-
-            for rank in range(1, world_size):
+            batch_results = []
+            for rank, metric in enumerate(rank_metrics):
+                metric.dist_sync_fn = gather
+                metric._distributed_available_fn = lambda: True
                 i = batch_idx[rank]
                 bk = {k: v[i] if _is_batched(v) else v for k, v in kwargs_update.items()}
-                rank_metrics[rank].update(preds[i], target[i], **bk)
+                batch_results.append(metric(preds[i], target[i], **bk))
+                metric.dist_sync_fn = None
+                metric._distributed_available_fn = None
 
             if check_batch:
                 step_kwargs = {
@@ -351,7 +351,14 @@ class MetricTester:
                     np.concatenate([np.asarray(target[i]) for i in batch_idx], axis=0),
                     **step_kwargs,
                 )
-                _assert_allclose(batch_result, sk_step, atol=self.atol)
+                for rank, batch_result in enumerate(batch_results):
+                    try:
+                        _assert_allclose(batch_result, sk_step, atol=self.atol)
+                    except AssertionError as err:
+                        raise AssertionError(
+                            f"rank {rank} batch value diverged from the cross-rank"
+                            f" oracle at step {s}"
+                        ) from err
 
         for rank in range(world_size):  # leftover batches accumulate plainly
             for i in range(steps * world_size + rank, NUM_BATCHES, world_size):
