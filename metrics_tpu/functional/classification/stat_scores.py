@@ -41,13 +41,22 @@ def _stat_scores(
     else:  # samples
         dim = 1
 
-    true_pred, false_pred = target == preds, target != preds
-    pos_pred, neg_pred = preds == 1, preds == 0
-
-    tp = jnp.sum(true_pred & pos_pred, axis=dim)
-    fp = jnp.sum(false_pred & pos_pred, axis=dim)
-    tn = jnp.sum(true_pred & neg_pred, axis=dim)
-    fn = jnp.sum(false_pred & neg_pred, axis=dim)
+    # For 0/1 inputs the four counts are linear in three sums — one fused
+    # pass over preds/target instead of four masked reductions (the
+    # reference's equality-mask decomposition, stat_scores.py:44-60, reads
+    # both [N, C] operands four times):
+    #   tp = Σ pt,  fp = Σ p − tp,  fn = Σ t − tp,  tn = count − Σp − Σt + tp
+    p = preds.astype(jnp.int32)
+    t = target.astype(jnp.int32)
+    tp = jnp.sum(p * t, axis=dim)
+    sum_p = jnp.sum(p, axis=dim)
+    sum_t = jnp.sum(t, axis=dim)
+    count = 1
+    for d in (dim if isinstance(dim, tuple) else (dim,)):
+        count *= preds.shape[d]
+    fp = sum_p - tp
+    fn = sum_t - tp
+    tn = count - sum_p - sum_t + tp
 
     return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
 
