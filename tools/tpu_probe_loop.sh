@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Recurring TPU-tunnel probe (VERDICT r4 item 1: certify every attempt).
+#
+# Appends one JSON line per attempt to TPU_PROBE_r05.jsonl. On the first
+# healthy probe it also writes TPU_WINDOW_OPEN as a sentinel the builder
+# polls between milestones to trigger the short-first bench schedule.
+#
+# Each attempt allows 300s: round-4/5 wedge symptom is jax.devices() hanging
+# indefinitely inside axon backend init, so a generous timeout separates
+# "slow init" from "wedged". Probes are idle-waits while wedged (blocked in
+# RPC), so the 1-core box stays usable for tests between attempts.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG="$REPO/TPU_PROBE_r05.jsonl"
+INTERVAL="${PROBE_INTERVAL_S:-900}"
+while true; do
+    start=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+    t0=$(date +%s)
+    out=$(timeout 300 python - <<'EOF' 2>&1
+import jax
+d = jax.devices()
+print("PROBE_OK", jax.default_backend(), len(d), d[0].device_kind if d else "none")
+EOF
+)
+    rc=$?
+    dt=$(( $(date +%s) - t0 ))
+    line=$(printf '%s' "$out" | grep PROBE_OK || true)
+    if [ -n "$line" ]; then
+        plat=$(printf '%s' "$line" | awk '{print $2}')
+        ndev=$(printf '%s' "$line" | awk '{print $3}')
+        kind=$(printf '%s' "$line" | awk '{$1=$2=$3=""; sub(/^ +/,""); print}')
+        echo "{\"t\": \"$start\", \"ok\": true, \"platform\": \"$plat\", \"n_devices\": $ndev, \"device_kind\": \"$kind\", \"probe_s\": $dt}" >> "$LOG"
+        if [ "$plat" != "cpu" ]; then
+            touch "$REPO/TPU_WINDOW_OPEN"
+        fi
+    else
+        echo "{\"t\": \"$start\", \"ok\": false, \"rc\": $rc, \"probe_s\": $dt, \"note\": \"timeout=wedged axon init\"}" >> "$LOG"
+    fi
+    sleep "$INTERVAL"
+done
