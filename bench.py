@@ -1206,6 +1206,12 @@ def _run_config(name: str, timeout_s: int, needs_accel: bool, persisted: dict) -
         live_error = result["error"]
     else:
         live_error = backend_error
+    def _flagged(entry: dict, source: str) -> dict:
+        out = dict(entry)
+        out["source"] = source
+        out["fallback_reason"] = live_error[:160]
+        return out
+
     prior = persisted.get(name)
     head = _code_version()
     prior_version = prior.get("code_version") if prior is not None else None
@@ -1216,18 +1222,12 @@ def _run_config(name: str, timeout_s: int, needs_accel: bool, persisted: dict) -
         prior_version and head and prior_version == head and "-dirty" not in prior_version
     )
     if prior is not None and fresh:
-        fallback = dict(prior)
-        fallback["source"] = "persisted_from_healthy_window"
-        fallback["fallback_reason"] = live_error[:160]
-        return fallback
+        return _flagged(prior, "persisted_from_healthy_window")
     if prior is not None and prior.get("platform") not in (None, "cpu"):
         # stale but accelerator-stamped: a flagged TPU number from an older
         # commit still beats a fresh CPU re-measure — don't discard the one
         # artifact the exercise is graded on
-        fallback = dict(prior)
-        fallback["source"] = "persisted_stale_code_version"
-        fallback["fallback_reason"] = live_error[:160]
-        return fallback
+        return _flagged(prior, "persisted_stale_code_version")
     # stale cpu-stamped entries are only used LAST, below — a re-measure beats them
     if name in _CPU_FALLBACK_OK:
         # no trustworthy persisted number: a pinned-CPU run (platform stamp
@@ -1238,14 +1238,9 @@ def _run_config(name: str, timeout_s: int, needs_accel: bool, persisted: dict) -
         result = _run_isolated(name, timeout_s, extra_env=extra)
         if "error" not in result:
             result["measured_at"] = _now_iso()
-            result["source"] = "cpu_fallback"
-            result["fallback_reason"] = live_error[:160]
-            return result
+            return _flagged(result, "cpu_fallback")
     if prior is not None:  # stale number, flagged as such — beats an error line
-        fallback = dict(prior)
-        fallback["source"] = "persisted_stale_code_version"
-        fallback["fallback_reason"] = live_error[:160]
-        return fallback
+        return _flagged(prior, "persisted_stale_code_version")
     return {"metric": name, "error": live_error}
 
 

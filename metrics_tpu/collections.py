@@ -63,7 +63,13 @@ class MetricCollection:
         self._fused_cmp_keys: Tuple[str, ...] = ()
         self._fused_cmp_fn: Optional[Any] = None
         self._fused_cmp_failed = False
-        self._fused_cmp_excluded: set = set()
+        # key -> member's _update_count when its compute failed the fused
+        # probe. Exclusions taken BEFORE the member's first update (count 0)
+        # are provisional — a pre-update compute() legitimately raises for
+        # many metrics — and are re-tried once the member has real state;
+        # exclusions with state behind them are permanent (genuine host-side
+        # computes would otherwise re-trigger a fused retrace every compute).
+        self._fused_cmp_excluded: Dict[str, int] = {}
         self.add_metrics(metrics, *additional_metrics)
 
     # -- lifecycle ------------------------------------------------------
@@ -267,8 +273,9 @@ class MetricCollection:
             return ()  # host-level sync must run per member inside compute
         keys = []
         for k, m in self._modules.items():
-            if k in self._fused_cmp_excluded:
-                continue
+            excluded_at = self._fused_cmp_excluded.get(k)
+            if excluded_at is not None and (excluded_at > 0 or m._update_count == excluded_at):
+                continue  # permanent (failed with real state) or still pre-update
             if not (m._enable_jit and not m._jit_failed and not m._has_list_state()):
                 continue
             if m._compute_is_host_side:
@@ -329,15 +336,16 @@ class MetricCollection:
 
         try:
             vals = self._fused_cmp_fn(states)
-        except _JIT_FALLBACK_ERRORS:
+        except Exception as fused_err:  # noqa: BLE001 — probed + re-raised below
             for k, m in zip(keys, members):
                 m._restore_state(states[k])
             # Find which member(s) can't trace (host-side compute that slipped
-            # past the static checks) and exclude only those, so one offender
-            # doesn't permanently defeat fused compute for the whole
-            # collection. Probing is trace-only (eval_shape: no compile, no
-            # execute). Only if no individual offender reproduces do we fall
-            # back to the collection-wide flag (interaction failure).
+            # past the static checks — whatever exception type it raises) and
+            # exclude only those, so one offender doesn't permanently defeat
+            # fused compute for the whole collection. Probing is trace-only
+            # (eval_shape: no compile, no execute). A member whose compute
+            # genuinely errors on concrete values too gets excluded here and
+            # surfaces its real error from the per-member fallback instead.
             offenders = set()
             for k, m in zip(keys, members):
                 def _probe(st, member=m):
@@ -346,21 +354,22 @@ class MetricCollection:
 
                 try:
                     jax.eval_shape(_probe, states[k])
-                except _JIT_FALLBACK_ERRORS:
+                except Exception:  # noqa: BLE001 — ANY probe failure marks an offender
                     offenders.add(k)
                 finally:
                     m._restore_state(states[k])
             if offenders:
-                self._fused_cmp_excluded |= offenders
+                for k in offenders:
+                    self._fused_cmp_excluded[k] = self._modules[k]._update_count
                 self._fused_cmp_keys = ()
                 self._fused_cmp_fn = None
                 return self._fused_compute(_warn=False)  # retry without the offenders
-            self._fused_cmp_failed = True
-            return {}
-        except Exception:
-            for k, m in zip(keys, members):
-                m._restore_state(states[k])
-            raise
+            if isinstance(fused_err, _JIT_FALLBACK_ERRORS):
+                # no individual offender reproduces: interaction failure —
+                # collection-wide per-member fallback
+                self._fused_cmp_failed = True
+                return {}
+            raise  # a genuine non-trace error with no offender: surface it
         out: Dict[str, Any] = {}
         for k, m in zip(keys, members):
             m._restore_state(states[k])  # tracers were bound during tracing
@@ -477,7 +486,7 @@ class MetricCollection:
         self._fused_cmp_keys = ()
         self._fused_cmp_fn = None
         self._fused_cmp_failed = False
-        self._fused_cmp_excluded = set()
+        self._fused_cmp_excluded = {}
 
         if isinstance(metrics, dict):
             for name in sorted(metrics.keys()):

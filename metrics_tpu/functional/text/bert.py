@@ -340,10 +340,14 @@ def bert_score(
             )
         )
     # sentence axis is last in both layouts: [n] plain, [num_layers, n] stacked
-    out = {k: np.concatenate([np.asarray(c[k]) for c in chunks], axis=-1) for k in chunks[0]} if chunks else {
-        "precision": np.zeros(0), "recall": np.zeros(0), "f1": np.zeros(0)
-    }
-    if baseline is not None:
+    if chunks:
+        out = {k: np.concatenate([np.asarray(c[k]) for c in chunks], axis=-1) for k in chunks[0]}
+    else:
+        # no sentences: the layer count is unknowable without an encoder
+        # pass, so the stacked layout degenerates to [0, 0] (rank preserved)
+        empty = np.zeros((0, 0)) if all_layers else np.zeros(0)
+        out = {"precision": empty, "recall": empty, "f1": empty}
+    if baseline is not None and np.asarray(out["f1"]).shape[0] > 0:
         out = _rescale_metrics_with_baseline(out, baseline, num_layers, all_layers)
     result: Dict[str, Union[List[float], str]] = {k: np.asarray(v).tolist() for k, v in out.items()}
     if return_hash:

@@ -444,7 +444,7 @@ def test_compute_fused_excludes_only_the_offender():
     p, t = batches[0]
     mc.update(p, t)
     got = mc.compute()
-    assert mc._fused_cmp_excluded == {"host"}
+    assert set(mc._fused_cmp_excluded) == {"host"}
     assert not mc._fused_cmp_failed
     assert set(mc._fused_cmp_keys) == {"acc", "f1"}  # fused retry engaged
     acc, f1 = Accuracy(num_classes=NUM_CLASSES), F1Score(num_classes=NUM_CLASSES, average="macro")
@@ -464,6 +464,70 @@ def test_compute_fused_excludes_only_the_offender():
     np.testing.assert_allclose(np.asarray(got2["acc"]), np.asarray(acc.compute()), rtol=1e-6)
 
 
+class _TracerHostileMean(_HostComputeMean):
+    """Compute that fails under ABSTRACT tracing with an exception OUTSIDE
+    _JIT_FALLBACK_ERRORS — the offender probe must still catch it."""
+
+    def compute(self):
+        import jax.core
+
+        if isinstance(self.total, jax.core.Tracer):
+            raise RuntimeError("this compute needs concrete values")
+        return self.total / self.count
+
+
+def test_compute_fused_excludes_offender_with_foreign_error():
+    """A probe failure of ANY exception type marks the offender; the rest
+    keep the fused path and values stay correct (r5 review finding)."""
+    mc = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "host": _HostComputeMean(),      # trips the fused trace (JIT_FALLBACK type)
+            "hostile": _TracerHostileMean(),  # probe raises RuntimeError
+        }
+    )
+    p, t = _batches(n=1, seed=29)[0]
+    mc.update(p, t)
+    got = mc.compute()
+    assert set(mc._fused_cmp_excluded) == {"host", "hostile"}
+    assert not mc._fused_cmp_failed
+    assert set(mc._fused_cmp_keys) == {"acc", "f1"}
+    acc = Accuracy(num_classes=NUM_CLASSES)
+    acc.update(p, t)
+    np.testing.assert_allclose(np.asarray(got["acc"]), np.asarray(acc.compute()), rtol=1e-6)
+    want_mean = float(jnp.sum(p)) / p.size
+    np.testing.assert_allclose(np.asarray(got["host"]), want_mean, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["hostile"]), want_mean, rtol=1e-6)
+
+
+def test_compute_fused_preupdate_exclusion_heals():
+    """compute() before update() excludes members whose compute raises on
+    default state — that exclusion must be provisional: after real updates
+    the members re-admit and the fused path engages (r5: a one-time user
+    mistake must not permanently cost the 8x compute-latency feature)."""
+    import warnings
+
+    mc = _stat_collection()
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        with pytest.raises(RuntimeError, match="determined mode"):
+            mc.compute()  # pre-update: every member probe-fails
+    assert mc._fused_cmp_excluded  # provisional exclusions recorded
+    assert all(v == 0 for v in mc._fused_cmp_excluded.values())
+    p, t = _batches(n=1, seed=31)[0]
+    mc.update(p, t)
+    got = mc.compute()
+    assert mc._fused_cmp_fn is not None  # fused path re-engaged
+    assert set(mc._fused_cmp_keys) == {"acc", "prec", "rec", "f1", "confmat"}
+    ref = _stat_collection()
+    ref.update(p, t)
+    ref._fused_cmp_failed = True  # per-member oracle
+    want = ref.compute()
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), rtol=1e-7, err_msg=k)
+
+
 def test_compute_fused_offender_retry_warns_once():
     """The offender-exclusion retry must not duplicate the
     compute-before-update warnings already emitted this call."""
@@ -475,7 +539,7 @@ def test_compute_fused_offender_retry_warns_once():
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         mc.compute()
-    assert mc._fused_cmp_excluded == {"host"}  # the retry actually happened
+    assert set(mc._fused_cmp_excluded) == {"host"}  # the retry actually happened
     texts = [str(w.message) for w in caught if "was called before the ``update``" in str(w.message)]
     # the retained members warn exactly once despite the retry; the offender
     # may warn once more from its per-member fallback (two genuine attempts

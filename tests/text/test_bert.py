@@ -406,6 +406,20 @@ class TestBertScoreAllLayers:
             bert_score(PREDS, TARGETS, model=toy_model_layers, user_tokenizer=toy_tokenizer,
                        max_length=MAX_LEN)
 
+    def test_all_layers_empty_inputs(self, tmp_path):
+        """No sentences: empty results in both layouts (the list conversion
+        flattens any empty array to []), and rescale is a clean no-op instead
+        of a 'scores have 0 layers' row-count crash (r5 review finding)."""
+        path = _write_baseline_csv(tmp_path / "baseline.csv")
+        for kwargs in ({}, {"rescale_with_baseline": True, "baseline_path": path}):
+            res = bert_score([], [], model=toy_model_layers, user_tokenizer=toy_tokenizer,
+                             max_length=MAX_LEN, all_layers=True, **kwargs)
+            plain = bert_score([], [], model=toy_model, user_tokenizer=toy_tokenizer,
+                               max_length=MAX_LEN, **kwargs)
+            for key in ("precision", "recall", "f1"):
+                assert res[key] == [], (kwargs, key)
+                assert plain[key] == [], (kwargs, key)
+
     def test_module_api_all_layers(self, tmp_path):
         path = _write_baseline_csv(tmp_path / "baseline.csv")
         metric = BERTScore(model=toy_model_layers, user_tokenizer=toy_tokenizer, max_length=MAX_LEN,
