@@ -1,0 +1,117 @@
+"""Cross-feature interactions: wrappers/collections x bounded sample buffers.
+
+The r4 advisor's one medium finding was exactly such an interaction
+(fused collection compute x buffer_capacity); these pin the neighboring
+combinations so the next one can't appear silently. Each case asserts
+values against an independent oracle, not just absence of a crash.
+"""
+import copy
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    BootStrapper,
+    MetricCollection,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    SpearmanCorrCoef,
+)
+
+rng = np.random.RandomState(7)
+P = jnp.asarray(rng.rand(64))
+T = jnp.asarray(rng.randint(0, 2, 64))
+
+
+def _auroc_oracle():
+    m = AUROC()
+    m.update(P, T)
+    return float(m.compute())
+
+
+def test_bootstrapper_over_bounded_member():
+    bs = BootStrapper(AUROC(buffer_capacity=128), num_bootstraps=8)
+    bs.update(P, T)
+    out = bs.compute()
+    # bootstrap resamples vary, but their mean must sit near the full-sample
+    # value and std must be a finite small spread
+    assert abs(float(out["mean"]) - _auroc_oracle()) < 0.25
+    assert 0.0 <= float(out["std"]) < 0.5
+
+
+def test_tracker_over_collection_with_bounded_member():
+    mt = MetricTracker(
+        MetricCollection({"acc": Accuracy(), "auroc": AUROC(buffer_capacity=128)})
+    )
+    for _ in range(2):
+        mt.increment()
+        mt.update(P, T)
+    best = mt.best_metric()
+    np.testing.assert_allclose(float(best["auroc"]), _auroc_oracle(), atol=1e-10)
+    acc = Accuracy()
+    acc.update(P, T)
+    np.testing.assert_allclose(float(best["acc"]), float(acc.compute()), atol=1e-10)
+
+
+def test_minmax_over_bounded_member():
+    mm = MinMaxMetric(AUROC(buffer_capacity=128))
+    mm.update(P, T)
+    out = mm.compute()
+    for key in ("raw", "max", "min"):
+        np.testing.assert_allclose(float(out[key]), _auroc_oracle(), atol=1e-10)
+
+
+def test_collection_deepcopy_mid_stream_with_bounded_member():
+    """deepcopy after a compute() (excluded-member bookkeeping populated)
+    must yield an independent, correct copy."""
+    mc = MetricCollection({"acc": Accuracy(), "auroc": AUROC(buffer_capacity=256)})
+    mc.update(P, T)
+    mc.compute()
+    dc = copy.deepcopy(mc)
+    dc.update(P, T)  # only the copy sees the second batch
+    v_orig, v_copy = mc.compute(), dc.compute()
+    # same sample set duplicated leaves both members' values unchanged
+    np.testing.assert_allclose(float(v_copy["auroc"]), float(v_orig["auroc"]), atol=1e-12)
+    np.testing.assert_allclose(float(v_copy["acc"]), float(v_orig["acc"]), atol=1e-12)
+    # and the copy is independent: the original never saw the second batch
+    assert dc["auroc"]._update_count == 2 and mc["auroc"]._update_count == 1
+    assert dc["acc"]._update_count == 2 and mc["acc"]._update_count == 1
+
+
+def test_pickle_roundtrip_mid_stream_bounded():
+    a = AUROC(buffer_capacity=128)
+    a.update(P[:32], T[:32])
+    a2 = pickle.loads(pickle.dumps(a))
+    a.update(P[32:], T[32:])
+    a2.update(P[32:], T[32:])
+    np.testing.assert_allclose(float(a.compute()), float(a2.compute()), atol=1e-12)
+
+
+def test_multioutput_over_bounded_member():
+    mo = MultioutputWrapper(SpearmanCorrCoef(buffer_capacity=64), num_outputs=2)
+    P2 = rng.normal(size=(40, 2))
+    T2 = rng.normal(size=(40, 2))
+    mo.update(jnp.asarray(P2), jnp.asarray(T2))
+    vals = np.atleast_1d(np.asarray(mo.compute()))
+    want = []
+    for i in range(2):
+        m = SpearmanCorrCoef(buffer_capacity=64)  # bounded oracle: no warning, same math
+        m.update(jnp.asarray(P2[:, i]), jnp.asarray(T2[:, i]))
+        want.append(float(m.compute()))
+    np.testing.assert_allclose(vals, want, atol=1e-10)
+
+
+def test_bounded_overflow_raises_through_collection():
+    """The checked-bound contract must survive the collection path: silent
+    truncation through a wrapper would be worse than the error."""
+    mc = MetricCollection({"acc": Accuracy(), "auroc": AUROC(buffer_capacity=64)})
+    mc.update(P, T)
+    mc.compute()
+    mc.update(P, T)  # 128 samples > 64 capacity
+    with pytest.raises(ValueError, match="buffer_capacity exceeded"):
+        mc.compute()
