@@ -963,6 +963,71 @@ def bench_topk_kernel() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# engine compile telemetry: shared-jit cache + bucketing amortization
+# ---------------------------------------------------------------------------
+def bench_engine_compile_stats() -> dict:
+    """Exercise the compile-aware engine the way a streaming eval epoch does
+    — instance clones, ragged tail batches under ``jit_bucket='pow2'``, and
+    cloned fused collections — and report the process compile telemetry, so
+    BENCH rounds track compile amortization alongside throughput."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, MetricCollection, engine
+
+    engine.clear_cache()
+    rng = np.random.RandomState(7)
+    ragged_sizes = [7, 33, 256] if _small() else [7, 1000, 8192]
+
+    t0 = time.perf_counter()
+    # two instances of one class: the second must ride the first's compiles
+    a1 = Accuracy(num_classes=NUM_CLASSES, jit_bucket="pow2")
+    a2 = Accuracy(num_classes=NUM_CLASSES, jit_bucket="pow2")
+    for b in ragged_sizes:
+        p = jnp.asarray(rng.rand(b, NUM_CLASSES).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, NUM_CLASSES, size=(b,)).astype(np.int32))
+        a1.update(p, t)
+        a2.update(p, t)
+    _force(a1._snapshot_state())
+    _force(a2._snapshot_state())
+
+    # two clones of one collection: the fused update/compute programs are
+    # shared through the same cache
+    def members():
+        return {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "confmat": ConfusionMatrix(num_classes=NUM_CLASSES),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+        }
+
+    p = jnp.asarray(rng.rand(ragged_sizes[-1], NUM_CLASSES).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, NUM_CLASSES, size=(ragged_sizes[-1],)))
+    for mc in (MetricCollection(members()), MetricCollection(members())):
+        mc.update(p, t)
+        mc.update(p, t)
+        _force(mc.compute()["acc"])
+    elapsed = time.perf_counter() - t0
+
+    summary = engine.cache_summary()
+    return {
+        "metric": "engine_compile_stats",
+        "value": summary["compiles"],
+        "unit": "compiles",
+        "vs_baseline": None,
+        "calls": summary["calls"],
+        "cache_hits": summary["cache_hits"],
+        "retraces": summary["retraces"],
+        "donated_bytes": summary["donated_bytes"],
+        "bucketed_calls": summary["bucketed_calls"],
+        "entries": summary["entries"],
+        "donation_active": summary["donation_active"],
+        "second_instance_compiles": a2.compile_stats()["compiles"],
+        "second_instance_cache_hits": a2.compile_stats()["cache_hits"],
+        "ragged_sizes": ragged_sizes,
+        "elapsed_s": round(elapsed, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
 # module-API compute() latency on the live backend
 # ---------------------------------------------------------------------------
 def bench_compute_latency() -> dict:
@@ -1041,6 +1106,7 @@ _CONFIGS = [
     ("bench_collection_fused", 1200, True),
     ("bench_topk_kernel", 1200, True),
     ("bench_compute_latency", 900, True),
+    ("bench_engine_compile_stats", 900, True),
 ]
 
 _PERSIST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_PARTIAL.json")
@@ -1159,6 +1225,7 @@ _CPU_FALLBACK_OK = {
     "bench_compute_latency",
     "bench_fid",
     "bench_bertscore",
+    "bench_engine_compile_stats",
 }
 _CPU_FALLBACK_TINY = {"bench_fid", "bench_bertscore"}
 
@@ -1245,6 +1312,22 @@ def _run_config(name: str, timeout_s: int, needs_accel: bool, persisted: dict) -
 
 
 def main() -> None:
+    if "--smoke" in sys.argv:
+        # CI telemetry smoke: one in-process engine exercise, one JSON line.
+        # The env pre-imports jax (axon sitecustomize), so a JAX_PLATFORMS
+        # pin must go through jax.config, like tests/conftest.py does.
+        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
+        if forced:
+            import jax
+
+            jax.config.update("jax_platforms", forced)
+        os.environ.setdefault("METRICS_TPU_BENCH_SMALL", "1")
+        result = bench_engine_compile_stats()
+        for key, value in _stamp().items():
+            result.setdefault(key, value)
+        emit(result)
+        return
+
     single = os.environ.get("METRICS_TPU_BENCH_CONFIG")
     if single:  # child mode: run exactly one config
         forced_platform = os.environ.get("METRICS_TPU_BENCH_PLATFORM")

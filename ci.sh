@@ -18,4 +18,15 @@ python -m pytest tests/ -q
 echo "=== lane 2/2: x32 (the dtype users get on TPU) ==="
 METRICS_TPU_TEST_X32=1 python -m pytest tests/ -q
 
+echo "=== engine compile-stats smoke (shared jit cache telemetry) ==="
+JAX_PLATFORMS=cpu python bench.py --smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "engine_compile_stats", obj
+assert obj["cache_hits"] > 0, f"shared jit cache recorded no hits: {obj}"
+assert obj["second_instance_compiles"] == 0, f"clone instance recompiled: {obj}"
+print("engine smoke OK:", line)
+'
+
 echo "both lanes green"

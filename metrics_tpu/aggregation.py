@@ -126,6 +126,10 @@ class SumMetric(BaseAggregator):
         6.0
     """
 
+    # per-row sum contributions: eligible for `jit_bucket` padding (which only
+    # engages when the update jits at all, i.e. under nan_strategy='disable')
+    _batch_additive = True
+
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
 
@@ -173,6 +177,9 @@ class MeanMetric(BaseAggregator):
         >>> print(round(float(mean.compute()), 4))
         2.0
     """
+
+    # value/weight sums are both per-row: eligible for `jit_bucket` padding
+    _batch_additive = True
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)

@@ -40,6 +40,9 @@ class ConfusionMatrix(Metric):
 
     is_differentiable = False
     higher_is_better = None
+    # bincount of per-row (true, pred) pairs: row-additive, so `jit_bucket`
+    # padding corrects exactly
+    _batch_additive = True
 
     def __init__(
         self,

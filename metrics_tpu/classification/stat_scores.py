@@ -34,6 +34,14 @@ class StatScores(Metric):
     is_differentiable = False
     higher_is_better = None
 
+    @property
+    def _batch_additive(self) -> bool:
+        # Row-additive sums — eligible for `jit_bucket` padding — except under
+        # macro reduce with ignore_index: the `.set(-1)` column marker is
+        # applied once per update (not once per row), so the padding
+        # correction would over-subtract it.
+        return self.ignore_index is None or self.reduce != "macro"
+
     def __init__(
         self,
         threshold: float = 0.5,
@@ -66,8 +74,12 @@ class StatScores(Metric):
 
         if mdmc_reduce != "samplewise" and reduce != "samples":
             zeros_shape = [] if reduce == "micro" else [num_classes]
+            # the lane's default int (int64 under jax_enable_x64, else int32)
+            # matches what `_stat_scores` accumulates in, so the state dtype is
+            # stable across updates (scan-carry/donation friendly)
+            int_dtype = jnp.asarray(0).dtype
             for s in ("tp", "fp", "tn", "fn"):
-                self.add_state(s, default=jnp.zeros(zeros_shape, dtype=jnp.int32), dist_reduce_fx="sum")
+                self.add_state(s, default=jnp.zeros(zeros_shape, dtype=int_dtype), dist_reduce_fx="sum")
         else:
             for s in ("tp", "fp", "tn", "fn"):
                 self.add_state(s, default=[], dist_reduce_fx="cat")

@@ -19,7 +19,10 @@ from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, Iterator, Optional, Sequence, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 
+from metrics_tpu.engine import bucketing as _bucketing
+from metrics_tpu.engine import cache as _engine
 from metrics_tpu.metric import _JIT_FALLBACK_ERRORS, Metric
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -34,6 +37,20 @@ class MetricCollection:
         additional_metrics: more metrics appended to a single/sequence input.
         prefix: string prepended to all result keys.
         postfix: string appended to all result keys.
+
+    The fused update/forward/compute programs live in the process-wide
+    compile cache (``metrics_tpu.engine``): two collections with identical
+    members — clones included — share one compiled program per path, and the
+    compile/hit/retrace counters are surfaced via :meth:`compile_stats`.
+
+    Fused-compute eviction: a member whose ``compute`` turns out to be
+    host-side is excluded from the fused compute program after one failed
+    probe (permanently once it has real state, provisionally before its
+    first update). :meth:`reset` clears these exclusions along with the
+    states, so a one-off misclassification — e.g. a compute that raised on
+    a degenerate all-zero state — is re-probed on the next epoch instead of
+    permanently evicting the member; a genuinely host-side compute simply
+    fails its one re-probe per reset and returns to per-member dispatch.
 
     Example:
         >>> import jax.numpy as jnp
@@ -54,6 +71,10 @@ class MetricCollection:
         self._modules: "OrderedDict[str, Metric]" = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
+        # compiled fused programs live in the process-wide engine cache,
+        # keyed by member names + fingerprints; the collection keeps failure
+        # flags, telemetry counters, and introspection handles (_fused*_keys
+        # = the member keys last fused, _fused*_fn = the shared cache entry)
         self._fused_keys: Tuple[str, ...] = ()
         self._fused_fn: Optional[Any] = None
         self._fused_failed = False
@@ -63,6 +84,8 @@ class MetricCollection:
         self._fused_cmp_keys: Tuple[str, ...] = ()
         self._fused_cmp_fn: Optional[Any] = None
         self._fused_cmp_failed = False
+        self._fused_cmp_probed: Optional[Tuple] = None
+        self._compile_stats = _engine.new_stats()
         # key -> member's _update_count when its compute failed the fused
         # probe. Exclusions taken BEFORE the member's first update (count 0)
         # are provisional — a pre-update compute() legitimately raises for
@@ -160,39 +183,34 @@ class MetricCollection:
         keys = self._forward_fusable_keys()
         if not keys:
             return {}
-        if keys != self._fused_fwd_keys:
-            self._fused_fwd_keys = keys
-            self._fused_fwd_fn = None
         members = [self._modules[k] for k in keys]
         states = {k: m._snapshot_state() for k, m in zip(keys, members)}
         member_kwargs = {k: m._filter_kwargs(**kwargs) for k, m in zip(keys, members)}
 
-        if self._fused_fwd_fn is None:
-
-            def transition(st: Dict[str, Any], a: Tuple[Any, ...], kw: Dict[str, Any]):
-                vals: Dict[str, Any] = {}
-                merged: Dict[str, Any] = {}
-                for key, member in zip(keys, members):
-                    fresh = {n: member._default_value(n) for n in member._defaults}
-                    member._restore_state(fresh)
-                    member._inner_update(*a, **kw[key])
-                    batch_state = member._snapshot_state()
-                    vals[key] = member._compute_impl()
-                    merged[key] = member.merge_states(st[key], batch_state)
-                return vals, merged
-
-            self._fused_fwd_fn = jax.jit(transition)
-
         try:
-            vals, merged = self._fused_fwd_fn(states, args, member_kwargs)
+            for k, m in zip(keys, members):
+                _engine.ensure_python_init(m, args, member_kwargs[k])
+            entry = _engine.fused_entry("fused_forward", keys, members)
+            self._fused_fwd_keys = keys
+            self._fused_fwd_fn = entry
+            fwd_states = states
+            if entry.donate:
+                fwd_states = {
+                    k: _engine.guard_donated_state(m, states[k]) for k, m in zip(keys, members)
+                }
+            vals, merged = entry.invoke(
+                "exact", members, self._compile_stats, fwd_states, args, member_kwargs
+            )
         except _JIT_FALLBACK_ERRORS:
             self._fused_fwd_failed = True
             for k, m in zip(keys, members):
                 m._restore_state(states[k])
             return {}
         except Exception:
+            # a donated runtime failure may have consumed the state buffers —
+            # rollback_state swaps in defaults rather than deleted arrays
             for k, m in zip(keys, members):
-                m._restore_state(states[k])
+                m._restore_state(_engine.rollback_state(m, states[k]))
             raise
         out: Dict[str, Any] = {}
         for k, m in zip(keys, members):
@@ -216,35 +234,52 @@ class MetricCollection:
         keys = self._fusable_keys()
         if not keys:
             return ()
-        if keys != self._fused_keys:
-            self._fused_keys = keys
-            self._fused_fn = None
         members = [self._modules[k] for k in keys]
         states = {k: m._snapshot_state() for k, m in zip(keys, members)}
         member_kwargs = {k: m._filter_kwargs(**kwargs) for k, m in zip(keys, members)}
 
-        if self._fused_fn is None:
-
-            def transition(st: Dict[str, Any], a: Tuple[Any, ...], kw: Dict[str, Any]) -> Dict[str, Any]:
-                new: Dict[str, Any] = {}
-                for key, member in zip(keys, members):
-                    member._restore_state(st[key])
-                    member._inner_update(*a, **kw[key])
-                    new[key] = member._snapshot_state()
-                return new
-
-            self._fused_fn = jax.jit(transition)
-
         try:
-            new_states = self._fused_fn(states, args, member_kwargs)
+            for k, m in zip(keys, members):
+                _engine.ensure_python_init(m, args, member_kwargs[k])
+            entry = _engine.fused_entry("fused_update", keys, members)
+            self._fused_keys = keys
+            self._fused_fn = entry
+            upd_states = states
+            if entry.donate:
+                upd_states = {
+                    k: _engine.guard_donated_state(m, states[k]) for k, m in zip(keys, members)
+                }
+            spec = None
+            if all(
+                m.jit_bucket == "pow2" and _bucketing.supports_bucketing(m) for m in members
+            ):
+                spec = _bucketing.input_spec(args, member_kwargs)
+            if spec is None:
+                new_states = entry.invoke(
+                    "exact", members, self._compile_stats, upd_states, args, member_kwargs
+                )
+            else:
+                leaves, treedef, batched, pad = spec
+                padded = _bucketing.pad_leaves(leaves, batched, pad)
+                new_states = entry.invoke(
+                    "bucketed",
+                    members,
+                    self._compile_stats,
+                    upd_states,
+                    tuple(padded),
+                    jnp.asarray(pad, jnp.int32),
+                    treedef,
+                    batched,
+                )
         except _JIT_FALLBACK_ERRORS:
             self._fused_failed = True
             for k, m in zip(keys, members):
                 m._restore_state(states[k])
             return ()
         except Exception:
+            # see _fused_forward: donated buffers may be gone on runtime failure
             for k, m in zip(keys, members):
-                m._restore_state(states[k])
+                m._restore_state(_engine.rollback_state(m, states[k]))
             raise
         for k, m in zip(keys, members):
             m._restore_state(new_states[k])
@@ -307,9 +342,6 @@ class MetricCollection:
         keys = self._compute_fusable_keys()
         if not keys:
             return {}
-        if keys != self._fused_cmp_keys:
-            self._fused_cmp_keys = keys
-            self._fused_cmp_fn = None
         members = [self._modules[k] for k in keys]
         states = {k: m._snapshot_state() for k, m in zip(keys, members)}
         for m in members if _warn else ():  # warn BEFORE computing, like the
@@ -323,19 +355,30 @@ class MetricCollection:
                     UserWarning,
                 )
 
-        if self._fused_cmp_fn is None:
-
-            def values(st: Dict[str, Any]) -> Dict[str, Any]:
-                vals: Dict[str, Any] = {}
-                for key, member in zip(keys, members):
-                    member._restore_state(st[key])
-                    vals[key] = member._compute_impl()
-                return vals
-
-            self._fused_cmp_fn = jax.jit(values)
-
         try:
-            vals = self._fused_cmp_fn(states)
+            # per-collection python probe: a warm shared program would skip
+            # the members' Python compute bodies entirely, silently bypassing
+            # validation the per-member path runs (e.g. Accuracy's "mode not
+            # determined" error before any update). One abstract pass per
+            # collection/member-set restores those semantics; a raise lands
+            # in the offender machinery below exactly like a failed trace.
+            probe_key = (keys, tuple(id(m) for m in members))
+            if self._fused_cmp_probed != probe_key:
+                for k, m in zip(keys, members):
+
+                    def _pre_probe(st, member=m):
+                        member._restore_state(st)
+                        return member._compute_impl()
+
+                    try:
+                        jax.eval_shape(_pre_probe, states[k])
+                    finally:
+                        m._restore_state(states[k])
+                self._fused_cmp_probed = probe_key
+            entry = _engine.fused_entry("fused_compute", keys, members)
+            self._fused_cmp_keys = keys
+            self._fused_cmp_fn = entry
+            vals = entry.invoke("exact", members, self._compile_stats, states)
         except Exception as fused_err:  # noqa: BLE001 — probed + re-raised below
             for k, m in zip(keys, members):
                 m._restore_state(states[k])
@@ -361,8 +404,6 @@ class MetricCollection:
             if offenders:
                 for k in offenders:
                     self._fused_cmp_excluded[k] = self._modules[k]._update_count
-                self._fused_cmp_keys = ()
-                self._fused_cmp_fn = None
                 return self._fused_compute(_warn=False)  # retry without the offenders
             if isinstance(fused_err, _JIT_FALLBACK_ERRORS):
                 # no individual offender reproduces: interaction failure —
@@ -423,6 +464,12 @@ class MetricCollection:
     def reset(self) -> None:
         for _, m in self.items(keep_base=True):
             m.reset()
+        # re-probe fused-compute exclusions next epoch: a one-off host-side
+        # misclassification (e.g. a compute that raised on the degenerate
+        # pre-update state) must not permanently evict a member, while a
+        # genuinely host-side compute costs one failed probe per reset
+        # (see class docstring)
+        self._fused_cmp_excluded = {}
 
     def persistent(self, mode: bool = True) -> None:
         for _, m in self.items(keep_base=True):
@@ -476,7 +523,9 @@ class MetricCollection:
                 " with mapping input."
             )
 
-        # member set changed: rebuild (and re-allow) the fused programs
+        # member set changed: re-allow the fused paths and drop the handles
+        # (the compiled programs themselves are keyed by member set in the
+        # engine cache, so the new set binds its own entry on next use)
         self._fused_keys = ()
         self._fused_fn = None
         self._fused_failed = False
@@ -486,6 +535,7 @@ class MetricCollection:
         self._fused_cmp_keys = ()
         self._fused_cmp_fn = None
         self._fused_cmp_failed = False
+        self._fused_cmp_probed = None
         self._fused_cmp_excluded = {}
 
         if isinstance(metrics, dict):
@@ -516,12 +566,21 @@ class MetricCollection:
             raise ValueError("Unknown input to MetricCollection.")
 
     def __getstate__(self) -> Dict[str, Any]:
-        # compiled functions don't pickle/deepcopy; rebuilt lazily on use
+        # the entry handles hold compiled programs (unpicklable); the copy
+        # re-binds its own entries from the process cache on next use
         state = self.__dict__.copy()
         state["_fused_fn"] = None
         state["_fused_fwd_fn"] = None
         state["_fused_cmp_fn"] = None
         return state
+
+    def compile_stats(self) -> Dict[str, Any]:
+        """Compile telemetry for this collection's fused dispatches, plus each
+        member's own counters (members also accumulate through their
+        per-metric update path when fusion doesn't cover them)."""
+        out: Dict[str, Any] = dict(self._compile_stats)
+        out["members"] = {k: m.compile_stats() for k, m in self._modules.items()}
+        return out
 
     @staticmethod
     def _check_arg(arg: Optional[str], name: str) -> Optional[str]:

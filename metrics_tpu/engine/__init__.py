@@ -1,0 +1,41 @@
+"""Compile-aware update engine: shared jit cache, state donation, bucketing.
+
+The streaming-metrics hot path is dominated by compile and copy overhead,
+not math: every ``update`` is a tiny XLA program. This package makes the
+compiled transition a process-wide resource instead of a per-instance one:
+
+* :mod:`metrics_tpu.engine.cache` — one compiled transition per
+  ``(metric class, jit-relevant config, input avals)`` shared by all
+  instances (and by clones inside ``MetricCollection``/``BootStrapper``),
+  state-pytree donation on backends that support it, and per-entry
+  compile/hit/retrace telemetry.
+* :mod:`metrics_tpu.engine.bucketing` — opt-in ``jit_bucket='pow2'`` batch
+  padding with an exact row-additive correction, capping retraces at
+  O(log max_batch) for ragged streaming batches.
+
+Introspection: ``Metric.compile_stats()`` for one instance,
+:func:`cache_summary` for the whole process, ``clear_cache()`` between
+experiments.
+"""
+from metrics_tpu.engine.bucketing import (  # noqa: F401
+    bucket_spec,
+    input_spec,
+    next_pow2,
+    pad_leaves,
+    supports_bucketing,
+)
+from metrics_tpu.engine.cache import (  # noqa: F401
+    SharedEntry,
+    cache_summary,
+    clear_cache,
+    donation_enabled,
+    ensure_python_init,
+    fused_entry,
+    guard_donated_state,
+    instance_stats,
+    metric_fingerprint,
+    new_stats,
+    rollback_state,
+    set_donation,
+    update_transition,
+)

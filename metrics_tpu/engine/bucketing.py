@@ -1,0 +1,124 @@
+"""Opt-in shape bucketing: pad the batch axis to power-of-two buckets.
+
+Every distinct batch shape normally triggers a full XLA retrace, so a
+streaming workload with ragged tail batches (7, 1000, 8192, ...) compiles an
+unbounded number of programs. With ``jit_bucket='pow2'`` the batch axis is
+padded up to the next power of two before entering the jitted transition,
+capping the number of distinct programs at O(log max_batch).
+
+Correctness does not come from a mask threaded through every kernel — it
+comes from *row-additivity*: for metrics that declare
+``_batch_additive = True`` (stat-scores-family classification, sum/mean
+aggregation, regression sums), every batch row contributes independently and
+additively to every ``'sum'``-reduced state. Padding appends all-zero rows
+(``jnp.pad`` constant mode), and the jitted transition subtracts the
+padding's contribution exactly::
+
+    corrected = update(state, padded) - pad_count * (update(default, zero_row) - default)
+
+``pad_count`` is passed as a traced scalar, so different pad amounts within
+one bucket share a single compiled program. For integer accumulators the
+correction is bitwise-exact; floats differ only by summation-order ulps.
+Zero rows (not replicas of a real row) are the pad value deliberately: a
+zero row's state delta is always finite for row-additive metrics, so the
+correction never manufactures ``inf - inf``/``0 * inf`` NaNs when the
+stream itself carries non-finite values — a ±inf accumulator survives
+bucketing exactly as it does eager updates.
+
+Metrics that cannot express this (max/min states, ``ignore_index`` column
+marking under macro reduce, list buffers) simply fall back to exact-shape jit
+— opting in to bucketing is never allowed to change results beyond float
+summation order.
+
+The ``_batch_additive`` contract a class opts into:
+
+* every registered state is an array with ``dist_reduce_fx='sum'``;
+* ``update`` treats axis 0 of every rank>=1 array input as the batch axis;
+* each row's contribution to every state is independent of the other rows
+  and of the accumulated state (pure additive delta), including static
+  counts (``x.size`` terms are linear in the row count, so they correct
+  exactly too).
+"""
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+#: spec = (leaves, treedef, batched_leaf_indices, pad_count)
+BucketSpec = Tuple[List[Any], Any, Tuple[int, ...], int]
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= ``n`` (``n >= 1``)."""
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def supports_bucketing(metric: Any) -> bool:
+    """Static eligibility: the class opted into row-additivity and every
+    state is a ``'sum'``-reduced array (the only reduction the padding
+    correction is exact for)."""
+    if not getattr(metric, "_batch_additive", False):
+        return False
+    for name in metric._defaults:
+        if isinstance(metric._defaults[name], list) or metric._reductions[name] != "sum":
+            return False
+    return True
+
+
+def input_spec(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Optional[BucketSpec]:
+    """Flatten the update inputs and locate the batch axis.
+
+    Returns ``None`` (exact-shape fallback) when there is no rank>=1 array
+    input, the batch is empty, or rank>=1 arrays disagree on axis-0 length —
+    anything but the unambiguous "all batched inputs share axis 0" case.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    batch: Optional[int] = None
+    batched: List[int] = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, (jax.Array, jnp.ndarray, np.ndarray)) and getattr(leaf, "ndim", 0) >= 1:
+            if batch is None:
+                batch = int(leaf.shape[0])
+            elif int(leaf.shape[0]) != batch:
+                return None
+            batched.append(i)
+    if not batched or not batch:
+        return None
+    return leaves, treedef, tuple(batched), next_pow2(batch) - batch
+
+
+def bucket_spec(metric: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Optional[BucketSpec]:
+    """Full gate for one metric: opt-in flag, state eligibility, input shape."""
+    if getattr(metric, "jit_bucket", None) != "pow2":
+        return None
+    if not supports_bucketing(metric):
+        return None
+    return input_spec(args, kwargs)
+
+
+def pad_leaves(leaves: List[Any], batched: Tuple[int, ...], pad: int) -> List[Any]:
+    """Zero-pad the batched leaves by ``pad`` rows (outside jit, so the jitted
+    program only ever sees bucket-shaped inputs)."""
+    batched_set = set(batched)
+    out: List[Any] = []
+    for i, leaf in enumerate(leaves):
+        if i not in batched_set:
+            out.append(leaf)
+            continue
+        arr = jnp.asarray(leaf)
+        if pad:
+            arr = jnp.pad(arr, [(0, pad)] + [(0, 0)] * (arr.ndim - 1))
+        out.append(arr)
+    return out
+
+
+def row_slice_leaves(leaves: List[Any], batched: Tuple[int, ...]) -> List[Any]:
+    """The single-row inputs reproducing one pad row (trace-side helper):
+    padding appends zero rows, so a zeroed ``[1, ...]`` slice is the pad row."""
+    batched_set = set(batched)
+    return [
+        jnp.zeros_like(leaf[-1:]) if i in batched_set else leaf for i, leaf in enumerate(leaves)
+    ]

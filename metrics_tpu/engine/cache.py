@@ -1,0 +1,618 @@
+"""Process-wide compilation cache for metric state transitions.
+
+The seed engine compiled one ``jax.jit`` per *instance*: N ``Accuracy``
+instances (and every clone inside ``MetricCollection``/``BootStrapper``) paid
+N identical compiles, and accumulated state was copied in and out of each
+step. pjit-era practice shows compile/copy overhead, not math, dominates
+small-kernel streaming workloads — so this module makes the compiled
+transition a *process* resource:
+
+* **Shared entries.** Compiled transitions are cached under
+  ``(kind, metric fingerprint)`` where the fingerprint captures everything
+  that can change the traced program: the class, jit-relevant constructor
+  config (simple public attributes by value, arrays by content digest,
+  callables/objects by pinned identity), and the state spec. Input avals are
+  handled by ``jax.jit``'s own per-signature cache underneath one entry.
+  The traced body binds the *calling* instance through ``entry.cell``, so a
+  retrace for a new aval signature always traces against a live instance.
+
+* **State donation.** On backends that support buffer donation (TPU/GPU) the
+  state argument is donated (``donate_argnums=0``) so XLA accumulates in
+  place instead of round-tripping HBM buffers. State leaves that alias the
+  registered defaults are defensively copied first (donating a default would
+  invalidate ``reset``/``init_state``). On CPU — and on any runtime donation
+  rejection — the entry falls back to a plain non-donating jit.
+
+* **Python-init probe.** A metric whose first update is served entirely from
+  a warm shared cache never runs its Python ``update`` body, so attribute
+  side effects (``Accuracy.mode`` inference, validation errors) would be
+  skipped. Each instance therefore runs one ``jax.eval_shape`` probe of its
+  transition before its first cached dispatch: abstract, no compile, but the
+  Python body executes once. Trace-incompatibility surfaces here too and
+  routes the instance to its eager fallback exactly like a failed trace.
+
+* **Telemetry.** Every entry counts calls, traces (compiles), cache hits,
+  retraces, donated bytes and bucketed calls; the same deltas are attributed
+  to the calling instance's ``_compile_stats`` (surfaced via
+  ``Metric.compile_stats()``) and aggregated by :func:`cache_summary`.
+"""
+import hashlib
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.engine import bucketing
+
+Array = jax.Array
+
+_CACHE: "Dict[Any, SharedEntry]" = {}
+_LOCK = threading.RLock()
+
+# Entries hold compiled executables and pin id-keyed config objects, so the
+# cache is bounded: beyond this many entries the least-recently-used one is
+# evicted (its programs and pins become collectable; a metric still using it
+# simply re-creates and re-compiles its entry). 512 distinct
+# (class, config) programs is far above any realistic eval fleet; override
+# via METRICS_TPU_ENGINE_CACHE_SIZE.
+_MAX_ENTRIES = max(8, int(os.environ.get("METRICS_TPU_ENGINE_CACHE_SIZE", "512")))
+_use_tick = 0
+
+_DONATABLE_PLATFORMS = ("tpu", "gpu", "cuda", "rocm")
+_DONATION_OVERRIDE: Optional[bool] = None
+
+_STAT_KEYS = ("compiles", "cache_hits", "retraces", "donated_bytes", "bucketed_calls")
+
+
+def new_stats() -> Dict[str, int]:
+    return {k: 0 for k in _STAT_KEYS}
+
+
+# ---------------------------------------------------------------------------
+# donation policy
+# ---------------------------------------------------------------------------
+def set_donation(enabled: Optional[bool]) -> None:
+    """Force donation on/off (``None`` restores platform auto-detection).
+    Affects entries created afterwards; ``clear_cache()`` to rebuild."""
+    global _DONATION_OVERRIDE
+    _DONATION_OVERRIDE = enabled
+
+
+def donation_enabled() -> bool:
+    """Whether new entries request state donation: env/manual override first,
+    else platform support (CPU's runtime ignores donation, so requesting it
+    there only buys a warning per dispatch)."""
+    if _DONATION_OVERRIDE is not None:
+        return _DONATION_OVERRIDE
+    env = os.environ.get("METRICS_TPU_DONATE")
+    if env in ("0", "1"):
+        return env == "1"
+    try:
+        return jax.default_backend() in _DONATABLE_PLATFORMS
+    except Exception:  # noqa: BLE001 — backend init failure: just don't donate
+        return False
+
+
+def _looks_like_donation_failure(err: Exception) -> bool:
+    # deliberately narrow: "donat"/"alias" appear in XLA's donation-rejection
+    # messages, while e.g. "Array has been deleted" is a *caller* bug that
+    # must propagate — not silently disable donation process-wide and retry
+    msg = str(err).lower()
+    return "donat" in msg or "alias" in msg
+
+
+def rollback_state(metric: Any, state: Dict[str, Any]) -> Dict[str, Any]:
+    """The state to restore after a failed dispatch.
+
+    Trace-time failures never executed, so ``state`` is intact. But on a
+    donating backend a *runtime* failure can land after XLA already consumed
+    the donated buffers — restoring those would plant deleted arrays in the
+    metric and every later touch would fail far from the real error. In that
+    case fall back to the registered defaults: the accumulation is lost (it
+    lived in the donated buffers), but the metric stays coherent and the
+    original error surfaces.
+    """
+
+    def _deleted(x: Any) -> bool:
+        try:
+            return isinstance(x, jax.Array) and x.is_deleted()
+        except Exception:  # noqa: BLE001 — conservative: unreadable == unusable
+            return True
+
+    for value in state.values():
+        if not isinstance(value, list) and _deleted(value):
+            return metric.init_state()
+    return state
+
+
+def guard_donated_state(metric: Any, state: Dict[str, Any]) -> Dict[str, Any]:
+    """Copy state leaves that alias the registered default arrays.
+
+    On the first update after construction/``reset`` the live state *is* the
+    default array object; donating it would invalidate the defaults that
+    ``reset``/``init_state``/clones still need.
+    """
+    default_ids = {id(v) for v in metric._defaults.values() if not isinstance(v, list)}
+    out: Dict[str, Any] = {}
+    for name, value in state.items():
+        if not isinstance(value, list) and id(value) in default_ids:
+            out[name] = jnp.array(value, copy=True)
+        else:
+            out[name] = value
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+_SIMPLE = (str, int, float, bool, bytes, type(None))
+
+# Excluded from fingerprints: lifecycle machinery rebound onto every instance
+# in ``Metric.__init__`` (per-instance by construction), and host-level sync
+# configuration — it steers ``compute``-time gather/forward policy OUTSIDE the
+# traced programs, and keying on it (ids, for callables) would give every
+# instance with its own sync callable a private compile, defeating sharing in
+# exactly the distributed setting the cache targets. Collections gate fused
+# membership on these attributes separately, and membership is part of the
+# fused cache key.
+_FP_SKIP = frozenset(
+    (
+        "update",
+        "compute",
+        "forward",
+        "reset",
+        "compute_on_step",
+        "dist_sync_on_step",
+        "process_group",
+        "dist_sync_fn",
+        "axis_name",
+    )
+)
+
+
+def _attr_token(value: Any, pins: List[Any]) -> Tuple:
+    if isinstance(value, (jax.Array, jnp.ndarray, np.ndarray)):
+        a = np.asarray(value)
+        return ("array", a.dtype.str, a.shape, hashlib.sha1(a.tobytes()).hexdigest())
+    if isinstance(value, _SIMPLE):
+        return ("val", type(value).__name__, repr(value))
+    if isinstance(value, (tuple, list)) and all(isinstance(x, _SIMPLE) for x in value):
+        return ("seq", type(value).__name__, repr(value))
+    # callables, sub-metrics, arbitrary objects: identity only — conservative
+    # (never false-shares two programs; at worst misses a share). The object
+    # is pinned by the entry so its id cannot be recycled under the key.
+    pins.append(value)
+    return ("id", id(value))
+
+
+def metric_fingerprint(metric: Any) -> Tuple[Any, Tuple]:
+    """``(key, pins)`` for one metric instance.
+
+    The key captures the traced program's free variables: class identity,
+    jit-relevant config (every public non-state attribute), and the state
+    spec (names, dtypes, shapes, default contents — defaults are baked into
+    the bucketed correction — and reductions). Computed once per instance at
+    first dispatch and cached: attributes the update itself derives
+    (``Accuracy.mode``) are aval-determined and may mutate later without
+    invalidating sharing.
+
+    Contract: jit-relevant config is FROZEN once the instance has dispatched.
+    This was already true per-instance in the pre-cache engine (the traced
+    program baked config at trace time; mutating ``threshold`` after the
+    first update silently kept the old program for seen shapes) — with a
+    shared cache a post-dispatch mutation could additionally leak into a
+    retrace other instances then share, so: reconstruct the metric to change
+    its config.
+    """
+    cached = metric.__dict__.get("_engine_key")
+    if cached is not None:
+        # pins travel with the cached key: an entry created later (another
+        # fused kind, or after clear_cache()) must still pin the id-keyed
+        # objects, or a recycled id could false-share a program
+        return cached, metric.__dict__.get("_engine_key_pins", ())
+    pins: List[Any] = []
+    cfg = tuple(
+        (name, _attr_token(metric.__dict__[name], pins))
+        for name in sorted(metric.__dict__)
+        if not name.startswith("_") and name not in metric._defaults and name not in _FP_SKIP
+    )
+    state_spec = []
+    for name in metric._defaults:
+        default = metric._defaults[name]
+        fx = metric._reductions[name]
+        fx_token = fx if (fx is None or isinstance(fx, str)) else _attr_token(fx, pins)
+        if isinstance(default, list):
+            state_spec.append((name, "list", fx_token))
+        else:
+            a = np.asarray(default)
+            state_spec.append(
+                (name, a.dtype.str, a.shape, hashlib.sha1(a.tobytes()).hexdigest(), fx_token)
+            )
+    key = (type(metric), cfg, tuple(state_spec))
+    metric._engine_key = key
+    metric._engine_key_pins = tuple(pins)
+    return key, tuple(pins)
+
+
+# ---------------------------------------------------------------------------
+# shared entries
+# ---------------------------------------------------------------------------
+class SharedEntry:
+    """One shared compiled-transition family (exact + bucketed variants).
+
+    ``jax.jit`` keys its executable cache by input avals underneath each
+    variant, so one entry covers every input signature of its program family.
+    """
+
+    def __init__(self, key: Any, kind: str, pins: Tuple = ()) -> None:
+        self.key = key
+        self.kind = kind
+        self.calls = 0
+        self.traces = 0
+        self.cache_hits = 0
+        self.donated_bytes = 0
+        self.bucketed_calls = 0
+        self.donate = False
+        self._variant_traces: Dict[str, int] = {}
+        self._fns: Dict[str, Callable] = {}
+        self._build: Optional[Callable[[bool], None]] = None
+        self._pins = pins  # objects whose id() participates in the key
+        self.last_used = 0  # LRU tick, maintained by _get_or_create
+        # the calling instance/member-list is bound per call and read by the
+        # traced body — thread-LOCAL so concurrent dispatches through one
+        # shared entry neither serialize nor trace against another thread's
+        # instance (tracing runs synchronously on the calling thread)
+        self._tls = threading.local()
+        # counters only; dispatch itself runs unlocked
+        self._counter_lock = threading.RLock()
+
+    @property
+    def cell(self) -> Any:
+        return getattr(self._tls, "value", None)
+
+    @cell.setter
+    def cell(self, value: Any) -> None:
+        self._tls.value = value
+
+    @property
+    def retraces(self) -> int:
+        return sum(max(0, n - 1) for n in self._variant_traces.values())
+
+    def mark_trace(self, variant: str) -> None:
+        with self._counter_lock:
+            self.traces += 1
+            self._variant_traces[variant] = self._variant_traces.get(variant, 0) + 1
+
+    def invoke(self, variant: str, cell: Any, stats: Optional[Dict[str, int]], *fn_args: Any) -> Any:
+        """Dispatch through one variant with telemetry attribution and the
+        runtime donation-rejection fallback (rebuild without donation, retry
+        once; if the donated call did execute and delete its buffers, the
+        retry surfaces the deleted-array error instead of looping).
+
+        Concurrent dispatches don't serialize: the cell is thread-local and
+        jax's own jit cache handles concurrent tracing. Telemetry deltas are
+        attributed to the caller by before/after counter reads, so heavily
+        concurrent streams can misattribute a trace between instances —
+        counters stay globally consistent, attribution is best-effort.
+        """
+        self.cell = cell
+        before = self.traces
+        # traces are marked under the base name ("exact"/"bucketed") — the
+        # _nodonate wrappers share the same traced body
+        base_variant = variant.replace("_nodonate", "")
+        before_variant = self._variant_traces.get(base_variant, 0)
+        try:
+            try:
+                out = self._fns[variant](*fn_args)
+            except Exception as err:  # noqa: BLE001 — donation probe, re-raised below
+                if not (self.donate and _looks_like_donation_failure(err)):
+                    raise
+                with self._counter_lock:
+                    self.donate = False
+                    self._build(False)
+                out = self._fns[variant](*fn_args)
+        finally:
+            self.cell = None
+        with self._counter_lock:
+            self.calls += 1
+            delta = self.traces - before
+            if delta == 0:
+                self.cache_hits += 1
+                if stats is not None:
+                    stats["cache_hits"] += 1
+            else:
+                if stats is not None:
+                    stats["compiles"] += delta
+                    # a retrace = any trace beyond the VARIANT's first, matching
+                    # SharedEntry.retraces / cache_summary (a first bucketed
+                    # trace after an exact one is a new program, not a retrace)
+                    stats["retraces"] += delta if before_variant > 0 else max(0, delta - 1)
+            if self.donate and not variant.endswith("_nodonate"):
+                nbytes = sum(
+                    x.nbytes for x in jax.tree_util.tree_leaves(fn_args[0]) if hasattr(x, "nbytes")
+                )
+                self.donated_bytes += nbytes
+                if stats is not None:
+                    stats["donated_bytes"] += nbytes
+            if variant.startswith("bucketed"):
+                self.bucketed_calls += 1
+                if stats is not None:
+                    stats["bucketed_calls"] += 1
+            return out
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "calls": self.calls,
+            "compiles": self.traces,
+            "cache_hits": self.cache_hits,
+            "retraces": self.retraces,
+            "donated_bytes": self.donated_bytes,
+            "bucketed_calls": self.bucketed_calls,
+            "donate": self.donate,
+        }
+
+
+def _get_or_create(cache_key: Any, factory: Callable[[], "SharedEntry"]) -> "SharedEntry":
+    global _use_tick
+    with _LOCK:
+        entry = _CACHE.get(cache_key)
+        if entry is None:
+            entry = factory()
+            _CACHE[cache_key] = entry
+        _use_tick += 1
+        entry.last_used = _use_tick  # stamp BEFORE eviction: the newcomer is the MRU
+        if len(_CACHE) > _MAX_ENTRIES:
+            victim = min(_CACHE, key=lambda k: _CACHE[k].last_used)
+            del _CACHE[victim]
+        return entry
+
+
+def _corrected_states(
+    padded_out: Dict[str, Any], row_out: Dict[str, Any], defaults: Dict[str, Any], pad_count: Array
+) -> Dict[str, Any]:
+    """Subtract the padding rows' contribution: exact for row-additive
+    sum states (see ``engine.bucketing``)."""
+    return {
+        name: padded_out[name] - pad_count * (row_out[name] - defaults[name])
+        for name in padded_out
+    }
+
+
+def _make_metric_entry(key: Any, pins: Tuple) -> SharedEntry:
+    entry = SharedEntry(key, "metric_update", pins)
+    entry.donate = donation_enabled()
+
+    def _exact(state, args, kwargs):
+        entry.mark_trace("exact")
+        inst = entry.cell
+        inst._restore_state(state)
+        inst._inner_update(*args, **kwargs)
+        return inst._snapshot_state()
+
+    def _bucketed(state, leaves, pad_count, treedef, batched):
+        entry.mark_trace("bucketed")
+        inst = entry.cell
+        args, kwargs = jax.tree_util.tree_unflatten(treedef, list(leaves))
+        inst._restore_state(state)
+        inst._inner_update(*args, **kwargs)
+        padded_out = inst._snapshot_state()
+        row_args, row_kwargs = jax.tree_util.tree_unflatten(
+            treedef, bucketing.row_slice_leaves(list(leaves), batched)
+        )
+        defaults = inst.init_state()
+        inst._restore_state(defaults)
+        inst._inner_update(*row_args, **row_kwargs)
+        row_out = inst._snapshot_state()
+        return _corrected_states(padded_out, row_out, defaults, pad_count)
+
+    def build(donate: bool) -> None:
+        # the *_nodonate variants serve the pure API (caller owns the state
+        # buffers); without donation they alias the plain variants so both
+        # paths share one trace cache
+        nodonate = {
+            "exact_nodonate": jax.jit(_exact),
+            "bucketed_nodonate": jax.jit(_bucketed, static_argnums=(3, 4)),
+        }
+        if donate:
+            entry._fns = {
+                "exact": jax.jit(_exact, donate_argnums=(0,)),
+                "bucketed": jax.jit(_bucketed, static_argnums=(3, 4), donate_argnums=(0,)),
+                **nodonate,
+            }
+        else:
+            entry._fns = {
+                "exact": nodonate["exact_nodonate"],
+                "bucketed": nodonate["bucketed_nodonate"],
+                **nodonate,
+            }
+
+    entry._build = build
+    build(entry.donate)
+    return entry
+
+
+def _make_fused_entry(kind: str, keys: Tuple[str, ...], cache_key: Any, pins: Tuple) -> SharedEntry:
+    entry = SharedEntry(cache_key, kind, pins)
+    entry.donate = donation_enabled() and kind in ("fused_update", "fused_forward")
+
+    def _update(states, args, member_kwargs):
+        entry.mark_trace("exact")
+        new: Dict[str, Any] = {}
+        for key, member in zip(keys, entry.cell):
+            member._restore_state(states[key])
+            member._inner_update(*args, **member_kwargs[key])
+            new[key] = member._snapshot_state()
+        return new
+
+    def _update_bucketed(states, leaves, pad_count, treedef, batched):
+        entry.mark_trace("bucketed")
+        args, member_kwargs = jax.tree_util.tree_unflatten(treedef, list(leaves))
+        row_args, row_kwargs = jax.tree_util.tree_unflatten(
+            treedef, bucketing.row_slice_leaves(list(leaves), batched)
+        )
+        new: Dict[str, Any] = {}
+        for key, member in zip(keys, entry.cell):
+            member._restore_state(states[key])
+            member._inner_update(*args, **member_kwargs[key])
+            padded_out = member._snapshot_state()
+            defaults = member.init_state()
+            member._restore_state(defaults)
+            member._inner_update(*row_args, **row_kwargs[key])
+            row_out = member._snapshot_state()
+            new[key] = _corrected_states(padded_out, row_out, defaults, pad_count)
+        return new
+
+    def _forward(states, args, member_kwargs):
+        entry.mark_trace("exact")
+        vals: Dict[str, Any] = {}
+        merged: Dict[str, Any] = {}
+        for key, member in zip(keys, entry.cell):
+            fresh = {n: member._default_value(n) for n in member._defaults}
+            member._restore_state(fresh)
+            member._inner_update(*args, **member_kwargs[key])
+            batch_state = member._snapshot_state()
+            vals[key] = member._compute_impl()
+            merged[key] = member.merge_states(states[key], batch_state)
+        return vals, merged
+
+    def _compute(states):
+        entry.mark_trace("exact")
+        vals: Dict[str, Any] = {}
+        for key, member in zip(keys, entry.cell):
+            member._restore_state(states[key])
+            vals[key] = member._compute_impl()
+        return vals
+
+    def build(donate: bool) -> None:
+        argnums = (0,) if donate else ()
+        if kind == "fused_update":
+            entry._fns = {
+                "exact": jax.jit(_update, donate_argnums=argnums),
+                "bucketed": jax.jit(_update_bucketed, static_argnums=(3, 4), donate_argnums=argnums),
+            }
+        elif kind == "fused_forward":
+            entry._fns = {"exact": jax.jit(_forward, donate_argnums=argnums)}
+        else:  # fused_compute: states are restored afterwards — never donate
+            entry._fns = {"exact": jax.jit(_compute)}
+
+    entry._build = build
+    build(entry.donate)
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+def instance_stats(obj: Any) -> Dict[str, int]:
+    stats = obj.__dict__.get("_compile_stats")
+    if stats is None:
+        stats = new_stats()
+        obj._compile_stats = stats
+    return stats
+
+
+def _python_init_probe(metric: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> None:
+    """Run the update body once abstractly (``eval_shape``: trace only, no
+    compile) so Python-level side effects happen even when every jitted
+    dispatch of this instance is a shared-cache hit."""
+    saved = metric._snapshot_state()
+
+    def _run(state, a, kw):
+        metric._restore_state(state)
+        metric._inner_update(*a, **kw)
+        return metric._snapshot_state()
+
+    try:
+        jax.eval_shape(_run, saved, args, kwargs)
+    finally:
+        metric._restore_state(saved)
+
+
+def ensure_python_init(metric: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> None:
+    """Run the python-init probe once per instance (no-op afterwards).
+
+    Raises the same trace-incompatibility errors a jit trace would, so
+    callers route the metric to its eager fallback identically.
+    """
+    if not metric.__dict__.get("_engine_probed", False):
+        _python_init_probe(metric, args, kwargs)
+        metric._engine_probed = True
+
+
+def update_transition(metric: Any, state: Dict[str, Any], args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """Dispatch one metric update through the shared compile cache.
+
+    Raises whatever the trace raises — the caller (``Metric._update_impl``)
+    owns the eager-fallback policy.
+    """
+    ensure_python_init(metric, args, kwargs)
+    key, pins = metric_fingerprint(metric)
+    entry = _get_or_create(("metric_update", key), lambda: _make_metric_entry(key, pins))
+    stats = instance_stats(metric)
+    spec = bucketing.bucket_spec(metric, args, kwargs)
+    # the pure API (update_state) sets _engine_no_donate: the caller owns the
+    # state argument, so it must never be consumed
+    donate_call = entry.donate and not metric.__dict__.get("_engine_no_donate", False)
+    suffix = "" if donate_call else "_nodonate"
+    if donate_call:
+        state = guard_donated_state(metric, state)
+    if spec is None:
+        return entry.invoke("exact" + suffix, metric, stats, state, args, kwargs)
+    leaves, treedef, batched, pad = spec
+    padded = bucketing.pad_leaves(leaves, batched, pad)
+    return entry.invoke(
+        "bucketed" + suffix,
+        metric,
+        stats,
+        state,
+        tuple(padded),
+        jnp.asarray(pad, jnp.int32),
+        treedef,
+        batched,
+    )
+
+
+def fused_entry(kind: str, keys: Tuple[str, ...], members: List[Any]) -> SharedEntry:
+    """Shared entry for a collection's fused program: keyed by the member
+    names *and* every member's fingerprint, so clones of one collection (and
+    independent collections with identical members) share one compile."""
+    member_keys: List[Any] = []
+    pins: List[Any] = []
+    for m in members:
+        k, p = metric_fingerprint(m)
+        member_keys.append(k)
+        pins.extend(p)
+    cache_key = (kind, tuple(keys), tuple(member_keys))
+    return _get_or_create(
+        cache_key, lambda: _make_fused_entry(kind, tuple(keys), cache_key, tuple(pins))
+    )
+
+
+# ---------------------------------------------------------------------------
+# introspection / lifecycle
+# ---------------------------------------------------------------------------
+def clear_cache() -> None:
+    """Drop every shared entry (compiled programs and telemetry). Instances
+    keep their own ``_compile_stats`` counters."""
+    with _LOCK:
+        _CACHE.clear()
+
+
+def cache_summary() -> Dict[str, Any]:
+    """Aggregate process-wide compile telemetry across all shared entries."""
+    with _LOCK:
+        entries = list(_CACHE.values())
+    by_kind: Dict[str, Dict[str, int]] = {}
+    totals = {"calls": 0, "compiles": 0, "cache_hits": 0, "retraces": 0, "donated_bytes": 0, "bucketed_calls": 0}
+    for e in entries:
+        s = e.summary()
+        kind = by_kind.setdefault(s["kind"], {"entries": 0, **{k: 0 for k in totals}})
+        kind["entries"] += 1
+        for k in totals:
+            kind[k] += s[k]
+            totals[k] += s[k]
+    return {"entries": len(entries), **totals, "donation_active": donation_enabled(), "by_kind": by_kind}

@@ -46,8 +46,14 @@ def _stat_scores(
     # reference's equality-mask decomposition, stat_scores.py:44-60, reads
     # both [N, C] operands four times):
     #   tp = Σ pt,  fp = Σ p − tp,  fn = Σ t − tp,  tn = count − Σp − Σt + tp
-    p = preds.astype(jnp.int32)
-    t = target.astype(jnp.int32)
+    # Accumulation dtype: the lane default int — int64 under jax_enable_x64,
+    # so micro/mdmc-global streams over >2^31 elements can't overflow the
+    # sums (which would corrupt `tn` through the `count − sums` identity).
+    # Without x64 the int32 bound stands: keep per-call batches under ~2.1e9
+    # counted elements per class, or enable x64 for the long tail.
+    int_dtype = jnp.asarray(0).dtype
+    p = preds.astype(int_dtype)
+    t = target.astype(int_dtype)
     tp = jnp.sum(p * t, axis=dim)
     sum_p = jnp.sum(p, axis=dim)
     sum_t = jnp.sum(t, axis=dim)
@@ -58,7 +64,7 @@ def _stat_scores(
     fn = sum_t - tp
     tn = count - sum_p - sum_t + tp
 
-    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+    return tp.astype(int_dtype), fp.astype(int_dtype), tn.astype(int_dtype), fn.astype(int_dtype)
 
 
 def _stat_scores_update(

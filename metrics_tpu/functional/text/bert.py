@@ -323,12 +323,13 @@ def bert_score(
         preds_emb = jnp.asarray(forward(preds_tok["input_ids"][sl], preds_tok["attention_mask"][sl]))
         target_emb = jnp.asarray(forward(target_tok["input_ids"][sl], target_tok["attention_mask"][sl]))
         want_ndim = 4 if all_layers else 3
-        if preds_emb.ndim != want_ndim:
-            raise ValueError(
-                f"With `all_layers={all_layers}` the encoder must return a rank-{want_ndim} array"
-                f" ({'[num_layers, n, seq_len, dim]' if all_layers else '[n, seq_len, dim]'}),"
-                f" got shape {tuple(preds_emb.shape)}."
-            )
+        for side, emb in (("preds", preds_emb), ("target", target_emb)):
+            if emb.ndim != want_ndim:
+                raise ValueError(
+                    f"With `all_layers={all_layers}` the encoder must return a rank-{want_ndim} array"
+                    f" ({'[num_layers, n, seq_len, dim]' if all_layers else '[n, seq_len, dim]'}),"
+                    f" got shape {tuple(emb.shape)} for the {side} sentences."
+                )
         chunks.append(
             score_fn(
                 preds_emb,

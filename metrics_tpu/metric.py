@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.engine import cache as _engine
 from metrics_tpu.parallel import comm
 from metrics_tpu.utils.data import _squeeze_if_scalar, dim_zero_cat
 from metrics_tpu.utils.exceptions import JitIncompatibleError, MetricsUserError
@@ -93,7 +94,16 @@ class Metric:
             :func:`metrics_tpu.parallel.comm.gather_all_arrays`.
         axis_name: named mesh axis (or axes) for in-trace sync when the metric
             is used through the pure API inside ``shard_map``/``pmap``.
-        jit_update: auto-jit the update transition (default True).
+        jit_update: auto-jit the update transition (default True). Compiled
+            transitions are shared process-wide across instances with the
+            same class/config/input signature (see ``metrics_tpu.engine``).
+        jit_bucket: ``'pow2'`` pads the batch axis of update inputs to
+            power-of-two buckets (with an exact row-additive correction for
+            the padding), capping retraces at O(log max_batch) under ragged
+            streaming batch sizes. Only engages for metrics that declare
+            ``_batch_additive`` (stat-scores-family classification,
+            sum aggregation, regression sums); everything else keeps
+            exact-shape jit. Default ``None`` (exact shapes).
 
     Example:
         >>> import jax.numpy as jnp
@@ -125,6 +135,14 @@ class Metric:
     # override this as a property (e.g. bounded sample buffers, whose
     # collection branches on a concrete count).
     _compute_is_host_side: bool = False
+    # Opt-in contract for ``jit_bucket`` shape bucketing: every batch row
+    # contributes independently and additively to every 'sum'-reduced state,
+    # with axis 0 of each rank>=1 array input being the batch axis (see
+    # ``metrics_tpu.engine.bucketing``). Classes whose updates are row-wise
+    # sums (stat scores, confusion counts, sum/mean aggregation, regression
+    # error sums) set this True — possibly as a property gating config that
+    # breaks additivity (e.g. macro ``ignore_index`` marking).
+    _batch_additive: bool = False
 
     def __init__(
         self,
@@ -134,6 +152,7 @@ class Metric:
         dist_sync_fn: Optional[Callable] = None,
         axis_name: Optional[Union[str, Sequence[str]]] = None,
         jit_update: bool = True,
+        jit_bucket: Optional[str] = None,
     ) -> None:
         self._device = None
         self.compute_on_step = compute_on_step
@@ -173,9 +192,13 @@ class Metric:
         # test/advanced hook: override the "is a distributed world present" check
         self._distributed_available_fn: Optional[Callable] = None
 
+        if jit_bucket not in (None, "pow2"):
+            raise ValueError(f"`jit_bucket` must be None or 'pow2', got {jit_bucket!r}")
+        self.jit_bucket = jit_bucket
         self._enable_jit = jit_update
         self._jit_failed = False
-        self._jitted_transition: Optional[Callable] = None
+        self._engine_probed = False
+        self._compile_stats = _engine.new_stats()
 
     # ------------------------------------------------------------------
     # state registration
@@ -247,13 +270,22 @@ class Metric:
             self._restore_state(saved)
 
     def update_state(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Pure update: ``state, batch -> new state``. Safe inside jit/scan."""
+        """Pure update: ``state, batch -> new state``. Safe inside jit/scan.
+
+        The caller owns ``state``: this path never donates it to XLA (the
+        OO ``update`` owns its buffers and may; a pure function must not
+        consume its argument).
+        """
 
         def _run() -> Dict[str, Any]:
             self._update_impl(*args, **kwargs)
             return self._snapshot_state()
 
-        return self._with_state(state, _run)
+        self._engine_no_donate = True
+        try:
+            return self._with_state(state, _run)
+        finally:
+            self._engine_no_donate = False
 
     def compute_state(self, state: Dict[str, Any]) -> Any:
         """Pure compute: ``state -> value``. Safe inside jit."""
@@ -391,32 +423,44 @@ class Metric:
         return wrapped_func
 
     def _update_impl(self, *args: Any, **kwargs: Any) -> None:
-        """Dispatch one update, through jit when possible."""
+        """Dispatch one update, through the shared-jit engine when possible."""
         if not self._enable_jit or self._jit_failed or self._has_list_state():
             self._inner_update(*args, **kwargs)
             return
         saved = self._snapshot_state()
         try:
-            if self._jitted_transition is None:
-                self._jitted_transition = jax.jit(self._jit_transition)
-            new_state = self._jitted_transition(saved, *args, **kwargs)
+            new_state = _engine.update_transition(self, saved, args, kwargs)
         except _JIT_FALLBACK_ERRORS:
             self._jit_failed = True
             self._restore_state(saved)
             self._inner_update(*args, **kwargs)
             return
         except Exception:
-            self._restore_state(saved)
+            # a donated runtime failure may have consumed `saved`'s buffers —
+            # rollback_state swaps in defaults rather than deleted arrays
+            self._restore_state(_engine.rollback_state(self, saved))
             raise
         self._restore_state(new_state)
 
-    def _jit_transition(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        self._restore_state(state)
-        self._inner_update(*args, **kwargs)
-        return self._snapshot_state()
-
     def _has_list_state(self) -> bool:
         return any(isinstance(getattr(self, n), list) for n in self._defaults)
+
+    def compile_stats(self) -> Dict[str, Any]:
+        """Compile telemetry for this instance's jitted dispatches.
+
+        ``compiles`` counts traces this instance triggered; ``cache_hits``
+        counts updates served by an already-compiled shared program (possibly
+        compiled by *another* instance — see ``metrics_tpu.engine``);
+        ``retraces`` counts traces beyond each program family's first;
+        ``donated_bytes`` accumulates state bytes donated to XLA; and
+        ``bucketed_calls`` counts updates routed through ``jit_bucket``
+        padding. Process-wide aggregates: ``metrics_tpu.engine.cache_summary``.
+        """
+        out: Dict[str, Any] = dict(self._compile_stats)
+        out["jit_enabled"] = self._enable_jit
+        out["jit_failed"] = self._jit_failed
+        out["jit_bucket"] = self.jit_bucket
+        return out
 
     # -- compute wrapping -----------------------------------------------
     def _wrap_compute(self, compute: Callable) -> Callable:
@@ -642,7 +686,14 @@ class Metric:
             key = prefix + name
             if key in state_dict:
                 v = state_dict[key]
-                setattr(self, name, [jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v))
+                # copy (not view) jax-array inputs: donated updates may later
+                # invalidate the state buffer, which must not reach back into
+                # the caller's arrays
+                setattr(
+                    self,
+                    name,
+                    [jnp.array(x, copy=True) for x in v] if isinstance(v, list) else jnp.array(v, copy=True),
+                )
             elif strict and self._persistent[name]:
                 raise KeyError(f"Missing state {key!r} in state_dict")
 
@@ -653,7 +704,16 @@ class Metric:
         state = {
             k: v
             for k, v in self.__dict__.items()
-            if k not in ("update", "compute", "_update_signature", "_jitted_transition", "_inner_update", "_compute_impl")
+            if k
+            not in (
+                "update",
+                "compute",
+                "_update_signature",
+                "_engine_key",
+                "_engine_key_pins",
+                "_inner_update",
+                "_compute_impl",
+            )
         }
         # device arrays -> numpy for portability
         def _np(x: Any) -> Any:
@@ -669,7 +729,13 @@ class Metric:
         self._update_signature = inspect.signature(self.update)
         self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute = self._wrap_compute(self.compute)  # type: ignore[method-assign]
-        self._jitted_transition = None
+        # shared-cache identity is process-local (id-pinned objects): recompute
+        # on first dispatch; telemetry counters describe live dispatches only
+        self.__dict__.pop("_engine_key", None)
+        self.__dict__.pop("_engine_key_pins", None)
+        self._compile_stats = _engine.new_stats()
+        self.__dict__.setdefault("_engine_probed", False)
+        self.__dict__.setdefault("jit_bucket", None)
         for name in self._defaults:
             v = getattr(self, name, None)
             if isinstance(v, list):

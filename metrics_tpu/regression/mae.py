@@ -23,6 +23,8 @@ class MeanAbsoluteError(Metric):
 
     is_differentiable = True
     higher_is_better = False
+    # per-row absolute-error sums + element counts: `jit_bucket`-eligible
+    _batch_additive = True
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -25,6 +25,8 @@ class MeanSquaredError(Metric):
 
     is_differentiable = True
     higher_is_better = False
+    # per-row squared-error sums + element counts: `jit_bucket`-eligible
+    _batch_additive = True
 
     def __init__(self, squared: bool = True, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -1,0 +1,229 @@
+"""Shape bucketing: exact parity with unpadded eager updates + retrace caps."""
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    Accuracy,
+    ConfusionMatrix,
+    F1Score,
+    MaxMetric,
+    MeanMetric,
+    MeanSquaredError,
+    MetricCollection,
+    StatScores,
+    SumMetric,
+    engine,
+)
+
+RAGGED = [7, 1, 33, 100, 257, 64]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    yield
+    engine.clear_cache()
+
+
+def _cls_batches(seed, sizes, c=5):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(n, c).astype(np.float32)),
+            jnp.asarray(rng.randint(0, c, size=(n,)).astype(np.int32)),
+        )
+        for n in sizes
+    ]
+
+
+def _assert_states_equal(bucketed, eager, exact=True):
+    for name in bucketed._defaults:
+        a = np.asarray(getattr(bucketed, name))
+        b = np.asarray(getattr(eager, name))
+        if exact:
+            np.testing.assert_array_equal(a, b, err_msg=name)
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-6, err_msg=name)
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [
+        lambda: Accuracy(num_classes=5),
+        lambda: Accuracy(num_classes=5, top_k=2),
+        lambda: ConfusionMatrix(num_classes=5),
+        lambda: StatScores(reduce="macro", num_classes=5),
+        lambda: F1Score(num_classes=5, average="macro"),
+    ],
+    ids=["accuracy", "accuracy_top_k", "confmat", "stat_scores_macro", "f1"],
+)
+def test_bucketed_classification_bitwise_parity(factory):
+    """Integer accumulators: padded+corrected states must be bitwise equal
+    to the unpadded eager states at every ragged batch size."""
+    bucketed = factory()
+    bucketed.jit_bucket = "pow2"
+    eager = factory()
+    eager._enable_jit = False
+    for p, t in _cls_batches(0, RAGGED):
+        bucketed.update(p, t)
+        eager.update(p, t)
+        _assert_states_equal(bucketed, eager, exact=True)
+    assert bucketed.compile_stats()["bucketed_calls"] == len(RAGGED)
+    np.testing.assert_allclose(np.asarray(bucketed.compute()), np.asarray(eager.compute()))
+
+
+@pytest.mark.parametrize(
+    "factory,update_args",
+    [
+        (
+            lambda: MeanSquaredError(),
+            lambda rng, n: (
+                jnp.asarray(rng.rand(n).astype(np.float32)),
+                jnp.asarray(rng.rand(n).astype(np.float32)),
+            ),
+        ),
+        (
+            lambda: SumMetric(nan_strategy="disable"),
+            lambda rng, n: (jnp.asarray(rng.rand(n).astype(np.float32)),),
+        ),
+        (
+            lambda: MeanMetric(nan_strategy="disable"),
+            lambda rng, n: (
+                jnp.asarray(rng.rand(n).astype(np.float32)),
+                jnp.asarray(rng.rand(n).astype(np.float32)),
+            ),
+        ),
+    ],
+    ids=["mse", "sum", "weighted_mean"],
+)
+def test_bucketed_float_sums_parity(factory, update_args):
+    """Float accumulators: summation order differs under padding, so parity
+    is allclose (tight), not bitwise."""
+    bucketed = factory()
+    bucketed.jit_bucket = "pow2"
+    eager = factory()
+    eager._enable_jit = False
+    rng = np.random.RandomState(1)
+    for n in RAGGED:
+        args = update_args(rng, n)
+        bucketed.update(*args)
+        eager.update(*args)
+    assert bucketed.compile_stats()["bucketed_calls"] == len(RAGGED)
+    _assert_states_equal(bucketed, eager, exact=False)
+    np.testing.assert_allclose(
+        np.asarray(bucketed.compute()), np.asarray(eager.compute()), rtol=1e-5
+    )
+
+
+def test_retrace_cap_log2_max_batch():
+    """Streaming 7/1000/8192 under pow2 bucketing compiles at most
+    ceil(log2(8192)) + 1 distinct programs — here exactly one per bucket."""
+    sizes = [7, 1000, 8192, 900, 6]
+    m = Accuracy(num_classes=3, jit_bucket="pow2")
+    for p, t in _cls_batches(2, sizes, c=3):
+        m.update(p, t)
+    stats = m.compile_stats()
+    buckets = {engine.next_pow2(n) for n in sizes}
+    # one program per bucket {8, 1024, 8192}, plus at most one extra for the
+    # first bucket's fresh-state signature (weak-typed defaults) when that
+    # bucket is revisited with accumulated state
+    assert len(buckets) <= stats["compiles"] <= len(buckets) + 1
+    assert stats["compiles"] <= math.ceil(math.log2(max(sizes))) + 1
+    # a second instance streaming the same shapes compiles nothing at all
+    m2 = Accuracy(num_classes=3, jit_bucket="pow2")
+    for p, t in _cls_batches(3, sizes, c=3):
+        m2.update(p, t)
+    assert m2.compile_stats()["compiles"] == 0
+    assert m2.compile_stats()["cache_hits"] == len(sizes)
+
+
+def test_bucketed_matches_eager_across_the_same_stream():
+    sizes = [7, 1000, 8192, 900, 6]
+    m = Accuracy(num_classes=3, jit_bucket="pow2")
+    e = Accuracy(num_classes=3, jit_update=False)
+    for p, t in _cls_batches(4, sizes, c=3):
+        m.update(p, t)
+        e.update(p, t)
+    _assert_states_equal(m, e, exact=True)
+    np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(e.compute()))
+
+
+def test_bucketed_preserves_nonfinite_accumulators():
+    """±inf flowing through a bucketed sum must survive exactly as it does
+    eagerly — the zero-row padding correction must never manufacture NaN
+    (0·inf / inf−inf), at exact power-of-two batches or ragged ones."""
+    for n in (4, 7):  # pad == 0 and pad > 0
+        bucketed = SumMetric(nan_strategy="disable", jit_bucket="pow2")
+        eager = SumMetric(nan_strategy="disable", jit_update=False)
+        x = jnp.asarray([1.0, float("inf"), 2.0, 3.0, -1.0, 0.5, 4.0][:n])
+        bucketed.update(x)
+        eager.update(x)
+        assert bucketed.compile_stats()["bucketed_calls"] == 1
+        a, b = float(bucketed.compute()), float(eager.compute())
+        assert a == b == float("inf"), (n, a, b)
+
+
+def test_non_additive_metric_falls_back_to_exact_shape():
+    """MaxMetric can't express the padding correction: jit_bucket must be a
+    no-op (exact-shape jit), never a wrong answer."""
+    m = MaxMetric(nan_strategy="disable", jit_bucket="pow2")
+    e = MaxMetric(nan_strategy="disable", jit_update=False)
+    rng = np.random.RandomState(5)
+    for n in (7, 33):
+        x = jnp.asarray(rng.rand(n).astype(np.float32))
+        m.update(x)
+        e.update(x)
+    assert m.compile_stats()["bucketed_calls"] == 0
+    np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(e.compute()))
+
+
+def test_macro_ignore_index_falls_back_to_exact_shape():
+    """The macro ignore_index `-1` marker is not row-additive: the gate must
+    route those instances to exact-shape jit and keep results identical."""
+    kw = dict(num_classes=5, average="macro", ignore_index=1)
+    m = Accuracy(jit_bucket="pow2", **kw)
+    e = Accuracy(jit_update=False, **kw)
+    for p, t in _cls_batches(6, [7, 33]):
+        m.update(p, t)
+        e.update(p, t)
+    assert m.compile_stats()["bucketed_calls"] == 0
+    _assert_states_equal(m, e, exact=True)
+    np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(e.compute()))
+
+
+def test_invalid_jit_bucket_rejected():
+    with pytest.raises(ValueError, match="jit_bucket"):
+        Accuracy(num_classes=2, jit_bucket="pow3")
+
+
+def test_collection_fused_update_buckets():
+    """A collection of bucket-eligible members pads once and corrects every
+    member exactly; parity against the per-member eager path."""
+    sizes = [7, 33, 100, 64]
+
+    def mk(**kw):
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=5, **kw),
+                "cm": ConfusionMatrix(num_classes=5, **kw),
+                "f1": F1Score(num_classes=5, average="macro", **kw),
+            }
+        )
+
+    fused = mk(jit_bucket="pow2")
+    eager = mk(jit_update=False)
+    for p, t in _cls_batches(7, sizes):
+        fused.update(p, t)
+        eager.update(p, t)
+    for key, m in fused.items(keep_base=True):
+        _assert_states_equal(m, eager[key], exact=True)
+    assert fused.compile_stats()["bucketed_calls"] == len(sizes)
+    # one fused program per bucket: {8, 64, 128}
+    assert fused.compile_stats()["compiles"] == len({engine.next_pow2(n) for n in sizes})
+    rf, re_ = fused.compute(), eager.compute()
+    for k in rf:
+        np.testing.assert_allclose(np.asarray(rf[k]), np.asarray(re_[k]))

@@ -1,0 +1,240 @@
+"""Shared compile cache: cross-instance sharing, telemetry, donation policy."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, MeanSquaredError, MetricCollection, engine
+from metrics_tpu.metric import Metric
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    yield
+    engine.clear_cache()
+
+
+def _batch(rng, n=16, c=5):
+    return (
+        jnp.asarray(rng.rand(n, c).astype(np.float32)),
+        jnp.asarray(rng.randint(0, c, size=(n,)).astype(np.int32)),
+    )
+
+
+def test_two_instances_share_one_compile():
+    rng = np.random.RandomState(0)
+    p, t = _batch(rng)
+    m1, m2 = Accuracy(num_classes=5), Accuracy(num_classes=5)
+    m1.update(p, t)
+    m2.update(p, t)
+    s1, s2 = m1.compile_stats(), m2.compile_stats()
+    assert s1["compiles"] == 1
+    assert s2["compiles"] == 0 and s2["cache_hits"] == 1
+    summary = engine.cache_summary()
+    assert summary["by_kind"]["metric_update"]["entries"] == 1
+    assert summary["by_kind"]["metric_update"]["compiles"] == 1
+    np.testing.assert_allclose(np.asarray(m1.compute()), np.asarray(m2.compute()))
+
+
+def test_shared_cache_matches_eager():
+    rng = np.random.RandomState(1)
+    m_jit, m_eager = MeanSquaredError(), MeanSquaredError(jit_update=False)
+    for _ in range(3):
+        p = jnp.asarray(rng.rand(8).astype(np.float32))
+        t = jnp.asarray(rng.rand(8).astype(np.float32))
+        m_jit.update(p, t)
+        m_eager.update(p, t)
+    np.testing.assert_allclose(
+        np.asarray(m_jit.compute()), np.asarray(m_eager.compute()), rtol=1e-6
+    )
+
+
+def test_different_config_not_shared():
+    rng = np.random.RandomState(2)
+    p, t = _batch(rng)
+    m1 = Accuracy(num_classes=5, threshold=0.3)
+    m2 = Accuracy(num_classes=5, threshold=0.7)
+    m1.update(p, t)
+    m2.update(p, t)
+    assert m1.compile_stats()["compiles"] == 1
+    assert m2.compile_stats()["compiles"] == 1  # its own program, not a hit
+    assert engine.cache_summary()["by_kind"]["metric_update"]["entries"] == 2
+
+
+def test_python_init_probe_runs_for_cached_instance():
+    """An instance whose first update is a pure cache hit must still derive
+    its Python-level attributes (Accuracy.mode) so compute() works."""
+    rng = np.random.RandomState(3)
+    p, t = _batch(rng)
+    m1, m2 = Accuracy(num_classes=5), Accuracy(num_classes=5)
+    m1.update(p, t)
+    m2.update(p, t)
+    assert m2.compile_stats()["compiles"] == 0  # really was a pure hit
+    assert m2.mode is not None
+    float(m2.compute())  # would raise "have to have determined mode" unprobed
+
+
+def test_clone_shares_compiled_transition():
+    rng = np.random.RandomState(4)
+    p, t = _batch(rng)
+    base = Accuracy(num_classes=5)
+    base.update(p, t)
+    # the first clone may retrace once (deepcopy's numpy round-trip drops
+    # jax weak-type flags, changing the state aval signature) ...
+    clone1 = base.clone()
+    clone1.update(p, t)
+    assert clone1.compile_stats()["compiles"] <= 1
+    # ... every further clone rides the shared cache outright — the
+    # BootStrapper-fleet case the shared cache exists for
+    clone2 = base.clone()
+    clone2.update(p, t)
+    assert clone2.compile_stats()["compiles"] == 0
+    assert clone2.compile_stats()["cache_hits"] == 1
+
+
+def test_collections_share_fused_programs():
+    rng = np.random.RandomState(5)
+    p, t = _batch(rng, n=32)
+
+    def mk():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=5),
+                "cm": ConfusionMatrix(num_classes=5),
+                "f1": F1Score(num_classes=5, average="macro"),
+            }
+        )
+
+    mc1, mc2 = mk(), mk()
+    mc1.update(p, t)
+    mc2.update(p, t)
+    assert mc1.compile_stats()["compiles"] == 1
+    assert mc2.compile_stats()["compiles"] == 0
+    assert mc2.compile_stats()["cache_hits"] == 1
+    r1, r2 = mc1.compute(), mc2.compute()
+    for k in r1:
+        np.testing.assert_allclose(np.asarray(r1[k]), np.asarray(r2[k]))
+    by_kind = engine.cache_summary()["by_kind"]
+    assert by_kind["fused_update"]["entries"] == 1
+    assert by_kind["fused_compute"]["entries"] == 1
+
+
+def test_retrace_counted_per_new_shape():
+    rng = np.random.RandomState(6)
+    m = Accuracy(num_classes=5)  # exact-shape jit: every new batch retraces
+    for n in (8, 16, 8):
+        p, t = _batch(rng, n=n)
+        m.update(p, t)
+    s = m.compile_stats()
+    assert s["compiles"] == 2 and s["retraces"] == 1 and s["cache_hits"] == 1
+
+
+def test_donation_fallback_on_cpu():
+    """CPU has no buffer donation: the engine must not request it, report
+    donation inactive, and still produce correct results."""
+    assert jax.default_backend() == "cpu"
+    rng = np.random.RandomState(7)
+    p, t = _batch(rng)
+    m = Accuracy(num_classes=5)
+    m.update(p, t)
+    assert m.compile_stats()["donated_bytes"] == 0
+    assert engine.cache_summary()["donation_active"] is False
+    assert engine.cache_summary()["donated_bytes"] == 0
+    float(m.compute())
+
+
+def test_forced_donation_does_not_corrupt_defaults():
+    """Even with donation forced on (CPU ignores the aliasing but exercises
+    the guard path), defaults survive a first-update donation and reset
+    still works."""
+    engine.set_donation(True)
+    try:
+        rng = np.random.RandomState(8)
+        p, t = _batch(rng)
+        m = Accuracy(num_classes=5)
+        m.update(p, t)
+        assert m.compile_stats()["donated_bytes"] > 0
+        m.reset()
+        m.update(p, t)
+        float(m.compute())
+    finally:
+        engine.set_donation(None)
+        engine.clear_cache()
+
+
+def test_pure_api_never_donates_caller_state():
+    """update_state is a pure function: even with donation forced on, the
+    caller-held state pytree must survive the call (the OO path may donate
+    its own buffers; the pure path must not consume its argument)."""
+    engine.set_donation(True)
+    try:
+        engine.clear_cache()
+        rng = np.random.RandomState(10)
+        p, t = _batch(rng)
+        m = Accuracy(num_classes=5)
+        s1 = m.init_state()
+        s2 = m.update_state(s1, p, t)
+        m.update_state(s2, p, t)
+        for v in s2.values():  # caller-held state still usable
+            assert not v.is_deleted()
+        float(np.asarray(m.compute_state(s2)))
+        assert m.compile_stats()["donated_bytes"] == 0  # nodonate path taken
+    finally:
+        engine.set_donation(None)
+        engine.clear_cache()
+
+
+def test_sync_only_config_does_not_split_the_cache():
+    """Host-level sync config (per-instance callables included) never enters
+    the traced update, so it must not defeat cross-instance sharing."""
+    rng = np.random.RandomState(11)
+    p, t = _batch(rng)
+    m1 = Accuracy(num_classes=5, dist_sync_fn=lambda arr, group: [arr])
+    m2 = Accuracy(num_classes=5, dist_sync_fn=lambda arr, group: [arr])
+    m1.update(p, t)
+    m2.update(p, t)
+    assert m2.compile_stats()["compiles"] == 0
+    assert m2.compile_stats()["cache_hits"] == 1
+
+
+def test_eager_fallback_still_works_with_shared_cache():
+    class NanGuard(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            if bool(jnp.isnan(x).any()):  # concretization under trace
+                raise RuntimeError("nan")
+            self.total = self.total + jnp.sum(x)
+
+        def compute(self):
+            return self.total
+
+    m = NanGuard()
+    m.update(jnp.asarray([1.0, 2.0]))
+    assert m._jit_failed
+    assert np.asarray(m.compute()) == 3.0
+    m.update(jnp.asarray([3.0]))
+    assert np.asarray(m.compute()) == 6.0
+
+
+def test_reset_reprobes_fused_compute_exclusions():
+    """A member evicted from the fused compute path is re-probed after
+    reset() instead of staying excluded forever."""
+    rng = np.random.RandomState(9)
+    p, t = _batch(rng)
+    mc = MetricCollection(
+        {"acc": Accuracy(num_classes=5), "cm": ConfusionMatrix(num_classes=5)}
+    )
+    mc.update(p, t)
+    mc._fused_cmp_excluded["acc"] = mc["acc"]._update_count  # simulate eviction
+    mc.compute()
+    assert "acc" in mc._fused_cmp_excluded
+    mc.reset()
+    assert mc._fused_cmp_excluded == {}
+    mc.update(p, t)
+    out = mc.compute()  # fused path re-probes and includes the member again
+    assert set(out) == {"acc", "cm"}

@@ -27,9 +27,23 @@ EOF
     line=$(printf '%s' "$out" | grep PROBE_OK || true)
     if [ -n "$line" ]; then
         plat=$(printf '%s' "$line" | awk '{print $2}')
-        ndev=$(printf '%s' "$line" | awk '{print $3}')
-        kind=$(printf '%s' "$line" | awk '{$1=$2=$3=""; sub(/^ +/,""); print}')
-        echo "{\"t\": \"$start\", \"ok\": true, \"platform\": \"$plat\", \"n_devices\": $ndev, \"device_kind\": \"$kind\", \"probe_s\": $dt}" >> "$LOG"
+        # build the JSONL line with json.dumps, not shell interpolation: a
+        # device_kind containing a quote (or any JSON metachar) must not be
+        # able to corrupt the log. -S skips sitecustomize (no jax preimport)
+        # and the timeout guards the one python call here that would
+        # otherwise hang the loop if interpreter startup wedges.
+        printf '%s' "$line" | PROBE_T="$start" PROBE_S="$dt" timeout 60 python -S -c '
+import json, os, sys
+parts = sys.stdin.read().split()
+print(json.dumps({
+    "t": os.environ["PROBE_T"],
+    "ok": True,
+    "platform": parts[1],
+    "n_devices": int(parts[2]),
+    "device_kind": " ".join(parts[3:]),
+    "probe_s": int(os.environ["PROBE_S"]),
+}))
+' >> "$LOG"
         if [ "$plat" != "cpu" ]; then
             touch "$REPO/TPU_WINDOW_OPEN"
         fi
