@@ -1028,6 +1028,67 @@ def bench_engine_compile_stats() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# sync resilience: fault-injected KV exchanges through the retry machinery
+# ---------------------------------------------------------------------------
+def bench_sync_resilience() -> dict:
+    """Drive the host-level sync stack through a deterministic drop+corrupt
+    fault sequence (simulated 2-rank world, in-memory KV fake) and report the
+    ``sync_report()`` telemetry — the resilience mirror of
+    ``bench_engine_compile_stats``. Sync 1: rank 1's payload is dropped, so
+    rank 0 degrades to a partial sync recording the missing rank. Sync 2:
+    rank 1's payload is corrupted once, so rank 0 retries and recovers the
+    full result. ``ci.sh`` asserts these fields exactly."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import SumMetric
+    from metrics_tpu.parallel import new_group
+    from metrics_tpu.resilience import FaultSpec, InMemoryKVStore, RetryPolicy, run_as_peers
+
+    retry = RetryPolicy(max_attempts=3, backoff_base_s=0.02, backoff_max_s=0.1)
+    group = new_group([0, 1], name="bench_resilience", timeout_s=4.0, retry=retry)
+    store = InMemoryKVStore(
+        [FaultSpec("drop", rank=1, epoch=0), FaultSpec("corrupt", rank=1, epoch=1)]
+    )
+    # rank r contributes 10^r, so local=1, full=11 — unambiguous outcomes
+    metrics = [SumMetric(process_group=group, on_sync_error="partial") for _ in range(2)]
+    for rank, m in enumerate(metrics):
+        m.update(jnp.asarray(float(10**rank)))
+
+    t0 = time.perf_counter()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        first = run_as_peers(2, lambda r: float(metrics[r].compute()), store=store)
+        missing_first = list(metrics[0].sync_report()["missing_ranks"])
+        for m in metrics:
+            m.update(jnp.asarray(0.0))  # invalidate the compute cache
+        second = run_as_peers(2, lambda r: float(metrics[r].compute()), store=store)
+    elapsed = time.perf_counter() - t0
+
+    report = metrics[0].sync_report()
+    return {
+        "metric": "sync_resilience",
+        "value": report["attempts"],
+        "unit": "kv_read_attempts",
+        "vs_baseline": None,
+        "syncs": report["syncs"],
+        "retries": report["retries"],
+        "kv_timeouts": report["kv_timeouts"],
+        "integrity_failures": report["integrity_failures"],
+        "degraded_partial": report["degraded_partial"],
+        "backoff_s": round(report["backoff_s"], 4),
+        "bytes_sent": report["bytes_sent"],
+        "bytes_received": report["bytes_received"],
+        "drop_sync_missing_ranks": missing_first,
+        "drop_sync_value_rank0": first[0],
+        "retried_sync_value_rank0": second[0],
+        "retried_sync_ok": second[0] == 11.0,
+        "elapsed_s": round(elapsed, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
 # module-API compute() latency on the live backend
 # ---------------------------------------------------------------------------
 def bench_compute_latency() -> dict:
@@ -1107,6 +1168,7 @@ _CONFIGS = [
     ("bench_topk_kernel", 1200, True),
     ("bench_compute_latency", 900, True),
     ("bench_engine_compile_stats", 900, True),
+    ("bench_sync_resilience", 600, False),
 ]
 
 _PERSIST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_PARTIAL.json")
@@ -1312,6 +1374,21 @@ def _run_config(name: str, timeout_s: int, needs_accel: bool, persisted: dict) -
 
 
 def main() -> None:
+    if "--sync-smoke" in sys.argv:
+        # CI fault-injection smoke: deterministic drop+corrupt sequence
+        # through the real sync stack on CPU, one JSON line (see --smoke for
+        # why the platform pin must go through jax.config).
+        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
+        if forced:
+            import jax
+
+            jax.config.update("jax_platforms", forced)
+        result = bench_sync_resilience()
+        for key, value in _stamp().items():
+            result.setdefault(key, value)
+        emit(result)
+        return
+
     if "--smoke" in sys.argv:
         # CI telemetry smoke: one in-process engine exercise, one JSON line.
         # The env pre-imports jax (axon sitecustomize), so a JAX_PLATFORMS

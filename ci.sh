@@ -29,4 +29,21 @@ assert obj["second_instance_compiles"] == 0, f"clone instance recompiled: {obj}"
 print("engine smoke OK:", line)
 '
 
+echo "=== resilience fault-injection smoke (drop+corrupt through the retry stack) ==="
+JAX_PLATFORMS=cpu python bench.py --sync-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "sync_resilience", obj
+# the drop fault: sync 1 degrades to partial, recording EXACTLY rank 1 missing
+assert obj["drop_sync_missing_ranks"] == [1], obj
+assert obj["degraded_partial"] == 1, obj
+assert obj["drop_sync_value_rank0"] == 1.0, f"partial sync must equal the responder-local reduction: {obj}"
+# the corrupt fault: sync 2 retries once on the checksum failure and recovers the FULL result
+assert obj["integrity_failures"] == 1, obj
+assert obj["retries"] >= 1, obj
+assert obj["retried_sync_ok"] and obj["retried_sync_value_rank0"] == 11.0, f"retried sync did not recover: {obj}"
+print("resilience smoke OK:", line)
+'
+
 echo "both lanes green"

@@ -582,6 +582,23 @@ class MetricCollection:
         out["members"] = {k: m.compile_stats() for k, m in self._modules.items()}
         return out
 
+    def sync_report(self) -> Dict[str, Any]:
+        """Host-level sync telemetry: numeric counters summed across members
+        (each member syncs itself inside its own ``compute()``), the union of
+        last-sync missing ranks, and every member's full report under
+        ``members`` — the distributed mirror of :meth:`compile_stats`."""
+        members = {k: m.sync_report() for k, m in self._modules.items()}
+        out: Dict[str, Any] = {}
+        missing: set = set()
+        for report in members.values():
+            for key, value in report.items():
+                if isinstance(value, (int, float)) and not isinstance(value, bool):
+                    out[key] = out.get(key, 0) + value
+            missing.update(report["missing_ranks"])
+        out["missing_ranks"] = sorted(missing)
+        out["members"] = members
+        return out
+
     @staticmethod
     def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
         if arg is None or isinstance(arg, str):

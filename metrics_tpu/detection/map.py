@@ -190,11 +190,14 @@ class MeanAveragePrecision(Metric):
             packed[name] = {"flat": jnp.asarray(byte_rows), "len": lengths}
             meta[name] = (cols, dtype, width)
 
-        from metrics_tpu.parallel.groups import gather_state_trees
-
         # one tree per sync peer; under a ProcessGroup all ten (flat, lengths)
-        # leaves ride ONE KV exchange — one subset barrier per compute()
-        member_trees = gather_state_trees(packed, group, dist_sync_fn)
+        # leaves ride ONE KV exchange — one subset barrier per compute().
+        # Degradation policies apply exactly as in the base _sync_dist (shared
+        # helper): the per-image structure survives a partial gather because
+        # each member tree re-splits independently below.
+        member_trees = self._gather_with_policy(packed, group, dist_sync_fn)
+        if member_trees is None:  # degraded: keep the rank-local lists
+            return
         gathered = {
             name: ([t[name]["flat"] for t in member_trees], [t[name]["len"] for t in member_trees])
             for name in packed

@@ -169,6 +169,7 @@ _FP_SKIP = frozenset(
         "process_group",
         "dist_sync_fn",
         "axis_name",
+        "on_sync_error",
     )
 )
 

@@ -46,8 +46,9 @@ import numpy as np
 
 from metrics_tpu.engine import cache as _engine
 from metrics_tpu.parallel import comm
+from metrics_tpu.resilience import SYNC_ERROR_POLICIES, new_sync_stats
 from metrics_tpu.utils.data import _squeeze_if_scalar, dim_zero_cat
-from metrics_tpu.utils.exceptions import JitIncompatibleError, MetricsUserError
+from metrics_tpu.utils.exceptions import JitIncompatibleError, MetricsUserError, SyncError
 from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
@@ -94,6 +95,15 @@ class Metric:
             :func:`metrics_tpu.parallel.comm.gather_all_arrays`.
         axis_name: named mesh axis (or axes) for in-trace sync when the metric
             is used through the pure API inside ``shard_map``/``pmap``.
+        on_sync_error: degradation policy for host-level sync failures
+            (``SyncError`` family: peer timeout after retries, corrupted
+            payload, failed barrier). ``'raise'`` (default) propagates;
+            ``'local'`` keeps the rank-local state with a ``rank_zero_warn``;
+            ``'partial'`` reduces over the ranks that responded within the
+            group deadline and records the missing ranks in
+            :meth:`sync_report` (full per-rank granularity on the
+            ``ProcessGroup`` KV path; other sync paths degrade whole-state,
+            like ``'local'``).
         jit_update: auto-jit the update transition (default True). Compiled
             transitions are shared process-wide across instances with the
             same class/config/input signature (see ``metrics_tpu.engine``).
@@ -151,12 +161,19 @@ class Metric:
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
         axis_name: Optional[Union[str, Sequence[str]]] = None,
+        on_sync_error: str = "raise",
         jit_update: bool = True,
         jit_bucket: Optional[str] = None,
     ) -> None:
         self._device = None
         self.compute_on_step = compute_on_step
         self.dist_sync_on_step = dist_sync_on_step
+        if on_sync_error not in SYNC_ERROR_POLICIES:
+            raise ValueError(
+                f"`on_sync_error` must be one of {SYNC_ERROR_POLICIES}, got {on_sync_error!r}"
+            )
+        self.on_sync_error = on_sync_error
+        self._sync_stats = new_sync_stats()
         if process_group is not None and dist_sync_fn is None:
             from metrics_tpu.parallel.groups import ProcessGroup
 
@@ -462,6 +479,29 @@ class Metric:
         out["jit_bucket"] = self.jit_bucket
         return out
 
+    def sync_report(self) -> Dict[str, Any]:
+        """Host-level sync telemetry for this instance — the distributed
+        mirror of :meth:`compile_stats`.
+
+        Counters accumulate over the instance lifetime: ``syncs`` (host-level
+        sync rounds), ``attempts``/``retries`` (KV reads, incl. retried
+        ones), ``kv_timeouts``, ``integrity_failures`` (corrupted/truncated
+        payloads caught by the wire checksum), ``barrier_timeouts``,
+        ``backoff_s`` (total backoff slept), ``bytes_sent``/``bytes_received``
+        on the wire, and ``degraded_local``/``degraded_partial`` (syncs that
+        fell back under ``on_sync_error``). Last-sync fields:
+        ``last_sync_outcome`` is ``'complete'``, ``'partial'``, ``'local'``
+        (whole-state degradation — per-rank attribution unknown, so
+        ``missing_ranks`` stays empty), ``'failed'``, or ``None`` (never
+        synced); ``missing_ranks`` lists the peers missing from the last
+        partial sync.
+        """
+        out: Dict[str, Any] = dict(self._sync_stats)
+        out["missing_ranks"] = list(self._sync_stats["missing_ranks"])
+        out["on_sync_error"] = self.on_sync_error
+        out["process_group"] = getattr(self.process_group, "name", None)
+        return out
+
     # -- compute wrapping -----------------------------------------------
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
@@ -502,8 +542,66 @@ class Metric:
     # ------------------------------------------------------------------
     # distributed sync (host-level, multi-process JAX)
     # ------------------------------------------------------------------
+    def _gather_with_policy(
+        self, tree: Dict[str, Any], group: Optional[Any], dist_sync_fn: Optional[Callable]
+    ) -> Optional[List[Dict[str, Any]]]:
+        """Gather ``tree`` from every sync peer under ``on_sync_error``.
+
+        The single place the degradation policy is applied — shared by the
+        base :meth:`_sync_dist` and the detection-mAP ragged override.
+        Returns one tree per responding member, or ``None`` when the sync
+        failed and the policy says to keep the rank-local state ('local', or
+        a whole-state failure under 'partial'). Telemetry lands in
+        ``self._sync_stats``; missing ranks under 'partial' are recorded
+        there and warned about.
+        """
+        from metrics_tpu.parallel.groups import gather_state_trees
+
+        policy = self.on_sync_error
+        stats = self._sync_stats
+        stats["syncs"] += 1
+        stats["missing_ranks"] = []
+        stats["last_sync_outcome"] = "failed"  # pessimistic until proven otherwise
+        try:
+            member_trees = gather_state_trees(
+                tree,
+                group,
+                dist_sync_fn,
+                policy="partial" if policy == "partial" else "raise",
+                report=stats,
+            )
+        except SyncError as err:
+            if policy == "raise":
+                raise
+            stats["degraded_local"] += 1
+            stats["last_sync_outcome"] = "local"
+            rank_zero_warn(
+                f"Distributed sync of {self.__class__.__name__} failed; keeping"
+                f" the rank-local state (on_sync_error={policy!r})."
+                f" Original error: {err}",
+                UserWarning,
+            )
+            return None
+        stats["last_sync_outcome"] = "partial" if stats["missing_ranks"] else "complete"
+        if stats["missing_ranks"]:
+            stats["degraded_partial"] += 1
+            rank_zero_warn(
+                f"Partial distributed sync of {self.__class__.__name__}: ranks"
+                f" {stats['missing_ranks']} did not deliver within the group"
+                f" deadline; reducing over the {len(member_trees)} responding"
+                " member(s) (on_sync_error='partial').",
+                UserWarning,
+            )
+        return member_trees
+
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
-        """Gather+reduce every state across processes (reference ``metric.py:231-256``)."""
+        """Gather+reduce every state across processes (reference ``metric.py:231-256``).
+
+        Failure handling follows ``on_sync_error``: ``'raise'`` propagates
+        :class:`SyncError`; ``'local'`` keeps the rank-local states with a
+        warning; ``'partial'`` reduces over the ranks that delivered within
+        the group deadline (missing ranks recorded in :meth:`sync_report`).
+        """
         input_dict = {attr: getattr(self, attr) for attr in self._reductions}
 
         for attr, reduction_fn in self._reductions.items():
@@ -512,12 +610,12 @@ class Metric:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
 
         group = process_group or self.process_group
-        from metrics_tpu.parallel.groups import gather_state_trees
-
         # one tree per sync peer; a ProcessGroup with the default gather
         # batches the whole state dict into ONE KV exchange (one barrier per
         # compute(), not one per state leaf)
-        member_trees = gather_state_trees(input_dict, group, dist_sync_fn)
+        member_trees = self._gather_with_policy(input_dict, group, dist_sync_fn)
+        if member_trees is None:  # degraded: keep the rank-local states
+            return
         output_dict = jax.tree_util.tree_map(lambda *leaves: list(leaves), *member_trees)
 
         for attr, reduction_fn in self._reductions.items():
@@ -736,6 +834,8 @@ class Metric:
         self._compile_stats = _engine.new_stats()
         self.__dict__.setdefault("_engine_probed", False)
         self.__dict__.setdefault("jit_bucket", None)
+        self.__dict__.setdefault("on_sync_error", "raise")
+        self.__dict__.setdefault("_sync_stats", new_sync_stats())
         for name in self._defaults:
             v = getattr(self, name, None)
             if isinstance(v, list):

@@ -9,5 +9,8 @@ from metrics_tpu.parallel.comm import (  # noqa: F401
 from metrics_tpu.parallel.groups import (  # noqa: F401
     ProcessGroup,
     gather_group_arrays,
+    gather_group_pytrees,
+    gather_state_trees,
     new_group,
 )
+from metrics_tpu.resilience.retry import RetryPolicy  # noqa: F401

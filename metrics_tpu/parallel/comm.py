@@ -30,17 +30,34 @@ Array = jax.Array
 _SIMPLE_REDUCTIONS = ("sum", "mean", "max", "min")
 
 
+def _simulated_process():
+    """(rank, world) override from the fault-injection harness's per-thread
+    world simulation, or None outside it (``resilience.simulated_world``)."""
+    from metrics_tpu.resilience import faults
+
+    return faults.simulated_process()
+
+
 def distributed_available() -> bool:
-    """True when running under multi-process (multi-host) JAX."""
+    """True when running under multi-process (multi-host) JAX — or inside the
+    fault-injection harness's simulated world. The simulation carries the
+    ProcessGroup (KV-store) sync path and custom ``dist_sync_fn``s; the
+    world-spanning ``multihost_utils`` gather has no simulated backend and
+    raises explicitly under simulation (see :func:`gather_all_arrays`)."""
+    sim = _simulated_process()
+    if sim is not None:
+        return sim[1] > 1
     return jax.process_count() > 1
 
 
 def world_size() -> int:
-    return jax.process_count()
+    sim = _simulated_process()
+    return sim[1] if sim is not None else jax.process_count()
 
 
 def process_index() -> int:
-    return jax.process_index()
+    sim = _simulated_process()
+    return sim[0] if sim is not None else jax.process_index()
 
 
 # ---------------------------------------------------------------------------
@@ -127,7 +144,12 @@ def _host_allgather(x: Array) -> Array:
     return multihost_utils.process_allgather(x)
 
 
-def gather_all_arrays(x: Array, group: Optional[Any] = None) -> List[Array]:
+def gather_all_arrays(
+    x: Array,
+    group: Optional[Any] = None,
+    policy: str = "raise",
+    report: Optional[dict] = None,
+) -> List[Array]:
     """Host-level all-gather returning one array per process.
 
     Mirror of reference ``gather_all_tensors`` (``utilities/distributed.py:96``)
@@ -142,12 +164,19 @@ def gather_all_arrays(x: Array, group: Optional[Any] = None) -> List[Array]:
     Any other non-None group type raises — pass a custom ``dist_sync_fn``
     that understands it, or use in-trace sync over a mesh-axis subset
     (``axis_name``), the in-trace subgroup analog.
+
+    ``policy``/``report`` carry the ``Metric(on_sync_error=...)`` degradation
+    plumbing: on the ProcessGroup path, ``'partial'`` returns only the ranks
+    that delivered within the group deadline (missing ranks recorded in
+    ``report``). The world-spanning ``multihost_utils`` path is a true
+    collective — it has no per-rank partial mode, so failures there surface
+    as exceptions and degrade whole-state at the metric level.
     """
     if group is not None:
         from metrics_tpu.parallel.groups import ProcessGroup, gather_group_arrays
 
         if isinstance(group, ProcessGroup):
-            return gather_group_arrays(x, group)
+            return gather_group_arrays(x, group, policy=policy, report=report)
         raise ValueError(
             f"Unsupported `process_group` type {type(group).__name__!r}: pass a"
             " metrics_tpu.parallel.ProcessGroup (host-level subgroup), provide a custom"
@@ -156,6 +185,18 @@ def gather_all_arrays(x: Array, group: Optional[Any] = None) -> List[Array]:
         )
     if not distributed_available():
         return [x]
+    if _simulated_process() is not None:
+        from metrics_tpu.utils.exceptions import MetricsUserError
+
+        # the real multihost gather would silently return a world of 1 here,
+        # reporting a "successful" sync with local-only values — fail loudly
+        raise MetricsUserError(
+            "The fault-injection harness's simulated world only carries"
+            " ProcessGroup (KV-store) syncs — the world-spanning multihost"
+            " gather has no simulated backend. Construct the metric with"
+            " process_group=new_group(range(world)) (or a custom"
+            " dist_sync_fn) to sync under simulated_world/run_as_peers."
+        )
     x = jnp.atleast_1d(jnp.asarray(x))
     local_shape = jnp.asarray(x.shape, dtype=jnp.int32)
     all_shapes = _host_allgather(local_shape)  # [world, ndim]

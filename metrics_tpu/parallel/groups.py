@@ -25,20 +25,58 @@ Like ``torch.distributed.new_group``, groups must be created in the same
 order with the same ranks on every participating process: per-group call
 counters key the KV entries, and they stay aligned only when member processes
 issue the same sequence of group collectives (the usual SPMD contract).
+
+The exchange is hardened for production fault modes (``docs/fault_tolerance.md``):
+payloads ride a versioned + crc32-checksummed envelope (corruption and
+mixed-version peers raise precise :class:`SyncIntegrityError`\\ s), peer reads
+retry with deadline-budgeted backoff under the group's
+:class:`~metrics_tpu.resilience.RetryPolicy`, and callers can opt into
+degraded results (``policy='partial'``) instead of failures. The
+fault-injection harness (``metrics_tpu.resilience.faults``) can impersonate
+the KV client and the process identity per thread, which is how all of this
+is tested single-process on CPU.
 """
 import itertools
 import json
 import struct
+import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from metrics_tpu.resilience import faults as _faults
+from metrics_tpu.resilience import new_sync_stats
+from metrics_tpu.resilience.retry import DEFAULT_RETRY, RetryPolicy
+from metrics_tpu.utils.exceptions import (
+    MetricsUserError,
+    SyncError,
+    SyncIntegrityError,
+    SyncTimeoutError,
+)
+
 _KV_PREFIX = "metrics_tpu/pg"
+
+# Versioned wire envelope: magic + format version + crc32 of everything after.
+# The version byte makes a mixed-version peer an *explicit* error instead of
+# garbage decode; the checksum turns corruption/truncation into a precise
+# SyncIntegrityError the retry machinery treats as transient.
+_WIRE_MAGIC = b"MT"
+WIRE_VERSION = 1
+_ENVELOPE = struct.Struct(">2sBI")
 
 # per-group monotonic call counters; aligned across processes by the SPMD
 # same-order contract documented above
 _call_counters: Dict[str, "itertools.count"] = {}
+
+
+def _next_epoch(scope: str) -> int:
+    """Next exchange epoch for ``scope``. Under the fault-injection harness's
+    in-process world simulation every simulated rank needs its OWN counter
+    (in real deployments each process has its own module state)."""
+    sim = _faults.simulated_process()
+    key = scope if sim is None else f"{scope}#sim{sim[0]}"
+    return next(_call_counters.setdefault(key, itertools.count()))
 
 
 class ProcessGroup:
@@ -53,10 +91,22 @@ class ProcessGroup:
         ranks: member process indices; deduplicated and sorted.
         name: optional stable identifier. Processes that should communicate
             must use equal names; defaults to a name derived from ``ranks``.
-        timeout_s: per-exchange timeout for the KV gets and the group barrier.
+        timeout_s: TOTAL deadline for one exchange (KV reads, backoff pauses,
+            and the group barrier all fit inside it). The group's ``retry``
+            policy splits it into per-attempt budgets; an exchange never
+            blocks past it.
+        retry: :class:`~metrics_tpu.resilience.RetryPolicy` for transient KV
+            failures (read timeouts, payload corruption) inside one exchange.
+            Not part of group identity — peers may tune it independently.
     """
 
-    def __init__(self, ranks: Sequence[int], name: Optional[str] = None, timeout_s: float = 120.0) -> None:
+    def __init__(
+        self,
+        ranks: Sequence[int],
+        name: Optional[str] = None,
+        timeout_s: float = 120.0,
+        retry: Optional[RetryPolicy] = None,
+    ) -> None:
         cleaned = sorted({int(r) for r in ranks})
         if not cleaned:
             raise ValueError("A ProcessGroup needs at least one member rank.")
@@ -65,6 +115,7 @@ class ProcessGroup:
         self.ranks = tuple(cleaned)
         self.name = name if name is not None else "r" + "_".join(str(r) for r in cleaned)
         self.timeout_s = float(timeout_s)
+        self.retry = retry if retry is not None else DEFAULT_RETRY
 
     @property
     def size(self) -> int:
@@ -89,12 +140,23 @@ class ProcessGroup:
         return f"{self.name}:{'-'.join(str(r) for r in self.ranks)}"
 
 
-def new_group(ranks: Sequence[int], name: Optional[str] = None, timeout_s: float = 120.0) -> ProcessGroup:
+def new_group(
+    ranks: Sequence[int],
+    name: Optional[str] = None,
+    timeout_s: float = 120.0,
+    retry: Optional[RetryPolicy] = None,
+) -> ProcessGroup:
     """Create a :class:`ProcessGroup` — mirror of ``torch.distributed.new_group``."""
-    return ProcessGroup(ranks, name=name, timeout_s=timeout_s)
+    return ProcessGroup(ranks, name=name, timeout_s=timeout_s, retry=retry)
 
 
 def _kv_client():
+    # fault-injection harness hooks: a per-thread simulated client (CPU
+    # tests), else the real runtime client — possibly wrapped in the
+    # env-activated (METRICS_TPU_FAULTS) fault plan for live probe runs
+    override = _faults.current_client()
+    if override is not None:
+        return override
     from jax._src import distributed
 
     client = getattr(distributed.global_state, "client", None)
@@ -103,11 +165,54 @@ def _kv_client():
             "ProcessGroup sync needs the JAX distributed runtime: call"
             " jax.distributed.initialize(...) before the first grouped compute()."
         )
-    return client
+    return _faults.maybe_wrap_client(client)
+
+
+def _seal(body: bytes) -> bytes:
+    """Wrap ``body`` in the versioned envelope: magic, version, crc32(body)."""
+    return _ENVELOPE.pack(_WIRE_MAGIC, WIRE_VERSION, zlib.crc32(body)) + body
+
+
+def _open_envelope(payload: bytes, context: str = "") -> bytes:
+    """Validate the envelope and return the body.
+
+    Raises :class:`SyncIntegrityError` — transient for truncation/corruption
+    (a retry may see a clean write), non-transient for a wire-format version
+    mismatch (retrying a mixed-version peer can never succeed).
+    """
+    if len(payload) < _ENVELOPE.size:
+        raise SyncIntegrityError(
+            f"Truncated sync payload: {len(payload)} bytes is smaller than the"
+            f" {_ENVELOPE.size}-byte wire envelope{context}."
+        )
+    magic, version, crc = _ENVELOPE.unpack(payload[: _ENVELOPE.size])
+    if magic != _WIRE_MAGIC:
+        raise SyncIntegrityError(
+            f"Sync payload does not carry the metrics_tpu wire magic{context} —"
+            " the peer is running an incompatible (pre-versioning) build, or"
+            " something else wrote to this KV key.",
+            transient=False,
+        )
+    if version != WIRE_VERSION:
+        raise SyncIntegrityError(
+            f"Sync wire-format version mismatch{context}: peer sent v{version},"
+            f" this process speaks v{WIRE_VERSION}. All members of a ProcessGroup"
+            " must run the same metrics_tpu wire version.",
+            transient=False,
+        )
+    body = payload[_ENVELOPE.size :]
+    actual = zlib.crc32(body)
+    if actual != crc:
+        raise SyncIntegrityError(
+            f"Corrupted sync payload{context}: crc32 {actual:#010x} != declared"
+            f" {crc:#010x} over {len(body)} body bytes."
+        )
+    return body
 
 
 def _encode(arr: np.ndarray) -> bytes:
-    """Self-describing wire format: length-prefixed JSON header + raw bytes.
+    """Self-describing wire format: versioned+checksummed envelope around a
+    length-prefixed JSON header + raw bytes.
 
     ``dtype.name`` round-trips every dtype JAX hands to the host, including
     the ml_dtypes extension types (``np.dtype('bfloat16')`` resolves once
@@ -118,63 +223,234 @@ def _encode(arr: np.ndarray) -> bytes:
     # can't be reinterpreted as garbage by the receiver's native _decode
     arr = arr.astype(arr.dtype.newbyteorder("="), copy=False)
     header = json.dumps({"dtype": arr.dtype.name, "shape": list(arr.shape)}).encode()
-    return struct.pack(">I", len(header)) + header + arr.tobytes()
+    return _seal(struct.pack(">I", len(header)) + header + arr.tobytes())
 
 
-def _decode(payload: bytes) -> np.ndarray:
-    (header_len,) = struct.unpack(">I", payload[:4])
-    header = json.loads(payload[4 : 4 + header_len].decode())
+def _decode(payload: bytes, context: str = "") -> np.ndarray:
+    body = _open_envelope(payload, context)
+    if len(body) < 4:
+        raise SyncIntegrityError(f"Truncated sync payload: no header length{context}.")
+    (header_len,) = struct.unpack(">I", body[:4])
+    if 4 + header_len > len(body):
+        raise SyncIntegrityError(
+            f"Truncated sync payload{context}: header claims {header_len} bytes,"
+            f" only {len(body) - 4} present."
+        )
+    try:
+        header = json.loads(body[4 : 4 + header_len].decode())
+        dtype_name, shape = header["dtype"], tuple(header["shape"])
+    except (ValueError, KeyError, UnicodeDecodeError) as err:
+        raise SyncIntegrityError(f"Unparseable sync payload header{context}: {err}") from err
     import ml_dtypes  # noqa: F401  (registers bfloat16 & friends with numpy)
 
-    dtype = np.dtype(header["dtype"])
-    data = np.frombuffer(payload[4 + header_len :], dtype=dtype)
-    return data.reshape(header["shape"])
+    dtype = np.dtype(dtype_name)
+    data = body[4 + header_len :]
+    expected = dtype.itemsize * int(np.prod(shape, dtype=np.int64)) if shape else dtype.itemsize
+    if len(data) != expected:
+        raise SyncIntegrityError(
+            f"Sync payload length mismatch{context}: header declares"
+            f" dtype={dtype.name} shape={list(shape)} ({expected} bytes), payload"
+            f" carries {len(data)}."
+        )
+    return np.frombuffer(data, dtype=dtype).reshape(shape)
 
 
-def _exchange_bytes(payload: bytes, group: ProcessGroup, rank: int) -> List[bytes]:
+_DESYNC_HINT = (
+    " All members must issue grouped collectives in the same order and count —"
+    " a peer that is behind (different call order) or ahead (restarted, epoch"
+    " counter reset) publishes under a different epoch key and can never meet"
+    " this one."
+)
+
+
+def _is_transient_kv_error(err: BaseException) -> bool:
+    """Transient = worth another attempt within the deadline: read timeouts
+    and retryable integrity failures. Classified by message because the real
+    coordination-service client surfaces timeouts as generic runtime errors
+    (``XlaRuntimeError: DEADLINE_EXCEEDED``)."""
+    if isinstance(err, SyncIntegrityError):
+        return err.transient
+    if isinstance(err, TimeoutError):
+        return True
+    msg = str(err).lower()
+    return any(s in msg for s in ("deadline_exceeded", "deadline exceeded", "timed out", "timeout", "unavailable"))
+
+
+def _read_peers_with_retry(
+    client: Any,
+    group: ProcessGroup,
+    scope: str,
+    epoch: int,
+    rank: int,
+    read_deadline: float,
+    policy: str,
+    stats: Dict[str, Any],
+) -> Dict[int, bytes]:
+    """Fetch every peer payload with round-robin retry/backoff inside the
+    read deadline; returns ``{peer rank: payload}`` for the peers that
+    delivered.
+
+    Retries run in ROUNDS across all still-missing peers (attempt 1 for
+    everyone, then attempt 2 for the failures, ...) so one dead peer cannot
+    starve the reads of live ones — with a straight per-peer loop, peer k's
+    retries would burn the whole deadline before peer k+1 is ever tried. The
+    keys (and with them the exchange epoch) are STABLE across attempts: a
+    retry is a re-read of the same epoch's key, so a slow peer can still meet
+    this exchange. Every read is envelope-verified in place; a transient
+    integrity failure (torn/corrupted read) burns one attempt and is re-read.
+    Exhaustion raises :class:`SyncTimeoutError` unless ``policy='partial'``,
+    which leaves the peer out of the result instead.
+    """
+    retry = group.retry
+    peers = [m for m in group.ranks if m != rank]
+    results: Dict[int, bytes] = {}
+    last_err: Dict[int, BaseException] = {}
+    tries: Dict[int, int] = {m: 0 for m in peers}
+    outstanding = list(peers)
+    for attempt in range(1, retry.max_attempts + 1):
+        attempts_left = retry.max_attempts - attempt + 1
+        failed_this_round: List[int] = []
+        for position, member in enumerate(outstanding):
+            remaining = read_deadline - time.monotonic()
+            if remaining <= 0:
+                failed_this_round.extend(outstanding[position:])
+                break
+            key = f"{_KV_PREFIX}/{scope}/{epoch}/{member}"
+            context = f" (group={group.name!r}, epoch={epoch}, peer rank={member}, this rank={rank})"
+            # split what's left of the deadline over every read that may
+            # still happen: the rest of this round, times the rounds left
+            budget_s = retry.attempt_timeout_s(remaining, attempts_left * (len(outstanding) - position))
+            budget_s = min(budget_s, remaining)
+            stats["attempts"] += 1
+            tries[member] += 1
+            if tries[member] > 1:
+                stats["retries"] += 1
+            try:
+                raw = client.blocking_key_value_get_bytes(key, max(1, int(budget_s * 1000)))
+                # verified here to classify corruption as transient (and to
+                # retry it); decode re-verifies the same envelope later —
+                # accepted double work, crc32 runs at GB/s vs KB-scale states
+                _open_envelope(raw, context)
+            except SyncIntegrityError as err:
+                stats["integrity_failures"] += 1
+                if not err.transient:
+                    raise
+                last_err[member] = err
+                failed_this_round.append(member)
+            except Exception as err:  # noqa: BLE001 — classified right below
+                if not _is_transient_kv_error(err):
+                    raise SyncError(f"KV read failed{context}: {err}") from err
+                stats["kv_timeouts"] += 1
+                last_err[member] = err
+                failed_this_round.append(member)
+            else:
+                stats["bytes_received"] += len(raw)
+                results[member] = raw
+        outstanding = failed_this_round
+        if not outstanding:
+            break
+        if attempt < retry.max_attempts:
+            pause = retry.backoff_s(attempt, key=(scope, epoch, rank))
+            pause = min(pause, max(0.0, read_deadline - time.monotonic()))
+            if pause > 0:
+                stats["backoff_s"] += pause
+                time.sleep(pause)
+    if outstanding and policy != "partial":
+        member = outstanding[0]
+        raise SyncTimeoutError(
+            f"Gave up on a peer's sync payload after {tries[member]} attempt(s)"
+            f" (group={group.name!r}, epoch={epoch}, peer rank={member}, this"
+            f" rank={rank}), group deadline {group.timeout_s}s.{_DESYNC_HINT}"
+            f" Last error: {last_err.get(member)}"
+        ) from last_err.get(member)
+    return results
+
+
+def _exchange_bytes(
+    payload: bytes,
+    group: ProcessGroup,
+    rank: int,
+    policy: str = "raise",
+    report: Optional[Dict[str, Any]] = None,
+) -> List[Optional[bytes]]:
     """One publish/read-all/barrier round among group members; returns the
     per-member payloads ordered by ``group.ranks``.
+
+    Fault tolerance: peer reads are retried with backoff under the group's
+    :class:`~metrics_tpu.resilience.RetryPolicy`, all inside ONE total
+    deadline (``group.timeout_s``) — the epoch key stays stable across
+    attempts so peers can still meet, and a small slice of the deadline is
+    reserved for the closing barrier so a last-moment read success cannot
+    turn into a spurious barrier timeout. Under ``policy='partial'`` a peer
+    that never delivers becomes ``None`` in the returned list (its rank
+    recorded in ``report['missing_ranks']``) instead of raising.
 
     The post-read subset barrier guarantees nobody deletes a key a peer has
     not read yet; cleanup of the member's own key runs even when a read or
     the barrier times out, so failed exchanges don't leak coordination-service
-    entries.
+    entries. Telemetry (attempts, retries, backoff, bytes, integrity
+    failures) accumulates into ``report`` when given.
     """
     client = _kv_client()
     scope = group._kv_scope
-    epoch = next(_call_counters.setdefault(scope, itertools.count()))
-    timeout_ms = max(1, int(group.timeout_s * 1000))
+    epoch = _next_epoch(scope)
+    stats = report if report is not None else new_sync_stats()
+    deadline = time.monotonic() + group.timeout_s
+    # reserve a slice for the barrier (bounded: the barrier normally clears
+    # in microseconds once every member has read)
+    read_deadline = deadline - min(1.0, 0.1 * group.timeout_s) if group.size > 1 else deadline
+    context = f" (group={group.name!r}, scope={scope!r}, epoch={epoch}, rank={rank})"
 
     own_key = f"{_KV_PREFIX}/{scope}/{epoch}/{rank}"
-    client.key_value_set_bytes(own_key, payload)
     try:
-        payloads = [
-            payload
-            if member == rank
-            else client.blocking_key_value_get_bytes(f"{_KV_PREFIX}/{scope}/{epoch}/{member}", timeout_ms)
-            for member in group.ranks
-        ]
-        client.wait_at_barrier(f"{_KV_PREFIX}/{scope}/{epoch}/done", timeout_ms, process_ids=list(group.ranks))
-    except Exception as err:
-        # the raw KV-get timeout names only an opaque key; re-raise with the
-        # group/epoch context so a desynced call sequence (members issuing
-        # grouped collectives in different orders, or a partial restart that
-        # reset one member's process-local epoch counter) is diagnosable
-        raise RuntimeError(
-            f"Grouped sync failed in {group!r} (scope={scope!r}, epoch={epoch},"
-            f" rank={rank}, timeout={group.timeout_s}s). If this is a KV-get"
-            " timeout: all members must issue grouped collectives in the same"
-            " order and count — a peer that is behind (different call order) or"
-            " ahead (restarted, epoch counter reset) publishes under a"
-            f" different epoch key and can never meet this one. Original error: {err}"
-        ) from err
+        client.key_value_set_bytes(own_key, payload)
+    except Exception as err:  # noqa: BLE001 — a KV publish failure IS a sync failure
+        raise SyncError(f"KV publish failed{context}: {err}") from err
+    stats["bytes_sent"] += len(payload)
+    try:
+        results = _read_peers_with_retry(client, group, scope, epoch, rank, read_deadline, policy, stats)
+        barrier_ms = max(1, int((deadline - time.monotonic()) * 1000))
+        try:
+            client.wait_at_barrier(f"{_KV_PREFIX}/{scope}/{epoch}/done", barrier_ms, process_ids=list(group.ranks))
+        except Exception as err:  # noqa: BLE001 — classified below
+            stats["barrier_timeouts"] += 1
+            if policy != "partial" or not _is_transient_kv_error(err):
+                raise SyncTimeoutError(
+                    f"Group barrier failed{context} within the {group.timeout_s}s"
+                    f" deadline.{_DESYNC_HINT} Original error: {err}"
+                ) from err
+            # degraded exchange: proceed to cleanup. Peers that already read
+            # our key are unaffected; a straggler that reads after the delete
+            # times out and degrades under ITS OWN policy.
     finally:
-        client.key_value_delete(own_key)
-    return payloads
+        try:
+            client.key_value_delete(own_key)
+        except Exception:  # noqa: BLE001, S110
+            # best-effort cleanup: a delete failure means the coordination
+            # service is already unhealthy — raising here would mask the
+            # primary error, and a leaked epoch key is bounded (one per
+            # failed exchange, never reused)
+            pass
+    stats["missing_ranks"] = [m for m in group.ranks if m != rank and m not in results]
+    return [payload if m == rank else results.get(m) for m in group.ranks]
 
 
 def _membership_or_raise(group: ProcessGroup) -> Optional[int]:
     """Validate this process against ``group``; None means single-process no-op."""
+    sim = _faults.simulated_process()
+    if sim is not None:
+        rank, world = sim
+        if rank not in group:
+            raise ValueError(
+                f"Simulated process {rank} is not a member of {group!r}; grouped"
+                " sync must only run on member processes."
+            )
+        if group.ranks[-1] >= world:
+            raise ValueError(
+                f"{group!r} names rank {group.ranks[-1]} but the simulated world"
+                f" has only {world} processes."
+            )
+        return rank
     import jax
 
     if jax.process_count() == 1:
@@ -200,20 +476,31 @@ def _membership_or_raise(group: ProcessGroup) -> Optional[int]:
     return rank
 
 
-def gather_group_arrays(x: Any, group: ProcessGroup) -> List[Any]:
+def gather_group_arrays(
+    x: Any,
+    group: ProcessGroup,
+    policy: str = "raise",
+    report: Optional[Dict[str, Any]] = None,
+) -> List[Any]:
     """All-gather ``x`` across the member processes of ``group``.
 
     Returns one array per member, ordered by ``group.ranks``. Must be called
     by every member (and only members) — the grouped analog of the collective
-    contract in ``comm.gather_all_arrays``.
+    contract in ``comm.gather_all_arrays``. Under ``policy='partial'`` the
+    list holds only the members that delivered within the group deadline
+    (missing ranks recorded in ``report['missing_ranks']``).
     """
     import jax.numpy as jnp
 
     rank = _membership_or_raise(group)
     if rank is None:
         return [x]
-    payloads = _exchange_bytes(_encode(np.asarray(x)), group, rank)
-    return [jnp.asarray(_decode(p)) for p in payloads]
+    payloads = _exchange_bytes(_encode(np.asarray(x)), group, rank, policy=policy, report=report)
+    return [
+        jnp.asarray(_decode(p, context=f" (group={group.name!r}, peer rank={member})"))
+        for member, p in zip(group.ranks, payloads)
+        if p is not None
+    ]
 
 
 def _tree_signature(treedef) -> int:
@@ -230,14 +517,17 @@ def _encode_tree(tree: Any) -> bytes:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     blocks = [_encode(np.asarray(leaf)) for leaf in leaves]
     header = struct.pack(">II", len(blocks), _tree_signature(treedef))
-    return header + b"".join(struct.pack(">Q", len(b)) + b for b in blocks)
+    return _seal(header + b"".join(struct.pack(">Q", len(b)) + b for b in blocks))
 
 
-def _decode_tree(payload: bytes, treedef, n_leaves: int) -> Any:
+def _decode_tree(payload: bytes, treedef, n_leaves: int, context: str = "") -> Any:
     import jax
     import jax.numpy as jnp
 
-    count, sig = struct.unpack(">II", payload[:8])
+    body = _open_envelope(payload, context)
+    if len(body) < 8:
+        raise SyncIntegrityError(f"Truncated sync tree payload: no block header{context}.")
+    count, sig = struct.unpack(">II", body[:8])
     if count != n_leaves or sig != _tree_signature(treedef):
         raise ValueError(
             f"Group member sent a state tree with {count} leaves (structure"
@@ -247,14 +537,26 @@ def _decode_tree(payload: bytes, treedef, n_leaves: int) -> Any:
         )
     offset, member_leaves = 8, []
     for _ in range(count):
-        (size,) = struct.unpack(">Q", payload[offset : offset + 8])
+        if offset + 8 > len(body):
+            raise SyncIntegrityError(f"Truncated sync tree payload at block {len(member_leaves)}{context}.")
+        (size,) = struct.unpack(">Q", body[offset : offset + 8])
         offset += 8
-        member_leaves.append(jnp.asarray(_decode(payload[offset : offset + size])))
+        if offset + size > len(body):
+            raise SyncIntegrityError(
+                f"Truncated sync tree payload{context}: block {len(member_leaves)}"
+                f" declares {size} bytes, only {len(body) - offset} remain."
+            )
+        member_leaves.append(jnp.asarray(_decode(body[offset : offset + size], context)))
         offset += size
     return jax.tree_util.tree_unflatten(treedef, member_leaves)
 
 
-def gather_group_pytrees(tree: Any, group: ProcessGroup) -> List[Any]:
+def gather_group_pytrees(
+    tree: Any,
+    group: ProcessGroup,
+    policy: str = "raise",
+    report: Optional[Dict[str, Any]] = None,
+) -> List[Any]:
     """All-gather a whole state pytree in ONE KV exchange.
 
     ``Metric._sync_dist`` uses this instead of per-leaf
@@ -264,6 +566,10 @@ def gather_group_pytrees(tree: Any, group: ProcessGroup) -> List[Any]:
     identical trees (the usual SPMD contract — leaf shapes may differ, the
     per-leaf wire headers carry them; tree STRUCTURE is fingerprinted and
     verified).
+
+    ``policy='partial'`` drops peers that never delivered within the group
+    deadline from the returned list (their ranks land in
+    ``report['missing_ranks']``); the default raises :class:`SyncTimeoutError`.
     """
     import jax
 
@@ -273,12 +579,19 @@ def gather_group_pytrees(tree: Any, group: ProcessGroup) -> List[Any]:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     payload = _encode_tree(tree)
     return [
-        _decode_tree(member_payload, treedef, len(leaves))
-        for member_payload in _exchange_bytes(payload, group, rank)
+        _decode_tree(member_payload, treedef, len(leaves), context=f" (group={group.name!r}, peer rank={member})")
+        for member, member_payload in zip(group.ranks, _exchange_bytes(payload, group, rank, policy=policy, report=report))
+        if member_payload is not None
     ]
 
 
-def gather_state_trees(tree: Any, group: Optional[Any], dist_sync_fn: Optional[Callable] = None) -> List[Any]:
+def gather_state_trees(
+    tree: Any,
+    group: Optional[Any],
+    dist_sync_fn: Optional[Callable] = None,
+    policy: str = "raise",
+    report: Optional[Dict[str, Any]] = None,
+) -> List[Any]:
     """Gather a whole state tree from every sync peer; one tree per member.
 
     The single dispatch point shared by ``Metric._sync_dist`` and the
@@ -286,6 +599,12 @@ def gather_state_trees(tree: Any, group: Optional[Any], dist_sync_fn: Optional[C
     takes the batched one-exchange path above; anything else (custom
     ``dist_sync_fn``, world-spanning default) gathers per leaf and
     transposes the results into per-member trees.
+
+    ``policy``/``report`` (the ``Metric.on_sync_error`` degradation plumbing)
+    only reach the batched ProcessGroup path: per-leaf gathers run one
+    collective per leaf, and a partial result for SOME leaves would
+    cross-assign members during transposition — degradation for those paths
+    is whole-state and handled by the caller catching :class:`SyncError`.
 
     .. note:: leaves are visited in ``tree_flatten`` order — for a state
        dict that is **sorted key order**, not ``add_state`` registration
@@ -295,7 +614,7 @@ def gather_state_trees(tree: Any, group: Optional[Any], dist_sync_fn: Optional[C
     import jax
 
     if dist_sync_fn is None and isinstance(group, ProcessGroup):
-        return gather_group_pytrees(tree, group)
+        return gather_group_pytrees(tree, group, policy=policy, report=report)
 
     from metrics_tpu.parallel import comm
 
@@ -303,7 +622,18 @@ def gather_state_trees(tree: Any, group: Optional[Any], dist_sync_fn: Optional[C
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
         return [tree]
-    gathered = [gather(leaf, group=group) for leaf in leaves]  # [n_leaves][n_members]
+    gathered = []  # [n_leaves][n_members]
+    for leaf in leaves:
+        try:
+            gathered.append(gather(leaf, group=group))
+        except (SyncError, ValueError, TypeError, MetricsUserError):
+            raise  # already-classified sync failures and programming errors
+        except Exception as err:  # noqa: BLE001 — reclassified below
+            # a world-spanning collective or custom gather died mid-flight
+            # (e.g. XlaRuntimeError from multihost_utils when a host drops):
+            # classify as SyncError so on_sync_error degradation applies —
+            # whole-state, since per-rank granularity is unknowable here
+            raise SyncError(f"Host-level gather failed for a state leaf: {err}") from err
     n_members = len(gathered[0])
     return [
         jax.tree_util.tree_unflatten(treedef, [per_leaf[m] for per_leaf in gathered])

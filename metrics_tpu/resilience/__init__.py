@@ -1,0 +1,67 @@
+"""Resilient distributed sync: retry/backoff, integrity, degradation, faults.
+
+The host-level sync stack (``parallel/groups.py`` KV exchanges,
+``parallel/comm.py`` world gathers) treats cross-host communication as a
+fallible resource — the posture multi-host TPU systems take (PAPERS: pjit at
+TPUv4 scale; EQuARX degraded collectives). This package holds the pieces:
+
+* :mod:`~metrics_tpu.resilience.retry` — :class:`RetryPolicy`: per-attempt
+  deadline budgeting and exponential backoff with deterministic jitter.
+* :mod:`~metrics_tpu.resilience.faults` — the deterministic fault-injection
+  harness: an in-memory KV fake with per-(rank, epoch) drop/delay/corrupt/
+  straggler faults, per-thread world simulation, and an env-activated
+  (``METRICS_TPU_FAULTS``) wrapper for live clients.
+* sync telemetry — :func:`new_sync_stats` is the counter template behind
+  ``Metric.sync_report()`` (attempts, retries, backoff elapsed, bytes
+  exchanged, integrity failures, degraded syncs, missing ranks), mirroring
+  the engine's ``compile_stats()`` pattern.
+
+Degradation policies themselves (``on_sync_error='raise'|'local'|'partial'``)
+live on :class:`~metrics_tpu.metric.Metric` and are documented in
+``docs/fault_tolerance.md``.
+"""
+from typing import Any, Dict
+
+from metrics_tpu.resilience.faults import (  # noqa: F401
+    FAULTS_ENV_VAR,
+    FaultPlan,
+    FaultSpec,
+    FaultyClient,
+    InMemoryKVStore,
+    KVTimeoutError,
+    current_client,
+    maybe_wrap_client,
+    parse_plan,
+    plan_from_env,
+    run_as_peers,
+    simulated_process,
+    simulated_world,
+)
+from metrics_tpu.resilience.retry import DEFAULT_RETRY, RetryPolicy  # noqa: F401
+
+SYNC_ERROR_POLICIES = ("raise", "local", "partial")
+
+_SYNC_STAT_KEYS = (
+    "syncs",
+    "attempts",
+    "retries",
+    "kv_timeouts",
+    "integrity_failures",
+    "barrier_timeouts",
+    "degraded_local",
+    "degraded_partial",
+    "bytes_sent",
+    "bytes_received",
+)
+
+
+def new_sync_stats() -> Dict[str, Any]:
+    """Fresh sync-telemetry counters (the template ``Metric.sync_report()``
+    reads). ``missing_ranks`` and ``last_sync_outcome``
+    (``'complete'|'partial'|'local'|'failed'|None``) reflect the *last* sync;
+    everything else accumulates over the metric's lifetime."""
+    stats: Dict[str, Any] = {key: 0 for key in _SYNC_STAT_KEYS}
+    stats["backoff_s"] = 0.0
+    stats["missing_ranks"] = []
+    stats["last_sync_outcome"] = None
+    return stats

@@ -1,0 +1,461 @@
+"""Deterministic fault injection for the host-level KV sync.
+
+The sync stack in ``parallel/groups.py`` talks to the JAX distributed
+runtime's key-value store through four calls (set / blocking get / barrier /
+delete). Everything here impersonates or wraps that client so every failure
+mode the retry/degradation machinery handles — a dropped peer, a slow read, a
+corrupted payload, a straggler publishing late — can be produced on demand,
+deterministically, in a single CPU process:
+
+* :class:`FaultSpec` / :class:`FaultPlan` — declarative faults keyed by the
+  *publisher* rank and the exchange epoch (parsed from the KV key itself, so
+  no coordination with the sync code is needed).
+* :class:`InMemoryKVStore` — a thread-shared fake of the coordination
+  service. ``store.client(rank)`` hands out per-rank client bindings; each
+  simulated rank runs the *real* ``_exchange_bytes`` against it on its own
+  thread (see :func:`run_as_peers`).
+* :func:`simulated_world` — a context manager that overrides, for the
+  current thread, both the KV client and the (rank, world) identity that
+  ``groups._membership_or_raise`` would otherwise read from
+  ``jax.process_index()``. ContextVars are per-thread, so N threads simulate
+  N processes faithfully.
+* :class:`FaultyClient` / :func:`maybe_wrap_client` — the same fault plan
+  wrapped around a **real** distributed-runtime client, activated by the
+  ``METRICS_TPU_FAULTS`` env var (inline JSON, or ``@/path/to/plan.json``)
+  for live multi-host probe runs (``tools/tpu_probe_loop.sh`` windows).
+
+No jax imports at module level — the harness must be loadable before any
+backend decision is made.
+"""
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpec",
+    "FaultyClient",
+    "InMemoryKVStore",
+    "KVTimeoutError",
+    "current_client",
+    "maybe_wrap_client",
+    "parse_plan",
+    "plan_from_env",
+    "run_as_peers",
+    "simulated_process",
+    "simulated_world",
+]
+
+FAULTS_ENV_VAR = "METRICS_TPU_FAULTS"
+
+_FAULT_KINDS = ("drop", "delay", "corrupt", "straggler")
+
+
+class KVTimeoutError(TimeoutError):
+    """Timeout raised by the fake store — message mirrors the real
+    coordination-service client (``DEADLINE_EXCEEDED``) so the transient-error
+    classifier in ``parallel/groups.py`` treats both identically."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One injected fault.
+
+    Args:
+        kind: ``'drop'`` — the publisher's payload is never stored;
+            ``'straggler'`` — the publish only becomes visible ``seconds``
+            after it happens; ``'delay'`` — every read of the payload takes an
+            extra ``seconds`` (timing out the attempt if its budget is
+            smaller); ``'corrupt'`` — the first ``times`` reads return
+            bit-flipped bytes, later reads the true payload.
+        rank: the *publisher* process index whose payload is affected.
+        epoch: exchange epoch the fault applies to; ``None`` = every epoch.
+        seconds: delay/straggler duration.
+        times: how many corrupted reads ``'corrupt'`` serves before healing.
+    """
+
+    kind: str
+    rank: int
+    epoch: Optional[int] = None
+    seconds: float = 0.25
+    times: int = 1
+
+    def __post_init__(self) -> None:
+        if self.kind not in _FAULT_KINDS:
+            raise ValueError(f"Unknown fault kind {self.kind!r}; choose from {_FAULT_KINDS}")
+
+    def matches(self, rank: int, epoch: Optional[int]) -> bool:
+        if rank != self.rank:
+            return False
+        return self.epoch is None or epoch is None or epoch == self.epoch
+
+
+def _parse_key(key: str) -> Optional[Tuple[int, int]]:
+    """``.../{scope}/{epoch}/{rank}`` -> (epoch, rank); None for non-payload
+    keys (barriers end in ``/done``)."""
+    parts = key.rsplit("/", 2)
+    if len(parts) != 3:
+        return None
+    try:
+        return int(parts[1]), int(parts[2])
+    except ValueError:
+        return None
+
+
+def corrupt_bytes(payload: bytes) -> bytes:
+    """Deterministic corruption: flip one byte in the middle and one at the
+    end — lands in the body for any real payload, so the crc32 envelope check
+    must catch it."""
+    if not payload:
+        return b"\xff"
+    buf = bytearray(payload)
+    buf[len(buf) // 2] ^= 0xFF
+    buf[-1] ^= 0xFF
+    return bytes(buf)
+
+
+class FaultPlan:
+    """A set of :class:`FaultSpec` plus the mutable claim state that makes
+    ``corrupt(times=N)`` deterministic across retries and threads."""
+
+    def __init__(self, specs: Sequence[FaultSpec] = ()) -> None:
+        self.specs = [s if isinstance(s, FaultSpec) else FaultSpec(**s) for s in specs]
+        self._lock = threading.Lock()
+        self._corrupt_served: Dict[Tuple[FaultSpec, int, int], int] = {}
+
+    def __iter__(self):
+        return iter(self.specs)
+
+    def __len__(self) -> int:
+        return len(self.specs)
+
+    def _first(self, kind: str, rank: int, epoch: Optional[int]) -> Optional[FaultSpec]:
+        for spec in self.specs:
+            if spec.kind == kind and spec.matches(rank, epoch):
+                return spec
+        return None
+
+    def drops_publish(self, key: str) -> bool:
+        parsed = _parse_key(key)
+        return bool(parsed and self._first("drop", parsed[1], parsed[0]))
+
+    def publish_visible_delay_s(self, key: str) -> float:
+        parsed = _parse_key(key)
+        spec = parsed and self._first("straggler", parsed[1], parsed[0])
+        return spec.seconds if spec else 0.0
+
+    def read_delay_s(self, key: str) -> float:
+        parsed = _parse_key(key)
+        spec = parsed and self._first("delay", parsed[1], parsed[0])
+        return spec.seconds if spec else 0.0
+
+    def maybe_corrupt(self, key: str, value: bytes) -> bytes:
+        parsed = _parse_key(key)
+        if not parsed:
+            return value
+        epoch, rank = parsed
+        spec = self._first("corrupt", rank, epoch)
+        if spec is None:
+            return value
+        claim = (spec, epoch, rank)
+        with self._lock:
+            served = self._corrupt_served.get(claim, 0)
+            if served >= spec.times:
+                return value
+            self._corrupt_served[claim] = served + 1
+        return corrupt_bytes(value)
+
+
+def parse_plan(text: str) -> FaultPlan:
+    """Parse a JSON list of fault dicts, e.g.
+    ``[{"kind": "drop", "rank": 1, "epoch": 0}]``."""
+    specs = json.loads(text)
+    if not isinstance(specs, list):
+        raise ValueError(f"A fault plan must be a JSON list of fault objects, got {type(specs).__name__}")
+    return FaultPlan([FaultSpec(**spec) for spec in specs])
+
+
+def plan_from_env(environ: Optional[Dict[str, str]] = None) -> Optional[FaultPlan]:
+    """Read ``METRICS_TPU_FAULTS`` — inline JSON, or ``@path`` to a JSON
+    file. Returns None when unset/empty."""
+    raw = (environ if environ is not None else os.environ).get(FAULTS_ENV_VAR, "").strip()
+    if not raw:
+        return None
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    return parse_plan(raw)
+
+
+# ---------------------------------------------------------------------------
+# in-memory coordination-service fake (single-process, multi-thread "ranks")
+# ---------------------------------------------------------------------------
+class InMemoryKVStore:
+    """Thread-shared fake of the distributed runtime's KV/barrier service.
+
+    ``store.client(rank)`` returns a per-rank binding exposing the four calls
+    the sync stack uses; ``store.log`` records every (op, rank, key) for
+    assertions like "retries stayed on the same epoch key".
+    """
+
+    def __init__(self, faults: Any = ()) -> None:
+        self.faults = faults if isinstance(faults, FaultPlan) else FaultPlan(faults)
+        self._cond = threading.Condition()
+        self._data: Dict[str, Tuple[bytes, float]] = {}  # key -> (value, visible_at)
+        self._barriers: Dict[str, set] = {}
+        self.log: List[Tuple[str, int, str]] = []
+
+    def client(self, rank: int) -> "_SimClient":
+        return _SimClient(self, int(rank))
+
+    # -- operations (rank-bound, called via _SimClient) -----------------
+    def _set(self, rank: int, key: str, value: bytes) -> None:
+        with self._cond:
+            self.log.append(("set", rank, key))
+            if self.faults.drops_publish(key):
+                return
+            visible_at = time.monotonic() + self.faults.publish_visible_delay_s(key)
+            self._data[key] = (bytes(value), visible_at)
+            self._cond.notify_all()
+
+    def _get(self, rank: int, key: str, timeout_ms: int) -> bytes:
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cond:
+            self.log.append(("get", rank, key))
+            while True:
+                entry = self._data.get(key)
+                if entry is not None and entry[1] <= time.monotonic():
+                    value = entry[0]
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise KVTimeoutError(
+                        f"DEADLINE_EXCEEDED: key {key!r} not available within {timeout_ms}ms"
+                    )
+                self._cond.wait(min(remaining, 0.005))
+        read_delay = self.faults.read_delay_s(key)
+        if read_delay:
+            remaining = deadline - time.monotonic()
+            if read_delay > remaining:  # the slow read overruns this attempt's budget
+                time.sleep(max(0.0, remaining))
+                raise KVTimeoutError(
+                    f"DEADLINE_EXCEEDED: read of key {key!r} exceeded its {timeout_ms}ms budget"
+                )
+            time.sleep(read_delay)
+        return self.faults.maybe_corrupt(key, value)
+
+    def _barrier(self, rank: int, barrier_id: str, timeout_ms: int, process_ids: Sequence[int]) -> None:
+        needed = set(int(p) for p in process_ids)
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cond:
+            self.log.append(("barrier", rank, barrier_id))
+            self._barriers.setdefault(barrier_id, set()).add(rank)
+            self._cond.notify_all()
+            while not needed.issubset(self._barriers[barrier_id]):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    missing = sorted(needed - self._barriers[barrier_id])
+                    raise KVTimeoutError(
+                        f"DEADLINE_EXCEEDED: barrier {barrier_id!r} missing ranks {missing}"
+                        f" after {timeout_ms}ms"
+                    )
+                self._cond.wait(min(remaining, 0.005))
+
+    def _delete(self, rank: int, key: str) -> None:
+        with self._cond:
+            self.log.append(("delete", rank, key))
+            self._data.pop(key, None)
+            self._cond.notify_all()
+
+
+class _SimClient:
+    """Per-rank binding of an :class:`InMemoryKVStore` — duck-types the
+    distributed runtime client surface the sync stack uses."""
+
+    def __init__(self, store: InMemoryKVStore, rank: int) -> None:
+        self._store = store
+        self.rank = rank
+
+    def key_value_set_bytes(self, key: str, value: bytes) -> None:
+        self._store._set(self.rank, key, value)
+
+    def blocking_key_value_get_bytes(self, key: str, timeout_ms: int) -> bytes:
+        return self._store._get(self.rank, key, timeout_ms)
+
+    def wait_at_barrier(self, barrier_id: str, timeout_ms: int, process_ids: Optional[Sequence[int]] = None) -> None:
+        self._store._barrier(self.rank, barrier_id, timeout_ms, process_ids or ())
+
+    def key_value_delete(self, key: str) -> None:
+        self._store._delete(self.rank, key)
+
+
+# ---------------------------------------------------------------------------
+# fault wrapper for a REAL distributed-runtime client (env-activated)
+# ---------------------------------------------------------------------------
+class FaultyClient:
+    """Apply a :class:`FaultPlan` around a live coordination-service client.
+
+    Used by ``groups._kv_client()`` when ``METRICS_TPU_FAULTS`` is set, so a
+    real multi-host run (e.g. inside a ``tools/tpu_probe_loop.sh`` TPU
+    window) exercises the same retry/degradation paths the CPU harness does.
+    Faults keyed by rank R bite on the host *publishing* as R (drop/straggler
+    suppress or delay its own publish) and on any host *reading* R's payload
+    (delay/corrupt).
+    """
+
+    def __init__(self, inner: Any, plan: FaultPlan) -> None:
+        self._inner = inner
+        self._plan = plan
+        self._pending: Dict[str, threading.Timer] = {}
+        self._pending_lock = threading.Lock()
+
+    def key_value_set_bytes(self, key: str, value: bytes) -> None:
+        if self._plan.drops_publish(key):
+            return
+        delay = self._plan.publish_visible_delay_s(key)
+        if delay:
+            # straggler semantics match the in-memory store: the publish
+            # becomes VISIBLE late — the publisher itself is not blocked (its
+            # exchange deadline keeps running against its peer reads only)
+            timer = threading.Timer(delay, self._inner.key_value_set_bytes, args=(key, bytes(value)))
+            timer.daemon = True
+            with self._pending_lock:
+                self._pending[key] = timer
+            timer.start()
+            return
+        self._inner.key_value_set_bytes(key, value)
+
+    def blocking_key_value_get_bytes(self, key: str, timeout_ms: int) -> bytes:
+        delay = self._plan.read_delay_s(key)
+        if delay:
+            budget = timeout_ms / 1000.0
+            if delay >= budget:
+                time.sleep(budget)
+                raise KVTimeoutError(
+                    f"DEADLINE_EXCEEDED: injected read delay exceeded the {timeout_ms}ms budget for {key!r}"
+                )
+            time.sleep(delay)
+            timeout_ms = max(1, int((budget - delay) * 1000))
+        value = self._inner.blocking_key_value_get_bytes(key, timeout_ms)
+        return self._plan.maybe_corrupt(key, value)
+
+    def key_value_delete(self, key: str) -> None:
+        # a delayed (straggler) publish still in flight must not land AFTER
+        # the exchange's cleanup and leak a coordination-service entry
+        with self._pending_lock:
+            timer = self._pending.pop(key, None)
+        if timer is not None:
+            timer.cancel()
+        self._inner.key_value_delete(key)
+
+    def __getattr__(self, name: str) -> Any:  # barrier/etc pass through
+        return getattr(self._inner, name)
+
+
+_env_wrapped: Dict[int, FaultyClient] = {}
+_ENV_PLAN_UNSET = object()
+_env_plan: Any = _ENV_PLAN_UNSET  # parsed once per process; None = "no plan"
+
+
+def maybe_wrap_client(client: Any) -> Any:
+    """Wrap ``client`` in a :class:`FaultyClient` when ``METRICS_TPU_FAULTS``
+    is set; otherwise return it unchanged. This sits on the hot sync path, so
+    everything is cached: the env plan is parsed once per process (including
+    the common negative "no plan" result), and the wrapper is cached per
+    client so ``corrupt(times=N)`` accounting survives across exchanges."""
+    global _env_plan
+    wrapper = _env_wrapped.get(id(client))
+    if wrapper is not None and wrapper._inner is client:
+        return wrapper
+    if _env_plan is _ENV_PLAN_UNSET:
+        _env_plan = plan_from_env()
+    if _env_plan is None or not len(_env_plan):
+        return client
+    wrapper = FaultyClient(client, _env_plan)
+    _env_wrapped[id(client)] = wrapper
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# per-thread world simulation (ContextVars are thread-local by default)
+# ---------------------------------------------------------------------------
+_CLIENT_OVERRIDE: "contextvars.ContextVar[Optional[Any]]" = contextvars.ContextVar(
+    "metrics_tpu_kv_client_override", default=None
+)
+_PROCESS_OVERRIDE: "contextvars.ContextVar[Optional[Tuple[int, int]]]" = contextvars.ContextVar(
+    "metrics_tpu_sim_process", default=None
+)
+
+
+def current_client() -> Optional[Any]:
+    """The KV client override for the current thread, if any."""
+    return _CLIENT_OVERRIDE.get()
+
+
+def simulated_process() -> Optional[Tuple[int, int]]:
+    """The simulated (rank, world) for the current thread, if any."""
+    return _PROCESS_OVERRIDE.get()
+
+
+@contextlib.contextmanager
+def simulated_world(rank: int, world: int, client: Any):
+    """Run the enclosed code as simulated process ``rank`` of ``world``,
+    talking to ``client`` instead of the real distributed runtime.
+
+    Overrides are ContextVars: each thread sets its own, so N threads under
+    :func:`run_as_peers` impersonate N processes concurrently.
+    """
+    token_c = _CLIENT_OVERRIDE.set(client)
+    token_p = _PROCESS_OVERRIDE.set((int(rank), int(world)))
+    try:
+        yield
+    finally:
+        _CLIENT_OVERRIDE.reset(token_c)
+        _PROCESS_OVERRIDE.reset(token_p)
+
+
+def run_as_peers(
+    world: int,
+    fn: Callable[[int], Any],
+    store: Optional[InMemoryKVStore] = None,
+    faults: Any = (),
+    timeout_s: float = 60.0,
+) -> Dict[int, Any]:
+    """Run ``fn(rank)`` for every rank on its own thread, each inside
+    :func:`simulated_world` over a shared :class:`InMemoryKVStore`.
+
+    Returns ``{rank: result}``; the first per-rank exception is re-raised in
+    the caller after every thread has finished (so a failing exchange can't
+    leave live threads mutating the store behind the test's back).
+    """
+    store = store if store is not None else InMemoryKVStore(faults)
+    results: Dict[int, Any] = {}
+    errors: Dict[int, BaseException] = {}
+
+    def runner(rank: int) -> None:
+        try:
+            with simulated_world(rank, world, store.client(rank)):
+                results[rank] = fn(rank)
+        except BaseException as err:  # noqa: BLE001 — re-raised below
+            errors[rank] = err
+
+    threads = [threading.Thread(target=runner, args=(r,), daemon=True) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout_s)
+    alive = [t for t in threads if t.is_alive()]
+    if alive:
+        raise RuntimeError(
+            f"{len(alive)} simulated peer(s) still running after {timeout_s}s — "
+            "a sync path hung past its group deadline"
+        )
+    if errors:
+        rank = sorted(errors)[0]
+        raise errors[rank]
+    return results

@@ -71,20 +71,84 @@ def _decode_dynamic(value: Any) -> Any:
     return value
 
 
+def _dtype_kind(dtype: Any) -> str:
+    """Coarse dtype family for restore validation: exact widths legitimately
+    differ across the x64/x32 lanes (a float64 checkpoint restored under x32
+    canonicalizes to float32), but float-vs-int-vs-bool never should."""
+    kind = np.dtype(dtype).kind
+    return {"f": "float", "V": "float", "i": "int", "u": "int", "b": "bool"}.get(kind, kind)
+
+
 def restore_metric_state_pytree(metric: Metric, tree: Dict[str, Any]) -> Metric:
-    """Inverse of :func:`metric_state_pytree` (in place)."""
-    metric._update_count = int(tree["_update_count"])
+    """Inverse of :func:`metric_state_pytree` (in place).
+
+    Every registered state is validated against the metric's registered
+    defaults before binding — a checkpoint from a different metric, config
+    (e.g. another ``num_classes``), or a corrupted tree raises a precise
+    error naming the offending state instead of silently mis-binding.
+    """
+    cls = type(metric).__name__
+    if "_update_count" not in tree:
+        raise KeyError(
+            f"Checkpoint tree for {cls} is missing '_update_count' — not a"
+            " metric_state_pytree snapshot?"
+        )
+    missing = [name for name in metric._defaults if name not in tree]
+    if missing:
+        held = sorted(k for k in tree if not k.startswith("_"))
+        raise KeyError(
+            f"Checkpoint tree is missing state(s) {missing} registered by {cls};"
+            f" the tree holds {held}. Restoring it would silently drop state."
+        )
+    restored: Dict[str, Any] = {}
     for name in metric._defaults:
         value = tree[name]
-        if tree.get(f"_{name}_is_list", False) or isinstance(value, dict):
+        default = metric._defaults[name]
+        is_list_value = tree.get(f"_{name}_is_list", False) or isinstance(value, dict)
+        if isinstance(default, list) != is_list_value:
+            want, got = ("list buffer", "array") if isinstance(default, list) else ("array", "list buffer")
+            raise ValueError(
+                f"State {name!r} of {cls} is registered as a {want} but the"
+                f" checkpoint holds a {got} — wrong metric class or config?"
+            )
+        if is_list_value:
             items = sorted(value.items(), key=lambda kv: int(kv[0]))
-            setattr(metric, name, [jnp.asarray(v) for _, v in items])
-        else:
-            setattr(metric, name, jnp.asarray(value))
+            restored[name] = [jnp.asarray(v) for _, v in items]
+            continue
+        arr = jnp.asarray(value)
+        if arr.shape != default.shape:
+            raise ValueError(
+                f"State {name!r} of {cls} has registered default shape"
+                f" {tuple(default.shape)} but the checkpoint holds shape"
+                f" {tuple(arr.shape)} — was it saved from a different"
+                " configuration (e.g. another num_classes)?"
+            )
+        if _dtype_kind(arr.dtype) != _dtype_kind(default.dtype):
+            raise ValueError(
+                f"State {name!r} of {cls} is registered as"
+                f" {_dtype_kind(default.dtype)} ({default.dtype}) but the"
+                f" checkpoint holds {_dtype_kind(arr.dtype)} ({arr.dtype})."
+            )
+        restored[name] = arr.astype(default.dtype)
+    # decode dynamic attrs BEFORE binding anything: a corrupted blob must
+    # fail while the metric is still untouched
+    restored_dyn: Dict[str, Any] = {}
     if "_dynamic" in tree:
-        dyn = json.loads(bytes(np.asarray(tree["_dynamic"], np.uint8)).decode("utf-8"))
-        for attr, value in dyn.items():
-            setattr(metric, attr, _decode_dynamic(value))
+        try:
+            dyn = json.loads(bytes(np.asarray(tree["_dynamic"], np.uint8)).decode("utf-8"))
+            restored_dyn = {attr: _decode_dynamic(value) for attr, value in dyn.items()}
+        except (ValueError, UnicodeDecodeError, AttributeError) as err:
+            raise ValueError(
+                f"Checkpoint tree for {cls} carries an unparseable '_dynamic'"
+                f" attribute blob: {err}"
+            ) from err
+    # bind only after EVERY state validated — a failed restore must not leave
+    # the metric half-overwritten
+    metric._update_count = int(np.asarray(tree["_update_count"]))
+    for name, value in restored.items():
+        setattr(metric, name, value)
+    for attr, value in restored_dyn.items():
+        setattr(metric, attr, value)
     metric._computed = None
     metric._is_synced = False
     metric._cache = None

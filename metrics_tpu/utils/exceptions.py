@@ -1,8 +1,45 @@
-"""Exception types (reference ``torchmetrics/utilities/exceptions.py``)."""
+"""Exception types (reference ``torchmetrics/utilities/exceptions.py``).
+
+Beyond the reference surface: the ``SyncError`` family raised by the
+host-level distributed sync stack (``parallel/groups.py``). They subclass
+``RuntimeError`` so pre-existing ``except RuntimeError`` call sites keep
+working, and they carry enough context (group, epoch, rank) to diagnose a
+desynced or degraded exchange without a debugger.
+"""
 
 
 class MetricsUserError(Exception):
     """Error raised by misuse of the metrics API by the user."""
+
+
+class SyncError(RuntimeError):
+    """Base class for host-level distributed sync failures.
+
+    Raised by the KV-store exchange in ``parallel/groups.py`` once the
+    retry/backoff machinery is exhausted (or for non-retryable failures).
+    ``Metric(on_sync_error='local'|'partial')`` catches exactly this family
+    when deciding whether to degrade instead of propagating.
+    """
+
+
+class SyncTimeoutError(SyncError):
+    """A sync peer's payload (or the group barrier) did not arrive within the
+    group deadline, across every retry attempt the group's
+    :class:`~metrics_tpu.resilience.RetryPolicy` allows."""
+
+
+class SyncIntegrityError(SyncError):
+    """A sync payload failed wire-format validation: truncated, checksum
+    mismatch, header/body length disagreement, or a mixed-version peer.
+
+    ``transient`` marks failures worth retrying (corruption/truncation may be
+    a torn read); a wire-format *version* mismatch is deterministic and is
+    raised with ``transient=False``.
+    """
+
+    def __init__(self, message: str, transient: bool = True) -> None:
+        super().__init__(message)
+        self.transient = transient
 
 
 class JitIncompatibleError(ValueError):

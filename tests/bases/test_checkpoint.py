@@ -50,6 +50,100 @@ class TestStatePytree:
         restore_metric_state_pytree(fresh, tree)
         assert fresh._computed is None
 
+    def test_update_counter_round_trips(self):
+        m = _fill(MeanSquaredError(), 9, batches=5)
+        assert m._update_count == 5
+        fresh = restore_metric_state_pytree(MeanSquaredError(), metric_state_pytree(m))
+        assert fresh._update_count == 5
+        # the counter keeps counting from where it left off
+        _fill(fresh, 10, batches=2)
+        assert fresh._update_count == 7
+        # and a tree without it is rejected outright
+        tree = metric_state_pytree(m)
+        del tree["_update_count"]
+        with pytest.raises(KeyError, match="_update_count"):
+            restore_metric_state_pytree(MeanSquaredError(), tree)
+
+
+class TestRestoreValidation:
+    """Satellite: restore must validate names/shapes/dtypes against the
+    metric's registered defaults and name the offending state — never
+    silently mis-bind."""
+
+    def test_missing_state_names_the_state(self):
+        m = _fill(MeanSquaredError(), 11)
+        tree = metric_state_pytree(m)
+        del tree["sum_squared_error"]
+        with pytest.raises(KeyError, match="sum_squared_error"):
+            restore_metric_state_pytree(MeanSquaredError(), tree)
+
+    def test_shape_mismatch_names_state_and_shapes(self):
+        import jax.numpy as jnp
+
+        from metrics_tpu import ConfusionMatrix
+
+        rng = np.random.default_rng(12)
+        m3 = ConfusionMatrix(num_classes=3)
+        m3.update(jnp.asarray(rng.integers(0, 3, 16)), jnp.asarray(rng.integers(0, 3, 16)))
+        tree = metric_state_pytree(m3)
+        with pytest.raises(ValueError, match=r"confmat.*\(5, 5\).*\(3, 3\)"):
+            restore_metric_state_pytree(ConfusionMatrix(num_classes=5), tree)
+
+    def test_dtype_kind_mismatch_is_rejected(self):
+        m = _fill(MeanSquaredError(), 13)
+        tree = metric_state_pytree(m)
+        tree["total"] = np.asarray(tree["total"], np.float32)  # counter state is int
+        with pytest.raises(ValueError, match="total"):
+            restore_metric_state_pytree(MeanSquaredError(), tree)
+
+    def test_list_vs_array_kind_mismatch_is_rejected(self):
+        rng = np.random.default_rng(14)
+        m = AUROC()
+        m.update(jnp.asarray(rng.uniform(size=20)), jnp.asarray(rng.integers(0, 2, 20)))
+        tree = metric_state_pytree(m)
+        tree["preds"] = np.zeros(20)  # list buffer replaced by a bare array
+        del tree["_preds_is_list"]
+        with pytest.raises(ValueError, match="preds.*list buffer"):
+            restore_metric_state_pytree(AUROC(), tree)
+
+    def test_failed_restore_leaves_metric_untouched(self):
+        """Validation failure mid-tree must not leave the metric half-bound."""
+        m = _fill(MeanSquaredError(), 15)
+        expected = float(m.compute())
+        tree = metric_state_pytree(_fill(MeanSquaredError(), 16))
+        tree["total"] = np.asarray(tree["total"], np.float32)  # poisoned
+        with pytest.raises(ValueError):
+            restore_metric_state_pytree(m, tree)
+        np.testing.assert_allclose(float(m.compute()), expected, atol=1e-7)
+
+    def test_corrupted_dynamic_blob_leaves_metric_untouched(self):
+        """A bad '_dynamic' attribute blob must fail BEFORE any state binds."""
+        rng = np.random.default_rng(18)
+        src = AUROC()
+        src.update(jnp.asarray(rng.uniform(size=20)), jnp.asarray(rng.integers(0, 2, 20)))
+        tree = metric_state_pytree(src)
+        tree["_dynamic"] = np.frombuffer(b"not json {", dtype=np.uint8)
+
+        dst = AUROC()
+        dst.update(jnp.asarray(rng.uniform(size=20)), jnp.asarray(rng.integers(0, 2, 20)))
+        expected = float(dst.compute())
+        before_count = dst._update_count
+        with pytest.raises(ValueError, match="_dynamic"):
+            restore_metric_state_pytree(dst, tree)
+        assert dst._update_count == before_count
+        np.testing.assert_allclose(float(dst.compute()), expected, atol=1e-7)
+
+    def test_cross_lane_float_width_still_restores(self):
+        """Exact float width may differ across the x64/x32 test lanes; the
+        restore casts to the registered default instead of rejecting."""
+        m = _fill(MeanSquaredError(), 17)
+        expected = float(m.compute())
+        tree = metric_state_pytree(m)
+        tree["sum_squared_error"] = np.asarray(tree["sum_squared_error"], np.float32)
+        fresh = restore_metric_state_pytree(MeanSquaredError(), tree)
+        assert fresh.sum_squared_error.dtype == fresh._defaults["sum_squared_error"].dtype
+        np.testing.assert_allclose(float(fresh.compute()), expected, rtol=1e-5)
+
 
 class TestOrbax:
     def test_save_load_metric(self, tmp_path):

@@ -1,0 +1,201 @@
+"""Rollback coverage for ``Metric.forward``'s finally-restore paths
+(``metric.py`` ``_forward_reduce_state_update`` / ``_forward_full_state_update``):
+an exception raised mid-batch-update (or in the batch-local compute) must
+leave the accumulated global state and ``_update_count`` bit-identical.
+
+The flaky metrics run eager (``jit_update=False``) so their Python-side
+failure triggers fire per call, not per trace.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+
+
+def state_bits(metric):
+    """Raw (bytes, dtype, shape) of every registered state — bit-identity."""
+    out = {}
+    for name in metric._defaults:
+        value = getattr(metric, name)
+        values = value if isinstance(value, list) else [value]
+        out[name] = [(np.asarray(v).tobytes(), np.asarray(v).dtype, np.asarray(v).shape) for v in values]
+    return out
+
+
+class FlakySum(Metric):
+    """Mergeable (sum) states -> the reduce-state forward fast path."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(jit_update=False, **kwargs)
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.fail_update = False
+        self.fail_compute = False
+        self.calls = 0
+
+    def update(self, x):
+        self.calls += 1
+        if self.fail_update:
+            raise RuntimeError("injected update failure")
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + x.size
+
+    def compute(self):
+        if self.fail_compute:
+            raise RuntimeError("injected compute failure")
+        return self.total / self.count
+
+
+class FlakyDance(FlakySum):
+    """Same states, but forced through the full-state save/reset/update/
+    compute/restore dance. ``fail_on_call`` targets the dance's SECOND update
+    (the batch-local one) while the accumulation update succeeds."""
+
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.fail_on_call = None
+
+    def update(self, x):
+        if self.calls + 1 == self.fail_on_call:
+            self.calls += 1
+            raise RuntimeError("injected mid-dance update failure")
+        super().update(x)
+
+
+def test_reduce_path_update_failure_rolls_back_bitwise():
+    m = FlakySum()
+    m(jnp.asarray([1.0, 2.0]))
+    m(jnp.asarray([3.0]))
+    before_states = state_bits(m)
+    before_count = m._update_count
+    before_computed = float(m.compute())
+
+    m.fail_update = True
+    with pytest.raises(RuntimeError, match="injected update failure"):
+        m(jnp.asarray([100.0]))
+
+    assert state_bits(m) == before_states
+    assert m._update_count == before_count
+    assert float(m.compute()) == before_computed
+
+    # recovery: the next good forward continues the accumulation correctly
+    m.fail_update = False
+    m(jnp.asarray([4.0]))
+    assert m._update_count == before_count + 1
+    np.testing.assert_allclose(float(m.total), 10.0)
+    assert int(m.count) == 4
+
+
+def test_reduce_path_compute_failure_rolls_back_bitwise():
+    """The batch update succeeds but the batch-local compute raises: the
+    global accumulation must be untouched (the merge never ran)."""
+    m = FlakySum()
+    m(jnp.asarray([1.0, 2.0]))
+    before_states = state_bits(m)
+    before_count = m._update_count
+
+    m.fail_compute = True
+    with pytest.raises(RuntimeError, match="injected compute failure"):
+        m(jnp.asarray([100.0]))
+
+    assert state_bits(m) == before_states
+    assert m._update_count == before_count
+    m.fail_compute = False
+    np.testing.assert_allclose(float(m.compute()), 1.5)
+
+
+def test_reduce_path_failure_does_not_leave_sync_flags_dirty():
+    m = FlakySum()
+    m(jnp.asarray([1.0]))
+    m.fail_compute = True
+    with pytest.raises(RuntimeError):
+        m(jnp.asarray([2.0]))
+    assert m._to_sync is True
+    assert m._should_unsync is True
+    assert m._is_synced is False
+    assert m._cache is None
+
+
+def test_dance_path_second_update_failure_keeps_accumulation():
+    """In the full-state dance the FIRST update accumulates the batch; if the
+    batch-local (second) update then raises, the state must equal exactly
+    accumulation-after-first-update — compared bit-for-bit against a twin
+    that ran plain ``update``."""
+    m = FlakyDance()
+    m(jnp.asarray([1.0, 2.0]))
+
+    twin = FlakyDance()
+    twin.update(jnp.asarray([1.0, 2.0]))
+    twin.update(jnp.asarray([5.0]))  # what m's accumulation will hold
+
+    m.fail_on_call = m.calls + 2  # first (accumulating) update ok, second raises
+    with pytest.raises(RuntimeError, match="mid-dance"):
+        m(jnp.asarray([5.0]))
+
+    assert state_bits(m) == state_bits(twin)
+    assert m._update_count == twin._update_count
+
+
+def test_dance_path_compute_failure_keeps_accumulation():
+    m = FlakyDance()
+    m(jnp.asarray([1.0, 2.0]))
+    twin = FlakyDance()
+    twin.update(jnp.asarray([1.0, 2.0]))
+    twin.update(jnp.asarray([5.0]))
+
+    m.fail_compute = True
+    with pytest.raises(RuntimeError, match="injected compute failure"):
+        m(jnp.asarray([5.0]))
+
+    assert state_bits(m) == state_bits(twin)
+    assert m._update_count == twin._update_count
+    assert m._should_unsync is True and m._to_sync is True and m._cache is None
+
+    # recovery: compute() now reflects the accumulated state
+    m.fail_compute = False
+    np.testing.assert_allclose(float(m.compute()), 8.0 / 3.0)
+
+
+def test_dance_path_restores_computed_cache_slot():
+    """The dance saves/restores ``_computed``: the batch-local value computed
+    inside the dance must never masquerade as the global cached result —
+    neither on success nor after a failed batch."""
+    m = FlakyDance()
+    batch_val = m(jnp.asarray([2.0, 4.0]))
+    # the dance computed a batch-local value, but the cache slot must hold
+    # the pre-dance state (None: an update invalidated it), so the next
+    # compute() reflects the ACCUMULATED state
+    assert m._computed is None
+    np.testing.assert_allclose(float(batch_val), 3.0)
+    np.testing.assert_allclose(float(m.compute()), 3.0)
+
+    m._computed = None  # drop the cache so the next dance starts clean
+    m.fail_compute = True
+    with pytest.raises(RuntimeError):
+        m(jnp.asarray([6.0]))
+    m.fail_compute = False
+    assert m._computed is None  # restored, not left holding a partial value
+    # accumulation includes the failed forward's first (successful) update
+    np.testing.assert_allclose(float(m.compute()), 12.0 / 3.0)
+
+
+def test_jitted_engine_update_failure_rolls_back_bitwise():
+    """Same invariant through the jitted engine path (ValueError raised at
+    trace time inside the shared-jit transition)."""
+    from metrics_tpu import Accuracy
+
+    m = Accuracy(num_classes=5)
+    rng = np.random.default_rng(0)
+    m.update(jnp.asarray(rng.random((8, 5))), jnp.asarray(rng.integers(0, 5, 8)))
+    before_states = state_bits(m)
+
+    with pytest.raises(ValueError):
+        # preds/target batch dims disagree -> the input formatter raises
+        m(jnp.asarray(rng.random((8, 5))), jnp.asarray(rng.integers(0, 5, 4)))
+
+    assert state_bits(m) == before_states

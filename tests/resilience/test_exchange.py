@@ -1,0 +1,235 @@
+"""The retrying KV exchange under injected faults — every fault class
+(drop/delay/corrupt/straggler) must deterministically yield either a
+successful retried sync or the configured degraded result, bounded by the
+group deadline, with the telemetry recording exactly what happened.
+
+Simulated multi-process worlds: each rank runs the REAL ``_exchange_bytes``
+on its own thread against a shared in-memory KV fake
+(``resilience.run_as_peers``).
+"""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.parallel import new_group
+from metrics_tpu.parallel.groups import _decode, _encode, _exchange_bytes, gather_group_pytrees
+from metrics_tpu.resilience import (
+    FaultSpec,
+    InMemoryKVStore,
+    RetryPolicy,
+    new_sync_stats,
+    run_as_peers,
+)
+from metrics_tpu.utils.exceptions import SyncTimeoutError
+
+FAST_RETRY = RetryPolicy(max_attempts=3, backoff_base_s=0.01, backoff_max_s=0.05)
+
+_group_seq = [0]
+
+
+def make_group(world=2, timeout_s=3.0, retry=FAST_RETRY):
+    """Fresh group name per test: exchange epochs are process-global per
+    scope, while fault specs here target epoch 0 of a new scope."""
+    _group_seq[0] += 1
+    return new_group(range(world), name=f"exch{_group_seq[0]}", timeout_s=timeout_s, retry=retry)
+
+
+def exchange(group, rank, payload=None, policy="raise", report=None):
+    payload = payload if payload is not None else _encode(np.arange(4) + 100 * rank)
+    return _exchange_bytes(payload, group, rank, policy=policy, report=report)
+
+
+def test_clean_exchange_round_trips_all_ranks():
+    group = make_group(world=3)
+    out = run_as_peers(3, lambda rank: exchange(group, rank))
+    for rank in range(3):
+        decoded = [_decode(p).tolist() for p in out[rank]]
+        assert decoded == [list(range(100 * r, 100 * r + 4)) for r in range(3)]
+
+
+def test_corrupt_payload_is_retried_and_recovers():
+    group = make_group()
+    reports = {r: new_sync_stats() for r in range(2)}
+    store = InMemoryKVStore([FaultSpec("corrupt", rank=1, epoch=0)])
+    out = run_as_peers(2, lambda rank: exchange(group, rank, report=reports[rank]), store=store)
+    np.testing.assert_array_equal(_decode(out[0][1]), np.arange(4) + 100)
+    assert reports[0]["integrity_failures"] == 1
+    assert reports[0]["retries"] == 1
+    assert reports[0]["attempts"] == 2
+    # the unaffected direction saw no faults
+    assert reports[1]["integrity_failures"] == 0 and reports[1]["retries"] == 0
+
+
+def test_retries_stay_on_the_same_epoch_key():
+    """The epoch must be stable across attempts so peers can still meet."""
+    group = make_group()
+    store = InMemoryKVStore([FaultSpec("corrupt", rank=1, epoch=0, times=2)])
+    run_as_peers(2, lambda rank: exchange(group, rank), store=store)
+    gets = [key for op, r, key in store.log if op == "get" and r == 0]
+    assert len(gets) == 3  # 2 corrupted reads + 1 clean
+    assert len(set(gets)) == 1  # ... all against ONE epoch key
+
+
+def test_persistent_corruption_exhausts_retries():
+    group = make_group(timeout_s=1.5)
+    store = InMemoryKVStore([FaultSpec("corrupt", rank=1, epoch=0, times=99)])
+
+    def peer(rank):
+        try:
+            exchange(group, rank)
+            return "ok"
+        except SyncTimeoutError as err:
+            # rank 0 exhausts retries on the corrupt payload (names the peer);
+            # rank 1 then times out at the barrier rank 0 never reached
+            if rank == 0:
+                assert "peer rank=1" in str(err)
+            return "timeout"
+
+    out = run_as_peers(2, peer, store=store)
+    assert out[0] == "timeout"
+
+
+def test_dropped_peer_raises_within_deadline():
+    group = make_group(timeout_s=1.0)
+    store = InMemoryKVStore([FaultSpec("drop", rank=1, epoch=0)])
+
+    def peer(rank):
+        try:
+            exchange(group, rank)
+            return "ok"
+        except SyncTimeoutError:
+            return "timeout"
+
+    start = time.monotonic()
+    out = run_as_peers(2, peer, store=store)
+    elapsed = time.monotonic() - start
+    # rank 0 times out reading the dropped payload; rank 1 then times out at
+    # the barrier rank 0 never reached — both bounded by the group deadline
+    assert out == {0: "timeout", 1: "timeout"}
+    assert elapsed < 3 * group.timeout_s  # never hangs past the deadline (+ slack)
+
+
+def test_dropped_peer_partial_returns_responders():
+    group = make_group(world=3, timeout_s=1.5)
+    store = InMemoryKVStore([FaultSpec("drop", rank=1, epoch=0)])
+    reports = {r: new_sync_stats() for r in range(3)}
+    out = run_as_peers(3, lambda rank: exchange(group, rank, policy="partial", report=reports[rank]), store=store)
+    # a dead peer must not starve live ones: ranks 0 and 2 still exchange
+    assert [p is not None for p in out[0]] == [True, False, True]
+    assert [p is not None for p in out[2]] == [True, False, True]
+    assert reports[0]["missing_ranks"] == [1] and reports[2]["missing_ranks"] == [1]
+    # rank 1 itself read everyone fine
+    assert [p is not None for p in out[1]] == [True, True, True]
+    assert reports[1]["missing_ranks"] == []
+
+
+def test_straggler_meets_the_exchange_late():
+    group = make_group(timeout_s=5.0)
+    store = InMemoryKVStore([FaultSpec("straggler", rank=1, epoch=0, seconds=0.4)])
+    reports = {r: new_sync_stats() for r in range(2)}
+    out = run_as_peers(2, lambda rank: exchange(group, rank, report=reports[rank]), store=store)
+    np.testing.assert_array_equal(_decode(out[0][1]), np.arange(4) + 100)
+    assert reports[0]["missing_ranks"] == []
+
+
+def test_delayed_read_within_budget_succeeds():
+    group = make_group(timeout_s=5.0)
+    store = InMemoryKVStore([FaultSpec("delay", rank=1, epoch=0, seconds=0.2)])
+    out = run_as_peers(2, lambda rank: exchange(group, rank), store=store)
+    np.testing.assert_array_equal(_decode(out[0][1]), np.arange(4) + 100)
+
+
+def test_delay_longer_than_deadline_degrades_partial_in_bounded_time():
+    group = make_group(timeout_s=1.0)
+    store = InMemoryKVStore([FaultSpec("delay", rank=1, epoch=0, seconds=30.0)])
+    report = new_sync_stats()
+
+    def peer(rank):
+        return exchange(group, rank, policy="partial", report=report if rank == 0 else None)
+
+    start = time.monotonic()
+    out = run_as_peers(2, peer, store=store)
+    assert time.monotonic() - start < 3 * group.timeout_s
+    assert out[0][1] is None and report["missing_ranks"] == [1]
+    assert report["kv_timeouts"] >= 1
+
+
+def test_pytree_gather_partial_drops_missing_member():
+    group = make_group(world=2, timeout_s=1.5)
+    store = InMemoryKVStore([FaultSpec("drop", rank=1, epoch=0)])
+    reports = {r: new_sync_stats() for r in range(2)}
+
+    def peer(rank):
+        tree = {"a": jnp.arange(3.0) + rank, "n": jnp.asarray(rank)}
+        return gather_group_pytrees(tree, group, policy="partial", report=reports[rank])
+
+    out = run_as_peers(2, peer, store=store)
+    assert len(out[0]) == 1  # only its own tree
+    assert reports[0]["missing_ranks"] == [1]
+    assert len(out[1]) == 2  # rank 1 read rank 0 fine
+    np.testing.assert_array_equal(np.asarray(out[1][0]["a"]), np.arange(3.0))
+
+
+def test_publish_failure_is_classified_as_sync_error():
+    """A coordination-service failure on the PUBLISH (not just reads) must be
+    a SyncError so on_sync_error degradation applies to it."""
+    from metrics_tpu.resilience import simulated_world
+    from metrics_tpu.utils.exceptions import SyncError
+
+    class DownService:
+        def key_value_set_bytes(self, key, value):
+            raise RuntimeError("UNAVAILABLE: coordination service unreachable")
+
+    group = make_group(timeout_s=0.5)
+    with simulated_world(0, 2, DownService()):
+        with pytest.raises(SyncError, match="KV publish failed"):
+            _exchange_bytes(_encode(np.arange(2)), group, 0)
+
+
+def test_cleanup_failure_does_not_mask_the_exchange_result():
+    """key deletion is best-effort: a delete failure must neither mask a read
+    error nor fail a successful exchange."""
+    from metrics_tpu.resilience import simulated_world
+
+    store = InMemoryKVStore()
+
+    class FlakyDelete:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def key_value_delete(self, key):
+            raise RuntimeError("UNAVAILABLE: service went away during cleanup")
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    group = make_group(timeout_s=1.0)
+
+    def peer(rank):
+        with simulated_world(rank, 2, FlakyDelete(store.client(rank))):
+            return _exchange_bytes(_encode(np.arange(2) + rank), group, rank)
+
+    import threading
+
+    results = {}
+
+    def runner(rank):
+        results[rank] = peer(rank)
+
+    threads = [threading.Thread(target=runner, args=(r,), daemon=True) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert sorted(results) == [0, 1]  # the exchange succeeded despite failed cleanup
+    np.testing.assert_array_equal(_decode(results[0][1]), np.arange(2) + 1)
+
+
+def test_backoff_elapsed_is_recorded():
+    group = make_group(timeout_s=3.0, retry=RetryPolicy(max_attempts=3, backoff_base_s=0.05, backoff_max_s=0.2))
+    store = InMemoryKVStore([FaultSpec("corrupt", rank=1, epoch=0, times=2)])
+    report = new_sync_stats()
+    run_as_peers(2, lambda rank: exchange(group, rank, report=report if rank == 0 else None), store=store)
+    assert report["backoff_s"] > 0.0

@@ -1,0 +1,163 @@
+"""The fault-injection harness itself: plan parsing, the in-memory KV fake,
+and the live-client wrapper."""
+import json
+import threading
+
+import pytest
+
+from metrics_tpu.resilience import (
+    FaultPlan,
+    FaultSpec,
+    FaultyClient,
+    InMemoryKVStore,
+    KVTimeoutError,
+    parse_plan,
+    plan_from_env,
+)
+from metrics_tpu.resilience.faults import corrupt_bytes
+
+
+def test_fault_spec_validation_and_matching():
+    with pytest.raises(ValueError, match="Unknown fault kind"):
+        FaultSpec("explode", rank=0)
+    spec = FaultSpec("drop", rank=1, epoch=2)
+    assert spec.matches(1, 2) and not spec.matches(1, 3) and not spec.matches(0, 2)
+    assert FaultSpec("drop", rank=1).matches(1, 99)  # epoch=None matches all
+
+
+def test_plan_parsing_inline_and_env(tmp_path, monkeypatch):
+    plan = parse_plan('[{"kind": "corrupt", "rank": 1, "epoch": 0, "times": 2}]')
+    assert len(plan) == 1 and plan.specs[0].times == 2
+    with pytest.raises(ValueError, match="JSON list"):
+        parse_plan('{"kind": "drop"}')
+
+    monkeypatch.delenv("METRICS_TPU_FAULTS", raising=False)
+    assert plan_from_env() is None
+    monkeypatch.setenv("METRICS_TPU_FAULTS", '[{"kind": "drop", "rank": 0}]')
+    assert len(plan_from_env()) == 1
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps([{"kind": "delay", "rank": 2, "seconds": 0.1}]))
+    monkeypatch.setenv("METRICS_TPU_FAULTS", f"@{path}")
+    plan = plan_from_env()
+    assert plan.specs[0].kind == "delay" and plan.specs[0].rank == 2
+
+
+def test_corrupt_bytes_changes_payload_deterministically():
+    payload = bytes(range(64))
+    assert corrupt_bytes(payload) != payload
+    assert corrupt_bytes(payload) == corrupt_bytes(payload)
+    assert len(corrupt_bytes(payload)) == len(payload)
+
+
+def test_store_set_get_delete_and_timeout():
+    store = InMemoryKVStore()
+    c0 = store.client(0)
+    c0.key_value_set_bytes("pg/s/0/0", b"abc")
+    assert store.client(1).blocking_key_value_get_bytes("pg/s/0/0", 100) == b"abc"
+    with pytest.raises(KVTimeoutError, match="DEADLINE_EXCEEDED"):
+        store.client(1).blocking_key_value_get_bytes("pg/s/0/9", 50)
+    c0.key_value_delete("pg/s/0/0")
+    with pytest.raises(KVTimeoutError):
+        store.client(1).blocking_key_value_get_bytes("pg/s/0/0", 50)
+
+
+def test_store_get_blocks_until_published():
+    store = InMemoryKVStore()
+    result = {}
+
+    def reader():
+        result["value"] = store.client(1).blocking_key_value_get_bytes("pg/s/0/0", 2000)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    store.client(0).key_value_set_bytes("pg/s/0/0", b"late")
+    t.join(5)
+    assert not t.is_alive() and result["value"] == b"late"
+
+
+def test_store_barrier_completes_and_times_out():
+    store = InMemoryKVStore()
+    done = []
+
+    def member(rank):
+        store.client(rank).wait_at_barrier("pg/s/0/done", 2000, process_ids=[0, 1])
+        done.append(rank)
+
+    threads = [threading.Thread(target=member, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)
+    assert sorted(done) == [0, 1]
+    with pytest.raises(KVTimeoutError, match="missing ranks \\[3\\]"):
+        store.client(0).wait_at_barrier("pg/s/1/done", 50, process_ids=[0, 3])
+
+
+def test_store_applies_drop_and_corrupt_faults():
+    store = InMemoryKVStore([FaultSpec("drop", rank=0, epoch=0), FaultSpec("corrupt", rank=1, epoch=0)])
+    store.client(0).key_value_set_bytes("pg/s/0/0", b"dropped")
+    with pytest.raises(KVTimeoutError):
+        store.client(1).blocking_key_value_get_bytes("pg/s/0/0", 50)
+    # same rank, later epoch: unaffected
+    store.client(0).key_value_set_bytes("pg/s/1/0", b"kept")
+    assert store.client(1).blocking_key_value_get_bytes("pg/s/1/0", 100) == b"kept"
+
+    store.client(1).key_value_set_bytes("pg/s/0/1", b"payload")
+    first = store.client(0).blocking_key_value_get_bytes("pg/s/0/1", 100)
+    second = store.client(0).blocking_key_value_get_bytes("pg/s/0/1", 100)
+    assert first != b"payload" and second == b"payload"  # heals after `times`
+
+
+class _FakeInner:
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set_bytes(self, key, value):
+        self.store[key] = value
+
+    def blocking_key_value_get_bytes(self, key, timeout_ms):
+        if key not in self.store:
+            raise KVTimeoutError("DEADLINE_EXCEEDED: absent")
+        return self.store[key]
+
+    def key_value_delete(self, key):
+        self.store.pop(key, None)
+
+    def wait_at_barrier(self, *a, **k):
+        return None
+
+
+def test_faulty_client_wrapper_drop_corrupt_passthrough():
+    inner = _FakeInner()
+    client = FaultyClient(inner, FaultPlan([FaultSpec("drop", rank=0, epoch=0), FaultSpec("corrupt", rank=1)]))
+    client.key_value_set_bytes("pg/s/0/0", b"x")  # dropped: never reaches inner
+    assert "pg/s/0/0" not in inner.store
+    client.key_value_set_bytes("pg/s/1/0", b"x")  # other epoch passes through
+    assert inner.store["pg/s/1/0"] == b"x"
+    client.key_value_set_bytes("pg/s/0/1", b"payload")
+    assert client.blocking_key_value_get_bytes("pg/s/0/1", 100) != b"payload"  # corrupted once
+    assert client.blocking_key_value_get_bytes("pg/s/0/1", 100) == b"payload"
+    client.wait_at_barrier("b", 10)  # non-payload ops pass through untouched
+    client.key_value_delete("pg/s/1/0")
+    assert "pg/s/1/0" not in inner.store
+
+
+def test_faulty_client_straggler_delays_visibility_not_the_publisher():
+    """Matches the in-memory store's semantics: the publish becomes VISIBLE
+    late, without burning the publisher's own exchange deadline."""
+    import time
+
+    inner = _FakeInner()
+    client = FaultyClient(inner, FaultPlan([FaultSpec("straggler", rank=0, epoch=0, seconds=0.2)]))
+    start = time.monotonic()
+    client.key_value_set_bytes("pg/s/0/0", b"late")
+    assert time.monotonic() - start < 0.15  # the set returned immediately
+    assert "pg/s/0/0" not in inner.store  # ... and is not yet visible
+    time.sleep(0.4)
+    assert inner.store.get("pg/s/0/0") == b"late"
+
+    # cleanup cancels an in-flight delayed publish: no leaked entries
+    client.key_value_set_bytes("pg/s/0/0", b"again")
+    client.key_value_delete("pg/s/0/0")
+    time.sleep(0.4)
+    assert "pg/s/0/0" not in inner.store

@@ -1,0 +1,53 @@
+"""RetryPolicy: deadline budgeting, exponential backoff, deterministic jitter."""
+import pytest
+
+from metrics_tpu.resilience import RetryPolicy
+
+
+def test_attempt_budgets_split_the_deadline():
+    policy = RetryPolicy(max_attempts=3)
+    # fresh deadline: 1/3 each; later attempts split what remains
+    assert policy.attempt_timeout_s(120.0, 3) == pytest.approx(40.0)
+    assert policy.attempt_timeout_s(60.0, 2) == pytest.approx(30.0)
+    assert policy.attempt_timeout_s(10.0, 1) == pytest.approx(10.0)
+    # the sum of planned budgets never exceeds the deadline
+    remaining, total = 120.0, 0.0
+    for attempts_left in (3, 2, 1):
+        budget = policy.attempt_timeout_s(remaining, attempts_left)
+        total += budget
+        remaining -= budget
+    assert total <= 120.0 + 1e-9
+
+
+def test_nearly_exhausted_deadline_still_gets_a_floor():
+    policy = RetryPolicy(max_attempts=3, min_attempt_s=0.005)
+    assert policy.attempt_timeout_s(1e-6, 1) == 0.005
+
+
+def test_backoff_grows_and_caps():
+    policy = RetryPolicy(backoff_base_s=0.1, backoff_max_s=0.35, jitter=0.0)
+    assert policy.backoff_s(1) == pytest.approx(0.1)
+    assert policy.backoff_s(2) == pytest.approx(0.2)
+    assert policy.backoff_s(3) == pytest.approx(0.35)  # capped
+    assert policy.backoff_s(10) == pytest.approx(0.35)
+
+
+def test_jitter_is_deterministic_and_bounded():
+    policy = RetryPolicy(backoff_base_s=0.1, backoff_max_s=10.0, jitter=0.5)
+    a = policy.backoff_s(2, key=("scope", 7, 1))
+    b = policy.backoff_s(2, key=("scope", 7, 1))
+    c = policy.backoff_s(2, key=("scope", 7, 2))  # different peer decorrelates
+    assert a == b
+    assert a != c
+    base = 0.2
+    for pause in (a, c):
+        assert base * 0.5 <= pause <= base * 1.5
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError, match="jitter"):
+        RetryPolicy(jitter=1.5)
+    with pytest.raises(ValueError, match="non-negative"):
+        RetryPolicy(backoff_base_s=-1.0)

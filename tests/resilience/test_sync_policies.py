@@ -1,0 +1,297 @@
+"""``Metric(on_sync_error=...)`` degradation policies end-to-end: the full
+``compute()`` -> ``sync_context`` -> ``_sync_dist`` -> KV-exchange path runs
+inside the harness's simulated worlds, with ``SumMetric`` states chosen so
+full/partial/local results are numerically unambiguous (rank r contributes
+10^r: full 2-rank sync = 11, 3-rank = 111, local = 10^r).
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MetricCollection, SumMetric
+from metrics_tpu.parallel import new_group
+from metrics_tpu.resilience import (
+    FaultSpec,
+    InMemoryKVStore,
+    RetryPolicy,
+    run_as_peers,
+)
+from metrics_tpu.utils.exceptions import SyncError, SyncTimeoutError
+
+FAST_RETRY = RetryPolicy(max_attempts=3, backoff_base_s=0.01, backoff_max_s=0.05)
+
+_seq = [0]
+
+
+def make_group(world, timeout_s=2.0):
+    _seq[0] += 1
+    return new_group(range(world), name=f"pol{_seq[0]}", timeout_s=timeout_s, retry=FAST_RETRY)
+
+
+def make_metrics(world, policy, group):
+    """One SumMetric per simulated rank, updated in the main thread (only the
+    sync machinery needs to run on the per-rank threads)."""
+    metrics = []
+    for rank in range(world):
+        m = SumMetric(process_group=group, on_sync_error=policy)
+        m.update(jnp.asarray(float(10**rank)))
+        metrics.append(m)
+    return metrics
+
+
+def test_on_sync_error_validated_at_construction():
+    with pytest.raises(ValueError, match="on_sync_error"):
+        SumMetric(on_sync_error="retry-forever")
+
+
+def test_healthy_sync_all_policies_agree():
+    for policy in ("raise", "local", "partial"):
+        group = make_group(2)
+        metrics = make_metrics(2, policy, group)
+        out = run_as_peers(2, lambda rank: float(metrics[rank].compute()))
+        assert out == {0: 11.0, 1: 11.0}
+        report = metrics[0].sync_report()
+        assert report["syncs"] == 1 and report["missing_ranks"] == []
+        assert report["last_sync_outcome"] == "complete"
+        assert report["bytes_sent"] > 0 and report["bytes_received"] > 0
+        assert report["on_sync_error"] == policy
+
+
+def test_raise_policy_propagates_sync_timeout():
+    group = make_group(2, timeout_s=1.0)
+    metrics = make_metrics(2, "raise", group)
+    store = InMemoryKVStore([FaultSpec("drop", rank=1, epoch=0)])
+
+    def peer(rank):
+        try:
+            return float(metrics[rank].compute())
+        except SyncTimeoutError:
+            return "timeout"
+
+    out = run_as_peers(2, peer, store=store)
+    assert out[0] == "timeout"
+
+
+def test_local_policy_falls_back_to_rank_local_state():
+    group = make_group(2, timeout_s=1.0)
+    metrics = make_metrics(2, "local", group)
+    store = InMemoryKVStore([FaultSpec("drop", rank=1, epoch=0)])
+    with pytest.warns(UserWarning, match="rank-local"):
+        out = run_as_peers(2, lambda rank: float(metrics[rank].compute()), store=store)
+    # rank 0 never got rank 1's payload -> local 1.0; rank 1 read rank 0 fine
+    # but its barrier failed (rank 0 degraded before reaching it) -> local 10.0
+    assert out == {0: 1.0, 1: 10.0}
+    assert metrics[0].sync_report()["degraded_local"] == 1
+    # whole-state degradation is visible as the LAST sync's outcome, not just
+    # a lifetime counter (missing_ranks stays [] — attribution is unknown)
+    assert metrics[0].sync_report()["last_sync_outcome"] == "local"
+
+
+def test_partial_policy_reduces_over_responders():
+    group = make_group(3, timeout_s=1.5)
+    metrics = make_metrics(3, "partial", group)
+    store = InMemoryKVStore([FaultSpec("drop", rank=1, epoch=0)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        out = run_as_peers(3, lambda rank: float(metrics[rank].compute()), store=store)
+    # ranks 0 and 2 reduce over {0, 2}; rank 1 (whose own publish was eaten)
+    # still read everyone and reduces over all three
+    assert out == {0: 101.0, 1: 111.0, 2: 101.0}
+    for rank in (0, 2):
+        report = metrics[rank].sync_report()
+        assert report["missing_ranks"] == [1]
+        assert report["degraded_partial"] == 1
+        assert report["last_sync_outcome"] == "partial"
+    assert metrics[1].sync_report()["missing_ranks"] == []
+    assert metrics[1].sync_report()["last_sync_outcome"] == "complete"
+
+
+def test_partial_warns_and_names_missing_ranks():
+    group = make_group(2, timeout_s=1.0)
+    metrics = make_metrics(2, "partial", group)
+    store = InMemoryKVStore([FaultSpec("drop", rank=1, epoch=0)])
+    with pytest.warns(UserWarning, match=r"ranks \[1\]"):
+        out = run_as_peers(2, lambda rank: float(metrics[rank].compute()), store=store)
+    assert out[0] == 1.0  # only itself responded
+
+
+def test_corrupt_then_clean_sync_is_transparent_to_the_value():
+    """A transient corrupted payload must not change the computed result —
+    only the telemetry notices."""
+    group = make_group(2)
+    metrics = make_metrics(2, "raise", group)
+    store = InMemoryKVStore([FaultSpec("corrupt", rank=1, epoch=0)])
+    out = run_as_peers(2, lambda rank: float(metrics[rank].compute()), store=store)
+    assert out == {0: 11.0, 1: 11.0}
+    report = metrics[0].sync_report()
+    assert report["integrity_failures"] == 1 and report["retries"] == 1
+
+
+def test_unsync_restores_local_state_after_partial():
+    group = make_group(2, timeout_s=1.0)
+    metrics = make_metrics(2, "partial", group)
+    store = InMemoryKVStore([FaultSpec("drop", rank=1, epoch=0)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        run_as_peers(2, lambda rank: float(metrics[rank].compute()), store=store)
+    # after compute() the sync_context unsynced: states are rank-local again
+    assert float(metrics[0].value) == 1.0
+    assert float(metrics[1].value) == 10.0
+
+
+def test_sync_report_accumulates_across_syncs():
+    group = make_group(2)
+    metrics = make_metrics(2, "raise", group)
+    run_as_peers(2, lambda rank: float(metrics[rank].compute()))
+    for m in metrics:
+        m.update(jnp.asarray(1.0))  # invalidates the compute cache
+    run_as_peers(2, lambda rank: float(metrics[rank].compute()))
+    report = metrics[0].sync_report()
+    assert report["syncs"] == 2
+    assert report["attempts"] >= 2
+
+
+def test_collection_sync_report_aggregates_members():
+    group = make_group(2, timeout_s=1.5)
+    collections = []
+    for rank in range(2):
+        mc = MetricCollection({"s": SumMetric(process_group=group, on_sync_error="partial")})
+        mc["s"].update(jnp.asarray(float(10**rank)))
+        collections.append(mc)
+    # epoch=None: the faulted store only serves the SECOND sync (epoch 1 on
+    # this scope), so the fault must match any epoch
+    store = InMemoryKVStore([FaultSpec("drop", rank=1)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        out = run_as_peers(2, lambda rank: {k: float(v) for k, v in collections[rank].compute().items()})
+        del out
+        out = None
+        # second world: the faulted one
+        for rank in range(2):
+            collections[rank]["s"].update(jnp.asarray(0.0))
+        out = run_as_peers(2, lambda rank: {k: float(v) for k, v in collections[rank].compute().items()}, store=store)
+    report = collections[0].sync_report()
+    assert report["syncs"] == 2
+    assert report["members"]["s"]["syncs"] == 2
+    assert report["missing_ranks"] == [1]
+
+
+def test_accuracy_partial_matches_responder_oracle():
+    """Policy semantics on a real classification metric: the partial result
+    equals a serial oracle over the responding ranks' shards."""
+    rng = np.random.default_rng(3)
+    preds = rng.random((4, 16, 5))
+    target = rng.integers(0, 5, (4, 16))
+    group = make_group(3, timeout_s=1.5)
+    metrics = []
+    for rank in range(3):
+        m = Accuracy(num_classes=5, process_group=group, on_sync_error="partial")
+        for i in range(rank, 4, 3):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        metrics.append(m)
+    store = InMemoryKVStore([FaultSpec("drop", rank=1, epoch=0)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        out = run_as_peers(3, lambda rank: float(metrics[rank].compute()), store=store)
+    oracle = Accuracy(num_classes=5)
+    for i in (0, 3, 2):  # rank 0's shard {0, 3} + rank 2's shard {2}
+        oracle.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    assert out[0] == pytest.approx(float(oracle.compute()), abs=1e-7)
+
+
+def test_local_policy_covers_custom_gather_failures_whole_state():
+    """Degradation must also cover the non-ProcessGroup sync paths: a custom
+    ``dist_sync_fn`` (or world collective) dying mid-gather is reclassified
+    as SyncError, so 'local' keeps the rank-local state instead of crashing."""
+
+    def dying_gather(x, group=None):
+        raise RuntimeError("collective died mid-flight")
+
+    m = SumMetric(dist_sync_fn=dying_gather, on_sync_error="local")
+    m.update(jnp.asarray(3.0))
+
+    def peer(rank):
+        if rank != 0:
+            return None
+        with pytest.warns(UserWarning, match="rank-local"):
+            return float(m.compute())
+
+    out = run_as_peers(2, peer)  # simulated world: distributed_available() is True
+    assert out[0] == 3.0
+    assert m.sync_report()["degraded_local"] == 1
+
+    # the same failure under the default policy surfaces as SyncError...
+    m_raise = SumMetric(dist_sync_fn=dying_gather)
+    m_raise.update(jnp.asarray(3.0))
+
+    def peer_raise(rank):
+        if rank != 0:
+            return None
+        with pytest.raises(SyncError, match="Host-level gather failed"):
+            m_raise.compute()
+        return "raised"
+
+    assert run_as_peers(2, peer_raise)[0] == "raised"
+
+    # ...while a programming error (bad signature -> TypeError) is NEVER
+    # reclassified or degraded, even under 'local'
+    m_bug = SumMetric(dist_sync_fn=lambda x: [x], on_sync_error="local")  # missing group kwarg
+    m_bug.update(jnp.asarray(3.0))
+
+    def peer_bug(rank):
+        if rank != 0:
+            return None
+        with pytest.raises(TypeError):
+            m_bug.compute()
+        return "raised"
+
+    assert run_as_peers(2, peer_bug)[0] == "raised"
+
+
+def test_on_sync_error_does_not_split_the_compile_cache():
+    """Host-level sync config is jit-irrelevant: two metrics differing only
+    in on_sync_error must share one compiled update transition."""
+    from metrics_tpu import engine
+
+    a = SumMetric()
+    b = SumMetric(on_sync_error="partial")
+    key_a = engine.metric_fingerprint(a)
+    key_b = engine.metric_fingerprint(b)
+    assert key_a == key_b
+
+
+def test_ungrouped_world_sync_raises_loudly_under_simulation():
+    """The simulated world has no multihost backend: an ungrouped metric must
+    fail with a clear usage error instead of silently 'syncing' only itself."""
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    m = SumMetric()  # no process_group, no dist_sync_fn -> world gather
+    m.update(jnp.asarray(1.0))
+
+    def peer(rank):
+        if rank != 0:
+            return None
+        with pytest.raises(MetricsUserError, match="simulated world"):
+            m.compute()
+        return "raised"
+
+    assert run_as_peers(2, peer)[0] == "raised"
+
+
+def test_non_sync_errors_are_never_swallowed_by_local_policy():
+    """'local' degrades only on SyncError — a programming error (non-member
+    rank) must still raise."""
+    group = new_group([1], name="notmine", timeout_s=1.0, retry=FAST_RETRY)
+    m = SumMetric(process_group=group, on_sync_error="local")
+    m.update(jnp.asarray(1.0))
+
+    def peer(rank):
+        if rank == 0:
+            with pytest.raises(ValueError, match="not a member"):
+                m.compute()
+        return None
+
+    run_as_peers(2, peer)
+    assert not issubclass(ValueError, SyncError)

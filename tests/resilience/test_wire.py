@@ -1,0 +1,117 @@
+"""Hardened wire format: version tag, crc32 envelope, length validation.
+
+Every corruption class must surface as a precise ``SyncIntegrityError`` (with
+the right ``transient`` flag) instead of decoding garbage or dying in
+``np.frombuffer``/``reshape`` with a cryptic size error.
+"""
+import json
+import struct
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.parallel.groups import (
+    WIRE_VERSION,
+    _decode,
+    _decode_tree,
+    _encode,
+    _encode_tree,
+    _open_envelope,
+    _seal,
+)
+from metrics_tpu.utils.exceptions import SyncIntegrityError
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32", "bool", "bfloat16", "float16"])
+def test_round_trip_under_envelope(dtype):
+    rng = np.random.default_rng(0)
+    arr = np.asarray(jnp.asarray(rng.normal(size=(3, 5)), dtype=dtype))
+    back = _decode(_encode(arr))
+    assert back.dtype == arr.dtype and back.shape == arr.shape
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_envelope_layout_is_versioned():
+    payload = _encode(np.arange(3.0))
+    assert payload[:2] == b"MT"
+    assert payload[2] == WIRE_VERSION
+    (declared_crc,) = struct.unpack(">I", payload[3:7])
+    assert declared_crc == zlib.crc32(payload[7:])
+
+
+def test_truncated_payload_raises_precisely():
+    payload = _encode(np.arange(10.0))
+    with pytest.raises(SyncIntegrityError, match="[Tt]runcated"):
+        _open_envelope(payload[:4])
+    # truncation INSIDE the body: crc catches it as corruption
+    with pytest.raises(SyncIntegrityError):
+        _decode(payload[:-8])
+
+
+def test_corrupted_body_raises_crc_mismatch():
+    payload = bytearray(_encode(np.arange(10.0)))
+    payload[len(payload) // 2] ^= 0xFF
+    with pytest.raises(SyncIntegrityError, match="crc32") as exc_info:
+        _decode(bytes(payload))
+    assert exc_info.value.transient  # corruption is worth a re-read
+
+
+def test_version_mismatch_is_explicit_and_not_transient():
+    payload = bytearray(_encode(np.arange(3.0)))
+    payload[2] = WIRE_VERSION + 1
+    with pytest.raises(SyncIntegrityError, match="version mismatch") as exc_info:
+        _decode(bytes(payload))
+    assert not exc_info.value.transient
+
+
+def test_foreign_magic_is_explicit_and_not_transient():
+    # a pre-versioning peer's payload starts with a big-endian header length,
+    # not the magic — the failure mode for mixed builds is an explicit error
+    legacy = struct.pack(">I", 10) + b"x" * 30
+    with pytest.raises(SyncIntegrityError, match="wire magic") as exc_info:
+        _decode(legacy)
+    assert not exc_info.value.transient
+
+
+def test_length_vs_header_product_mismatch():
+    """A payload whose envelope is intact but whose header-declared
+    dtype×shape product disagrees with the body length (satellite: the old
+    code let this die inside ``np.frombuffer``/``reshape``)."""
+    arr = np.arange(6, dtype=np.float32)
+    header = json.dumps({"dtype": "float32", "shape": [8]}).encode()  # claims 8 elements
+    body = struct.pack(">I", len(header)) + header + arr.tobytes()  # carries 6
+    with pytest.raises(SyncIntegrityError, match="length mismatch") as exc_info:
+        _decode(_seal(body))
+    msg = str(exc_info.value)
+    assert "float32" in msg and "[8]" in msg and "24" in msg  # names dtype, shape, actual bytes
+
+
+def test_decode_error_carries_context():
+    payload = bytearray(_encode(np.arange(4.0)))
+    payload[-1] ^= 0x01
+    with pytest.raises(SyncIntegrityError, match="peer rank=3"):
+        _decode(bytes(payload), context=" (group='g', peer rank=3)")
+
+
+def test_tree_round_trip_and_truncation():
+    tree = {"tp": jnp.arange(3.0), "buf": [jnp.ones((2, 2))], "n": jnp.asarray(4)}
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    payload = _encode_tree(tree)
+    back = _decode_tree(payload, treedef, len(leaves))
+    for a, b in zip(jax.tree_util.tree_leaves(back), leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(SyncIntegrityError):
+        _decode_tree(payload[:-4], treedef, len(leaves))
+
+
+def test_tree_structure_mismatch_still_a_value_error():
+    """Structure mismatch is a deterministic config error (NOT corruption):
+    it must stay a ValueError so it is never retried as transient."""
+    mine = {"A": [jnp.arange(2.0)], "B": []}
+    theirs = {"A": [], "B": [jnp.arange(2.0)]}
+    _, my_def = jax.tree_util.tree_flatten(mine)
+    with pytest.raises(ValueError, match="structurally identical"):
+        _decode_tree(_encode_tree(theirs), my_def, 1)
