@@ -46,4 +46,30 @@ assert obj["retried_sync_ok"] and obj["retried_sync_value_rank0"] == 11.0, f"ret
 print("resilience smoke OK:", line)
 '
 
+echo "=== numerical-health smoke (screening policies through the fused engine) ==="
+# the count/determinism assertions must hold on EVERY attempt; the timing
+# gate gets one retry (min-based, but a fully throttled CI box can still
+# blanket a whole measurement window)
+health_smoke() {
+JAX_PLATFORMS=cpu python bench.py --health-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "health_screening", obj
+# clean/bad/clean stream, 3 members, 3 bad rows x 1 NaN each in the bad batch:
+# skip quarantines the whole update once per member; mask drops exactly the 3 rows
+assert obj["skip_updates_quarantined"] == 3, obj
+assert obj["skip_rows_masked"] == 0, obj
+assert obj["skip_nan_count"] == 9, obj
+assert obj["mask_updates_quarantined"] == 0, obj
+assert obj["mask_rows_masked"] == 9, obj
+assert obj["mask_nan_count"] == 9, obj
+assert obj["deterministic"] is True, f"same contaminated stream must reproduce identical state+counts: {obj}"
+# screening compiled into the headline collection-update program costs < 5%
+assert obj["value"] < 5.0, "screening overhead %s%% >= 5%%: %s" % (obj["value"], obj)
+print("health smoke OK:", line)
+'
+}
+health_smoke || { echo "health smoke attempt 1 failed; retrying once"; health_smoke; }
+
 echo "both lanes green"

@@ -4,23 +4,66 @@ Parity: reference ``torchmetrics/aggregation.py`` (``BaseAggregator`` :24 with
 ``_cast_and_nan_check_input`` :83-101; ``MaxMetric`` :112, ``MinMetric`` :177,
 ``SumMetric`` :242, ``CatMetric`` :300, ``MeanMetric`` :363).
 
-TPU note: the value-inspecting NaN strategies (``"error"``/``"warn"``) and the
-shape-changing ``"ignore"`` are data-dependent, so instances using them run
-their update eagerly (the engine's automatic jit fallback). The extra strategy
-``"disable"`` skips NaN handling entirely and keeps the update a static jitted
-program — the recommended setting for hot TPU loops when inputs are known
-finite.
+TPU note — the legacy ``nan_strategy`` is now an alias over the jit-safe
+screening layer (``metrics_tpu.resilience.health``; see ``docs/numerics.md``):
+
+* ``'ignore'`` / ``'warn'`` map to ``on_bad_input='mask'``: NaN elements are
+  dropped *inside* the compiled update (rank>=2 values are flattened first
+  via ``_health_prescreen``, so masking removes elements exactly like the
+  reference's boolean filter; zero + exact correction for the row-additive
+  Sum/Mean family, concrete filtering on the eager fallback for
+  ``CatMetric``'s list buffer), so these strategies now work under
+  ``jit``/``scan`` instead of forcing a host round-trip per update. The
+  ``'warn'`` message fires at removal on eager paths; compiled programs
+  cannot warn in-trace.
+* ``'error'`` maps to ``on_bad_input='raise'``: the contaminated update is
+  quarantined in-trace and a ``NumericalHealthError`` (a ``RuntimeError``,
+  like the reference's) is raised on the per-update host check.
+* a float maps to a branchless ``jnp.where`` fill (no screening needed).
+* ``'disable'`` maps to ``'propagate'`` — no NaN handling at all, the
+  recommended setting for hot TPU loops with known-finite inputs.
+
+``Max``/``Min`` handle ``'ignore'`` by filling NaN with the reduction's
+identity (−inf/+inf) — branchless, jitted, and exactly equivalent to
+removal; their ``'warn'`` keeps the mask policy, whose non-additive states
+land on the eager fallback where removal warns (the reference contract —
+warning fidelity costs those instances the compiled path, exactly as the
+host-side legacy implementation did). Deprecation note: ``nan_strategy``
+remains supported as the legacy
+alias; new code should pass ``on_bad_input`` (any :class:`Metric` accepts
+it) and read ``health_report()`` for the counts.
+
+All aggregators screen **NaN only** (``health_screen='nan'``): the reference
+treats ±inf as data (a running max of inf is legitimate), and the alias
+preserves that.
 """
-from typing import Any, Callable, List, Optional, Union
+from typing import Any, Callable, List, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.safe_ops import kahan_add
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
+
+_LEGACY_TO_POLICY = {"error": "raise", "warn": "mask", "ignore": "mask", "disable": "propagate"}
+
+
+def _flatten_value_prescreen(args, kwargs):
+    """Screening prescreen for flatten-invariant aggregators: rank>=2 values
+    are raveled so the mask machinery drops ELEMENTS along the (now only)
+    axis — the reference's ``x[~isnan(x)]`` removal, which flattens too."""
+
+    def _flat(x):
+        if isinstance(x, (jax.Array, jnp.ndarray, np.ndarray)) and getattr(x, "ndim", 0) >= 2:
+            return jnp.reshape(jnp.asarray(x), (-1,))
+        return x
+
+    return jax.tree_util.tree_map(_flat, (args, kwargs))
 
 
 class BaseAggregator(Metric):
@@ -36,34 +79,39 @@ class BaseAggregator(Metric):
         nan_strategy: Union[str, float] = "error",
         **kwargs: Any,
     ) -> None:
-        super().__init__(**kwargs)
         allowed_nan_strategy = ("error", "warn", "ignore", "disable")
         if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, (float, int)):
             raise ValueError(
                 f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} "
                 f"but got {nan_strategy}."
             )
+        legacy_mapped = "on_bad_input" not in kwargs
+        if legacy_mapped:
+            kwargs["on_bad_input"] = (
+                _LEGACY_TO_POLICY[nan_strategy] if isinstance(nan_strategy, str) else "propagate"
+            )
+        super().__init__(**kwargs)
+        # legacy semantics: only NaN is screened; ±inf is data
+        self.health_screen = "nan"
+        # the reference contract for 'warn' (the Sum/Mean/Max/Min DEFAULT)
+        # is a UserWarning at every removal — only a host-side update can
+        # warn, so the screening layer routes such instances to the eager
+        # fallback on first dispatch (exactly where the pre-port
+        # implementation's bool() concretization landed them too). Explicit
+        # `on_bad_input` opts out of the legacy contract and stays compiled.
+        self._health_warn_on_bad = legacy_mapped and nan_strategy == "warn"
         self.nan_strategy = nan_strategy
         self.add_state("value", default=default_value, dist_reduce_fx=fn)
 
     def _cast_and_nan_check_input(self, x: Union[float, Array]) -> Array:
-        """Cast to float and apply the NaN policy (reference ``aggregation.py:83``)."""
+        """Cast to float and apply the float-fill strategy branchlessly
+        (reference ``aggregation.py:83``; removal/raise strategies are
+        handled by the screening layer before this runs)."""
         x = jnp.asarray(x, dtype=jnp.float32) if not isinstance(x, (jax.Array, jnp.ndarray)) else x
         if not jnp.issubdtype(x.dtype, jnp.floating):
             x = x.astype(jnp.float32)
-        if self.nan_strategy == "disable":
-            return x
-        nans = jnp.isnan(x)
-        if bool(jnp.any(nans)):  # concretization point: falls back to eager under jit
-            if self.nan_strategy == "error":
-                raise RuntimeError("Encountered `nan` values in tensor")
-            if self.nan_strategy == "warn":
-                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
-                x = x[~nans]
-            elif self.nan_strategy == "ignore":
-                x = x[~nans]
-            else:
-                x = jnp.where(nans, jnp.asarray(float(self.nan_strategy), dtype=x.dtype), x)
+        if isinstance(self.nan_strategy, (float, int)) and not isinstance(self.nan_strategy, bool):
+            x = jnp.where(jnp.isnan(x), jnp.asarray(float(self.nan_strategy), dtype=x.dtype), x)
         return x
 
     def update(self, value: Union[float, Array]) -> None:  # pragma: no cover - abstract
@@ -86,10 +134,22 @@ class MaxMetric(BaseAggregator):
     full_state_update = True
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        # 'ignore': removal == filling with the reduction identity, handled
+        # branchlessly in update (jit-safe, no screening state needed).
+        # 'warn' keeps the mask policy: max/min states are not row-additive,
+        # so the first trace falls back to eager — where removal WARNS, the
+        # reference contract. 'error' keeps the raise policy.
+        if "on_bad_input" not in kwargs and nan_strategy == "ignore":
+            kwargs["on_bad_input"] = "propagate"
         super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, **kwargs)
+
+    def _health_prescreen(self, args: Any, kwargs: Any) -> Any:
+        return _flatten_value_prescreen(args, kwargs)
 
     def update(self, value: Union[float, Array]) -> None:
         value = self._cast_and_nan_check_input(value)
+        if self.nan_strategy in ("warn", "ignore"):
+            value = jnp.where(jnp.isnan(value), -jnp.inf, value)
         if value.size:  # make sure empty-after-nan-removal doesn't error
             self.value = jnp.maximum(self.value, jnp.max(value))
 
@@ -107,16 +167,32 @@ class MinMetric(BaseAggregator):
     full_state_update = True
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        # see MaxMetric: 'ignore' -> branchless identity fill, 'warn' keeps
+        # the mask policy (eager fallback) so removal warns
+        if "on_bad_input" not in kwargs and nan_strategy == "ignore":
+            kwargs["on_bad_input"] = "propagate"
         super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, **kwargs)
+
+    def _health_prescreen(self, args: Any, kwargs: Any) -> Any:
+        return _flatten_value_prescreen(args, kwargs)
 
     def update(self, value: Union[float, Array]) -> None:
         value = self._cast_and_nan_check_input(value)
+        if self.nan_strategy in ("warn", "ignore"):
+            value = jnp.where(jnp.isnan(value), jnp.inf, value)
         if value.size:
             self.value = jnp.minimum(self.value, jnp.min(value))
 
 
 class SumMetric(BaseAggregator):
     """Running sum (reference ``aggregation.py:242``).
+
+    Args:
+        compensated: opt into Kahan (compensated) summation for the running
+            total — guards float32 long-horizon accumulation against
+            cancellation at the cost of one extra state and 3 adds per
+            update. Disables the row-additivity contract (`jit_bucket`
+            padding and compiled `'mask'` drop to their eager fallbacks).
 
     Example:
         >>> import jax.numpy as jnp
@@ -126,17 +202,31 @@ class SumMetric(BaseAggregator):
         6.0
     """
 
-    # per-row sum contributions: eligible for `jit_bucket` padding (which only
-    # engages when the update jits at all, i.e. under nan_strategy='disable')
-    _batch_additive = True
-
-    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+    def __init__(
+        self, nan_strategy: Union[str, float] = "warn", compensated: bool = False, **kwargs: Any
+    ) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+        self.compensated = compensated
+        if compensated:
+            self.add_state("value_comp", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    # per-row sum contributions: eligible for `jit_bucket` padding and the
+    # compiled 'mask' row drop — except under Kahan compensation, whose
+    # carry is order-dependent (not row-additive)
+    @property
+    def _batch_additive(self) -> bool:
+        return not getattr(self, "compensated", False)
+
+    def _health_prescreen(self, args: Any, kwargs: Any) -> Any:
+        return _flatten_value_prescreen(args, kwargs)
 
     def update(self, value: Union[float, Array]) -> None:
         value = self._cast_and_nan_check_input(value)
         if value.size:
-            self.value = self.value + jnp.sum(value)
+            if self.compensated:
+                self.value, self.value_comp = kahan_add(self.value, self.value_comp, jnp.sum(value))
+            else:
+                self.value = self.value + jnp.sum(value)
 
 
 class CatMetric(BaseAggregator):
@@ -153,10 +243,22 @@ class CatMetric(BaseAggregator):
     """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        # a list-buffer metric is inherently eager, so the legacy host-side
+        # element filter below IS the right implementation — routing through
+        # the screening layer's row masking would drop whole rows of rank>=2
+        # values and change the buffered shapes
+        if "on_bad_input" not in kwargs and nan_strategy in ("warn", "ignore"):
+            kwargs["on_bad_input"] = "propagate"
         super().__init__("cat", [], nan_strategy, **kwargs)
 
     def update(self, value: Union[float, Array]) -> None:
         value = self._cast_and_nan_check_input(value)
+        if self.nan_strategy in ("warn", "ignore"):
+            nans = jnp.isnan(value)
+            if bool(jnp.any(nans)):  # concrete: list-state updates never jit
+                if self.nan_strategy == "warn":
+                    rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+                value = value[~nans]
         if value.size:
             self.value.append(value)
 
@@ -169,6 +271,10 @@ class CatMetric(BaseAggregator):
 class MeanMetric(BaseAggregator):
     """Weighted running mean (reference ``aggregation.py:363``).
 
+    Args:
+        compensated: Kahan-compensate both running sums (value and weight);
+            see :class:`SumMetric`.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import MeanMetric
@@ -178,38 +284,61 @@ class MeanMetric(BaseAggregator):
         2.0
     """
 
-    # value/weight sums are both per-row: eligible for `jit_bucket` padding
-    _batch_additive = True
-
-    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+    def __init__(
+        self, nan_strategy: Union[str, float] = "warn", compensated: bool = False, **kwargs: Any
+    ) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
         self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.compensated = compensated
+        if compensated:
+            self.add_state("value_comp", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("weight_comp", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    # value/weight sums are both per-row (Kahan carry excepted, see SumMetric)
+    @property
+    def _batch_additive(self) -> bool:
+        return not getattr(self, "compensated", False)
+
+    def _health_prescreen(self, args: Any, kwargs: Any) -> Any:
+        """Broadcast weight against value and flatten the PAIR, so masking
+        drops (value, weight) elements jointly — the reference's
+        ``value[~nans], weight[~nans]`` semantics at element granularity."""
+        value = kwargs.get("value", args[0] if args else None)
+        if value is None:
+            return args, kwargs
+        weight = kwargs.get("weight", args[1] if len(args) > 1 else 1.0)
+        value = (
+            jnp.asarray(value, dtype=jnp.float32)
+            if not isinstance(value, (jax.Array, jnp.ndarray))
+            else value
+        )
+        if not jnp.issubdtype(value.dtype, jnp.floating):
+            value = value.astype(jnp.float32)
+        weight = jnp.broadcast_to(jnp.asarray(weight, dtype=value.dtype), value.shape)
+        if value.ndim >= 2:
+            value, weight = jnp.reshape(value, (-1,)), jnp.reshape(weight, (-1,))
+        return (value, weight), {}
 
     def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
-        # broadcast weight to value shape FIRST, then apply the NaN policy
-        # jointly — filtering them independently would mispair (or crash on
-        # shape mismatch) whenever NaN removal changes the length
+        # broadcast weight to value shape FIRST so a NaN in either lane
+        # drops/fills the PAIR: the screening layer masks whole rows jointly,
+        # and the float-fill below applies to both
         value = jnp.asarray(value, dtype=jnp.float32) if not isinstance(value, (jax.Array, jnp.ndarray)) else value
         if not jnp.issubdtype(value.dtype, jnp.floating):
             value = value.astype(jnp.float32)
         weight = jnp.broadcast_to(jnp.asarray(weight, dtype=value.dtype), value.shape)
-        if self.nan_strategy != "disable":
-            nans = jnp.isnan(value) | jnp.isnan(weight)
-            if bool(jnp.any(nans)):
-                if self.nan_strategy == "error":
-                    raise RuntimeError("Encountered `nan` values in tensor")
-                if self.nan_strategy == "warn":
-                    rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
-                if self.nan_strategy in ("warn", "ignore"):
-                    value, weight = value[~nans], weight[~nans]
-                else:
-                    fill = jnp.asarray(float(self.nan_strategy), dtype=value.dtype)
-                    value = jnp.where(jnp.isnan(value), fill, value)
-                    weight = jnp.where(jnp.isnan(weight), fill, weight)
+        if isinstance(self.nan_strategy, (float, int)) and not isinstance(self.nan_strategy, bool):
+            fill = jnp.asarray(float(self.nan_strategy), dtype=value.dtype)
+            value = jnp.where(jnp.isnan(value), fill, value)
+            weight = jnp.where(jnp.isnan(weight), fill, weight)
         if value.size == 0:
             return
-        self.value = self.value + jnp.sum(value * weight)
-        self.weight = self.weight + jnp.sum(weight)
+        if self.compensated:
+            self.value, self.value_comp = kahan_add(self.value, self.value_comp, jnp.sum(value * weight))
+            self.weight, self.weight_comp = kahan_add(self.weight, self.weight_comp, jnp.sum(weight))
+        else:
+            self.value = self.value + jnp.sum(value * weight)
+            self.weight = self.weight + jnp.sum(weight)
 
     def compute(self) -> Array:
         return self.value / self.weight

@@ -133,10 +133,8 @@ class Accuracy(StatScores):
                 mode=self.mode,
             )
             if self.reduce != "samples" and self.mdmc_reduce != "samplewise":
-                self.tp = self.tp + tp
-                self.fp = self.fp + fp
-                self.tn = self.tn + tn
-                self.fn = self.fn + fn
+                # shared overflow-guarded accumulation (StatScores)
+                self._accumulate_stat_scores(tp, fp, tn, fn)
             else:
                 self.tp.append(tp)
                 self.fp.append(fp)

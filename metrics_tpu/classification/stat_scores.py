@@ -11,6 +11,8 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.stat_scores import _stat_scores_compute, _stat_scores_update
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.safe_ops import saturating_add
+from metrics_tpu.resilience import health as _health
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
 
@@ -97,15 +99,35 @@ class StatScores(Metric):
             ignore_index=self.ignore_index,
         )
         if self.reduce != AverageMethod.SAMPLES and self.mdmc_reduce != MDMCAverageMethod.SAMPLEWISE:
-            self.tp = self.tp + tp
-            self.fp = self.fp + fp
-            self.tn = self.tn + tn
-            self.fn = self.fn + fn
+            self._accumulate_stat_scores(tp, fp, tn, fn)
         else:
             self.tp.append(tp)
             self.fp.append(fp)
             self.tn.append(tn)
             self.fn.append(fn)
+
+    def _accumulate_stat_scores(self, tp: Array, fp: Array, tn: Array, fn: Array) -> None:
+        """Accumulate one batch's counts — shared by the whole stat-scores
+        family (Accuracy's non-subset path included).
+
+        With a health policy active the accumulation is overflow-guarded:
+        the lane-default int sums (int32 off-x64) wrap after ~2^31 counted
+        elements on a long-horizon stream; here they saturate at the dtype
+        max instead and the event lands in
+        ``health_report()['overflow_events']`` (see ``docs/numerics.md`` for
+        the exact bound and when x64 lifts it).
+        """
+        if _health.health_enabled(self):
+            self.tp, of_tp = saturating_add(self.tp, tp)
+            self.fp, of_fp = saturating_add(self.fp, fp)
+            self.tn, of_tn = saturating_add(self.tn, tn)
+            self.fn, of_fn = saturating_add(self.fn, fn)
+            _health.record_overflow(self, of_tp | of_fp | of_tn | of_fn)
+        else:
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
 
     def _get_final_stats(self) -> Tuple[Array, Array, Array, Array]:
         """Concatenate list states if necessary (reference ``stat_scores.py:228``)."""

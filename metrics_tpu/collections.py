@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from metrics_tpu.engine import bucketing as _bucketing
 from metrics_tpu.engine import cache as _engine
 from metrics_tpu.metric import _JIT_FALLBACK_ERRORS, Metric
+from metrics_tpu.resilience import health as _health
+from metrics_tpu.utils.exceptions import NumericalHealthError
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -142,6 +144,11 @@ class MetricCollection:
         for k, m in self._modules.items():
             if not (m._enable_jit and not m._jit_failed and not m._has_list_state()):
                 continue
+            if _health.forces_eager(m):
+                # warn-contract / non-additive-mask members dispatch eagerly
+                # by design: excluding them here keeps ONE such member from
+                # disabling the fused program for every other member
+                continue
             # the same instance under two keys must update twice; the fused
             # transition would restore the later key's pre-update snapshot
             # over the earlier one's result, so only the first occurrence
@@ -220,6 +227,7 @@ class MetricCollection:
             value = _squeeze_if_scalar(vals[k])
             m._forward_cache = value
             out[k] = value
+        self._post_fused_health(keys, members)
         return out
 
     def _fused_update(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[str, ...]:
@@ -285,7 +293,29 @@ class MetricCollection:
             m._restore_state(new_states[k])
             m._update_count += 1
             m._computed = None
+        self._post_fused_health(keys, members)
         return keys
+
+    def _post_fused_health(self, keys, members) -> None:
+        """Host-side health bookkeeping after a fused dispatch: the fused
+        program already applied each member's in-trace policy; here the
+        'raise' members get their per-update host check (same contract as
+        the single-metric path). EVERY member's check runs — and its host
+        mirrors sync — before the first error surfaces, so one member's
+        quarantine can't leave another's mirrors stale (a stale mirror would
+        spuriously re-raise on the next clean update)."""
+        first_err: Optional[NumericalHealthError] = None
+        for _, m in zip(keys, members):
+            if _health.health_enabled(m):
+                m._health_stats["batches_screened"] += 1
+                if m.on_bad_input == "raise":
+                    try:
+                        _health.raise_on_quarantine(m)
+                    except NumericalHealthError as err:
+                        if first_err is None:
+                            first_err = err
+        if first_err is not None:
+            raise first_err
 
     def compute(self) -> Dict[str, Any]:
         """Every member's ``compute`` (reference ``collections.py:114``), with
@@ -417,6 +447,11 @@ class MetricCollection:
             value = _squeeze_if_scalar(vals[k])
             m._computed = value
             out[k] = value
+            if _health.health_enabled(m):
+                # the per-member wrapped compute was bypassed: run its
+                # compute-side finite check here (raise policy surfaces
+                # non-finite results; others record the flag)
+                _health.check_compute_result(m, value)
         return out
 
     # -- pure (explicitly state-passing) API — jit/shard_map friendly ----
@@ -433,7 +468,12 @@ class MetricCollection:
         """Pure fused update: ``states, batch -> new states`` with per-member
         kwarg routing. Wrap the caller in ``jax.jit`` (or use inside
         ``lax.scan``/``shard_map``) to trace every member into one XLA
-        program — the pure analog of the fused OO ``update``."""
+        program — the pure analog of the fused OO ``update``. No screening
+        memo here: each member dispatches its own engine trace, so there is
+        nothing to share and an id-keyed memo across separate (freed) trace
+        contexts would be an id-recycling hazard; XLA's CSE deduplicates
+        identical screening subexpressions in the caller's outer jit
+        instead. The fused OO entries (one trace) do share explicitly."""
         return {k: m.update_state(states[k], *args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
 
     def sync_state(
@@ -596,6 +636,23 @@ class MetricCollection:
                     out[key] = out.get(key, 0) + value
             missing.update(report["missing_ranks"])
         out["missing_ranks"] = sorted(missing)
+        out["members"] = members
+        return out
+
+    def health_report(self) -> Dict[str, Any]:
+        """Numerical-health telemetry: numeric counters summed across
+        members, plus every member's full report under ``members`` — the
+        on-device mirror of :meth:`sync_report` (and the collection face of
+        ``Metric.health_report``). Fused members accumulate their health
+        counters inside the shared fused program, so the report is identical
+        whether a member was fused or dispatched individually."""
+        members = {k: m.health_report() for k, m in self._modules.items()}
+        out: Dict[str, Any] = {}
+        for report in members.values():
+            for key, value in report.items():
+                if isinstance(value, (int, float)) and not isinstance(value, bool):
+                    out[key] = out.get(key, 0) + value
+        out["any_compute_nonfinite"] = any(r["last_compute_nonfinite"] for r in members.values())
         out["members"] = members
         return out
 

@@ -30,6 +30,12 @@ marking under macro reduce, list buffers) simply fall back to exact-shape jit
 — opting in to bucketing is never allowed to change results beyond float
 summation order.
 
+The same zero-row correction implements the ``on_bad_input='mask'``
+numerical-health policy (``resilience/health.py``): contaminated rows are
+zeroed like pad rows and their contribution subtracted, so masking composes
+with bucketing in one compiled program — the combined correction just
+subtracts ``pad_count + n_bad`` zero-row deltas.
+
 The ``_batch_additive`` contract a class opts into:
 
 * every registered state is an array with ``dist_reduce_fx='sum'``;
@@ -56,16 +62,58 @@ def next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+def row_additive_states(metric: Any) -> bool:
+    """The state half of the row-additivity contract: every registered state
+    is a ``'sum'``-reduced array (the only reduction the zero-row correction
+    is exact for). Shared with ``resilience/health.mask_supported`` so the
+    bucketing and mask policies can never drift apart on what the contract
+    means."""
+    for name in metric._defaults:
+        if isinstance(metric._defaults[name], list) or metric._reductions[name] != "sum":
+            return False
+    return True
+
+
 def supports_bucketing(metric: Any) -> bool:
     """Static eligibility: the class opted into row-additivity and every
     state is a ``'sum'``-reduced array (the only reduction the padding
     correction is exact for)."""
     if not getattr(metric, "_batch_additive", False):
         return False
-    for name in metric._defaults:
-        if isinstance(metric._defaults[name], list) or metric._reductions[name] != "sum":
+    if not row_additive_states(metric):
+        return False
+    if getattr(metric, "on_bad_input", "propagate") != "propagate":
+        # an active screening prescreen that reshapes inputs (aggregators
+        # flatten rank>=2 values to mask elements) redefines what a "row"
+        # is, while pad_count counts rows of the ORIGINAL batch axis — such
+        # metrics keep exact-shape jit so bucketing can never change their
+        # masked results (lazy import: metric.py imports this module)
+        from metrics_tpu.metric import Metric
+
+        if type(metric)._health_prescreen is not Metric._health_prescreen:
             return False
     return True
+
+
+def batched_leaf_indices(leaves: List[Any]) -> Tuple[int, ...]:
+    """Indices of rank>=1 array leaves sharing axis 0 — THE batch-axis
+    consensus rule, shared by the pad-bucketing spec below and the
+    numerical-health row masking (``resilience/health.py``), which must
+    agree on what a "row" is for the zero-row correction to be exact.
+    Empty when there is no unambiguous batch axis (no rank>=1 array, an
+    empty batch, or axis-0 disagreement)."""
+    batch: Optional[int] = None
+    batched: List[int] = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, (jax.Array, jnp.ndarray, np.ndarray)) and getattr(leaf, "ndim", 0) >= 1:
+            if batch is None:
+                batch = int(leaf.shape[0])
+            elif int(leaf.shape[0]) != batch:
+                return ()
+            batched.append(i)
+    if batch in (None, 0):
+        return ()
+    return tuple(batched)
 
 
 def input_spec(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Optional[BucketSpec]:
@@ -76,18 +124,11 @@ def input_spec(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Optional[Bucket
     anything but the unambiguous "all batched inputs share axis 0" case.
     """
     leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
-    batch: Optional[int] = None
-    batched: List[int] = []
-    for i, leaf in enumerate(leaves):
-        if isinstance(leaf, (jax.Array, jnp.ndarray, np.ndarray)) and getattr(leaf, "ndim", 0) >= 1:
-            if batch is None:
-                batch = int(leaf.shape[0])
-            elif int(leaf.shape[0]) != batch:
-                return None
-            batched.append(i)
-    if not batched or not batch:
+    batched = batched_leaf_indices(leaves)
+    if not batched:
         return None
-    return leaves, treedef, tuple(batched), next_pow2(batch) - batch
+    batch = int(leaves[batched[0]].shape[0])
+    return leaves, treedef, batched, next_pow2(batch) - batch
 
 
 def bucket_spec(metric: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Optional[BucketSpec]:

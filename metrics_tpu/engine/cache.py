@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.engine import bucketing
+from metrics_tpu.resilience import health as _health
 
 Array = jax.Array
 
@@ -372,43 +373,23 @@ def _get_or_create(cache_key: Any, factory: Callable[[], "SharedEntry"]) -> "Sha
         return entry
 
 
-def _corrected_states(
-    padded_out: Dict[str, Any], row_out: Dict[str, Any], defaults: Dict[str, Any], pad_count: Array
-) -> Dict[str, Any]:
-    """Subtract the padding rows' contribution: exact for row-additive
-    sum states (see ``engine.bucketing``)."""
-    return {
-        name: padded_out[name] - pad_count * (row_out[name] - defaults[name])
-        for name in padded_out
-    }
-
-
 def _make_metric_entry(key: Any, pins: Tuple) -> SharedEntry:
     entry = SharedEntry(key, "metric_update", pins)
     entry.donate = donation_enabled()
 
+    # both bodies are the health-screened transition
+    # (resilience/health.traced_update): with on_bad_input='propagate' (the
+    # default) it emits exactly the pre-screening program — restore, update,
+    # snapshot, plus the pad-row correction on the bucketed variant — so
+    # screening costs nothing unless a policy opted in.
     def _exact(state, args, kwargs):
         entry.mark_trace("exact")
-        inst = entry.cell
-        inst._restore_state(state)
-        inst._inner_update(*args, **kwargs)
-        return inst._snapshot_state()
+        return _health.traced_update(entry.cell, state, args, kwargs)
 
     def _bucketed(state, leaves, pad_count, treedef, batched):
         entry.mark_trace("bucketed")
-        inst = entry.cell
         args, kwargs = jax.tree_util.tree_unflatten(treedef, list(leaves))
-        inst._restore_state(state)
-        inst._inner_update(*args, **kwargs)
-        padded_out = inst._snapshot_state()
-        row_args, row_kwargs = jax.tree_util.tree_unflatten(
-            treedef, bucketing.row_slice_leaves(list(leaves), batched)
-        )
-        defaults = inst.init_state()
-        inst._restore_state(defaults)
-        inst._inner_update(*row_args, **row_kwargs)
-        row_out = inst._snapshot_state()
-        return _corrected_states(padded_out, row_out, defaults, pad_count)
+        return _health.traced_update(entry.cell, state, args, kwargs, pad_count=pad_count)
 
     def build(donate: bool) -> None:
         # the *_nodonate variants serve the pure API (caller owns the state
@@ -440,44 +421,41 @@ def _make_fused_entry(kind: str, keys: Tuple[str, ...], cache_key: Any, pins: Tu
     entry = SharedEntry(cache_key, kind, pins)
     entry.donate = donation_enabled() and kind in ("fused_update", "fused_forward")
 
+    # member updates run through the health-screened transition; each
+    # member's policy is applied independently inside the ONE fused program
+    # (the screening subexpressions are identical across members screening
+    # the same inputs, so XLA's CSE folds them — same deduplication the
+    # fused update already relies on for input formatting).
     def _update(states, args, member_kwargs):
         entry.mark_trace("exact")
         new: Dict[str, Any] = {}
-        for key, member in zip(keys, entry.cell):
-            member._restore_state(states[key])
-            member._inner_update(*args, **member_kwargs[key])
-            new[key] = member._snapshot_state()
+        with _health.shared_screening():  # one detection pass per input leaf
+            for key, member in zip(keys, entry.cell):
+                new[key] = _health.traced_update(member, states[key], args, member_kwargs[key])
         return new
 
     def _update_bucketed(states, leaves, pad_count, treedef, batched):
         entry.mark_trace("bucketed")
         args, member_kwargs = jax.tree_util.tree_unflatten(treedef, list(leaves))
-        row_args, row_kwargs = jax.tree_util.tree_unflatten(
-            treedef, bucketing.row_slice_leaves(list(leaves), batched)
-        )
         new: Dict[str, Any] = {}
-        for key, member in zip(keys, entry.cell):
-            member._restore_state(states[key])
-            member._inner_update(*args, **member_kwargs[key])
-            padded_out = member._snapshot_state()
-            defaults = member.init_state()
-            member._restore_state(defaults)
-            member._inner_update(*row_args, **row_kwargs[key])
-            row_out = member._snapshot_state()
-            new[key] = _corrected_states(padded_out, row_out, defaults, pad_count)
+        with _health.shared_screening():
+            for key, member in zip(keys, entry.cell):
+                new[key] = _health.traced_update(
+                    member, states[key], args, member_kwargs[key], pad_count=pad_count
+                )
         return new
 
     def _forward(states, args, member_kwargs):
         entry.mark_trace("exact")
         vals: Dict[str, Any] = {}
         merged: Dict[str, Any] = {}
-        for key, member in zip(keys, entry.cell):
-            fresh = {n: member._default_value(n) for n in member._defaults}
-            member._restore_state(fresh)
-            member._inner_update(*args, **member_kwargs[key])
-            batch_state = member._snapshot_state()
-            vals[key] = member._compute_impl()
-            merged[key] = member.merge_states(states[key], batch_state)
+        with _health.shared_screening():
+            for key, member in zip(keys, entry.cell):
+                fresh = {n: member._default_value(n) for n in member._defaults}
+                batch_state = _health.traced_update(member, fresh, args, member_kwargs[key])
+                member._restore_state(batch_state)
+                vals[key] = member._compute_impl()
+                merged[key] = member.merge_states(states[key], batch_state)
         return vals, merged
 
     def _compute(states):

@@ -10,6 +10,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.safe_ops import safe_divide
 from metrics_tpu.utils.checks import _input_format_classification
 from metrics_tpu.utils.enums import DataType
 
@@ -35,9 +36,8 @@ def _binning_bucketize(
     conf_sum = jax.ops.segment_sum(jnp.where(valid, confidences, 0.0), idx, num_segments=n_bins)
     acc_sum = jax.ops.segment_sum(jnp.where(valid, accuracies, 0.0), idx, num_segments=n_bins)
 
-    denom = jnp.where(count_bin == 0, 1.0, count_bin)
-    conf_bin = conf_sum / denom
-    acc_bin = acc_sum / denom
+    conf_bin = safe_divide(conf_sum, count_bin)
+    acc_bin = safe_divide(acc_sum, count_bin)
     prop_bin = count_bin / confidences.shape[0]
     return conf_bin, acc_bin, prop_bin
 

@@ -8,6 +8,7 @@ axis — jittable, no helper needed).
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.safe_ops import safe_divide
 from metrics_tpu.parallel.comm import reduce
 from metrics_tpu.utils.data import to_categorical
 
@@ -50,6 +51,6 @@ def dice_score(
     has_fg = jnp.sum(t_eq, axis=sum_axes) > 0
 
     denom = 2 * tp + fp + fn
-    score_cls = jnp.where(denom != 0, 2 * tp / jnp.where(denom != 0, denom, 1.0), nan_score)
+    score_cls = jnp.where(denom != 0, safe_divide(2 * tp, denom), nan_score)
     scores = jnp.where(has_fg, score_cls, no_fg_score)
     return reduce(scores, reduction=reduction)

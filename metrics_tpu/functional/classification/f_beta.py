@@ -3,7 +3,9 @@
 Parity: reference ``torchmetrics/functional/classification/f_beta.py``
 (``_safe_divide`` :26, ``_fbeta_compute`` :32, ``fbeta_score`` :113,
 ``f1_score`` :274). The reference's in-place masking is expressed with
-``jnp.where`` so the kernel jits.
+``jnp.where`` so the kernel jits. ``_safe_divide`` itself now lives in
+``metrics_tpu.ops.safe_ops`` (one audited 0/0 guard shared by every
+division site); the name is re-exported here for compatibility.
 """
 from typing import Optional
 
@@ -11,15 +13,10 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.ops.safe_ops import safe_divide as _safe_divide  # noqa: F401 — legacy import site
 from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
 
 Array = jax.Array
-
-
-def _safe_divide(num: Array, denom: Array) -> Array:
-    """Division that treats 0/0 as 0 (reference ``f_beta.py:26``)."""
-    denom = jnp.where(denom == 0.0, 1.0, denom)
-    return num / denom
 
 
 def _fbeta_compute(

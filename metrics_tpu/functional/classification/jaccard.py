@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+from metrics_tpu.ops.safe_ops import safe_divide
 from metrics_tpu.parallel.comm import reduce
 
 Array = jax.Array
@@ -32,7 +33,7 @@ def _jaccard_from_confmat(
     intersection = jnp.diag(confmat)
     union = jnp.sum(confmat, axis=0) + jnp.sum(confmat, axis=1) - intersection
 
-    scores = intersection.astype(jnp.float32) / jnp.where(union == 0, 1.0, union.astype(jnp.float32))
+    scores = safe_divide(intersection.astype(jnp.float32), union.astype(jnp.float32))
     scores = jnp.where(union == 0, absent_score, scores)
 
     if ignore_index is not None and 0 <= ignore_index < num_classes:

@@ -12,6 +12,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.safe_ops import safe_divide
 from metrics_tpu.utils.checks import _input_format_classification
 from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
 
@@ -147,13 +148,13 @@ def _reduce_stat_scores(
     weights = jnp.ones_like(denominator) if weights is None else weights.astype(jnp.float32)
 
     numerator = jnp.where(zero_div_mask, float(zero_division), numerator)
-    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    denominator = jnp.where(ignore_mask, 1.0, denominator)  # zero guard below
     weights = jnp.where(ignore_mask, 0.0, weights)
 
     if average not in (AverageMethod.MICRO, AverageMethod.NONE, None):
         weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
 
-    scores = weights * (numerator / denominator)
+    scores = weights * safe_divide(numerator, denominator)
     # sum(weights) == 0 (e.g. ignoring the only present class with 'weighted')
     scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
 

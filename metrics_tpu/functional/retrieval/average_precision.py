@@ -6,6 +6,7 @@ Branch-free (empty queries produce 0.0 via ``where``) so it jits and vmaps.
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.safe_ops import safe_divide
 from metrics_tpu.functional.retrieval._ranking import (
     GroupedRanking,
     _segment_sum,
@@ -31,7 +32,7 @@ def retrieval_average_precision(preds: Array, target: Array) -> Array:
     hits = jnp.cumsum(st)
     precision_at = hits / jnp.arange(1, st.shape[0] + 1)
     total = jnp.sum(st)
-    return jnp.where(total > 0, jnp.sum(precision_at * st) / jnp.clip(total, min=1.0), 0.0)
+    return jnp.where(total > 0, safe_divide(jnp.sum(precision_at * st), total), 0.0)
 
 
 def _average_precision_grouped(g: GroupedRanking) -> Array:
@@ -40,4 +41,4 @@ def _average_precision_grouped(g: GroupedRanking) -> Array:
     hits = _within_group_cumsum(t, g)
     contrib = t * hits / (g.rank + 1)
     n_pos = _segment_sum(t, g)
-    return jnp.where(n_pos > 0, _segment_sum(contrib, g) / jnp.clip(n_pos, min=1.0), 0.0)
+    return jnp.where(n_pos > 0, safe_divide(_segment_sum(contrib, g), n_pos), 0.0)

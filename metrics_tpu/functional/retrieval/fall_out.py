@@ -7,6 +7,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.safe_ops import safe_divide
 from metrics_tpu.functional.retrieval._ranking import (
     GroupedRanking,
     _k_mask,
@@ -38,11 +39,11 @@ def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> 
     st = _sorted_by_scores(preds, neg).astype(jnp.float32)
     irrelevant = jnp.sum(st[: min(k, n)])
     total = jnp.sum(st)
-    return jnp.where(total > 0, irrelevant / jnp.clip(total, min=1.0), 0.0)
+    return jnp.where(total > 0, safe_divide(irrelevant, total), 0.0)
 
 
 def _fall_out_grouped(g: GroupedRanking, k: Optional[int] = None) -> Array:
     neg = (1 - g.target).astype(jnp.float32)
     irrelevant = _segment_sum(neg * _k_mask(g, k), g)
     n_neg = _segment_sum(neg, g)
-    return jnp.where(n_neg > 0, irrelevant / jnp.clip(n_neg, min=1.0), 0.0)
+    return jnp.where(n_neg > 0, safe_divide(irrelevant, n_neg), 0.0)

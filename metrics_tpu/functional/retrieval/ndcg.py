@@ -8,6 +8,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.safe_ops import safe_divide
 from metrics_tpu.functional.retrieval._ranking import (
     GroupedRanking,
     _k_mask,
@@ -44,7 +45,7 @@ def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = Non
     ideal_target = jnp.sort(target)[::-1][:k]
     ideal_dcg = _dcg(ideal_target)
     target_dcg = _dcg(sorted_target)
-    return jnp.where(ideal_dcg > 0, target_dcg / jnp.where(ideal_dcg > 0, ideal_dcg, 1.0), 0.0)
+    return jnp.where(ideal_dcg > 0, safe_divide(target_dcg, ideal_dcg), 0.0)
 
 
 def _ndcg_grouped(g: GroupedRanking, g_ideal: GroupedRanking, k: Optional[int] = None) -> Array:
@@ -53,4 +54,4 @@ def _ndcg_grouped(g: GroupedRanking, g_ideal: GroupedRanking, k: Optional[int] =
     dcg = _segment_sum(g.target.astype(jnp.float32) * disc * _k_mask(g, k), g)
     disc_i = 1.0 / jnp.log2(g_ideal.rank + 2.0)
     idcg = _segment_sum(g_ideal.target.astype(jnp.float32) * disc_i * _k_mask(g_ideal, k), g_ideal)
-    return jnp.where(idcg > 0, dcg / jnp.where(idcg > 0, idcg, 1.0), 0.0)
+    return jnp.where(idcg > 0, safe_divide(dcg, idcg), 0.0)

@@ -8,6 +8,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.safe_ops import safe_divide
 from metrics_tpu.functional.retrieval._ranking import (
     GroupedRanking,
     _k_mask,
@@ -45,4 +46,4 @@ def _precision_grouped(g: GroupedRanking, k: Optional[int] = None) -> Array:
     relevant = _segment_sum(t * _k_mask(g, k), g)
     denom = g.sizes if k is None else jnp.full_like(g.sizes, k)
     n_pos = _segment_sum(t, g)
-    return jnp.where(n_pos > 0, relevant / denom, 0.0)
+    return jnp.where(n_pos > 0, safe_divide(relevant, denom), 0.0)

@@ -47,6 +47,7 @@ import numpy as np
 from metrics_tpu.engine import cache as _engine
 from metrics_tpu.parallel import comm
 from metrics_tpu.resilience import SYNC_ERROR_POLICIES, new_sync_stats
+from metrics_tpu.resilience import health as _health
 from metrics_tpu.utils.data import _squeeze_if_scalar, dim_zero_cat
 from metrics_tpu.utils.exceptions import JitIncompatibleError, MetricsUserError, SyncError
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -104,6 +105,21 @@ class Metric:
             :meth:`sync_report` (full per-rank granularity on the
             ``ProcessGroup`` KV path; other sync paths degrade whole-state,
             like ``'local'``).
+        on_bad_input: numerical-health policy for non-finite update inputs
+            (NaN/±Inf), screened *inside* the compiled update transition
+            (branchless, no extra host sync, no retrace — see
+            ``metrics_tpu.resilience.health`` and ``docs/numerics.md``).
+            ``'propagate'`` (default) performs no screening and keeps
+            bit-exact reference parity; ``'raise'`` quarantines the
+            contaminated update in-trace and raises a precise
+            :class:`~metrics_tpu.utils.exceptions.NumericalHealthError` on
+            the per-update host fetch (a debugging policy — it forces one
+            device sync per update); ``'skip'`` quarantines the whole
+            contaminated update (state bit-identical to never having seen
+            the batch, event counted); ``'mask'`` drops only the
+            contaminated rows, exactly, via the pow2-bucketing correction
+            (row-additive metrics stay compiled; others fall back to eager
+            concrete row filtering). Telemetry: :meth:`health_report`.
         jit_update: auto-jit the update transition (default True). Compiled
             transitions are shared process-wide across instances with the
             same class/config/input signature (see ``metrics_tpu.engine``).
@@ -162,6 +178,7 @@ class Metric:
         dist_sync_fn: Optional[Callable] = None,
         axis_name: Optional[Union[str, Sequence[str]]] = None,
         on_sync_error: str = "raise",
+        on_bad_input: str = "propagate",
         jit_update: bool = True,
         jit_bucket: Optional[str] = None,
     ) -> None:
@@ -174,6 +191,18 @@ class Metric:
             )
         self.on_sync_error = on_sync_error
         self._sync_stats = new_sync_stats()
+        if on_bad_input not in _health.HEALTH_POLICIES:
+            raise ValueError(
+                f"`on_bad_input` must be one of {_health.HEALTH_POLICIES}, got {on_bad_input!r}"
+            )
+        self.on_bad_input = on_bad_input
+        # what counts as contamination: 'nonfinite' (NaN and ±Inf) or 'nan'
+        # (NaN only — the legacy aggregation nan_strategy semantics, where
+        # ±Inf is data). Jit-relevant, hence a public attribute (it lands in
+        # the engine's config fingerprint).
+        self.health_screen = "nonfinite"
+        self._health_stats = _health.new_health_stats()
+        self._health_warn_on_bad = False
         if process_group is not None and dist_sync_fn is None:
             from metrics_tpu.parallel.groups import ProcessGroup
 
@@ -216,6 +245,14 @@ class Metric:
         self._jit_failed = False
         self._engine_probed = False
         self._compile_stats = _engine.new_stats()
+
+        if on_bad_input != "propagate":
+            # screening telemetry is a real 'sum'-reduced state: it rides
+            # jit/scan carries, checkpoints, clones, merge_states, and the
+            # distributed state-tree gather like any other accumulator.
+            # Registered only when a policy is active so the default keeps
+            # the reference's exact state set (and zero screening overhead).
+            _health.attach_state(self)
 
     # ------------------------------------------------------------------
     # state registration
@@ -441,8 +478,23 @@ class Metric:
 
     def _update_impl(self, *args: Any, **kwargs: Any) -> None:
         """Dispatch one update, through the shared-jit engine when possible."""
-        if not self._enable_jit or self._jit_failed or self._has_list_state():
-            self._inner_update(*args, **kwargs)
+        screened = _health.health_enabled(self)
+        if screened:
+            self._health_stats["batches_screened"] += 1
+        # forces_eager: policies with host-side contracts (warn-on-removal,
+        # concrete row filtering) must NEVER hit a shared compiled program —
+        # a cache hit would silently skip the contract — so they're routed
+        # statically, not via a trace failure
+        if (
+            not self._enable_jit
+            or self._jit_failed
+            or self._has_list_state()
+            or (screened and _health.forces_eager(self))
+        ):
+            if screened:
+                _health.eager_update(self, args, kwargs)
+            else:
+                self._inner_update(*args, **kwargs)
             return
         saved = self._snapshot_state()
         try:
@@ -450,7 +502,10 @@ class Metric:
         except _JIT_FALLBACK_ERRORS:
             self._jit_failed = True
             self._restore_state(saved)
-            self._inner_update(*args, **kwargs)
+            if screened:
+                _health.eager_update(self, args, kwargs)
+            else:
+                self._inner_update(*args, **kwargs)
             return
         except Exception:
             # a donated runtime failure may have consumed `saved`'s buffers —
@@ -458,9 +513,19 @@ class Metric:
             self._restore_state(_engine.rollback_state(self, saved))
             raise
         self._restore_state(new_state)
+        if screened and self.on_bad_input == "raise":
+            _health.raise_on_quarantine(self)
 
     def _has_list_state(self) -> bool:
         return any(isinstance(getattr(self, n), list) for n in self._defaults)
+
+    def _health_prescreen(self, args: Any, kwargs: Any) -> Any:
+        """Hook: normalize update inputs before non-finite screening (see
+        ``metrics_tpu.resilience.health``; runs only when a health policy is
+        active). Identity by default; aggregation metrics override it to
+        flatten rank>=2 values so masking drops elements, matching the
+        reference's boolean NaN removal."""
+        return args, kwargs
 
     def compile_stats(self) -> Dict[str, Any]:
         """Compile telemetry for this instance's jitted dispatches.
@@ -502,6 +567,24 @@ class Metric:
         out["process_group"] = getattr(self.process_group, "name", None)
         return out
 
+    def health_report(self) -> Dict[str, Any]:
+        """Numerical-health telemetry for this instance — the on-device
+        mirror of :meth:`sync_report` (see ``metrics_tpu.resilience.health``).
+
+        Device counters (they live in a registered ``'sum'`` state, so they
+        reset with :meth:`reset`, merge in ``forward``, ride checkpoints and
+        the distributed state gather): ``nan_count`` / ``inf_count``
+        (non-finite elements observed in screened update inputs),
+        ``rows_masked`` (rows dropped under ``'mask'``),
+        ``updates_quarantined`` (whole updates dropped under
+        ``'skip'``/``'raise'``), and ``overflow_events`` (saturated integer
+        accumulations in the stat-scores family). Host counters (lifetime of
+        the instance): ``batches_screened`` and ``last_compute_nonfinite``.
+        All device counters read 0 under ``on_bad_input='propagate'`` —
+        no screening runs.
+        """
+        return _health.metric_report(self)
+
     # -- compute wrapping -----------------------------------------------
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
@@ -524,6 +607,8 @@ class Metric:
             ):
                 value = compute(*args, **kwargs)
                 self._computed = _squeeze_if_scalar(value)
+            if _health.health_enabled(self):
+                _health.check_compute_result(self, self._computed)
             return self._computed
 
         self._compute_impl = compute
@@ -538,6 +623,10 @@ class Metric:
             setattr(self, name, self._default_value(name))
         self._cache = None
         self._is_synced = False
+        # the 'raise'-policy host mirrors track the device counters, which
+        # just went back to zero — a stale mirror would silently swallow the
+        # next quarantine (see resilience/health.raise_on_quarantine)
+        _health.reset_seen_mirrors(self)
 
     # ------------------------------------------------------------------
     # distributed sync (host-level, multi-process JAX)
@@ -836,6 +925,10 @@ class Metric:
         self.__dict__.setdefault("jit_bucket", None)
         self.__dict__.setdefault("on_sync_error", "raise")
         self.__dict__.setdefault("_sync_stats", new_sync_stats())
+        self.__dict__.setdefault("on_bad_input", "propagate")
+        self.__dict__.setdefault("health_screen", "nonfinite")
+        self.__dict__.setdefault("_health_stats", _health.new_health_stats())
+        self.__dict__.setdefault("_health_warn_on_bad", False)
         for name in self._defaults:
             v = getattr(self, name, None)
             if isinstance(v, list):

@@ -1,4 +1,6 @@
-"""Pallas TPU kernels for hot metric ops (XLA fallbacks included)."""
+"""Pallas TPU kernels for hot metric ops (XLA fallbacks included), plus the
+shared branchless numerical guard primitives (``safe_ops``)."""
 from metrics_tpu.ops.binned_counts import binned_stat_counts  # noqa: F401
+from metrics_tpu.ops.safe_ops import kahan_add, safe_divide, saturating_add  # noqa: F401
 
-__all__ = ["binned_stat_counts"]
+__all__ = ["binned_stat_counts", "kahan_add", "safe_divide", "saturating_add"]

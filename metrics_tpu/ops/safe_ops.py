@@ -1,0 +1,71 @@
+"""Audited numerical guard primitives shared across metric kernels.
+
+Three small ops that previously lived as per-file copies (or didn't exist):
+
+* :func:`safe_divide` — the reference's ``_safe_divide`` (0/0 -> 0 guard,
+  ``torchmetrics/functional/classification/f_beta.py:26``), hoisted out of
+  ``functional/classification/f_beta.py`` so every 0/0-guard division site
+  (f-beta, jaccard, dice, calibration binning, stat-scores reduction,
+  retrieval ratios) shares ONE audited implementation.
+* :func:`saturating_add` — overflow-guarded integer accumulation for
+  long-horizon counter states (stat-scores family): a wrapped int32 sum
+  silently goes negative; a saturated one clamps at the dtype max and
+  reports the event so ``health_report()`` can flag it.
+* :func:`kahan_add` — compensated (Kahan) streaming addition for float
+  accumulators: guards the cross-batch accumulation of Sum/Mean-family and
+  MSE/MAE running states against float32 cancellation over millions of
+  updates. Opt-in via the metrics' ``compensated=True``.
+
+All three are branchless ``jnp`` programs: safe inside ``jit``/``scan``/
+``shard_map`` with no host sync.
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def safe_divide(num: Array, denom: Array) -> Array:
+    """Division that treats 0/0 as 0 (reference ``f_beta.py:26``).
+
+    The guard substitutes 1 for zero denominators, so the result is exact
+    (``num/denom``) wherever ``denom != 0`` and equals ``num`` (conventionally
+    0, since a zero denominator implies a zero numerator at every call site)
+    where ``denom == 0``. Never emits the inf/NaN a raw division would.
+    """
+    denom_dtype = jnp.asarray(denom).dtype
+    one = jnp.ones((), dtype=denom_dtype)
+    return num / jnp.where(denom == 0, one, denom)
+
+
+def saturating_add(acc: Array, delta: Array) -> Tuple[Array, Array]:
+    """Integer add that clamps at the dtype max instead of wrapping.
+
+    Assumes ``delta >= 0`` (counter increments). Returns ``(result,
+    overflowed)`` where ``overflowed`` is a scalar bool — True when any
+    element would have wrapped past ``iinfo(acc.dtype).max``. On overflow the
+    affected elements saturate at the max value: a visibly-pegged sentinel
+    instead of a silently negative count.
+    """
+    out = acc + delta
+    wrapped = out < acc  # nonnegative delta: a decrease can only be a wrap
+    info_max = jnp.asarray(jnp.iinfo(jnp.asarray(acc).dtype).max, dtype=jnp.asarray(acc).dtype)
+    return jnp.where(wrapped, info_max, out), jnp.any(wrapped)
+
+
+def kahan_add(
+    total: Array, comp: Array, delta: Union[Array, float]
+) -> Tuple[Array, Array]:
+    """One step of Kahan (compensated) summation: ``total + delta`` with the
+    running low-order error carried in ``comp``. Returns ``(total', comp')``.
+
+    The compensation recovers the bits an ``x + tiny`` float add drops, so a
+    float32 running sum keeps ~float64-level accuracy over millions of
+    streaming updates at the cost of 3 extra adds.
+    """
+    y = delta - comp
+    t = total + y
+    comp = (t - total) - y
+    return t, comp

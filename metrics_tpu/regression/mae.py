@@ -6,12 +6,17 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.regression.mae import _mean_absolute_error_compute, _mean_absolute_error_update
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.safe_ops import kahan_add
 
 Array = jax.Array
 
 
 class MeanAbsoluteError(Metric):
     """Mean absolute error.
+
+    Args:
+        compensated: Kahan-compensate the running absolute-error sum (see
+            :class:`~metrics_tpu.MeanSquaredError` and ``docs/numerics.md``).
 
     Example:
         >>> import jax.numpy as jnp
@@ -23,17 +28,29 @@ class MeanAbsoluteError(Metric):
 
     is_differentiable = True
     higher_is_better = False
-    # per-row absolute-error sums + element counts: `jit_bucket`-eligible
-    _batch_additive = True
 
-    def __init__(self, **kwargs: Any) -> None:
+    # per-row absolute-error sums + element counts: `jit_bucket`-eligible
+    # unless the Kahan carry (order-dependent) is enabled
+    @property
+    def _batch_additive(self) -> bool:
+        return not getattr(self, "compensated", False)
+
+    def __init__(self, compensated: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
+        self.compensated = compensated
         self.add_state("sum_abs_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        if compensated:
+            self.add_state("sum_abs_error_comp", default=jnp.asarray(0.0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
-        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        if self.compensated:
+            self.sum_abs_error, self.sum_abs_error_comp = kahan_add(
+                self.sum_abs_error, self.sum_abs_error_comp, sum_abs_error
+            )
+        else:
+            self.sum_abs_error = self.sum_abs_error + sum_abs_error
         self.total = self.total + n_obs
 
     def compute(self) -> Array:

@@ -6,12 +6,20 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.regression.mse import _mean_squared_error_compute, _mean_squared_error_update
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.safe_ops import kahan_add
 
 Array = jax.Array
 
 
 class MeanSquaredError(Metric):
     """Mean squared error (RMSE with ``squared=False``).
+
+    Args:
+        compensated: opt into Kahan (compensated) summation for the running
+            squared-error sum — guards float32 long-horizon accumulation
+            against cancellation (see ``docs/numerics.md``). Disables the
+            row-additivity contract (``jit_bucket`` padding / compiled
+            ``'mask'`` fall back to exact shapes / eager filtering).
 
     Example:
         >>> import jax.numpy as jnp
@@ -25,18 +33,30 @@ class MeanSquaredError(Metric):
 
     is_differentiable = True
     higher_is_better = False
-    # per-row squared-error sums + element counts: `jit_bucket`-eligible
-    _batch_additive = True
 
-    def __init__(self, squared: bool = True, **kwargs: Any) -> None:
+    # per-row squared-error sums + element counts: `jit_bucket`-eligible
+    # unless the Kahan carry (order-dependent) is enabled
+    @property
+    def _batch_additive(self) -> bool:
+        return not getattr(self, "compensated", False)
+
+    def __init__(self, squared: bool = True, compensated: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.squared = squared
+        self.compensated = compensated
         self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        if compensated:
+            self.add_state("sum_squared_error_comp", default=jnp.asarray(0.0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
-        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        if self.compensated:
+            self.sum_squared_error, self.sum_squared_error_comp = kahan_add(
+                self.sum_squared_error, self.sum_squared_error_comp, sum_squared_error
+            )
+        else:
+            self.sum_squared_error = self.sum_squared_error + sum_squared_error
         self.total = self.total + n_obs
 
     def compute(self) -> Array:

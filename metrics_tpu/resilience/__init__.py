@@ -7,6 +7,10 @@ TPUv4 scale; EQuARX degraded collectives). This package holds the pieces:
 
 * :mod:`~metrics_tpu.resilience.retry` — :class:`RetryPolicy`: per-attempt
   deadline budgeting and exponential backoff with deterministic jitter.
+* :mod:`~metrics_tpu.resilience.health` — the on-device twin of the sync
+  resilience: jit-safe non-finite screening fused into the compiled update
+  transition, the ``Metric(on_bad_input='propagate'|'raise'|'skip'|'mask')``
+  policies, and the ``health_report()`` counter state (``docs/numerics.md``).
 * :mod:`~metrics_tpu.resilience.faults` — the deterministic fault-injection
   harness: an in-memory KV fake with per-(rank, epoch) drop/delay/corrupt/
   straggler faults, per-thread world simulation, and an env-activated
@@ -36,6 +40,11 @@ from metrics_tpu.resilience.faults import (  # noqa: F401
     run_as_peers,
     simulated_process,
     simulated_world,
+)
+from metrics_tpu.resilience.health import (  # noqa: F401
+    HEALTH_POLICIES,
+    HEALTH_STATE,
+    new_health_stats,
 )
 from metrics_tpu.resilience.retry import DEFAULT_RETRY, RetryPolicy  # noqa: F401
 

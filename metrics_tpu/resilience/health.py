@@ -1,0 +1,659 @@
+"""Numerical-health containment: jit-safe non-finite screening + policies.
+
+PR 2 made the *host-side* sync path survive faults; this module hardens the
+*on-device* compute path. One NaN-laced batch from a diverging training run
+silently poisons a streaming metric's state forever (``nan + x = nan``), and
+at the scale the ROADMAP targets (pjit/TPU jobs streaming millions of
+samples, reduced-precision comms in play) nobody is eyeballing per-batch
+values. Numerical health therefore becomes a first-class, policy-driven,
+observable property of every :class:`~metrics_tpu.Metric`:
+
+* **Branchless screening.** :func:`traced_update` classifies every update's
+  array inputs as finite or contaminated *inside* the compiled state
+  transition (fused through ``metrics_tpu.engine`` — the screening ops ride
+  the same XLA program as the update itself, so there is no extra host sync
+  and no retrace: contamination flows through ``jnp.where`` selects, never
+  through Python control flow).
+
+* **Policies** (``Metric(on_bad_input=...)``):
+
+  - ``'propagate'`` (default) — no screening at all; the traced program is
+    bit-identical to the unscreened engine, preserving reference parity.
+  - ``'raise'`` — the contaminated update is quarantined in-trace (state
+    unchanged) and a precise :class:`NumericalHealthError` (metric, update
+    index, NaN vs ±Inf counts) is raised on the host-side fetch that
+    follows each update. The check forces one device sync per update: a
+    debugging policy, not a hot-loop one.
+  - ``'skip'`` — the whole contaminated update is quarantined (state
+    bit-identical to never having dispatched it) and counted. Works for any
+    jittable metric: the select is a per-leaf ``where``.
+  - ``'mask'`` — only the contaminated rows are dropped, exactly, using the
+    pow2-bucketing correction machinery from PR 1: bad rows are zeroed and
+    the zero-rows' contribution is subtracted
+    (``update(state, zeroed) - n_bad * (update(default, zero_row) - default)``),
+    which is exact for row-additive metrics (``_batch_additive``). Metrics
+    that can't express row-additivity raise ``JitIncompatibleError`` at
+    trace time and fall back to the eager path, where rows are filtered
+    concretely instead — same result, per-op dispatch.
+
+* **Health counters are state.** Screening telemetry lives in a registered
+  ``'sum'``-reduced state vector (:data:`HEALTH_STATE`), so it rides
+  ``jit``/``scan`` carries, checkpoints (``utils/checkpoint.py``), clones,
+  ``merge_states``, and the distributed state-tree gather exactly like any
+  other metric state. ``Metric.health_report()`` /
+  ``MetricCollection.health_report()`` surface it host-side — the numerical
+  mirror of PR 2's ``sync_report()`` and PR 1's ``compile_stats()``.
+
+Screening scope: float/complex leaves only (integer and bool inputs cannot
+hold non-finite values). ``metric.health_screen`` selects what counts as
+contamination — ``'nonfinite'`` (default: NaN and ±Inf) or ``'nan'`` (NaN
+only; the legacy aggregation ``nan_strategy`` semantics, where ±Inf is
+data).
+"""
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.exceptions import JitIncompatibleError, NumericalHealthError
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+HEALTH_POLICIES = ("propagate", "raise", "skip", "mask")
+
+#: Registered state holding the device-side health counters. A plain
+#: ``'sum'``-reduced int vector so it stays bucketing-eligible and merges /
+#: syncs / checkpoints like any metric state.
+HEALTH_STATE = "_health_counts"
+
+# slot layout of the HEALTH_STATE vector — the five counters are additive
+# (a zero pad/mask row contributes exactly 0), so the pow2-bucketing
+# correction and the mask correction are exact for them. SLOT_LAST_BAD is a
+# per-dispatch SENTINEL, not a counter: every screened update overwrites it
+# with that update's contamination flag (set-semantics survive the zero-row
+# corrections because a clean zero row writes 0 on both sides), and the
+# 'raise'-policy host check reads-and-clears it — so the check is correct
+# per dispatch regardless of forward's state dances, merges, resets, or
+# checkpoint restores.
+SLOT_NAN, SLOT_INF, SLOT_MASKED, SLOT_QUARANTINED, SLOT_OVERFLOW, SLOT_LAST_BAD = range(6)
+N_SLOTS = 6
+
+_REPORT_SLOTS = (
+    ("nan_count", SLOT_NAN),
+    ("inf_count", SLOT_INF),
+    ("rows_masked", SLOT_MASKED),
+    ("updates_quarantined", SLOT_QUARANTINED),
+    ("overflow_events", SLOT_OVERFLOW),
+)
+
+
+def new_health_stats() -> Dict[str, Any]:
+    """Host-side health counters (the non-device half of ``health_report()``).
+
+    ``batches_screened`` counts update dispatches that ran with screening
+    active (best-effort under the pure API traced by user code);
+    ``last_compute_nonfinite`` records whether the most recent host-side
+    ``compute()`` returned a non-finite value.
+    """
+    return {
+        "batches_screened": 0,
+        "last_compute_nonfinite": False,
+        # host mirrors of the device counters at the last 'raise'-policy
+        # check — deltas are computed against these (never against a
+        # pre-dispatch state snapshot, whose buffers a donating backend may
+        # already have consumed)
+        "_seen_quarantined": 0,
+        "_seen_nan": 0,
+        "_seen_inf": 0,
+    }
+
+
+def attach_state(metric: Any) -> None:
+    """Register the health-counter state on ``metric`` (policy != propagate)."""
+    int_dtype = jnp.asarray(0).dtype  # lane default: int64 under x64, else int32
+    metric.add_state(HEALTH_STATE, default=jnp.zeros((N_SLOTS,), dtype=int_dtype), dist_reduce_fx="sum")
+
+
+def health_enabled(metric: Any) -> bool:
+    return (
+        getattr(metric, "on_bad_input", "propagate") != "propagate"
+        and HEALTH_STATE in getattr(metric, "_defaults", {})
+    )
+
+
+def mask_supported(metric: Any) -> bool:
+    """'mask' needs the row-additivity contract the bucketing correction is
+    exact for: ``_batch_additive`` plus all-array ``'sum'``-reduced states —
+    the SAME contract ``engine.bucketing.supports_bucketing`` checks, via
+    the shared helper (``jit_bucket`` opt-in is orthogonal)."""
+    from metrics_tpu.engine import bucketing
+
+    if not getattr(metric, "_batch_additive", False):
+        return False
+    return bucketing.row_additive_states(metric)
+
+
+def forces_eager(metric: Any) -> bool:
+    """True when the active health policy can never run compiled for this
+    instance: the warn-on-removal contract (host-side warnings), or 'mask'
+    on a metric without the row-additivity contract (rows must be filtered
+    concretely). Checked STATICALLY by ``Metric._update_impl`` and the
+    collection fusion gate, so such instances route straight to eager
+    dispatch instead of tracing into (or cache-hitting!) a shared compiled
+    program that cannot honor their contract."""
+    if not health_enabled(metric):
+        return False
+    if getattr(metric, "_health_warn_on_bad", False):
+        return True
+    return metric.on_bad_input == "mask" and not mask_supported(metric)
+
+
+def record_overflow(metric: Any, overflowed: Array) -> None:
+    """Bump the overflow slot from inside a metric's ``update`` body (used by
+    the stat-scores family's saturating accumulation). Additive — a zero
+    row never overflows — so it survives the bucketing/mask corrections."""
+    counts = getattr(metric, HEALTH_STATE)
+    zero = jnp.zeros((), counts.dtype)
+    slots = [zero] * N_SLOTS
+    slots[SLOT_OVERFLOW] = jnp.asarray(overflowed, counts.dtype)
+    setattr(metric, HEALTH_STATE, counts + jnp.stack(slots))
+
+
+# ---------------------------------------------------------------------------
+# screening primitive
+# ---------------------------------------------------------------------------
+def _as_screenable(leaf: Any) -> Optional[Array]:
+    """The float view of a leaf, or None when it can't carry non-finites."""
+    if isinstance(leaf, bool) or (isinstance(leaf, int) and not isinstance(leaf, bool)):
+        return None
+    if isinstance(leaf, float):
+        return jnp.asarray(leaf)
+    if isinstance(leaf, (jax.Array, jnp.ndarray, np.ndarray)):
+        return leaf if jnp.issubdtype(leaf.dtype, jnp.inexact) else None
+    return None
+
+
+def batched_indices(leaves: List[Any]) -> Tuple[int, ...]:
+    """Indices of rank>=1 array leaves sharing axis 0 — delegates to THE
+    batch-axis consensus rule in ``engine.bucketing`` (row masking and the
+    zero-row pad correction must agree on what a row is; lazy import keeps
+    the engine->health import direction acyclic)."""
+    from metrics_tpu.engine import bucketing
+
+    return bucketing.batched_leaf_indices(leaves)
+
+
+class _ScreenMemo(threading.local):
+    """Per-trace memo of per-leaf detection results, keyed by leaf identity.
+
+    A fused collection screens the SAME input tracers once per member; the
+    memo (activated by :func:`shared_screening` around the member loop)
+    makes the sharing explicit instead of hoping XLA CSE deduplicates the
+    subexpressions. Thread-local and stack-scoped, so concurrent traces on
+    different threads never mix, and tracer ids can't leak across traces.
+    """
+
+    def __init__(self) -> None:
+        self.stack: List[Dict[Any, Any]] = []
+
+    @property
+    def active(self) -> Optional[Dict[Any, Any]]:
+        return self.stack[-1] if self.stack else None
+
+
+_screen_memo = _ScreenMemo()
+
+
+@contextmanager
+def shared_screening() -> Any:
+    """Share per-leaf screening results across the calls inside (used by the
+    engine's fused transitions: one detection pass per distinct input leaf
+    per compiled program, however many members screen it)."""
+    _screen_memo.stack.append({})
+    try:
+        yield
+    finally:
+        _screen_memo.stack.pop()
+
+
+def _memoized(key: Any, pin: Any, compute: Any) -> Any:
+    """Memo lookup that PINS the keyed object(s) in the entry: keys carry
+    ``id()``s, and an unpinned leaf (e.g. a prescreen-created tracer nothing
+    else references) could be freed mid-trace and its id recycled by a later
+    leaf — handing that leaf the wrong screening result."""
+    memo = _screen_memo.active
+    if memo is None:
+        return compute()
+    if key not in memo:
+        memo[key] = (pin, compute())
+    return memo[key][1]
+
+
+def _leaf_row_bad(arr: Array, nan_only: bool) -> Array:
+    """[B] per-row contamination of one batched leaf — ONE elementwise pass
+    plus one row reduction (the hot-path cost of screening). The
+    zero-multiply poison trick marks NaN and ±Inf together (``x*0`` is 0
+    for every finite value, NaN otherwise); ``nan_only`` needs the explicit
+    compare (±Inf must NOT poison)."""
+    flat = arr.reshape(arr.shape[0], -1)
+    if nan_only:
+        return jnp.any(flat != flat, axis=1)
+    return jnp.isnan(jnp.sum(flat * jnp.zeros((), arr.dtype), axis=1))
+
+
+def _leaf_any_bad(arr: Array, nan_only: bool) -> Array:
+    if nan_only:
+        return jnp.any(arr != arr)
+    return jnp.isnan(jnp.sum(arr * jnp.zeros((), arr.dtype)))
+
+
+def screen_leaves(
+    leaves: List[Any], batched: Tuple[int, ...], nan_only: bool, need_rows: bool = True
+) -> Tuple[Array, Array, Optional[Array], Array]:
+    """Classify the update inputs, branchlessly (no host sync, no retrace).
+
+    Returns ``(nan_count, inf_count, row_bad, any_bad)``: the NaN / ±Inf
+    element counts over every float leaf, the per-row contamination mask
+    over the shared batch axis (``None`` when ``batched`` is empty), and the
+    whole-batch contamination flag. ``nan_only`` narrows what counts as
+    *bad* to NaN (legacy aggregation semantics).
+
+    Cost model: clean batches pay only the detection pass (one elementwise
+    op + one row reduction per float leaf, memoized across fused members);
+    the exact nan-vs-inf element counts are computed under a ``lax.cond``
+    that only executes for contaminated batches — in-trace data-dependent
+    control flow, so still no host round-trip and no retrace. The counts
+    therefore describe *contaminated* updates (they are 0-by-construction
+    for clean ones), which is exactly what they count.
+    """
+    int_dtype = jnp.asarray(0).dtype
+    batched_set = set(batched)
+    row_bad: Optional[Array] = None
+    scalar_bad: Optional[Array] = None
+    screenable: List[Array] = []
+    for i, leaf in enumerate(leaves):
+        arr = _as_screenable(leaf)
+        if arr is None:
+            continue
+        screenable.append(arr)
+        if need_rows and i in batched_set and arr.ndim >= 1:
+            # the per-row mask materializes a [B] vector: only 'mask' needs
+            # it — skip/raise callers pass need_rows=False and pay a single
+            # whole-leaf reduction instead
+            leaf_rows = _memoized(
+                (id(leaf), "row", nan_only), leaf, lambda a=arr: _leaf_row_bad(a, nan_only)
+            )
+            row_bad = leaf_rows if row_bad is None else (row_bad | leaf_rows)
+        else:
+            leaf_any = _memoized(
+                (id(leaf), "any", nan_only), leaf, lambda a=arr: _leaf_any_bad(a, nan_only)
+            )
+            scalar_bad = leaf_any if scalar_bad is None else (scalar_bad | leaf_any)
+    if not screenable:
+        zero = jnp.zeros((), int_dtype)
+        return zero, zero, None, False
+    if row_bad is not None:
+        if scalar_bad is not None:
+            # a contaminated non-batched leaf (e.g. a bad scalar weight)
+            # taints every row — masking then drops the whole batch, exactly
+            row_bad = row_bad | scalar_bad
+        any_bad = jnp.any(row_bad)
+    else:
+        any_bad = scalar_bad if scalar_bad is not None else jnp.zeros((), jnp.bool_)
+
+    def _exact_counts() -> Tuple[Array, Array]:
+        nan_c = jnp.zeros((), jnp.int32)
+        notfin = jnp.zeros((), jnp.int32)
+        for arr in screenable:
+            nan_c = nan_c + jnp.sum(arr != arr, dtype=jnp.int32)
+            notfin = notfin + jnp.sum(~jnp.isfinite(arr), dtype=jnp.int32)
+        return nan_c, notfin - nan_c
+
+    def _guarded_counts() -> Tuple[Array, Array]:
+        return jax.lax.cond(
+            any_bad, _exact_counts, lambda: (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+        )
+
+    nan_count, inf_count = _memoized(
+        (tuple(id(a) for a in screenable), "counts", nan_only),
+        tuple(screenable),
+        _guarded_counts,
+    )
+    return nan_count.astype(int_dtype), inf_count.astype(int_dtype), row_bad, any_bad
+
+
+def _zero_bad_rows(leaves: List[Any], batched: Tuple[int, ...], row_bad: Array) -> List[Any]:
+    """Zero the contaminated rows of the batched leaves (pad-value semantics:
+    a zero row's state delta is finite and exactly correctable)."""
+    batched_set = set(batched)
+    out: List[Any] = []
+    for i, leaf in enumerate(leaves):
+        if i not in batched_set:
+            out.append(leaf)
+            continue
+        arr = jnp.asarray(leaf)
+        mask = row_bad.reshape((-1,) + (1,) * (arr.ndim - 1))
+        out.append(jnp.where(mask, jnp.zeros((), arr.dtype), arr))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# traced transition (the engine's compiled-update body)
+# ---------------------------------------------------------------------------
+def _run_inner(inst: Any, state: Dict[str, Any], args: Tuple, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    inst._restore_state(state)
+    inst._inner_update(*args, **kwargs)
+    return inst._snapshot_state()
+
+
+def _zero_row_outputs(
+    inst: Any, args: Tuple, kwargs: Dict[str, Any]
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """One zero-row update on the defaults — the correction term shared by
+    pad-bucketing and row-masking (see ``engine.bucketing``)."""
+    from metrics_tpu.engine import bucketing
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    batched = batched_indices(leaves)
+    row_args, row_kwargs = jax.tree_util.tree_unflatten(
+        treedef, bucketing.row_slice_leaves(leaves, batched)
+    )
+    defaults = inst.init_state()
+    row_out = _run_inner(inst, defaults, row_args, row_kwargs)
+    return row_out, defaults
+
+
+def traced_update(
+    inst: Any,
+    state: Dict[str, Any],
+    args: Tuple,
+    kwargs: Dict[str, Any],
+    pad_count: Optional[Any] = None,
+) -> Dict[str, Any]:
+    """One screened state transition — the body of every engine-compiled
+    update program (exact and pow2-bucketed, single-metric and fused).
+
+    ``pad_count`` is the traced number of zero pad rows appended by
+    ``jit_bucket='pow2'`` (``None`` for exact-shape dispatches); its
+    contribution is subtracted with the same zero-row correction that
+    implements 'mask'. With ``on_bad_input='propagate'`` the emitted program
+    is identical to the unscreened engine.
+    """
+    policy = getattr(inst, "on_bad_input", "propagate")
+    if policy == "propagate":
+        out = _run_inner(inst, state, args, kwargs)
+        if pad_count is None:
+            return out
+        row_out, defaults = _zero_row_outputs(inst, args, kwargs)
+        return {name: out[name] - pad_count * (row_out[name] - defaults[name]) for name in out}
+
+    if getattr(inst, "_health_warn_on_bad", False):
+        # warn-on-removal is a host-side contract: route the instance to the
+        # eager fallback (where eager_update warns at each removal), exactly
+        # where the legacy implementation's concretization landed it
+        raise JitIncompatibleError(
+            f"nan_strategy='warn' on {type(inst).__name__} warns at every"
+            " NaN removal, which a compiled update cannot do — falling back"
+            " to eager dispatch (use 'ignore' or on_bad_input='mask' for"
+            " the compiled drop)."
+        )
+
+    if pad_count is None:
+        # metric-declared input normalization before screening (aggregators
+        # flatten rank>=2 values so 'mask' drops ELEMENTS like the legacy
+        # boolean removal). Skipped on bucketed dispatches: pad_count counts
+        # rows of the ORIGINAL batch axis, which a reshape would redefine.
+        args, kwargs = inst._health_prescreen(args, kwargs)
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    batched = batched_indices(leaves)
+    nan_only = getattr(inst, "health_screen", "nonfinite") == "nan"
+    nan_count, inf_count, row_bad, any_bad = screen_leaves(
+        leaves, batched, nan_only, need_rows=policy == "mask"
+    )
+
+    use_mask = policy == "mask"
+    if use_mask and not mask_supported(inst):
+        raise JitIncompatibleError(
+            f"on_bad_input='mask' needs the row-additivity contract"
+            f" (`_batch_additive` with all-'sum' array states) to drop rows"
+            f" inside a compiled update; {type(inst).__name__} does not"
+            " declare it. Falling back to eager dispatch, where contaminated"
+            " rows are filtered concretely."
+        )
+    if use_mask and row_bad is None:
+        # no unambiguous batch axis to mask along: quarantine the whole
+        # update instead (deterministic, and exact — dropping every row of a
+        # contaminated scalar update IS skipping it)
+        use_mask = False
+
+    run_leaves = leaves
+    n_bad = jnp.zeros((), jnp.asarray(0).dtype)
+    if use_mask:
+        n_bad = jnp.sum(row_bad, dtype=n_bad.dtype)
+        run_leaves = _zero_bad_rows(leaves, batched, row_bad)
+    run_args, run_kwargs = jax.tree_util.tree_unflatten(treedef, run_leaves)
+
+    out = _run_inner(inst, state, run_args, run_kwargs)
+
+    drop = None
+    if pad_count is not None and use_mask:
+        drop = pad_count + n_bad
+    elif pad_count is not None:
+        drop = pad_count
+    elif use_mask:
+        drop = n_bad
+    if drop is not None:
+        row_out, defaults = _zero_row_outputs(inst, run_args, run_kwargs)
+        out = {name: out[name] - drop * (row_out[name] - defaults[name]) for name in out}
+
+    quarantine = policy in ("skip", "raise") or not use_mask
+    if quarantine:
+        out = {name: jnp.where(any_bad, state[name], out[name]) for name in out}
+
+    counts = out[HEALTH_STATE]
+    zero = jnp.zeros((), counts.dtype)
+    # stack, not .at[].set scatters: XLA CPU dispatches each scatter as its
+    # own op and they showed up in the screening-overhead budget
+    delta = jnp.stack(
+        [
+            jnp.asarray(nan_count, counts.dtype),
+            jnp.asarray(inf_count, counts.dtype),
+            zero if quarantine else jnp.asarray(n_bad, counts.dtype),
+            jnp.asarray(any_bad, counts.dtype) if quarantine else zero,
+            zero,
+            zero,
+        ]
+    )
+    counts = counts + delta
+    # the sentinel slot is OVERWRITTEN (set, not accumulated) with THIS
+    # dispatch's contamination flag
+    out[HEALTH_STATE] = jnp.concatenate(
+        [counts[:SLOT_LAST_BAD], jnp.asarray(any_bad, counts.dtype)[None]]
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# eager transition (jit-fallback metrics: list states, host-side updates)
+# ---------------------------------------------------------------------------
+def eager_update(inst: Any, args: Tuple, kwargs: Dict[str, Any]) -> None:
+    """Screened update on concrete values, mutating ``inst`` in place.
+
+    The eager twin of :func:`traced_update` with concrete-value privileges:
+    'raise' raises immediately with the exact update index, 'mask' filters
+    the contaminated rows out by boolean indexing (no additivity needed —
+    this is the fallback path masked non-additive metrics land on), and
+    legacy-'warn' aggregators warn at the moment of removal.
+    """
+    policy = getattr(inst, "on_bad_input", "propagate")
+    if policy == "propagate" or not health_enabled(inst):
+        inst._inner_update(*args, **kwargs)
+        return
+
+    args, kwargs = inst._health_prescreen(args, kwargs)
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    batched = batched_indices(leaves)
+    nan_only = getattr(inst, "health_screen", "nonfinite") == "nan"
+    nan_count, inf_count, row_bad, any_bad = screen_leaves(leaves, batched, nan_only)
+    nan_i, inf_i = int(nan_count), int(inf_count)
+
+    def _bump(masked: int = 0, quarantined: int = 0) -> None:
+        counts = getattr(inst, HEALTH_STATE)
+        delta = np.zeros(N_SLOTS, dtype=np.asarray(counts).dtype)
+        delta[SLOT_NAN], delta[SLOT_INF] = nan_i, inf_i
+        delta[SLOT_MASKED], delta[SLOT_QUARANTINED] = masked, quarantined
+        setattr(inst, HEALTH_STATE, counts + jnp.asarray(delta))
+
+    if not bool(any_bad):
+        inst._inner_update(*args, **kwargs)
+        _bump()
+        return
+    if policy == "raise":
+        _bump(quarantined=1)
+        # sync the host mirrors so a later jitted raise-check doesn't
+        # re-surface this (already raised) quarantine
+        counts = np.asarray(getattr(inst, HEALTH_STATE))
+        inst._health_stats["_seen_quarantined"] = int(counts[SLOT_QUARANTINED])
+        inst._health_stats["_seen_nan"] = int(counts[SLOT_NAN])
+        inst._health_stats["_seen_inf"] = int(counts[SLOT_INF])
+        raise NumericalHealthError(_raise_message(inst, inst._update_count, nan_i, inf_i))
+    if getattr(inst, "_health_warn_on_bad", False):
+        rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+    if policy == "skip" or row_bad is None:
+        _bump(quarantined=1)
+        return
+    # mask: drop the contaminated rows concretely
+    keep = ~np.asarray(row_bad)
+    n_bad = int(np.asarray(row_bad).sum())
+    if not keep.any():
+        _bump(masked=n_bad)
+        return
+    filtered = [
+        jnp.asarray(leaf)[keep] if i in set(batched) else leaf for i, leaf in enumerate(leaves)
+    ]
+    run_args, run_kwargs = jax.tree_util.tree_unflatten(treedef, filtered)
+    inst._inner_update(*run_args, **run_kwargs)
+    _bump(masked=n_bad)
+
+
+# ---------------------------------------------------------------------------
+# host-side checks (raise policy, compute results, reports)
+# ---------------------------------------------------------------------------
+def _is_tracer(x: Any) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _raise_message(metric: Any, update_index: int, nan_i: int, inf_i: int) -> str:
+    return (
+        f"Encountered `nan` values or ±inf in the inputs of"
+        f" {type(metric).__name__}.update (update #{update_index}):"
+        f" {nan_i} NaN and {inf_i} ±Inf element(s) this update. The"
+        " contaminated update was quarantined — the accumulated states"
+        f" ({', '.join(n for n in metric._defaults if n != HEALTH_STATE)})"
+        " are unchanged (on_bad_input='raise')."
+    )
+
+
+def reset_seen_mirrors(metric: Any, counts: Optional[np.ndarray] = None) -> None:
+    """Re-sync the 'raise'-policy host mirrors with the device counters —
+    called whenever the counters change outside an update (``reset()``,
+    checkpoint restore). ``counts`` defaults to zeros (the post-reset
+    state)."""
+    stats = getattr(metric, "_health_stats", None)
+    if stats is None:
+        return
+    if counts is None:
+        stats["_seen_quarantined"] = stats["_seen_nan"] = stats["_seen_inf"] = 0
+    else:
+        stats["_seen_quarantined"] = int(counts[SLOT_QUARANTINED])
+        stats["_seen_nan"] = int(counts[SLOT_NAN])
+        stats["_seen_inf"] = int(counts[SLOT_INF])
+
+
+def raise_on_quarantine(metric: Any) -> None:
+    """Host check behind ``on_bad_input='raise'``: fetch the health counters
+    and raise if THIS dispatch was quarantined. No-op while tracing
+    (pure-API users inside their own jit read ``health_report()`` instead).
+
+    The decision reads the per-dispatch :data:`SLOT_LAST_BAD` sentinel —
+    not a counter delta — so it stays correct through forward's state
+    dances, merges, ``reset()``, and checkpoint restores; the sentinel is
+    cleared before raising so an already-surfaced quarantine can't
+    re-surface through a later merge. The ``_seen_*`` mirrors only refine
+    the error message's NaN/±Inf deltas (best-effort)."""
+    cur = getattr(metric, HEALTH_STATE, None)
+    if cur is None or _is_tracer(cur):
+        return
+    cur_np = np.asarray(cur)  # the advertised per-update host fetch
+    stats = metric._health_stats
+    nan_c, inf_c = int(cur_np[SLOT_NAN]), int(cur_np[SLOT_INF])
+    nan_i = max(0, nan_c - stats.get("_seen_nan", 0))
+    inf_i = max(0, inf_c - stats.get("_seen_inf", 0))
+    stats["_seen_quarantined"] = int(cur_np[SLOT_QUARANTINED])
+    stats["_seen_nan"], stats["_seen_inf"] = nan_c, inf_c
+    if int(cur_np[SLOT_LAST_BAD]):
+        arr = jnp.asarray(cur)
+        setattr(
+            metric,
+            HEALTH_STATE,
+            jnp.concatenate([arr[:SLOT_LAST_BAD], jnp.zeros((1,), arr.dtype)]),
+        )
+        raise NumericalHealthError(_raise_message(metric, metric._update_count, nan_i, inf_i))
+
+
+def check_compute_result(metric: Any, value: Any) -> None:
+    """compute()-side finite check: under 'raise' a non-finite result is an
+    error; under 'skip'/'mask' it is recorded in ``health_report()``.
+
+    Skipped before the first update: an empty-stream compute legitimately
+    returns the state defaults (``-inf`` running max, ``0/0`` mean) and the
+    reference surfaces those with the compute-before-update warning, not an
+    error."""
+    if getattr(metric, "_update_count", 0) == 0:
+        return
+    leaves = jax.tree_util.tree_leaves(value)
+    if any(_is_tracer(leaf) for leaf in leaves):
+        return
+    # honor the screening mode: under health_screen='nan' (legacy
+    # aggregation semantics) ±inf is DATA — a running max of inf is a
+    # legitimate result, not a health event
+    nan_only = getattr(metric, "health_screen", "nonfinite") == "nan"
+    nonfinite = False
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.inexact):
+            continue
+        if np.isnan(arr).any() or (not nan_only and np.isinf(arr).any()):
+            nonfinite = True
+            break
+    metric._health_stats["last_compute_nonfinite"] = nonfinite
+    if nonfinite and getattr(metric, "on_bad_input", "propagate") == "raise":
+        raise NumericalHealthError(
+            f"compute() of {type(metric).__name__} returned a non-finite"
+            " result (on_bad_input='raise'). Health counters:"
+            f" {metric.health_report()}"
+        )
+
+
+def metric_report(metric: Any) -> Dict[str, Any]:
+    """The per-metric ``health_report()`` body (see ``Metric.health_report``)."""
+    out: Dict[str, Any] = {
+        "on_bad_input": getattr(metric, "on_bad_input", "propagate"),
+        "screen": getattr(metric, "health_screen", "nonfinite"),
+        "batches_screened": metric._health_stats["batches_screened"],
+        "last_compute_nonfinite": metric._health_stats["last_compute_nonfinite"],
+    }
+    counts = getattr(metric, HEALTH_STATE, None)
+    counts_np = (
+        np.zeros(N_SLOTS, dtype=np.int64)
+        if counts is None or _is_tracer(counts)
+        else np.asarray(counts)
+    )
+    for name, slot in _REPORT_SLOTS:
+        out[name] = int(counts_np[slot])
+    return out

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.retrieval._ranking import GroupedRanking, _group_by_query, _segment_sum
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.safe_ops import safe_divide
 from metrics_tpu.utils.bounded import _BoundedSampleBufferMixin
 from metrics_tpu.utils.checks import _check_retrieval_inputs
 
@@ -111,7 +112,7 @@ class RetrievalMetric(_BoundedSampleBufferMixin, Metric, ABC):
         if self.empty_target_action == "skip":
             keep = ~empty
             n_keep = jnp.sum(keep)
-            return jnp.where(n_keep > 0, jnp.sum(jnp.where(keep, values, 0.0)) / jnp.clip(n_keep, min=1), 0.0)
+            return jnp.where(n_keep > 0, safe_divide(jnp.sum(jnp.where(keep, values, 0.0)), n_keep), 0.0)
         fill = 1.0 if self.empty_target_action == "pos" else 0.0
         return jnp.mean(jnp.where(empty, fill, values))
 

@@ -53,6 +53,11 @@ def metric_state_pytree(metric: Metric) -> Dict[str, Any]:
     if dyn_attrs:
         dyn = {a: _encode_dynamic(getattr(metric, a)) for a in dyn_attrs}
         out["_dynamic"] = np.frombuffer(json.dumps(dyn).encode("utf-8"), dtype=np.uint8)
+    # the device-side health counters are a registered state and ride the
+    # loop above; the host-side screened-dispatch counter travels alongside
+    # so health_report() stays coherent across a restore
+    if "_health_counts" in metric._defaults:
+        out["_health_screened"] = np.asarray(metric._health_stats["batches_screened"])
     return out
 
 
@@ -93,7 +98,7 @@ def restore_metric_state_pytree(metric: Metric, tree: Dict[str, Any]) -> Metric:
             f"Checkpoint tree for {cls} is missing '_update_count' — not a"
             " metric_state_pytree snapshot?"
         )
-    missing = [name for name in metric._defaults if name not in tree]
+    missing = [name for name in metric._defaults if name not in tree and name != "_health_counts"]
     if missing:
         held = sorted(k for k in tree if not k.startswith("_"))
         raise KeyError(
@@ -102,6 +107,13 @@ def restore_metric_state_pytree(metric: Metric, tree: Dict[str, Any]) -> Metric:
         )
     restored: Dict[str, Any] = {}
     for name in metric._defaults:
+        if name == "_health_counts" and name not in tree:
+            # telemetry counters are the one state allowed to be absent: a
+            # checkpoint saved before health screening existed (or from a
+            # 'propagate' twin) restores with zeroed counters instead of
+            # failing the whole restore
+            restored[name] = jnp.zeros_like(metric._defaults[name])
+            continue
         value = tree[name]
         default = metric._defaults[name]
         is_list_value = tree.get(f"_{name}_is_list", False) or isinstance(value, dict)
@@ -116,6 +128,11 @@ def restore_metric_state_pytree(metric: Metric, tree: Dict[str, Any]) -> Metric:
             restored[name] = [jnp.asarray(v) for _, v in items]
             continue
         arr = jnp.asarray(value)
+        if name == "_health_counts" and arr.shape != default.shape:
+            # slot-layout drift across versions: zeroed telemetry beats a
+            # failed restore of real metric state
+            restored[name] = jnp.zeros_like(default)
+            continue
         if arr.shape != default.shape:
             raise ValueError(
                 f"State {name!r} of {cls} has registered default shape"
@@ -145,8 +162,17 @@ def restore_metric_state_pytree(metric: Metric, tree: Dict[str, Any]) -> Metric:
     # bind only after EVERY state validated — a failed restore must not leave
     # the metric half-overwritten
     metric._update_count = int(np.asarray(tree["_update_count"]))
+    if "_health_screened" in tree and hasattr(metric, "_health_stats"):
+        metric._health_stats["batches_screened"] = int(np.asarray(tree["_health_screened"]))
     for name, value in restored.items():
         setattr(metric, name, value)
+    if "_health_counts" in restored:
+        # re-sync the 'raise'-policy host mirrors with the restored device
+        # counters, or the next update spuriously raises (counter above
+        # mirror) / silently skips (mirror above counter)
+        from metrics_tpu.resilience import health as _health
+
+        _health.reset_seen_mirrors(metric, np.asarray(restored["_health_counts"]))
     for attr, value in restored_dyn.items():
         setattr(metric, attr, value)
     metric._computed = None

@@ -42,6 +42,20 @@ class SyncIntegrityError(SyncError):
         self.transient = transient
 
 
+class NumericalHealthError(RuntimeError):
+    """A numerical-health policy violation surfaced by the screening layer.
+
+    Raised host-side (never inside a traced program) when a metric with
+    ``on_bad_input='raise'`` observes non-finite input (the contaminated
+    update is quarantined in-trace first, so the accumulated state stays
+    clean), or when its ``compute()`` result is non-finite. Subclasses
+    ``RuntimeError`` so the reference aggregation ``nan_strategy='error'``
+    call sites (``except RuntimeError``) keep working. The message carries
+    the metric class, the update index where detection happened, and the
+    NaN vs ±Inf element counts from :meth:`~metrics_tpu.Metric.health_report`.
+    """
+
+
 class JitIncompatibleError(ValueError):
     """Raised when an operation is inherently data-dependent and cannot run
     under jit tracing (e.g. inferring ``num_classes`` from label values).

@@ -1,0 +1,620 @@
+"""Numerical-health containment: screening policies, parity, telemetry.
+
+Covers the ISSUE 3 acceptance surface: non-finite parity under 'propagate'
+(including bitwise agreement with the torch reference), 'skip'/'mask' leaving
+state bit-identical to never having seen the bad data, jit/scan
+compatibility of the ported aggregation ``nan_strategy``, determinism and
+zero-retrace guarantees, overflow saturation sentinels, Kahan opt-in, and
+``health_report()`` / checkpoint round-trips.
+"""
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    MaxMetric,
+    MeanMetric,
+    MeanSquaredError,
+    MetricCollection,
+    NumericalHealthError,
+    SumMetric,
+)
+from metrics_tpu.ops.safe_ops import kahan_add, safe_divide, saturating_add
+from metrics_tpu.resilience import health
+from metrics_tpu.utils.checkpoint import metric_state_pytree, restore_metric_state_pytree
+from tests.helpers import seed_all
+
+seed_all(7)
+
+REFERENCE = pathlib.Path("/root/reference")
+
+
+def _nan_batch(rng, n=12, num_classes=3, bad_rows=(2, 5), bad_value=np.nan):
+    preds = rng.rand(n, num_classes).astype(np.float32)
+    target = (np.arange(n) % num_classes).astype(np.int64)
+    for r in bad_rows:
+        preds[r, r % num_classes] = bad_value
+    return preds, target
+
+
+# ---------------------------------------------------------------------------
+# construction / defaults
+# ---------------------------------------------------------------------------
+def test_invalid_policy_rejected():
+    with pytest.raises(ValueError, match="on_bad_input"):
+        Accuracy(on_bad_input="quarantine")
+
+
+def test_propagate_registers_no_health_state():
+    m = Accuracy()
+    assert health.HEALTH_STATE not in m._defaults
+    report = m.health_report()
+    assert report["on_bad_input"] == "propagate"
+    assert report["nan_count"] == 0 and report["updates_quarantined"] == 0
+
+
+def test_policy_metrics_register_sum_state():
+    m = Accuracy(on_bad_input="skip")
+    assert health.HEALTH_STATE in m._defaults
+    assert m._reductions[health.HEALTH_STATE] == "sum"
+
+
+# ---------------------------------------------------------------------------
+# skip / mask bit-identity: contaminated stream == stream without the bad data
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("bad_value", [np.nan, np.inf, -np.inf])
+def test_skip_state_bit_identical_classification(bad_value):
+    rng = np.random.RandomState(0)
+    clean1 = _nan_batch(rng, bad_rows=())
+    bad = _nan_batch(rng, bad_rows=(1, 4), bad_value=bad_value)
+    clean2 = _nan_batch(rng, bad_rows=())
+
+    screened = Accuracy(num_classes=3, on_bad_input="skip")
+    witness = Accuracy(num_classes=3)
+    for p, t in (clean1, bad, clean2):
+        screened.update(jnp.asarray(p), jnp.asarray(t))
+    for p, t in (clean1, clean2):
+        witness.update(jnp.asarray(p), jnp.asarray(t))
+    for name in witness._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(screened, name)), np.asarray(getattr(witness, name)), err_msg=name
+        )
+    assert float(screened.compute()) == float(witness.compute())
+    report = screened.health_report()
+    assert report["updates_quarantined"] == 1
+    assert report["batches_screened"] == 3
+    expected_key = "nan_count" if np.isnan(bad_value) else "inf_count"
+    assert report[expected_key] == 2
+
+
+def test_mask_state_bit_identical_regression():
+    rng = np.random.RandomState(1)
+    preds = rng.rand(16).astype(np.float32)
+    target = rng.rand(16).astype(np.float32)
+    bad_rows = np.array([3, 9])
+    preds_bad = preds.copy()
+    preds_bad[bad_rows] = np.nan
+
+    screened = MeanSquaredError(on_bad_input="mask")
+    screened.update(jnp.asarray(preds_bad), jnp.asarray(target))
+    witness = MeanSquaredError()
+    keep = np.ones(16, bool)
+    keep[bad_rows] = False
+    witness.update(jnp.asarray(preds[keep]), jnp.asarray(target[keep]))
+    np.testing.assert_array_equal(np.asarray(screened.total), np.asarray(witness.total))
+    np.testing.assert_allclose(
+        np.asarray(screened.sum_squared_error), np.asarray(witness.sum_squared_error), rtol=1e-6
+    )
+    assert screened.health_report()["rows_masked"] == 2
+
+
+def test_mask_joint_row_drop_mean_metric_weighted():
+    # a NaN in EITHER lane must drop the (value, weight) pair, like the
+    # reference's joint boolean filter
+    m = MeanMetric(nan_strategy="ignore")
+    value = jnp.asarray([1.0, np.nan, 3.0, 5.0])
+    weight = jnp.asarray([1.0, 2.0, np.nan, 4.0])
+    m.update(value, weight)
+    expected = (1.0 * 1.0 + 5.0 * 4.0) / (1.0 + 4.0)
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-6)
+    assert m.health_report()["rows_masked"] == 2
+
+
+def test_mask_non_additive_falls_back_to_eager_filtering():
+    # MaxMetric's state is max-reduced (not row-additive): mask routes the
+    # instance statically to eager dispatch, where rows filter concretely —
+    # it never touches the shared compile cache (a cache hit would skip the
+    # concrete filtering)
+    m = MaxMetric(nan_strategy="error", on_bad_input="mask")
+    m.update(jnp.asarray([1.0, np.nan, 5.0]))
+    stats = m.compile_stats()
+    assert stats["compiles"] == 0 and stats["cache_hits"] == 0
+    assert float(m.compute()) == 5.0
+    assert m.health_report()["rows_masked"] == 1
+
+
+def test_scalar_contamination_quarantines_under_mask():
+    # no batch axis to mask along -> the whole update is quarantined
+    m = SumMetric(nan_strategy="ignore")
+    m.update(jnp.asarray(2.0))
+    m.update(jnp.asarray(float("nan")))
+    m.update(jnp.asarray(3.0))
+    assert float(m.compute()) == 5.0
+    assert m.health_report()["updates_quarantined"] == 1
+
+
+# ---------------------------------------------------------------------------
+# raise policy
+# ---------------------------------------------------------------------------
+def test_raise_quarantines_then_raises_precisely():
+    m = MeanSquaredError(on_bad_input="raise")
+    m.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 1.0]))
+    with pytest.raises(NumericalHealthError, match=r"update #2.*1 NaN and 1 ±Inf"):
+        m.update(jnp.asarray([np.nan, np.inf]), jnp.asarray([1.0, 1.0]))
+    # the contaminated update was quarantined in-trace: state stays clean
+    assert float(m.compute()) == 0.5
+    # a later clean update must not re-raise the old quarantine
+    m.update(jnp.asarray([3.0]), jnp.asarray([1.0]))
+    assert m.health_report()["updates_quarantined"] == 1
+
+
+def test_raise_policy_unconfused_by_forward_dance():
+    # forward()'s batch-local state dance must not desync the per-dispatch
+    # quarantine detection: a raise, then a clean forward, then clean
+    # updates must not spuriously raise — and a later bad batch still must
+    m = MeanSquaredError(on_bad_input="raise")
+    with pytest.raises(NumericalHealthError):
+        m.update(jnp.asarray([np.nan]), jnp.asarray([1.0]))
+    m(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 2.0]))  # clean forward
+    m.update(jnp.asarray([3.0]), jnp.asarray([3.0]))  # clean update
+    with pytest.raises(NumericalHealthError):
+        m.update(jnp.asarray([np.inf]), jnp.asarray([1.0]))
+    assert float(m.compute()) == 0.0
+
+
+def test_error_strategy_admits_legitimate_inf_results():
+    # legacy semantics: nan_strategy screens NaN only — a running max of
+    # inf (or an inf mean) is data, not a health event
+    m = MaxMetric(nan_strategy="error")
+    m.update(jnp.asarray([1.0, np.inf]))
+    assert np.isposinf(float(m.compute()))
+
+
+def test_warn_strategy_sum_mean_warn_like_reference():
+    for cls, expected in ((SumMetric, 4.0), (MeanMetric, 2.0)):
+        with pytest.warns(UserWarning, match="Will be removed"):
+            m = cls()  # default nan_strategy='warn'
+            m.update(jnp.asarray([1.0, np.nan, 3.0]))
+        assert float(m.compute()) == expected
+
+
+def test_pre_health_checkpoint_restores_with_zeroed_counters():
+    # a checkpoint saved without health state (propagate twin / older
+    # version) must restore into a screened instance, counters zeroed
+    src = MeanSquaredError()
+    src.update(jnp.asarray([1.0, 3.0]), jnp.asarray([1.0, 1.0]))
+    tree = metric_state_pytree(src)
+    dst = MeanSquaredError(on_bad_input="skip")
+    restore_metric_state_pytree(dst, tree)
+    assert float(dst.compute()) == float(src.compute())
+    assert dst.health_report()["updates_quarantined"] == 0
+
+
+def test_raise_policy_survives_reset():
+    # reset() zeroes the device counters; the host mirrors must follow, or
+    # the next quarantine is silently swallowed
+    m = MeanSquaredError(on_bad_input="raise")
+    with pytest.raises(NumericalHealthError):
+        m.update(jnp.asarray([np.nan]), jnp.asarray([1.0]))
+    m.reset()
+    with pytest.raises(NumericalHealthError):
+        m.update(jnp.asarray([np.nan]), jnp.asarray([1.0]))
+
+
+def test_raise_policy_survives_checkpoint_restore():
+    src = MeanSquaredError(on_bad_input="raise")
+    with pytest.raises(NumericalHealthError):
+        src.update(jnp.asarray([np.nan]), jnp.asarray([1.0]))
+    tree = metric_state_pytree(src)
+    dst = MeanSquaredError(on_bad_input="raise")
+    restore_metric_state_pytree(dst, tree)
+    # restored counters sit above the fresh instance's mirrors: a clean
+    # update must NOT spuriously raise ...
+    dst.update(jnp.asarray([1.0]), jnp.asarray([1.0]))
+    # ... and a genuinely contaminated one still must
+    with pytest.raises(NumericalHealthError):
+        dst.update(jnp.asarray([np.inf]), jnp.asarray([1.0]))
+
+
+def test_collection_raise_members_all_sync_before_error():
+    mc = MetricCollection(
+        {
+            "a": Accuracy(num_classes=3, on_bad_input="raise"),
+            "b": Accuracy(num_classes=3, on_bad_input="raise", top_k=2),
+        }
+    )
+    rng = np.random.RandomState(8)
+    p, t = _nan_batch(rng, bad_rows=(1,))
+    with pytest.raises(NumericalHealthError):
+        mc.update(jnp.asarray(p), jnp.asarray(t))
+    # every member's mirrors synced despite the raise: clean updates proceed
+    clean, t2 = _nan_batch(rng, bad_rows=())
+    mc.update(jnp.asarray(clean), jnp.asarray(t2))
+    assert mc.health_report()["updates_quarantined"] == 2
+
+
+def test_aggregator_masking_immune_to_jit_bucket():
+    # the flatten prescreen redefines the batch axis, so bucketing must not
+    # engage for screened aggregators — same result with and without it
+    for bucket in (None, "pow2"):
+        s = SumMetric(nan_strategy="ignore", jit_bucket=bucket)
+        s.update(jnp.asarray([[1.0, np.nan], [3.0, 4.0]]))
+        assert float(s.compute()) == 8.0, (bucket, float(s.compute()))
+        assert s.compile_stats()["bucketed_calls"] == 0 or bucket is None
+
+
+def test_cat_metric_keeps_legacy_element_filter():
+    from metrics_tpu import CatMetric
+
+    cat = CatMetric(nan_strategy="ignore")
+    cat.update(jnp.asarray([[1.0, np.nan], [3.0, 4.0]]))
+    np.testing.assert_array_equal(np.asarray(cat.compute()), [1.0, 3.0, 4.0])
+    clean = CatMetric(nan_strategy="ignore")
+    clean.update(jnp.asarray([[1.0, 2.0], [3.0, 4.0]]))  # clean keeps shape
+    assert np.asarray(clean.compute()).shape == (2, 2)
+
+
+def test_raise_on_nonfinite_compute_result():
+    # 0/0 -> NaN result: flagged even under the aggregators' nan-only
+    # screening (a ±inf result would be data — see
+    # test_error_strategy_admits_legitimate_inf_results)
+    m = MeanMetric(nan_strategy="error")
+    m.update(jnp.asarray([1.0, 1.0]), weight=jnp.asarray([1.0, -1.0]))  # both sums are 0
+    with pytest.raises(NumericalHealthError, match="non-finite"):
+        m.compute()
+
+
+def test_raise_matches_legacy_aggregation_contract():
+    # the reference raised RuntimeError("Encountered `nan` values ...")
+    m = SumMetric(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="Encountered `nan` values"):
+        m.update(jnp.asarray([1.0, float("nan")]))
+
+
+# ---------------------------------------------------------------------------
+# legacy nan_strategy alias: jit/scan compatibility
+# ---------------------------------------------------------------------------
+def test_nan_ignore_stays_jitted():
+    m = SumMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, np.nan, 3.0]))
+    m.update(jnp.asarray([2.0, 2.0, np.nan]))
+    assert not m._jit_failed
+    assert float(m.compute()) == 8.0
+    assert m.health_report()["rows_masked"] == 2
+
+
+def test_nan_ignore_under_user_jit_and_scan():
+    m = SumMetric(nan_strategy="ignore")
+
+    @jax.jit
+    def epoch(state, batches):
+        def body(st, v):
+            return m.update_state(st, v), None
+
+        return jax.lax.scan(body, state, batches)[0]
+
+    batches = jnp.asarray([[1.0, np.nan, 3.0], [2.0, 2.0, 2.0], [np.nan, np.nan, 1.0]])
+    state = epoch(m.init_state(), batches)
+    assert float(state["value"]) == 11.0
+    counts = np.asarray(state[health.HEALTH_STATE])
+    assert counts[health.SLOT_MASKED] == 3 and counts[health.SLOT_NAN] == 3
+
+
+def test_inf_is_data_for_aggregators():
+    # legacy nan_strategy semantics: only NaN is screened, ±inf flows through
+    m = SumMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, np.inf]))
+    assert np.isposinf(float(m.compute()))
+    assert m.health_report()["rows_masked"] == 0
+
+
+def test_max_min_nan_removal_is_branchless_jitted():
+    m = MaxMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, np.nan, 5.0]))
+    assert not m._jit_failed
+    assert float(m.compute()) == 5.0
+
+
+def test_nan_removal_is_element_wise_for_rank2_values():
+    # the reference's boolean filter flattens: only the NaN ELEMENT is
+    # dropped from a rank-2 value, never its whole row
+    s = SumMetric(nan_strategy="ignore")
+    s.update(jnp.asarray([[1.0, np.nan], [2.0, 3.0]]))
+    assert float(s.compute()) == 6.0
+    assert not s._jit_failed  # the flatten prescreen keeps the compiled path
+    assert s.health_report()["rows_masked"] == 1  # one element masked
+
+    m = MeanMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([[1.0, np.nan], [2.0, 3.0]]))
+    assert float(m.compute()) == 2.0
+
+
+def test_max_warn_strategy_warns_on_removal():
+    # reference contract: 'warn' (the Max/Min default) warns when NaNs are
+    # removed — the warn contract statically routes to the eager path,
+    # which can and does warn
+    with pytest.warns(UserWarning, match="Will be removed"):
+        m = MaxMetric()
+        m.update(jnp.asarray([1.0, np.nan, 5.0]))
+    assert float(m.compute()) == 5.0
+
+
+def test_warn_instance_never_shares_compiled_mask_program():
+    # an explicit-mask instance compiles first; the legacy-'warn' twin with
+    # the same shapes must NOT ride that cached program (a cache hit would
+    # silently skip its warn-at-removal contract)
+    a = SumMetric(on_bad_input="mask")
+    a.update(jnp.asarray([1.0, 2.0, 3.0]))
+    with pytest.warns(UserWarning, match="Will be removed"):
+        b = SumMetric()  # default 'warn'
+        b.update(jnp.asarray([1.0, np.nan, 3.0]))
+    assert float(b.compute()) == 4.0
+    assert b.compile_stats()["cache_hits"] == 0
+
+
+def test_one_eager_policy_member_does_not_break_collection_fusion():
+    mc = MetricCollection(
+        {
+            "mx": MaxMetric(nan_strategy="error", on_bad_input="mask"),  # forces eager
+            "acc": Accuracy(num_classes=3),
+            "acc2": Accuracy(num_classes=3, top_k=2),
+        }
+    )
+    rng = np.random.RandomState(9)
+    p = jnp.asarray(rng.rand(8, 3).astype(np.float32))
+    t = jnp.asarray(np.arange(8) % 3)
+    mc.update(preds=p, target=t, value=jnp.asarray([1.0, 2.0]))
+    assert not mc._fused_failed
+    assert set(mc._fused_keys) == {"acc", "acc2"}  # fusion survives, minus the eager member
+
+
+def test_empty_stream_compute_keeps_identity_under_error():
+    # compute() before any update returns the state default (-inf identity)
+    # with the usual warning — never a NumericalHealthError
+    with pytest.warns(UserWarning, match="before the ``update``"):
+        v = MaxMetric(nan_strategy="error").compute()
+    assert np.isneginf(float(v))
+
+
+# ---------------------------------------------------------------------------
+# determinism + zero additional retraces
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("policy", ["skip", "mask"])
+def test_policies_deterministic_and_no_retrace(policy):
+    from metrics_tpu import engine
+
+    def run(pol):
+        engine.clear_cache()
+        rng = np.random.RandomState(3)
+        m = MeanSquaredError(on_bad_input=pol)
+        for i in range(5):
+            p = rng.rand(8).astype(np.float32)
+            if i % 2:
+                p[rng.randint(8)] = np.inf
+            m.update(jnp.asarray(p), jnp.asarray(rng.rand(8).astype(np.float32)))
+        rep = m.health_report()
+        return (
+            float(m.compute()),
+            rep["rows_masked"],
+            rep["updates_quarantined"],
+            rep["inf_count"],
+            m.compile_stats()["retraces"],
+        )
+
+    first, second = run(policy), run(policy)
+    assert first == second  # same contaminated stream -> identical everything
+    # zero ADDITIONAL retraces vs screening disabled: the screened program
+    # retraces exactly as often as the propagate baseline (the one
+    # weak->strong state-aval promotion after the first update)
+    assert first[-1] == run("propagate")[-1]
+
+
+# ---------------------------------------------------------------------------
+# overflow sentinels + Kahan opt-in
+# ---------------------------------------------------------------------------
+def test_saturating_add_unit():
+    acc = jnp.asarray([2**31 - 3, 5], dtype=jnp.int32)
+    out, overflowed = saturating_add(acc, jnp.asarray([10, 1], dtype=jnp.int32))
+    assert bool(overflowed)
+    assert int(out[0]) == 2**31 - 1  # pegged, not wrapped negative
+    assert int(out[1]) == 6
+    out2, ov2 = saturating_add(out, jnp.asarray([0, 1], dtype=jnp.int32))
+    assert not bool(ov2) and int(out2[0]) == 2**31 - 1
+
+
+def test_stat_scores_saturation_sentinel():
+    m = Accuracy(num_classes=3, on_bad_input="skip")
+    p = jnp.asarray(np.random.RandomState(0).rand(6, 3).astype(np.float32))
+    t = jnp.asarray(np.arange(6) % 3)
+    m.update(p, t)
+    # push an accumulator to the brink, then update again: it must peg at
+    # the dtype max (visible sentinel) and count an overflow event
+    info_max = jnp.iinfo(m.tp.dtype).max
+    m.tn = jnp.full_like(m.tn, info_max - 1)
+    m.update(p, t)
+    assert int(np.asarray(m.tn)) == int(info_max)
+    assert m.health_report()["overflow_events"] == 1
+
+
+def test_kahan_add_unit():
+    total, comp = jnp.float32(0.0), jnp.float32(0.0)
+    naive = np.float32(0.0)
+    big, tiny = np.float32(1e8), np.float32(1.0)
+    total, comp = kahan_add(total, comp, big)
+    naive += big
+    for _ in range(100):
+        total, comp = kahan_add(total, comp, tiny)
+        naive += tiny
+    # the compensated sum lands on the float32 nearest to the true value;
+    # the naive f32 sum absorbs every 1.0 into 1e8's ulp and stays at 1e8
+    exact_f32 = float(np.float32(1e8 + 100.0))
+    assert float(total) == exact_f32
+    assert abs(float(total) - (1e8 + 100.0)) < abs(float(naive) - (1e8 + 100.0))
+
+
+def test_compensated_sum_metric_beats_naive_float32():
+    values = [np.float32(1e8)] + [np.float32(0.5)] * 256
+    plain, comp = SumMetric(), SumMetric(compensated=True)
+    for v in values:
+        plain.update(jnp.float32(v))
+        comp.update(jnp.float32(v))
+    exact = 1e8 + 128.0
+    assert abs(float(comp.compute()) - exact) <= abs(float(plain.compute()) - exact)
+    assert float(comp.compute()) == exact
+
+
+def test_compensated_mse_matches_float64_oracle():
+    rng = np.random.RandomState(5)
+    preds = rng.rand(64, 32).astype(np.float32) * 100
+    target = rng.rand(64, 32).astype(np.float32)
+    m = MeanSquaredError(compensated=True)
+    for p, t in zip(preds, target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    oracle = np.mean((preds.astype(np.float64) - target.astype(np.float64)) ** 2)
+    np.testing.assert_allclose(float(m.compute()), oracle, rtol=1e-6)
+
+
+def test_safe_divide_zero_over_zero():
+    out = safe_divide(jnp.asarray([0.0, 2.0]), jnp.asarray([0.0, 4.0]))
+    np.testing.assert_array_equal(np.asarray(out), [0.0, 0.5])
+
+
+# ---------------------------------------------------------------------------
+# reports: collections, clones, fused parity
+# ---------------------------------------------------------------------------
+def test_collection_health_report_aggregates_and_fused_counts_match():
+    def build():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=3, on_bad_input="skip"),
+                "mse_like": Accuracy(num_classes=3, on_bad_input="skip", top_k=1),
+            }
+        )
+
+    rng = np.random.RandomState(2)
+    batches = [_nan_batch(rng, bad_rows=()), _nan_batch(rng, bad_rows=(0,)), _nan_batch(rng, bad_rows=())]
+
+    fused = build()
+    unfused = build()
+    unfused._fused_failed = True  # force per-member dispatch
+    for p, t in batches:
+        fused.update(jnp.asarray(p), jnp.asarray(t))
+        unfused.update(jnp.asarray(p), jnp.asarray(t))
+
+    fr, ur = fused.health_report(), unfused.health_report()
+    for key in ("nan_count", "updates_quarantined", "rows_masked", "batches_screened"):
+        assert fr[key] == ur[key], key
+    assert fr["updates_quarantined"] == 2  # one per member
+    assert set(fr["members"]) == {"acc", "mse_like"}
+
+    # a clone carries the accumulated health counters (they are state) and
+    # keeps counting independently of the original
+    clone = fused.clone()
+    for p, t in batches:
+        clone.update(jnp.asarray(p), jnp.asarray(t))
+    assert clone.health_report()["updates_quarantined"] == 4
+    assert fused.health_report()["updates_quarantined"] == 2
+
+
+def test_forward_merges_health_counts():
+    m = Accuracy(num_classes=3, on_bad_input="skip")
+    rng = np.random.RandomState(4)
+    p, t = _nan_batch(rng, bad_rows=(1,))
+    m(jnp.asarray(p), jnp.asarray(t))  # forward path
+    assert m.health_report()["updates_quarantined"] == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip
+# ---------------------------------------------------------------------------
+def test_health_counters_checkpoint_round_trip():
+    rng = np.random.RandomState(6)
+    m = Accuracy(num_classes=3, on_bad_input="skip")
+    for bad in ((), (2,), ()):
+        p, t = _nan_batch(rng, bad_rows=bad)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    before = m.health_report()
+    tree = metric_state_pytree(m)
+    fresh = Accuracy(num_classes=3, on_bad_input="skip")
+    restore_metric_state_pytree(fresh, tree)
+    after = fresh.health_report()
+    for key in (
+        "nan_count",
+        "inf_count",
+        "rows_masked",
+        "updates_quarantined",
+        "overflow_events",
+        "batches_screened",
+    ):
+        assert after[key] == before[key], key
+    np.testing.assert_array_equal(
+        np.asarray(getattr(fresh, health.HEALTH_STATE)),
+        np.asarray(getattr(m, health.HEALTH_STATE)),
+    )
+
+
+def test_reset_clears_device_counters():
+    m = MeanSquaredError(on_bad_input="skip")
+    m.update(jnp.asarray([np.nan]), jnp.asarray([1.0]))
+    assert m.health_report()["updates_quarantined"] == 1
+    m.reset()
+    rep = m.health_report()
+    assert rep["updates_quarantined"] == 0
+    assert rep["batches_screened"] == 1  # host counter is lifetime
+
+
+# ---------------------------------------------------------------------------
+# torch-reference parity: NaN-laced streams under 'propagate'
+# ---------------------------------------------------------------------------
+@pytest.mark.skipif(
+    not (REFERENCE / "torchmetrics").is_dir(), reason="reference checkout not present"
+)
+class TestNonFiniteReferenceParity:
+    def test_accuracy_propagate_bitwise(self, tm):
+        import torch
+
+        rng = np.random.RandomState(11)
+        ours, ref = Accuracy(num_classes=3), tm.Accuracy(num_classes=3)
+        for bad in ((), (1, 3), ()):
+            p, t = _nan_batch(rng, bad_rows=bad)
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(torch.from_numpy(p), torch.from_numpy(t))
+        np.testing.assert_array_equal(
+            np.asarray(ours.compute(), np.float64),
+            np.asarray(ref.compute().numpy(), np.float64),
+        )
+
+    def test_mse_propagate_bitwise_nan(self, tm):
+        import torch
+
+        ours, ref = MeanSquaredError(), tm.MeanSquaredError()
+        p = np.asarray([1.0, np.nan, 3.0], np.float32)
+        t = np.asarray([1.0, 2.0, 2.0], np.float32)
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.from_numpy(p), torch.from_numpy(t))
+        o, r = float(ours.compute()), float(ref.compute())
+        assert np.isnan(o) and np.isnan(r)  # both propagate the contamination
+
+    @pytest.mark.parametrize("strategy", ["ignore", 0.0, 2.5])
+    def test_aggregation_nan_strategy_parity(self, tm, strategy):
+        import torch
+
+        ours, ref = SumMetric(nan_strategy=strategy), tm.SumMetric(nan_strategy=strategy)
+        batch = np.asarray([1.0, np.nan, 3.0], np.float32)
+        ours.update(jnp.asarray(batch))
+        ref.update(torch.from_numpy(batch))
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()))
