@@ -72,4 +72,31 @@ print("health smoke OK:", line)
 }
 health_smoke || { echo "health smoke attempt 1 failed; retrying once"; health_smoke; }
 
+echo "=== observability smoke (bus parity, disabled-path overhead, JSONL schema) ==="
+# the parity/schema assertions must hold on EVERY attempt; the timing gate
+# gets one retry, same rationale as the health smoke
+obs_smoke() {
+JAX_PLATFORMS=cpu python bench.py --obs-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "obs_smoke", obj
+# enabling the event bus changes no compiled program: identical sequence,
+# identical compile/retrace counters with the bus on vs off
+assert obj["bus_parity_ok"] is True, f"bus on/off compile counters diverged: {obj}"
+assert obj["compiles_bus_on"] == obj["compiles_bus_off"], obj
+assert obj["retraces_bus_on"] == obj["retraces_bus_off"], obj
+# every retrace event names the changed cache-key component
+assert obj["retrace_events"] > 0 and obj["retraces_explained"] is True, obj
+# the fault-injection run exports a schema-valid JSONL covering the sync kinds
+assert obj["jsonl_valid"] is True and obj["jsonl_events"] > 0, obj
+for kind in ("sync_attempt", "sync_retry", "sync_degrade", "quarantine"):
+    assert kind in obj["jsonl_kinds"], f"missing {kind} in exported JSONL: {obj}"
+# instrumentation guards on the headline update path, observability off, < 2%
+assert obj["value"] < 2.0, "disabled-path overhead %s%% >= 2%%: %s" % (obj["value"], obj)
+print("obs smoke OK:", line)
+'
+}
+obs_smoke || { echo "obs smoke attempt 1 failed; retrying once"; obs_smoke; }
+
 echo "both lanes green"

@@ -24,9 +24,11 @@ import jax.numpy as jnp
 from metrics_tpu.engine import bucketing as _bucketing
 from metrics_tpu.engine import cache as _engine
 from metrics_tpu.metric import _JIT_FALLBACK_ERRORS, Metric
+from metrics_tpu.obs import trace as _obs_trace
+from metrics_tpu.obs.warn import instance_token as _warn_instance_token
+from metrics_tpu.obs.warn import warn_once
 from metrics_tpu.resilience import health as _health
 from metrics_tpu.utils.exceptions import NumericalHealthError
-from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class MetricCollection:
@@ -71,6 +73,7 @@ class MetricCollection:
         postfix: Optional[str] = None,
     ) -> None:
         self._modules: "OrderedDict[str, Metric]" = OrderedDict()
+        self._warn_token = _warn_instance_token()  # per-instance warn_once keys
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
         # compiled fused programs live in the process-wide engine cache,
@@ -102,6 +105,12 @@ class MetricCollection:
         """Every member's ``forward`` (reference ``collections.py:106-112``),
         with fast-path members fused into ONE compiled program computing each
         batch value and merged accumulator state per step."""
+        if not _obs_trace.active():
+            return self._forward_impl(*args, **kwargs)
+        with _obs_trace.span("forward", "MetricCollection"):
+            return self._forward_impl(*args, **kwargs)
+
+    def _forward_impl(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         was_failed = self._fused_fwd_failed
         fused_vals = self._fused_forward(args, kwargs)
         out: Dict[str, Any] = {}
@@ -122,6 +131,13 @@ class MetricCollection:
         return self.forward(*args, **kwargs)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
+        if not _obs_trace.active():
+            self._update_members(*args, **kwargs)
+            return
+        with _obs_trace.span("update", "MetricCollection"):
+            self._update_members(*args, **kwargs)
+
+    def _update_members(self, *args: Any, **kwargs: Any) -> None:
         was_failed = self._fused_failed
         done = self._fused_update(args, kwargs)
         try:
@@ -268,6 +284,9 @@ class MetricCollection:
                 )
             else:
                 leaves, treedef, batched, pad = spec
+                _bucketing.emit_bucket_event(
+                    "fused_update", int(leaves[batched[0]].shape[0]), int(pad)
+                )
                 padded = _bucketing.pad_leaves(leaves, batched, pad)
                 new_states = entry.invoke(
                     "bucketed",
@@ -322,6 +341,12 @@ class MetricCollection:
         jit-compatible members evaluated in ONE compiled program and fetched
         together — `compute()` latency is one dispatch + one host round-trip
         instead of one per member."""
+        if not _obs_trace.active():
+            return self._compute_members()
+        with _obs_trace.span("compute", "MetricCollection"):
+            return self._compute_members()
+
+    def _compute_members(self) -> Dict[str, Any]:
         fused_vals = self._fused_compute()
         out: Dict[str, Any] = {}
         for base, m in self._modules.items():
@@ -374,15 +399,18 @@ class MetricCollection:
             return {}
         members = [self._modules[k] for k in keys]
         states = {k: m._snapshot_state() for k, m in zip(keys, members)}
-        for m in members if _warn else ():  # warn BEFORE computing, like the
-            # wrapped per-member path; suppressed on the offender-exclusion
-            # retry, which already warned for every member this call
+        for k, m in zip(keys, members) if _warn else ():  # warn BEFORE
+            # computing, like the wrapped per-member path; suppressed on the
+            # offender-exclusion retry, which already warned for every member
+            # this call. Keyed per member SLOT (not class): two same-class
+            # members are distinct metrics and each gets its one warning.
             if m._update_count == 0:
-                rank_zero_warn(
+                warn_once(
                     f"The ``compute`` method of metric {m.__class__.__name__}"
                     " was called before the ``update`` method which may lead to errors,"
                     " as metric states have not yet been updated.",
                     UserWarning,
+                    key=("compute_before_update", self._warn_token, k),
                 )
 
         try:
@@ -554,7 +582,7 @@ class MetricCollection:
             for m in additional_metrics:
                 (metrics if isinstance(m, Metric) else remain).append(m)
             if remain:
-                rank_zero_warn(
+                warn_once(
                     f"You have passes extra arguments {remain} which are not Metrics and will be ignored."
                 )
         elif additional_metrics:
@@ -614,6 +642,14 @@ class MetricCollection:
         state["_fused_cmp_fn"] = None
         return state
 
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        # warn dedup identity is per-instance and process-local: a deepcopy
+        # must not share the original's dedup history, and an unpickled
+        # token could collide with one already issued in this process
+        # (same contract as Metric.__setstate__)
+        self._warn_token = _warn_instance_token()
+
     def compile_stats(self) -> Dict[str, Any]:
         """Compile telemetry for this collection's fused dispatches, plus each
         member's own counters (members also accumulate through their
@@ -622,12 +658,10 @@ class MetricCollection:
         out["members"] = {k: m.compile_stats() for k, m in self._modules.items()}
         return out
 
-    def sync_report(self) -> Dict[str, Any]:
-        """Host-level sync telemetry: numeric counters summed across members
-        (each member syncs itself inside its own ``compute()``), the union of
-        last-sync missing ranks, and every member's full report under
-        ``members`` — the distributed mirror of :meth:`compile_stats`."""
-        members = {k: m.sync_report() for k, m in self._modules.items()}
+    @staticmethod
+    def _sync_aggregate(members: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+        """Cross-member sync aggregates from already-computed member reports
+        (numeric counters summed, last-sync missing ranks unioned)."""
         out: Dict[str, Any] = {}
         missing: set = set()
         for report in members.values():
@@ -636,6 +670,15 @@ class MetricCollection:
                     out[key] = out.get(key, 0) + value
             missing.update(report["missing_ranks"])
         out["missing_ranks"] = sorted(missing)
+        return out
+
+    def sync_report(self) -> Dict[str, Any]:
+        """Host-level sync telemetry: numeric counters summed across members
+        (each member syncs itself inside its own ``compute()``), the union of
+        last-sync missing ranks, and every member's full report under
+        ``members`` — the distributed mirror of :meth:`compile_stats`."""
+        members = {k: m.sync_report() for k, m in self._modules.items()}
+        out = self._sync_aggregate(members)
         out["members"] = members
         return out
 
@@ -647,14 +690,44 @@ class MetricCollection:
         counters inside the shared fused program, so the report is identical
         whether a member was fused or dispatched individually."""
         members = {k: m.health_report() for k, m in self._modules.items()}
+        out = self._health_aggregate(members)
+        out["members"] = members
+        return out
+
+    @staticmethod
+    def _health_aggregate(members: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+        """Cross-member health aggregates from already-computed member
+        reports (numeric counters summed, nonfinite-compute flags OR-ed)."""
         out: Dict[str, Any] = {}
         for report in members.values():
             for key, value in report.items():
                 if isinstance(value, (int, float)) and not isinstance(value, bool):
                     out[key] = out.get(key, 0) + value
         out["any_compute_nonfinite"] = any(r["last_compute_nonfinite"] for r in members.values())
-        out["members"] = members
         return out
+
+    def obs_snapshot(self) -> Dict[str, Any]:
+        """One nested dict of every telemetry surface for the whole
+        collection — the collection face of :func:`metrics_tpu.obs.snapshot`.
+
+        ``members`` maps each member key to that member's
+        :meth:`Metric.obs_snapshot` (whose ``compile``/``sync``/``health``
+        sections are bit-identical to the member's legacy reports);
+        ``fused_compile`` holds the collection's own fused-dispatch counters
+        (the non-``members`` half of :meth:`compile_stats`); ``sync`` and
+        ``health`` hold the cross-member aggregates the legacy collection
+        reports compute, derived from the member sections already in hand —
+        each member report (and its device-counter fetch) runs exactly once
+        per snapshot.
+        """
+        members = {k: m.obs_snapshot() for k, m in self._modules.items()}
+        return {
+            "class": "MetricCollection",
+            "fused_compile": dict(self._compile_stats),
+            "sync": self._sync_aggregate({k: s["sync"] for k, s in members.items()}),
+            "health": self._health_aggregate({k: s["health"] for k, s in members.items()}),
+            "members": members,
+        }
 
     @staticmethod
     def _check_arg(arg: Optional[str], name: str) -> Optional[str]:

@@ -51,7 +51,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.obs import bus as _bus
+
 Array = jax.Array
+
+
+def emit_bucket_event(source: str, batch: int, pad: int) -> None:
+    """Record one bucketed-dispatch decision on the event bus (no-op while
+    the bus is disabled). Called by the engine and the fused collection
+    update right before padding, so the event stream shows which batch
+    landed in which pow2 bucket and how many pad rows it cost."""
+    if _bus.enabled():
+        _bus.emit("bucketed", source=source, batch=batch, pad=pad, bucket=batch + pad)
 
 #: spec = (leaves, treedef, batched_leaf_indices, pad_count)
 BucketSpec = Tuple[List[Any], Any, Tuple[int, ...], int]

@@ -46,6 +46,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.engine import bucketing
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.obs import explain as _explain
 from metrics_tpu.resilience import health as _health
 
 Array = jax.Array
@@ -263,6 +265,10 @@ class SharedEntry:
         self._build: Optional[Callable[[bool], None]] = None
         self._pins = pins  # objects whose id() participates in the key
         self.last_used = 0  # LRU tick, maintained by _get_or_create
+        # last dispatch signature per variant, for the retrace explainer
+        # (metrics_tpu.obs.explain) — populated only while the event bus is
+        # recording, scoped to the entry so eviction forgets history with it
+        self._obs_sigs: Dict[str, Dict[str, Any]] = {}
         # the calling instance/member-list is bound per call and read by the
         # traced body — thread-LOCAL so concurrent dispatches through one
         # shared entry neither serialize nor trace against another thread's
@@ -306,6 +312,24 @@ class SharedEntry:
         # _nodonate wrappers share the same traced body
         base_variant = variant.replace("_nodonate", "")
         before_variant = self._variant_traces.get(base_variant, 0)
+        # observability context is captured up front (the cell is cleared in
+        # the finally below) and ONLY while the bus records — the disabled
+        # path pays a single bool read
+        obs_on = _bus.enabled()
+        obs_source = obs_screening = None
+        if obs_on:
+            if self.kind == "metric_update":
+                obs_source = type(cell).__name__
+                obs_screening = (
+                    getattr(cell, "on_bad_input", "propagate"),
+                    getattr(cell, "health_screen", "nonfinite"),
+                    getattr(cell, "jit_bucket", None),
+                )
+            else:
+                obs_source = self.kind
+                obs_screening = tuple(
+                    (type(m).__name__, getattr(m, "on_bad_input", "propagate")) for m in cell
+                )
         try:
             try:
                 out = self._fns[variant](*fn_args)
@@ -343,7 +367,55 @@ class SharedEntry:
                 self.bucketed_calls += 1
                 if stats is not None:
                     stats["bucketed_calls"] += 1
+            if obs_on:
+                self._obs_after_dispatch(
+                    variant, base_variant, before_variant, delta, obs_source, obs_screening, fn_args
+                )
             return out
+
+    def _obs_after_dispatch(
+        self,
+        variant: str,
+        base_variant: str,
+        before_variant: int,
+        delta: int,
+        source: str,
+        screening: Tuple,
+        fn_args: Tuple,
+    ) -> None:
+        """Emit compile/cache_hit/retrace events for one dispatch (bus known
+        enabled; caller holds the counter lock, which orders the signature
+        history). Retrace events carry the explainer verdict naming the
+        changed cache-key component."""
+        if delta == 0:
+            _bus.emit("cache_hit", source=source, entry_kind=self.kind, variant=base_variant)
+            return
+        bucket = None
+        if variant.startswith("bucketed") and len(fn_args) >= 5 and fn_args[4]:
+            padded = fn_args[1]
+            bucket = int(padded[fn_args[4][0]].shape[0])
+        leaves = jax.tree_util.tree_leaves(fn_args[0]) + jax.tree_util.tree_leaves(fn_args[1:3])
+        sig = _explain.signature(
+            leaves,
+            bucket=bucket,
+            donate=self.donate and not variant.endswith("_nodonate"),
+            screening=screening,
+        )
+        is_retrace = before_variant > 0
+        explanation = _explain.record_and_explain(self._obs_sigs, base_variant, sig, is_retrace)
+        if is_retrace:
+            _bus.emit(
+                "retrace",
+                source=source,
+                entry_kind=self.kind,
+                variant=base_variant,
+                traces=delta,
+                explain=explanation,
+            )
+        else:
+            _bus.emit(
+                "compile", source=source, entry_kind=self.kind, variant=base_variant, traces=delta
+            )
 
     def summary(self) -> Dict[str, Any]:
         return {
@@ -542,6 +614,10 @@ def update_transition(metric: Any, state: Dict[str, Any], args: Tuple[Any, ...],
     if spec is None:
         return entry.invoke("exact" + suffix, metric, stats, state, args, kwargs)
     leaves, treedef, batched, pad = spec
+    if _bus.enabled():
+        bucketing.emit_bucket_event(
+            type(metric).__name__, int(leaves[batched[0]].shape[0]), int(pad)
+        )
     padded = bucketing.pad_leaves(leaves, batched, pad)
     return entry.invoke(
         "bucketed" + suffix,

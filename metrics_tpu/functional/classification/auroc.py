@@ -4,11 +4,12 @@ Parity: reference ``torchmetrics/functional/classification/auroc.py``
 (``_auroc_update`` :27, ``_auroc_compute`` :51, ``auroc`` :196). Host/eager
 side (exact curves underneath); the streaming module buffers preds/target.
 """
-import warnings
 from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from metrics_tpu.obs.warn import warn_once
 
 from metrics_tpu.functional.classification.auc import _auc_compute_without_check
 from metrics_tpu.functional.classification.roc import roc
@@ -81,7 +82,7 @@ def _auroc_compute(
                 class_observed = jnp.sum(target_bool_mat, axis=0) > 0
                 for c in range(num_classes):
                     if not bool(class_observed[c]):
-                        warnings.warn(f"Class {c} had 0 observations, omitted from AUROC calculation", UserWarning)
+                        warn_once(f"Class {c} had 0 observations, omitted from AUROC calculation", UserWarning)
                 preds = preds[:, class_observed]
                 target_subset = target_bool_mat[:, class_observed]
                 target = jnp.nonzero(target_subset)[1]

@@ -5,11 +5,12 @@ Parity: reference ``torchmetrics/functional/classification/average_precision.py`
 ``_average_precision_compute_with_precision_recall`` :112,
 ``average_precision`` :180).
 """
-import warnings
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+
+from metrics_tpu.obs.warn import warn_once
 
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
@@ -76,7 +77,7 @@ def _average_precision_compute_with_precision_recall(
     if average in ("macro", "weighted"):
         res = jnp.stack(res)
         if bool(jnp.any(jnp.isnan(res))):
-            warnings.warn(
+            warn_once(
                 "Average precision score for one or more classes was `nan`. Ignoring these classes in average",
                 UserWarning,
             )

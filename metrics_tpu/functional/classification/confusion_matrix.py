@@ -12,8 +12,8 @@ import jax.numpy as jnp
 
 from metrics_tpu.utils.checks import _input_format_classification
 from metrics_tpu.utils.data import _bincount
+from metrics_tpu.obs.warn import warn_once
 from metrics_tpu.utils.enums import DataType
-from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
@@ -70,8 +70,11 @@ def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -
         from metrics_tpu.utils.data import is_tracing
 
         if not is_tracing(confmat) and bool(jnp.any(nan_mask)):
-            rank_zero_warn(
-                f"{int(jnp.sum(nan_mask))} nan values found in confusion matrix have been replaced with zeros."
+            # the count varies per call: key explicitly so this dedups as
+            # one condition, not one warning per distinct count
+            warn_once(
+                f"{int(jnp.sum(nan_mask))} nan values found in confusion matrix have been replaced with zeros.",
+                key="confusion_matrix_nan_replaced",
             )
         confmat = jnp.where(nan_mask, 0.0, confmat)
     return confmat

@@ -17,7 +17,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.obs.warn import warn_once
 
 Array = jax.Array
 
@@ -81,7 +81,7 @@ def _precision_recall_curve_update(
             num_classes = 1
     elif preds.ndim == target.ndim + 1:
         if pos_label is not None:
-            rank_zero_warn(
+            warn_once(
                 "Argument `pos_label` should be `None` when running"
                 f" multiclass precision recall curve. Got {pos_label}"
             )

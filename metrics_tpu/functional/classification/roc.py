@@ -14,7 +14,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _binary_clf_curve,
     _precision_recall_curve_update,
 )
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.obs.warn import warn_once
 
 Array = jax.Array
 
@@ -43,7 +43,7 @@ def _roc_compute_single_class(
     thresholds = jnp.concatenate([thresholds[0][None] + 1, thresholds])
 
     if fps[-1] <= 0:
-        rank_zero_warn(
+        warn_once(
             "No negative samples in targets, false positive value should be meaningless."
             " Returning zero tensor in false positive score",
             UserWarning,
@@ -53,7 +53,7 @@ def _roc_compute_single_class(
         fpr = fps / fps[-1]
 
     if tps[-1] <= 0:
-        rank_zero_warn(
+        warn_once(
             "No positive samples in targets, true positive value should be meaningless."
             " Returning zero tensor in true positive score",
             UserWarning,

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.parallel.comm import reduce as _reduce
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.obs.warn import warn_once
 
 Array = jax.Array
 
@@ -71,7 +71,7 @@ def peak_signal_noise_ratio(
         38.06
     """
     if dim is None and reduction != "elementwise_mean":
-        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+        warn_once(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
 
     if data_range is None:
         if dim is not None:

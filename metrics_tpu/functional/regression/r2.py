@@ -10,7 +10,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.utils.checks import _check_same_shape
 from metrics_tpu.utils.data import is_tracing
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.obs.warn import warn_once
 
 Array = jax.Array
 
@@ -63,13 +63,13 @@ def _r2_score_compute(
 
     if adjusted != 0 and n_obs_static is not None:
         if adjusted > n_obs_static - 1:
-            rank_zero_warn(
+            warn_once(
                 "More independent regressions than data points in"
                 " adjusted r2 score. Falls back to standard r2 score.",
                 UserWarning,
             )
         elif adjusted == n_obs_static - 1:
-            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+            warn_once("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
         else:
             r2 = 1 - (1 - r2) * (n_obs_static - 1) / (n_obs_static - adjusted - 1)
     elif adjusted != 0:

@@ -6,7 +6,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.image.psnr import _psnr_compute, _psnr_update
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.obs.warn import warn_once
 
 Array = jax.Array
 
@@ -48,7 +48,7 @@ class PeakSignalNoiseRatio(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if dim is None and reduction != "elementwise_mean":
-            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+            warn_once(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
 
         if dim is None:
             self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")

@@ -11,7 +11,7 @@ from metrics_tpu.functional.image.ssim import (
 )
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.obs.warn import warn_once
 
 Array = jax.Array
 
@@ -52,7 +52,7 @@ class StructuralSimilarityIndexMeasure(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        rank_zero_warn(
+        warn_once(
             "Metric `SSIM` will save all targets and predictions in buffer."
             " For large datasets this may lead to large memory footprint."
         )
@@ -107,7 +107,7 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        rank_zero_warn(
+        warn_once(
             "Metric `MS_SSIM` will save all targets and predictions in buffer."
             " For large datasets this may lead to large memory footprint."
         )

@@ -45,6 +45,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.engine import cache as _engine
+from metrics_tpu.obs import bus as _obs_bus
+from metrics_tpu.obs import trace as _obs_trace
+from metrics_tpu.obs.warn import instance_token as _warn_instance_token
+from metrics_tpu.obs.warn import warn_once
 from metrics_tpu.parallel import comm
 from metrics_tpu.resilience import SYNC_ERROR_POLICIES, new_sync_stats
 from metrics_tpu.resilience import health as _health
@@ -183,6 +187,7 @@ class Metric:
         jit_bucket: Optional[str] = None,
     ) -> None:
         self._device = None
+        self._warn_token = _warn_instance_token()  # per-instance warn_once keys
         self.compute_on_step = compute_on_step
         self.dist_sync_on_step = dist_sync_on_step
         if on_sync_error not in SYNC_ERROR_POLICIES:
@@ -390,6 +395,14 @@ class Metric:
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate the batch into global state and (optionally) return the
         batch-local value (reference ``metric.py:192-229``)."""
+        if not _obs_trace.active():
+            return self._forward_impl(*args, **kwargs)
+        # observability span around the whole forward (batch value + merge);
+        # fenced timing waits on the batch value, covering device execution
+        with _obs_trace.span("forward", type(self).__name__, payload=lambda: self._forward_cache):
+            return self._forward_impl(*args, **kwargs)
+
+    def _forward_impl(self, *args: Any, **kwargs: Any) -> Any:
         if self._is_synced:
             raise MetricsUserError(
                 "The Metric shouldn't be synced when performing ``forward``. "
@@ -471,7 +484,11 @@ class Metric:
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
-            self._update_impl(*args, **kwargs)
+            if not _obs_trace.active():  # disabled observability: one bool read
+                self._update_impl(*args, **kwargs)
+                return
+            with _obs_trace.span("update", type(self).__name__, payload=self._snapshot_state):
+                self._update_impl(*args, **kwargs)
 
         self._inner_update = update
         return wrapped_func
@@ -542,6 +559,9 @@ class Metric:
         out["jit_enabled"] = self._enable_jit
         out["jit_failed"] = self._jit_failed
         out["jit_bucket"] = self.jit_bucket
+        children = self._children()
+        if children:
+            out["children"] = {k: c.compile_stats() for k, c in children.items()}
         return out
 
     def sync_report(self) -> Dict[str, Any]:
@@ -565,6 +585,9 @@ class Metric:
         out["missing_ranks"] = list(self._sync_stats["missing_ranks"])
         out["on_sync_error"] = self.on_sync_error
         out["process_group"] = getattr(self.process_group, "name", None)
+        children = self._children()
+        if children:
+            out["children"] = {k: c.sync_report() for k, c in children.items()}
         return out
 
     def health_report(self) -> Dict[str, Any]:
@@ -583,18 +606,51 @@ class Metric:
         All device counters read 0 under ``on_bad_input='propagate'`` —
         no screening runs.
         """
-        return _health.metric_report(self)
+        out = _health.metric_report(self)
+        children = self._children()
+        if children:
+            out["children"] = {k: c.health_report() for k, c in children.items()}
+        return out
+
+    def _children(self) -> Dict[str, "Metric"]:
+        """Inner metrics whose telemetry this metric's report surfaces
+        forward — wrappers (``BootStrapper``, ``MinMaxMetric``,
+        ``MultioutputWrapper``, ``ClasswiseWrapper``) override this, the way
+        ``MetricCollection`` already forwards its members. Empty for a plain
+        metric."""
+        return {}
+
+    def obs_snapshot(self) -> Dict[str, Any]:
+        """One nested dict of every telemetry surface for this instance —
+        the per-metric face of :func:`metrics_tpu.obs.snapshot`.
+
+        The ``compile`` / ``sync`` / ``health`` sections are exactly the
+        dicts :meth:`compile_stats` / :meth:`sync_report` /
+        :meth:`health_report` return (bit-consistent by construction; those
+        remain as thin per-surface views). Wrapper children ride INSIDE each
+        section under its ``children`` key — the snapshot adds no second
+        copy, so each child report (and its device-counter fetch) is
+        computed exactly once per snapshot.
+        """
+        return {
+            "class": type(self).__name__,
+            "compile": self.compile_stats(),
+            "sync": self.sync_report(),
+            "health": self.health_report(),
+        }
 
     # -- compute wrapping -----------------------------------------------
     def _wrap_compute(self, compute: Callable) -> Callable:
-        @functools.wraps(compute)
-        def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+        def compute_body(*args: Any, **kwargs: Any) -> Any:
             if self._update_count == 0:
-                rank_zero_warn(
+                # keyed per INSTANCE: sibling metrics of the same class are
+                # distinct objects and each gets its one warning
+                warn_once(
                     f"The ``compute`` method of metric {self.__class__.__name__}"
                     " was called before the ``update`` method which may lead to errors,"
                     " as metric states have not yet been updated.",
                     UserWarning,
+                    key=("compute_before_update", self._warn_token),
                 )
             if self._computed is not None:
                 return self._computed
@@ -610,6 +666,13 @@ class Metric:
             if _health.health_enabled(self):
                 _health.check_compute_result(self, self._computed)
             return self._computed
+
+        @functools.wraps(compute)
+        def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if not _obs_trace.active():
+                return compute_body(*args, **kwargs)
+            with _obs_trace.span("compute", type(self).__name__, payload=lambda: self._computed):
+                return compute_body(*args, **kwargs)
 
         self._compute_impl = compute
         return wrapped_func
@@ -661,9 +724,25 @@ class Metric:
             )
         except SyncError as err:
             if policy == "raise":
+                if _obs_bus.enabled():
+                    _obs_bus.emit(
+                        "sync_degrade",
+                        source=self.__class__.__name__,
+                        policy=policy,
+                        outcome="failed",
+                        error=str(err),
+                    )
                 raise
             stats["degraded_local"] += 1
             stats["last_sync_outcome"] = "local"
+            if _obs_bus.enabled():
+                _obs_bus.emit(
+                    "sync_degrade",
+                    source=self.__class__.__name__,
+                    policy=policy,
+                    outcome="local",
+                    error=str(err),
+                )
             rank_zero_warn(
                 f"Distributed sync of {self.__class__.__name__} failed; keeping"
                 f" the rank-local state (on_sync_error={policy!r})."
@@ -674,6 +753,14 @@ class Metric:
         stats["last_sync_outcome"] = "partial" if stats["missing_ranks"] else "complete"
         if stats["missing_ranks"]:
             stats["degraded_partial"] += 1
+            if _obs_bus.enabled():
+                _obs_bus.emit(
+                    "sync_degrade",
+                    source=self.__class__.__name__,
+                    policy=policy,
+                    outcome="partial",
+                    missing_ranks=list(stats["missing_ranks"]),
+                )
             rank_zero_warn(
                 f"Partial distributed sync of {self.__class__.__name__}: ranks"
                 f" {stats['missing_ranks']} did not deliver within the group"
@@ -752,7 +839,11 @@ class Metric:
         if not should_sync or not is_distributed:
             return
         self._cache = self._snapshot_state()
-        self._sync_dist(dist_sync_fn, process_group=process_group)
+        if not _obs_trace.active():
+            self._sync_dist(dist_sync_fn, process_group=process_group)
+        else:
+            with _obs_trace.span("sync", type(self).__name__, payload=self._snapshot_state):
+                self._sync_dist(dist_sync_fn, process_group=process_group)
         self._is_synced = True
 
     def unsync(self, should_unsync: bool = True) -> None:
@@ -912,15 +1003,30 @@ class Metric:
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
+        """Rebuild the unpicklable wrappers after unpickling / deepcopy.
+
+        Telemetry contract across pickle round-trips (and ``clone()``, which
+        routes through here): ``_sync_stats`` and ``_health_stats`` describe
+        the METRIC — how many syncs degraded, how many batches were screened
+        — so they are preserved verbatim from the pickled state.
+        ``_compile_stats`` describes dispatches against THIS PROCESS's
+        shared compile cache (``metrics_tpu.engine``), which cannot survive
+        a process boundary: compile counters restart at zero, by design, and
+        the first post-restore dispatch recomputes the cache identity.
+        """
         self.__dict__.update(state)
         self._update_signature = inspect.signature(self.update)
         self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute = self._wrap_compute(self.compute)  # type: ignore[method-assign]
         # shared-cache identity is process-local (id-pinned objects): recompute
-        # on first dispatch; telemetry counters describe live dispatches only
+        # on first dispatch; COMPILE counters describe live dispatches only —
+        # sync/health host counters above are metric-lifetime and preserved
         self.__dict__.pop("_engine_key", None)
         self.__dict__.pop("_engine_key_pins", None)
         self._compile_stats = _engine.new_stats()
+        # warn dedup is process-local too: a pickled token could collide with
+        # a token already issued to a live instance in this process
+        self._warn_token = _warn_instance_token()
         self.__dict__.setdefault("_engine_probed", False)
         self.__dict__.setdefault("jit_bucket", None)
         self.__dict__.setdefault("on_sync_error", "raise")
@@ -1157,6 +1263,16 @@ class CompositionalMetric(Metric):
             self.metric_a.persistent(mode=mode)
         if isinstance(self.metric_b, Metric):
             self.metric_b.persistent(mode=mode)
+
+    def _children(self) -> Dict[str, Metric]:
+        """Operand metrics' telemetry forwards through the composition's
+        reports/snapshot (the operands do the real updates and syncs)."""
+        out: Dict[str, Metric] = {}
+        if isinstance(self.metric_a, Metric):
+            out["a"] = self.metric_a
+        if isinstance(self.metric_b, Metric):
+            out["b"] = self.metric_b
+        return out
 
     def __repr__(self) -> str:
         _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else 'op'}(\n    {repr(self.metric_a)},\n    {repr(self.metric_b)}\n  )\n)"

@@ -1,0 +1,90 @@
+"""Unified observability: event bus, spans, retrace explainer, exporters.
+
+One layer for everything the engine (PR 1), the sync stack (PR 2) and the
+numerical-health layer (PR 3) want to tell an operator:
+
+* :mod:`~metrics_tpu.obs.bus` — a process-wide, lock-protected, bounded,
+  typed event stream (compile / cache-hit / retrace / bucketed /
+  sync attempt-retry-degrade / quarantine / lifecycle spans / warnings).
+  Ships disabled; the disabled hot path costs one bool read, and enabling
+  it changes no compiled program (CI-asserted).
+* :mod:`~metrics_tpu.obs.trace` — zero-dep lifecycle spans around
+  ``update``/``forward``/``compute``/``sync`` with opt-in
+  ``fence=True`` (``block_until_ready``) for device-honest timing.
+* :mod:`~metrics_tpu.obs.explain` — every retrace event names the changed
+  cache-key component (avals, dtype, structure, bucket, donation,
+  screening) by diffing dispatch signatures per program family.
+* :mod:`~metrics_tpu.obs.export` — ``snapshot()`` (one nested dict that
+  subsumes ``compile_stats()``/``sync_report()``/``health_report()`` across
+  collections and wrapper children), JSONL event logs with a validated
+  schema, and a Prometheus text dump.
+* :mod:`~metrics_tpu.obs.warn` — ``warn_once``: rank-zero-aware,
+  once-per-key rate-limited warnings (the push-path twin of the
+  reference's ``rank_zero_warn``).
+
+See ``docs/observability.md`` for the event schema, span semantics, and the
+legacy-report -> snapshot mapping.
+"""
+from metrics_tpu.obs import bus, explain, trace  # noqa: F401
+from metrics_tpu.obs.bus import (  # noqa: F401
+    EVENT_KINDS,
+    Event,
+    capture,
+    disable,
+    emit,
+    enable,
+    enabled,
+    events,
+    subscribe,
+    unsubscribe,
+)
+from metrics_tpu.obs.export import (  # noqa: F401
+    JSONL_SCHEMA_VERSION,
+    process_snapshot,
+    prometheus_text,
+    snapshot,
+    to_jsonl,
+    validate_jsonl,
+)
+from metrics_tpu.obs.trace import (  # noqa: F401
+    disable_tracing,
+    enable_tracing,
+    span,
+    span_summary,
+    tracing_enabled,
+)
+from metrics_tpu.obs.warn import (  # noqa: F401
+    reset_warn_once,
+    warn_counts,
+    warn_once,
+)
+
+__all__ = [
+    "EVENT_KINDS",
+    "Event",
+    "JSONL_SCHEMA_VERSION",
+    "bus",
+    "capture",
+    "disable",
+    "disable_tracing",
+    "emit",
+    "enable",
+    "enable_tracing",
+    "enabled",
+    "events",
+    "explain",
+    "process_snapshot",
+    "prometheus_text",
+    "reset_warn_once",
+    "snapshot",
+    "span",
+    "span_summary",
+    "subscribe",
+    "to_jsonl",
+    "trace",
+    "tracing_enabled",
+    "unsubscribe",
+    "validate_jsonl",
+    "warn_counts",
+    "warn_once",
+]

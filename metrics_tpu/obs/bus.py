@@ -1,0 +1,247 @@
+"""Process-wide bounded event bus for metric telemetry.
+
+PRs 1–3 each grew a *pull* report surface (``compile_stats()``,
+``sync_report()``, ``health_report()``) — counters you read after the fact.
+This module adds the *push* half: a lock-protected, bounded, typed event
+stream that the engine (compiles, cache hits, retraces, bucketing), the
+host-level sync stack (attempts, retries, degradations) and the numerical
+health layer (quarantines) emit into, and that exporters
+(``metrics_tpu.obs.export``) drain into JSONL / Prometheus text.
+
+Design constraints, in order:
+
+* **Disabled is free.** The bus ships disabled; every emit site guards on a
+  single module-level bool (``enabled()``) before building the event, so the
+  hot update path pays one attribute read when observability is off. The
+  ``bench.py --obs-smoke`` CI lane gates this.
+* **Enabling changes no compiled program.** Every emit site is *host-side*
+  Python — dispatch bookkeeping, retry loops, host checks. Nothing emits
+  from inside a traced function, so turning the bus on adds zero host syncs
+  and zero retraces (also CI-asserted: compile counters identical bus on/off).
+* **Bounded.** Events land in a ring buffer (default 4096 entries,
+  ``METRICS_TPU_OBS_CAPACITY``); overflow evicts the oldest and counts it in
+  ``dropped`` rather than growing without bound on a long run. Per-kind
+  counters keep totals even after eviction.
+* **Typed.** ``kind`` must be one of :data:`EVENT_KINDS` — an unknown kind
+  is a programming error at the emit site, surfaced immediately, so the
+  JSONL schema stays closed and exporters/dashboards can enumerate it.
+
+Thread safety: one process-wide ``RLock`` guards the buffer, counters, and
+subscriber list; emission from concurrent dispatch threads interleaves but
+never tears. Subscribers run synchronously under the lock *holder's* thread;
+a raising subscriber is counted (``subscriber_errors``) and never breaks the
+emitting hot path.
+"""
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: The closed set of event kinds (the JSONL schema's ``kind`` field).
+#: Engine: ``compile`` (a new trace), ``cache_hit`` (dispatch served by an
+#: already-compiled shared program), ``retrace`` (a trace beyond a program
+#: family's first — carries the ``explain`` payload naming the changed
+#: cache-key component), ``bucketed`` (an update routed through pow2
+#: padding). Sync: ``sync_attempt`` / ``sync_retry`` (KV peer reads),
+#: ``sync_degrade`` (an ``on_sync_error`` fallback engaged). Health:
+#: ``quarantine`` (a contaminated update surfaced host-side). Lifecycle
+#: spans (``metrics_tpu.obs.trace``): ``update`` / ``forward`` / ``compute``
+#: / ``sync``. Misc: ``warning`` (a ``warn_once`` emission).
+EVENT_KINDS = (
+    "compile",
+    "cache_hit",
+    "retrace",
+    "bucketed",
+    "sync_attempt",
+    "sync_retry",
+    "sync_degrade",
+    "quarantine",
+    "update",
+    "forward",
+    "compute",
+    "sync",
+    "warning",
+)
+
+_DEFAULT_CAPACITY = 4096
+
+
+def _capacity_from_env() -> int:
+    try:
+        return max(16, int(os.environ.get("METRICS_TPU_OBS_CAPACITY", _DEFAULT_CAPACITY)))
+    except ValueError:
+        return _DEFAULT_CAPACITY
+
+
+class Event:
+    """One telemetry event: ``kind`` (see :data:`EVENT_KINDS`), a process-wide
+    monotonically increasing ``seq``, wall-clock ``t`` (``time.time()``),
+    ``source`` (the emitting component — usually a metric class name), and a
+    flat JSON-safe ``data`` payload."""
+
+    __slots__ = ("kind", "seq", "t", "source", "data")
+
+    def __init__(self, kind: str, seq: int, t: float, source: str, data: Dict[str, Any]) -> None:
+        self.kind = kind
+        self.seq = seq
+        self.t = t
+        self.source = source
+        self.data = data
+
+    def as_dict(self) -> Dict[str, Any]:
+        """The JSONL wire form (see ``docs/observability.md`` for the schema)."""
+        return {"v": 1, "seq": self.seq, "kind": self.kind, "t": self.t, "source": self.source, "data": self.data}
+
+    def __repr__(self) -> str:
+        return f"Event(kind={self.kind!r}, seq={self.seq}, source={self.source!r}, data={self.data!r})"
+
+
+# module-level fast flag: emit sites read this before doing ANY work, so the
+# disabled path costs one attribute load + truth test
+_ENABLED = False
+
+_LOCK = threading.RLock()
+_BUFFER: "deque[Event]" = deque(maxlen=_capacity_from_env())
+_SEQ = 0
+_DROPPED = 0
+_SUBSCRIBER_ERRORS = 0
+_COUNTS: Dict[str, int] = {}
+_SUBSCRIBERS: List[Callable[[Event], None]] = []
+
+
+def enabled() -> bool:
+    """Whether the bus is recording (cheap enough for hot-path guards)."""
+    return _ENABLED
+
+
+def enable() -> None:
+    """Start recording events (idempotent). Emission points all over the
+    library light up; nothing about compiled programs changes."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable() -> None:
+    """Stop recording (idempotent). The buffer is kept — ``clear()`` drops it."""
+    global _ENABLED
+    _ENABLED = False
+
+
+def emit(kind: str, source: str = "", **data: Any) -> Optional[Event]:
+    """Record one event; returns it, or ``None`` when the bus is disabled.
+
+    ``kind`` must be a member of :data:`EVENT_KINDS` — emitting an unknown
+    kind raises ``ValueError`` (a closed schema is what makes the exporters
+    and dashboards enumerable). Call sites on hot paths should guard on
+    :func:`enabled` *before* building ``data`` so the disabled path stays
+    free.
+    """
+    global _SEQ, _DROPPED, _SUBSCRIBER_ERRORS
+    if not _ENABLED:
+        return None
+    if kind not in EVENT_KINDS:
+        raise ValueError(f"Unknown obs event kind {kind!r}; must be one of {EVENT_KINDS}")
+    with _LOCK:
+        _SEQ += 1
+        event = Event(kind, _SEQ, time.time(), source, data)
+        if len(_BUFFER) == _BUFFER.maxlen:
+            _DROPPED += 1
+        _BUFFER.append(event)
+        _COUNTS[kind] = _COUNTS.get(kind, 0) + 1
+        subscribers = list(_SUBSCRIBERS)
+    for fn in subscribers:
+        try:
+            fn(event)
+        except Exception:  # noqa: BLE001 — a subscriber must never break the emitter
+            with _LOCK:
+                _SUBSCRIBER_ERRORS += 1
+    return event
+
+
+def subscribe(fn: Callable[[Event], None]) -> Callable[[Event], None]:
+    """Register a synchronous per-event callback; returns ``fn`` (so it can
+    be used as a decorator). Exceptions it raises are counted, not raised."""
+    with _LOCK:
+        _SUBSCRIBERS.append(fn)
+    return fn
+
+
+def unsubscribe(fn: Callable[[Event], None]) -> None:
+    with _LOCK:
+        try:
+            _SUBSCRIBERS.remove(fn)
+        except ValueError:
+            pass
+
+
+def events(kind: Optional[str] = None) -> List[Event]:
+    """Snapshot of the buffered events (oldest first), optionally filtered."""
+    with _LOCK:
+        snap = list(_BUFFER)
+    if kind is None:
+        return snap
+    return [e for e in snap if e.kind == kind]
+
+
+def clear() -> None:
+    """Drop buffered events and zero the counters (the enabled flag and
+    subscribers are left alone)."""
+    global _DROPPED, _SUBSCRIBER_ERRORS
+    with _LOCK:
+        _BUFFER.clear()
+        _COUNTS.clear()
+        _DROPPED = 0
+        _SUBSCRIBER_ERRORS = 0
+
+
+def capacity() -> int:
+    return _BUFFER.maxlen or 0
+
+
+def set_capacity(n: int) -> None:
+    """Resize the ring buffer (keeps the newest events that fit)."""
+    global _BUFFER
+    with _LOCK:
+        _BUFFER = deque(_BUFFER, maxlen=max(16, int(n)))
+
+
+def summary() -> Dict[str, Any]:
+    """Counter view of the bus — the piece ``obs.snapshot()`` embeds."""
+    with _LOCK:
+        counts = dict(_COUNTS)
+        return {
+            "enabled": _ENABLED,
+            "capacity": _BUFFER.maxlen,
+            "buffered": len(_BUFFER),
+            "emitted_total": sum(counts.values()),
+            "dropped": _DROPPED,
+            "subscriber_errors": _SUBSCRIBER_ERRORS,
+            "by_kind": counts,
+        }
+
+
+class capture:
+    """``with obs.bus.capture() as events: ...`` — enable the bus for the
+    block, collect the events emitted inside it, restore the previous
+    enabled state on exit. The process buffer still receives the events."""
+
+    def __init__(self, kinds: Optional[Tuple[str, ...]] = None) -> None:
+        self._kinds = kinds
+        self._events: List[Event] = []
+        self._was_enabled = False
+
+    def _on_event(self, event: Event) -> None:
+        if self._kinds is None or event.kind in self._kinds:
+            self._events.append(event)
+
+    def __enter__(self) -> List[Event]:
+        self._was_enabled = _ENABLED
+        enable()
+        subscribe(self._on_event)
+        return self._events
+
+    def __exit__(self, *exc: Any) -> None:
+        unsubscribe(self._on_event)
+        if not self._was_enabled:
+            disable()

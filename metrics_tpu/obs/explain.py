@@ -1,0 +1,132 @@
+"""Retrace explainer: name the cache-key component a retrace changed.
+
+A retrace — a trace beyond a program family's first — is the single most
+expensive silent event in a streaming-metrics process (tens of ms to seconds
+of XLA compilation on the update path). The engine's telemetry counts them
+(``compile_stats()['retraces']``); this module answers the operational
+question the count cannot: *what changed?*
+
+The engine's shared cache keys programs by ``(class, config fingerprint)``
+with input avals handled by ``jax.jit`` underneath one entry
+(``engine/cache.py``), so within one entry+variant a retrace can only come
+from a handful of components. :func:`signature` captures them per dispatch
+— cheaply, and **only while the event bus is recording** (the disabled hot
+path never builds signatures):
+
+* ``avals`` — shape set of the state + input array leaves (the common case:
+  a new batch shape outside the bucketing contract);
+* ``dtype`` — dtype set of those leaves (x64 flips, mixed-precision drift);
+* ``structure`` — the leaf count / tree shape of the inputs (a kwarg
+  appearing, a list growing);
+* ``bucket`` — the pow2 bucket a bucketed dispatch padded to;
+* ``donation`` — the entry rebuilt without donation after a runtime
+  rejection (same traced body, new executable);
+* ``screening`` — the active health policy/screen mode (these are part of
+  the config fingerprint, so a change normally means a *new* entry — the
+  component is still tracked so a same-entry drift is named, not guessed).
+
+:func:`diff` compares the previous dispatch's signature for the same
+``(entry, variant)`` against the new one and returns the changed components
+with a human-readable detail per component. ``engine/cache.py`` stores the
+last signature on the cache entry itself (``entry._obs_sigs``) so the
+explainer's memory is exactly the cache's lifetime — evict the entry, forget
+its history.
+
+Pure stdlib: signatures are plain tuples built from pre-flattened leaves the
+engine hands over; no jax import, no tracing, no device work.
+"""
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Component names, in the order they are reported.
+COMPONENTS = ("structure", "avals", "dtype", "bucket", "donation", "screening")
+
+
+def _leaf_desc(leaf: Any) -> Tuple[str, str]:
+    """(shape, dtype) description of one leaf; scalars/non-arrays by type.
+
+    ``weak_type`` is part of the dtype description: a fresh zero state carries
+    weakly-typed scalars that strengthen after the first update, and that
+    promotion is the most common real-world cause of a same-shape second
+    trace — it must be named, not filed under unknown."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return ("py", type(leaf).__name__)
+    desc = str(dtype)
+    if getattr(leaf, "weak_type", False):
+        desc += "(weak)"
+    return (str(tuple(shape)), desc)
+
+
+def signature(
+    leaves: List[Any],
+    bucket: Optional[int] = None,
+    donate: bool = False,
+    screening: Tuple[Any, ...] = (),
+) -> Dict[str, Any]:
+    """Build one dispatch's cache-key-component signature from the flattened
+    ``(state, inputs)`` leaves plus the engine-side knobs."""
+    descs = [_leaf_desc(leaf) for leaf in leaves]
+    return {
+        "structure": len(descs),
+        "avals": tuple(d[0] for d in descs),
+        "dtype": tuple(d[1] for d in descs),
+        "bucket": bucket,
+        "donation": bool(donate),
+        "screening": tuple(screening),
+    }
+
+
+def _describe_change(name: str, prev: Any, new: Any) -> str:
+    if name in ("avals", "dtype") and isinstance(prev, tuple) and isinstance(new, tuple) and len(prev) == len(new):
+        changed = [f"leaf{i}: {p} -> {n}" for i, (p, n) in enumerate(zip(prev, new)) if p != n]
+        if changed:
+            return f"{name} changed ({'; '.join(changed[:4])}{', ...' if len(changed) > 4 else ''})"
+    return f"{name} changed ({prev!r} -> {new!r})"
+
+
+def diff(prev: Optional[Dict[str, Any]], new: Dict[str, Any]) -> Dict[str, Any]:
+    """Name the components that differ between two dispatch signatures.
+
+    Returns ``{"changed": [component, ...], "detail": str}``. With no prior
+    signature (bus enabled after the family's first trace) the cause is
+    honestly ``unknown`` rather than guessed. A shape change implies an aval
+    change; when ``structure`` changed, the per-leaf ``avals``/``dtype``
+    tuples aren't comparable element-wise and ``structure`` is reported
+    alone.
+    """
+    if prev is None:
+        return {"changed": ["unknown"], "detail": "no prior dispatch signature recorded (bus enabled mid-run?)"}
+    if prev.get("structure") != new.get("structure"):
+        return {
+            "changed": ["structure"],
+            "detail": _describe_change("structure", prev.get("structure"), new.get("structure")),
+        }
+    changed: List[str] = []
+    details: List[str] = []
+    for name in COMPONENTS:
+        if name == "structure":
+            continue
+        if prev.get(name) != new.get(name):
+            changed.append(name)
+            details.append(_describe_change(name, prev.get(name), new.get(name)))
+    if not changed:
+        # identical signature yet jax retraced: weak_type promotion, a
+        # python-scalar aval, or an explicit cache clear — name it honestly
+        return {
+            "changed": ["unknown"],
+            "detail": "dispatch signature identical; likely weak_type promotion or an explicit jit-cache clear",
+        }
+    return {"changed": changed, "detail": "; ".join(details)}
+
+
+def record_and_explain(
+    store: Dict[str, Dict[str, Any]], variant: str, sig: Dict[str, Any], is_retrace: bool
+) -> Optional[Dict[str, Any]]:
+    """Update ``store[variant]`` with ``sig``; when ``is_retrace``, first
+    diff against the stored predecessor and return the explanation. ``store``
+    lives on the engine cache entry, so history scope == program-family
+    scope. The caller holds the entry's counter lock."""
+    explanation = diff(store.get(variant), sig) if is_retrace else None
+    store[variant] = sig
+    return explanation

@@ -1,0 +1,219 @@
+"""Exporters: ``snapshot()``, JSONL event log, Prometheus text dump.
+
+``obs.snapshot()`` is the one nested dict that subsumes the three
+per-surface reports PRs 1–3 grew (``compile_stats()`` / ``sync_report()`` /
+``health_report()``): called on a :class:`~metrics_tpu.Metric` it returns
+all three for that instance (and, recursively, for wrapper children); on a
+:class:`~metrics_tpu.collections.MetricCollection` it covers every member in
+one call, bit-consistent with the legacy per-metric reports (each member
+section IS the dict the legacy method returns); with no argument it returns
+the process view — engine cache summary, event-bus counters, span
+aggregates, warn-once counts.
+
+The legacy reports stay as thin per-surface views; new code should read the
+snapshot (``docs/observability.md`` maps the fields).
+
+JSONL: one event per line in the :meth:`Event.as_dict` schema
+(``{"v": 1, "seq", "kind", "t", "source", "data"}``), append-friendly, and
+validated by :func:`validate_jsonl` — the CI ``--obs-smoke`` lane round-trips
+a fault-injection run through it.
+
+Prometheus: a text-format (0.0.4) dump of the counter surfaces — engine
+totals, bus per-kind counters, span aggregates, and (when a metric or
+collection is passed) per-member compile/sync/health counters with a
+``member`` label. Point a node_exporter textfile collector or a sidecar
+scraper at it.
+"""
+import json
+from typing import Any, Dict, IO, Iterable, List, Optional, Tuple, Union
+
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.obs import trace as _trace
+from metrics_tpu.obs import warn as _warn
+
+JSONL_SCHEMA_VERSION = 1
+_EVENT_REQUIRED_FIELDS = ("v", "seq", "kind", "t", "source", "data")
+
+
+def process_snapshot() -> Dict[str, Any]:
+    """The process-wide observability view (no metric argument needed)."""
+    from metrics_tpu import engine as _engine
+
+    return {
+        "engine": _engine.cache_summary(),
+        "bus": _bus.summary(),
+        "spans": _trace.span_summary(),
+        "warnings": {repr(k): v for k, v in _warn.warn_counts().items()},
+    }
+
+
+def snapshot(obj: Optional[Any] = None) -> Dict[str, Any]:
+    """One nested dict of every telemetry surface.
+
+    ``obj=None`` → :func:`process_snapshot`. A ``Metric`` /
+    ``MetricCollection`` / ``MetricTracker`` (anything exposing
+    ``obs_snapshot()``) → its per-instance view, which embeds the exact
+    dicts the legacy ``compile_stats()`` / ``sync_report()`` /
+    ``health_report()`` methods return (bit-consistent by construction) and
+    recurses over collection members and wrapper children.
+    """
+    if obj is None:
+        return process_snapshot()
+    fn = getattr(obj, "obs_snapshot", None)
+    if fn is None:
+        raise TypeError(
+            f"obs.snapshot() needs a Metric/MetricCollection/MetricTracker"
+            f" (anything with .obs_snapshot()); got {type(obj).__name__!r}."
+            " Call obs.snapshot() with no argument for the process view."
+        )
+    return fn()
+
+
+# ---------------------------------------------------------------------------
+# JSONL event log
+# ---------------------------------------------------------------------------
+def to_jsonl(
+    target: Union[str, IO[str]],
+    events: Optional[Iterable[_bus.Event]] = None,
+    append: bool = False,
+) -> int:
+    """Write events (default: the bus buffer) to ``target`` as JSON lines.
+
+    ``target`` is a path or an open text file. Returns the number of lines
+    written. Lines follow the versioned event schema — see
+    :func:`validate_jsonl`.
+    """
+    if events is None:
+        events = _bus.events()
+    lines = [json.dumps(e.as_dict(), sort_keys=True, default=str) for e in events]
+    if hasattr(target, "write"):
+        for line in lines:
+            target.write(line + "\n")
+    else:
+        with open(target, "a" if append else "w") as f:
+            for line in lines:
+                f.write(line + "\n")
+    return len(lines)
+
+
+def validate_jsonl(target: Union[str, IO[str]]) -> int:
+    """Validate a JSONL event log against the schema; returns the line count.
+
+    Checks per line: parseable JSON object, the required fields, a known
+    schema version, a ``kind`` from :data:`metrics_tpu.obs.bus.EVENT_KINDS`,
+    numeric ``seq``/``t``, and a dict ``data`` payload. Raises ``ValueError``
+    naming the first offending line.
+    """
+    if hasattr(target, "read"):
+        lines = target.read().splitlines()
+    else:
+        with open(target) as f:
+            lines = f.read().splitlines()
+    count = 0
+    for lineno, line in enumerate(lines, start=1):
+        if not line.strip():
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError as err:
+            raise ValueError(f"JSONL line {lineno} is not valid JSON: {err}") from err
+        if not isinstance(obj, dict):
+            raise ValueError(f"JSONL line {lineno} is not an object: {type(obj).__name__}")
+        missing = [f for f in _EVENT_REQUIRED_FIELDS if f not in obj]
+        if missing:
+            raise ValueError(f"JSONL line {lineno} is missing fields {missing}")
+        if obj["v"] != JSONL_SCHEMA_VERSION:
+            raise ValueError(f"JSONL line {lineno} has schema version {obj['v']!r}, expected {JSONL_SCHEMA_VERSION}")
+        if obj["kind"] not in _bus.EVENT_KINDS:
+            raise ValueError(f"JSONL line {lineno} has unknown kind {obj['kind']!r}")
+        if not isinstance(obj["seq"], int) or not isinstance(obj["t"], (int, float)):
+            raise ValueError(f"JSONL line {lineno} has non-numeric seq/t")
+        if not isinstance(obj["data"], dict):
+            raise ValueError(f"JSONL line {lineno} has a non-object data payload")
+        count += 1
+    return count
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text format
+# ---------------------------------------------------------------------------
+def _sanitize_label(value: Any) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
+
+
+def _prom_line(name: str, value: Any, labels: Optional[Dict[str, Any]] = None) -> str:
+    if labels:
+        inner = ",".join(f'{k}="{_sanitize_label(v)}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{inner}}} {value}"
+    return f"{name} {value}"
+
+
+def _numeric_items(report: Dict[str, Any]) -> List[Any]:
+    return [
+        (k, (1 if v else 0) if isinstance(v, bool) else v)
+        for k, v in report.items()
+        if isinstance(v, (int, float, bool))
+    ]
+
+
+def prometheus_text(obj: Optional[Any] = None) -> str:
+    """Render the counter surfaces in Prometheus text exposition format.
+
+    Always includes the process view (engine totals, bus per-kind counters,
+    span aggregates). With a metric/collection argument, adds the per-member
+    compile/sync/health counters under a ``member`` label (members keyed the
+    way the collection keys them; a bare metric is labeled ``_``).
+    """
+    from metrics_tpu import engine as _engine
+
+    # exposition format: one TYPE line per metric family naming the exact
+    # sample name, and all of a family's samples contiguous — so samples are
+    # gathered into per-family buckets (insertion-ordered) and rendered last
+    families: Dict[str, Tuple[str, List[str]]] = {}
+
+    def _sample(name: str, value: Any, labels: Optional[Dict[str, Any]] = None, kind: str = "counter") -> None:
+        bucket = families.setdefault(name, (kind, []))
+        bucket[1].append(_prom_line(name, value, labels))
+
+    eng = _engine.cache_summary()
+    _sample("metrics_tpu_engine_entries", eng["entries"], kind="gauge")  # LRU-evictable
+    for key in ("calls", "compiles", "cache_hits", "retraces", "donated_bytes", "bucketed_calls"):
+        _sample(f"metrics_tpu_engine_{key}", eng[key])
+
+    bus_summary = _bus.summary()
+    for kind in sorted(bus_summary["by_kind"]):
+        _sample("metrics_tpu_obs_events_total", bus_summary["by_kind"][kind], {"kind": kind})
+    _sample("metrics_tpu_obs_events_dropped", bus_summary["dropped"])
+
+    spans = _trace.span_summary()
+    for phase in sorted(spans):
+        for source in sorted(spans[phase]):
+            agg = spans[phase][source]
+            labels = {"phase": phase, "source": source}
+            _sample("metrics_tpu_span_seconds_total", agg["total_s"], labels)
+            _sample("metrics_tpu_span_count", agg["count"], labels)
+
+    if obj is not None:
+        snap = snapshot(obj)
+        members = snap.get("members")
+        if members is None:
+            members = {"_": snap}
+        for member_key in sorted(members):
+            member = members[member_key]
+            for surface in ("compile", "sync", "health"):
+                report = member.get(surface, {})
+                for key, value in _numeric_items(report):
+                    # gauge, not counter: the mix includes booleans, floats,
+                    # and counters that reset with the instance lifecycle
+                    _sample(
+                        f"metrics_tpu_metric_{surface}_{key}",
+                        value,
+                        {"member": member_key, "class": member.get("class", "")},
+                        kind="gauge",
+                    )
+
+    out: List[str] = []
+    for name, (kind, lines) in families.items():
+        out.append(f"# TYPE {name} {kind}")
+        out.extend(lines)
+    return "\n".join(out) + "\n"

@@ -1,0 +1,164 @@
+"""Zero-dependency lifecycle spans: wall time per metric phase.
+
+A *span* wraps one phase of the metric lifecycle — ``update``, ``forward``,
+``compute``, ``sync`` — and records its wall time into per-(phase, source)
+aggregates (count / total / min / max), emitting one bus event per finished
+span when the event bus is recording.
+
+Two honesty regimes, chosen per the JAX dispatch model:
+
+* **Unfenced (default):** the span measures *host dispatch* time. JAX
+  execution is asynchronous — ``update`` returns as soon as the XLA call is
+  enqueued — so unfenced update spans are short and measure the Python/
+  dispatch overhead, not device math. That is the honest default because it
+  adds **zero host syncs**: timing must never change the pipelining it
+  measures.
+* **Fenced (``enable_tracing(fence=True)``):** the span calls
+  ``jax.block_until_ready`` on the payload the instrumented site hands it
+  (the metric's post-update state leaves) before reading the clock, so the
+  span covers device execution too. One device sync per span — a profiling
+  mode, not a production default, exactly like ``on_bad_input='raise'``.
+
+The disabled path is a no-op by construction: instrumented sites call
+:func:`active` (one module-bool read each for tracing and the bus) and only
+enter the context manager when something is listening. Nothing here runs
+inside a traced function, so tracing on/off never changes a compiled
+program. The module imports nothing but stdlib; ``jax`` is imported lazily
+and only when a fenced span actually fires.
+"""
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from metrics_tpu.obs import bus as _bus
+
+_TRACING = False
+_FENCE = False
+
+_LOCK = threading.RLock()
+#: (phase, source) -> {"count", "total_s", "min_s", "max_s", "fenced"}
+_AGG: Dict[Any, Dict[str, Any]] = {}
+
+
+def tracing_enabled() -> bool:
+    return _TRACING
+
+
+def fence_enabled() -> bool:
+    return _FENCE
+
+
+def enable_tracing(fence: bool = False) -> None:
+    """Start recording spans. ``fence=True`` opts into the device-honest
+    timing regime (one ``block_until_ready`` per span — see module doc)."""
+    global _TRACING, _FENCE
+    _TRACING = True
+    _FENCE = bool(fence)
+
+
+def disable_tracing() -> None:
+    global _TRACING, _FENCE
+    _TRACING = False
+    _FENCE = False
+
+
+def active() -> bool:
+    """True when spans should be taken at all: someone is aggregating
+    (tracing) or streaming (bus). The hot-path guard instrumented sites use."""
+    return _TRACING or _bus.enabled()
+
+
+def clear() -> None:
+    """Drop the span aggregates (tracing/fence flags are left alone)."""
+    with _LOCK:
+        _AGG.clear()
+
+
+def span_summary() -> Dict[str, Dict[str, Any]]:
+    """Nested ``{phase: {source: aggregate}}`` view of every span recorded
+    since the last :func:`clear` — the piece ``obs.snapshot()`` embeds.
+    Aggregates carry ``count``, ``total_s``, ``mean_s``, ``min_s``,
+    ``max_s``, and whether any contributing span was fenced."""
+    out: Dict[str, Dict[str, Any]] = {}
+    with _LOCK:
+        items = list(_AGG.items())
+    for (phase, source), agg in items:
+        entry = dict(agg)
+        entry["mean_s"] = agg["total_s"] / agg["count"] if agg["count"] else 0.0
+        out.setdefault(phase, {})[source] = entry
+    return out
+
+
+def _record(phase: str, source: str, elapsed_s: float, fenced: bool) -> None:
+    with _LOCK:
+        agg = _AGG.get((phase, source))
+        if agg is None:
+            _AGG[(phase, source)] = {
+                "count": 1,
+                "total_s": elapsed_s,
+                "min_s": elapsed_s,
+                "max_s": elapsed_s,
+                "fenced": fenced,
+            }
+            return
+        agg["count"] += 1
+        agg["total_s"] += elapsed_s
+        agg["min_s"] = min(agg["min_s"], elapsed_s)
+        agg["max_s"] = max(agg["max_s"], elapsed_s)
+        agg["fenced"] = agg["fenced"] or fenced
+
+
+class span:
+    """Context manager timing one lifecycle phase.
+
+    Args:
+        phase: one of ``update`` / ``forward`` / ``compute`` / ``sync``
+            (anything in :data:`metrics_tpu.obs.bus.EVENT_KINDS` works —
+            the finished span is emitted as an event of that kind).
+        source: the emitting component, usually a metric class name.
+        payload: zero-arg callable returning the arrays to fence on (the
+            instrumented site's post-phase state). Only called when fencing.
+        fence: ``None`` (default) follows the process flag set by
+            :func:`enable_tracing`; a bool forces this span's regime.
+
+    The span exits cleanly on exceptions too (the phase duration is then the
+    time-to-raise, flagged ``error=True`` in the event).
+    """
+
+    __slots__ = ("phase", "source", "payload", "fence", "_t0")
+
+    def __init__(
+        self,
+        phase: str,
+        source: str = "",
+        payload: Optional[Callable[[], Any]] = None,
+        fence: Optional[bool] = None,
+    ) -> None:
+        self.phase = phase
+        self.source = source
+        self.payload = payload
+        self.fence = _FENCE if fence is None else fence
+        self._t0 = 0.0
+
+    def __enter__(self) -> "span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> None:
+        fenced = False
+        if self.fence and self.payload is not None and exc_type is None:
+            try:
+                import jax
+
+                jax.block_until_ready(self.payload())
+                fenced = True
+            except Exception:  # noqa: BLE001 — timing must never mask the real work's error
+                pass
+        elapsed = time.perf_counter() - self._t0
+        if _TRACING:
+            _record(self.phase, self.source, elapsed, fenced)
+        if _bus.enabled():
+            data: Dict[str, Any] = {"duration_s": elapsed, "fenced": fenced}
+            if exc_type is not None:
+                data["error"] = True
+            _bus.emit(self.phase, source=self.source, **data)

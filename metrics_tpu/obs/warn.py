@@ -1,0 +1,105 @@
+"""Rank-zero, once-per-key warnings for multi-process runs.
+
+The reference gates its warnings on rank zero (``utilities/prints.py:22-49``)
+but still re-emits them every call; at the scale the ROADMAP targets an eval
+fleet re-validating the same config warns thousands of times per epoch, and
+log volume is itself an availability concern. :func:`warn_once` keeps the
+rank-zero gate and adds a process-wide once-per-key rate limit:
+
+* the **key** defaults to ``(message, category)`` — a call site that formats
+  varying detail into the message (a class index, a question id) naturally
+  gets one warning per distinct detail; a site that wants coarser dedup
+  passes an explicit ``key``;
+* every *suppressed* repeat is still **counted** (``warn_counts()``) and the
+  first emission lands on the event bus as a ``warning`` event, so dedup
+  never hides information from the telemetry path — only from stderr;
+* ``METRICS_TPU_WARN_EVERY=1`` disables dedup process-wide (debugging);
+* :func:`reset_warn_once` clears the registry (tests do this between cases
+  via a conftest fixture, so ``pytest.warns`` assertions keep working).
+
+Call sites that must warn on every occurrence by contract — the legacy
+aggregation ``nan_strategy='warn'`` removal warnings, the per-incident sync
+degradation warnings — deliberately stay on ``rank_zero_warn``.
+"""
+import itertools
+import os
+import threading
+import warnings as _warnings
+from typing import Any, Dict, Hashable, Optional, Tuple, Type
+
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.utils.prints import _rank
+
+_LOCK = threading.RLock()
+_SEEN: Dict[Hashable, int] = {}
+_TOKEN_SEQ = itertools.count()
+
+
+def instance_token() -> int:
+    """Monotonic process-unique token for keying per-instance warnings.
+
+    ``id(obj)`` is recycled after garbage collection — a new object allocated
+    at a dead object's address would inherit its dedup history. These tokens
+    never repeat within a process, so per-instance keys stay per-instance."""
+    return next(_TOKEN_SEQ)
+
+
+def _dedup_disabled() -> bool:
+    return os.environ.get("METRICS_TPU_WARN_EVERY", "") == "1"
+
+
+def warn_once(
+    message: str,
+    category: Type[Warning] = UserWarning,
+    key: Optional[Hashable] = None,
+    stacklevel: int = 2,
+) -> bool:
+    """Emit ``message`` once per ``key`` on process rank zero.
+
+    Returns True when the warning was actually emitted (first occurrence on
+    rank zero), False when it was deduplicated or gated off-rank. Repeats
+    are counted either way — see :func:`warn_counts`.
+    """
+    dedup_key: Hashable = key if key is not None else (message, category.__name__)
+    with _LOCK:
+        seen = _SEEN.get(dedup_key, 0)
+        _SEEN[dedup_key] = seen + 1
+    if seen and not _dedup_disabled():
+        return False
+    if _bus.enabled():
+        _bus.emit(
+            "warning",
+            source=category.__name__,
+            message=str(message),
+            key=repr(dedup_key),
+            repeat=seen,
+        )
+    if _rank() != 0:
+        return False
+    _warnings.warn(message, category, stacklevel=stacklevel)
+    return True
+
+
+def warn_counts() -> Dict[Hashable, int]:
+    """Occurrence count per dedup key (emitted + suppressed)."""
+    with _LOCK:
+        return dict(_SEEN)
+
+
+def reset_warn_once(key: Optional[Hashable] = None) -> None:
+    """Forget one key (or all of them), re-arming the corresponding warning."""
+    with _LOCK:
+        if key is None:
+            _SEEN.clear()
+        else:
+            _SEEN.pop(key, None)
+
+
+def seen_count(key: Hashable) -> int:
+    with _LOCK:
+        return _SEEN.get(key, 0)
+
+
+def _warn_keys() -> Tuple[Any, ...]:  # pragma: no cover - debugging helper
+    with _LOCK:
+        return tuple(_SEEN)

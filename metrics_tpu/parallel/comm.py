@@ -185,6 +185,14 @@ def gather_all_arrays(
         )
     if not distributed_available():
         return [x]
+    from metrics_tpu.obs import bus as _obs_bus
+
+    if _obs_bus.enabled():
+        # the world-spanning multihost gather is one collective with no
+        # per-peer retry loop — one attempt event covers it
+        _obs_bus.emit(
+            "sync_attempt", source="multihost", world=world_size(), rank=process_index()
+        )
     if _simulated_process() is not None:
         from metrics_tpu.utils.exceptions import MetricsUserError
 

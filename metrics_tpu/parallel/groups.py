@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from metrics_tpu.obs import bus as _obs_bus
 from metrics_tpu.resilience import faults as _faults
 from metrics_tpu.resilience import new_sync_stats
 from metrics_tpu.resilience.retry import DEFAULT_RETRY, RetryPolicy
@@ -325,6 +326,16 @@ def _read_peers_with_retry(
             tries[member] += 1
             if tries[member] > 1:
                 stats["retries"] += 1
+            if _obs_bus.enabled():
+                _obs_bus.emit(
+                    "sync_retry" if tries[member] > 1 else "sync_attempt",
+                    source=f"group:{group.name}",
+                    epoch=epoch,
+                    peer=member,
+                    rank=rank,
+                    attempt=tries[member],
+                    budget_s=round(budget_s, 4),
+                )
             try:
                 raw = client.blocking_key_value_get_bytes(key, max(1, int(budget_s * 1000)))
                 # verified here to classify corruption as transient (and to
@@ -414,6 +425,15 @@ def _exchange_bytes(
             client.wait_at_barrier(f"{_KV_PREFIX}/{scope}/{epoch}/done", barrier_ms, process_ids=list(group.ranks))
         except Exception as err:  # noqa: BLE001 — classified below
             stats["barrier_timeouts"] += 1
+            if _obs_bus.enabled():
+                _obs_bus.emit(
+                    "sync_degrade",
+                    source=f"group:{group.name}",
+                    policy=policy,
+                    outcome="barrier_timeout",
+                    epoch=epoch,
+                    rank=rank,
+                )
             if policy != "partial" or not _is_transient_kv_error(err):
                 raise SyncTimeoutError(
                     f"Group barrier failed{context} within the {group.timeout_s}s"

@@ -58,6 +58,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.obs import bus as _obs_bus
 from metrics_tpu.utils.exceptions import JitIncompatibleError, NumericalHealthError
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -513,6 +514,18 @@ def eager_update(inst: Any, args: Tuple, kwargs: Dict[str, Any]) -> None:
         inst._inner_update(*args, **kwargs)
         _bump()
         return
+    if _obs_bus.enabled():
+        # contamination is host-visible on the eager path: one event per
+        # contaminated update, whatever the policy does with it
+        _obs_bus.emit(
+            "quarantine",
+            source=type(inst).__name__,
+            policy=policy,
+            nan_count=nan_i,
+            inf_count=inf_i,
+            update_index=inst._update_count,
+            path="eager",
+        )
     if policy == "raise":
         _bump(quarantined=1)
         # sync the host mirrors so a later jitted raise-check doesn't
@@ -603,6 +616,16 @@ def raise_on_quarantine(metric: Any) -> None:
             HEALTH_STATE,
             jnp.concatenate([arr[:SLOT_LAST_BAD], jnp.zeros((1,), arr.dtype)]),
         )
+        if _obs_bus.enabled():
+            _obs_bus.emit(
+                "quarantine",
+                source=type(metric).__name__,
+                policy="raise",
+                nan_count=nan_i,
+                inf_count=inf_i,
+                update_index=metric._update_count,
+                path="compiled",
+            )
         raise NumericalHealthError(_raise_message(metric, metric._update_count, nan_i, inf_i))
 
 

@@ -82,7 +82,7 @@ class _BoundedSampleBufferMixin:
         warn: bool = True,
         warn_message: Optional[str] = None,
     ) -> None:
-        from metrics_tpu.utils.prints import rank_zero_warn
+        from metrics_tpu.obs.warn import warn_once
 
         if specs is None:  # the curve-metric default: scores + integer labels
             specs = (("preds", num_classes, None), ("target", None, jnp.int32))
@@ -94,7 +94,7 @@ class _BoundedSampleBufferMixin:
             for name, _, _ in self._buffer_specs:
                 self.add_state(name, default=[], dist_reduce_fx="cat")
             if warn:  # the reference warns for curves/Spearman but not retrieval
-                rank_zero_warn(
+                warn_once(
                     warn_message
                     or f"Metric `{type(self).__name__}` will save all targets and predictions in buffer."
                     " For large datasets this may lead to large memory footprint."

@@ -230,3 +230,15 @@ class BootStrapper(Metric):
         self._rng = np.random.default_rng(self._seed)
         for m in self.metrics:
             m.reset()
+
+    def _children(self) -> Dict[str, Metric]:
+        """Replicate telemetry rides the reports (``compile_stats`` /
+        ``sync_report`` / ``health_report`` / ``obs_snapshot``) under
+        ``children``. On the vmap fast path the replicates share one stacked
+        state and the template's compiled program — the template's counters
+        are the live ones, exposed as ``template``; the eager replicate
+        clones carry their own counters on the fallback path."""
+        out: Dict[str, Metric] = {"template": self._template}
+        for i, m in enumerate(self.metrics):
+            out[f"bootstrap_{i}"] = m
+        return out

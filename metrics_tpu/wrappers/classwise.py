@@ -1,0 +1,93 @@
+"""Unroll a per-class metric result into individually-keyed scalars.
+
+Parity target: reference ``torchmetrics/wrappers/classwise.py``
+(``ClasswiseWrapper``) — wrap a metric configured with ``average=None`` /
+``average='none'`` (so its ``compute`` returns a per-class vector) and get a
+``{name_label: scalar}`` dict instead, ready for loggers that want flat
+scalar streams.
+
+The wrapper holds exactly one inner metric and adds no state of its own;
+update/forward route straight through, and the inner metric's telemetry
+(``compile_stats`` / ``sync_report`` / ``health_report`` /
+``obs_snapshot``) forwards under ``children`` via the base-class hook.
+"""
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+__all__ = ["ClasswiseWrapper"]
+
+
+class ClasswiseWrapper(Metric):
+    """Wrap a per-class metric so ``compute``/``forward`` return one keyed
+    scalar per class.
+
+    Args:
+        metric: a metric whose ``compute`` returns a 1-d per-class vector
+            (e.g. ``Accuracy(num_classes=C, average=None)``).
+        labels: optional class names; defaults to ``0..C-1``. Keys are
+            ``f"{metricname}_{label}"`` with the metric class name
+            lowercased, matching the reference's naming.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Recall
+        >>> from metrics_tpu.wrappers import ClasswiseWrapper
+        >>> cw = ClasswiseWrapper(Recall(num_classes=3, average=None))
+        >>> cw.update(jnp.asarray([0, 1, 2, 0]), jnp.asarray([0, 1, 1, 0]))
+        >>> print(sorted(cw.compute().keys()))
+        ['recall_0', 'recall_1', 'recall_2']
+    """
+
+    full_state_update = True
+
+    def __init__(self, metric: Metric, labels: Optional[List[str]] = None, **kwargs: Any) -> None:
+        kwargs.setdefault("jit_update", False)  # update mutates the child metric
+        super().__init__(**kwargs)
+        if not isinstance(metric, Metric):
+            raise ValueError(
+                f"Expected argument `metric` to be an instance of `metrics_tpu.Metric` but got {metric}"
+            )
+        if labels is not None and not (
+            isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)
+        ):
+            raise ValueError(
+                f"Expected argument `labels` to be either `None` or a list of strings but got {labels}"
+            )
+        self.metric = metric
+        self.labels = labels
+
+    def _convert(self, x: Array) -> Dict[str, Array]:
+        name = self.metric.__class__.__name__.lower()
+        if self.labels is None:
+            return {f"{name}_{i}": val for i, val in enumerate(x)}
+        return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        return self._convert(self.metric.compute())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Array]]:
+        batch_val = self.metric(*args, **kwargs)
+        self._update_count += 1
+        self._computed = None
+        if batch_val is None or not self.compute_on_step:
+            return None
+        out = self._convert(batch_val)
+        self._forward_cache = out
+        return out
+
+    def reset(self) -> None:
+        super().reset()
+        self.metric.reset()
+
+    def _children(self) -> Dict[str, Metric]:
+        """The wrapped metric's telemetry forwards through this wrapper's
+        reports/snapshot under ``children``."""
+        return {"base": self.metric}

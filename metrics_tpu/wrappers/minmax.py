@@ -13,6 +13,8 @@ import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
 
+__all__ = ["MinMaxMetric"]
+
 Array = jax.Array
 
 
@@ -86,6 +88,12 @@ class MinMaxMetric(Metric):
         self.min_val = jnp.asarray(float("inf"))
         self.max_val = jnp.asarray(float("-inf"))
         self._base_metric.reset()
+
+    def _children(self) -> Dict[str, Metric]:
+        """The wrapped metric's telemetry forwards through this wrapper's
+        reports/snapshot under ``children`` (it does the real compiled
+        updates and any distributed sync)."""
+        return {"base": self._base_metric}
 
     @staticmethod
     def _is_suitable_val(val: Union[int, float, Array]) -> bool:

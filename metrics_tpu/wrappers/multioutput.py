@@ -7,7 +7,7 @@ indexing) and the clones update eagerly — the same host/device split the
 reference has implicitly (its ``index_select`` + mask also materializes on the
 update path, outside any compiled graph).
 """
-from typing import Any, List, Tuple
+from typing import Any, Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -122,3 +122,8 @@ class MultioutputWrapper(Metric):
         super().reset()
         for metric in self.metrics:
             metric.reset()
+
+    def _children(self) -> Dict[str, Metric]:
+        """Per-output clone telemetry forwards through this wrapper's
+        reports/snapshot under ``children`` (keyed ``output_<i>``)."""
+        return {f"output_{i}": m for i, m in enumerate(self.metrics)}

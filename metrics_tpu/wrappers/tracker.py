@@ -146,6 +146,29 @@ class MetricTracker:
             return idx, best
         return best
 
+    # -- telemetry forwarding -------------------------------------------
+    # The tracker is a container, not a Metric; its per-step clones hold the
+    # real counters. These mirror the Metric/MetricCollection report surface
+    # so a tracked metric never drops telemetry (keyed ``step_<i>``).
+    def compile_stats(self) -> Dict[str, Any]:
+        return {"steps": {f"step_{i}": m.compile_stats() for i, m in enumerate(self._steps)}}
+
+    def sync_report(self) -> Dict[str, Any]:
+        return {"steps": {f"step_{i}": m.sync_report() for i, m in enumerate(self._steps)}}
+
+    def health_report(self) -> Dict[str, Any]:
+        return {"steps": {f"step_{i}": m.health_report() for i, m in enumerate(self._steps)}}
+
+    def obs_snapshot(self) -> Dict[str, Any]:
+        """Per-step snapshots (``metrics_tpu.obs.snapshot`` face): one entry
+        per tracked step, newest last, each the full nested snapshot of that
+        step's metric or collection."""
+        return {
+            "class": "MetricTracker",
+            "n_steps": self.n_steps,
+            "steps": {f"step_{i}": m.obs_snapshot() for i, m in enumerate(self._steps)},
+        }
+
     def _check_for_increment(self, method: str) -> None:
         if not self._increment_called:
             raise ValueError(f"`{method}` cannot be called before `.increment()` has been called")

@@ -52,6 +52,19 @@ def tm():
     return torchmetrics
 
 
+@pytest.fixture(autouse=True)
+def _reset_warn_once_registry():
+    """``obs.warn_once`` dedups per key for the PROCESS lifetime — exactly
+    right in production, wrong across independent tests: a warning consumed
+    by one test would silently starve another test's ``pytest.warns``. Each
+    test starts with a fresh registry."""
+    from metrics_tpu.obs.warn import reset_warn_once
+
+    reset_warn_once()
+    yield
+    reset_warn_once()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "x64only: test depends on float64 numerics; skipped in the x32 lane"

@@ -1,0 +1,20 @@
+"""Every obs test starts from a quiet process: bus off, tracing off, empty
+buffers and span aggregates (the warn-once registry is reset by the top-level
+conftest). Restored on exit too, so an assertion failure mid-test can't leak
+an enabled bus into unrelated suites."""
+import pytest
+
+from metrics_tpu import obs
+
+
+@pytest.fixture(autouse=True)
+def _quiet_obs():
+    obs.disable()
+    obs.disable_tracing()
+    obs.bus.clear()
+    obs.trace.clear()
+    yield
+    obs.disable()
+    obs.disable_tracing()
+    obs.bus.clear()
+    obs.trace.clear()

@@ -1,0 +1,122 @@
+"""Event-bus mechanics: typed kinds, bounded buffer, subscribers, capture."""
+import threading
+
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.obs import bus
+
+
+def test_disabled_emit_is_none_and_records_nothing():
+    assert not obs.enabled()
+    assert obs.emit("compile", source="x") is None
+    assert obs.events() == []
+    assert bus.summary()["emitted_total"] == 0
+
+
+def test_emit_and_events_roundtrip():
+    obs.enable()
+    e = obs.emit("compile", source="Accuracy", variant="exact", traces=1)
+    assert e is not None and e.kind == "compile" and e.source == "Accuracy"
+    assert e.data == {"variant": "exact", "traces": 1}
+    evs = obs.events()
+    assert [x.seq for x in evs] == [e.seq]
+    assert obs.events("compile") == evs
+    assert obs.events("retrace") == []
+
+
+def test_unknown_kind_raises_even_when_enabled():
+    obs.enable()
+    with pytest.raises(ValueError, match="Unknown obs event kind"):
+        obs.emit("not_a_kind", source="x")
+
+
+def test_seq_monotonic_and_counts_by_kind():
+    obs.enable()
+    for _ in range(3):
+        obs.emit("cache_hit", source="m")
+    obs.emit("retrace", source="m")
+    seqs = [e.seq for e in obs.events()]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 4
+    summary = bus.summary()
+    assert summary["by_kind"] == {"cache_hit": 3, "retrace": 1}
+    assert summary["emitted_total"] == 4
+    assert summary["enabled"] is True
+
+
+def test_ring_buffer_bounded_and_drops_counted():
+    obs.enable()
+    bus.set_capacity(16)  # clamps at the 16 floor
+    try:
+        for i in range(20):
+            obs.emit("warning", source="w", i=i)
+        summary = bus.summary()
+        assert summary["buffered"] == 16
+        assert summary["dropped"] == 4
+        assert summary["by_kind"]["warning"] == 20  # totals survive eviction
+        # the newest events are the kept ones
+        assert [e.data["i"] for e in obs.events()] == list(range(4, 20))
+    finally:
+        bus.set_capacity(4096)
+
+
+def test_subscriber_sees_events_and_errors_never_break_emitter():
+    obs.enable()
+    seen = []
+
+    def bad(_event):
+        raise RuntimeError("subscriber bug")
+
+    obs.subscribe(seen.append)
+    obs.subscribe(bad)
+    try:
+        obs.emit("compile", source="m")
+        obs.emit("compute", source="m")
+    finally:
+        obs.unsubscribe(seen.append)
+        obs.unsubscribe(bad)
+    assert [e.kind for e in seen] == ["compile", "compute"]
+    assert bus.summary()["subscriber_errors"] == 2
+
+
+def test_capture_restores_previous_enabled_state():
+    assert not obs.enabled()
+    with obs.capture() as events:
+        assert obs.enabled()
+        obs.emit("compile", source="m")
+    assert not obs.enabled()
+    assert [e.kind for e in events] == ["compile"]
+    # already-enabled bus stays enabled after a nested capture
+    obs.enable()
+    with obs.capture(kinds=("retrace",)) as events:
+        obs.emit("compile", source="m")
+        obs.emit("retrace", source="m")
+    assert obs.enabled()
+    assert [e.kind for e in events] == ["retrace"]  # kind filter
+
+
+def test_concurrent_emit_never_tears():
+    obs.enable()
+
+    def hammer(k):
+        for _ in range(200):
+            obs.emit("cache_hit", source=f"t{k}")
+
+    threads = [threading.Thread(target=hammer, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    summary = bus.summary()
+    assert summary["by_kind"]["cache_hit"] == 800
+    seqs = [e.seq for e in obs.events()]
+    assert len(set(seqs)) == len(seqs)  # no duplicated/torn sequence numbers
+
+
+def test_clear_zeroes_counters_but_keeps_enabled_flag():
+    obs.enable()
+    obs.emit("compile", source="m")
+    bus.clear()
+    assert obs.enabled()
+    assert obs.events() == []
+    assert bus.summary()["emitted_total"] == 0

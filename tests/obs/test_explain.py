@@ -1,0 +1,121 @@
+"""Retrace explainer: signature diffs name the changed cache-key component,
+and live engine retraces carry the explanation on their bus events."""
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import Accuracy, obs
+from metrics_tpu.obs import explain
+
+
+def _sig(shapes_dtypes, **kw):
+    class Leaf:
+        def __init__(self, shape, dtype):
+            self.shape = shape
+            self.dtype = dtype
+
+    return explain.signature([Leaf(s, d) for s, d in shapes_dtypes], **kw)
+
+
+def test_no_prior_signature_is_honestly_unknown():
+    verdict = explain.diff(None, _sig([((4,), "f32")]))
+    assert verdict["changed"] == ["unknown"]
+    assert "no prior dispatch signature" in verdict["detail"]
+
+
+def test_aval_change_named_per_leaf():
+    prev = _sig([((4, 3), "f32"), ((4,), "i32")])
+    new = _sig([((8, 3), "f32"), ((8,), "i32")])
+    verdict = explain.diff(prev, new)
+    assert verdict["changed"] == ["avals"]
+    assert "leaf0: (4, 3) -> (8, 3)" in verdict["detail"]
+    assert "leaf1: (4,) -> (8,)" in verdict["detail"]
+
+
+def test_dtype_bucket_donation_screening_changes_named():
+    base = dict(bucket=8, donate=True, screening=("propagate",))
+    prev = _sig([((4,), "float32")], **base)
+    assert explain.diff(prev, _sig([((4,), "float64")], **base))["changed"] == ["dtype"]
+    assert explain.diff(prev, _sig([((4,), "float32")], bucket=16, donate=True, screening=("propagate",)))[
+        "changed"
+    ] == ["bucket"]
+    assert explain.diff(prev, _sig([((4,), "float32")], bucket=8, donate=False, screening=("propagate",)))[
+        "changed"
+    ] == ["donation"]
+    assert explain.diff(prev, _sig([((4,), "float32")], bucket=8, donate=True, screening=("skip",)))[
+        "changed"
+    ] == ["screening"]
+
+
+def test_structure_change_reported_alone():
+    prev = _sig([((4,), "f32")])
+    new = _sig([((4,), "f32"), ((4,), "f32")])
+    verdict = explain.diff(prev, new)
+    assert verdict["changed"] == ["structure"]
+
+
+def test_identical_signature_is_honestly_unknown():
+    sig = _sig([((4,), "f32")])
+    verdict = explain.diff(sig, dict(sig))
+    assert verdict["changed"] == ["unknown"]
+    assert "weak_type" in verdict["detail"]
+
+
+def test_weak_type_drift_visible_in_dtype_component():
+    weak = explain.signature([jnp.asarray(0)])  # python int -> weakly typed
+    strong = explain.signature([jnp.zeros((), jnp.int32)])
+    verdict = explain.diff(weak, strong)
+    assert verdict["changed"] == ["dtype"]
+    assert "(weak)" in verdict["detail"]
+
+
+def test_live_bucket_retrace_event_names_bucket_and_avals():
+    obs.enable()
+    acc = Accuracy(num_classes=3, jit_bucket="pow2")
+    rng = np.random.RandomState(0)
+
+    def batch(n):
+        return (
+            jnp.asarray(rng.rand(n, 3).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 3, size=(n,)).astype(np.int32)),
+        )
+
+    acc.update(*batch(4))  # bucket 4: first compile
+    acc.update(*batch(4))  # possible weak-type second trace — explained, not asserted
+    obs.bus.clear()
+    acc.update(*batch(7))  # bucket 8: a real retrace
+    retraces = obs.events("retrace")
+    assert len(retraces) == 1
+    verdict = retraces[0].data["explain"]
+    assert "bucket" in verdict["changed"]
+    assert "avals" in verdict["changed"]
+    assert retraces[0].source == "Accuracy"
+
+
+def test_live_weak_type_retrace_is_named_not_unknown():
+    obs.enable()
+    acc = Accuracy(num_classes=3, jit_bucket="pow2")
+    p = jnp.asarray([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1]])
+    t = jnp.asarray([0, 1])
+    acc.update(p, t)
+    obs.bus.clear()
+    acc.update(p, t)  # fresh-state weak_type promotion retraces once
+    for event in obs.events("retrace"):
+        verdict = event.data["explain"]
+        assert verdict["changed"] == ["dtype"]
+        assert "(weak)" in verdict["detail"]
+
+
+def test_every_engine_retrace_carries_an_explainer():
+    obs.enable()
+    acc = Accuracy(num_classes=3, jit_bucket="pow2")
+    rng = np.random.RandomState(1)
+    for n in (3, 3, 5, 9, 17, 33):
+        p = jnp.asarray(rng.rand(n, 3).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 3, size=(n,)).astype(np.int32))
+        acc.update(p, t)
+    retraces = obs.events("retrace")
+    assert retraces, "ragged growth must retrace at least once"
+    for event in retraces:
+        verdict = event.data.get("explain")
+        assert verdict and verdict["changed"], event
+        assert verdict["changed"] != ["unknown"], event

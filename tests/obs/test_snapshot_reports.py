@@ -1,0 +1,308 @@
+"""``obs.snapshot()`` vs the three legacy reports, across lifecycle dances:
+clone, reset, checkpoint restore, fused-collection dispatch, pickle, and the
+fault-injection simulated world."""
+import io
+import pickle
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    ConfusionMatrix,
+    F1Score,
+    MeanSquaredError,
+    MetricCollection,
+    SumMetric,
+    engine,
+    obs,
+)
+from metrics_tpu.parallel import new_group
+from metrics_tpu.resilience import FaultSpec, InMemoryKVStore, RetryPolicy, run_as_peers
+from metrics_tpu.utils.checkpoint import load_metric_state, save_metric_state
+from metrics_tpu.wrappers import (
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+)
+
+NUM_CLASSES = 3
+_rng = np.random.RandomState(42)
+_P = jnp.asarray(_rng.rand(16, NUM_CLASSES).astype(np.float32))
+_T = jnp.asarray(_rng.randint(0, NUM_CLASSES, size=(16,)).astype(np.int32))
+
+
+def members():
+    return {
+        "acc": Accuracy(num_classes=NUM_CLASSES),
+        "confmat": ConfusionMatrix(num_classes=NUM_CLASSES),
+        "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+    }
+
+
+def assert_snapshot_matches_reports(metric):
+    """The acceptance invariant: the snapshot sections ARE the legacy dicts."""
+    snap = metric.obs_snapshot()
+    assert snap["compile"] == metric.compile_stats()
+    assert snap["sync"] == metric.sync_report()
+    assert snap["health"] == metric.health_report()
+    assert snap["class"] == type(metric).__name__
+
+
+def test_snapshot_bit_consistent_with_legacy_reports():
+    acc = Accuracy(num_classes=NUM_CLASSES)
+    acc.update(_P, _T)
+    acc.compute()
+    assert_snapshot_matches_reports(acc)
+    assert obs.snapshot(acc) == acc.obs_snapshot()
+
+
+def test_snapshot_requires_a_report_surface():
+    with pytest.raises(TypeError, match="obs_snapshot"):
+        obs.snapshot(42)
+
+
+def test_collection_snapshot_covers_every_member_in_one_call():
+    mc = MetricCollection(members())
+    mc.update(_P, _T)
+    mc.compute()
+    snap = obs.snapshot(mc)
+    assert set(snap["members"]) == {"acc", "confmat", "f1"}
+    for key, m in mc.items():
+        member = snap["members"][key]
+        assert member["compile"] == m.compile_stats()
+        assert member["sync"] == m.sync_report()
+        assert member["health"] == m.health_report()
+    # the fused-dispatch counters are the collection's own, not a member's
+    assert snap["fused_compile"] == {
+        k: v for k, v in mc.compile_stats().items() if k != "members"
+    }
+    # fused dispatch actually ran: the members share one compiled program
+    # (compiled fresh or served from the process-wide cache by a prior test)
+    assert snap["fused_compile"]["compiles"] + snap["fused_compile"]["cache_hits"] >= 1
+
+
+def test_snapshot_consistency_across_clone_and_reset():
+    acc = Accuracy(num_classes=NUM_CLASSES)
+    acc.update(_P, _T)
+    dolly = acc.clone()
+    assert_snapshot_matches_reports(dolly)
+    # clone routes through __setstate__: compile counters are process-local
+    assert dolly.obs_snapshot()["compile"]["compiles"] == 0
+    dolly.update(_P, _T)
+    assert_snapshot_matches_reports(dolly)
+    acc.reset()
+    assert_snapshot_matches_reports(acc)
+    mc = MetricCollection(members())
+    mc.update(_P, _T)
+    cloned = mc.clone()
+    cloned.update(_P, _T)
+    cloned.reset()
+    cloned.update(_P, _T)
+    for key, m in cloned.items():
+        member = cloned.obs_snapshot()["members"][key]
+        assert member["compile"] == m.compile_stats()
+        assert member["health"] == m.health_report()
+
+
+def test_snapshot_consistency_across_checkpoint_restore(tmp_path):
+    src = Accuracy(num_classes=NUM_CLASSES, on_bad_input="skip")
+    bad = np.asarray(_P).copy()
+    bad[0, 0] = np.nan
+    src.update(jnp.asarray(bad), _T)  # quarantined
+    src.update(_P, _T)
+    path = str(tmp_path / "acc.ckpt")
+    save_metric_state(path, src)
+    dst = load_metric_state(path, Accuracy(num_classes=NUM_CLASSES, on_bad_input="skip"))
+    assert_snapshot_matches_reports(dst)
+    # the health counters are registered state: they ride the checkpoint
+    assert dst.obs_snapshot()["health"]["updates_quarantined"] == 1
+    dst.update(_P, _T)
+    assert_snapshot_matches_reports(dst)
+
+
+def test_pickle_preserves_sync_and_health_counters_but_not_compile():
+    acc = Accuracy(num_classes=NUM_CLASSES)
+    acc.update(_P, _T)
+    stats = acc.compile_stats()
+    # dispatched through the shared cache: compiled here or hit a prior program
+    assert stats["compiles"] + stats["cache_hits"] > 0
+    acc._sync_stats["degraded_local"] = 3
+    acc._sync_stats["retries"] = 5
+    acc._health_stats["batches_screened"] = 7
+    restored = pickle.loads(pickle.dumps(acc))
+    assert restored.sync_report()["degraded_local"] == 3
+    assert restored.sync_report()["retries"] == 5
+    assert restored.health_report()["batches_screened"] == 7
+    # compile counters describe this process's shared cache: reset by design
+    assert restored.compile_stats()["compiles"] == 0
+    assert_snapshot_matches_reports(restored)
+    restored.update(_P, _T)
+    np.testing.assert_allclose(np.asarray(restored.compute()), np.asarray(acc.compute()))
+
+
+def test_wrapper_children_forward_every_surface():
+    wrappers = {
+        "minmax": (MinMaxMetric(Accuracy(num_classes=NUM_CLASSES)), ["base"]),
+        "classwise": (
+            ClasswiseWrapper(Accuracy(num_classes=NUM_CLASSES, average=None)),
+            ["base"],
+        ),
+        "multioutput": (
+            MultioutputWrapper(MeanSquaredError(), num_outputs=2),
+            ["output_0", "output_1"],
+        ),
+    }
+    preds2 = jnp.asarray(_rng.rand(8, 2).astype(np.float32))
+    target2 = jnp.asarray(_rng.rand(8, 2).astype(np.float32))
+    for name, (wrapper, child_keys) in wrappers.items():
+        if name == "multioutput":
+            wrapper.update(preds2, target2)
+        else:
+            wrapper.update(_P, _T)
+        for surface in ("compile_stats", "sync_report", "health_report"):
+            report = getattr(wrapper, surface)()
+            assert set(report["children"]) == set(child_keys), (name, surface)
+            for key in child_keys:
+                inner = wrapper._children()[key]
+                assert report["children"][key] == getattr(inner, surface)(), (name, surface)
+        # the snapshot embeds those exact reports — children ride inside each
+        # section, once (no duplicated top-level copy)
+        snap = wrapper.obs_snapshot()
+        assert "children" not in snap
+        for section, surface in (("compile", "compile_stats"), ("sync", "sync_report"), ("health", "health_report")):
+            assert set(snap[section]["children"]) == set(child_keys)
+            for key in child_keys:
+                inner = wrapper._children()[key]
+                assert snap[section]["children"][key] == getattr(inner, surface)()
+
+
+def test_bootstrapper_forwards_replicate_telemetry():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        bs = BootStrapper(MeanSquaredError(), num_bootstraps=3)
+        bs.update(jnp.asarray(_rng.rand(8).astype(np.float32)), jnp.asarray(_rng.rand(8).astype(np.float32)))
+    snap = bs.obs_snapshot()
+    assert "template" in snap["compile"]["children"]
+    assert {f"bootstrap_{i}" for i in range(3)} <= set(snap["compile"]["children"])
+
+
+def test_tracker_snapshots_every_step():
+    tracker = MetricTracker(Accuracy(num_classes=NUM_CLASSES))
+    for _ in range(2):
+        tracker.increment()
+        tracker.update(_P, _T)
+    snap = tracker.obs_snapshot()
+    assert snap["class"] == "MetricTracker"
+    assert set(snap["steps"]) == {"step_0", "step_1"}
+    for i, report in enumerate(tracker.compile_stats()["steps"].values()):
+        assert report == snap["steps"][f"step_{i}"]["compile"]
+    assert set(tracker.sync_report()["steps"]) == {"step_0", "step_1"}
+    assert set(tracker.health_report()["steps"]) == {"step_0", "step_1"}
+
+
+def test_collection_snapshot_computes_each_member_report_once(monkeypatch):
+    """Each member's health report does a device-counter fetch — the snapshot
+    must run it exactly once per member, not once for the member section and
+    again for the cross-member aggregates."""
+    from metrics_tpu.resilience import health as health_mod
+
+    calls = []
+    orig = health_mod.metric_report
+    monkeypatch.setattr(
+        health_mod, "metric_report", lambda m: (calls.append(type(m).__name__), orig(m))[1]
+    )
+    mc = MetricCollection(members())
+    mc.update(_P, _T)
+    calls.clear()
+    mc.obs_snapshot()
+    assert sorted(calls) == ["Accuracy", "ConfusionMatrix", "F1Score"]
+
+
+def test_enabling_bus_changes_no_compiled_program():
+    def run(bus_on):
+        engine.clear_cache()
+        if bus_on:
+            obs.enable()
+            obs.enable_tracing()
+        try:
+            acc = Accuracy(num_classes=NUM_CLASSES, jit_bucket="pow2")
+            for n in (3, 3, 7, 16):
+                acc.update(_P[:n], _T[:n])
+            mc = MetricCollection(members())
+            mc.update(_P, _T)
+            mc.compute()
+            summary = engine.cache_summary()
+            return {k: summary[k] for k in ("compiles", "retraces", "cache_hits", "calls")}
+        finally:
+            obs.disable()
+            obs.disable_tracing()
+
+    assert run(False) == run(True)
+
+
+def test_fault_injected_world_streams_events_and_keeps_reports_consistent():
+    retry = RetryPolicy(max_attempts=3, backoff_base_s=0.01, backoff_max_s=0.05)
+    group = new_group([0, 1], name="obs_snapshot_faults", timeout_s=2.0, retry=retry)
+    store = InMemoryKVStore(
+        [FaultSpec("drop", rank=1, epoch=0), FaultSpec("corrupt", rank=1, epoch=1)]
+    )
+    sums = [SumMetric(process_group=group, on_sync_error="partial") for _ in range(2)]
+    for rank, m in enumerate(sums):
+        m.update(jnp.asarray(float(10**rank)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with obs.capture() as events:
+            first = run_as_peers(2, lambda r: float(sums[r].compute()), store=store)
+            for m in sums:
+                m.update(jnp.asarray(0.0))
+            second = run_as_peers(2, lambda r: float(sums[r].compute()), store=store)
+    assert first[0] == 1.0 and second[0] == 11.0  # the PR-2 guarantees still hold
+    kinds = {e.kind for e in events}
+    assert {"sync_attempt", "sync_retry", "sync_degrade"} <= kinds
+    report = sums[0].sync_report()
+    assert report["retries"] >= 1 and report["degraded_partial"] == 1
+    assert_snapshot_matches_reports(sums[0])
+    # the degradation event carries the policy and outcome the report shows
+    degrades = [e for e in events if e.kind == "sync_degrade" and e.source == "SumMetric"]
+    assert any(e.data["outcome"] == "partial" for e in degrades)
+
+
+def test_jsonl_roundtrip_and_prometheus_render():
+    mc = MetricCollection(members())
+    with obs.capture() as events:
+        mc.update(_P, _T)
+        mc.compute()
+    assert events
+    buf = io.StringIO()
+    written = obs.to_jsonl(buf, events)
+    assert written == len(events)
+    buf.seek(0)
+    assert obs.validate_jsonl(buf) == written
+    text = obs.prometheus_text(mc)
+    assert "metrics_tpu_engine_compiles" in text
+    assert 'metrics_tpu_obs_events_total{kind="' in text
+    assert 'member="acc"' in text
+    # process snapshot embeds the same surfaces the exporters read
+    process = obs.snapshot()
+    assert set(process) == {"engine", "bus", "spans", "warnings"}
+    assert process["engine"] == engine.cache_summary()
+
+
+def test_validate_jsonl_rejects_bad_lines():
+    good = '{"v": 1, "seq": 1, "kind": "compile", "t": 0.0, "source": "m", "data": {}}'
+    assert obs.validate_jsonl(io.StringIO(good)) == 1
+    for bad, match in [
+        ("not json", "not valid JSON"),
+        ('{"v": 1}', "missing fields"),
+        ('{"v": 99, "seq": 1, "kind": "compile", "t": 0.0, "source": "m", "data": {}}', "schema version"),
+        ('{"v": 1, "seq": 1, "kind": "nope", "t": 0.0, "source": "m", "data": {}}', "unknown kind"),
+        ('{"v": 1, "seq": "x", "kind": "compile", "t": 0.0, "source": "m", "data": {}}', "non-numeric"),
+        ('{"v": 1, "seq": 1, "kind": "compile", "t": 0.0, "source": "m", "data": []}', "non-object data"),
+    ]:
+        with pytest.raises(ValueError, match=match):
+            obs.validate_jsonl(io.StringIO(bad))

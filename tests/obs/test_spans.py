@@ -1,0 +1,87 @@
+"""Span semantics: aggregates, fenced vs unfenced, lifecycle instrumentation."""
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import Accuracy, MetricCollection, obs
+from metrics_tpu.obs import trace
+
+
+def test_inactive_span_machinery_is_off_by_default():
+    assert not trace.active()
+    assert trace.span_summary() == {}
+
+
+def test_span_records_aggregates():
+    obs.enable_tracing()
+    with trace.span("compute", "Demo"):
+        pass
+    with trace.span("compute", "Demo"):
+        pass
+    agg = trace.span_summary()["compute"]["Demo"]
+    assert agg["count"] == 2
+    assert agg["total_s"] >= agg["max_s"] >= agg["min_s"] >= 0.0
+    assert agg["mean_s"] == pytest.approx(agg["total_s"] / 2)
+    assert agg["fenced"] is False
+
+
+def test_span_emits_bus_event_and_flags_errors():
+    obs.enable()
+    with pytest.raises(RuntimeError):
+        with trace.span("update", "Demo"):
+            raise RuntimeError("boom")
+    (event,) = obs.events("update")
+    assert event.source == "Demo"
+    assert event.data["error"] is True
+    assert event.data["duration_s"] >= 0.0
+
+
+def test_fenced_span_blocks_on_payload():
+    obs.enable_tracing(fence=True)
+    assert trace.fence_enabled()
+    fetched = []
+    with trace.span("update", "Demo", payload=lambda: fetched.append(1) or jnp.zeros(())):
+        pass
+    assert fetched == [1]
+    assert trace.span_summary()["update"]["Demo"]["fenced"] is True
+    # unfenced spans never call the payload
+    trace.disable_tracing()
+    obs.enable_tracing(fence=False)
+    with trace.span("update", "Demo2", payload=lambda: fetched.append(2)):
+        pass
+    assert fetched == [1]
+    assert trace.span_summary()["update"]["Demo2"]["fenced"] is False
+
+
+def test_metric_lifecycle_phases_recorded():
+    obs.enable_tracing()
+    acc = Accuracy(num_classes=3)
+    p = jnp.asarray([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1]])
+    t = jnp.asarray([0, 1])
+    acc.update(p, t)
+    acc.compute()
+    summary = trace.span_summary()
+    assert summary["update"]["Accuracy"]["count"] == 1
+    assert summary["compute"]["Accuracy"]["count"] == 1
+
+
+def test_collection_lifecycle_phases_recorded():
+    obs.enable_tracing()
+    mc = MetricCollection({"acc": Accuracy(num_classes=3)})
+    p = jnp.asarray([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1]])
+    t = jnp.asarray([0, 1])
+    mc.update(p, t)
+    mc.compute()
+    mc.forward(p, t)
+    summary = trace.span_summary()
+    assert summary["update"]["MetricCollection"]["count"] == 1
+    assert summary["compute"]["MetricCollection"]["count"] == 1
+    assert summary["forward"]["MetricCollection"]["count"] == 1
+
+
+def test_disabled_tracing_adds_no_spans_around_lifecycle():
+    acc = Accuracy(num_classes=3)
+    p = jnp.asarray([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1]])
+    t = jnp.asarray([0, 1])
+    acc.update(p, t)
+    acc.compute()
+    assert trace.span_summary() == {}

@@ -1,0 +1,92 @@
+"""``warn_once``: once-per-key dedup, counting, bus events, env escape hatch."""
+import warnings
+
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.obs.warn import reset_warn_once, seen_count, warn_counts, warn_once
+
+
+def test_warn_once_dedups_per_key():
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert warn_once("hello", key="k") is True
+        assert warn_once("hello", key="k") is False
+        assert warn_once("hello", key="k") is False
+    assert len(w) == 1
+    assert "hello" in str(w[0].message)
+    assert seen_count("k") == 3  # suppressed repeats still counted
+
+
+def test_default_key_is_message_and_category():
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        warn_once("msg a")
+        warn_once("msg a")
+        warn_once("msg b")
+        warn_once("msg a", category=DeprecationWarning)  # distinct category -> distinct key
+    assert [str(x.message) for x in w] == ["msg a", "msg b", "msg a"]
+    assert warn_counts()[("msg a", "UserWarning")] == 2
+
+
+def test_reset_rearms_one_key_or_all():
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        warn_once("again", key="k1")
+        warn_once("other", key="k2")
+        reset_warn_once("k1")
+        warn_once("again", key="k1")  # re-armed
+        warn_once("other", key="k2")  # still suppressed
+    assert [str(x.message) for x in w] == ["again", "other", "again"]
+    reset_warn_once()
+    assert warn_counts() == {}
+
+
+def test_first_emission_lands_on_bus_with_repeat_count():
+    obs.enable()
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        warn_once("streamed", key="bk")
+        warn_once("streamed", key="bk")
+    events = obs.events("warning")
+    assert len(events) == 1  # dedup applies to the stream too
+    assert events[0].data["message"] == "streamed"
+    assert events[0].data["repeat"] == 0
+
+
+def test_env_escape_hatch_disables_dedup(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_WARN_EVERY", "1")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        warn_once("every time", key="e")
+        warn_once("every time", key="e")
+    assert len(w) == 2
+
+
+def test_off_rank_process_is_silent_but_counted(monkeypatch):
+    from metrics_tpu.obs import warn as warn_mod
+
+    monkeypatch.setattr(warn_mod, "_rank", lambda: 1)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert warn_once("rank gated", key="r") is False
+    assert w == []
+    assert seen_count("r") == 1
+
+
+def test_compute_before_update_warns_once_per_instance():
+    from metrics_tpu import Accuracy, MeanSquaredError
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        mse = MeanSquaredError()
+        mse.compute()  # warns (nan result, no update yet)
+        mse._computed = None  # defeat result caching; still pre-update
+        mse.compute()  # same instance: deduplicated
+        MeanSquaredError().compute()  # sibling instance: its own misuse, warns
+        with pytest.raises(RuntimeError):
+            Accuracy(num_classes=3).compute()  # undetermined mode, post-warning
+    msgs = [str(x.message) for x in w if "was called before" in str(x.message)]
+    assert len(msgs) == 3
+    assert sum("MeanSquaredError" in m for m in msgs) == 2
+    assert sum("Accuracy" in m for m in msgs) == 1
