@@ -1382,6 +1382,132 @@ def bench_obs_smoke() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# scan-fused evaluation driver vs the per-step Python loop
+# ---------------------------------------------------------------------------
+def bench_eval_driver() -> dict:
+    """The device-resident epoch executor (``engine.drive``) vs the per-step
+    loop on the headline classification collection, plus the async coalesced
+    results plane. Asserted by the ``ci.sh --driver-smoke`` lane:
+
+    1. **Epoch throughput** — one scan-fused launch per epoch must beat N
+       per-step fused-collection dispatches by >= 2x on the CPU lane (the
+       loop pays one host dispatch + one Python bookkeeping pass per step;
+       the driver pays one). Min-over-epochs estimator, warm programs, state
+       fetch forced at the end of each timed epoch.
+    2. **Bit-identity** — the driven states equal the looped states exactly.
+    3. **One transfer per collection** — resolving a ``compute_async()``
+       handle issues exactly ONE coalesced device→host transfer
+       (``engine.fetch_stats``), with values bitwise-equal to ``compute()``.
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, MetricCollection, engine
+
+    steps = 32 if _small() else 64
+    batch = 64
+    rng = np.random.RandomState(7)
+    preds = jnp.asarray(rng.rand(steps, batch, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, size=(steps, batch)).astype(np.int32))
+
+    def members():
+        return {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "confmat": ConfusionMatrix(num_classes=NUM_CLASSES),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+        }
+
+    def _drain(mc):
+        for _, m in mc.items(keep_base=True):
+            _force(m._snapshot_state())
+
+    # instances are reused across epochs with reset(), the real eval-loop
+    # shape: the one-time python-init probe and the compiles land in the
+    # warmup epoch, not the timed region
+    mc_loop = MetricCollection(members())
+    mc_drive = MetricCollection(members())
+
+    def run_loop():
+        mc_loop.reset()
+        t0 = time.perf_counter()
+        for i in range(steps):
+            mc_loop.update(preds[i], target[i])
+        _drain(mc_loop)
+        return time.perf_counter() - t0, mc_loop
+
+    def run_drive():
+        mc_drive.reset()
+        t0 = time.perf_counter()
+        engine.drive(mc_drive, (preds, target))
+        _drain(mc_drive)
+        return time.perf_counter() - t0, mc_drive
+
+    # warm both program families: compiles stay out of the timed region
+    run_loop()
+    run_drive()
+    parity_ok = True
+    for (k, a), (_, b) in zip(mc_loop.items(keep_base=True), mc_drive.items(keep_base=True)):
+        sa, sb = a._snapshot_state(), b._snapshot_state()
+        for name in sa:
+            if not np.array_equal(np.asarray(sa[name]), np.asarray(sb[name])):
+                parity_ok = False
+    loop_s = min(run_loop()[0] for _ in range(5))
+    drive_s = min(run_drive()[0] for _ in range(5))
+    loop_sps = steps * batch / loop_s
+    drive_sps = steps * batch / drive_s
+
+    # -- async coalesced results plane ----------------------------------
+    mc = MetricCollection(members())
+    engine.drive(mc, (preds, target))
+    _drain(mc)
+    engine.reset_fetch_stats()
+    handle = mc.compute_async()
+    async_vals = handle.result()
+    handle.result()  # resolving twice must not re-fetch
+    async_fetches = engine.fetch_stats()["async_fetches"]
+    blocking_vals = mc.compute()
+    async_equal = set(async_vals) == set(blocking_vals) and all(
+        np.array_equal(np.asarray(async_vals[k]), np.asarray(blocking_vals[k]))
+        for k in blocking_vals
+    )
+
+    # fetch latency at a logging point: per-member blocking np fetches vs
+    # one coalesced async resolve, pending work drained so only the fetch
+    # path lands in the timed region
+    def _invalidate():
+        mc.update(preds[0], target[0])
+        _drain(mc)
+
+    blocking_ms, async_ms = [], []
+    for _ in range(7):
+        _invalidate()
+        t0 = time.perf_counter()
+        out = mc.compute()
+        for v in out.values():
+            np.asarray(v)  # one blocking device->host fetch per metric
+        blocking_ms.append((time.perf_counter() - t0) * 1000)
+        _invalidate()
+        t0 = time.perf_counter()
+        mc.compute_async().result()  # one coalesced fetch per collection
+        async_ms.append((time.perf_counter() - t0) * 1000)
+
+    return {
+        "metric": "eval_driver",
+        "value": round(drive_sps / loop_sps, 3),
+        "unit": "x_speedup_vs_per_step_loop",
+        "vs_baseline": None,
+        "loop_samples_per_sec": round(loop_sps, 1),
+        "drive_samples_per_sec": round(drive_sps, 1),
+        "parity_ok": parity_ok,
+        "async_fetches": async_fetches,
+        "async_equal": async_equal,
+        "blocking_fetch_ms": round(float(np.median(blocking_ms)), 3),
+        "async_fetch_ms": round(float(np.median(async_ms)), 3),
+        "steps": steps,
+        "batch": batch,
+    }
+
+
+# ---------------------------------------------------------------------------
 # module-API compute() latency on the live backend
 # ---------------------------------------------------------------------------
 def bench_compute_latency() -> dict:
@@ -1464,6 +1590,7 @@ _CONFIGS = [
     ("bench_sync_resilience", 600, False),
     ("bench_health_screening", 900, True),
     ("bench_obs_smoke", 600, False),
+    ("bench_eval_driver", 900, False),
 ]
 
 _PERSIST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_PARTIAL.json")
@@ -1712,6 +1839,23 @@ def main() -> None:
             jax.config.update("jax_platforms", forced)
         os.environ.setdefault("METRICS_TPU_BENCH_SMALL", "1")
         result = bench_obs_smoke()
+        for key, value in _stamp().items():
+            result.setdefault(key, value)
+        emit(result)
+        return
+
+    if "--driver-smoke" in sys.argv:
+        # CI eval-driver smoke: scan-fused epoch vs per-step loop speedup,
+        # bit-identity, one coalesced transfer per compute_async resolve —
+        # one JSON line (platform pin through jax.config — see --smoke for
+        # why).
+        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
+        if forced:
+            import jax
+
+            jax.config.update("jax_platforms", forced)
+        os.environ.setdefault("METRICS_TPU_BENCH_SMALL", "1")
+        result = bench_eval_driver()
         for key, value in _stamp().items():
             result.setdefault(key, value)
         emit(result)

@@ -99,4 +99,36 @@ print("obs smoke OK:", line)
 }
 obs_smoke || { echo "obs smoke attempt 1 failed; retrying once"; obs_smoke; }
 
+echo "=== eval-driver smoke (scan-fused epoch vs per-step loop, async coalesced fetch) ==="
+# bit-identity and the one-transfer contract must hold on EVERY attempt; the
+# >=2x throughput gate gets one retry (min-based, but a fully throttled CI
+# box can still blanket a whole measurement window)
+driver_smoke() {
+JAX_PLATFORMS=cpu python bench.py --driver-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "eval_driver", obj
+# contract failures (exit 2) are never retried: the driven states must
+# equal the per-step loop bit-for-bit, and resolving a compute_async
+# handle must be exactly ONE coalesced device->host transfer (resolved
+# twice in the bench: still one)
+if obj["parity_ok"] is not True:
+    print("scan-fused epoch diverged from the per-step loop:", line); sys.exit(2)
+if obj["async_fetches"] != 1 or obj["async_equal"] is not True:
+    print("compute_async contract violated:", line); sys.exit(2)
+# the throughput gate (exit 3) is the only retryable condition: one
+# scan-fused launch per epoch beats N per-step dispatches >= 2x (CPU lane)
+if obj["value"] < 2.0:
+    print("driver speedup %sx < 2x: %s" % (obj["value"], line)); sys.exit(3)
+print("driver smoke OK:", line)
+'
+}
+driver_rc=0; driver_smoke || driver_rc=$?
+if [ "$driver_rc" -eq 3 ]; then
+  echo "driver throughput gate failed; retrying once"
+  driver_rc=0; driver_smoke || driver_rc=$?
+fi
+[ "$driver_rc" -eq 0 ] || exit "$driver_rc"
+
 echo "both lanes green"

@@ -5,6 +5,7 @@ Parity: reference ``torchmetrics/classification/auc.py:22``.
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.auc import _auc_compute, _auc_update
 from metrics_tpu.metric import Metric
@@ -30,8 +31,9 @@ class AUC(Metric):
     def __init__(self, reorder: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.reorder = reorder
-        self.add_state("x", default=[], dist_reduce_fx="cat")
-        self.add_state("y", default=[], dist_reduce_fx="cat")
+        float_dtype = jnp.zeros(()).dtype  # lane-default float placeholder
+        self.add_state("x", default=[], dist_reduce_fx="cat", placeholder=float_dtype)
+        self.add_state("y", default=[], dist_reduce_fx="cat", placeholder=float_dtype)
 
     def update(self, x: Array, y: Array) -> None:
         x, y = _auc_update(x, y)

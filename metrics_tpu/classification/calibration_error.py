@@ -42,8 +42,9 @@ class CalibrationError(Metric):
         self.norm = norm
         self.bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
 
-        self.add_state("confidences", [], dist_reduce_fx="cat")
-        self.add_state("accuracies", [], dist_reduce_fx="cat")
+        float_dtype = jnp.zeros(()).dtype  # lane-default float placeholder
+        self.add_state("confidences", [], dist_reduce_fx="cat", placeholder=float_dtype)
+        self.add_state("accuracies", [], dist_reduce_fx="cat", placeholder=float_dtype)
 
     def update(self, preds: Array, target: Array) -> None:
         confidences, accuracies = _ce_update(preds, target)

@@ -27,6 +27,10 @@ class HingeLoss(Metric):
 
     is_differentiable = True
     higher_is_better = False
+    # one-vs-all update reassigns the scalar ``measure`` default to ``[C]``:
+    # a rank that never updated still holds the scalar, so the host-sync
+    # fixed-shape fast path must not assume registration shape for it
+    _shape_polymorphic_states = frozenset({"measure"})
 
     def __init__(
         self,

@@ -84,7 +84,10 @@ class StatScores(Metric):
                 self.add_state(s, default=jnp.zeros(zeros_shape, dtype=int_dtype), dist_reduce_fx="sum")
         else:
             for s in ("tp", "fp", "tn", "fn"):
-                self.add_state(s, default=[], dist_reduce_fx="cat")
+                # samplewise rows accumulate in the lane-default int; declare
+                # it so a sample-less rank's empty-gather contribution can't
+                # inject float32 into the int cat (comm.empty_placeholder)
+                self.add_state(s, default=[], dist_reduce_fx="cat", placeholder=jnp.asarray(0).dtype)
 
     def update(self, preds: Array, target: Array) -> None:
         tp, fp, tn, fn = _stat_scores_update(

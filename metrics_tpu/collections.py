@@ -65,6 +65,12 @@ class MetricCollection:
         {'acc': 0.75, 'f1': 0.7333}
     """
 
+    # set by a mesh-mode ``engine.drive``: members hold the globally-synced
+    # accumulation, so the fused update/forward paths (which bypass the
+    # per-member guard in ``Metric._wrap_update``) must also refuse host-side
+    # accumulation until reset()
+    _drive_synced = False
+
     def __init__(
         self,
         metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
@@ -111,6 +117,7 @@ class MetricCollection:
             return self._forward_impl(*args, **kwargs)
 
     def _forward_impl(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        self._raise_if_drive_synced()
         was_failed = self._fused_fwd_failed
         fused_vals = self._fused_forward(args, kwargs)
         out: Dict[str, Any] = {}
@@ -138,6 +145,7 @@ class MetricCollection:
             self._update_members(*args, **kwargs)
 
     def _update_members(self, *args: Any, **kwargs: Any) -> None:
+        self._raise_if_drive_synced()
         was_failed = self._fused_failed
         done = self._fused_update(args, kwargs)
         try:
@@ -152,6 +160,18 @@ class MetricCollection:
             # fused path for later, correct, updates
             self._fused_failed = was_failed
             raise
+
+    def _raise_if_drive_synced(self) -> None:
+        if self._drive_synced:
+            from metrics_tpu.utils.exceptions import MetricsUserError
+
+            raise MetricsUserError(
+                "This MetricCollection holds the globally-synced state of a"
+                " mesh-mode engine.drive: a host-side update/forward would be"
+                " dropped from (or double-counted in) the cross-rank total."
+                " reset() first, or accumulate further epochs through"
+                " drive(mesh=...)."
+            )
 
     # -- fused update (one XLA program for all jit-compatible members) ---
     def _fusable_keys(self) -> Tuple[str, ...]:
@@ -346,6 +366,18 @@ class MetricCollection:
         with _obs_trace.span("compute", "MetricCollection"):
             return self._compute_members()
 
+    def compute_async(self) -> Any:
+        """:meth:`compute` with the device→host fetch deferred and coalesced
+        into ONE ``jax.device_get`` for the whole collection — one transfer
+        per collection instead of one blocking fetch per metric. The compute
+        dispatches normally (fused where possible); the returned
+        :class:`~metrics_tpu.engine.driver.AsyncResult` starts the copies
+        without blocking and resolves on ``.result()`` with values bitwise
+        equal to :meth:`compute`'s. See ``docs/performance.md``."""
+        from metrics_tpu.engine.driver import async_compute
+
+        return async_compute(self)
+
     def _compute_members(self) -> Dict[str, Any]:
         fused_vals = self._fused_compute()
         out: Dict[str, Any] = {}
@@ -516,7 +548,8 @@ class MetricCollection:
         from metrics_tpu.parallel import comm
 
         reductions = {k: m._reductions for k, m in self.items()}
-        return comm.sync_state_trees(states, reductions, axis_name)
+        placeholders = {k: m._list_placeholders for k, m in self.items()}
+        return comm.sync_state_trees(states, reductions, axis_name, placeholders=placeholders)
 
     def compute_state(self, states: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         """Pure compute: ``states -> {key: value}``. Safe inside jit."""
@@ -530,6 +563,7 @@ class MetricCollection:
         return {k: m.merge_states(states_a[k], states_b[k]) for k, m in self.items()}
 
     def reset(self) -> None:
+        self._drive_synced = False
         for _, m in self.items(keep_base=True):
             m.reset()
         # re-probe fused-compute exclusions next epoch: a one-off host-side

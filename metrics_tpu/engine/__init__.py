@@ -12,10 +12,17 @@ compiled transition a process-wide resource instead of a per-instance one:
 * :mod:`metrics_tpu.engine.bucketing` — opt-in ``jit_bucket='pow2'`` batch
   padding with an exact row-additive correction, capping retraces at
   O(log max_batch) for ragged streaming batches.
+* :mod:`metrics_tpu.engine.driver` — device-resident epoch execution:
+  :func:`drive` scan-fuses a whole evaluation epoch into one XLA launch
+  (ragged tails absorbed by the bucketing correction, host iterators
+  streamed with double-buffered prefetch, optional in-trace compute/sync),
+  and the async results plane (:func:`async_compute` /
+  ``Metric.compute_async`` / ``MetricCollection.compute_async``) coalesces
+  every result fetch into one ``jax.device_get`` per collection.
 
 Introspection: ``Metric.compile_stats()`` for one instance,
 :func:`cache_summary` for the whole process, ``clear_cache()`` between
-experiments.
+experiments; ``driver.fetch_stats()`` for the async results plane.
 """
 from metrics_tpu.engine.bucketing import (  # noqa: F401
     bucket_spec,
@@ -29,6 +36,7 @@ from metrics_tpu.engine.cache import (  # noqa: F401
     cache_summary,
     clear_cache,
     donation_enabled,
+    driver_entry,
     ensure_python_init,
     fused_entry,
     guard_donated_state,
@@ -38,4 +46,12 @@ from metrics_tpu.engine.cache import (  # noqa: F401
     rollback_state,
     set_donation,
     update_transition,
+)
+from metrics_tpu.engine.driver import (  # noqa: F401
+    AsyncResult,
+    DriveResult,
+    async_compute,
+    drive,
+    fetch_stats,
+    reset_fetch_stats,
 )

@@ -631,6 +631,187 @@ def update_transition(metric: Any, state: Dict[str, Any], args: Tuple[Any, ...],
     )
 
 
+def _make_driver_entry(
+    cache_key: Any,
+    keys: Tuple[str, ...],
+    pins: Tuple,
+    compute_keys: Tuple[str, ...],
+    axis_name: Optional[str],
+    mesh: Optional[Any],
+) -> SharedEntry:
+    """One scan-fused epoch program family (``metrics_tpu.engine.driver``).
+
+    The scan body is the SAME health-screened transition every per-step
+    engine program compiles (``resilience/health.traced_update``), so the
+    driver's ``on_bad_input`` semantics match the per-step loop by
+    construction. Variants: ``scan`` (uniform steps), ``scan_pad`` (per-step
+    zero-row pad counts — the pow2-bucketing correction absorbing a ragged
+    final batch / partial final chunk), each with a ``*_cmp`` twin folding
+    the members' ``compute_state`` into the same program; ``shard_*``
+    variants wrap the epoch in ``shard_map`` over ``axis_name``/``mesh``
+    (steps sharded across devices, states synced in-trace, prior state
+    merged back in) so a full sharded eval epoch is one XLA launch.
+    """
+    entry = SharedEntry(cache_key, "driver", pins)
+    # mesh variants scan from the defaults and merge the (replicated) prior
+    # state AFTER the in-trace sync — donating the prior would consume the
+    # caller's live accumulation, so donation is local-variant only
+    entry.donate = donation_enabled() and mesh is None
+
+    def _step(carry, step_leaves, pad, treedef):
+        args, kwargs = jax.tree_util.tree_unflatten(treedef, list(step_leaves))
+        new: Dict[str, Any] = {}
+        with _health.shared_screening():  # one detection pass per input leaf
+            for key, member in zip(keys, entry.cell):
+                new[key] = _health.traced_update(
+                    member, carry[key], args, member._filter_kwargs(**kwargs), pad_count=pad
+                )
+        return new
+
+    def _scan_epoch(states, leaves, pads, treedef):
+        def body(carry, step):
+            step_leaves, pad = step if pads is not None else (step, None)
+            return _step(carry, step_leaves, pad, treedef), None
+
+        xs = tuple(leaves) if pads is None else (tuple(leaves), pads)
+        out, _ = jax.lax.scan(body, states, xs)
+        return out
+
+    def _values(states):
+        vals: Dict[str, Any] = {}
+        for key, member in zip(keys, entry.cell):
+            if key in compute_keys:
+                member._restore_state(states[key])
+                vals[key] = member._compute_impl()
+        return vals
+
+    def _sync_and_merge(states, prior):
+        from metrics_tpu.parallel import comm
+
+        members = list(entry.cell)
+        reductions = {k: m._reductions for k, m in zip(keys, members)}
+        placeholders = {k: m._list_placeholders for k, m in zip(keys, members)}
+        synced = comm.sync_state_trees(states, reductions, axis_name, placeholders=placeholders)
+        return {k: m.merge_states(prior[k], synced[k]) for k, m in zip(keys, members)}
+
+    def build(donate: bool) -> None:
+        argnums = (0,) if donate else ()
+
+        def scan(states, leaves, treedef):
+            entry.mark_trace("scan")
+            return _scan_epoch(states, leaves, None, treedef)
+
+        def scan_pad(states, leaves, pads, treedef):
+            entry.mark_trace("scan_pad")
+            return _scan_epoch(states, leaves, pads, treedef)
+
+        def scan_cmp(states, leaves, treedef):
+            entry.mark_trace("scan_cmp")
+            out = _scan_epoch(states, leaves, None, treedef)
+            return out, _values(out)
+
+        def scan_pad_cmp(states, leaves, pads, treedef):
+            entry.mark_trace("scan_pad_cmp")
+            out = _scan_epoch(states, leaves, pads, treedef)
+            return out, _values(out)
+
+        entry._fns = {
+            "scan": jax.jit(scan, static_argnums=(2,), donate_argnums=argnums),
+            "scan_pad": jax.jit(scan_pad, static_argnums=(3,), donate_argnums=argnums),
+            "scan_cmp": jax.jit(scan_cmp, static_argnums=(2,), donate_argnums=argnums),
+            "scan_pad_cmp": jax.jit(scan_pad_cmp, static_argnums=(3,), donate_argnums=argnums),
+        }
+        if axis_name is None or mesh is None:
+            return
+        from jax.sharding import PartitionSpec as _P
+
+        if hasattr(jax, "shard_map"):  # jax >= 0.6 top-level spelling
+            _shard_map = jax.shard_map
+            _check_kw = "check_vma"
+        else:
+            from jax.experimental.shard_map import shard_map as _shard_map
+
+            _check_kw = "check_rep"
+
+        def _shard(fn, n_sharded_args):
+            kw = dict(
+                mesh=mesh,
+                in_specs=(_P(),) + (_P(axis_name),) * n_sharded_args,
+                out_specs=_P(),
+            )
+            kw[_check_kw] = False
+            return _shard_map(fn, **kw)
+
+        def _shard_variant(name, padded, compute):
+            def outer(prior, leaves, *rest):
+                pads_arg = rest[0] if padded else None
+                treedef = rest[-1]
+
+                def inner(prior, leaves, *maybe_pads):
+                    entry.mark_trace(name)
+                    fresh = {k: m.init_state() for k, m in zip(keys, entry.cell)}
+                    out = _scan_epoch(
+                        fresh, leaves, maybe_pads[0] if padded else None, treedef
+                    )
+                    merged = _sync_and_merge(out, prior)
+                    if compute:
+                        return merged, _values(merged)
+                    return merged
+
+                shard_args = (tuple(leaves),) + ((pads_arg,) if padded else ())
+                return _shard(inner, 1 + int(padded))(prior, *shard_args)
+
+            return jax.jit(outer, static_argnums=(3,) if padded else (2,))
+
+        entry._fns.update(
+            {
+                "shard_scan": _shard_variant("shard_scan", False, False),
+                "shard_scan_pad": _shard_variant("shard_scan_pad", True, False),
+                "shard_scan_cmp": _shard_variant("shard_scan_cmp", False, True),
+                "shard_scan_pad_cmp": _shard_variant("shard_scan_pad_cmp", True, True),
+            }
+        )
+
+    entry._build = build
+    build(entry.donate)
+    return entry
+
+
+def driver_entry(
+    keys: Tuple[str, ...],
+    members: List[Any],
+    compute_keys: Tuple[str, ...] = (),
+    axis_name: Optional[str] = None,
+    mesh: Optional[Any] = None,
+) -> SharedEntry:
+    """Shared entry for one scan-fused epoch program: keyed by the member
+    names, every member's fingerprint, the in-trace-compute member subset,
+    and the sync axis/mesh — so instances, clones, and identical collections
+    share one compiled epoch per (steps, batch) signature."""
+    member_keys: List[Any] = []
+    pins: List[Any] = []
+    for m in members:
+        k, p = metric_fingerprint(m)
+        member_keys.append(k)
+        pins.extend(p)
+    if mesh is not None:
+        pins.append(mesh)  # id-keyed below: pin against recycling
+    cache_key = (
+        "driver",
+        tuple(keys),
+        tuple(member_keys),
+        tuple(compute_keys),
+        axis_name,
+        id(mesh) if mesh is not None else None,
+    )
+    return _get_or_create(
+        cache_key,
+        lambda: _make_driver_entry(
+            cache_key, tuple(keys), tuple(pins), tuple(compute_keys), axis_name, mesh
+        ),
+    )
+
+
 def fused_entry(kind: str, keys: Tuple[str, ...], members: List[Any]) -> SharedEntry:
     """Shared entry for a collection's fused program: keyed by the member
     names *and* every member's fingerprint, so clones of one collection (and

@@ -1,0 +1,806 @@
+"""Device-resident evaluation driver: scan-fused epochs, prefetch, async fetch.
+
+The PR-1 engine made each ``update()`` dispatch cheap; an evaluation epoch
+was still N Python round-trips — per-step host dispatch, per-step
+bookkeeping, and a blocking per-metric device→host fetch at every logging
+point. This module is the execution layer that removes the host from the
+loop (the whole-program discipline of arXiv:1810.09868 / the pjit step
+fusion of arXiv:2204.06514), driving the pure state API the library has
+exposed since PR 0:
+
+* **One program per epoch.** :func:`drive` compiles a single XLA program
+  that ``lax.scan``s the pure update transition over a leading steps axis
+  (carry = state tree, donated on donating backends). The scan body is the
+  SAME health-screened transition every per-step engine program compiles
+  (``resilience/health.traced_update``), so ``on_bad_input='skip'/'mask'``
+  semantics inside the scan match the per-step loop bit-identically.
+
+* **Ragged tails don't retrace.** A final batch with fewer rows is folded
+  into the same program through the PR-1 pow2-bucketing correction: the
+  short batch is zero-padded to the chunk's batch size and the pad rows'
+  contribution subtracted exactly (row-additive metrics; others fall back
+  to a per-step tail dispatch). A partial final *chunk* in streaming mode is
+  absorbed the same way — whole pad steps with ``pad_count = batch``.
+
+* **Host iterators stream.** Data arriving as a host iterator is chunked
+  into ``[K, batch]`` super-steps with double-buffered host→device
+  prefetch: chunk ``i`` is dispatched asynchronously, then chunk ``i+1`` is
+  pulled, stacked, and staged onto the device while ``i`` executes.
+
+* **One launch per sharded epoch.** ``compute_in_trace=True`` folds
+  ``compute_state`` into the same program; ``axis_name=``/``mesh=`` fold
+  the in-trace sync (``parallel/comm.sync_state_trees``) in too — steps are
+  sharded across the mesh axis, each shard scans its slice from the
+  defaults, states are synced with one collective per leaf, merged with the
+  prior (replicated) accumulation, and computed — a full sharded eval epoch
+  in a single XLA launch under ``shard_map``.
+
+* **Async, coalesced results.** :func:`async_compute` (surfaced as
+  ``Metric.compute_async()`` / ``MetricCollection.compute_async()``)
+  returns a lazy :class:`AsyncResult` backed by ONE coalesced
+  ``jax.device_get`` of the entire results tree — one transfer per
+  collection instead of one blocking fetch per metric, with the
+  device→host copies started eagerly so logging overlaps the next step.
+
+Driver programs live in the PR-1 process-wide cache (``engine.cache``,
+entry kind ``driver``) shared across instances and clones, emit
+compile/cache_hit/retrace events through the PR-4 bus with retrace-explainer
+coverage, and each :func:`drive` is timed by a ``drive`` obs span.
+
+Members a scan cannot honor keep their per-step contracts instead of losing
+them: list-state/eager-fallback metrics, ``on_bad_input='raise'`` (its
+per-update host check is the point), and the warn-on-removal/-non-additive
+mask policies are driven through the ordinary per-step path inside the same
+:func:`drive` call.
+"""
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.engine import bucketing as _bucketing
+from metrics_tpu.engine import cache as _cache
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.obs import trace as _trace
+from metrics_tpu.resilience import health as _health
+
+Array = jax.Array
+
+__all__ = ["AsyncResult", "DriveResult", "async_compute", "drive", "fetch_stats", "reset_fetch_stats"]
+
+
+# ---------------------------------------------------------------------------
+# async coalesced results plane
+# ---------------------------------------------------------------------------
+_UNSET = object()
+_FETCH_LOCK = threading.Lock()
+_FETCH_STATS = {"async_fetches": 0, "coalesced_leaves": 0}
+
+
+def fetch_stats() -> Dict[str, int]:
+    """Process-wide async-fetch telemetry: ``async_fetches`` counts resolved
+    :class:`AsyncResult` handles (== device→host transfers issued by the
+    async results plane — the smoke test asserts exactly one per collection),
+    ``coalesced_leaves`` the result leaves those transfers carried."""
+    with _FETCH_LOCK:
+        return dict(_FETCH_STATS)
+
+
+def reset_fetch_stats() -> None:
+    with _FETCH_LOCK:
+        _FETCH_STATS["async_fetches"] = 0
+        _FETCH_STATS["coalesced_leaves"] = 0
+
+
+class AsyncResult:
+    """Lazy handle over a device-resident results tree.
+
+    Construction starts the device→host copies (``copy_to_host_async`` per
+    leaf) without blocking, so the transfer overlaps whatever the host does
+    next — typically dispatching the next step. :meth:`result` resolves the
+    handle with ONE coalesced ``jax.device_get`` of the whole tree (counted
+    in :func:`fetch_stats` and emitted as a ``fetch`` bus event); the host
+    values are cached, so resolving twice costs one transfer.
+    """
+
+    __slots__ = ("_tree", "_host", "_source", "_n_leaves", "_lock")
+
+    def __init__(self, tree: Any, source: str = "") -> None:
+        self._tree = tree
+        self._host: Any = _UNSET
+        self._source = source
+        self._lock = threading.Lock()
+        leaves = jax.tree_util.tree_leaves(tree)
+        self._n_leaves = len(leaves)
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array):
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — eager D2H is an optimization only
+                    pass
+
+    def ready(self) -> bool:
+        """True when every device leaf has finished computing (resolving
+        would not block on device execution)."""
+        if self._host is not _UNSET:
+            return True
+        for leaf in jax.tree_util.tree_leaves(self._tree):
+            is_ready = getattr(leaf, "is_ready", None)
+            if callable(is_ready) and not is_ready():
+                return False
+        return True
+
+    def result(self) -> Any:
+        """The results tree with numpy leaves — bitwise the values a blocking
+        ``compute()`` fetch would have produced."""
+        if self._host is _UNSET:
+            # the documented use is cross-thread (a logger thread resolves
+            # while the training thread steps): resolution is one PER-HANDLE
+            # critical section, so concurrent resolvers of this handle see
+            # either _UNSET -> fetch once, or the cached host tree — never a
+            # cleared _tree — while other handles resolve concurrently. The
+            # process-global _FETCH_LOCK guards only the counter bump, and
+            # neither it nor the handle lock is held across the bus emit:
+            # device_get can block on a still-executing epoch, and a bus
+            # subscriber runs arbitrary code (a 'fetch' subscriber calling
+            # fetch_stats() must not deadlock on a lock we still hold).
+            fetched = False
+            with self._lock:
+                if self._host is _UNSET:
+                    host = jax.device_get(self._tree)
+                    # drop the device-side tree: the handle may outlive the
+                    # epoch (e.g. accumulated for end-of-epoch logging) and
+                    # must not pin device buffers the host already holds
+                    # copies of
+                    self._tree = None
+                    self._host = host
+                    fetched = True
+            if fetched:
+                with _FETCH_LOCK:
+                    _FETCH_STATS["async_fetches"] += 1
+                    _FETCH_STATS["coalesced_leaves"] += self._n_leaves
+                if _bus.enabled():
+                    _bus.emit(
+                        "fetch", source=self._source, leaves=self._n_leaves, coalesced=True
+                    )
+        return self._host
+
+    def __repr__(self) -> str:
+        state = "resolved" if self._host is not _UNSET else ("ready" if self.ready() else "pending")
+        return f"AsyncResult(source={self._source!r}, leaves={self._n_leaves}, {state})"
+
+
+def async_compute(obj: Any) -> AsyncResult:
+    """``obj.compute()`` wrapped in an :class:`AsyncResult` — the body of
+    ``Metric.compute_async`` / ``MetricCollection.compute_async``. The
+    compute itself dispatches normally (fused for collections); only the
+    device→host fetch is deferred and coalesced."""
+    return AsyncResult(obj.compute(), source=type(obj).__name__)
+
+
+# ---------------------------------------------------------------------------
+# drive: one scan-fused evaluation epoch
+# ---------------------------------------------------------------------------
+class DriveResult:
+    """What one :func:`drive` did: ``steps`` consumed, ``chunks`` dispatched
+    (scan launches), the member keys driven through the fused scan
+    (``fused_keys``) vs the per-step path (``eager_keys``), and — when
+    ``compute_in_trace`` was requested — the epoch's computed ``values``."""
+
+    __slots__ = ("steps", "chunks", "fused_keys", "eager_keys", "values")
+
+    def __init__(self, steps: int, chunks: int, fused_keys: Tuple[str, ...], eager_keys: Tuple[str, ...], values: Any) -> None:
+        self.steps = steps
+        self.chunks = chunks
+        self.fused_keys = fused_keys
+        self.eager_keys = eager_keys
+        self.values = values
+
+    def __repr__(self) -> str:
+        return (
+            f"DriveResult(steps={self.steps}, chunks={self.chunks},"
+            f" fused_keys={self.fused_keys}, eager_keys={self.eager_keys})"
+        )
+
+
+def _members_of(obj: Any) -> Tuple[Tuple[str, ...], List[Any], bool]:
+    """``(keys, members, is_collection)`` — a plain metric is driven as a
+    one-member collection keyed ``'_'``."""
+    if hasattr(obj, "_modules"):  # MetricCollection face (duck-typed: no import cycle)
+        items = list(obj.items(keep_base=True))
+        return tuple(k for k, _ in items), [m for _, m in items], True
+    return ("_",), [obj], False
+
+
+def _scan_drivable(m: Any) -> bool:
+    """Can this member's update ride the fused scan without losing a
+    contract? Mirrors the collection fusion gate, plus the 'raise' policy
+    (whose per-update host check is incompatible with a device-resident
+    epoch by design — it stays on the per-step path)."""
+    if not (m._enable_jit and not m._jit_failed and not m._has_list_state()):
+        return False
+    if m._is_synced:
+        return False
+    if _health.health_enabled(m):
+        if _health.forces_eager(m) or m.on_bad_input == "raise":
+            return False
+    return True
+
+
+def _steps_iter(batches: Iterable[Any]):
+    for item in batches:
+        if isinstance(item, (tuple, list)):
+            # dataloaders commonly collate a step's update arguments as a
+            # LIST ([preds, target]); treat it like the documented tuple form
+            # rather than passing the list as one (wrong-arity) argument
+            yield tuple(item)
+        else:
+            yield (item,)
+
+
+def _stacked_steps(batches: Any) -> Optional[Tuple[Tuple[Any, ...], int]]:
+    """``(args_tree, n_steps)`` when ``batches`` is a stacked array tuple
+    (every leaf ``[N, ...]`` sharing the leading steps axis), else None."""
+    if isinstance(batches, (jax.Array, np.ndarray)):
+        batches = (batches,)
+    if not isinstance(batches, tuple):
+        return None
+    if any(isinstance(x, (tuple, list)) for x in batches):
+        # a tuple OF per-step argument tuples is the iterable-of-steps form
+        # (its leaves all share the BATCH dim, which would otherwise be
+        # misread as a steps axis) — stream it, don't stack it
+        return None
+    leaves = jax.tree_util.tree_leaves(batches)
+    if not leaves or not all(
+        isinstance(x, (jax.Array, np.ndarray)) and getattr(x, "ndim", 0) >= 1 for x in leaves
+    ):
+        return None
+    n = int(leaves[0].shape[0])
+    if any(int(x.shape[0]) != n for x in leaves):
+        return None
+    return batches, n
+
+
+def _step_sig(leaves: List[Any], treedef: Any) -> Tuple:
+    # np.shape/jnp.result_type only: this runs per streamed step, and
+    # jnp.asarray here would device-put every host batch a second time
+    # (and python-scalar args have no .shape)
+    return (treedef, tuple((tuple(np.shape(x)), str(jnp.result_type(x))) for x in leaves))
+
+
+def _ragged_pad(
+    leaves: List[Any], chunk_leaves0: List[Any], treedef: Any, chunk_treedef: Any, batched: Tuple[int, ...]
+) -> Optional[Tuple[List[Any], int]]:
+    """Fold a short final batch into the chunk's shape: zero-pad the batched
+    leaves up to the chunk batch size and return ``(padded_leaves, pad)``,
+    or None when the step can't be expressed as the chunk shape + pad rows."""
+    if treedef != chunk_treedef or len(leaves) != len(chunk_leaves0) or not batched:
+        return None
+    batch = int(jnp.shape(chunk_leaves0[batched[0]])[0])
+    pad = None
+    for i, (leaf, ref) in enumerate(zip(leaves, chunk_leaves0)):
+        leaf_shape, ref_shape = tuple(jnp.shape(leaf)), tuple(jnp.shape(ref))
+        if jnp.result_type(leaf) != jnp.result_type(ref):  # no device transfer
+            return None
+        if i in batched:
+            if leaf_shape[1:] != ref_shape[1:] or leaf_shape[0] >= batch:
+                return None
+            step_pad = batch - leaf_shape[0]
+            if pad is not None and step_pad != pad:
+                return None
+            pad = step_pad
+        elif leaf_shape != ref_shape:
+            return None
+    if pad is None:
+        return None
+    return _bucketing.pad_leaves(leaves, batched, pad), pad
+
+
+def drive(
+    obj: Any,
+    batches: Any,
+    *,
+    compute_in_trace: bool = False,
+    axis_name: Optional[str] = None,
+    mesh: Optional[Any] = None,
+    steps_per_chunk: int = 16,
+) -> DriveResult:
+    """Run one evaluation epoch through a device-resident scan program.
+
+    Args:
+        obj: a ``Metric`` or ``MetricCollection``. States accumulate exactly
+            as if every batch had gone through ``update()`` per step.
+        batches: either a **stacked** tuple of arrays whose leaves share a
+            leading steps axis (``(preds[N, B, ...], target[N, ...])`` — one
+            XLA launch for the whole epoch), or a **host iterable** of
+            per-step update-argument tuples (streamed in ``[K, batch]``
+            super-steps with double-buffered host→device prefetch).
+        compute_in_trace: fold each eligible member's ``compute_state`` into
+            the final chunk's program; the epoch values are returned in
+            ``DriveResult.values`` (host-side computes and distributed
+            host-sync members are computed host-side after the scan).
+        axis_name / mesh: fold the in-trace sync into the same program and
+            execute it under ``shard_map`` over ``mesh`` — steps sharded
+            across ``axis_name``, states synced with one collective per
+            leaf, merged with the prior accumulation. Requires a stacked
+            epoch, mergeable states, and both arguments together.
+        steps_per_chunk: streaming-mode super-step length ``K``. Larger K
+            amortizes more dispatches per launch but delays the first launch
+            by K host batches; see ``docs/performance.md``.
+
+    Members whose contracts a scan cannot honor (list states, eager
+    fallbacks, ``on_bad_input='raise'``, warn-on-removal / non-additive
+    mask) are driven per step inside the same call. A ragged final batch is
+    absorbed via the pow2-bucketing zero-row correction for row-additive
+    members and dispatched per step otherwise — either way the resulting
+    states match the per-step loop bit-identically.
+    """
+    source = type(obj).__name__
+    if not _trace.active():
+        return _drive_impl(obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source)
+    _keys, _members, _ = _members_of(obj)
+    with _trace.span("drive", source, payload=lambda: [m._snapshot_state() for m in _members]):
+        return _drive_impl(obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source)
+
+
+def _drive_impl(
+    obj: Any,
+    batches: Any,
+    compute_in_trace: bool,
+    axis_name: Optional[str],
+    mesh: Optional[Any],
+    steps_per_chunk: int,
+    source: str,
+) -> DriveResult:
+    from metrics_tpu.metric import _JIT_FALLBACK_ERRORS
+    from metrics_tpu.parallel import comm
+    from metrics_tpu.utils.data import _squeeze_if_scalar
+
+    if (axis_name is None) != (mesh is None):
+        raise ValueError(
+            "drive(axis_name=..., mesh=...) fold the in-trace sync into a"
+            " shard_map'd epoch and must be passed together (for embedding in"
+            " your own shard_map, scan the pure update_state/sync_state API"
+            " instead — see docs/distributed.md)."
+        )
+    if steps_per_chunk < 1:
+        raise ValueError(f"steps_per_chunk must be >= 1, got {steps_per_chunk}")
+
+    keys, members, is_collection = _members_of(obj)
+    if mesh is None and any(m._drive_synced for m in members):
+        from metrics_tpu.utils.exceptions import MetricsUserError
+
+        raise MetricsUserError(
+            "This metric holds the globally-synced state of a mesh-mode"
+            " engine.drive: a local (non-mesh) drive would accumulate rank-"
+            "local steps onto the cross-rank total without syncing them."
+            " reset() first, or keep driving with the same axis_name/mesh."
+        )
+    stats = _cache.instance_stats(obj)
+
+    stacked = _stacked_steps(batches)
+    if mesh is not None and stacked is None:
+        raise ValueError(
+            "drive(mesh=...) needs a stacked epoch (a tuple of arrays with a"
+            " leading steps axis): a host iterator cannot be sharded as one"
+            " launch."
+        )
+
+    # -- partition members: fused scan vs per-step ----------------------
+    fused: List[Tuple[str, Any]] = []
+    eager: List[Tuple[str, Any]] = []
+    id_counts: Dict[int, int] = {}
+    for m in members:
+        id_counts[id(m)] = id_counts.get(id(m), 0) + 1
+    for k, m in zip(keys, members):
+        if id_counts[id(m)] > 1 or not _scan_drivable(m):
+            # an instance aliased under two keys must update once per key per
+            # step; a scan carrying ONE snapshot of it cannot honor that (the
+            # alias's per-step updates would be clobbered by the scan's
+            # rebind), so every occurrence takes the per-step path
+            eager.append((k, m))
+            continue
+        fused.append((k, m))
+
+    # -- normalize the epoch into per-step args / stacked leaves --------
+    if stacked is not None:
+        args_tree, n_steps = stacked
+        if n_steps == 0:
+            # an empty shard still reports like any other epoch: values
+            # reflect whatever state the members already hold
+            return DriveResult(0, 0, (), tuple(k for k, _ in eager), _host_values(obj, compute_in_trace))
+        step0 = tuple(jax.tree_util.tree_map(lambda a: a[0], args_tree))
+        leaves, treedef = jax.tree_util.tree_flatten((step0, {}))
+        stacked_leaves, _ = jax.tree_util.tree_flatten((args_tree, {}))
+    else:
+        step_iter = _steps_iter(batches)
+        step0 = next(iter(step_iter), None)
+        if step0 is None:
+            return DriveResult(0, 0, (), tuple(k for k, _ in eager), _host_values(obj, compute_in_trace))
+        leaves, treedef = jax.tree_util.tree_flatten((step0, {}))
+
+    # python-init probe every fused member against the first step (side
+    # effects + trace compatibility); failures route to the per-step path,
+    # where Metric.update applies its own eager fallback
+    still_fused: List[Tuple[str, Any]] = []
+    for k, m in fused:
+        try:
+            _cache.ensure_python_init(m, step0, {})
+        except _JIT_FALLBACK_ERRORS:
+            eager.append((k, m))
+            continue
+        still_fused.append((k, m))
+    fused = still_fused
+
+    fused_keys = tuple(k for k, _ in fused)
+    fused_members = [m for _, m in fused]
+    eager_keys = tuple(k for k, _ in eager)
+
+    if mesh is not None:
+        not_mergeable = [k for k, m in fused if not m._states_mergeable]
+        if not_mergeable or eager:
+            raise ValueError(
+                "drive(mesh=...) needs every member scan-drivable with"
+                " mergeable states (sum/max/min/cat) — the sharded epoch"
+                " scans from the defaults and merges the synced delta back;"
+                f" offending members: {sorted(set(not_mergeable) | set(eager_keys))}."
+            )
+
+    # zero-row pad corrections are exact only under the row-additivity
+    # contract shared with jit_bucket / on_bad_input='mask'
+    additive_ok = bool(fused) and all(_bucketing.supports_bucketing(m) for m in fused_members)
+    batched = _bucketing.batched_leaf_indices(leaves)
+
+    # -- in-trace compute eligibility -----------------------------------
+    compute_keys: Tuple[str, ...] = ()
+    if compute_in_trace and fused and (axis_name is not None or not comm.distributed_available()):
+        eligible = []
+        for k, m in fused:
+            if (
+                m._compute_is_host_side
+                or m._is_synced
+                or m.dist_sync_fn is not None
+                or m._distributed_available_fn is not None
+                or m.process_group is not None
+            ):
+                continue
+            # the trace-probe verdict is static per instance (class/config +
+            # registration-fixed state shapes): probe once, not per epoch
+            traceable = m.__dict__.get("_drive_cmp_traceable")
+            if traceable is None:
+                saved = m._snapshot_state()
+
+                def _probe(st, member=m):
+                    member._restore_state(st)
+                    return member._compute_impl()
+
+                try:
+                    jax.eval_shape(_probe, saved)
+                    traceable = True
+                except Exception:  # noqa: BLE001 — host-side compute: host fallback
+                    traceable = False
+                finally:
+                    m._restore_state(saved)
+                m._drive_cmp_traceable = traceable
+            if traceable:
+                eligible.append(k)
+        compute_keys = tuple(eligible)
+
+    traced_values: Optional[Dict[str, Any]] = None
+    n_steps_total = 0
+    n_chunks = 0
+
+    if fused:
+        entry = _cache.driver_entry(fused_keys, fused_members, compute_keys, axis_name, mesh)
+        snapshots = {k: m._snapshot_state() for k, m in fused}
+        states: Dict[str, Any] = snapshots
+        if entry.donate:
+            states = {k: _cache.guard_donated_state(m, snapshots[k]) for k, m in fused}
+
+        def _dispatch(states, chunk_leaves, pads, last):
+            variant = "scan_pad" if pads is not None else "scan"
+            if last and compute_keys:
+                variant += "_cmp"
+            if mesh is not None:
+                variant = "shard_" + variant
+            fn_args = (states, tuple(chunk_leaves))
+            if pads is not None:
+                fn_args += (jnp.asarray(pads, jnp.int32),)
+            fn_args += (treedef,)
+            return entry.invoke(variant, fused_members, stats, *fn_args)
+
+        try:
+            if stacked is not None:
+                pads = None
+                chunk_leaves = list(stacked_leaves)
+                steps = n_steps
+                if mesh is not None:
+                    world = int(mesh.shape[axis_name])  # axis_name is required with mesh
+                    rem = (-steps) % world
+                    if rem:
+                        if not additive_ok or not batched:
+                            raise ValueError(
+                                f"drive(mesh=...): {steps} steps do not divide"
+                                f" across {world} shards and the members are not"
+                                " row-additive over an unambiguous batch axis"
+                                " (whole pad steps would not correct exactly);"
+                                " pad the epoch or drop mesh mode."
+                            )
+                        batch = int(jnp.shape(leaves[batched[0]])[0])
+                        chunk_leaves = [
+                            jnp.pad(jnp.asarray(x), [(0, rem)] + [(0, 0)] * (jnp.asarray(x).ndim - 1))
+                            for x in chunk_leaves
+                        ]
+                        pads = [0] * steps + [batch] * rem
+                        steps += rem
+                out = _dispatch(states, chunk_leaves, pads, True)
+                n_chunks = 1
+                n_steps_total = n_steps
+            else:
+                out, n_steps_total, n_chunks, tail_steps = _stream_chunks(
+                    _dispatch,
+                    states,
+                    step_iter,
+                    step0,
+                    treedef,
+                    batched,
+                    additive_ok,
+                    steps_per_chunk,
+                    eager,
+                    defer_last=bool(compute_keys),
+                )
+                # per-step tail: steps the scan could not absorb (shape
+                # change without additivity) — driven through the members'
+                # ordinary engine path after binding the scanned states.
+                # n_steps_total already counts them; update() below does its
+                # own per-step counting/screening, so the scan-side
+                # bookkeeping must exclude them.
+                scan_steps = n_steps_total - len(tail_steps)
+                if tail_steps:
+                    states_out = out[0] if isinstance(out, tuple) else out
+                    _bind_states(fused, states_out, scan_steps)
+                    _screen_bookkeeping(fused, scan_steps)
+                    for step_args in tail_steps:
+                        for _, m in fused:
+                            m.update(*step_args)
+                    out = None  # states already live on the members
+        except _JIT_FALLBACK_ERRORS:
+            # the scan trace failed even though the per-member probes passed
+            # (interaction failure): restore and, for a stacked epoch, replay
+            # per step. A STACKED epoch has exactly one dispatch, so its trace
+            # failure precedes any execution and the snapshots are intact; a
+            # mid-STREAM retrace failure (new chunk signature after executed,
+            # donated chunks) may have consumed snapshot buffers — rollback
+            # swaps defaults in for deleted arrays instead of planting them
+            for k, m in fused:
+                m._restore_state(_cache.rollback_state(m, snapshots[k]))
+            eager = list(eager) + fused
+            eager_keys = tuple(k for k, _ in eager)
+            fused, fused_keys, fused_members = [], (), []
+            if stacked is not None:
+                for i in range(n_steps):
+                    step_args = tuple(jax.tree_util.tree_map(lambda a: a[i], args_tree))
+                    for _, m in eager:
+                        m.update(*step_args)
+                return DriveResult(n_steps, 0, (), eager_keys, _host_values(obj, compute_in_trace))
+            raise
+        except Exception:
+            # a donated runtime failure may have consumed the state buffers
+            for k, m in fused:
+                m._restore_state(_cache.rollback_state(m, snapshots[k]))
+            raise
+
+        if out is not None:
+            if compute_keys and isinstance(out, tuple):
+                states_out, traced_values = out
+            else:
+                states_out = out
+            _bind_states(fused, states_out, n_steps_total)
+            _screen_bookkeeping(fused, n_steps_total)
+        if mesh is not None:
+            # the shard variants' in-trace sync already produced the GLOBAL
+            # accumulation on every participating process; the host-side sync
+            # dance inside a later compute() would reduce those identical
+            # global totals AGAIN (world_size x the true value). Mark the
+            # members as not needing the host sync, and guard host-side
+            # update/forward (which would corrupt the cross-rank total) —
+            # reset() restores the ordinary contract, and further mesh drives
+            # keep merging global deltas correctly.
+            for _, m in fused:
+                m._to_sync = False
+                m._drive_synced = True
+            if is_collection:
+                obj._drive_synced = True  # O(1) guard for the fused update path
+        # (out is None: the tail path above already bound the scanned states
+        # and counted/screened both scan and tail steps)
+    # -- per-step members over a stacked epoch --------------------------
+    if stacked is not None and eager:
+        for i in range(n_steps):
+            step_args = tuple(jax.tree_util.tree_map(lambda a: a[i], args_tree))
+            for _, m in eager:
+                m.update(*step_args)
+        n_steps_total = max(n_steps_total, n_steps)
+    if not fused and stacked is None:
+        # nothing scanned: the streaming loop above never ran — drain the
+        # iterator through the per-step members
+        for step_args in _chain_first(step0, step_iter):
+            for _, m in eager:
+                m.update(*step_args)
+            n_steps_total += 1
+
+    # -- results --------------------------------------------------------
+    values = None
+    if compute_in_trace:
+        if traced_values is not None:
+            for k, m in fused:
+                if k in traced_values:
+                    value = _squeeze_if_scalar(traced_values[k])
+                    m._computed = value
+                    if _health.health_enabled(m):
+                        _health.check_compute_result(m, value)
+        values = _host_values(obj, True)
+    return DriveResult(n_steps_total, n_chunks, fused_keys, eager_keys, values)
+
+
+def _chain_first(first: Tuple[Any, ...], rest: Any):
+    yield first
+    for item in rest:
+        yield item
+
+
+def _bind_states(fused: List[Tuple[str, Any]], states_out: Dict[str, Any], n_steps: int) -> None:
+    for k, m in fused:
+        m._restore_state(states_out[k])
+        m._update_count += n_steps
+        m._computed = None
+
+
+def _screen_bookkeeping(fused: List[Tuple[str, Any]], n_steps: int) -> None:
+    """Host-side screening telemetry for scanned steps — the per-step loop's
+    ``batches_screened`` increment, applied once per step the scan absorbed
+    (per-step tail updates count themselves)."""
+    for _, m in fused:
+        if _health.health_enabled(m):
+            m._health_stats["batches_screened"] += n_steps
+
+
+def _host_values(obj: Any, compute: bool) -> Any:
+    if not compute:
+        return None
+    return obj.compute()
+
+
+def _stream_chunks(
+    dispatch: Any,
+    states: Dict[str, Any],
+    step_iter: Any,
+    step0: Tuple[Any, ...],
+    treedef: Any,
+    batched: Tuple[int, ...],
+    additive_ok: bool,
+    steps_per_chunk: int,
+    eager: List[Tuple[str, Any]],
+    defer_last: bool = False,
+):
+    """Chunked streaming with host→device prefetch: stack K same-shape steps
+    into a ``[K, batch]`` super-step, stage it host→device, and dispatch it
+    asynchronously — the device executes chunk ``i`` while the host pulls,
+    stacks, and stages ``i+1``.
+
+    ``defer_last=True`` (in-trace compute requested): each staged chunk is
+    parked until the NEXT one is ready, so the final chunk can be recognized
+    and dispatched through the ``*_cmp`` variant — at the cost of the first
+    launch waiting for 2K host batches instead of K.
+
+    Returns ``(out, n_steps, n_chunks, tail_steps)`` where ``out`` is the
+    final program output (carrying the compute values when the last chunk
+    used a ``*_cmp`` variant) and ``tail_steps`` are per-step args the scan
+    could not absorb (shape break without row-additivity).
+    """
+    chunk_sig: Optional[Tuple] = None
+    chunk_leaves0: Optional[List[Any]] = None
+    chunk_steps: List[List[Any]] = []
+    chunk_pads: List[int] = []
+    pending: Optional[Tuple[List[Any], Optional[List[int]]]] = None
+    tail_steps: List[Tuple[Any, ...]] = []
+    n_steps = 0
+    n_chunks = 0
+    family_full_chunks = 0  # full [K, batch] chunks staged for the CURRENT sig
+    out: Any = states
+
+    def _stage(steps: List[List[Any]], pads: List[int]):
+        cols = list(zip(*steps))
+        if all(isinstance(x, np.ndarray) for col in cols for x in col):
+            stacked = [np.stack(col) for col in cols]
+            stacked = jax.device_put(stacked)  # async H2D: the prefetch
+        else:
+            stacked = [jnp.stack([jnp.asarray(x) for x in col]) for col in cols]
+        return stacked, (pads if any(pads) else None)
+
+    def _flush(last: bool, cmp: Optional[bool] = None):
+        nonlocal pending, out, n_chunks, chunk_steps, chunk_pads
+        if chunk_steps:
+            staged = _stage(chunk_steps, chunk_pads)
+            chunk_steps, chunk_pads = [], []
+            if not defer_last:
+                # no *_cmp variant to select on the last chunk: dispatch as
+                # soon as staged (jax dispatch is async — the device starts
+                # on this chunk while the host prepares the next)
+                out = dispatch(_states_of(out), staged[0], staged[1], False)
+                n_chunks += 1
+            else:
+                if pending is not None:
+                    out = dispatch(_states_of(out), pending[0], pending[1], False)
+                    n_chunks += 1
+                pending = staged
+        if last and pending is not None:
+            out = dispatch(_states_of(out), pending[0], pending[1], last if cmp is None else cmp)
+            n_chunks += 1
+            pending = None
+
+    def _states_of(value):
+        return value[0] if isinstance(value, tuple) else value
+
+    for step_args in _chain_first(step0, step_iter):
+        for _, m in eager:
+            m.update(*step_args)
+        leaves, step_treedef = jax.tree_util.tree_flatten((step_args, {}))
+        sig = _step_sig(leaves, step_treedef)
+        if step_treedef != treedef:
+            # a structural break (different update arity) cannot enter this
+            # program family at all — per-step tail
+            tail_steps.append(step_args)
+            n_steps += 1
+            continue
+        if chunk_sig is None or sig != chunk_sig:
+            folded = None
+            if chunk_sig is not None and additive_ok:
+                folded = _ragged_pad(leaves, chunk_leaves0, step_treedef, treedef, batched)
+            if folded is not None:
+                padded, pad = folded
+                chunk_steps.append(padded)
+                chunk_pads.append(pad)
+                n_steps += 1
+                if len(chunk_steps) >= steps_per_chunk:
+                    family_full_chunks += 1
+                    _flush(False)
+                continue
+            if chunk_sig is not None:
+                # shape break the pad correction can't absorb: flush what we
+                # have; the new shape starts its own chunk family below (its
+                # own (K, batch) program signature in the same entry)
+                _flush(False)
+                family_full_chunks = 0
+            chunk_sig = sig
+            chunk_leaves0 = list(leaves)
+        chunk_steps.append(list(leaves))
+        chunk_pads.append(0)
+        n_steps += 1
+        if len(chunk_steps) >= steps_per_chunk:
+            family_full_chunks += 1
+            _flush(False)
+
+    # absorb a partial final chunk: pad to full super-steps (row-additive —
+    # a whole pad step is `batch` pad rows) so the final launch reuses the
+    # same (K, batch) program instead of tracing a (K', batch) one. Only
+    # worth it when a full chunk of the CURRENT signature family was staged
+    # (a lone short chunk after a mid-stream shape break has no (K, batch)
+    # program to reuse — padding it would just execute K-n wasted steps)
+    if chunk_steps and additive_ok and batched and len(chunk_steps) < steps_per_chunk and family_full_chunks > 0:
+        batch = int(jnp.shape(chunk_leaves0[batched[0]])[0])
+        zero_step = [
+            jnp.zeros_like(jnp.asarray(x)) if i in set(batched) else x
+            for i, x in enumerate(chunk_leaves0)
+        ]
+        while len(chunk_steps) < steps_per_chunk:
+            chunk_steps.append(list(zero_step))
+            chunk_pads.append(batch)
+    # tail steps force a host-side recompute anyway — don't pay (or trace)
+    # the in-trace *_cmp variant for a result that would be discarded
+    _flush(True, cmp=not tail_steps)
+    if n_chunks == 0 and not tail_steps:
+        # stream shorter than one chunk and never flushed (defensive)
+        out = states
+    return out, n_steps, n_chunks, tail_steps

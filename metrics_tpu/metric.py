@@ -38,7 +38,7 @@ import functools
 import inspect
 from contextlib import contextmanager
 from copy import deepcopy
-from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, FrozenSet, Generator, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -70,6 +70,24 @@ _JIT_FALLBACK_ERRORS = (
 )
 
 _MERGEABLE_FX = ("sum", "max", "min", "cat")
+
+
+def _normalize_placeholder(name: str, placeholder: Any) -> jax.ShapeDtypeStruct:
+    """Normalize an ``add_state(placeholder=)`` declaration to a zero-length
+    ``jax.ShapeDtypeStruct``: a dtype-like means 1-D samples (``(0,)``); a
+    spec/array-like contributes its trailing row shape (``(0, *shape[1:])``
+    — the leading axis is the sample axis and is forced to 0)."""
+    shape = getattr(placeholder, "shape", None)
+    dtype = getattr(placeholder, "dtype", None)
+    if shape is not None and dtype is not None:  # spec/array-like
+        return jax.ShapeDtypeStruct((0,) + tuple(shape)[1:], np.dtype(dtype))
+    try:  # dtype-like (np.dtype instances have a () .shape but no .dtype)
+        return jax.ShapeDtypeStruct((0,), np.dtype(placeholder))
+    except TypeError as err:
+        raise ValueError(
+            f"`placeholder` for state {name!r} must be a dtype or a shaped"
+            f" spec/array, got {placeholder!r}"
+        ) from err
 
 
 def jit_distributed_available() -> bool:
@@ -173,6 +191,16 @@ class Metric:
     # error sums) set this True — possibly as a property gating config that
     # breaks additivity (e.g. macro ``ignore_index`` marking).
     _batch_additive: bool = False
+    # Names of array states whose ``update`` may REASSIGN them to a different
+    # shape than the registered default (e.g. HingeLoss one-vs-all growing its
+    # scalar ``measure`` to ``[C]``). The host-sync fast path skips the
+    # per-leaf shape pre-gather for fixed-shape reduce states
+    # (``gather_state_trees(reductions=)``); a state named here always keeps
+    # the ragged pad-to-max path, because a rank that never updated would
+    # otherwise feed a mismatched shape into the direct allgather. Class-level
+    # on purpose: the opt-out must be rank-INVARIANT (identical collective
+    # sequence on every rank), so it cannot depend on the live local shape.
+    _shape_polymorphic_states: FrozenSet[str] = frozenset()
 
     def __init__(
         self,
@@ -237,8 +265,17 @@ class Metric:
         self._defaults: Dict[str, Union[Array, List]] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Union[str, Callable, None]] = {}
+        # list-state empty-gather placeholder specs (``add_state(placeholder=)``):
+        # name -> jax.ShapeDtypeStruct with leading dim 0, or absent (legacy
+        # float32 ``zeros((0,))`` contribution). See ``parallel/comm.empty_placeholder``.
+        self._list_placeholders: Dict[str, Any] = {}
 
         self._is_synced = False
+        # set by a mesh-mode ``engine.drive``: the state holds the GLOBAL
+        # (in-trace-synced) accumulation, so host-side update/forward would
+        # silently corrupt the cross-rank total — both raise until reset()
+        # (another mesh drive is fine: it merges a new global delta)
+        self._drive_synced = False
         self._cache: Optional[Dict[str, Any]] = None
         # test/advanced hook: override the "is a distributed world present" check
         self._distributed_available_fn: Optional[Callable] = None
@@ -268,12 +305,22 @@ class Metric:
         default: Union[Array, List, float, int, np.ndarray],
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
+        placeholder: Optional[Any] = None,
     ) -> None:
         """Register a metric state (reference ``metric.py:122-190``).
 
         ``default`` must be an array (any array-like is converted) or an empty
         list; ``dist_reduce_fx`` one of ``"sum"/"mean"/"max"/"min"/"cat"``, a
         custom callable, or ``None`` (per-rank states are stacked on sync).
+
+        ``placeholder`` (list states only) declares the dtype — and, for
+        row-shaped samples, the trailing row shape — this state's appended
+        arrays will have, as a dtype (``jnp.int32``) or a
+        ``jax.ShapeDtypeStruct``. An in-trace sync of a rank whose list is
+        still EMPTY contributes ``zeros((0, *row_shape), dtype)`` to the
+        gather instead of the legacy bare float32 ``zeros((0,))`` — without
+        the declaration, a sample-less rank injects float32 into an int
+        ``'cat'`` gather (see ``parallel/comm.empty_placeholder``).
         """
         if isinstance(default, list):
             if default:
@@ -290,6 +337,14 @@ class Metric:
 
         if name in ("update", "compute", "forward", "reset"):
             raise ValueError(f"The name {name!r} clashes with a Metric method")
+
+        if placeholder is not None:
+            if not isinstance(default, list):
+                raise ValueError(
+                    f"`placeholder` declares the empty-gather contribution of a LIST state;"
+                    f" {name!r} has an array default."
+                )
+            self._list_placeholders[name] = _normalize_placeholder(name, placeholder)
 
         self._defaults[name] = [] if isinstance(default, list) else default
         self._persistent[name] = persistent
@@ -355,7 +410,9 @@ class Metric:
         axis_name = axis_name if axis_name is not None else self.axis_name
         if axis_name is None:
             raise MetricsUserError("sync_state requires an axis_name (constructor or argument)")
-        return comm.sync_state_in_trace(state, self._reductions, axis_name)
+        return comm.sync_state_in_trace(
+            state, self._reductions, axis_name, placeholders=self._list_placeholders
+        )
 
     def merge_states(self, state_a: Dict[str, Any], state_b: Dict[str, Any]) -> Dict[str, Any]:
         """Merge two independently-accumulated states (the reduction each state
@@ -407,6 +464,13 @@ class Metric:
             raise MetricsUserError(
                 "The Metric shouldn't be synced when performing ``forward``. "
                 "HINT: Did you forget to call ``unsync``?"
+            )
+        if self._drive_synced:
+            raise MetricsUserError(
+                f"{type(self).__name__} holds the globally-synced state of a"
+                " mesh-mode engine.drive: forward() would re-arm the host sync"
+                " and double-count the global total. reset() first, or"
+                " accumulate further epochs through drive(mesh=...)."
             )
         use_dance = self.full_state_update if self.full_state_update is not None else not self._states_mergeable
         if not self.compute_on_step:
@@ -482,6 +546,14 @@ class Metric:
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
+            if self._drive_synced:
+                raise MetricsUserError(
+                    f"{type(self).__name__} holds the globally-synced state of a"
+                    " mesh-mode engine.drive: a host-side update would be"
+                    " dropped from (or double-counted in) the cross-rank total."
+                    " reset() first, or accumulate further epochs through"
+                    " drive(mesh=...)."
+                )
             self._computed = None
             self._update_count += 1
             if not _obs_trace.active():  # disabled observability: one bool read
@@ -677,6 +749,21 @@ class Metric:
         self._compute_impl = compute
         return wrapped_func
 
+    def compute_async(self) -> "Any":
+        """:meth:`compute` with the device→host fetch deferred and coalesced.
+
+        The compute itself dispatches normally (sync dance included) but no
+        value is fetched: the returned
+        :class:`~metrics_tpu.engine.driver.AsyncResult` starts the
+        device→host copies without blocking, so logging overlaps the next
+        step, and resolves with ONE ``jax.device_get`` of the whole result
+        tree when ``.result()`` is called — bitwise the values a blocking
+        ``compute()`` fetch would have produced. See ``docs/performance.md``.
+        """
+        from metrics_tpu.engine.driver import async_compute
+
+        return async_compute(self)
+
     def reset(self) -> None:
         """Reset states to defaults (reference ``metric.py:396``)."""
         self._update_count = 0
@@ -686,6 +773,11 @@ class Metric:
             setattr(self, name, self._default_value(name))
         self._cache = None
         self._is_synced = False
+        # a mesh-mode engine.drive leaves `_to_sync = False` (its in-trace
+        # sync already made the state global) and `_drive_synced = True`
+        # (host update/forward guard); a reset state is local again
+        self._to_sync = True
+        self._drive_synced = False
         # the 'raise'-policy host mirrors track the device counters, which
         # just went back to zero — a stale mirror would silently swallow the
         # next quarantine (see resilience/health.raise_on_quarantine)
@@ -721,6 +813,14 @@ class Metric:
                 dist_sync_fn,
                 policy="partial" if policy == "partial" else "raise",
                 report=stats,
+                # a name absent from `reductions` never takes the fixed-shape
+                # fast path — shape-polymorphic states stay on the ragged
+                # pad-to-max gather even though their reduce fx is 'sum'
+                reductions={
+                    n: fx
+                    for n, fx in self._reductions.items()
+                    if n not in self._shape_polymorphic_states
+                },
             )
         except SyncError as err:
             if policy == "raise":
@@ -833,6 +933,14 @@ class Metric:
         (reference ``metric.py:267-301``)."""
         if self._is_synced and should_sync:
             raise MetricsUserError("The Metric has already been synced.")
+        if self._drive_synced and should_sync:
+            raise MetricsUserError(
+                f"{type(self).__name__} holds the globally-synced state of a"
+                " mesh-mode engine.drive: a host-side sync would re-reduce the"
+                " identical global totals world_size-fold. Its compute()"
+                " already skips the sync dance; reset() restores the ordinary"
+                " contract."
+            )
         if distributed_available is None:
             distributed_available = jit_distributed_available
         is_distributed = distributed_available() if callable(distributed_available) else bool(distributed_available)
@@ -1035,6 +1143,8 @@ class Metric:
         self.__dict__.setdefault("health_screen", "nonfinite")
         self.__dict__.setdefault("_health_stats", _health.new_health_stats())
         self.__dict__.setdefault("_health_warn_on_bad", False)
+        self.__dict__.setdefault("_list_placeholders", {})
+        self.__dict__.setdefault("_drive_synced", False)
         for name in self._defaults:
             v = getattr(self, name, None)
             if isinstance(v, list):

@@ -47,7 +47,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: ``sync_degrade`` (an ``on_sync_error`` fallback engaged). Health:
 #: ``quarantine`` (a contaminated update surfaced host-side). Lifecycle
 #: spans (``metrics_tpu.obs.trace``): ``update`` / ``forward`` / ``compute``
-#: / ``sync``. Misc: ``warning`` (a ``warn_once`` emission).
+#: / ``sync`` / ``drive`` (one scan-fused evaluation epoch through
+#: ``metrics_tpu.engine.driver``). Results plane: ``fetch`` (one coalesced
+#: device→host transfer resolving a ``compute_async`` handle). Misc:
+#: ``warning`` (a ``warn_once`` emission).
 EVENT_KINDS = (
     "compile",
     "cache_hit",
@@ -61,6 +64,8 @@ EVENT_KINDS = (
     "forward",
     "compute",
     "sync",
+    "drive",
+    "fetch",
     "warning",
 )
 

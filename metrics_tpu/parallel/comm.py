@@ -92,14 +92,22 @@ def reduce_in_trace(x: Array, reduce_fx: Union[str, Callable, None], axis_name: 
 
 
 def sync_state_trees(
-    states: dict, reductions: dict, axis_name: Union[str, Sequence[str]]
+    states: dict,
+    reductions: dict,
+    axis_name: Union[str, Sequence[str]],
+    placeholders: Optional[dict] = None,
 ) -> dict:
     """Synchronize several metrics' state dicts across a mesh axis inside a
     trace, one collective per state leaf.
 
     ``states``/``reductions`` map member key -> state dict / reduction dict.
     List states ('cat') are pre-concatenated locally before the gather, like
-    the reference's pre-cat at ``metric.py:236-237``.
+    the reference's pre-cat at ``metric.py:236-237``. ``placeholders`` maps
+    member key -> the metric's registered empty-list placeholder specs
+    (``Metric._list_placeholders``): a list state with no appended samples
+    contributes a zero-length array of its *declared* dtype/width to the
+    gather instead of a bare float32 ``zeros((0,))`` — an int cat state must
+    not have float32 injected into it by a sample-less rank.
 
     Lowering note (measured, not assumed): jax binds ``psum`` per leaf even
     for a pytree argument, so each state tensor is its own all-reduce in the
@@ -118,20 +126,44 @@ def sync_state_trees(
     out: dict = {key: {} for key in states}
     for key, state in states.items():
         member_reductions = reductions[key]
+        member_placeholders = (placeholders or {}).get(key) or {}
         for name, value in state.items():
             fx = member_reductions.get(name)
             if isinstance(value, list):
-                value = dim_zero_cat(value) if value else jnp.zeros((0,))
-                out[key][name] = [reduce_in_trace(value, "cat" if fx in (None, "cat") else fx, axis_name)]
+                value = dim_zero_cat(value) if value else empty_placeholder(member_placeholders.get(name))
+                if value.shape[0] == 0:
+                    # SPMD: shapes are uniform inside one trace, so a
+                    # zero-length pre-cat means EVERY rank is empty — the
+                    # gather result is the empty array itself, and XLA
+                    # cannot lower an all_gather over a zero-sized dim anyway
+                    out[key][name] = [value]
+                else:
+                    out[key][name] = [reduce_in_trace(value, "cat" if fx in (None, "cat") else fx, axis_name)]
             else:
                 out[key][name] = reduce_in_trace(value, fx, axis_name)
     return out
 
 
-def sync_state_in_trace(state: dict, reductions: dict, axis_name: Union[str, Sequence[str]]) -> dict:
+def empty_placeholder(spec: Optional[Any]) -> Array:
+    """Zero-length gather contribution for an empty list state: the declared
+    dtype/width when the metric registered one (``add_state(placeholder=)``),
+    else the legacy bare float vector."""
+    if spec is None:
+        return jnp.zeros((0,))
+    return jnp.zeros(tuple(spec.shape), dtype=spec.dtype)
+
+
+def sync_state_in_trace(
+    state: dict,
+    reductions: dict,
+    axis_name: Union[str, Sequence[str]],
+    placeholders: Optional[dict] = None,
+) -> dict:
     """Synchronize one state dict across a mesh axis inside a trace — the
     single-metric view of :func:`sync_state_trees`."""
-    return sync_state_trees({"_": state}, {"_": reductions}, axis_name)["_"]
+    return sync_state_trees(
+        {"_": state}, {"_": reductions}, axis_name, placeholders={"_": placeholders or {}}
+    )["_"]
 
 
 # ---------------------------------------------------------------------------
@@ -149,6 +181,7 @@ def gather_all_arrays(
     group: Optional[Any] = None,
     policy: str = "raise",
     report: Optional[dict] = None,
+    fixed_shape: bool = False,
 ) -> List[Array]:
     """Host-level all-gather returning one array per process.
 
@@ -171,6 +204,13 @@ def gather_all_arrays(
     ``report``). The world-spanning ``multihost_utils`` path is a true
     collective — it has no per-rank partial mode, so failures there surface
     as exceptions and degrade whole-state at the metric level.
+
+    ``fixed_shape=True`` declares every rank's leaf shape identical *by
+    registration* (reduce states with ``dist_reduce_fx`` in sum/mean/max/min
+    never grow), skipping the per-leaf shape pre-gather below — one host
+    collective per leaf instead of two. The pre-gather only exists for the
+    ragged case (cat/None reductions), mirroring the reference's pad-to-max
+    dance (``distributed.py:133-145``).
     """
     if group is not None:
         from metrics_tpu.parallel.groups import ProcessGroup, gather_group_arrays
@@ -206,6 +246,9 @@ def gather_all_arrays(
             " dist_sync_fn) to sync under simulated_world/run_as_peers."
         )
     x = jnp.atleast_1d(jnp.asarray(x))
+    if fixed_shape:
+        gathered = _host_allgather(x)  # [world, ...] — shapes static by registration
+        return [gathered[i] for i in range(gathered.shape[0])]
     local_shape = jnp.asarray(x.shape, dtype=jnp.int32)
     all_shapes = _host_allgather(local_shape)  # [world, ndim]
     import numpy as np

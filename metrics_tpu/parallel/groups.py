@@ -611,6 +611,7 @@ def gather_state_trees(
     dist_sync_fn: Optional[Callable] = None,
     policy: str = "raise",
     report: Optional[Dict[str, Any]] = None,
+    reductions: Optional[Dict[str, Any]] = None,
 ) -> List[Any]:
     """Gather a whole state tree from every sync peer; one tree per member.
 
@@ -625,6 +626,23 @@ def gather_state_trees(
     collective per leaf, and a partial result for SOME leaves would
     cross-assign members during transposition — degradation for those paths
     is whole-state and handled by the caller catching :class:`SyncError`.
+
+    ``reductions`` (``{state name: dist_reduce_fx}``, for a ``tree`` whose
+    top level maps state names) lets the default world-spanning gather skip
+    the per-leaf shape pre-gather for fixed-shape reduce states
+    (sum/mean/max/min — their shapes are static by registration), halving
+    the host collectives per such leaf. Cat/None/callable reductions and
+    list states keep the ragged path; a custom ``dist_sync_fn`` never sees
+    the flag (its signature is its contract). The flag is derived from
+    REGISTRATION only — deliberately rank-invariant, so every rank issues
+    the same collective sequence (a rank-local fallback to the ragged path
+    would desynchronize the collective pairing). A reduce state whose
+    update may REASSIGN it to a different shape (e.g. HingeLoss one-vs-all
+    growing its scalar ``measure`` to ``[C]`` — a rank that never updated
+    still holds the scalar) must be excluded by its class via
+    ``Metric._shape_polymorphic_states``, which drops the name from the
+    ``reductions`` mapping the caller passes here and keeps that state on
+    the ragged pad-to-max gather.
 
     .. note:: leaves are visited in ``tree_flatten`` order — for a state
        dict that is **sorted key order**, not ``add_state`` registration
@@ -642,10 +660,30 @@ def gather_state_trees(
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
         return [tree]
+    fixed_flags = [False] * len(leaves)
+    if dist_sync_fn is None and reductions and isinstance(tree, dict):
+        # per-leaf flags via a same-structure flag tree: a dict value that is
+        # a list (pre-catted cat state) flattens to one flag per element,
+        # keeping flag order aligned with tree_flatten's sorted-key order
+        flag_tree = {
+            name: jax.tree_util.tree_map(
+                lambda _leaf, fx=reductions.get(name), is_list=isinstance(value, list): (
+                    not is_list and fx in ("sum", "mean", "max", "min")
+                ),
+                value,
+            )
+            for name, value in tree.items()
+        }
+        fixed_flags = jax.tree_util.tree_leaves(flag_tree)
+        if len(fixed_flags) != len(leaves):  # defensive: never misalign flags
+            fixed_flags = [False] * len(leaves)
     gathered = []  # [n_leaves][n_members]
-    for leaf in leaves:
+    for leaf, fixed in zip(leaves, fixed_flags):
         try:
-            gathered.append(gather(leaf, group=group))
+            if dist_sync_fn is None:
+                gathered.append(gather(leaf, group=group, fixed_shape=fixed))
+            else:
+                gathered.append(gather(leaf, group=group))
         except (SyncError, ValueError, TypeError, MetricsUserError):
             raise  # already-classified sync failures and programming errors
         except Exception as err:  # noqa: BLE001 — reclassified below
@@ -653,7 +691,17 @@ def gather_state_trees(
             # (e.g. XlaRuntimeError from multihost_utils when a host drops):
             # classify as SyncError so on_sync_error degradation applies —
             # whole-state, since per-rank granularity is unknowable here
-            raise SyncError(f"Host-level gather failed for a state leaf: {err}") from err
+            hint = ""
+            if fixed:
+                hint = (
+                    " HINT: this leaf took the fixed-shape gather fast path."
+                    " If the metric's update() reassigns this state to a"
+                    " different shape than its registered default (so ranks"
+                    " can disagree on the live shape), declare the state name"
+                    " in the metric class's `_shape_polymorphic_states` to"
+                    " keep it on the ragged pad-to-max gather."
+                )
+            raise SyncError(f"Host-level gather failed for a state leaf: {err}{hint}") from err
     n_members = len(gathered[0])
     return [
         jax.tree_util.tree_unflatten(treedef, [per_leaf[m] for per_leaf in gathered])

@@ -27,6 +27,13 @@ class ExplainedVariance(Metric):
 
     is_differentiable = True
     higher_is_better = True
+    # multi-output update reassigns the scalar sum defaults to
+    # ``[num_outputs]`` (``jnp.sum(..., axis=0)`` on [N, D] inputs): a rank
+    # that never updated still holds the scalars, so the host-sync
+    # fixed-shape fast path must not assume registration shape for these
+    _shape_polymorphic_states = frozenset(
+        {"sum_error", "sum_squared_error", "sum_target", "sum_squared_target"}
+    )
 
     def __init__(self, multioutput: str = "uniform_average", **kwargs: Any) -> None:
         super().__init__(**kwargs)

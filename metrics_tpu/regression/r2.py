@@ -23,6 +23,12 @@ class R2Score(Metric):
 
     is_differentiable = True
     higher_is_better = True
+    # the sum states register as [num_outputs] but broadcast-grow to the
+    # live [D] when multi-output inputs exceed the declared num_outputs
+    # (reference-compatible leniency): a rank that never updated still holds
+    # the registered shape, so the host-sync fixed-shape fast path must not
+    # assume it
+    _shape_polymorphic_states = frozenset({"sum_squared_error", "sum_error", "residual"})
 
     def __init__(
         self,

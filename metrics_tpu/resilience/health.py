@@ -368,6 +368,17 @@ def _zero_row_outputs(
     return row_out, defaults
 
 
+def _subtract_rows(out: Any, count: Any, row_out: Any, default: Any) -> Any:
+    """``out - count * (row_out - default)`` with ``count`` cast to the
+    updated state's dtype first: the count scalar arrives as a strong int32,
+    and multiplying it straight into a *weak*-typed state (e.g. a
+    ``jnp.asarray(0)`` default under x64) would demote the state to int32 —
+    a dtype the per-step exact path never produces. The cast keeps the
+    correction's arithmetic in the state's own dtype."""
+    out = jnp.asarray(out)
+    return out - jnp.asarray(count, out.dtype) * (row_out - default)
+
+
 def traced_update(
     inst: Any,
     state: Dict[str, Any],
@@ -390,7 +401,7 @@ def traced_update(
         if pad_count is None:
             return out
         row_out, defaults = _zero_row_outputs(inst, args, kwargs)
-        return {name: out[name] - pad_count * (row_out[name] - defaults[name]) for name in out}
+        return {name: _subtract_rows(out[name], pad_count, row_out[name], defaults[name]) for name in out}
 
     if getattr(inst, "_health_warn_on_bad", False):
         # warn-on-removal is a host-side contract: route the instance to the
@@ -450,7 +461,7 @@ def traced_update(
         drop = n_bad
     if drop is not None:
         row_out, defaults = _zero_row_outputs(inst, run_args, run_kwargs)
-        out = {name: out[name] - drop * (row_out[name] - defaults[name]) for name in out}
+        out = {name: _subtract_rows(out[name], drop, row_out[name], defaults[name]) for name in out}
 
     quarantine = policy in ("skip", "raise") or not use_mask
     if quarantine:

@@ -95,10 +95,13 @@ class BERTScore(Metric):
                 model_name_or_path, max_length, num_layers, all_layers
             )
 
-        self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
-        self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
-        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
-        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+        # token ids / masks are lane-default ints: declare the placeholder so
+        # an empty rank's sync contribution keeps the int dtype
+        int_dtype = jnp.asarray(0).dtype
+        self.add_state("preds_input_ids", [], dist_reduce_fx="cat", placeholder=int_dtype)
+        self.add_state("preds_attention_mask", [], dist_reduce_fx="cat", placeholder=int_dtype)
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat", placeholder=int_dtype)
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat", placeholder=int_dtype)
 
     def update(self, preds: List[str], target: List[str]) -> None:
         """Tokenize and buffer (reference ``text/bert.py:205-228``)."""

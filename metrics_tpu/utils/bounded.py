@@ -91,8 +91,17 @@ class _BoundedSampleBufferMixin:
         if capacity is not None:
             self._init_bounded_buffers(capacity, self._buffer_specs)
         else:
-            for name, _, _ in self._buffer_specs:
-                self.add_state(name, default=[], dist_reduce_fx="cat")
+            for name, width, dtype in self._buffer_specs:
+                # the spec knows the row layout the bounded path would
+                # register; declare it as the empty-gather placeholder so a
+                # sample-less rank contributes the right dtype/width
+                shape = (0,) if not width or width == 1 else (0, width)
+                self.add_state(
+                    name,
+                    default=[],
+                    dist_reduce_fx="cat",
+                    placeholder=jax.ShapeDtypeStruct(shape, jnp.zeros((), dtype).dtype),
+                )
             if warn:  # the reference warns for curves/Spearman but not retrieval
                 warn_once(
                     warn_message

@@ -1,0 +1,265 @@
+"""Empty-list placeholder specs and the fixed-shape gather fast path.
+
+Satellites of the driver PR: a rank with no appended 'cat' samples must
+contribute a zero-length array of the state's DECLARED dtype/width to the
+in-trace gather (``add_state(placeholder=)`` / ``comm.empty_placeholder``),
+and fixed-shape reduce states skip the per-leaf shape pre-gather in the
+world-spanning host collective (``gather_all_arrays(fixed_shape=True)``).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import AUC, Metric, PrecisionRecallCurve, StatScores
+from metrics_tpu.parallel import comm
+from metrics_tpu.parallel.groups import gather_state_trees
+
+
+def test_normalize_placeholder_forms():
+    from metrics_tpu.metric import _normalize_placeholder
+
+    assert _normalize_placeholder("s", jnp.int32) == jax.ShapeDtypeStruct((0,), np.dtype("int32"))
+    assert _normalize_placeholder("s", np.dtype("float32")) == jax.ShapeDtypeStruct(
+        (0,), np.dtype("float32")
+    )
+    spec = _normalize_placeholder("s", jax.ShapeDtypeStruct((7, 4), np.float32))
+    assert spec.shape == (0, 4)  # leading sample axis forced to zero
+    spec = _normalize_placeholder("s", np.zeros((5, 3), np.int32))
+    assert spec.shape == (0, 3) and spec.dtype == np.dtype("int32")
+    with pytest.raises(ValueError, match="placeholder"):
+        _normalize_placeholder("s", object())
+
+
+def test_placeholder_rejected_for_array_states():
+    class Bad(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum", placeholder=jnp.int32)
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return self.total
+
+    with pytest.raises(ValueError, match="LIST state"):
+        Bad()
+
+
+def test_empty_placeholder_dtype():
+    z = comm.empty_placeholder(jax.ShapeDtypeStruct((0, 3), np.dtype("int32")))
+    assert z.shape == (0, 3) and z.dtype == np.dtype("int32")
+    legacy = comm.empty_placeholder(None)
+    assert legacy.shape == (0,) and legacy.dtype == jnp.zeros(()).dtype
+
+
+def test_registered_placeholders():
+    m = StatScores(reduce="samples", mdmc_reduce="samplewise", num_classes=3)
+    int_dtype = jnp.asarray(0).dtype
+    assert {n: p.dtype for n, p in m._list_placeholders.items()} == {
+        s: int_dtype for s in ("tp", "fp", "tn", "fn")
+    }
+    a = AUC()
+    assert a._list_placeholders["x"].dtype == jnp.zeros(()).dtype
+    # unbounded curve buffers declare their spec's dtype/width
+    c = PrecisionRecallCurve(num_classes=4)
+    assert c._list_placeholders["target"].dtype == jnp.zeros((), jnp.int32).dtype
+
+
+def test_empty_cat_sync_keeps_declared_dtype():
+    """A sample-less rank's in-trace sync contribution must carry the
+    declared int dtype, not the legacy float32 zeros((0,))."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:  # newer jax spells it at top level
+        shard_map = jax.shard_map
+
+    m = StatScores(reduce="samples", mdmc_reduce="samplewise", num_classes=3)
+    states = {n: getattr(m, n) for n in m._defaults}
+    mesh = Mesh(np.array(jax.devices()[:1]), ("i",))
+
+    def f():
+        out = comm.sync_state_in_trace(
+            states, m._reductions, "i", placeholders=m._list_placeholders
+        )
+        return out["tp"][0]
+
+    r = shard_map(f, mesh=mesh, in_specs=(), out_specs=P(), check_rep=False)()
+    assert r.shape == (0,) and r.dtype == jnp.asarray(0).dtype
+
+
+def test_empty_cat_sync_without_placeholder_is_legacy_float():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:
+        shard_map = jax.shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("i",))
+
+    def f():
+        out = comm.sync_state_trees({"_": {"buf": []}}, {"_": {"buf": "cat"}}, "i")
+        return out["_"]["buf"][0]
+
+    r = shard_map(f, mesh=mesh, in_specs=(), out_specs=P(), check_rep=False)()
+    assert r.dtype == jnp.zeros(()).dtype
+
+
+# ---------------------------------------------------------------------------
+# fixed-shape gather gating
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def _fake_world(monkeypatch):
+    calls = {"n": 0}
+
+    def counting(x):
+        calls["n"] += 1
+        return jnp.stack([x, x])  # a fake 2-process world
+
+    monkeypatch.setattr(comm, "_host_allgather", counting)
+    monkeypatch.setattr(comm, "distributed_available", lambda: True)
+    return calls
+
+
+def test_fixed_shape_skips_shape_pregather(_fake_world):
+    x = jnp.ones((4,))
+    out = comm.gather_all_arrays(x, fixed_shape=True)
+    assert len(out) == 2 and _fake_world["n"] == 1
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x))
+    _fake_world["n"] = 0
+    out = comm.gather_all_arrays(x, fixed_shape=False)
+    assert len(out) == 2 and _fake_world["n"] == 2  # shape pre-gather + payload
+
+
+def test_gather_state_trees_gates_by_reduction(_fake_world):
+    tree = {"total": jnp.asarray([3.0]), "buf": [jnp.asarray([1.0, 2.0])]}
+    reductions = {"total": "sum", "buf": "cat"}
+    members = gather_state_trees(tree, None, None, reductions=reductions)
+    # 2 leaves; 'total' (sum: fixed by registration) gathers once, 'buf'
+    # (cat: ragged) pre-gathers shapes first -> 3 collectives, not 4
+    assert _fake_world["n"] == 3
+    assert len(members) == 2
+    np.testing.assert_array_equal(np.asarray(members[0]["total"]), np.asarray([3.0]))
+    np.testing.assert_array_equal(np.asarray(members[1]["buf"][0]), np.asarray([1.0, 2.0]))
+
+
+def test_setstate_defaults_missing_placeholders():
+    # a pickle from before placeholder specs existed has no
+    # _list_placeholders in its state dict — restore must default it, the
+    # way pre-health checkpoints restore with zeroed counters
+    m = PrecisionRecallCurve()
+    state = m.__getstate__()
+    state.pop("_list_placeholders", None)
+    restored = PrecisionRecallCurve.__new__(PrecisionRecallCurve)
+    restored.__setstate__(state)
+    assert restored._list_placeholders == {}
+
+
+def test_fixed_shape_flag_is_rank_invariant(_fake_world):
+    # the fast-path decision comes from REGISTRATION only: a reduce state an
+    # update reassigned to a different shape (the HingeLoss one-vs-all
+    # pattern, scalar default -> [C]) STILL takes the fixed path — a
+    # rank-local live-shape check would let ranks disagree on the number of
+    # collectives and desynchronize the pairing; when rank shapes truly
+    # diverge the direct allgather fails loudly instead and is reclassified
+    # as SyncError for on_sync_error degradation
+    class _Growing(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("measure", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, v):
+            self.measure = v + self.measure  # broadcasts scalar -> v.shape
+            self.total = self.total + 1.0
+
+        def compute(self):
+            return self.measure / self.total
+
+    m = _Growing()
+    m.update(jnp.ones((3,)))
+    tree = {"measure": m.measure, "total": m.total}
+    m._gather_with_policy(tree, None, None)
+    assert _fake_world["n"] == 2  # one collective per leaf, on every rank
+
+    def exploding(x):
+        raise RuntimeError("mismatched per-process shapes")
+
+    import pytest as _pytest
+
+    from metrics_tpu.utils.exceptions import SyncError
+
+    comm._host_allgather, saved = exploding, comm._host_allgather
+    try:
+        with _pytest.raises(SyncError):
+            gather_state_trees(tree, None, None, reductions=m._reductions)
+    finally:
+        comm._host_allgather = saved
+
+
+def test_gather_state_trees_custom_fn_unchanged(_fake_world):
+    seen = []
+
+    def custom(x, group=None):
+        seen.append(x)
+        return [x, x]
+
+    tree = {"total": jnp.asarray([1.0])}
+    members = gather_state_trees(tree, None, custom, reductions={"total": "sum"})
+    assert len(members) == 2 and len(seen) == 1  # flag never reaches the custom fn
+    assert _fake_world["n"] == 0
+
+def test_shape_polymorphic_states_keep_ragged_path(_fake_world):
+    # HingeLoss one-vs-all REASSIGNS its scalar ``measure`` default to [C]:
+    # a rank that never updated still holds the scalar, so the class opts the
+    # state out of the fixed-shape fast path (`_shape_polymorphic_states`) —
+    # class-level, hence rank-invariant: every rank runs the same sequence
+    from metrics_tpu import HingeLoss
+
+    m = HingeLoss(multiclass_mode="one-vs-all")
+    m.update(jnp.asarray([[1.0, 0.2, 0.1], [0.1, 1.0, 0.2]]), jnp.asarray([0, 1]))
+    assert tuple(jnp.shape(m.measure)) == (3,)  # grew past the scalar default
+
+    tree = m._snapshot_state()
+    m._gather_with_policy(tree, None, None)
+    # 'measure' (polymorphic): shape pre-gather + payload = 2 collectives;
+    # every other state is a fixed sum state: 1 each
+    assert _fake_world["n"] == 2 + (len(tree) - 1)
+
+def test_explained_variance_polymorphic_states_keep_ragged_path(_fake_world):
+    # same pattern as HingeLoss one-vs-all: [N, D] inputs reassign the four
+    # scalar sum defaults to [D], so those states must stay on the ragged
+    # pad-to-max gather while n_obs (genuinely fixed) takes the fast path
+    from metrics_tpu import ExplainedVariance
+
+    m = ExplainedVariance(multioutput="raw_values")
+    m.update(jnp.ones((4, 3)), jnp.ones((4, 3)) * 2)
+    assert tuple(jnp.shape(m.sum_error)) == (3,)
+
+    tree = m._snapshot_state()
+    n_poly = len(type(m)._shape_polymorphic_states & set(tree))
+    assert n_poly == 4
+    m._gather_with_policy(tree, None, None)
+    # polymorphic states: shape pre-gather + payload; the rest: 1 each
+    assert _fake_world["n"] == 2 * n_poly + (len(tree) - n_poly)
+
+def test_r2_polymorphic_states_keep_ragged_path(_fake_world):
+    # R2Score's sums register as [num_outputs] but broadcast-grow to the
+    # live [D] when inputs are wider than declared — same contract as
+    # HingeLoss / ExplainedVariance
+    from metrics_tpu import R2Score
+
+    m = R2Score()  # num_outputs=1 registered
+    m.update(jnp.ones((8, 3)), jnp.ones((8, 3)) * 2)
+    assert tuple(jnp.shape(m.sum_error)) == (3,)
+
+    tree = m._snapshot_state()
+    n_poly = len(type(m)._shape_polymorphic_states & set(tree))
+    assert n_poly == 3
+    m._gather_with_policy(tree, None, None)
+    assert _fake_world["n"] == 2 * n_poly + (len(tree) - n_poly)

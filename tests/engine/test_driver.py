@@ -1,0 +1,662 @@
+"""Device-resident evaluation driver: bit-identity vs the per-step loop,
+ragged tails, health-policy parity inside the scan, retrace caps, and the
+async coalesced results plane (``metrics_tpu.engine.driver``)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    AUC,
+    Accuracy,
+    ConfusionMatrix,
+    F1Score,
+    MeanMetric,
+    MetricCollection,
+    PrecisionRecallCurve,
+    StatScores,
+    SumMetric,
+    engine,
+)
+from metrics_tpu.engine import driver
+
+NUM_CLASSES = 5
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    engine.reset_fetch_stats()
+    yield
+    engine.clear_cache()
+
+
+def _epoch(rng, n_steps=8, batch=16, c=NUM_CLASSES, nan_every=None):
+    preds = rng.rand(n_steps, batch, c).astype(np.float32)
+    target = rng.randint(0, c, size=(n_steps, batch)).astype(np.int32)
+    if nan_every:
+        for i in range(0, n_steps, nan_every):
+            preds[i, :3, 0] = np.nan
+    return jnp.asarray(preds), jnp.asarray(target)
+
+
+def _assert_state_equal(m_a, m_b):
+    sa, sb = m_a._snapshot_state(), m_b._snapshot_state()
+    assert set(sa) == set(sb)
+    for name in sa:
+        a, b = sa[name], sb[name]
+        if isinstance(a, list):
+            assert len(a) == len(b)
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        else:
+            assert jnp.asarray(a).dtype == jnp.asarray(b).dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _loop(metric, preds, target):
+    for i in range(preds.shape[0]):
+        metric.update(preds[i], target[i])
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [
+        lambda: Accuracy(num_classes=NUM_CLASSES),
+        lambda: StatScores(reduce="macro", num_classes=NUM_CLASSES),
+        lambda: F1Score(num_classes=NUM_CLASSES, average="macro"),
+        lambda: ConfusionMatrix(num_classes=NUM_CLASSES),
+    ],
+    ids=["accuracy", "stat_scores", "f1", "confmat"],
+)
+def test_stacked_epoch_bit_identity(factory):
+    rng = np.random.RandomState(0)
+    preds, target = _epoch(rng)
+    m_drive, m_loop = factory(), factory()
+    res = driver.drive(m_drive, (preds, target))
+    assert res.steps == preds.shape[0] and res.fused_keys == ("_",)
+    _loop(m_loop, preds, target)
+    _assert_state_equal(m_drive, m_loop)
+    np.testing.assert_array_equal(np.asarray(m_drive.compute()), np.asarray(m_loop.compute()))
+    assert m_drive._update_count == m_loop._update_count
+
+
+@pytest.mark.parametrize("cls", [SumMetric, MeanMetric], ids=["sum", "mean"])
+def test_aggregation_bit_identity(cls):
+    rng = np.random.RandomState(1)
+    xs = jnp.asarray(rng.rand(6, 32).astype(np.float32))
+    # nan_strategy='disable' == on_bad_input='propagate': the legacy 'warn'
+    # default carries a host-side warn contract that (correctly) routes the
+    # member to the per-step path inside drive()
+    m_drive, m_loop = cls(nan_strategy="disable"), cls(nan_strategy="disable")
+    res = driver.drive(m_drive, (xs,))
+    assert res.fused_keys == ("_",)
+    for i in range(xs.shape[0]):
+        m_loop.update(xs[i])
+    _assert_state_equal(m_drive, m_loop)
+    np.testing.assert_array_equal(np.asarray(m_drive.compute()), np.asarray(m_loop.compute()))
+
+
+def test_legacy_warn_contract_takes_per_step_path():
+    rng = np.random.RandomState(2)
+    xs = jnp.asarray(rng.rand(4, 8).astype(np.float32))
+    m = MeanMetric()  # nan_strategy='warn' -> host-side removal warnings
+    res = driver.drive(m, (xs,))
+    assert res.fused_keys == () and res.eager_keys == ("_",)
+    m2 = MeanMetric()
+    for i in range(xs.shape[0]):
+        m2.update(xs[i])
+    _assert_state_equal(m, m2)
+
+
+def test_bounded_curve_metric_scans():
+    rng = np.random.RandomState(3)
+    preds, target = _epoch(rng, n_steps=6, batch=8)
+    m_drive = PrecisionRecallCurve(num_classes=NUM_CLASSES, buffer_capacity=64)
+    m_loop = PrecisionRecallCurve(num_classes=NUM_CLASSES, buffer_capacity=64)
+    res = driver.drive(m_drive, (preds, target))
+    assert res.fused_keys == ("_",)  # bounded buffers are array states: scannable
+    _loop(m_loop, preds, target)
+    _assert_state_equal(m_drive, m_loop)
+    for a, b in zip(m_drive.compute(), m_loop.compute()):
+        for xa, xb in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_list_state_member_stays_per_step():
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.rand(5, 16).astype(np.float32))
+    y = jnp.asarray(rng.rand(5, 16).astype(np.float32))
+    m = AUC(reorder=True)
+    res = driver.drive(m, iter((x[i], y[i]) for i in range(5)))
+    assert res.fused_keys == () and res.eager_keys == ("_",) and res.steps == 5
+    m2 = AUC(reorder=True)
+    for i in range(5):
+        m2.update(x[i], y[i])
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(m2.compute()))
+
+
+def test_streaming_ragged_last_batch():
+    rng = np.random.RandomState(5)
+    preds, target = _epoch(rng, n_steps=9, batch=16)
+    steps = [(preds[i], target[i]) for i in range(9)]
+    steps.append((preds[0][:5], target[0][:5]))  # ragged tail
+    m_drive, m_loop = Accuracy(num_classes=NUM_CLASSES), Accuracy(num_classes=NUM_CLASSES)
+    res = driver.drive(m_drive, iter(steps), steps_per_chunk=4)
+    assert res.steps == 10
+    for p, t in steps:
+        m_loop.update(p, t)
+    _assert_state_equal(m_drive, m_loop)
+    np.testing.assert_array_equal(np.asarray(m_drive.compute()), np.asarray(m_loop.compute()))
+    assert m_drive._update_count == m_loop._update_count == 10
+
+
+def test_streaming_matches_stacked():
+    rng = np.random.RandomState(6)
+    preds, target = _epoch(rng, n_steps=12, batch=8)
+    m_stacked, m_streamed = Accuracy(num_classes=NUM_CLASSES), Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(m_stacked, (preds, target))
+    driver.drive(m_streamed, iter((preds[i], target[i]) for i in range(12)), steps_per_chunk=5)
+    _assert_state_equal(m_stacked, m_streamed)
+
+
+@pytest.mark.parametrize("policy", ["skip", "mask"])
+def test_health_policy_parity_inside_scan(policy):
+    rng = np.random.RandomState(7)
+    preds, target = _epoch(rng, nan_every=3)
+    m_drive = Accuracy(num_classes=NUM_CLASSES, on_bad_input=policy)
+    m_loop = Accuracy(num_classes=NUM_CLASSES, on_bad_input=policy)
+    res = driver.drive(m_drive, (preds, target))
+    assert res.fused_keys == ("_",)  # skip/mask screening is scan-safe
+    _loop(m_loop, preds, target)
+    _assert_state_equal(m_drive, m_loop)  # includes the _health_counts state
+    np.testing.assert_array_equal(np.asarray(m_drive.compute()), np.asarray(m_loop.compute()))
+    r_a, r_b = m_drive.health_report(), m_loop.health_report()
+    for key in ("nan_count", "rows_masked", "updates_quarantined", "batches_screened"):
+        assert r_a[key] == r_b[key], (key, r_a, r_b)
+
+
+def test_raise_policy_keeps_per_update_host_check():
+    rng = np.random.RandomState(8)
+    preds, target = _epoch(rng, nan_every=2)
+    m = Accuracy(num_classes=NUM_CLASSES, on_bad_input="raise")
+    from metrics_tpu import NumericalHealthError
+
+    with pytest.raises(NumericalHealthError):
+        driver.drive(m, (preds, target))
+
+
+def test_collection_fused_parity():
+    rng = np.random.RandomState(9)
+    preds, target = _epoch(rng)
+
+    def build():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=NUM_CLASSES),
+                "cm": ConfusionMatrix(num_classes=NUM_CLASSES),
+                "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            }
+        )
+
+    mc_drive, mc_loop = build(), build()
+    res = driver.drive(mc_drive, (preds, target))
+    assert set(res.fused_keys) == {"acc", "cm", "f1"}
+    for i in range(preds.shape[0]):
+        mc_loop.update(preds[i], target[i])
+    out_a, out_b = mc_drive.compute(), mc_loop.compute()
+    assert set(out_a) == set(out_b)
+    for k in out_a:
+        np.testing.assert_array_equal(np.asarray(out_a[k]), np.asarray(out_b[k]))
+
+
+def test_collection_mixed_members_split():
+    rng = np.random.RandomState(10)
+    preds = jnp.asarray(rng.rand(4, 8).astype(np.float32))
+    target = jnp.asarray(rng.rand(4, 8).astype(np.float32))
+    mc = MetricCollection({"auc": AUC(), "mean": MeanMetric(nan_strategy="disable")})
+    res = driver.drive(mc, (preds, target))
+    assert "auc" in res.eager_keys and "mean" in res.fused_keys
+
+
+def test_retrace_cap_one_compile_per_signature():
+    rng = np.random.RandomState(11)
+    preds, target = _epoch(rng, n_steps=8, batch=16)
+    m1 = Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(m1, (preds, target))
+    first = engine.cache_summary()["by_kind"]["driver"]
+    assert first["compiles"] >= 1
+    # same (steps, batch) signature again — same instance AND a fresh one:
+    # the driver program is a process-wide shared resource
+    driver.drive(m1, (preds, target))
+    m2 = Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(m2, (preds, target))
+    after = engine.cache_summary()["by_kind"]["driver"]
+    assert after["compiles"] == first["compiles"]
+    assert after["entries"] == first["entries"] == 1
+    # a different steps count is a new signature: exactly one more trace
+    driver.drive(Accuracy(num_classes=NUM_CLASSES), (preds[:5], target[:5]))
+    final = engine.cache_summary()["by_kind"]["driver"]
+    assert final["compiles"] == after["compiles"] + 1
+
+
+def test_compute_in_trace_matches_host_compute():
+    rng = np.random.RandomState(12)
+    preds, target = _epoch(rng)
+    m_a, m_b = Accuracy(num_classes=NUM_CLASSES), Accuracy(num_classes=NUM_CLASSES)
+    res = driver.drive(m_a, (preds, target), compute_in_trace=True)
+    driver.drive(m_b, (preds, target))
+    np.testing.assert_array_equal(np.asarray(res.values), np.asarray(m_b.compute()))
+    np.testing.assert_array_equal(np.asarray(m_a.compute()), np.asarray(m_b.compute()))
+
+
+def test_empty_epoch():
+    m = Accuracy(num_classes=NUM_CLASSES)
+    res = driver.drive(m, iter(()))
+    assert res.steps == 0 and res.chunks == 0
+    assert m._update_count == 0
+
+
+def test_empty_epoch_still_computes_in_trace_values():
+    # an unevenly sharded loader can leave one worker with zero batches: the
+    # empty drive must report values like any other epoch (the metric's
+    # previously accumulated state), not values=None
+    rng = np.random.RandomState(21)
+    preds, target = _epoch(rng, n_steps=4, batch=8)
+    m = Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(m, (preds, target))
+    want = np.asarray(m.compute())
+    for empty in (iter(()), (preds[:0], target[:0])):
+        res = driver.drive(m, empty, compute_in_trace=True)
+        assert res.steps == 0 and res.values is not None
+        np.testing.assert_array_equal(np.asarray(res.values), want)
+
+
+def test_streaming_python_scalar_step_arg():
+    # a per-step python-scalar update argument (e.g. a weight) must stream:
+    # the step signature reads shape/dtype without .shape attribute access
+    # or a device transfer
+    vals = [np.arange(4.0) + i for i in range(6)]
+    weights = [0.5, 2.0, 1.0, 0.25, 3.0, 1.5]
+    a, b = MeanMetric(nan_strategy="disable"), MeanMetric(nan_strategy="disable")
+    res = driver.drive(a, iter(zip(vals, weights)), steps_per_chunk=3)
+    assert res.steps == 6
+    for v, w in zip(vals, weights):
+        b.update(v, w)
+    np.testing.assert_allclose(np.asarray(a.compute()), np.asarray(b.compute()), rtol=1e-6)
+
+
+def test_tuple_of_step_tuples_streams():
+    """A tuple OF per-step argument tuples is the iterable-of-steps form —
+    its leaves share the BATCH dim, which must not be misread as a steps
+    axis (it would slice rows as steps, or crash on mixed-rank args)."""
+    rng = np.random.RandomState(14)
+    preds, target = _epoch(rng, n_steps=5, batch=8)
+    steps = tuple((preds[i], target[i]) for i in range(5))
+    m_drive, m_loop = Accuracy(num_classes=NUM_CLASSES), Accuracy(num_classes=NUM_CLASSES)
+    res = driver.drive(m_drive, steps)
+    assert res.steps == 5
+    for p, t in steps:
+        m_loop.update(p, t)
+    _assert_state_equal(m_drive, m_loop)
+
+
+def test_mesh_pad_without_batch_axis_raises():
+    """Non-divisible steps over a mesh need whole pad steps, which are only
+    exact over an unambiguous batch axis — scalar-step epochs must raise,
+    not silently accumulate uncorrected zero updates."""
+    import jax
+    from jax.sharding import Mesh
+
+    xs = jnp.asarray(np.arange(3.0, dtype=np.float32))  # 3 scalar steps
+    m = MeanMetric(nan_strategy="disable")
+    if len(jax.devices()) >= 2:
+        mesh = Mesh(np.array(jax.devices()[:2]), ("i",))  # 3 % 2 leaves a pad step
+        with pytest.raises(ValueError, match="batch axis"):
+            driver.drive(m, (xs,), axis_name="i", mesh=mesh)
+    else:  # pragma: no cover - single-device lane: no remainder to pad
+        mesh = Mesh(np.array(jax.devices()[:1]), ("i",))
+        res = driver.drive(m, (xs,), axis_name="i", mesh=mesh)
+        assert res.steps == 3
+        np.testing.assert_allclose(np.asarray(m.compute()), 1.0)
+
+
+def test_mesh_requires_both_args():
+    m = Accuracy(num_classes=NUM_CLASSES)
+    with pytest.raises(ValueError, match="together"):
+        driver.drive(m, (jnp.zeros((2, 4, NUM_CLASSES)), jnp.zeros((2, 4), jnp.int32)), axis_name="i")
+
+
+# ---------------------------------------------------------------------------
+# async coalesced results plane
+# ---------------------------------------------------------------------------
+def test_compute_async_bitwise_equal_single_fetch():
+    rng = np.random.RandomState(13)
+    preds, target = _epoch(rng)
+    mc = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "cm": ConfusionMatrix(num_classes=NUM_CLASSES),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    driver.drive(mc, (preds, target))
+    engine.reset_fetch_stats()
+    handle = mc.compute_async()
+    got = handle.result()
+    stats = engine.fetch_stats()
+    # ONE coalesced device->host transfer for the whole collection
+    assert stats["async_fetches"] == 1
+    assert stats["coalesced_leaves"] == len(got)
+    blocking = mc.compute()
+    assert set(got) == set(blocking)
+    for k in got:
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(blocking[k]))
+    # resolving twice costs nothing extra
+    handle.result()
+    assert engine.fetch_stats()["async_fetches"] == 1
+
+
+def test_compute_async_metric_and_repr():
+    m = SumMetric(nan_strategy="disable")
+    m.update(jnp.asarray([1.0, 2.0]))
+    handle = m.compute_async()
+    assert "AsyncResult" in repr(handle)
+    np.testing.assert_array_equal(np.asarray(handle.result()), np.asarray(m.compute()))
+    assert handle.ready()
+
+
+def test_compute_async_concurrent_resolution_single_fetch():
+    # the documented use resolves the handle from a logger thread while the
+    # training thread steps: concurrent result() calls must coalesce into
+    # ONE transfer and all observe the same host tree
+    import threading
+
+    m = SumMetric(nan_strategy="disable")
+    m.update(jnp.asarray([4.0, 5.0]))
+    handle = m.compute_async()
+    engine.reset_fetch_stats()
+    results, barrier = [None] * 8, threading.Barrier(8)
+
+    def resolve(i):
+        barrier.wait()
+        results[i] = handle.result()
+
+    threads = [threading.Thread(target=resolve, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert engine.fetch_stats()["async_fetches"] == 1
+    for r in results:
+        assert r is not None
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(results[0]))
+
+
+def test_compute_async_releases_device_tree_after_resolve():
+    m = SumMetric(nan_strategy="disable")
+    m.update(jnp.asarray([1.0, 2.0]))
+    handle = m.compute_async()
+    first = handle.result()
+    # the handle may outlive the epoch: once the host holds the values the
+    # device-side tree must be dropped so its buffers can be freed
+    assert handle._tree is None
+    assert handle.ready()
+    np.testing.assert_array_equal(np.asarray(handle.result()), np.asarray(first))
+
+
+def test_compute_async_emits_fetch_event():
+    from metrics_tpu import obs
+
+    m = SumMetric(nan_strategy="disable")
+    m.update(jnp.asarray([3.0]))
+    obs.enable()
+    try:
+        obs.bus.clear()
+        m.compute_async().result()
+        kinds = [e.kind for e in obs.events()]
+        assert "fetch" in kinds
+    finally:
+        obs.disable()
+
+def test_fetch_subscriber_reading_fetch_stats_does_not_deadlock():
+    # a bus subscriber reacting to 'fetch' events by reading the async-fetch
+    # telemetry re-enters the results plane on the resolving thread — no lock
+    # may still be held across the emit (non-reentrant locks would deadlock)
+    import threading
+
+    from metrics_tpu import obs
+    from metrics_tpu.obs import bus
+
+    m = SumMetric(nan_strategy="disable")
+    m.update(jnp.asarray([6.0, 7.0]))
+    handle = m.compute_async()
+    seen = []
+
+    def nosy(event):
+        if event.kind == "fetch":
+            seen.append(engine.fetch_stats())
+
+    obs.enable()
+    bus.subscribe(nosy)
+    done = threading.Event()
+    out = {}
+
+    def resolve():
+        out["value"] = handle.result()
+        done.set()
+
+    t = threading.Thread(target=resolve, daemon=True)
+    try:
+        t.start()
+        assert done.wait(timeout=30), "AsyncResult.result() deadlocked under a fetch subscriber"
+    finally:
+        bus.unsubscribe(nosy)
+        obs.disable()
+    np.testing.assert_array_equal(np.asarray(out["value"]), np.asarray(m.compute()))
+    assert seen and seen[0]["async_fetches"] >= 1
+
+
+def test_mesh_drive_skips_host_resync_on_compute():
+    # the shard variants' in-trace sync already produced the GLOBAL
+    # accumulation on every process — a later compute() must NOT run the
+    # host-side sync dance again (it would re-reduce identical global totals
+    # to world_size x the true value)
+    import jax
+    from jax.sharding import Mesh
+
+    xs = jnp.asarray(np.arange(8.0, dtype=np.float32).reshape(8, 1))
+    serial = SumMetric(nan_strategy="disable")
+    _loop_1d(serial, xs)
+
+    m = SumMetric(nan_strategy="disable")
+    mesh = Mesh(np.array(jax.devices()[:1]), ("i",))
+    driver.drive(m, (xs,), axis_name="i", mesh=mesh)
+    assert m._to_sync is False
+
+    calls = []
+
+    def fake_gather(x, group=None):
+        calls.append(x)
+        return [x, x]  # a second process holding the same global total
+
+    m._distributed_available_fn = lambda: True
+    m.dist_sync_fn = fake_gather
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(serial.compute()))
+    assert not calls  # the host sync never ran
+
+    # reset restores the ordinary host-sync contract
+    m.reset()
+    assert m._to_sync is True
+
+
+def _loop_1d(metric, xs):
+    for i in range(xs.shape[0]):
+        metric.update(xs[i])
+
+def test_mesh_drive_guards_host_accumulation():
+    # after a mesh drive the members hold the GLOBAL total: host-side
+    # update()/forward() would silently drop from or double-count the
+    # cross-rank accumulation and must raise; another mesh drive and reset()
+    # are the supported continuations
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    xs = jnp.asarray(np.arange(8.0, dtype=np.float32).reshape(8, 1))
+    mesh = Mesh(np.array(jax.devices()[:1]), ("i",))
+
+    m = SumMetric(nan_strategy="disable")
+    driver.drive(m, (xs,), axis_name="i", mesh=mesh)
+    with pytest.raises(MetricsUserError, match="mesh-mode engine.drive"):
+        m.update(jnp.asarray([1.0]))
+    with pytest.raises(MetricsUserError, match="mesh-mode engine.drive"):
+        m(jnp.asarray([1.0]))
+    with pytest.raises(MetricsUserError, match="mesh-mode engine.drive"):
+        driver.drive(m, (xs,))  # a LOCAL drive would skip the sync
+    # a second mesh drive merges another global delta
+    driver.drive(m, (xs,), axis_name="i", mesh=mesh)
+    np.testing.assert_allclose(np.asarray(m.compute()), 2 * float(np.sum(np.asarray(xs))))
+    m.reset()
+    m.update(jnp.asarray([1.0]))  # reset restores the ordinary contract
+    np.testing.assert_allclose(np.asarray(m.compute()), 1.0)
+
+    # collection face: the fused update path bypasses the per-member wrapper
+    mc = MetricCollection({"s": SumMetric(nan_strategy="disable")})
+    driver.drive(mc, (xs,), axis_name="i", mesh=mesh)
+    with pytest.raises(MetricsUserError, match="mesh-mode engine.drive"):
+        mc.update(jnp.asarray([1.0]))
+    with pytest.raises(MetricsUserError, match="mesh-mode engine.drive"):
+        mc(jnp.asarray([1.0]))
+    mc.reset()
+    mc.update(jnp.asarray([2.0]))
+    np.testing.assert_allclose(np.asarray(mc.compute()["s"]), 2.0)
+
+
+def test_streaming_dispatches_eagerly_without_in_trace_compute():
+    # with no *_cmp variant to select on the last chunk, a staged chunk must
+    # be dispatched immediately — not parked until the NEXT chunk arrives
+    # (which would idle the device for a full chunk of dataloader time)
+    m = Accuracy(num_classes=NUM_CLASSES)
+    rng = np.random.RandomState(3)
+    steps = [
+        (jnp.asarray(rng.rand(8, NUM_CLASSES).astype(np.float32)),
+         jnp.asarray(rng.randint(0, NUM_CLASSES, size=(8,)).astype(np.int32)))
+        for _ in range(6)
+    ]
+    calls_at_yield = []
+
+    def instrumented():
+        for i, s in enumerate(steps):
+            calls_at_yield.append((i, engine.cache_summary()["calls"]))
+            yield s
+
+    res = driver.drive(m, instrumented(), steps_per_chunk=2)
+    assert res.steps == 6 and res.chunks == 3
+    # chunk 1 holds steps 0-1 and must have been dispatched by the time the
+    # host pulls step 3 (index 2 was pulled BEFORE the chunk filled)
+    calls_by_index = dict(calls_at_yield)
+    assert calls_by_index[3] > calls_by_index[0], calls_at_yield
+
+
+def test_fixed_shape_gather_failure_names_escape_hatch():
+    # a shape-mismatch on the fixed-shape fast path must tell the user about
+    # _shape_polymorphic_states, not just re-raise the backend error
+    from metrics_tpu.parallel import comm
+    from metrics_tpu.parallel.groups import gather_state_trees
+    from metrics_tpu.utils.exceptions import SyncError
+
+    def exploding(x):
+        raise RuntimeError("mismatched per-process shapes")
+
+    saved_gather, saved_avail = comm._host_allgather, comm.distributed_available
+    comm._host_allgather = exploding
+    comm.distributed_available = lambda: True
+    try:
+        with pytest.raises(SyncError, match="_shape_polymorphic_states"):
+            gather_state_trees(
+                {"total": jnp.asarray([1.0])}, None, None, reductions={"total": "sum"}
+            )
+    finally:
+        comm._host_allgather = saved_gather
+        comm.distributed_available = saved_avail
+
+def test_mesh_drive_guards_public_sync():
+    # compute()'s internal sync is skipped via _to_sync, but the PUBLIC
+    # sync()/sync_context() pass should_sync=True explicitly — they must
+    # refuse too, or the already-global totals get re-reduced world_size-fold
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    xs = jnp.asarray(np.arange(4.0, dtype=np.float32).reshape(4, 1))
+    mesh = Mesh(np.array(jax.devices()[:1]), ("i",))
+    m = SumMetric(nan_strategy="disable")
+    driver.drive(m, (xs,), axis_name="i", mesh=mesh)
+    m._distributed_available_fn = lambda: True
+    with pytest.raises(MetricsUserError, match="mesh-mode engine.drive"):
+        m.sync(distributed_available=lambda: True)
+    with pytest.raises(MetricsUserError, match="mesh-mode engine.drive"):
+        with m.sync_context(distributed_available=lambda: True):
+            pass
+    m.reset()
+    m.update(jnp.asarray([1.0]))
+    with m.sync_context(distributed_available=lambda: False):  # restored
+        np.testing.assert_allclose(np.asarray(m._compute_impl()), 1.0)
+
+
+def test_partial_final_chunk_pads_only_within_its_family():
+    # the zero-step pad exists to REUSE the current family's (K, batch)
+    # program; a lone short chunk after a mid-stream shape break has no such
+    # program and must dispatch at its natural (n, batch') length
+    import jax
+
+    def _steps(n, batch):
+        rng = np.random.RandomState(batch)
+        return [
+            (jnp.asarray(rng.rand(batch, NUM_CLASSES).astype(np.float32)),
+             jnp.asarray(rng.randint(0, NUM_CLASSES, size=(batch,)).astype(np.int32)))
+            for _ in range(n)
+        ]
+
+    recorded = []
+
+    def fake_dispatch(states, chunk_leaves, pads, last):
+        recorded.append((int(chunk_leaves[0].shape[0]), None if pads is None else list(pads)))
+        return states
+
+    def _run(steps, k):
+        recorded.clear()
+        it = iter(steps)
+        step0 = next(it)
+        leaves, treedef = jax.tree_util.tree_flatten((step0, {}))
+        from metrics_tpu.engine import bucketing
+
+        batched = bucketing.batched_leaf_indices(leaves)
+        driver._stream_chunks(
+            fake_dispatch, {}, it, step0, treedef, batched, True, k, []
+        )
+        return list(recorded)
+
+    # shape break mid-stream (batch 4 -> 8; 8 rows can't fold into a 4-row
+    # family): neither short chunk has a full (K,·) sibling — no padding
+    assert _run(_steps(3, 4) + _steps(3, 8), 4) == [(3, None), (3, None)]
+    # same family throughout: the short tail pads up to K and reuses the
+    # (4, 8) program (two whole pad steps of 8 rows each)
+    assert _run(_steps(6, 8), 4) == [(4, None), (4, [0, 0, 8, 8])]
+    # break AFTER a full chunk, then a new family's short chunk: still no pad
+    assert _run(_steps(4, 8) + _steps(2, 16), 4) == [(4, None), (2, None)]
+
+def test_streaming_accepts_list_collated_steps():
+    # dataloaders commonly collate a step's update args as a LIST; the
+    # stream must treat [preds, target] like the documented tuple form
+    rng = np.random.RandomState(11)
+    preds, target = _epoch(rng, n_steps=5)
+    batches = [[preds[i], target[i]] for i in range(5)]
+    m_drive, m_loop = Accuracy(num_classes=NUM_CLASSES), Accuracy(num_classes=NUM_CLASSES)
+    res = driver.drive(m_drive, iter(batches), steps_per_chunk=2)
+    assert res.steps == 5
+    _loop(m_loop, preds, target)
+    _assert_state_equal(m_drive, m_loop)
