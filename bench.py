@@ -1507,6 +1507,132 @@ def bench_eval_driver() -> dict:
     }
 
 
+def bench_serving_plane() -> dict:
+    """The multi-tenant serving plane (``metrics_tpu.serving``) vs
+    per-instance dispatch. Asserted by the ``ci.sh --serving-smoke`` lane:
+
+    1. **Launch amortization** — serving N same-signature sessions through a
+       ``MetricBank`` + ``RequestRouter`` must issue >= 5x fewer XLA
+       launches than N solo instances (one launch per ``update()``); the
+       lane reports launches-per-1k-requests for both paths.
+    2. **Bit-identity** — every tenant's banked state equals a solo instance
+       fed the same stream, exactly.
+    3. **Eviction determinism** — an over-subscribed bank (LRU spill churn)
+       served twice with the same traffic produces identical per-tenant
+       values and identical eviction counts.
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, engine
+    from metrics_tpu.serving import MetricBank, RequestRouter
+
+    # the acceptance scenario is 1024 same-signature sessions on the CPU
+    # lane — per-request work is tiny, so the full population runs even in
+    # the small tier (the starved-box tiny tier alone shrinks it)
+    tenants = 128 if _tiny() else 1024
+    rounds = 3
+    batch = 8
+    flush = 256
+    rng = np.random.RandomState(11)
+    # per-tenant, per-round streams, identical for both paths
+    data = [
+        [
+            (
+                jnp.asarray(rng.rand(batch, NUM_CLASSES).astype(np.float32)),
+                jnp.asarray(rng.randint(0, NUM_CLASSES, size=batch).astype(np.int32)),
+            )
+            for _ in range(rounds)
+        ]
+        for _ in range(tenants)
+    ]
+
+    # -- per-instance dispatch: one launch per update -------------------
+    solos = [Accuracy(num_classes=NUM_CLASSES) for _ in range(tenants)]
+    for t in range(tenants):  # warmup round: python-init probes + compiles
+        solos[t].update(*data[t][0])
+    t0 = time.perf_counter()
+    for r in range(1, rounds):
+        for t in range(tenants):
+            solos[t].update(*data[t][r])
+    _force(solos[-1]._snapshot_state())
+    solo_s = time.perf_counter() - t0
+    solo_requests = tenants * (rounds - 1)
+    solo_launches = solo_requests  # update() == one XLA launch each
+
+    # -- banked dispatch: router-batched, one launch per flush ----------
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=tenants, name="bench_bank")
+    router = RequestRouter(bank, max_requests=flush, max_delay_s=None)
+    for t in range(tenants):  # warmup round: admissions + bank compiles
+        router.submit(t, *data[t][0])
+    router.flush()
+    launches0 = bank.stats["launches"]
+    t0 = time.perf_counter()
+    for r in range(1, rounds):
+        for t in range(tenants):
+            router.submit(t, *data[t][r])
+        router.flush()
+    _force(bank._bank)
+    banked_s = time.perf_counter() - t0
+    banked_requests = bank.stats["requests"] - tenants
+    banked_launches = bank.stats["launches"] - launches0
+
+    parity_ok = banked_requests == solo_requests
+    for t in range(tenants):
+        state = bank.tenant_state(t)
+        for name, value in solos[t]._snapshot_state().items():
+            if not np.array_equal(np.asarray(value), np.asarray(state[name])):
+                parity_ok = False
+
+    # -- eviction determinism under LRU spill churn ---------------------
+    def _churned_serve():
+        small_rng = np.random.RandomState(23)
+        churn_data = [
+            [
+                (
+                    jnp.asarray(small_rng.rand(batch, NUM_CLASSES).astype(np.float32)),
+                    jnp.asarray(
+                        small_rng.randint(0, NUM_CLASSES, size=batch).astype(np.int32)
+                    ),
+                )
+                for _ in range(2)
+            ]
+            for _ in range(48)
+        ]
+        b = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=16)
+        r = RequestRouter(b, max_requests=16, max_delay_s=None)
+        for step in range(2):
+            for t in range(48):
+                r.submit(t, *churn_data[t][step])
+            r.flush()
+        values = {t: float(np.asarray(b.compute(t))) for t in range(48)}
+        return values, b.stats["evictions"], b.stats["spills"]
+
+    v1, e1, s1 = _churned_serve()
+    v2, e2, s2 = _churned_serve()
+    eviction_deterministic = v1 == v2 and e1 == e2 and s1 == s2 and e1 > 0
+
+    amortization = solo_launches / max(1, banked_launches)
+    return {
+        "metric": "serving_plane",
+        "value": round(amortization, 3),
+        "unit": "x_launch_amortization_vs_per_instance",
+        "vs_baseline": None,
+        "tenants": tenants,
+        "requests": solo_requests,
+        "launches_per_1k_per_instance": round(1000.0 * solo_launches / solo_requests, 2),
+        "launches_per_1k_banked": round(1000.0 * banked_launches / banked_requests, 2),
+        "per_instance_rps": round(solo_requests / solo_s, 1),
+        "banked_rps": round(banked_requests / banked_s, 1),
+        "rps_speedup": round((banked_requests / banked_s) / (solo_requests / solo_s), 3),
+        "parity_ok": parity_ok,
+        "eviction_deterministic": eviction_deterministic,
+        "evictions_churn": e1,
+        "bank_summary": {
+            k: bank.stats[k] for k in ("launches", "requests", "admits", "evictions")
+        },
+    }
+
+
 # ---------------------------------------------------------------------------
 # module-API compute() latency on the live backend
 # ---------------------------------------------------------------------------
@@ -1591,6 +1717,7 @@ _CONFIGS = [
     ("bench_health_screening", 900, True),
     ("bench_obs_smoke", 600, False),
     ("bench_eval_driver", 900, False),
+    ("bench_serving_plane", 900, False),
 ]
 
 _PERSIST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_PARTIAL.json")
@@ -1856,6 +1983,22 @@ def main() -> None:
             jax.config.update("jax_platforms", forced)
         os.environ.setdefault("METRICS_TPU_BENCH_SMALL", "1")
         result = bench_eval_driver()
+        for key, value in _stamp().items():
+            result.setdefault(key, value)
+        emit(result)
+        return
+
+    if "--serving-smoke" in sys.argv:
+        # CI serving-plane smoke: banked vs per-instance launch amortization,
+        # per-tenant bit-identity, eviction determinism — one JSON line
+        # (platform pin through jax.config — see --smoke for why). NOT run
+        # under the small lane: the acceptance scenario is 1024 sessions.
+        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
+        if forced:
+            import jax
+
+            jax.config.update("jax_platforms", forced)
+        result = bench_serving_plane()
         for key, value in _stamp().items():
             result.setdefault(key, value)
         emit(result)

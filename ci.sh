@@ -131,4 +131,27 @@ if [ "$driver_rc" -eq 3 ]; then
 fi
 [ "$driver_rc" -eq 0 ] || exit "$driver_rc"
 
+echo "=== serving-plane smoke (banked multi-tenant dispatch vs per-instance) ==="
+# bit-identity and eviction determinism must hold on EVERY attempt; the
+# >=5x launch-amortization gate is structural (launch counts, not timing)
+# and therefore not retried either
+JAX_PLATFORMS=cpu python bench.py --serving-smoke | tail -n 1 | python -c '
+import json, os, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "serving_plane", obj
+# 1024 same-signature sessions, every tenant bitwise-equal to a solo
+# instance (the starved-box tiny tier legitimately shrinks the population;
+# the correctness gates below still apply there)
+if os.environ.get("METRICS_TPU_BENCH_TINY") != "1":
+    assert obj["tenants"] >= 1024, f"acceptance scenario is 1024 sessions: {obj}"
+assert obj["parity_ok"] is True, f"banked state diverged from solo instances: {obj}"
+# LRU spill/re-admit churn is deterministic: same traffic -> same values + evictions
+assert obj["eviction_deterministic"] is True, obj
+assert obj["evictions_churn"] > 0, f"churn scenario evicted nothing: {obj}"
+# batched cross-tenant dispatch amortizes launches >= 5x vs per-instance
+assert obj["value"] >= 5.0, "launch amortization %sx < 5x: %s" % (obj["value"], obj)
+print("serving smoke OK:", line)
+'
+
 echo "both lanes green"

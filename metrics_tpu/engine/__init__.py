@@ -33,6 +33,7 @@ from metrics_tpu.engine.bucketing import (  # noqa: F401
 )
 from metrics_tpu.engine.cache import (  # noqa: F401
     SharedEntry,
+    bank_entry,
     cache_summary,
     clear_cache,
     donation_enabled,
@@ -43,10 +44,19 @@ from metrics_tpu.engine.cache import (  # noqa: F401
     instance_stats,
     metric_fingerprint,
     new_stats,
+    program_identity,
     rollback_state,
     set_donation,
     update_transition,
 )
+from metrics_tpu.engine.persist import (  # noqa: F401
+    enable_persistent_cache,
+    persistent_cache_enabled,
+    persistent_cache_stats,
+)
+from metrics_tpu.engine import persist as _persist
+
+_persist._maybe_enable_from_env()
 from metrics_tpu.engine.driver import (  # noqa: F401
     AsyncResult,
     DriveResult,

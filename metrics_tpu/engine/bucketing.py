@@ -106,6 +106,21 @@ def supports_bucketing(metric: Any) -> bool:
     return True
 
 
+def bucketing_active(metric: Any, batched: Tuple[int, ...]) -> bool:
+    """Whether pow2 batch bucketing applies to a dispatch with these batched
+    leaf indices: the instance opted in (``jit_bucket='pow2'``), the class
+    satisfies the row-additivity contract, and there is an unambiguous batch
+    axis. THE shared gate for the serving plane (``MetricBank`` pads ragged
+    request batches with it; ``RequestRouter`` folds batch sizes into pow2
+    buckets when grouping by signature) — both sides must agree or the
+    router would build waves the bank rejects."""
+    return (
+        getattr(metric, "jit_bucket", None) == "pow2"
+        and supports_bucketing(metric)
+        and bool(batched)
+    )
+
+
 def batched_leaf_indices(leaves: List[Any]) -> Tuple[int, ...]:
     """Indices of rank>=1 array leaves sharing axis 0 — THE batch-axis
     consensus rule, shared by the pad-bucketing spec below and the

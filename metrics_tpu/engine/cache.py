@@ -192,6 +192,31 @@ def _attr_token(value: Any, pins: List[Any]) -> Tuple:
     return ("id", id(value))
 
 
+def program_identity(metric: Any) -> Tuple[Any, Tuple]:
+    """The per-INSTANCE identity half of the cache's addressing scheme.
+
+    The cache separates two orthogonal questions that the seed engine fused
+    into one object:
+
+    * **Which compiled program?** — answered by this function: the config
+      fingerprint ``(class, jit-relevant config, state spec)``. Every
+      instance (and clone, and bank template) with the same fingerprint
+      shares one :class:`SharedEntry` and its compiled program family.
+    * **Whose state?** — answered per dispatch: the state pytree is an
+      explicit argument to every compiled transition, never baked into the
+      program. ``update_transition`` passes the calling instance's own
+      snapshot; a :class:`~metrics_tpu.serving.MetricBank` passes a
+      device-resident bank holding *many tenants'* states under a leading
+      tenant axis and addresses tenants by slot index inside the same
+      launch (``bank_entry`` below).
+
+    Splitting identity from state addressing is what lets N sessions of the
+    same metric config share ONE program and ONE launch: the program is a
+    function of the fingerprint only, the tenant is just data.
+    """
+    return metric_fingerprint(metric)
+
+
 def metric_fingerprint(metric: Any) -> Tuple[Any, Tuple]:
     """``(key, pins)`` for one metric instance.
 
@@ -318,7 +343,9 @@ class SharedEntry:
         obs_on = _bus.enabled()
         obs_source = obs_screening = None
         if obs_on:
-            if self.kind == "metric_update":
+            if self.kind in ("metric_update", "bank_update"):
+                # both kinds bind ONE metric instance as the cell (a bank's
+                # cell is its template); fused/driver kinds bind member lists
                 obs_source = type(cell).__name__
                 obs_screening = (
                     getattr(cell, "on_bad_input", "propagate"),
@@ -631,6 +658,108 @@ def update_transition(metric: Any, state: Dict[str, Any], args: Tuple[Any, ...],
     )
 
 
+# ---------------------------------------------------------------------------
+# multi-tenant bank programs (per-tenant state addressing)
+# ---------------------------------------------------------------------------
+def _make_bank_entry(key: Any, pins: Tuple) -> SharedEntry:
+    """One multi-tenant banked-update program family.
+
+    The state argument is a BANK: the same state pytree every other entry
+    kind carries, with one extra leading tenant axis (``[capacity, ...]``
+    per leaf). The body vmaps the SAME health-screened transition the
+    per-instance engine compiles (``resilience/health.traced_update``) over
+    the request axis, so per-tenant semantics — including
+    ``on_bad_input='skip'/'mask'`` and the pow2 pad-row correction — match a
+    solo instance by construction. Variants:
+
+    * ``scatter`` / ``scatter_pad`` — sparse request sets: gather the
+      addressed slots' states (``leaf[slots]``), vmap the transition over
+      the ``R`` requests, scatter the results back (``leaf.at[slots].set``).
+      Cost scales with R, not capacity. The request axis is padded to a
+      pow2 bucket by the caller with out-of-range slot ids: the gather
+      clamps (harmless — the result is discarded) and the scatter DROPS
+      out-of-bounds updates, which is jax's documented default mode — so
+      ragged flush sizes share O(log capacity) programs instead of
+      retracing per distinct R.
+    * ``dense`` / ``dense_pad`` — hot banks: vmap over the FULL capacity
+      axis with a per-slot active mask; inactive slots run the transition
+      on zero inputs and a ``where`` select keeps their old state bitwise.
+      No gather/scatter in the program; cost scales with capacity.
+
+    The ``*_pad`` twins carry a per-request traced pad count (the pow2
+    batch-bucketing correction), so tenants with different batch sizes in
+    the same bucket share one launch. All variants donate the bank on
+    donating backends — the bank is the carry of a long-lived serving loop.
+    """
+    entry = SharedEntry(key, "bank_update", pins)
+    entry.donate = donation_enabled()
+
+    def _request_body(treedef):
+        def body(state, step_leaves, pad):
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, list(step_leaves))
+            return _health.traced_update(entry.cell, state, args, kwargs, pad_count=pad)
+
+        return body
+
+    def _scatter(bank, slots, leaves, pads, treedef):
+        entry.mark_trace("scatter" if pads is None else "scatter_pad")
+        req_states = jax.tree_util.tree_map(lambda leaf: leaf[slots], bank)
+        body = _request_body(treedef)
+        if pads is None:
+            new_states = jax.vmap(lambda s, sl: body(s, sl, None))(req_states, tuple(leaves))
+        else:
+            new_states = jax.vmap(body)(req_states, tuple(leaves), pads)
+        return jax.tree_util.tree_map(
+            lambda leaf, upd: leaf.at[slots].set(upd), bank, new_states
+        )
+
+    def _dense(bank, active, leaves, pads, treedef):
+        entry.mark_trace("dense" if pads is None else "dense_pad")
+        body = _request_body(treedef)
+
+        def per_slot(state, act, step_leaves, pad):
+            new = body(state, step_leaves, pad)
+            # scalar `act` broadcasts against every state leaf: inactive
+            # slots keep their exact old bits, whatever the dummy update did
+            return {n: jnp.where(act, new[n], state[n]) for n in new}
+
+        if pads is None:
+            return jax.vmap(lambda s, a, sl: per_slot(s, a, sl, None))(
+                bank, active, tuple(leaves)
+            )
+        return jax.vmap(per_slot)(bank, active, tuple(leaves), pads)
+
+    def build(donate: bool) -> None:
+        argnums = (0,) if donate else ()
+        entry._fns = {
+            "scatter": jax.jit(
+                lambda bank, slots, leaves, treedef: _scatter(bank, slots, leaves, None, treedef),
+                static_argnums=(3,),
+                donate_argnums=argnums,
+            ),
+            "scatter_pad": jax.jit(_scatter, static_argnums=(4,), donate_argnums=argnums),
+            "dense": jax.jit(
+                lambda bank, active, leaves, treedef: _dense(bank, active, leaves, None, treedef),
+                static_argnums=(3,),
+                donate_argnums=argnums,
+            ),
+            "dense_pad": jax.jit(_dense, static_argnums=(4,), donate_argnums=argnums),
+        }
+
+    entry._build = build
+    build(entry.donate)
+    return entry
+
+
+def bank_entry(template: Any) -> SharedEntry:
+    """Shared entry for one bank program family, keyed by the template's
+    :func:`program_identity` alone — the tenant population is state, not
+    identity, so every bank (and every restarted worker's bank) of the same
+    metric config shares one compiled family per input signature."""
+    key, pins = program_identity(template)
+    return _get_or_create(("bank_update", key), lambda: _make_bank_entry(key, pins))
+
+
 def _make_driver_entry(
     cache_key: Any,
     keys: Tuple[str, ...],
@@ -851,4 +980,12 @@ def cache_summary() -> Dict[str, Any]:
         for k in totals:
             kind[k] += s[k]
             totals[k] += s[k]
-    return {"entries": len(entries), **totals, "donation_active": donation_enabled(), "by_kind": by_kind}
+    from metrics_tpu.engine import persist as _persist
+
+    return {
+        "entries": len(entries),
+        **totals,
+        "donation_active": donation_enabled(),
+        "by_kind": by_kind,
+        "persistent_cache": _persist.persistent_cache_stats(),
+    }

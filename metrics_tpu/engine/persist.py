@@ -1,0 +1,142 @@
+"""Opt-in persistent compile cache: restarted serving workers skip recompiles.
+
+The process-wide jit cache (``engine.cache``) deduplicates compiles *within*
+a process; a restarted worker still pays the full trace+compile tax on its
+first request per signature. This module wires JAX's persistent compilation
+cache (SNIPPETS [3]: ``compilation_cache.initialize_cache``; spelled
+``jax_compilation_cache_dir`` on current jax) UNDER the process-wide cache,
+so a warm cache directory turns a cold worker's first-compile into a disk
+load:
+
+* :func:`enable_persistent_cache` — point jax at a cache directory and drop
+  the min-compile-time floor to zero (metric transitions are tiny programs
+  that would otherwise never be persisted).
+* ``METRICS_TPU_COMPILE_CACHE=<path>`` — env wiring: the engine enables the
+  cache automatically at import when the variable is set, so deployment
+  manifests need no code change.
+* **Observability** — a jax monitoring listener translates the backend's
+  ``/jax/compilation_cache/cache_hits`` event into a ``compile`` bus event
+  tagged ``persistent_hit=True`` (source ``persistent_cache``), and
+  :func:`persistent_cache_stats` (embedded in ``engine.cache_summary()``)
+  counts hits/misses — the retrace explainer tells you *why* something
+  compiled; this tells you whether the compile came from disk.
+"""
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from metrics_tpu.obs import bus as _bus
+
+__all__ = [
+    "ENV_VAR",
+    "enable_persistent_cache",
+    "persistent_cache_enabled",
+    "persistent_cache_stats",
+]
+
+ENV_VAR = "METRICS_TPU_COMPILE_CACHE"
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_LOCK = threading.Lock()
+_STATE: Dict[str, Any] = {
+    "enabled": False,
+    "path": None,
+    "persistent_hits": 0,
+    "persistent_misses": 0,
+    "listener_registered": False,
+}
+
+
+def _on_monitoring_event(event: str, **kwargs: Any) -> None:
+    """jax monitoring listener: count persistent-cache hits/misses and
+    surface each disk hit as a tagged ``compile`` bus event."""
+    if event == _HIT_EVENT:
+        with _LOCK:
+            _STATE["persistent_hits"] += 1
+        if _bus.enabled():
+            _bus.emit(
+                "compile",
+                source="persistent_cache",
+                persistent_hit=True,
+                path=str(_STATE["path"]),
+            )
+    elif event == _MISS_EVENT:
+        with _LOCK:
+            _STATE["persistent_misses"] += 1
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> str:
+    """Enable JAX's persistent compilation cache at ``path`` (or
+    ``$METRICS_TPU_COMPILE_CACHE``). Returns the resolved path.
+
+    Idempotent; re-enabling with a different path re-points the cache.
+    Programs compiled by ANY entry of the process-wide cache (per-metric,
+    fused, driver, bank) are persisted and reloaded across worker restarts;
+    compiles served from disk emit a ``compile`` bus event tagged
+    ``persistent_hit`` and are counted in :func:`persistent_cache_stats`.
+    """
+    path = path or os.environ.get(ENV_VAR)
+    if not path:
+        raise ValueError(
+            "enable_persistent_cache needs a directory: pass `path` or set"
+            f" the {ENV_VAR} environment variable."
+        )
+    path = os.path.abspath(os.path.expanduser(path))
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # metric update transitions compile in milliseconds; the default
+    # min-compile-time floor (1s) would persist nothing we serve
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except AttributeError:  # older jax: no size floor to lower
+        pass
+    with _LOCK:
+        _STATE["enabled"] = True
+        _STATE["path"] = path
+        if not _STATE["listener_registered"]:
+            from jax import monitoring
+
+            monitoring.register_event_listener(_on_monitoring_event)
+            _STATE["listener_registered"] = True
+    return path
+
+
+def persistent_cache_enabled() -> bool:
+    return bool(_STATE["enabled"])
+
+
+def persistent_cache_stats() -> Dict[str, Any]:
+    """``{enabled, path, persistent_hits, persistent_misses}`` — embedded in
+    ``engine.cache_summary()`` and the process ``obs.snapshot()``."""
+    with _LOCK:
+        return {
+            "enabled": _STATE["enabled"],
+            "path": _STATE["path"],
+            "persistent_hits": _STATE["persistent_hits"],
+            "persistent_misses": _STATE["persistent_misses"],
+        }
+
+
+def _maybe_enable_from_env() -> None:
+    """Import-time env wiring (called by ``metrics_tpu.engine``): a worker
+    launched with ``METRICS_TPU_COMPILE_CACHE`` set starts warm with no code
+    change. Failures are swallowed into a warning — a bad cache path must
+    not take the whole library down at import."""
+    if not os.environ.get(ENV_VAR):
+        return
+    try:
+        enable_persistent_cache()
+    except Exception as err:  # noqa: BLE001 — import-time: degrade, don't die
+        import warnings
+
+        warnings.warn(
+            f"{ENV_VAR} is set but the persistent compile cache could not be"
+            f" enabled: {err}",
+            RuntimeWarning,
+            stacklevel=2,
+        )

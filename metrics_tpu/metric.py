@@ -437,6 +437,83 @@ class Metric:
                 )
         return out
 
+    def state_spec(self) -> Dict[str, Any]:
+        """``name -> jax.ShapeDtypeStruct`` for every array state (list
+        states map to ``None``). This is the per-tenant slot layout a
+        :class:`~metrics_tpu.serving.MetricBank` replicates under its
+        leading tenant axis."""
+        out: Dict[str, Any] = {}
+        for name, default in self._defaults.items():
+            if isinstance(default, list):
+                out[name] = None
+            else:
+                arr = jnp.asarray(default)
+                out[name] = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+        return out
+
+    def bind_state(self, state: Dict[str, Any], update_count: Optional[int] = None) -> "Metric":
+        """Bind a state pytree onto this instance (validated against the
+        registered spec) — the inverse of :meth:`_snapshot_state` for
+        external state holders (bank slots, user-managed pure-API carries).
+        Invalidates the compute cache; ``update_count`` (when given) makes
+        lifecycle bookkeeping — compute-before-update warning, ``forward``
+        merges — behave as if this instance had run those updates itself.
+        """
+        unknown = sorted(set(state) - set(self._defaults))
+        missing = sorted(set(self._defaults) - set(state))
+        if unknown or missing:
+            raise MetricsUserError(
+                f"bind_state on {type(self).__name__}: state tree does not"
+                f" match the registered states (missing {missing},"
+                f" unknown {unknown})."
+            )
+        bound: Dict[str, Any] = {}
+        for name, value in state.items():
+            default = self._defaults[name]
+            if isinstance(default, list) != isinstance(value, list):
+                raise MetricsUserError(
+                    f"bind_state on {type(self).__name__}: state {name!r}"
+                    " kind (list vs array) does not match its registration."
+                )
+            if isinstance(default, list):
+                bound[name] = list(value)
+                continue
+            arr = jnp.asarray(value)
+            # same validation contract as checkpoint restore: exact shape
+            # (shape-polymorphic states exempt — their update legitimately
+            # reassigns them), coarse dtype kind, cast to the registered
+            # dtype so the carry matches what update() would produce
+            if (
+                arr.shape != default.shape
+                and name not in self._shape_polymorphic_states
+            ):
+                raise MetricsUserError(
+                    f"bind_state on {type(self).__name__}: state {name!r} has"
+                    f" registered shape {tuple(default.shape)} but the tree"
+                    f" holds {tuple(arr.shape)} — state from a different"
+                    " configuration?"
+                )
+            if jnp.issubdtype(arr.dtype, jnp.floating) != jnp.issubdtype(
+                default.dtype, jnp.floating
+            ):
+                raise MetricsUserError(
+                    f"bind_state on {type(self).__name__}: state {name!r} is"
+                    f" registered as {default.dtype} but the tree holds"
+                    f" {arr.dtype} (float/integer kind mismatch)."
+                )
+            bound[name] = arr.astype(default.dtype)
+        self._restore_state(bound)
+        if update_count is not None:
+            self._update_count = int(update_count)
+        self._computed = None
+        self._is_synced = False
+        self._cache = None
+        _health.reset_seen_mirrors(
+            self,
+            np.asarray(state[_health.HEALTH_STATE]) if _health.HEALTH_STATE in state else None,
+        )
+        return self
+
     @property
     def _states_mergeable(self) -> bool:
         return all(

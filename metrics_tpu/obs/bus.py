@@ -49,8 +49,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: spans (``metrics_tpu.obs.trace``): ``update`` / ``forward`` / ``compute``
 #: / ``sync`` / ``drive`` (one scan-fused evaluation epoch through
 #: ``metrics_tpu.engine.driver``). Results plane: ``fetch`` (one coalesced
-#: device→host transfer resolving a ``compute_async`` handle). Misc:
-#: ``warning`` (a ``warn_once`` emission).
+#: device→host transfer resolving a ``compute_async`` handle). Serving plane
+#: (``metrics_tpu.serving``): ``admit`` (a tenant became device-resident in
+#: a ``MetricBank``), ``evict`` (a tenant left its slot — ``spilled`` says
+#: whether its state was kept on host), ``flush`` (one batched cross-tenant
+#: dispatch: ``requests`` updates in one XLA launch). Misc: ``warning`` (a
+#: ``warn_once`` emission).
 EVENT_KINDS = (
     "compile",
     "cache_hit",
@@ -66,6 +70,9 @@ EVENT_KINDS = (
     "sync",
     "drive",
     "fetch",
+    "admit",
+    "evict",
+    "flush",
     "warning",
 )
 

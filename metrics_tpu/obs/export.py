@@ -38,9 +38,14 @@ _EVENT_REQUIRED_FIELDS = ("v", "seq", "kind", "t", "source", "data")
 def process_snapshot() -> Dict[str, Any]:
     """The process-wide observability view (no metric argument needed)."""
     from metrics_tpu import engine as _engine
+    from metrics_tpu import serving as _serving
 
     return {
         "engine": _engine.cache_summary(),
+        # the async results plane (PR 5) is part of the process view too:
+        # coalesced-transfer counters ride next to the compile counters
+        "fetch": _engine.fetch_stats(),
+        "serving": _serving.serving_summary(),
         "bus": _bus.summary(),
         "spans": _trace.span_summary(),
         "warnings": {repr(k): v for k, v in _warn.warn_counts().items()},
@@ -179,6 +184,36 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
     _sample("metrics_tpu_engine_entries", eng["entries"], kind="gauge")  # LRU-evictable
     for key in ("calls", "compiles", "cache_hits", "retraces", "donated_bytes", "bucketed_calls"):
         _sample(f"metrics_tpu_engine_{key}", eng[key])
+    persist = eng.get("persistent_cache", {})
+    _sample(
+        "metrics_tpu_engine_persistent_cache_enabled",
+        1 if persist.get("enabled") else 0,
+        kind="gauge",
+    )
+    for key in ("persistent_hits", "persistent_misses"):
+        _sample(f"metrics_tpu_engine_{key}", persist.get(key, 0))
+
+    # async results plane (mirrors the snapshot's "fetch" section)
+    fetch = _engine.fetch_stats()
+    for key in ("async_fetches", "coalesced_leaves"):
+        _sample(f"metrics_tpu_engine_{key}", fetch[key])
+
+    # serving plane: per-bank occupancy / eviction / quarantine gauges
+    from metrics_tpu import serving as _serving
+
+    for bank_name, bank in sorted(_serving.serving_summary().items()):
+        labels = {"bank": bank_name, "template": bank.get("template", "")}
+        _sample("metrics_tpu_bank_capacity", bank["capacity"], labels, kind="gauge")
+        _sample("metrics_tpu_bank_occupancy", bank["occupancy"], labels, kind="gauge")
+        _sample("metrics_tpu_bank_spilled", bank["spilled"], labels, kind="gauge")
+        for key in ("admits", "readmits", "evictions", "spills", "launches", "requests"):
+            _sample(f"metrics_tpu_bank_{key}", bank[key], labels)
+        if "quarantine_rate" in bank:
+            _sample(
+                "metrics_tpu_bank_quarantine_rate", bank["quarantine_rate"], labels, kind="gauge"
+            )
+            _sample("metrics_tpu_bank_updates_quarantined", bank["updates_quarantined"], labels)
+            _sample("metrics_tpu_bank_rows_masked", bank["rows_masked"], labels)
 
     bus_summary = _bus.summary()
     for kind in sorted(bus_summary["by_kind"]):

@@ -166,6 +166,32 @@ def sync_state_in_trace(
     )["_"]
 
 
+def sync_bank_states(
+    bank: dict,
+    reductions: dict,
+    axis_name: Union[str, Sequence[str]],
+) -> dict:
+    """In-trace sync of a :class:`~metrics_tpu.serving.MetricBank` state
+    tree: banked states ride the EXISTING per-leaf collectives untouched —
+    a ``[capacity, ...]`` leaf under ``psum``/``pmax``/``pmin`` reduces
+    elementwise, preserving the tenant axis, so the contract is just that
+    every participating process assigns the same tenants to the same slots
+    (dp-style replicated serving). List/'cat' states never reach a bank
+    (banks reject list-state templates), so the ragged-gather machinery is
+    deliberately out of scope here.
+    """
+    for name, value in bank.items():
+        fx = reductions.get(name)
+        if isinstance(value, list) or fx not in ("sum", "mean", "max", "min"):
+            raise ValueError(
+                f"sync_bank_states: state {name!r} has reduction {fx!r};"
+                " banks only hold elementwise-reducible array states"
+                " (sum/mean/max/min) — a custom callable would receive the"
+                " tenant axis mixed into its gather axis."
+            )
+    return sync_state_in_trace(bank, reductions, axis_name)
+
+
 # ---------------------------------------------------------------------------
 # Host-level collectives (multi-process JAX; no-op in a single process)
 # ---------------------------------------------------------------------------

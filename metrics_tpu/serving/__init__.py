@@ -1,0 +1,26 @@
+"""Multi-tenant metric serving plane: state banks, batched dispatch, LRU spill.
+
+The "millions of users" layer (ROADMAP): thousands→millions of independent
+metric sessions — one per user/stream/experiment — served from shared
+device-resident state banks instead of per-instance dispatch.
+
+* :class:`MetricBank` (``serving/bank.py``) — up to ``capacity``
+  same-signature sessions as ONE device pytree with a leading tenant axis;
+  a batch of ``(tenant, update)`` requests is applied in ONE XLA launch
+  (vmapped, donated variant of the engine's health-screened transition),
+  with LRU spill of cold tenants to host via the existing checkpoint
+  encode, and per-tenant results sliced off one coalesced async fetch.
+* :class:`RequestRouter` (``serving/router.py``) — groups incoming updates
+  by input signature and flushes size/deadline-bounded waves into the bank.
+* :func:`serving_summary` — per-bank occupancy/eviction/quarantine
+  telemetry; surfaced in ``obs.snapshot()`` and the Prometheus dump
+  (``metrics_tpu_bank_*`` gauges), with ``admit``/``evict``/``flush``
+  events on the bus.
+
+See ``docs/serving.md`` for the bank model, admission/eviction policy,
+router flush semantics, and sizing guidance.
+"""
+from metrics_tpu.serving.bank import MetricBank, all_banks, serving_summary  # noqa: F401
+from metrics_tpu.serving.router import RequestRouter  # noqa: F401
+
+__all__ = ["MetricBank", "RequestRouter", "all_banks", "serving_summary"]

@@ -1,0 +1,715 @@
+"""Multi-tenant metric state banks: many sessions, one compiled launch.
+
+The engine already made the compiled transition a *process* resource (one
+program per config fingerprint, PR 1) — but every metric *instance* still
+dispatched its own XLA launch, so serving N independent sessions (one per
+user/stream/experiment) cost N launches no matter how identical they were.
+This module exploits the identity/state split in ``engine.cache``
+(:func:`~metrics_tpu.engine.cache.program_identity`): the program is a
+function of the config fingerprint only, the tenant is just data.
+
+A :class:`MetricBank` holds the states of up to ``capacity`` same-signature
+sessions as ONE device-resident pytree with a leading tenant axis
+(``[capacity, ...]`` per state leaf), compiled once. A batch of
+``(tenant_id, update args)`` requests is applied in ONE XLA launch through
+a vmapped, donated variant of the same health-screened ``traced_update``
+every solo instance compiles — so per-tenant results, including
+``on_bad_input='skip'/'mask'`` screening and the pow2 pad-row correction,
+are bit-identical to a solo :class:`~metrics_tpu.Metric` fed the same
+stream (CI-asserted by ``bench.py --serving-smoke``).
+
+Layout & dispatch (``engine/cache._make_bank_entry``):
+
+* **scatter** — sparse request sets: gather the addressed slots' states,
+  vmap the transition over the R requests, scatter the results back. The
+  request axis is padded to a pow2 bucket with out-of-range slot ids
+  (gather clamps, scatter drops — both jax defaults), so ragged flush
+  sizes share O(log capacity) programs.
+* **dense** — hot banks (R >= ``dense_threshold * capacity``): vmap over
+  the full capacity axis with an active mask; inactive slots run the
+  transition on zero inputs and a ``where`` select keeps their old bits.
+
+Sessions beyond ``capacity`` spill: admission evicts the least-recently
+-used tenant and round-trips its state through the EXISTING checkpoint
+encode (``utils.checkpoint.metric_state_pytree``) onto the host; re-admission
+decodes it back into a free slot exactly. Per-tenant results ride the PR-5
+async plane: :meth:`MetricBank.compute_async` returns one
+:class:`~metrics_tpu.engine.driver.AsyncResult` whose single coalesced
+device→host fetch carries every requested tenant's value.
+
+Observability: ``admit``/``evict``/``flush`` bus events, and per-bank
+occupancy / eviction / quarantine-rate gauges in ``obs.prometheus_text``
+via :func:`metrics_tpu.serving.serving_summary`.
+"""
+import itertools
+import threading
+import weakref
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.engine import bucketing as _bucketing
+from metrics_tpu.engine import cache as _cache
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.resilience import health as _health
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+Array = jax.Array
+
+__all__ = ["MetricBank", "all_banks", "serving_summary"]
+
+# live banks, for the process-wide ops surface (obs.snapshot / Prometheus):
+# weak so a dropped bank doesn't leak its device pytree through telemetry
+_BANKS: "weakref.WeakSet[MetricBank]" = weakref.WeakSet()
+_BANK_IDS = itertools.count()
+_REGISTRY_LOCK = threading.Lock()
+
+
+def all_banks() -> List["MetricBank"]:
+    with _REGISTRY_LOCK:
+        return sorted(_BANKS, key=lambda b: b.name)
+
+
+def serving_summary() -> Dict[str, Any]:
+    """Per-bank occupancy/eviction/launch telemetry for every live bank —
+    the serving section of ``obs.snapshot()`` and the source of the
+    ``metrics_tpu_bank_*`` Prometheus gauges."""
+    return {bank.name: bank.summary() for bank in all_banks()}
+
+
+def _bankable_error(template: Any) -> Optional[str]:
+    """Why this template cannot ride a bank, or None. Mirrors the driver's
+    scan gate: the banked program is the same traced transition, so the same
+    contracts disqualify — plus aliasing hazards specific to shared slots."""
+    if not template._enable_jit or template._jit_failed:
+        return "its update is not jit-compiled (jit_update=False or a prior trace failure)"
+    if template._has_list_state():
+        return "it holds list states (unbounded per-tenant buffers cannot share a fixed-shape bank)"
+    if getattr(template, "on_bad_input", "propagate") == "raise":
+        return "on_bad_input='raise' needs a per-update host check, incompatible with batched dispatch"
+    if _health.health_enabled(template) and _health.forces_eager(template):
+        return "its health policy forces eager dispatch (warn-on-removal or non-additive mask)"
+    if template._shape_polymorphic_states:
+        return (
+            "its update reassigns state shapes"
+            f" ({sorted(template._shape_polymorphic_states)}), which a fixed-shape"
+            " slot bank cannot hold"
+        )
+    return None
+
+
+class MetricBank:
+    """Device-resident state bank serving up to ``capacity`` sessions of one
+    metric signature with batched single-launch dispatch and LRU host spill.
+
+    Args:
+        template: a configured :class:`~metrics_tpu.Metric` defining the
+            signature (class + config). The bank clones it — the caller's
+            instance stays independent. Every tenant behaves exactly like a
+            private clone of this template.
+        capacity: number of device-resident tenant slots. Sessions beyond
+            it are admitted by spilling the least-recently-used tenant's
+            state to host (checkpoint-encoded) and re-admitted on demand.
+        name: label for telemetry (defaults to ``bank<N>``).
+        dense_threshold: fraction of ``capacity`` above which a request
+            batch dispatches through the dense full-bank variant instead of
+            gather/scatter.
+
+    ``update(tenant, *args)`` is sugar for a one-request
+    :meth:`apply_batch`; real serving traffic should flow through a
+    :class:`~metrics_tpu.serving.RequestRouter`, which groups requests by
+    signature and flushes size/deadline-bounded batches into one launch.
+    """
+
+    def __init__(
+        self,
+        template: Any,
+        capacity: int,
+        *,
+        name: Optional[str] = None,
+        dense_threshold: float = 0.5,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        reason = _bankable_error(template)
+        if reason is not None:
+            raise MetricsUserError(
+                f"{type(template).__name__} cannot be served from a MetricBank: {reason}."
+                " Serve such metrics as solo instances."
+            )
+        self._template = template.clone()
+        self.capacity = int(capacity)
+        self.name = name if name is not None else f"bank{next(_BANK_IDS)}"
+        self.dense_threshold = float(dense_threshold)
+        defaults = {
+            n: jnp.asarray(self._template._defaults[n]) for n in self._template._defaults
+        }
+        self._defaults = defaults
+        self._bank: Dict[str, Array] = {
+            n: jnp.repeat(d[None], self.capacity, axis=0) for n, d in defaults.items()
+        }
+        self._slots: Dict[Hashable, int] = {}
+        self._counts: Dict[Hashable, int] = {}
+        self._lru: Dict[Hashable, int] = {}
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))  # pop() -> slot 0 first
+        self._spilled: Dict[Hashable, Dict[str, Any]] = {}
+        self._spilled_counts: Dict[Hashable, int] = {}
+        # host aggregate of CURRENTLY-spilled tenants' health counters, so
+        # the bank-wide quarantine rate doesn't understate under LRU churn
+        # (spilled numerators must not vanish while their requests stay in
+        # the lifetime denominator); maintained at spill/readmit/drop
+        self._spilled_health = np.zeros(_health.N_SLOTS, dtype=np.int64)
+        self._tick = 0
+        self._lock = threading.RLock()
+        self._poisoned = False
+        self.stats: Dict[str, int] = {
+            "admits": 0,
+            "readmits": 0,
+            "evictions": 0,
+            "spills": 0,
+            "launches": 0,
+            "requests": 0,
+            "scatter_launches": 0,
+            "dense_launches": 0,
+            "bucketed_requests": 0,
+            "lost_tenants": 0,
+        }
+        with _REGISTRY_LOCK:
+            _BANKS.add(self)
+
+    # ------------------------------------------------------------------
+    # admission / eviction (control plane)
+    # ------------------------------------------------------------------
+    @property
+    def occupancy(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    @property
+    def tenants(self) -> List[Hashable]:
+        with self._lock:
+            return list(self._slots)
+
+    @property
+    def spilled_tenants(self) -> List[Hashable]:
+        with self._lock:
+            return list(self._spilled)
+
+    def _touch(self, tenant: Hashable) -> None:
+        self._tick += 1
+        self._lru[tenant] = self._tick
+
+    def _check_poisoned(self) -> None:
+        if self._poisoned:
+            raise MetricsUserError(
+                f"MetricBank {self.name!r} lost its device state to a failed"
+                " donated dispatch; the resident tenants' accumulations are"
+                " gone (spilled tenants survived on host). Build a new bank."
+            )
+
+    def admit(self, tenant: Hashable) -> int:
+        """Ensure ``tenant`` is device-resident; returns its slot.
+
+        A new tenant takes a free slot (its state starts at the registered
+        defaults); a spilled tenant is decoded back exactly. When the bank
+        is full, the least-recently-used tenant is evicted first (spilled
+        to host). Emits an ``admit`` bus event."""
+        with self._lock:
+            self._check_poisoned()
+            return self._admit_many([tenant])[0]
+
+    def _admit_many(self, tenants: List[Hashable]) -> List[int]:
+        """Admit a batch under one bank rebuild: slot writes for every new
+        admission are applied with ONE ``.at[slots].set`` per state leaf —
+        filling a capacity-C bank is O(C) copied leaves, not O(C^2). The
+        batch's tenants are pinned against eviction by each other's
+        admissions (caller holds the lock)."""
+        pinned = frozenset(tenants)
+        writes: Dict[int, Dict[str, Any]] = {}
+        slots: List[int] = []
+        for tenant in tenants:
+            if tenant in self._slots:
+                self._touch(tenant)
+                slots.append(self._slots[tenant])
+                continue
+            readmit = tenant in self._spilled
+            if not self._free:
+                self._evict_lru(pinned)
+            slot = self._free.pop()
+            if readmit:
+                state, count = self._decode_spilled(tenant)
+                self._drop_spilled_entry(tenant)
+                writes[slot] = state
+                self._counts[tenant] = count
+                self.stats["readmits"] += 1
+            else:
+                writes[slot] = self._defaults
+                self._counts[tenant] = 0
+                self.stats["admits"] += 1
+            self._slots[tenant] = slot
+            self._touch(tenant)
+            slots.append(slot)
+            if _bus.enabled():
+                _bus.emit(
+                    "admit",
+                    source=type(self._template).__name__,
+                    bank=self.name,
+                    tenant=str(tenant),
+                    slot=slot,
+                    readmit=readmit,
+                    occupancy=len(self._slots),
+                )
+        if writes:
+            self._write_slots(writes)
+        return slots
+
+    def _evict_lru(self, pinned: frozenset) -> None:
+        victims = [t for t in self._slots if t not in pinned]
+        if not victims:
+            raise MetricsUserError(
+                f"MetricBank {self.name!r} cannot admit: every resident tenant"
+                " is part of the current batch (batch size exceeds capacity"
+                f" {self.capacity}). Route through a RequestRouter with"
+                " max_requests <= capacity."
+            )
+        victim = min(victims, key=lambda t: self._lru[t])
+        self.evict(victim)
+
+    def evict(self, tenant: Hashable, spill: bool = True) -> None:
+        """Remove ``tenant`` from the bank. ``spill=True`` (default) keeps
+        its state on host (checkpoint-encoded) for exact re-admission;
+        ``spill=False`` drops the session. Emits an ``evict`` bus event."""
+        with self._lock:
+            if not spill and tenant in self._spilled:
+                # dropping a host-spilled session needs no device state, so
+                # it works even on a poisoned bank
+                self._drop_spilled_entry(tenant)
+                return
+            self._check_poisoned()
+            if tenant not in self._slots:
+                raise KeyError(f"tenant {tenant!r} is not resident in bank {self.name!r}")
+            slot = self._slots.pop(tenant)
+            count = self._counts.pop(tenant)
+            self._lru.pop(tenant, None)
+            if spill:
+                tree = self._encode_state(self._read_slot(slot), count)
+                self._spilled[tenant] = tree
+                self._spilled_counts[tenant] = count
+                if _health.HEALTH_STATE in tree:
+                    self._spilled_health += np.asarray(tree[_health.HEALTH_STATE], np.int64)
+                self.stats["spills"] += 1
+            self._free.append(slot)
+            self.stats["evictions"] += 1
+            if _bus.enabled():
+                _bus.emit(
+                    "evict",
+                    source=type(self._template).__name__,
+                    bank=self.name,
+                    tenant=str(tenant),
+                    slot=slot,
+                    spilled=spill,
+                    occupancy=len(self._slots),
+                )
+
+    def _drop_spilled_entry(self, tenant: Hashable) -> None:
+        tree = self._spilled.pop(tenant)
+        self._spilled_counts.pop(tenant)
+        if _health.HEALTH_STATE in tree:
+            self._spilled_health -= np.asarray(tree[_health.HEALTH_STATE], np.int64)
+
+    # -- slot <-> state plumbing ----------------------------------------
+    def _read_slot(self, slot: int) -> Dict[str, Array]:
+        return {n: leaf[slot] for n, leaf in self._bank.items()}
+
+    def _write_slots(self, writes: Dict[int, Dict[str, Any]]) -> None:
+        slots = sorted(writes)
+        idx = jnp.asarray(slots, jnp.int32)
+        self._bank = {
+            n: leaf.at[idx].set(
+                jnp.stack([jnp.asarray(writes[s][n], leaf.dtype) for s in slots])
+            )
+            for n, leaf in self._bank.items()
+        }
+
+    def _encode_state(self, state: Dict[str, Any], count: int) -> Dict[str, Any]:
+        """Host-encode one tenant's state through the EXISTING checkpoint
+        encode — a spilled tenant is exactly a checkpointed metric."""
+        from metrics_tpu.utils import checkpoint as _ckpt
+
+        tpl = self._template
+        saved, saved_count = tpl._snapshot_state(), tpl._update_count
+        try:
+            tpl._restore_state(state)
+            tpl._update_count = count
+            return _ckpt.metric_state_pytree(tpl)
+        finally:
+            tpl._restore_state(saved)
+            tpl._update_count = saved_count
+
+    def _decode_spilled(self, tenant: Hashable) -> Tuple[Dict[str, Any], int]:
+        from metrics_tpu.utils import checkpoint as _ckpt
+
+        tpl = self._template
+        saved, saved_count = tpl._snapshot_state(), tpl._update_count
+        try:
+            _ckpt.restore_metric_state_pytree(tpl, self._spilled[tenant])
+            return tpl._snapshot_state(), tpl._update_count
+        finally:
+            tpl._restore_state(saved)
+            tpl._update_count = saved_count
+
+    # ------------------------------------------------------------------
+    # batched cross-tenant dispatch (data plane)
+    # ------------------------------------------------------------------
+    def update(self, tenant: Hashable, *args: Any) -> None:
+        """Apply one tenant's update (a one-request batch — still one
+        launch; batch requests through a router for amortization)."""
+        self.apply_batch([(tenant, args)])
+
+    def apply_batch(self, requests: Sequence[Tuple[Hashable, Tuple[Any, ...]]]) -> int:
+        """Apply a batch of ``(tenant_id, update_args)`` requests in ONE XLA
+        launch; returns the number of requests applied.
+
+        Constraints (the :class:`RequestRouter` guarantees both): at most
+        one request per tenant per batch, and every request shares one
+        input signature — identical leaf shapes/dtypes, or batch sizes in
+        the same pow2 bucket when the template opted into
+        ``jit_bucket='pow2'`` (ragged request batches are padded and
+        corrected exactly, like a solo bucketed instance).
+        """
+        if not requests:
+            return 0
+        with self._lock:
+            self._check_poisoned()
+            return self._apply_batch_locked(list(requests))
+
+    def _apply_batch_locked(self, requests: List[Tuple[Hashable, Tuple[Any, ...]]]) -> int:
+        tenants = [t for t, _ in requests]
+        if len(set(tenants)) != len(tenants):
+            raise ValueError(
+                "apply_batch got multiple requests for one tenant in a single"
+                " batch; the second update would race the first inside one"
+                " launch. Queue them as separate waves (RequestRouter does)."
+            )
+        if len(requests) > self.capacity:
+            raise ValueError(
+                f"batch of {len(requests)} requests exceeds bank capacity"
+                f" {self.capacity}; split it (RequestRouter clamps flushes)."
+            )
+        first_args = requests[0][1]
+        _cache.ensure_python_init(self._template, first_args, {})
+
+        flat = [jax.tree_util.tree_flatten((args, {})) for _, args in requests]
+        treedef = flat[0][1]
+        if any(td != treedef for _, td in flat[1:]):
+            raise ValueError(
+                "apply_batch requests disagree on update-argument structure;"
+                " group by signature first (RequestRouter does)."
+            )
+        leaves_per_req = [leaves for leaves, _ in flat]
+        batched = _bucketing.batched_leaf_indices(leaves_per_req[0])
+        pads = self._unify_shapes(leaves_per_req, batched)
+
+        entry = _cache.bank_entry(self._template)
+        stats = _cache.instance_stats(self._template)
+        slots = self._admit_many(tenants)
+
+        n_req = len(requests)
+        dense = n_req >= self.dense_threshold * self.capacity
+        # a trace binds tracer states onto the template (the traced body is
+        # `_restore_state` + update); a solo instance overwrites them with
+        # the dispatch result, the bank must restore concrete leaves itself
+        tpl_saved = self._template._snapshot_state()
+        try:
+            if dense:
+                out = self._dispatch_dense(entry, stats, slots, leaves_per_req, pads, treedef)
+            else:
+                out = self._dispatch_scatter(entry, stats, slots, leaves_per_req, pads, treedef)
+        except Exception:
+            self._rollback_after_failure()
+            raise
+        finally:
+            self._template._restore_state(tpl_saved)
+        self._bank = out
+        for t in tenants:
+            self._counts[t] += 1
+        self.stats["launches"] += 1
+        self.stats["requests"] += n_req
+        self.stats["dense_launches" if dense else "scatter_launches"] += 1
+        if pads is not None:
+            self.stats["bucketed_requests"] += n_req
+        if _bus.enabled():
+            _bus.emit(
+                "flush",
+                source=type(self._template).__name__,
+                bank=self.name,
+                requests=n_req,
+                variant="dense" if dense else "scatter",
+                bucketed=pads is not None,
+                occupancy=len(self._slots),
+            )
+        return n_req
+
+    def _unify_shapes(
+        self, leaves_per_req: List[List[Any]], batched: Tuple[int, ...]
+    ) -> Optional[List[int]]:
+        """Pad ragged request batches into one shape (pow2 bucketing opt-in,
+        exactly like a solo ``jit_bucket='pow2'`` instance); returns the
+        per-request pad counts, or None for an exact-shape batch. Mutates
+        ``leaves_per_req`` in place with the padded leaves."""
+        sigs = [
+            tuple((tuple(np.shape(x)), str(jnp.result_type(x))) for x in leaves)
+            for leaves in leaves_per_req
+        ]
+        if not _bucketing.bucketing_active(self._template, batched):
+            if any(s != sigs[0] for s in sigs[1:]):
+                raise ValueError(
+                    "apply_batch requests disagree on input shapes/dtypes and"
+                    f" {type(self._template).__name__} did not opt into"
+                    " jit_bucket='pow2'; group by exact signature first."
+                )
+            return None
+        batch_sizes = [int(np.shape(leaves[batched[0]])[0]) for leaves in leaves_per_req]
+        bucket = _bucketing.next_pow2(max(batch_sizes))
+        pads = [bucket - b for b in batch_sizes]
+        for i, leaves in enumerate(leaves_per_req):
+            leaves_per_req[i] = _bucketing.pad_leaves(leaves, batched, pads[i])
+        padded_sigs = [
+            tuple((tuple(np.shape(x)), str(jnp.result_type(x))) for x in leaves)
+            for leaves in leaves_per_req
+        ]
+        if any(s != padded_sigs[0] for s in padded_sigs[1:]):
+            raise ValueError(
+                "apply_batch requests differ beyond the batch axis (trailing"
+                " dims or dtypes); group by signature first."
+            )
+        return pads
+
+    @staticmethod
+    def _host_stackable(x: Any) -> bool:
+        """Stage via numpy only when it costs no device sync: host-origin
+        data, or CPU-backend arrays (where ``np.asarray`` is a view). On an
+        accelerator a per-leaf ``np.asarray`` is a blocking D2H transfer —
+        exactly the serialization the bank exists to remove — so
+        device-resident requests stay on-device through ``jnp.stack``."""
+        if not isinstance(x, jax.Array):
+            return True
+        try:
+            return all(d.platform == "cpu" for d in x.devices())
+        except Exception:  # noqa: BLE001 — tracers/uncommitted: stay on-device
+            return False
+
+    def _stack(self, leaves_per_req: List[List[Any]]) -> List[Array]:
+        cols = list(zip(*leaves_per_req))
+        out: List[Array] = []
+        for col in cols:
+            if all(self._host_stackable(x) for x in col):
+                # host-side stack + ONE device put: an N-operand jnp.stack
+                # costs a dispatch per flush that dominates small-batch
+                # serving when the data is host-resident anyway
+                out.append(jnp.asarray(np.stack([np.asarray(x) for x in col])))
+            else:
+                out.append(jnp.stack([jnp.asarray(x) for x in col]))
+        return out
+
+    def _dispatch_scatter(self, entry, stats, slots, leaves_per_req, pads, treedef):
+        n_req = len(slots)
+        n_padded = _bucketing.next_pow2(n_req)
+        rows = list(leaves_per_req)
+        slot_ids = list(slots)
+        req_pads = list(pads) if pads is not None else None
+        if n_padded > n_req:
+            # pad the REQUEST axis with sentinel rows: slot id == capacity
+            # (gather clamps to a real slot, whose result the scatter then
+            # DROPS — jax's default out-of-bounds modes), zero inputs
+            zero_row = [jnp.zeros_like(jnp.asarray(x)) for x in leaves_per_req[0]]
+            for _ in range(n_padded - n_req):
+                rows.append(list(zero_row))
+                slot_ids.append(self.capacity)
+                if req_pads is not None:
+                    req_pads.append(0)
+        stacked = self._stack(rows)
+        slots_arr = jnp.asarray(slot_ids, jnp.int32)
+        fn_args: Tuple[Any, ...] = (self._bank, slots_arr, tuple(stacked))
+        variant = "scatter"
+        if req_pads is not None:
+            variant = "scatter_pad"
+            fn_args += (jnp.asarray(req_pads, jnp.int32),)
+        fn_args += (treedef,)
+        return entry.invoke(variant, self._template, stats, *fn_args)
+
+    def _dispatch_dense(self, entry, stats, slots, leaves_per_req, pads, treedef):
+        n_leaves = len(leaves_per_req[0])
+        cols: List[Array] = []
+        slot_idx = jnp.asarray(list(slots), jnp.int32)
+        for i in range(n_leaves):
+            col = [leaves[i] for leaves in leaves_per_req]
+            ref = jnp.asarray(col[0])
+            if all(self._host_stackable(x) for x in col):
+                full = np.zeros((self.capacity,) + tuple(ref.shape), dtype=ref.dtype)
+                for slot, x in zip(slots, col):
+                    full[slot] = np.asarray(x)
+                cols.append(jnp.asarray(full))
+            else:
+                # device-resident inputs: scatter on-device, no D2H sync
+                stacked = jnp.stack([jnp.asarray(x) for x in col])
+                cols.append(
+                    jnp.zeros((self.capacity,) + tuple(ref.shape), ref.dtype)
+                    .at[slot_idx]
+                    .set(stacked)
+                )
+        active = np.zeros((self.capacity,), dtype=bool)
+        active[list(slots)] = True
+        fn_args: Tuple[Any, ...] = (self._bank, jnp.asarray(active), tuple(cols))
+        variant = "dense"
+        if pads is not None:
+            # inactive slots' pad counts are irrelevant (their output is
+            # where-discarded); zero keeps the correction a no-op there
+            full_pads = np.zeros((self.capacity,), dtype=np.int32)
+            for slot, pad in zip(slots, pads):
+                full_pads[slot] = pad
+            variant = "dense_pad"
+            fn_args += (jnp.asarray(full_pads),)
+        fn_args += (treedef,)
+        return entry.invoke(variant, self._template, stats, *fn_args)
+
+    def _rollback_after_failure(self) -> None:
+        """A trace-time failure leaves the bank intact; a runtime failure on
+        a donating backend may have consumed it. Mirror
+        ``engine.cache.rollback_state``: detect deleted leaves and poison
+        the bank rather than plant dead arrays."""
+
+        def _deleted(x: Any) -> bool:
+            try:
+                return isinstance(x, jax.Array) and x.is_deleted()
+            except Exception:  # noqa: BLE001 — unreadable == unusable
+                return True
+
+        if any(_deleted(leaf) for leaf in self._bank.values()):
+            self.stats["lost_tenants"] += len(self._slots)
+            self._poisoned = True
+
+    # ------------------------------------------------------------------
+    # per-tenant results (compute / async / materialize)
+    # ------------------------------------------------------------------
+    def tenant_state(self, tenant: Hashable) -> Dict[str, Any]:
+        """The tenant's state pytree (device leaves for resident tenants,
+        decoded host leaves for spilled ones). Spilled tenants remain
+        readable even on a poisoned bank — their host-encoded state is
+        exactly what the poisoning error promises survived."""
+        with self._lock:
+            if tenant in self._spilled:
+                return self._decode_spilled(tenant)[0]
+            self._check_poisoned()
+            if tenant in self._slots:
+                return self._read_slot(self._slots[tenant])
+            raise KeyError(f"unknown tenant {tenant!r} in bank {self.name!r}")
+
+    def update_count(self, tenant: Hashable) -> int:
+        with self._lock:
+            if tenant in self._counts:
+                return self._counts[tenant]
+            if tenant in self._spilled_counts:
+                return self._spilled_counts[tenant]
+            raise KeyError(f"unknown tenant {tenant!r} in bank {self.name!r}")
+
+    def compute(self, tenant: Hashable) -> Any:
+        """The tenant's metric value — ``compute()`` of a solo instance
+        holding the same state (device-resident, not yet fetched)."""
+        from metrics_tpu.utils.data import _squeeze_if_scalar
+
+        state = self.tenant_state(tenant)
+        with self._lock:
+            return _squeeze_if_scalar(self._template.compute_state(state))
+
+    def compute_many(self, tenants: Iterable[Hashable]) -> Dict[Hashable, Any]:
+        return {t: self.compute(t) for t in tenants}
+
+    def compute_async(self, tenants: Optional[Iterable[Hashable]] = None) -> Any:
+        """Per-tenant values sliced off ONE coalesced device→host fetch: an
+        :class:`~metrics_tpu.engine.driver.AsyncResult` over the
+        ``{tenant: value}`` tree (``.result()`` is a single
+        ``jax.device_get``, counted in ``engine.fetch_stats()``). The
+        default covers EVERY known session — resident and host-spilled —
+        so end-of-epoch reporting can't silently lose churned tenants."""
+        from metrics_tpu.engine.driver import AsyncResult
+
+        if tenants is None:
+            tenants = self.tenants + self.spilled_tenants
+        return AsyncResult(self.compute_many(tenants), source=f"MetricBank:{self.name}")
+
+    def materialize(self, tenant: Hashable) -> Any:
+        """A standalone clone of the template bound to the tenant's state —
+        the escape hatch onto every existing per-instance surface (host
+        sync dance, checkpointing, reports, wrappers)."""
+        metric = self._template.clone()
+        metric.bind_state(self.tenant_state(tenant), update_count=self.update_count(tenant))
+        return metric
+
+    # ------------------------------------------------------------------
+    # distributed: banked states ride the existing sync path
+    # ------------------------------------------------------------------
+    def sync_state_in_trace(self, axis_name: Any) -> None:
+        """Reduce the WHOLE bank across a mesh axis in-trace — valid when
+        every process assigns the same tenants to the same slots (dp-style
+        replicated serving). The leading tenant axis rides the existing
+        per-leaf collectives untouched (see ``parallel/comm.sync_bank_states``)."""
+        from metrics_tpu.parallel import comm
+
+        with self._lock:
+            self._bank = comm.sync_bank_states(
+                self._bank, self._template._reductions, axis_name
+            )
+
+    # ------------------------------------------------------------------
+    # ops surface
+    # ------------------------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        """Occupancy/eviction/launch counters plus the bank-wide screening
+        totals (summed over every resident tenant's health-counter state)."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                "template": type(self._template).__name__,
+                "capacity": self.capacity,
+                "occupancy": len(self._slots),
+                "spilled": len(self._spilled),
+                **self.stats,
+            }
+            requests = self.stats["requests"]
+            out["launch_amortization"] = (
+                round(requests / self.stats["launches"], 3) if self.stats["launches"] else None
+            )
+            health_leaf = self._bank.get(_health.HEALTH_STATE)
+            occupied = sorted(self._slots.values()) if self._slots else []
+            counts_dev = None
+            spilled_health = self._spilled_health.copy()
+            if health_leaf is not None and occupied:
+                # the REDUCTION runs under the lock (async dispatch into a
+                # fresh buffer, so a later donating flush can't delete it),
+                # but the blocking device->host FETCH happens outside it: a
+                # scrape landing mid-flush waits on the pending launch, and
+                # holding the bank lock there would stall the serving data
+                # plane behind telemetry
+                counts_dev = health_leaf[jnp.asarray(occupied, jnp.int32)].sum(axis=0)
+        if health_leaf is not None:
+            # resident slots + currently-spilled tenants: the rate's
+            # numerator must not shrink when LRU churn moves counters to host
+            counts = spilled_health
+            if counts_dev is not None:
+                counts = counts + np.asarray(counts_dev, np.int64)
+            out["nan_count"] = int(counts[_health.SLOT_NAN])
+            out["inf_count"] = int(counts[_health.SLOT_INF])
+            out["rows_masked"] = int(counts[_health.SLOT_MASKED])
+            out["updates_quarantined"] = int(counts[_health.SLOT_QUARANTINED])
+            out["quarantine_rate"] = (
+                round(out["updates_quarantined"] / requests, 6) if requests else 0.0
+            )
+        return out
+
+    def __repr__(self) -> str:
+        return (
+            f"MetricBank(name={self.name!r}, template={type(self._template).__name__},"
+            f" occupancy={len(self._slots)}/{self.capacity}, spilled={len(self._spilled)})"
+        )

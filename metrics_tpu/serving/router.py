@@ -1,0 +1,198 @@
+"""Request router: group per-tenant updates by signature, flush in batches.
+
+Serving traffic arrives one ``(tenant, batch)`` request at a time; the bank
+amortizes launches only when requests reach it in batches. The router is the
+piece in between: it buckets incoming requests by *input signature* — the
+exact leaf shapes/dtypes/structure, or the pow2 batch bucket when the bank's
+template opted into ``jit_bucket='pow2'`` (so ragged per-tenant batch sizes
+still share a launch) — and flushes a bucket into
+:meth:`MetricBank.apply_batch` when either bound trips:
+
+* **size** — a wave reaches ``max_requests`` (clamped to bank capacity);
+* **deadline** — the oldest pending request has waited ``max_delay_s``.
+
+Two requests for one tenant cannot ride one launch (the second would race
+the first inside the program), so each signature group holds a list of
+*waves*: a request lands in the first wave not already holding its tenant,
+and a flush dispatches the waves in arrival order — per-tenant update order
+is preserved exactly.
+
+The router is deliberately thread-simple and clock-driven rather than
+thread-driven: deadlines are checked on :meth:`submit` and :meth:`poll`
+(call ``poll()`` from your serving loop's idle tick); nothing flushes from
+a background thread, so request application stays deterministic — the
+property the eviction-determinism CI gate relies on.
+"""
+import time
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.engine import bucketing as _bucketing
+
+__all__ = ["RequestRouter"]
+
+
+class _Wave:
+    __slots__ = ("t", "reqs")
+
+    def __init__(self, now: float) -> None:
+        self.t = now  # creation time == arrival of its oldest request
+        self.reqs: Dict[Hashable, Tuple[Any, ...]] = {}
+
+
+class _Group:
+    __slots__ = ("waves", "pending")
+
+    def __init__(self, now: float) -> None:
+        self.waves: List[_Wave] = [_Wave(now)]
+        self.pending = 0
+
+    @property
+    def oldest_t(self) -> float:
+        # waves are created in arrival order, so the head wave holds the
+        # oldest pending request — partial flushes pop it, and the deadline
+        # naturally advances to the next wave's own arrival time instead of
+        # restarting (a size-flushed head must not starve later waves)
+        return self.waves[0].t
+
+
+class RequestRouter:
+    """Batched dispatch front for one :class:`~metrics_tpu.serving.MetricBank`.
+
+    Args:
+        bank: the bank requests are applied to.
+        max_requests: flush a signature wave when it reaches this many
+            requests (default: ``min(256, bank.capacity)``; always clamped
+            to capacity).
+        max_delay_s: flush every wave of a signature group once its oldest
+            request has waited this long (checked on ``submit``/``poll``;
+            default 0.05s). ``None`` disables the deadline — size-only.
+        clock: time source (injectable for deterministic tests).
+    """
+
+    def __init__(
+        self,
+        bank: Any,
+        *,
+        max_requests: Optional[int] = None,
+        max_delay_s: Optional[float] = 0.05,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.bank = bank
+        cap = bank.capacity
+        self.max_requests = min(max_requests or min(256, cap), cap)
+        self.max_delay_s = max_delay_s
+        self._clock = clock
+        self._groups: Dict[Any, _Group] = {}
+        self.stats = {"submitted": 0, "flushes": 0, "deadline_flushes": 0, "size_flushes": 0}
+
+    # ------------------------------------------------------------------
+    def _signature(self, args: Tuple[Any, ...]) -> Any:
+        leaves, treedef = jax.tree_util.tree_flatten((args, {}))
+        batched = _bucketing.batched_leaf_indices(leaves)
+        bucketing_on = _bucketing.bucketing_active(self.bank._template, batched)
+        sig: List[Any] = [treedef]
+        for i, leaf in enumerate(leaves):
+            shape = tuple(np.shape(leaf))
+            if bucketing_on and i in batched:
+                # the batch axis folds into its pow2 bucket: ragged sizes in
+                # one bucket share a wave (the bank pads + corrects exactly)
+                shape = (_bucketing.next_pow2(shape[0]),) + shape[1:]
+            sig.append((shape, str(jnp.result_type(leaf))))
+        return tuple(sig)
+
+    def submit(self, tenant: Hashable, *args: Any) -> int:
+        """Queue one update request; returns the number of requests flushed
+        as a side effect (0 when the request just queued)."""
+        now = self._clock()
+        sig = self._signature(args)
+        flushed = 0
+        # per-tenant order is global, not per-signature: a request landing in
+        # a NEW signature group while the tenant still has pending requests
+        # in another group must not overtake them — flush those groups first
+        for other_sig, other in list(self._groups.items()):
+            if other_sig != sig and any(tenant in w.reqs for w in other.waves):
+                flushed += self._flush_group(other_sig)
+        group = self._groups.get(sig)
+        if group is None:
+            group = self._groups[sig] = _Group(now)
+        for wave in group.waves:
+            if tenant not in wave.reqs:
+                wave.reqs[tenant] = args
+                break
+        else:
+            fresh = _Wave(now)
+            fresh.reqs[tenant] = args
+            group.waves.append(fresh)
+        group.pending += 1
+        self.stats["submitted"] += 1
+        if len(group.waves[0].reqs) >= self.max_requests:
+            self.stats["size_flushes"] += 1
+            flushed += self._flush_group(sig, waves=1)
+        return flushed + self._flush_expired(now)
+
+    def poll(self) -> int:
+        """Deadline check without a new request (call from the serving
+        loop's idle tick); returns requests flushed."""
+        return self._flush_expired(self._clock())
+
+    def flush(self) -> int:
+        """Flush everything pending (e.g. before a compute/checkpoint
+        barrier); returns requests flushed."""
+        flushed = 0
+        for sig in list(self._groups):
+            flushed += self._flush_group(sig)
+        return flushed
+
+    @property
+    def pending(self) -> int:
+        return sum(g.pending for g in self._groups.values())
+
+    # ------------------------------------------------------------------
+    def _flush_expired(self, now: float) -> int:
+        if self.max_delay_s is None:
+            return 0
+        flushed = 0
+        for sig in list(self._groups):
+            group = self._groups.get(sig)
+            if group is not None and now - group.oldest_t >= self.max_delay_s:
+                self.stats["deadline_flushes"] += 1
+                flushed += self._flush_group(sig)
+        return flushed
+
+    def _flush_group(self, sig: Any, waves: Optional[int] = None) -> int:
+        group = self._groups.get(sig)
+        if group is None:
+            return 0
+        n_waves = len(group.waves) if waves is None else min(waves, len(group.waves))
+        flushed = 0
+        for _ in range(n_waves):
+            wave = group.waves.pop(0)
+            if not wave.reqs:
+                continue
+            requests = list(wave.reqs.items())
+            # a wave larger than capacity cannot be one launch: chunk it
+            try:
+                for start in range(0, len(requests), self.bank.capacity):
+                    chunk = requests[start : start + self.bank.capacity]
+                    applied = self.bank.apply_batch(chunk)
+                    self.stats["flushes"] += 1
+                    flushed += applied
+                    for tenant, _ in chunk:
+                        wave.reqs.pop(tenant, None)
+            except Exception:
+                # a failed dispatch must not lose requests or corrupt the
+                # pending counter: whatever was not applied goes back to the
+                # head of the queue (its wave time preserved) for a retry
+                # after the caller handles the error
+                group.pending -= flushed
+                if wave.reqs:
+                    group.waves.insert(0, wave)
+                raise
+        group.pending -= flushed
+        if not group.waves or all(not w.reqs for w in group.waves):
+            del self._groups[sig]
+        return flushed

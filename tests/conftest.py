@@ -69,6 +69,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "x64only: test depends on float64 numerics; skipped in the x32 lane"
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / long-haul test; deselected by the ROADMAP tier-1"
+        " verify command (-m 'not slow') — ci.sh's thorough lanes still run it",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

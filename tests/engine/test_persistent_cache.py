@@ -1,0 +1,98 @@
+"""Opt-in persistent compile cache (``engine.persist``): config wiring, env
+activation, monitoring-event translation, and (backend permitting) a real
+two-process disk round-trip."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+import jax
+
+from metrics_tpu import engine, obs
+from metrics_tpu.engine import persist
+
+
+def test_enable_requires_a_path(monkeypatch):
+    monkeypatch.delenv(persist.ENV_VAR, raising=False)
+    with pytest.raises(ValueError, match=persist.ENV_VAR):
+        persist.enable_persistent_cache()
+
+
+def test_enable_points_jax_at_the_cache_dir(tmp_path):
+    path = persist.enable_persistent_cache(str(tmp_path / "cc"))
+    assert os.path.isdir(path)
+    assert jax.config.jax_compilation_cache_dir == path
+    # tiny metric programs must clear the persistence floor
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == 0
+    assert persist.persistent_cache_enabled()
+    stats = persist.persistent_cache_stats()
+    assert stats["enabled"] and stats["path"] == path
+    # the engine's process summary embeds the same stats
+    assert engine.cache_summary()["persistent_cache"]["enabled"] is True
+
+
+def test_env_var_wiring(tmp_path):
+    path = str(tmp_path / "envcc")
+    code = (
+        "import os, metrics_tpu\n"
+        "from metrics_tpu.engine import persist\n"
+        "s = persist.persistent_cache_stats()\n"
+        f"assert s['enabled'] and s['path'] == os.path.abspath({path!r}), s\n"
+        "print('env wiring ok')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **{persist.ENV_VAR: path})
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True, timeout=240
+    )
+    assert out.returncode == 0, out.stderr
+    assert "env wiring ok" in out.stdout
+
+
+def test_disk_hit_emits_tagged_compile_event(tmp_path):
+    """The monitoring listener translates the backend's cache-hit event into
+    a ``compile`` bus event tagged ``persistent_hit`` (exercised directly:
+    whether a given backend build persists tiny CPU programs is its
+    business; the translation contract is ours)."""
+    persist.enable_persistent_cache(str(tmp_path / "cc2"))
+    before = persist.persistent_cache_stats()["persistent_hits"]
+    with obs.bus.capture(kinds=("compile",)) as events:
+        jax.monitoring.record_event("/jax/compilation_cache/cache_hits")
+    assert persist.persistent_cache_stats()["persistent_hits"] == before + 1
+    tagged = [e for e in events if e.data.get("persistent_hit")]
+    assert len(tagged) == 1
+    assert tagged[0].source == "persistent_cache"
+
+
+@pytest.mark.slow
+def test_restarted_worker_loads_programs_from_disk(tmp_path):
+    """Two fresh processes sharing one cache dir: the second must record
+    persistent-cache hits (skipped when this jax build doesn't persist CPU
+    executables at all — the first process then records no misses either)."""
+    path = str(tmp_path / "cc3")
+    code = (
+        "import jax, numpy as np, jax.numpy as jnp\n"
+        "import metrics_tpu as mt\n"
+        "from metrics_tpu.engine import persist\n"
+        "m = mt.Accuracy(num_classes=4)\n"
+        "m.update(jnp.asarray(np.eye(4, dtype=np.float32)),"
+        " jnp.asarray(np.arange(4, dtype=np.int32)))\n"
+        "s = persist.persistent_cache_stats()\n"
+        "print('HITS', s['persistent_hits'], 'MISSES', s['persistent_misses'])\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **{persist.ENV_VAR: path})
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True, text=True, timeout=300
+        )
+        assert out.returncode == 0, out.stderr
+        line = [l for l in out.stdout.splitlines() if l.startswith("HITS")][0]
+        parts = line.split()
+        return int(parts[1]), int(parts[3])
+
+    hits1, misses1 = run()
+    if misses1 == 0:
+        pytest.skip("this jax build does not persist CPU executables")
+    hits2, _ = run()
+    assert hits2 > 0, "restarted worker compiled from scratch despite a warm cache dir"

@@ -287,10 +287,17 @@ def test_jsonl_roundtrip_and_prometheus_render():
     assert "metrics_tpu_engine_compiles" in text
     assert 'metrics_tpu_obs_events_total{kind="' in text
     assert 'member="acc"' in text
-    # process snapshot embeds the same surfaces the exporters read
+    # process snapshot embeds the same surfaces the exporters read — since
+    # the serving plane, that includes the async-fetch counters and the
+    # per-bank serving summary
     process = obs.snapshot()
-    assert set(process) == {"engine", "bus", "spans", "warnings"}
+    assert set(process) == {"engine", "fetch", "serving", "bus", "spans", "warnings"}
     assert process["engine"] == engine.cache_summary()
+    assert process["fetch"] == engine.fetch_stats()
+    assert set(process["fetch"]) == {"async_fetches", "coalesced_leaves"}
+    # ...and the Prometheus dump mirrors the fetch counters
+    assert "metrics_tpu_engine_async_fetches" in text
+    assert "metrics_tpu_engine_coalesced_leaves" in text
 
 
 def test_validate_jsonl_rejects_bad_lines():

@@ -1,0 +1,337 @@
+"""MetricBank bit-identity and lifecycle: a tenant served through a bank —
+admit → interleaved batched updates with other tenants → evict/spill →
+re-admit → compute — must produce bit-identical results to a solo Metric
+instance fed the same stream (ISSUE 7 acceptance), across the stat-scores
+family, ConfusionMatrix, and Sum/MeanMetric, including
+``on_bad_input='skip'/'mask'`` and pow2-bucketed batches."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    Accuracy,
+    CatMetric,
+    ConfusionMatrix,
+    F1Score,
+    MeanMetric,
+    Precision,
+    StatScores,
+    SumMetric,
+    engine,
+)
+from metrics_tpu.serving import MetricBank, serving_summary
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+NUM_CLASSES = 5
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    yield
+    engine.clear_cache()
+
+
+def _cls_stream(seed, n=6, batch=16, nan_rows=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        preds = rng.rand(batch, NUM_CLASSES).astype(np.float32)
+        target = rng.randint(0, NUM_CLASSES, size=batch).astype(np.int32)
+        if nan_rows and i % 2 == 1:
+            preds[:nan_rows, 0] = np.nan
+        out.append((jnp.asarray(preds), jnp.asarray(target)))
+    return out
+
+
+def _assert_states_equal(solo, bank, tenant, context=""):
+    state = bank.tenant_state(tenant)
+    for name, value in solo._snapshot_state().items():
+        assert np.array_equal(np.asarray(value), np.asarray(state[name])), (
+            f"{context}: state {name!r} diverged"
+        )
+    assert bank.update_count(tenant) == solo._update_count
+
+
+def _serve_interleaved(factory, stream_a, others, capacity=None):
+    """Serve tenant 'A' (stream_a) through a bank interleaved with other
+    tenants' traffic, forcing evict/spill/re-admit churn; returns the bank.
+
+    Capacity equals the batch width, so the lone 'churn' tenant updated
+    between batches evicts the LRU batch member every step — every batch
+    re-admits at least one spilled tenant."""
+    capacity = capacity or (len(others) + 1)
+    bank = MetricBank(factory(), capacity=capacity)
+    n = len(stream_a)
+    for i in range(n):
+        batch = [("A", stream_a[i])] + [(t, s[i]) for t, s in others.items()]
+        bank.apply_batch(batch)
+        bank.update("churn", *stream_a[i])  # full bank: evicts an LRU member
+        if i == n // 2:
+            # force A off-device mid-stream: spill + exact re-admission
+            if "A" in bank.tenants:
+                bank.evict("A")
+            assert "A" in bank.spilled_tenants
+            bank.admit("A")
+    assert bank.stats["spills"] > 0 and bank.stats["readmits"] > 0
+    return bank
+
+
+METRIC_FACTORIES = [
+    pytest.param(lambda: Accuracy(num_classes=NUM_CLASSES), id="accuracy"),
+    pytest.param(lambda: StatScores(num_classes=NUM_CLASSES, reduce="macro"), id="stat_scores"),
+    pytest.param(lambda: Precision(num_classes=NUM_CLASSES, average="macro"), id="precision"),
+    pytest.param(lambda: F1Score(num_classes=NUM_CLASSES, average="micro"), id="f1"),
+    pytest.param(lambda: ConfusionMatrix(num_classes=NUM_CLASSES), id="confusion_matrix"),
+]
+
+
+@pytest.mark.parametrize("factory", METRIC_FACTORIES)
+def test_bank_bit_identity_classification(factory):
+    stream_a = _cls_stream(1)
+    others = {"B": _cls_stream(2), "C": _cls_stream(3)}
+    solo = factory()
+    for args in stream_a:
+        solo.update(*args)
+    bank = _serve_interleaved(factory, stream_a, others)
+    _assert_states_equal(solo, bank, "A", "classification")
+    solo_val = solo.compute()
+    bank_val = bank.compute("A")
+    assert np.array_equal(np.asarray(solo_val), np.asarray(bank_val))
+
+
+@pytest.mark.parametrize(
+    "factory, stream",
+    [
+        pytest.param(
+            lambda: SumMetric(nan_strategy="disable"),
+            [np.random.RandomState(s).rand(16).astype(np.float32) for s in range(4)],
+            id="sum",
+        ),
+        pytest.param(
+            lambda: MeanMetric(nan_strategy="disable"),
+            [np.random.RandomState(s).rand(16).astype(np.float32) for s in range(4)],
+            id="mean",
+        ),
+    ],
+)
+def test_bank_bit_identity_aggregation(factory, stream):
+    stream = [(jnp.asarray(v),) for v in stream]
+    solo = factory()
+    for args in stream:
+        solo.update(*args)
+    rng = np.random.RandomState(77)
+    others = {
+        "B": [(jnp.asarray(rng.rand(16).astype(np.float32)),) for _ in stream],
+    }
+    bank = _serve_interleaved(factory, stream, others)
+    _assert_states_equal(solo, bank, "A", "aggregation")
+    assert np.array_equal(np.asarray(solo.compute()), np.asarray(bank.compute("A")))
+
+
+@pytest.mark.parametrize("policy", ["skip", "mask"])
+def test_bank_bit_identity_screening_policies(policy):
+    def factory():
+        return Accuracy(num_classes=NUM_CLASSES, on_bad_input=policy)
+
+    stream_a = _cls_stream(11, nan_rows=3)
+    others = {"B": _cls_stream(12, nan_rows=2), "C": _cls_stream(13)}
+    solo = factory()
+    for args in stream_a:
+        solo.update(*args)
+    bank = _serve_interleaved(factory, stream_a, others)
+    # health counters are a registered state: they must ride the bank (and
+    # the spill round-trip) exactly like the accumulators
+    _assert_states_equal(solo, bank, "A", f"policy={policy}")
+    assert np.array_equal(np.asarray(solo.compute()), np.asarray(bank.compute("A")))
+    summary = bank.summary()
+    if policy == "skip":
+        assert summary["updates_quarantined"] > 0
+    else:
+        assert summary["rows_masked"] > 0
+
+
+def test_bank_bit_identity_pow2_bucketed_ragged_batches():
+    """Ragged per-request batch sizes share one launch via the pow2 pad
+    correction, bit-identical to a solo ``jit_bucket='pow2'`` instance."""
+
+    def factory():
+        return SumMetric(nan_strategy="disable", jit_bucket="pow2")
+
+    rng = np.random.RandomState(5)
+    sizes = [5, 7, 8, 3, 6]
+    stream_a = [(jnp.asarray(rng.rand(n).astype(np.float32)),) for n in sizes]
+    solo = factory()
+    for args in stream_a:
+        solo.update(*args)
+    bank = MetricBank(factory(), capacity=4)
+    for i, args in enumerate(stream_a):
+        other = (jnp.asarray(rng.rand(sizes[i]).astype(np.float32)),)
+        bank.apply_batch([("A", args), ("B", other)])
+    assert bank.stats["bucketed_requests"] > 0
+    _assert_states_equal(solo, bank, "A", "pow2")
+    assert np.array_equal(np.asarray(solo.compute()), np.asarray(bank.compute("A")))
+
+
+def test_bank_mixed_shapes_without_bucketing_rejected():
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=4)
+    a = (jnp.asarray(np.ones(4, np.float32)),)
+    b = (jnp.asarray(np.ones(6, np.float32)),)
+    with pytest.raises(ValueError, match="did not opt into"):
+        bank.apply_batch([("A", a), ("B", b)])
+
+
+def test_bank_launch_amortization_one_launch_per_batch():
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=32)
+    streams = {f"t{i}": _cls_stream(i, n=3) for i in range(16)}
+    for step in range(3):
+        bank.apply_batch([(t, s[step]) for t, s in streams.items()])
+    assert bank.stats["launches"] == 3
+    assert bank.stats["requests"] == 48
+    # one compiled program family shared across every launch: after the
+    # first trace, later batches are cache hits (same R bucket)
+    stats = engine.cache_summary()["by_kind"]["bank_update"]
+    assert stats["cache_hits"] >= 1
+
+
+def test_bank_dense_and_scatter_variants_agree():
+    solo = Accuracy(num_classes=NUM_CLASSES)
+    stream = _cls_stream(21, n=2)
+    for args in stream:
+        solo.update(*args)
+    # dense: batch fills the bank (threshold 0 forces dense)
+    dense = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=4, dense_threshold=0.0)
+    # scatter: same traffic, threshold above 1 forces gather/scatter
+    scatter = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=4, dense_threshold=2.0)
+    for args in stream:
+        dense.apply_batch([("A", args), ("B", args)])
+        scatter.apply_batch([("A", args), ("B", args)])
+    assert dense.stats["dense_launches"] == 2 and dense.stats["scatter_launches"] == 0
+    assert scatter.stats["scatter_launches"] == 2 and scatter.stats["dense_launches"] == 0
+    _assert_states_equal(solo, dense, "A", "dense")
+    _assert_states_equal(solo, scatter, "A", "scatter")
+
+
+def test_bank_spill_readmit_roundtrips_exactly():
+    bank = MetricBank(ConfusionMatrix(num_classes=NUM_CLASSES), capacity=1)
+    solo = ConfusionMatrix(num_classes=NUM_CLASSES)
+    stream = _cls_stream(31, n=4)
+    for i, args in enumerate(stream):
+        solo.update(*args)
+        bank.update("A", *args)
+        # every other step, bounce A through the host spill
+        bank.update("filler", *_cls_stream(99, n=4)[i])  # evicts A (capacity 1)
+        assert "A" in bank.spilled_tenants
+    _assert_states_equal(solo, bank, "A", "spill")
+    # spilled tenants still compute (host decode), without re-admission
+    assert np.array_equal(np.asarray(solo.compute()), np.asarray(bank.compute("A")))
+
+
+def test_bank_lru_eviction_order_deterministic():
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=2)
+    s = _cls_stream(41, n=1)[0]
+    bank.update("A", *s)
+    bank.update("B", *s)
+    bank.update("A", *s)  # A is now MRU
+    bank.update("C", *s)  # must evict B (LRU), not A
+    assert set(bank.tenants) == {"A", "C"}
+    assert bank.spilled_tenants == ["B"]
+
+
+def test_bank_duplicate_tenant_in_batch_rejected():
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=4)
+    s = _cls_stream(51, n=1)[0]
+    with pytest.raises(ValueError, match="multiple requests for one tenant"):
+        bank.apply_batch([("A", s), ("A", s)])
+
+
+def test_bank_batch_exceeding_capacity_rejected():
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=2)
+    s = _cls_stream(52, n=1)[0]
+    with pytest.raises(ValueError, match="exceeds bank capacity"):
+        bank.apply_batch([(f"t{i}", s) for i in range(3)])
+
+
+def test_unbankable_templates_rejected():
+    with pytest.raises(MetricsUserError, match="list states"):
+        MetricBank(CatMetric(), capacity=4)
+    with pytest.raises(MetricsUserError, match="raise"):
+        MetricBank(Accuracy(num_classes=NUM_CLASSES, on_bad_input="raise"), capacity=4)
+    with pytest.raises(MetricsUserError, match="eager"):
+        MetricBank(MeanMetric(nan_strategy="warn"), capacity=4)
+
+
+def test_bank_compute_async_one_coalesced_fetch():
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=8)
+    for t in ("A", "B", "C"):
+        for args in _cls_stream(hash(t) % 100, n=2):
+            bank.update(t, *args)
+    engine.reset_fetch_stats()
+    handle = bank.compute_async(["A", "B", "C"])
+    values = handle.result()
+    handle.result()  # resolving twice must not re-fetch
+    assert engine.fetch_stats()["async_fetches"] == 1
+    assert set(values) == {"A", "B", "C"}
+    for t in ("A", "B", "C"):
+        assert np.array_equal(np.asarray(values[t]), np.asarray(bank.compute(t)))
+
+
+def test_bank_materialize_rides_existing_surfaces():
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=4)
+    solo = Accuracy(num_classes=NUM_CLASSES)
+    for args in _cls_stream(61, n=3):
+        solo.update(*args)
+        bank.update("A", *args)
+    metric = bank.materialize("A")
+    assert type(metric) is Accuracy
+    assert metric._update_count == 3
+    assert np.array_equal(np.asarray(metric.compute()), np.asarray(solo.compute()))
+    # the materialized clone is independent of the bank
+    metric.reset()
+    _assert_states_equal(solo, bank, "A", "post-materialize")
+
+
+def test_state_spec_matches_bank_slot_layout():
+    m = Accuracy(num_classes=NUM_CLASSES)
+    spec = m.state_spec()
+    assert set(spec) == set(m._defaults)
+    bank = MetricBank(m, capacity=3)
+    for name, s in spec.items():
+        leaf = bank._bank[name]
+        assert tuple(leaf.shape) == (3,) + tuple(s.shape)
+        assert leaf.dtype == s.dtype
+    # bind_state round-trips a snapshot and rejects a mismatched tree
+    clone = Accuracy(num_classes=NUM_CLASSES)
+    clone.bind_state(m._snapshot_state(), update_count=0)
+    with pytest.raises(MetricsUserError, match="does not match"):
+        clone.bind_state({"nope": jnp.zeros(())})
+    # a tree with the right names but wrong shapes must not bind silently
+    bad = {
+        n: (jnp.zeros((7,) + tuple(s.shape)) if s is not None else [])
+        for n, s in spec.items()
+    }
+    with pytest.raises(MetricsUserError, match="registered shape"):
+        clone.bind_state(bad)
+
+
+def test_bank_events_and_serving_summary():
+    from metrics_tpu.obs import bus
+
+    with bus.capture(kinds=("admit", "evict", "flush")) as events:
+        bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=1, name="evbank")
+        s = _cls_stream(71, n=1)[0]
+        bank.update("x", *s)
+        bank.update("y", *s)  # evicts x
+    kinds = [e.kind for e in events]
+    assert kinds.count("admit") == 2 and kinds.count("evict") == 1 and kinds.count("flush") == 2
+    evict = next(e for e in events if e.kind == "evict")
+    assert evict.data["tenant"] == "x" and evict.data["spilled"] is True
+    summary = serving_summary()["evbank"]
+    assert summary["occupancy"] == 1 and summary["capacity"] == 1
+    assert summary["evictions"] == 1 and summary["launches"] == 2
+    # ...and the Prometheus dump renders the bank gauges
+    from metrics_tpu import obs
+
+    text = obs.prometheus_text()
+    assert 'metrics_tpu_bank_occupancy{bank="evbank"' in text

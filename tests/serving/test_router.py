@@ -1,0 +1,121 @@
+"""RequestRouter: signature grouping, wave ordering, size/deadline flush."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, SumMetric, engine
+from metrics_tpu.serving import MetricBank, RequestRouter
+
+NUM_CLASSES = 5
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    yield
+    engine.clear_cache()
+
+
+def _req(seed, batch=8):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.rand(batch, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, size=batch).astype(np.int32)),
+    )
+
+
+def test_size_flush_batches_requests_into_one_launch():
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=16)
+    router = RequestRouter(bank, max_requests=4, max_delay_s=None)
+    flushed = 0
+    for i in range(4):
+        flushed += router.submit(f"t{i}", *_req(i))
+    assert flushed == 4  # the 4th submit tripped the size bound
+    assert bank.stats["launches"] == 1 and bank.stats["requests"] == 4
+    assert router.pending == 0
+
+
+def test_same_tenant_requests_split_into_ordered_waves():
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=4)
+    router = RequestRouter(bank, max_requests=4, max_delay_s=None)
+    solo = SumMetric(nan_strategy="disable")
+    vals = [jnp.asarray(np.full(4, i + 1.0, np.float32)) for i in range(3)]
+    for v in vals:
+        solo.update(v)
+        router.submit("S", v)
+    router.flush()
+    # three same-tenant requests cannot share a launch: three waves
+    assert bank.stats["launches"] == 3
+    assert np.array_equal(
+        np.asarray(solo._snapshot_state()["value"]),
+        np.asarray(bank.tenant_state("S")["value"]),
+    )
+
+
+def test_signature_groups_keep_shapes_apart():
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=8)
+    router = RequestRouter(bank, max_requests=8, max_delay_s=None)
+    router.submit("a", jnp.asarray(np.ones(4, np.float32)))
+    router.submit("b", jnp.asarray(np.ones(6, np.float32)))  # different shape
+    router.submit("c", jnp.asarray(np.ones(4, np.float32)))
+    assert router.pending == 3
+    router.flush()
+    # two signature groups -> two launches (4-row wave {a, c}, 6-row wave {b})
+    assert bank.stats["launches"] == 2 and bank.stats["requests"] == 3
+
+
+def test_pow2_bucket_grouping_shares_a_wave():
+    bank = MetricBank(SumMetric(nan_strategy="disable", jit_bucket="pow2"), capacity=8)
+    router = RequestRouter(bank, max_requests=8, max_delay_s=None)
+    for i, n in enumerate((5, 7, 8)):  # all bucket to 8
+        router.submit(f"t{i}", jnp.asarray(np.ones(n, np.float32)))
+    router.flush()
+    assert bank.stats["launches"] == 1 and bank.stats["bucketed_requests"] == 3
+
+
+def test_cross_group_submissions_preserve_per_tenant_order():
+    """A tenant's request in a NEW signature group must not overtake its
+    pending requests in another group: the older group flushes first."""
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=8)
+    router = RequestRouter(bank, max_requests=8, max_delay_s=None)
+    router.submit("T", jnp.asarray(np.ones(4, np.float32)))      # group A, pending
+    assert router.pending == 1
+    router.submit("T", jnp.asarray(np.ones(6, np.float32)))      # group B: flushes A first
+    assert bank.stats["launches"] == 1                            # A applied before B queued
+    assert float(np.asarray(bank.compute("T"))) == 4.0
+    router.flush()
+    assert float(np.asarray(bank.compute("T"))) == 10.0
+
+
+def test_compute_async_default_covers_spilled_tenants():
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=1)
+    bank.update("a", jnp.asarray(np.ones(4, np.float32)))
+    bank.update("b", jnp.asarray(np.ones(4, np.float32)))  # spills "a"
+    values = bank.compute_async().result()
+    assert set(values) == {"a", "b"}
+
+
+def test_deadline_flush_uses_injected_clock():
+    now = [0.0]
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=8)
+    router = RequestRouter(bank, max_requests=100, max_delay_s=1.0, clock=lambda: now[0])
+    router.submit("a", *_req(1))
+    assert router.pending == 1
+    assert router.poll() == 0  # deadline not reached
+    now[0] = 2.0
+    assert router.poll() == 1  # deadline flush
+    assert bank.stats["launches"] == 1
+    assert router.stats["deadline_flushes"] == 1
+
+
+def test_oversized_wave_chunks_to_capacity():
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=2)
+    router = RequestRouter(bank, max_requests=100, max_delay_s=None)
+    for i in range(5):
+        router.submit(f"t{i}", *_req(i))
+    router.flush()
+    assert bank.stats["requests"] == 5
+    # ceil(5 / capacity 2) = 3 launches, LRU spill absorbing the overflow
+    assert bank.stats["launches"] == 3
+    assert bank.occupancy == 2 and len(bank.spilled_tenants) == 3
