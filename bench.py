@@ -1089,6 +1089,171 @@ def bench_sync_resilience() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# quantized sync wire codecs: exactness, error bounds, bytes-on-wire
+# ---------------------------------------------------------------------------
+def bench_sync_quantized() -> dict:
+    """Sync a list-state-heavy collection (curve specs + samplewise scores +
+    BERTScore-shaped int ids + a large count tensor) through the 2-rank KV
+    exchange under each wire codec and report bytes-on-wire + error.
+    ``ci.sh --quant-smoke`` asserts: the exact default is bit-identical wire
+    v1; integer-count states are bit-exact under EVERY codec; float states
+    stay within the documented per-codec bound; bytes-on-wire reduction is
+    >= 2x (bf16) / >= 3.5x (int8) on the quantized lane; and hierarchical
+    in-trace reduction matches flat psum bit-exactly for integer sums on
+    the 8-device mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu import Metric
+    from metrics_tpu.parallel import WIRE_VERSION, comm, new_group, quantize, unpack_envelope
+    from metrics_tpu.parallel.groups import _encode_tree
+    from metrics_tpu.resilience import InMemoryKVStore, RetryPolicy, run_as_peers
+
+    N = 4000  # samples per rank: float list states dominate the payload
+
+    class ListHeavy(Metric):
+        def __init__(self, precision, **kw):
+            super().__init__(jit_update=False, **kw)
+            self.add_state(
+                "curve",
+                [],
+                dist_reduce_fx="cat",
+                placeholder=jax.ShapeDtypeStruct((0, 3), jnp.float32),
+                sync_precision=precision,
+            )
+            self.add_state(
+                "scores", [], dist_reduce_fx="cat", placeholder=jnp.float32, sync_precision=precision
+            )
+            # BERTScore-shaped ids: ints stay exact even under the tag
+            self.add_state(
+                "ids", [], dist_reduce_fx="cat", placeholder=jnp.int32, sync_precision=precision
+            )
+            self.add_state("counts", jnp.zeros((1024,), jnp.int32), dist_reduce_fx="sum")
+
+        def update(self, curve, scores, ids):
+            self.curve.append(jnp.asarray(curve, jnp.float32))
+            self.scores.append(jnp.asarray(scores, jnp.float32))
+            self.ids.append(jnp.asarray(ids, jnp.int32))
+            self.counts = self.counts + jnp.bincount(jnp.asarray(ids) % 1024, length=1024)
+
+        def compute(self):
+            return {
+                "curve": jnp.concatenate(self.curve, axis=0),
+                "scores": jnp.concatenate([jnp.atleast_1d(s) for s in self.scores]),
+                "ids": jnp.concatenate([jnp.atleast_1d(i) for i in self.ids]),
+                "counts": self.counts,
+            }
+
+    retry = RetryPolicy(max_attempts=3, backoff_base_s=0.02, backoff_max_s=0.1)
+
+    def run(precision):
+        group = new_group([0, 1], name=f"bench_quant_{precision}", timeout_s=10.0, retry=retry)
+        metrics = [ListHeavy(precision, process_group=group) for _ in range(2)]
+        for rank, m in enumerate(metrics):
+            rng = np.random.default_rng(42)  # identical data per lane
+            m.update(
+                rng.normal(size=(N, 3)) * 5 + rank,
+                rng.normal(size=(N,)) * (rank + 1),
+                rng.integers(0, 30000, size=(N,)),
+            )
+        values = run_as_peers(
+            2,
+            lambda rank: jax.tree_util.tree_map(np.asarray, metrics[rank].compute()),
+            store=InMemoryKVStore(),
+        )
+        return values[0], metrics[0].sync_report(), metrics[0]
+
+    t0 = time.perf_counter()
+    exact_vals, exact_report, exact_metric = run("exact")
+    bf16_vals, bf16_report, _ = run("bf16")
+    int8_vals, int8_report, _ = run("int8")
+    elapsed = time.perf_counter() - t0
+
+    # the exact default still seals wire v1 — and records no quantized bytes
+    tree = {n: getattr(exact_metric, n) for n in exact_metric._reductions}
+    exact_v1 = unpack_envelope(_encode_tree(tree))[0] == WIRE_VERSION and (
+        exact_report["bytes_raw_quantized"] == 0
+        and exact_report["codec_counts"]["bf16"] == 0
+        and exact_report["codec_counts"]["int8"] == 0
+        and exact_report["bytes_raw"] == exact_report["bytes_encoded"]
+    )
+
+    int_exact = bool(
+        np.array_equal(bf16_vals["ids"], exact_vals["ids"])
+        and np.array_equal(int8_vals["ids"], exact_vals["ids"])
+        and np.array_equal(bf16_vals["counts"], exact_vals["counts"])
+        and np.array_equal(int8_vals["counts"], exact_vals["counts"])
+    )
+
+    def within(vals, codec):
+        ok = True
+        for name in ("curve", "scores"):
+            bound = quantize.error_bound(codec, float(np.max(np.abs(exact_vals[name]))))
+            ok = ok and float(np.max(np.abs(vals[name] - exact_vals[name]))) <= bound
+        return bool(ok)
+
+    bf16_ratio = bf16_report["bytes_raw_quantized"] / max(1, bf16_report["bytes_encoded_quantized"])
+    int8_ratio = int8_report["bytes_raw_quantized"] / max(1, int8_report["bytes_encoded_quantized"])
+    total_ratio_int8 = int8_report["bytes_raw"] / max(1, int8_report["bytes_encoded"])
+
+    # hierarchical integer psum vs flat on the 8-device mesh (bit-exactness
+    # acceptance gate); skipped when the lane has fewer devices
+    hier_exact = None
+    if len(jax.devices()) >= 8:
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        if hasattr(jax, "shard_map"):
+            _shard_map, _check = jax.shard_map, "check_vma"
+        else:
+            from jax.experimental.shard_map import shard_map as _shard_map
+
+            _check = "check_rep"
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("host", "local"))
+        x = jnp.arange(8 * 64, dtype=jnp.int32).reshape(8, 64) * 7919
+
+        def reduce_with(hier):
+            def f(shard):
+                return comm.reduce_in_trace(
+                    shard[0], "sum", ("host", "local"), hierarchical=hier
+                )
+
+            kw = {_check: False}
+            return np.asarray(
+                _shard_map(
+                    f, mesh=mesh, in_specs=(P(("host", "local")),), out_specs=P(), **kw
+                )(x)
+            )
+
+        hier_exact = bool(
+            np.array_equal(reduce_with(True), reduce_with(False))
+            and np.array_equal(reduce_with(True), np.asarray(x).sum(axis=0))
+        )
+
+    return {
+        "metric": "sync_quantized",
+        "value": round(int8_ratio, 3),
+        "unit": "bytes_on_wire_reduction_x",
+        "vs_baseline": None,
+        "exact_bit_identical_v1": exact_v1,
+        "int_states_bit_exact": int_exact,
+        "bf16_within_bound": within(bf16_vals, "bf16"),
+        "int8_within_bound": within(int8_vals, "int8"),
+        "bf16_ratio": round(bf16_ratio, 3),
+        "int8_ratio": round(int8_ratio, 3),
+        "int8_total_payload_ratio": round(total_ratio_int8, 3),
+        "bf16_max_dequant_error": bf16_report["max_dequant_error"],
+        "int8_max_dequant_error": int8_report["max_dequant_error"],
+        "bytes_raw": int8_report["bytes_raw"],
+        "bytes_encoded_int8": int8_report["bytes_encoded"],
+        "codec_counts_int8_lane": dict(int8_report["codec_counts"]),
+        "hierarchical_int_sum_bit_exact": hier_exact,
+        "n_samples_per_rank": N,
+        "elapsed_s": round(elapsed, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
 # numerical-health screening: policy correctness + compiled-in overhead
 # ---------------------------------------------------------------------------
 def bench_health_screening() -> dict:
@@ -1714,6 +1879,7 @@ _CONFIGS = [
     ("bench_compute_latency", 900, True),
     ("bench_engine_compile_stats", 900, True),
     ("bench_sync_resilience", 600, False),
+    ("bench_sync_quantized", 600, False),
     ("bench_health_screening", 900, True),
     ("bench_obs_smoke", 600, False),
     ("bench_eval_driver", 900, False),
@@ -1934,6 +2100,26 @@ def main() -> None:
 
             jax.config.update("jax_platforms", forced)
         result = bench_sync_resilience()
+        for key, value in _stamp().items():
+            result.setdefault(key, value)
+        emit(result)
+        return
+
+    if "--quant-smoke" in sys.argv:
+        # CI quantized-sync smoke: wire codecs through the real 2-rank KV
+        # exchange on CPU — exactness, bounds, bytes-on-wire reduction, and
+        # the 8-device hierarchical integer psum gate. The mesh needs 8
+        # virtual CPU devices; XLA_FLAGS is honored because backends init
+        # lazily (see tests/conftest.py).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
+        if forced:
+            import jax
+
+            jax.config.update("jax_platforms", forced)
+        result = bench_sync_quantized()
         for key, value in _stamp().items():
             result.setdefault(key, value)
         emit(result)

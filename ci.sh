@@ -46,6 +46,26 @@ assert obj["retried_sync_ok"] and obj["retried_sync_value_rank0"] == 11.0, f"ret
 print("resilience smoke OK:", line)
 '
 
+echo "=== quantized-sync smoke (wire codecs: exactness, bounds, bytes-on-wire) ==="
+JAX_PLATFORMS=cpu python bench.py --quant-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)
+assert obj["metric"] == "sync_quantized", obj
+# the exact default is BIT-identical to wire v1 (no quantized payloads at all)
+assert obj["exact_bit_identical_v1"] is True, obj
+# integer-count states never degrade under any codec
+assert obj["int_states_bit_exact"] is True, obj
+# float states stay within the documented per-codec bound
+assert obj["bf16_within_bound"] is True and obj["int8_within_bound"] is True, obj
+# bytes-on-wire reduction on the quantized lane of the list-heavy collection
+assert obj["bf16_ratio"] >= 2.0, obj
+assert obj["int8_ratio"] >= 3.5, obj
+# hierarchical integer psum == flat psum on the 8-device mesh, bit-exactly
+assert obj["hierarchical_int_sum_bit_exact"] is True, obj
+print("quantized-sync smoke OK:", line)
+'
+
 echo "=== numerical-health smoke (screening policies through the fused engine) ==="
 # the count/determinism assertions must hold on EVERY attempt; the timing
 # gate gets one retry (min-based, but a fully throttled CI box can still

@@ -537,19 +537,27 @@ class MetricCollection:
         return {k: m.update_state(states[k], *args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
 
     def sync_state(
-        self, states: Dict[str, Dict[str, Any]], axis_name: Union[str, Sequence[str]]
+        self,
+        states: Dict[str, Dict[str, Any]],
+        axis_name: Union[str, Sequence[str]],
+        hierarchical: bool = False,
     ) -> Dict[str, Dict[str, Any]]:
         """In-trace cross-device sync of every member's state over a named
         mesh axis, in one traced region: each leaf lowers to its own
         collective and XLA's combiner merges adjacent launches where
         profitable (an explicit DDP-style flat-buffer packing was
         benchmarked ~24% slower on the CPU mesh and rejected — see
-        ``comm.sync_state_trees``)."""
+        ``comm.sync_state_trees``). ``hierarchical=True`` with a multi-axis
+        ``axis_name`` (ordered outer→inner, e.g. ``('host', 'local')``)
+        stages each collective intra-host first — see
+        ``comm.reduce_in_trace``."""
         from metrics_tpu.parallel import comm
 
         reductions = {k: m._reductions for k, m in self.items()}
         placeholders = {k: m._list_placeholders for k, m in self.items()}
-        return comm.sync_state_trees(states, reductions, axis_name, placeholders=placeholders)
+        return comm.sync_state_trees(
+            states, reductions, axis_name, placeholders=placeholders, hierarchical=hierarchical
+        )
 
     def compute_state(self, states: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         """Pure compute: ``states -> {key: value}``. Safe inside jit."""
@@ -695,14 +703,23 @@ class MetricCollection:
     @staticmethod
     def _sync_aggregate(members: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         """Cross-member sync aggregates from already-computed member reports
-        (numeric counters summed, last-sync missing ranks unioned)."""
+        (numeric counters summed — except ``max_dequant_error``, a max —
+        per-codec wire payload counts summed, last-sync missing ranks
+        unioned)."""
         out: Dict[str, Any] = {}
         missing: set = set()
+        codec_counts: Dict[str, int] = {}
         for report in members.values():
             for key, value in report.items():
-                if isinstance(value, (int, float)) and not isinstance(value, bool):
+                if key == "max_dequant_error":
+                    out[key] = max(out.get(key, 0.0), value)
+                elif isinstance(value, (int, float)) and not isinstance(value, bool):
                     out[key] = out.get(key, 0) + value
+            for codec, count in report.get("codec_counts", {}).items():
+                codec_counts[codec] = codec_counts.get(codec, 0) + count
             missing.update(report["missing_ranks"])
+        if codec_counts:
+            out["codec_counts"] = codec_counts
         out["missing_ranks"] = sorted(missing)
         return out
 

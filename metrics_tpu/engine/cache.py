@@ -760,13 +760,25 @@ def bank_entry(template: Any) -> SharedEntry:
     return _get_or_create(("bank_update", key), lambda: _make_bank_entry(key, pins))
 
 
+def axis_world(mesh: Any, axis_name: Any) -> int:
+    """Total device count across ``axis_name`` — one mesh axis, or the
+    product of a tuple of axes (the hierarchical-sync spelling)."""
+    if isinstance(axis_name, (tuple, list)):
+        world = 1
+        for ax in axis_name:
+            world *= int(mesh.shape[ax])
+        return world
+    return int(mesh.shape[axis_name])
+
+
 def _make_driver_entry(
     cache_key: Any,
     keys: Tuple[str, ...],
     pins: Tuple,
     compute_keys: Tuple[str, ...],
-    axis_name: Optional[str],
+    axis_name: Optional[Any],
     mesh: Optional[Any],
+    hierarchical: bool = False,
 ) -> SharedEntry:
     """One scan-fused epoch program family (``metrics_tpu.engine.driver``).
 
@@ -820,7 +832,9 @@ def _make_driver_entry(
         members = list(entry.cell)
         reductions = {k: m._reductions for k, m in zip(keys, members)}
         placeholders = {k: m._list_placeholders for k, m in zip(keys, members)}
-        synced = comm.sync_state_trees(states, reductions, axis_name, placeholders=placeholders)
+        synced = comm.sync_state_trees(
+            states, reductions, axis_name, placeholders=placeholders, hierarchical=hierarchical
+        )
         return {k: m.merge_states(prior[k], synced[k]) for k, m in zip(keys, members)}
 
     def build(donate: bool) -> None:
@@ -862,10 +876,14 @@ def _make_driver_entry(
 
             _check_kw = "check_rep"
 
+        # a tuple axis_name shards the steps dim over the PRODUCT of the
+        # named axes: PartitionSpec((a, b)) — one dim, several mesh axes
+        leading = tuple(axis_name) if isinstance(axis_name, (tuple, list)) else axis_name
+
         def _shard(fn, n_sharded_args):
             kw = dict(
                 mesh=mesh,
-                in_specs=(_P(),) + (_P(axis_name),) * n_sharded_args,
+                in_specs=(_P(),) + (_P(leading),) * n_sharded_args,
                 out_specs=_P(),
             )
             kw[_check_kw] = False
@@ -910,8 +928,9 @@ def driver_entry(
     keys: Tuple[str, ...],
     members: List[Any],
     compute_keys: Tuple[str, ...] = (),
-    axis_name: Optional[str] = None,
+    axis_name: Optional[Any] = None,
     mesh: Optional[Any] = None,
+    hierarchical: bool = False,
 ) -> SharedEntry:
     """Shared entry for one scan-fused epoch program: keyed by the member
     names, every member's fingerprint, the in-trace-compute member subset,
@@ -932,11 +951,12 @@ def driver_entry(
         tuple(compute_keys),
         axis_name,
         id(mesh) if mesh is not None else None,
+        hierarchical,
     )
     return _get_or_create(
         cache_key,
         lambda: _make_driver_entry(
-            cache_key, tuple(keys), tuple(pins), tuple(compute_keys), axis_name, mesh
+            cache_key, tuple(keys), tuple(pins), tuple(compute_keys), axis_name, mesh, hierarchical
         ),
     )
 

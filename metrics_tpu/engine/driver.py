@@ -303,9 +303,10 @@ def drive(
     batches: Any,
     *,
     compute_in_trace: bool = False,
-    axis_name: Optional[str] = None,
+    axis_name: Optional[Any] = None,
     mesh: Optional[Any] = None,
     steps_per_chunk: int = 16,
+    hierarchical_sync: bool = False,
 ) -> DriveResult:
     """Run one evaluation epoch through a device-resident scan program.
 
@@ -326,6 +327,14 @@ def drive(
             across ``axis_name``, states synced with one collective per
             leaf, merged with the prior accumulation. Requires a stacked
             epoch, mergeable states, and both arguments together.
+            ``axis_name`` may be a TUPLE of mesh axes (ordered outer→inner,
+            e.g. ``('host', 'local')``): steps shard over their product.
+        hierarchical_sync: with a multi-axis ``axis_name``, stage each
+            in-trace sync collective intra-host first, inter-host second
+            (``parallel/comm.reduce_in_trace``) — only the per-host partials
+            cross the slow inter-host fabric. Integer ``sum``/``max``/``min``
+            states reduce bit-exactly vs the flat collective; float states
+            may reassociate in the last ulp.
         steps_per_chunk: streaming-mode super-step length ``K``. Larger K
             amortizes more dispatches per launch but delays the first launch
             by K host batches; see ``docs/performance.md``.
@@ -339,20 +348,25 @@ def drive(
     """
     source = type(obj).__name__
     if not _trace.active():
-        return _drive_impl(obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source)
+        return _drive_impl(
+            obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source, hierarchical_sync
+        )
     _keys, _members, _ = _members_of(obj)
     with _trace.span("drive", source, payload=lambda: [m._snapshot_state() for m in _members]):
-        return _drive_impl(obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source)
+        return _drive_impl(
+            obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source, hierarchical_sync
+        )
 
 
 def _drive_impl(
     obj: Any,
     batches: Any,
     compute_in_trace: bool,
-    axis_name: Optional[str],
+    axis_name: Optional[Any],
     mesh: Optional[Any],
     steps_per_chunk: int,
     source: str,
+    hierarchical_sync: bool = False,
 ) -> DriveResult:
     from metrics_tpu.metric import _JIT_FALLBACK_ERRORS
     from metrics_tpu.parallel import comm
@@ -367,6 +381,16 @@ def _drive_impl(
         )
     if steps_per_chunk < 1:
         raise ValueError(f"steps_per_chunk must be >= 1, got {steps_per_chunk}")
+    if hierarchical_sync and (
+        axis_name is None or isinstance(axis_name, str) or len(tuple(axis_name)) < 2
+    ):
+        raise ValueError(
+            "drive(hierarchical_sync=True) stages the in-trace sync over a"
+            " MULTI-axis mesh: pass axis_name as a tuple of >= 2 mesh axes"
+            f" ordered outer->inner (e.g. ('host', 'local')), got {axis_name!r}."
+        )
+    if isinstance(axis_name, (tuple, list)):
+        axis_name = tuple(axis_name)
 
     keys, members, is_collection = _members_of(obj)
     if mesh is None and any(m._drive_synced for m in members):
@@ -493,7 +517,9 @@ def _drive_impl(
     n_chunks = 0
 
     if fused:
-        entry = _cache.driver_entry(fused_keys, fused_members, compute_keys, axis_name, mesh)
+        entry = _cache.driver_entry(
+            fused_keys, fused_members, compute_keys, axis_name, mesh, hierarchical_sync
+        )
         snapshots = {k: m._snapshot_state() for k, m in fused}
         states: Dict[str, Any] = snapshots
         if entry.donate:
@@ -517,7 +543,7 @@ def _drive_impl(
                 chunk_leaves = list(stacked_leaves)
                 steps = n_steps
                 if mesh is not None:
-                    world = int(mesh.shape[axis_name])  # axis_name is required with mesh
+                    world = _cache.axis_world(mesh, axis_name)  # axis_name is required with mesh
                     rem = (-steps) % world
                     if rem:
                         if not additive_ok or not batched:

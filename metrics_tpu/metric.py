@@ -265,6 +265,10 @@ class Metric:
         self._defaults: Dict[str, Union[Array, List]] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Union[str, Callable, None]] = {}
+        # per-state wire codec tags (``add_state(sync_precision=)``): name ->
+        # 'exact'|'bf16'|'int8'. Only non-default entries are threaded into
+        # the host-level gather; see ``parallel/quantize.py``.
+        self._sync_precisions: Dict[str, str] = {}
         # list-state empty-gather placeholder specs (``add_state(placeholder=)``):
         # name -> jax.ShapeDtypeStruct with leading dim 0, or absent (legacy
         # float32 ``zeros((0,))`` contribution). See ``parallel/comm.empty_placeholder``.
@@ -306,6 +310,7 @@ class Metric:
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
         placeholder: Optional[Any] = None,
+        sync_precision: str = "exact",
     ) -> None:
         """Register a metric state (reference ``metric.py:122-190``).
 
@@ -321,6 +326,16 @@ class Metric:
         gather instead of the legacy bare float32 ``zeros((0,))`` — without
         the declaration, a sample-less rank injects float32 into an int
         ``'cat'`` gather (see ``parallel/comm.empty_placeholder``).
+
+        ``sync_precision`` tags this state's HOST-LEVEL sync wire codec
+        (``'exact'`` default, ``'bf16'``, ``'int8'`` — see
+        ``parallel/quantize.py`` and ``docs/distributed.md``). A quantized
+        tag is a *tolerance declaration*: the state's floats may round-trip
+        the distributed gather with bounded error (bf16: one bf16 ulp
+        relative; int8: per-256-block absmax/254 absolute) in exchange for
+        2-4x fewer bytes on the wire. Integer/bool payloads always pass
+        through exact regardless of the tag, so counts can never be
+        degraded. The default keeps today's wire v1 payload byte-for-byte.
         """
         if isinstance(default, list):
             if default:
@@ -346,6 +361,14 @@ class Metric:
                 )
             self._list_placeholders[name] = _normalize_placeholder(name, placeholder)
 
+        from metrics_tpu.parallel.quantize import CODECS as _WIRE_CODECS
+
+        if sync_precision not in _WIRE_CODECS:
+            raise ValueError(
+                f"`sync_precision` for state {name!r} must be one of {_WIRE_CODECS},"
+                f" got {sync_precision!r}"
+            )
+        self._sync_precisions[name] = sync_precision
         self._defaults[name] = [] if isinstance(default, list) else default
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
@@ -405,13 +428,27 @@ class Metric:
         """Pure compute: ``state -> value``. Safe inside jit."""
         return self._with_state(state, self._compute_impl)
 
-    def sync_state(self, state: Dict[str, Any], axis_name: Optional[Union[str, Sequence[str]]] = None) -> Dict[str, Any]:
-        """In-trace cross-device sync over a named mesh axis (psum/pmax/.../all_gather)."""
+    def sync_state(
+        self,
+        state: Dict[str, Any],
+        axis_name: Optional[Union[str, Sequence[str]]] = None,
+        hierarchical: bool = False,
+    ) -> Dict[str, Any]:
+        """In-trace cross-device sync over a named mesh axis (psum/pmax/.../all_gather).
+
+        ``hierarchical=True`` with a multi-axis ``axis_name`` (ordered
+        outer→inner, e.g. ``('host', 'local')``) stages each collective
+        intra-host first — see :func:`metrics_tpu.parallel.comm.reduce_in_trace`.
+        """
         axis_name = axis_name if axis_name is not None else self.axis_name
         if axis_name is None:
             raise MetricsUserError("sync_state requires an axis_name (constructor or argument)")
         return comm.sync_state_in_trace(
-            state, self._reductions, axis_name, placeholders=self._list_placeholders
+            state,
+            self._reductions,
+            axis_name,
+            placeholders=self._list_placeholders,
+            hierarchical=hierarchical,
         )
 
     def merge_states(self, state_a: Dict[str, Any], state_b: Dict[str, Any]) -> Dict[str, Any]:
@@ -732,6 +769,8 @@ class Metric:
         """
         out: Dict[str, Any] = dict(self._sync_stats)
         out["missing_ranks"] = list(self._sync_stats["missing_ranks"])
+        if "codec_counts" in out:  # wire-codec counters: don't alias live state
+            out["codec_counts"] = dict(out["codec_counts"])
         out["on_sync_error"] = self.on_sync_error
         out["process_group"] = getattr(self.process_group, "name", None)
         children = self._children()
@@ -898,6 +937,12 @@ class Metric:
                     for n, fx in self._reductions.items()
                     if n not in self._shape_polymorphic_states
                 },
+                # wire codec tags (add_state(sync_precision=)): non-exact
+                # entries only — an untouched metric threads an empty dict
+                # and its payloads stay bit-identical wire v1
+                sync_precisions={
+                    n: p for n, p in self._sync_precisions.items() if p != "exact"
+                },
             )
         except SyncError as err:
             if policy == "raise":
@@ -994,7 +1039,10 @@ class Metric:
                 elif callable(reduction_fn):
                     reduced = reduction_fn(jnp.stack(output, axis=0))
                 else:
-                    raise ValueError(f"Unsupported dist_reduce_fx {reduction_fn!r}")
+                    raise ValueError(
+                        f"Unsupported dist_reduce_fx {reduction_fn!r} for state"
+                        f" {type(self).__name__}.{attr}"
+                    )
                 setattr(self, attr, reduced)
             else:
                 setattr(self, attr, output)
@@ -1221,6 +1269,7 @@ class Metric:
         self.__dict__.setdefault("_health_stats", _health.new_health_stats())
         self.__dict__.setdefault("_health_warn_on_bad", False)
         self.__dict__.setdefault("_list_placeholders", {})
+        self.__dict__.setdefault("_sync_precisions", {})
         self.__dict__.setdefault("_drive_synced", False)
         for name in self._defaults:
             v = getattr(self, name, None)

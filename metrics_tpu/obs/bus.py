@@ -44,7 +44,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: family's first — carries the ``explain`` payload naming the changed
 #: cache-key component), ``bucketed`` (an update routed through pow2
 #: padding). Sync: ``sync_attempt`` / ``sync_retry`` (KV peer reads),
-#: ``sync_degrade`` (an ``on_sync_error`` fallback engaged). Health:
+#: ``sync_degrade`` (an ``on_sync_error`` fallback engaged), ``wire`` (a
+#: quantized sync payload was encoded — carries ``codec``, ``bytes_raw`` vs
+#: ``bytes_encoded``, ``max_dequant_error``; exact-only syncs emit none).
+#: Health:
 #: ``quarantine`` (a contaminated update surfaced host-side). Lifecycle
 #: spans (``metrics_tpu.obs.trace``): ``update`` / ``forward`` / ``compute``
 #: / ``sync`` / ``drive`` (one scan-fused evaluation epoch through
@@ -63,6 +66,7 @@ EVENT_KINDS = (
     "sync_attempt",
     "sync_retry",
     "sync_degrade",
+    "wire",
     "quarantine",
     "update",
     "forward",

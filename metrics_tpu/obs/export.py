@@ -39,6 +39,7 @@ def process_snapshot() -> Dict[str, Any]:
     """The process-wide observability view (no metric argument needed)."""
     from metrics_tpu import engine as _engine
     from metrics_tpu import serving as _serving
+    from metrics_tpu.parallel import quantize as _quantize
 
     return {
         "engine": _engine.cache_summary(),
@@ -46,6 +47,9 @@ def process_snapshot() -> Dict[str, Any]:
         # coalesced-transfer counters ride next to the compile counters
         "fetch": _engine.fetch_stats(),
         "serving": _serving.serving_summary(),
+        # sync wire codecs (PR 8): bytes-on-wire raw vs encoded, per-codec
+        # payload counts, max observed dequantization error
+        "wire": _quantize.wire_stats(),
         "bus": _bus.summary(),
         "spans": _trace.span_summary(),
         "warnings": {repr(k): v for k, v in _warn.warn_counts().items()},
@@ -214,6 +218,16 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
             )
             _sample("metrics_tpu_bank_updates_quarantined", bank["updates_quarantined"], labels)
             _sample("metrics_tpu_bank_rows_masked", bank["rows_masked"], labels)
+
+    # sync wire codecs: bytes-on-wire and per-codec payload counts
+    from metrics_tpu.parallel import quantize as _quantize
+
+    wire = _quantize.wire_stats()
+    for key in ("bytes_raw", "bytes_encoded", "bytes_raw_quantized", "bytes_encoded_quantized"):
+        _sample(f"metrics_tpu_wire_{key}", wire[key])
+    for codec in sorted(wire["codec_counts"]):
+        _sample("metrics_tpu_wire_payloads_total", wire["codec_counts"][codec], {"codec": codec})
+    _sample("metrics_tpu_wire_max_dequant_error", wire["max_dequant_error"], kind="gauge")
 
     bus_summary = _bus.summary()
     for kind in sorted(bus_summary["by_kind"]):

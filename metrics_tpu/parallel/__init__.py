@@ -1,4 +1,28 @@
+"""Distributed sync plane: collectives, process subgroups, wire format.
+
+Wire-format version negotiation (public contract)
+-------------------------------------------------
+Every host-level sync payload rides a versioned crc32 envelope
+(:func:`pack_envelope` / :func:`unpack_envelope`):
+
+* :data:`WIRE_VERSION` (``1``) — exact payloads. The default: a metric whose
+  states are all ``sync_precision='exact'`` emits v1 byte-for-byte, so a
+  fleet that never opts into quantization never emits anything newer.
+* :data:`WIRE_VERSION_QUANTIZED` (``2``) — quantized payloads (``'bf16'`` /
+  ``'int8'`` tags, :mod:`metrics_tpu.parallel.quantize`): the header carries
+  the codec id and (int8) per-block scale metadata.
+* :data:`SUPPORTED_WIRE_VERSIONS` is what this build SPEAKS. A payload
+  outside that set — or outside the ``accept`` set a caller narrows
+  ``unpack_envelope`` to — raises a NON-transient
+  :class:`~metrics_tpu.utils.exceptions.SyncIntegrityError` naming both the
+  peer's version and the local versions: mixed-version peers are an explicit
+  configuration error, never retried. Rolling upgrades therefore sequence
+  as: upgrade every peer to a v2-speaking build FIRST (v2 builds still emit
+  v1 for exact states, so the fleet interoperates), THEN turn on quantized
+  ``sync_precision`` tags.
+"""
 from metrics_tpu.parallel import comm  # noqa: F401
+from metrics_tpu.parallel import quantize  # noqa: F401
 from metrics_tpu.parallel.comm import (  # noqa: F401
     class_reduce,
     distributed_available,
@@ -7,10 +31,21 @@ from metrics_tpu.parallel.comm import (  # noqa: F401
     sync_state_in_trace,
 )
 from metrics_tpu.parallel.groups import (  # noqa: F401
+    SUPPORTED_WIRE_VERSIONS,
+    WIRE_VERSION,
+    WIRE_VERSION_QUANTIZED,
     ProcessGroup,
     gather_group_arrays,
     gather_group_pytrees,
     gather_state_trees,
     new_group,
+    pack_envelope,
+    unpack_envelope,
+)
+from metrics_tpu.parallel.quantize import (  # noqa: F401
+    CODECS,
+    INT8_BLOCK,
+    reset_wire_stats,
+    wire_stats,
 )
 from metrics_tpu.resilience.retry import RetryPolicy  # noqa: F401

@@ -64,7 +64,30 @@ def process_index() -> int:
 # In-trace collectives (usable inside shard_map / pmap with named axes)
 # ---------------------------------------------------------------------------
 
-def reduce_in_trace(x: Array, reduce_fx: Union[str, Callable, None], axis_name: Union[str, Sequence[str]]) -> Array:
+def _staged_axes(
+    axis_name: Union[str, Sequence[str]], hierarchical: bool
+) -> Optional[Sequence[str]]:
+    """The axis sequence to reduce STAGE-BY-STAGE, or ``None`` for one flat
+    collective. Staging needs ``hierarchical=True`` and at least two named
+    axes (a single axis has no hierarchy to exploit)."""
+    if not hierarchical or isinstance(axis_name, str):
+        return None
+    axes = tuple(axis_name)
+    return axes if len(axes) >= 2 else None
+
+
+def _unsupported_fx(reduce_fx: Any, state: Optional[str]) -> ValueError:
+    where = f" for state {state!r}" if state else ""
+    return ValueError(f"Unsupported dist_reduce_fx{where}: {reduce_fx!r}")
+
+
+def reduce_in_trace(
+    x: Array,
+    reduce_fx: Union[str, Callable, None],
+    axis_name: Union[str, Sequence[str]],
+    hierarchical: bool = False,
+    state: Optional[str] = None,
+) -> Array:
     """Apply one reduction to ``x`` across a named mesh axis, inside a trace.
 
     ``sum/mean/max/min`` map to ``psum/pmean/pmax/pmin``; ``cat`` maps to a
@@ -72,7 +95,40 @@ def reduce_in_trace(x: Array, reduce_fx: Union[str, Callable, None], axis_name: 
     states kept separate, mirroring the reference's ``dist_reduce_fx=None``
     stack at ``metric.py:246-248``); a callable is applied to the stacked
     gather.
+
+    ``hierarchical=True`` with a MULTI-axis ``axis_name`` (ordered
+    outer→inner, e.g. ``('host', 'local')`` — hosts over the slow DCN
+    fabric, chips within a host over ICI) stages the collective inner-first:
+    the intra-host reduction runs over ICI, then only the per-host partials
+    cross the inter-host fabric — the pod-topology pattern from "Scalable
+    Training of LMs using JAX pjit and TPUv4" (arXiv:2204.06514). Staged
+    ``sum``/``max``/``min`` over integers is bit-exact vs the flat
+    collective (associative, no rounding); staged float ``sum``/``mean``
+    may differ from flat in the last ulp (reduction-order sensitivity —
+    same caveat any all-reduce implementation carries). ``cat`` stages as
+    nested tiled gathers whose concatenation order matches the flat
+    outer→inner gather; ``None``/callable reductions always run flat (their
+    contract is the stacked per-rank axis, which staging would reshape).
+
+    ``state`` (optional ``"member.state_name"``) names the offending state
+    in the unsupported-reduction error.
     """
+    axes = _staged_axes(axis_name, hierarchical)
+    if axes is not None and reduce_fx in ("sum", "mean", "max", "min", "cat"):
+        op = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax, "min": lax.pmin}.get(reduce_fx)
+        if op is None:  # 'cat': nested tiled gathers, inner-first
+            out = jnp.atleast_1d(x)
+            for ax in reversed(axes):
+                out = lax.all_gather(out, ax, axis=0, tiled=True)
+            return out
+        out = x
+        # inner-first: the LAST axis is the innermost (fastest) fabric.
+        # staged pmean is exact relative to flat pmean's grouping because
+        # mesh axis sizes are uniform (mean of per-group means of equal-size
+        # groups IS the global mean, up to float reassociation).
+        for ax in reversed(axes):
+            out = op(out, ax)
+        return out
     if reduce_fx == "sum":
         return lax.psum(x, axis_name)
     if reduce_fx == "mean":
@@ -88,7 +144,7 @@ def reduce_in_trace(x: Array, reduce_fx: Union[str, Callable, None], axis_name: 
         return lax.all_gather(x, axis_name, axis=0)  # stack along new leading dim
     if callable(reduce_fx):
         return reduce_fx(lax.all_gather(x, axis_name, axis=0))
-    raise ValueError(f"Unsupported dist_reduce_fx: {reduce_fx!r}")
+    raise _unsupported_fx(reduce_fx, state)
 
 
 def sync_state_trees(
@@ -96,6 +152,7 @@ def sync_state_trees(
     reductions: dict,
     axis_name: Union[str, Sequence[str]],
     placeholders: Optional[dict] = None,
+    hierarchical: bool = False,
 ) -> dict:
     """Synchronize several metrics' state dicts across a mesh axis inside a
     trace, one collective per state leaf.
@@ -108,6 +165,12 @@ def sync_state_trees(
     contributes a zero-length array of its *declared* dtype/width to the
     gather instead of a bare float32 ``zeros((0,))`` — an int cat state must
     not have float32 injected into it by a sample-less rank.
+
+    ``hierarchical=True`` with a multi-axis ``axis_name`` (ordered
+    outer→inner, e.g. ``('host', 'local')``) stages every leaf's collective
+    intra-host first, inter-host second — see :func:`reduce_in_trace` for
+    the exactness contract (integer sum/max/min bit-exact vs flat; float
+    may reassociate).
 
     Lowering note (measured, not assumed): jax binds ``psum`` per leaf even
     for a pytree argument, so each state tensor is its own all-reduce in the
@@ -138,9 +201,19 @@ def sync_state_trees(
                     # cannot lower an all_gather over a zero-sized dim anyway
                     out[key][name] = [value]
                 else:
-                    out[key][name] = [reduce_in_trace(value, "cat" if fx in (None, "cat") else fx, axis_name)]
+                    out[key][name] = [
+                        reduce_in_trace(
+                            value,
+                            "cat" if fx in (None, "cat") else fx,
+                            axis_name,
+                            hierarchical=hierarchical,
+                            state=f"{key}.{name}",
+                        )
+                    ]
             else:
-                out[key][name] = reduce_in_trace(value, fx, axis_name)
+                out[key][name] = reduce_in_trace(
+                    value, fx, axis_name, hierarchical=hierarchical, state=f"{key}.{name}"
+                )
     return out
 
 
@@ -158,11 +231,16 @@ def sync_state_in_trace(
     reductions: dict,
     axis_name: Union[str, Sequence[str]],
     placeholders: Optional[dict] = None,
+    hierarchical: bool = False,
 ) -> dict:
     """Synchronize one state dict across a mesh axis inside a trace — the
     single-metric view of :func:`sync_state_trees`."""
     return sync_state_trees(
-        {"_": state}, {"_": reductions}, axis_name, placeholders={"_": placeholders or {}}
+        {"_": state},
+        {"_": reductions},
+        axis_name,
+        placeholders={"_": placeholders or {}},
+        hierarchical=hierarchical,
     )["_"]
 
 
@@ -170,6 +248,7 @@ def sync_bank_states(
     bank: dict,
     reductions: dict,
     axis_name: Union[str, Sequence[str]],
+    hierarchical: bool = False,
 ) -> dict:
     """In-trace sync of a :class:`~metrics_tpu.serving.MetricBank` state
     tree: banked states ride the EXISTING per-leaf collectives untouched —
@@ -178,7 +257,9 @@ def sync_bank_states(
     every participating process assigns the same tenants to the same slots
     (dp-style replicated serving). List/'cat' states never reach a bank
     (banks reject list-state templates), so the ragged-gather machinery is
-    deliberately out of scope here.
+    deliberately out of scope here. ``hierarchical=True`` with a multi-axis
+    ``axis_name`` stages each reduction intra-host first (see
+    :func:`reduce_in_trace`).
     """
     for name, value in bank.items():
         fx = reductions.get(name)
@@ -189,7 +270,7 @@ def sync_bank_states(
                 " (sum/mean/max/min) — a custom callable would receive the"
                 " tenant axis mixed into its gather axis."
             )
-    return sync_state_in_trace(bank, reductions, axis_name)
+    return sync_state_in_trace(bank, reductions, axis_name, hierarchical=hierarchical)
 
 
 # ---------------------------------------------------------------------------
@@ -202,12 +283,63 @@ def _host_allgather(x: Array) -> Array:
     return multihost_utils.process_allgather(x)
 
 
+def _quantized_allgather(
+    x: Array, codec: str, report: Optional[dict], source: str = "multihost"
+) -> List[Array]:
+    """World-spanning all-gather of ``x`` moving the NARROW wire
+    representation: encode locally, gather the codes (and, for int8, the
+    per-block scales), decode every rank's contribution back to ``x``'s
+    dtype. ``codec='exact'`` is the unchanged full-width gather."""
+    from metrics_tpu.parallel import quantize as _quant
+
+    if codec == "exact":
+        # exact payloads count toward the wire totals here too, so the
+        # whole-payload reduction ratio is comparable across gather paths
+        _quant.record_wire("exact", int(x.nbytes), int(x.nbytes), stats=report)
+        gathered = _host_allgather(x)
+        return [gathered[i] for i in range(gathered.shape[0])]
+    from metrics_tpu.obs import bus as _obs_bus
+
+    qdata, scales = _quant.encode_in_jax(x, codec)
+    gathered_q = _host_allgather(qdata)
+    gathered_s = _host_allgather(scales) if scales is not None else None
+    out = []
+    for i in range(gathered_q.shape[0]):
+        out.append(
+            _quant.decode_in_jax(
+                gathered_q[i],
+                gathered_s[i] if gathered_s is not None else None,
+                codec,
+                x.dtype,
+                tuple(x.shape),
+            )
+        )
+    # telemetry covers the LOCAL contribution (mirroring the KV wire path,
+    # which counts what this rank encodes); the round-trip error is observed
+    # on our own decoded slot — identical quantization math on every rank
+    own = out[process_index()] if process_index() < len(out) else out[0]
+    error = float(jnp.max(jnp.abs(x.astype(jnp.float32) - own.astype(jnp.float32)))) if x.size else 0.0
+    encoded = int(qdata.nbytes) + (int(scales.nbytes) if scales is not None else 0)
+    _quant.record_wire(codec, int(x.nbytes), encoded, error=error, stats=report)
+    if _obs_bus.enabled():
+        _obs_bus.emit(
+            "wire",
+            source=source,
+            codec=codec,
+            bytes_raw=int(x.nbytes),
+            bytes_encoded=encoded,
+            max_dequant_error=error,
+        )
+    return out
+
+
 def gather_all_arrays(
     x: Array,
     group: Optional[Any] = None,
     policy: str = "raise",
     report: Optional[dict] = None,
     fixed_shape: bool = False,
+    precision: Optional[str] = None,
 ) -> List[Array]:
     """Host-level all-gather returning one array per process.
 
@@ -237,12 +369,19 @@ def gather_all_arrays(
     collective per leaf instead of two. The pre-gather only exists for the
     ragged case (cat/None reductions), mirroring the reference's pad-to-max
     dance (``distributed.py:133-145``).
+
+    ``precision`` selects the wire codec (``parallel/quantize.py``,
+    ``add_state(sync_precision=)``): quantized float payloads move the
+    narrow representation through the collective — on the fixed-shape fast
+    path AND the ragged pad-to-max path alike — and are decoded back to the
+    state dtype on receipt; integer/bool payloads (and the shape pre-gather)
+    always travel exact.
     """
     if group is not None:
         from metrics_tpu.parallel.groups import ProcessGroup, gather_group_arrays
 
         if isinstance(group, ProcessGroup):
-            return gather_group_arrays(x, group, policy=policy, report=report)
+            return gather_group_arrays(x, group, policy=policy, report=report, precision=precision)
         raise ValueError(
             f"Unsupported `process_group` type {type(group).__name__!r}: pass a"
             " metrics_tpu.parallel.ProcessGroup (host-level subgroup), provide a custom"
@@ -272,33 +411,42 @@ def gather_all_arrays(
             " dist_sync_fn) to sync under simulated_world/run_as_peers."
         )
     x = jnp.atleast_1d(jnp.asarray(x))
+    from metrics_tpu.parallel import quantize as _quant
+
+    codec = _quant.resolve_codec(precision, x.dtype)
     if fixed_shape:
-        gathered = _host_allgather(x)  # [world, ...] — shapes static by registration
-        return [gathered[i] for i in range(gathered.shape[0])]
+        # shapes static by registration — one collective per leaf (two for
+        # int8: codes + scales), moving the narrow representation
+        return _quantized_allgather(x, codec, report)
     local_shape = jnp.asarray(x.shape, dtype=jnp.int32)
-    all_shapes = _host_allgather(local_shape)  # [world, ndim]
+    all_shapes = _host_allgather(local_shape)  # [world, ndim] — always exact
     import numpy as np
 
     all_shapes = np.asarray(all_shapes)
     max_shape = all_shapes.max(axis=0)
     if (all_shapes == all_shapes[0]).all():
-        gathered = _host_allgather(x)  # [world, ...]
-        return [gathered[i] for i in range(gathered.shape[0])]
+        return _quantized_allgather(x, codec, report)
     pad = [(0, int(m - s)) for s, m in zip(x.shape, max_shape)]
-    padded = jnp.pad(x, pad)
-    gathered = _host_allgather(padded)
+    padded = jnp.pad(x, pad)  # zero padding quantizes exactly (block codes 0)
+    gathered = _quantized_allgather(padded, codec, report)
     out = []
-    for rank in range(gathered.shape[0]):
+    for rank in range(len(gathered)):
         slices = tuple(slice(0, int(d)) for d in all_shapes[rank])
         out.append(gathered[rank][slices])
     return out
 
 
-def host_reduce(x: Array, reduce_fx: Union[str, Callable, None]) -> Any:
-    """Gather ``x`` from all processes and reduce per ``reduce_fx``."""
+def host_reduce(x: Array, reduce_fx: Union[str, Callable, None], state: Optional[str] = None) -> Any:
+    """Gather ``x`` from all processes and reduce per ``reduce_fx``.
+
+    ``state`` (optional) names the metric state in the unsupported-reduction
+    error, so a bad ``dist_reduce_fx`` is attributable to its registration.
+    """
     gathered = gather_all_arrays(x)
     if reduce_fx == "cat":
         return jnp.concatenate(gathered, axis=0)
+    if reduce_fx not in ("sum", "mean", "max", "min", None) and not callable(reduce_fx):
+        raise _unsupported_fx(reduce_fx, state)  # before the gather result is shaped
     stacked = jnp.stack(gathered, axis=0)
     if reduce_fx == "sum":
         return jnp.sum(stacked, axis=0)
@@ -310,9 +458,7 @@ def host_reduce(x: Array, reduce_fx: Union[str, Callable, None]) -> Any:
         return jnp.min(stacked, axis=0)
     if reduce_fx is None:
         return stacked
-    if callable(reduce_fx):
-        return reduce_fx(stacked)
-    raise ValueError(f"Unsupported dist_reduce_fx: {reduce_fx!r}")
+    return reduce_fx(stacked)
 
 
 def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:

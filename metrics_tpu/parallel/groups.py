@@ -62,8 +62,24 @@ _KV_PREFIX = "metrics_tpu/pg"
 # The version byte makes a mixed-version peer an *explicit* error instead of
 # garbage decode; the checksum turns corruption/truncation into a precise
 # SyncIntegrityError the retry machinery treats as transient.
+#
+# Version negotiation contract (public: ``metrics_tpu.parallel``):
+# * v1 (``WIRE_VERSION``) — exact payloads: length-prefixed JSON header
+#   (dtype, shape) + raw array bytes. The DEFAULT: every state whose
+#   ``sync_precision`` is ``'exact'`` ships v1 byte-for-byte, so a fleet
+#   that never opts into quantization never emits v2.
+# * v2 (``WIRE_VERSION_QUANTIZED``) — quantized payloads: the header
+#   additionally carries the codec id (+ per-block scale metadata for
+#   int8); see ``parallel/quantize.py`` and ``docs/distributed.md``.
+# * A payload whose version is outside ``SUPPORTED_WIRE_VERSIONS`` (or
+#   outside the ``accept`` set a caller narrows to) raises a NON-transient
+#   :class:`SyncIntegrityError` naming both the peer's version and the
+#   locally spoken versions — mixed-version peers must never be retried,
+#   because re-reading the same build's payload can never succeed.
 _WIRE_MAGIC = b"MT"
 WIRE_VERSION = 1
+WIRE_VERSION_QUANTIZED = 2
+SUPPORTED_WIRE_VERSIONS = (WIRE_VERSION, WIRE_VERSION_QUANTIZED)
 _ENVELOPE = struct.Struct(">2sBI")
 
 # per-group monotonic call counters; aligned across processes by the SPMD
@@ -169,18 +185,34 @@ def _kv_client():
     return _faults.maybe_wrap_client(client)
 
 
-def _seal(body: bytes) -> bytes:
-    """Wrap ``body`` in the versioned envelope: magic, version, crc32(body)."""
-    return _ENVELOPE.pack(_WIRE_MAGIC, WIRE_VERSION, zlib.crc32(body)) + body
+def pack_envelope(body: bytes, version: int = WIRE_VERSION) -> bytes:
+    """Wrap ``body`` in the versioned envelope: magic, version, crc32(body).
 
-
-def _open_envelope(payload: bytes, context: str = "") -> bytes:
-    """Validate the envelope and return the body.
-
-    Raises :class:`SyncIntegrityError` — transient for truncation/corruption
-    (a retry may see a clean write), non-transient for a wire-format version
-    mismatch (retrying a mixed-version peer can never succeed).
+    Public face of the wire layer (exported from ``metrics_tpu.parallel``),
+    so version-skew behavior is testable from the public API; see the
+    version-negotiation contract at the top of this module.
     """
+    if version not in SUPPORTED_WIRE_VERSIONS:
+        raise ValueError(
+            f"Cannot seal a payload as wire v{version}; this build speaks"
+            f" {SUPPORTED_WIRE_VERSIONS}."
+        )
+    return _ENVELOPE.pack(_WIRE_MAGIC, version, zlib.crc32(body)) + body
+
+
+def unpack_envelope(
+    payload: bytes, context: str = "", accept: Optional[Sequence[int]] = None
+) -> "tuple[int, bytes]":
+    """Validate the envelope and return ``(version, body)``.
+
+    ``accept`` narrows the admissible wire versions (default: every version
+    this build speaks, :data:`SUPPORTED_WIRE_VERSIONS`). Raises
+    :class:`SyncIntegrityError` — transient for truncation/corruption (a
+    retry may see a clean write), non-transient for a wire-format version
+    mismatch (retrying a mixed-version peer can never succeed); the mismatch
+    message names both the peer's version and the versions accepted here.
+    """
+    accepted = tuple(accept) if accept is not None else SUPPORTED_WIRE_VERSIONS
     if len(payload) < _ENVELOPE.size:
         raise SyncIntegrityError(
             f"Truncated sync payload: {len(payload)} bytes is smaller than the"
@@ -194,11 +226,13 @@ def _open_envelope(payload: bytes, context: str = "") -> bytes:
             " something else wrote to this KV key.",
             transient=False,
         )
-    if version != WIRE_VERSION:
+    if version not in accepted:
+        speaks = "/".join(f"v{v}" for v in accepted)
         raise SyncIntegrityError(
             f"Sync wire-format version mismatch{context}: peer sent v{version},"
-            f" this process speaks v{WIRE_VERSION}. All members of a ProcessGroup"
-            " must run the same metrics_tpu wire version.",
+            f" this process speaks {speaks}. All members of a ProcessGroup must"
+            " run compatible metrics_tpu wire versions (quantized payloads are"
+            " v2; exact payloads are v1).",
             transient=False,
         )
     body = payload[_ENVELOPE.size :]
@@ -208,27 +242,81 @@ def _open_envelope(payload: bytes, context: str = "") -> bytes:
             f"Corrupted sync payload{context}: crc32 {actual:#010x} != declared"
             f" {crc:#010x} over {len(body)} body bytes."
         )
-    return body
+    return version, body
 
 
-def _encode(arr: np.ndarray) -> bytes:
-    """Self-describing wire format: versioned+checksummed envelope around a
-    length-prefixed JSON header + raw bytes.
+def _seal(body: bytes, version: int = WIRE_VERSION) -> bytes:
+    return pack_envelope(body, version)
 
-    ``dtype.name`` round-trips every dtype JAX hands to the host, including
-    the ml_dtypes extension types (``np.dtype('bfloat16')`` resolves once
-    ml_dtypes is imported, which importing jax guarantees).
+
+def _open_envelope(payload: bytes, context: str = "") -> bytes:
+    """Body-only view of :func:`unpack_envelope` (envelope verification for
+    callers that do not interpret the body — e.g. the in-flight read check)."""
+    return unpack_envelope(payload, context)[1]
+
+
+def _encode_with_codec(
+    arr: np.ndarray, precision: Optional[str] = None, stats: Optional[Dict[str, Any]] = None
+) -> "tuple[bytes, str]":
+    """Codec-aware array encode; returns ``(payload, resolved codec)``.
+
+    Exact payloads are BYTE-IDENTICAL to the pre-quantization wire v1 format
+    (CI-asserted); quantized payloads seal as wire v2 with the codec id (and
+    int8 per-block scale metadata) in the header, scales + codes in the body.
+    Wire telemetry (raw vs encoded bytes, codec counts, round-trip error)
+    accumulates into ``stats`` (the sync ``report``) and the process-wide
+    :func:`~metrics_tpu.parallel.quantize.wire_stats`.
     """
+    from metrics_tpu.parallel import quantize as _quant
+
     arr = np.asarray(arr, order="C")  # not ascontiguousarray: that promotes 0-d to (1,)
     # dtype.name drops byte order — normalize so non-native-endian numpy input
     # can't be reinterpreted as garbage by the receiver's native _decode
     arr = arr.astype(arr.dtype.newbyteorder("="), copy=False)
-    header = json.dumps({"dtype": arr.dtype.name, "shape": list(arr.shape)}).encode()
-    return _seal(struct.pack(">I", len(header)) + header + arr.tobytes())
+    codec = _quant.resolve_codec(precision, arr.dtype)
+    if codec == "exact":
+        header = json.dumps({"dtype": arr.dtype.name, "shape": list(arr.shape)}).encode()
+        _quant.record_wire("exact", arr.nbytes, arr.nbytes, stats=stats)
+        return _seal(struct.pack(">I", len(header)) + header + arr.tobytes()), codec
+    qdata, scales, meta = _quant.quantize_array(arr, codec)
+    decoded = _quant.dequantize_array(qdata, scales, codec, arr.dtype, arr.shape)
+    if arr.size:
+        with np.errstate(invalid="ignore"):
+            diff = np.abs(arr.astype(np.float64) - decoded.astype(np.float64))
+        finite = diff[np.isfinite(diff)]  # NaN/±Inf inputs: error undefined there
+        error = float(np.max(finite)) if finite.size else 0.0
+    else:
+        error = 0.0
+    header_fields = {"dtype": arr.dtype.name, "shape": list(arr.shape), **meta}
+    header = json.dumps(header_fields).encode()
+    scale_bytes = scales.tobytes() if scales is not None else b""
+    encoded_nbytes = qdata.nbytes + (scales.nbytes if scales is not None else 0)
+    _quant.record_wire(codec, arr.nbytes, encoded_nbytes, error=error, stats=stats)
+    if _obs_bus.enabled():
+        _obs_bus.emit(
+            "wire",
+            source="kv",
+            codec=codec,
+            bytes_raw=int(arr.nbytes),
+            bytes_encoded=int(encoded_nbytes),
+            max_dequant_error=error,
+        )
+    return (
+        _seal(struct.pack(">I", len(header)) + header + scale_bytes + qdata.tobytes(), WIRE_VERSION_QUANTIZED),
+        codec,
+    )
+
+
+def _encode(
+    arr: np.ndarray, precision: Optional[str] = None, stats: Optional[Dict[str, Any]] = None
+) -> bytes:
+    return _encode_with_codec(arr, precision, stats)[0]
 
 
 def _decode(payload: bytes, context: str = "") -> np.ndarray:
-    body = _open_envelope(payload, context)
+    from metrics_tpu.parallel import quantize as _quant
+
+    version, body = unpack_envelope(payload, context)
     if len(body) < 4:
         raise SyncIntegrityError(f"Truncated sync payload: no header length{context}.")
     (header_len,) = struct.unpack(">I", body[:4])
@@ -246,14 +334,55 @@ def _decode(payload: bytes, context: str = "") -> np.ndarray:
 
     dtype = np.dtype(dtype_name)
     data = body[4 + header_len :]
-    expected = dtype.itemsize * int(np.prod(shape, dtype=np.int64)) if shape else dtype.itemsize
+    codec = header.get("codec", "exact")
+    n_elems = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    # the envelope version and the header's codec metadata must AGREE — a
+    # disagreement is a malformed payload, never worth a retry
+    if (version == WIRE_VERSION) != (codec == "exact"):
+        raise SyncIntegrityError(
+            f"Sync wire-format version mismatch{context}: envelope v{version}"
+            f" with codec {codec!r} — v{WIRE_VERSION} payloads are exact-only,"
+            f" v{WIRE_VERSION_QUANTIZED} payloads must name their codec.",
+            transient=False,
+        )
+    if codec == "exact":
+        expected = dtype.itemsize * n_elems
+        if len(data) != expected:
+            raise SyncIntegrityError(
+                f"Sync payload length mismatch{context}: header declares"
+                f" dtype={dtype.name} shape={list(shape)} ({expected} bytes), payload"
+                f" carries {len(data)}."
+            )
+        return np.frombuffer(data, dtype=dtype).reshape(shape)
+    if codec == "bf16":
+        qdtype, scale_bytes, nblocks = np.dtype(ml_dtypes.bfloat16), 0, 0
+    elif codec == "int8":
+        block = int(header.get("block", _quant.INT8_BLOCK))
+        if block != _quant.INT8_BLOCK:
+            raise SyncIntegrityError(
+                f"Sync payload uses int8 block size {block}{context}; this build"
+                f" speaks block size {_quant.INT8_BLOCK}.",
+                transient=False,
+            )
+        qdtype = np.dtype(np.int8)
+        nblocks = -(-n_elems // block) if n_elems else 0
+        scale_bytes = nblocks * 4
+    else:
+        raise SyncIntegrityError(
+            f"Sync payload names unknown wire codec {codec!r}{context}; this"
+            f" build speaks {_quant.CODECS}.",
+            transient=False,
+        )
+    expected = scale_bytes + qdtype.itemsize * n_elems
     if len(data) != expected:
         raise SyncIntegrityError(
             f"Sync payload length mismatch{context}: header declares"
-            f" dtype={dtype.name} shape={list(shape)} ({expected} bytes), payload"
-            f" carries {len(data)}."
+            f" codec={codec} dtype={dtype.name} shape={list(shape)}"
+            f" ({expected} bytes), payload carries {len(data)}."
         )
-    return np.frombuffer(data, dtype=dtype).reshape(shape)
+    scales = np.frombuffer(data[:scale_bytes], dtype=np.float32) if scale_bytes else None
+    qdata = np.frombuffer(data[scale_bytes:], dtype=qdtype)
+    return _quant.dequantize_array(qdata, scales, codec, dtype, shape)
 
 
 _DESYNC_HINT = (
@@ -501,6 +630,7 @@ def gather_group_arrays(
     group: ProcessGroup,
     policy: str = "raise",
     report: Optional[Dict[str, Any]] = None,
+    precision: Optional[str] = None,
 ) -> List[Any]:
     """All-gather ``x`` across the member processes of ``group``.
 
@@ -508,14 +638,20 @@ def gather_group_arrays(
     by every member (and only members) — the grouped analog of the collective
     contract in ``comm.gather_all_arrays``. Under ``policy='partial'`` the
     list holds only the members that delivered within the group deadline
-    (missing ranks recorded in ``report['missing_ranks']``).
+    (missing ranks recorded in ``report['missing_ranks']``). ``precision``
+    selects the wire codec (``parallel/quantize.py``): the default exact
+    path ships today's v1 payload byte-for-byte; ``'bf16'``/``'int8'``
+    quantize float payloads onto wire v2 (integer/bool payloads always pass
+    through exact).
     """
     import jax.numpy as jnp
 
     rank = _membership_or_raise(group)
     if rank is None:
         return [x]
-    payloads = _exchange_bytes(_encode(np.asarray(x)), group, rank, policy=policy, report=report)
+    payloads = _exchange_bytes(
+        _encode(np.asarray(x), precision, stats=report), group, rank, policy=policy, report=report
+    )
     return [
         jnp.asarray(_decode(p, context=f" (group={group.name!r}, peer rank={member})"))
         for member, p in zip(group.ranks, payloads)
@@ -531,13 +667,47 @@ def _tree_signature(treedef) -> int:
     return zlib.crc32(str(treedef).encode())
 
 
-def _encode_tree(tree: Any) -> bytes:
+def _leaf_precisions(tree: Any, precisions: Optional[Dict[str, str]]) -> Optional[List[Optional[str]]]:
+    """Per-leaf ``sync_precision`` tags in ``tree_flatten`` order, for a
+    ``tree`` whose top level maps state names (the ``fixed_flags`` trick in
+    :func:`gather_state_trees`, reused: a dict value that is a list — a
+    pre-catted cat state — flattens to one tag per element, keeping tag
+    order aligned with sorted-key flatten order). ``None`` = all exact."""
+    if not precisions or not isinstance(tree, dict):
+        return None
+    import jax
+
+    tag_tree = {
+        name: jax.tree_util.tree_map(lambda _leaf, p=precisions.get(name): p, value)
+        for name, value in tree.items()
+    }
+    tags = jax.tree_util.tree_leaves(tag_tree, is_leaf=lambda x: x is None)
+    if len(tags) != len(jax.tree_util.tree_leaves(tree)):  # defensive: never misalign tags
+        return None
+    return tags
+
+
+def _encode_tree(
+    tree: Any,
+    precisions: Optional[Dict[str, str]] = None,
+    stats: Optional[Dict[str, Any]] = None,
+) -> bytes:
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    blocks = [_encode(np.asarray(leaf)) for leaf in leaves]
+    tags = _leaf_precisions(tree, precisions) or [None] * len(leaves)
+    blocks: List[bytes] = []
+    any_quantized = False
+    for leaf, tag in zip(leaves, tags):
+        payload, codec = _encode_with_codec(np.asarray(leaf), tag, stats=stats)
+        any_quantized = any_quantized or codec != "exact"
+        blocks.append(payload)
     header = struct.pack(">II", len(blocks), _tree_signature(treedef))
-    return _seal(header + b"".join(struct.pack(">Q", len(b)) + b for b in blocks))
+    # an all-exact tree seals v1 — BYTE-IDENTICAL to the pre-quantization
+    # wire; any quantized leaf lifts the envelope to v2 so a v1-only peer
+    # rejects it explicitly instead of choking on a codec header
+    version = WIRE_VERSION_QUANTIZED if any_quantized else WIRE_VERSION
+    return _seal(header + b"".join(struct.pack(">Q", len(b)) + b for b in blocks), version)
 
 
 def _decode_tree(payload: bytes, treedef, n_leaves: int, context: str = "") -> Any:
@@ -576,6 +746,7 @@ def gather_group_pytrees(
     group: ProcessGroup,
     policy: str = "raise",
     report: Optional[Dict[str, Any]] = None,
+    precisions: Optional[Dict[str, str]] = None,
 ) -> List[Any]:
     """All-gather a whole state pytree in ONE KV exchange.
 
@@ -590,6 +761,12 @@ def gather_group_pytrees(
     ``policy='partial'`` drops peers that never delivered within the group
     deadline from the returned list (their ranks land in
     ``report['missing_ranks']``); the default raises :class:`SyncTimeoutError`.
+
+    ``precisions`` maps state name -> ``sync_precision`` tag for a ``tree``
+    whose top level maps state names; tagged float leaves ride the wire
+    quantized (v2 envelope), everything else ships exact v1 bytes. Peers do
+    NOT need matching tags — every payload is self-describing — but all
+    peers must speak v2 to receive a quantized payload.
     """
     import jax
 
@@ -597,7 +774,7 @@ def gather_group_pytrees(
     if rank is None:
         return [tree]
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    payload = _encode_tree(tree)
+    payload = _encode_tree(tree, precisions=precisions, stats=report)
     return [
         _decode_tree(member_payload, treedef, len(leaves), context=f" (group={group.name!r}, peer rank={member})")
         for member, member_payload in zip(group.ranks, _exchange_bytes(payload, group, rank, policy=policy, report=report))
@@ -612,6 +789,7 @@ def gather_state_trees(
     policy: str = "raise",
     report: Optional[Dict[str, Any]] = None,
     reductions: Optional[Dict[str, Any]] = None,
+    sync_precisions: Optional[Dict[str, str]] = None,
 ) -> List[Any]:
     """Gather a whole state tree from every sync peer; one tree per member.
 
@@ -644,6 +822,14 @@ def gather_state_trees(
     ``reductions`` mapping the caller passes here and keeps that state on
     the ragged pad-to-max gather.
 
+    ``sync_precisions`` (``{state name: 'bf16'|'int8'}`` — the
+    ``add_state(sync_precision=)`` tags, exact entries omitted) selects the
+    wire codec per state on BOTH default gather paths: the batched
+    ProcessGroup exchange and the world-spanning per-leaf gather (the
+    fixed-shape fast path and the ragged pad-to-max path alike). A custom
+    ``dist_sync_fn`` never sees the tags — its signature is its contract —
+    and integer/bool states always pass through exact regardless of tag.
+
     .. note:: leaves are visited in ``tree_flatten`` order — for a state
        dict that is **sorted key order**, not ``add_state`` registration
        order. A custom ``dist_sync_fn`` that replays recorded answers by
@@ -652,7 +838,9 @@ def gather_state_trees(
     import jax
 
     if dist_sync_fn is None and isinstance(group, ProcessGroup):
-        return gather_group_pytrees(tree, group, policy=policy, report=report)
+        return gather_group_pytrees(
+            tree, group, policy=policy, report=report, precisions=sync_precisions
+        )
 
     from metrics_tpu.parallel import comm
 
@@ -677,11 +865,19 @@ def gather_state_trees(
         fixed_flags = jax.tree_util.tree_leaves(flag_tree)
         if len(fixed_flags) != len(leaves):  # defensive: never misalign flags
             fixed_flags = [False] * len(leaves)
+    leaf_tags = (
+        _leaf_precisions(tree, sync_precisions) if dist_sync_fn is None else None
+    ) or [None] * len(leaves)
     gathered = []  # [n_leaves][n_members]
-    for leaf, fixed in zip(leaves, fixed_flags):
+    for leaf, fixed, tag in zip(leaves, fixed_flags, leaf_tags):
         try:
             if dist_sync_fn is None:
-                gathered.append(gather(leaf, group=group, fixed_shape=fixed))
+                # `report` carries the wire telemetry only — per-leaf gathers
+                # keep policy='raise' (degradation stays whole-state here,
+                # see the docstring above)
+                gathered.append(
+                    gather(leaf, group=group, fixed_shape=fixed, precision=tag, report=report)
+                )
             else:
                 gathered.append(gather(leaf, group=group))
         except (SyncError, ValueError, TypeError, MetricsUserError):

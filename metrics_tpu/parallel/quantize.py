@@ -1,0 +1,276 @@
+"""Opt-in wire codecs for distributed metric sync: shrink bytes-on-wire.
+
+Sync payloads are dominated by list-state gathers (curve specs, samplewise
+scores, BERTScore ids) and large count tensors. Following EQuARX (PAPERS.md,
+arXiv:2506.17615 — quantized AllReduce inside XLA), tolerance-tagged float
+states can ride the wire compressed while exact integer-count paths stay
+bit-identical:
+
+* ``'exact'`` — the default: raw bytes, today's wire v1 payload, bit-identical
+  end-to-end.
+* ``'bf16'`` — float states cast to ``bfloat16`` (round-to-nearest-even) on
+  the wire and cast back to the state's dtype on receipt. 2x on float32.
+  Per-element error bound: ``|x̂ - x| <= 2**-8 * |x|`` (one bf16 ULP,
+  conservative); ±Inf/NaN round-trip exactly (bf16 keeps float32's exponent
+  range).
+* ``'int8'`` — symmetric per-block quantization: the flattened state is split
+  into blocks of :data:`INT8_BLOCK` elements, each block carries one float32
+  scale (``absmax/127``) and int8 codes. ~3.9x on float32
+  (``4 / (1 + 4/INT8_BLOCK)``). Per-element error bound:
+  ``|x̂ - x| <= absmax_block / 254`` (half a quantization step). Requires
+  finite states — non-finite values are clipped to the code range, not
+  preserved (screen with ``on_bad_input`` first, see ``docs/numerics.md``).
+
+A codec is *requested* per state via ``Metric.add_state(sync_precision=)``
+and *resolved* per payload dtype here: integer/bool states always take the
+exact passthrough regardless of their tag, so count tensors can never be
+degraded by a blanket precision policy.
+
+The module also owns the process-wide wire telemetry
+(:func:`wire_stats` — bytes raw vs encoded, per-codec payload counts, max
+observed dequantization error) surfaced by ``obs.snapshot()`` and the
+Prometheus dump, so wins are attributable, not vibes.
+
+Codec payloads ride the versioned crc32 envelope in ``parallel/groups.py``
+as wire **v2** (``WIRE_VERSION_QUANTIZED``); exact payloads stay wire v1
+byte-for-byte. See ``docs/distributed.md`` for the format table.
+"""
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+#: Valid ``sync_precision`` tags (requested codecs).
+CODECS = ("exact", "bf16", "int8")
+
+#: Elements per int8 quantization block (one float32 scale per block).
+INT8_BLOCK = 256
+
+_SCALE_DTYPE = np.float32
+
+
+def _bf16_dtype() -> np.dtype:
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _is_float_dtype(dtype: Any) -> bool:
+    """True for every float family the wire may carry — numpy's f16/f32/f64
+    and the ml_dtypes extension floats (bfloat16 & friends)."""
+    dt = np.dtype(dtype)
+    if np.issubdtype(dt, np.floating):
+        return True
+    try:  # ml_dtypes extension floats expose finfo but are not np.floating
+        import ml_dtypes
+
+        ml_dtypes.finfo(dt)
+        return True
+    except (ValueError, TypeError):
+        return False
+
+
+def resolve_codec(precision: Optional[str], dtype: Any) -> str:
+    """The codec a payload of ``dtype`` actually rides under ``precision``.
+
+    ``None``/``'exact'`` → exact. A quantized tag on an integer/bool payload
+    resolves to exact too (the passthrough contract: quantization is for
+    tolerance-tagged float states only — counts stay bit-identical).
+    """
+    if precision is None or precision == "exact":
+        return "exact"
+    if precision not in CODECS:
+        raise ValueError(f"`sync_precision` must be one of {CODECS}, got {precision!r}")
+    return precision if _is_float_dtype(dtype) else "exact"
+
+
+def _block_count(n: int) -> int:
+    return -(-n // INT8_BLOCK) if n else 0
+
+
+# ---------------------------------------------------------------------------
+# host-side (numpy) codecs — the KV wire path
+# ---------------------------------------------------------------------------
+
+def quantize_array(arr: np.ndarray, codec: str) -> Tuple[np.ndarray, Optional[np.ndarray], Dict[str, Any]]:
+    """Encode ``arr`` under ``codec``; returns ``(qdata, scales, meta)``.
+
+    ``scales`` is ``None`` except for int8 (one float32 per
+    :data:`INT8_BLOCK`-element block). ``meta`` carries what the receiver
+    needs beyond the payload's dtype/shape header: ``codec`` and (int8) the
+    block size, so the format can evolve without renegotiation.
+    """
+    arr = np.asarray(arr)
+    if codec == "exact":
+        return arr, None, {"codec": "exact"}
+    if codec == "bf16":
+        return arr.astype(_bf16_dtype()), None, {"codec": "bf16"}
+    if codec == "int8":
+        flat = arr.astype(np.float32, copy=False).ravel()
+        nblocks = _block_count(flat.size)
+        padded = np.zeros(nblocks * INT8_BLOCK, dtype=np.float32)
+        padded[: flat.size] = flat
+        blocks = padded.reshape(nblocks, INT8_BLOCK) if nblocks else padded.reshape(0, INT8_BLOCK)
+        absmax = np.max(np.abs(blocks), axis=1) if nblocks else np.zeros((0,), np.float32)
+        # zero blocks (and non-finite absmax, which the codec does not
+        # support — see module docstring) get a neutral scale of 1.0: all
+        # codes land on 0 / get clipped instead of dividing by 0 or inf
+        safe = np.where(np.isfinite(absmax) & (absmax > 0), absmax, 1.0)
+        scales = (safe / 127.0).astype(_SCALE_DTYPE)
+        q = np.clip(np.rint(blocks / scales[:, None]), -127, 127).astype(np.int8)
+        # ship only the true element count — the last block's padding codes
+        # are reconstructed as zeros on decode, so a 300-element state costs
+        # 300 codes + 2 scales, not 512 codes
+        return q.ravel()[: flat.size], scales, {"codec": "int8", "block": INT8_BLOCK}
+    raise ValueError(f"Unknown wire codec {codec!r}; must be one of {CODECS}")
+
+
+def dequantize_array(
+    qdata: np.ndarray,
+    scales: Optional[np.ndarray],
+    codec: str,
+    dtype: Any,
+    shape: Tuple[int, ...],
+) -> np.ndarray:
+    """Decode a :func:`quantize_array` payload back to ``dtype``/``shape``."""
+    if codec == "exact":
+        return np.asarray(qdata).reshape(shape)
+    if codec == "bf16":
+        return np.asarray(qdata).astype(dtype).reshape(shape)
+    if codec == "int8":
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        if n == 0:  # zero-size payload: no blocks, no scales
+            return np.zeros(shape, dtype=dtype)
+        nblocks = _block_count(n)
+        codes = np.zeros(nblocks * INT8_BLOCK, dtype=np.float32)
+        codes[:n] = np.asarray(qdata[:n], dtype=np.float32)
+        blocks = codes.reshape(nblocks, INT8_BLOCK) if nblocks else codes.reshape(0, INT8_BLOCK)
+        out = (blocks * np.asarray(scales, dtype=np.float32)[:, None]).ravel()[:n]
+        return out.reshape(shape).astype(dtype)
+    raise ValueError(f"Unknown wire codec {codec!r}; must be one of {CODECS}")
+
+
+def error_bound(codec: str, absmax: float) -> float:
+    """Documented per-element dequantization error bound for ``codec`` on a
+    payload whose largest magnitude is ``absmax`` (see module docstring)."""
+    if codec == "exact":
+        return 0.0
+    if codec == "bf16":
+        return float(absmax) * 2.0 ** -8
+    if codec == "int8":
+        return float(absmax) / 254.0
+    raise ValueError(f"Unknown wire codec {codec!r}; must be one of {CODECS}")
+
+
+# ---------------------------------------------------------------------------
+# in-jax codecs — the world-spanning multihost gather path
+# ---------------------------------------------------------------------------
+
+def encode_in_jax(x: Any, codec: str) -> Tuple[Any, Optional[Any]]:
+    """``(qdata, scales)`` as jax arrays — the device-side twin of
+    :func:`quantize_array`, used by ``comm.gather_all_arrays`` so the
+    multihost collective moves the narrow representation."""
+    import jax.numpy as jnp
+
+    if codec == "exact":
+        return x, None
+    if codec == "bf16":
+        return x.astype(jnp.bfloat16), None
+    if codec == "int8":
+        flat = x.astype(jnp.float32).ravel()
+        nblocks = _block_count(flat.size)
+        padded = jnp.zeros(nblocks * INT8_BLOCK, dtype=jnp.float32).at[: flat.size].set(flat)
+        blocks = padded.reshape(max(nblocks, 0), INT8_BLOCK)
+        absmax = jnp.max(jnp.abs(blocks), axis=1) if nblocks else jnp.zeros((0,), jnp.float32)
+        safe = jnp.where(jnp.isfinite(absmax) & (absmax > 0), absmax, 1.0)
+        scales = (safe / 127.0).astype(jnp.float32)
+        q = jnp.clip(jnp.rint(blocks / scales[:, None]), -127, 127).astype(jnp.int8)
+        return q.ravel()[: flat.size], scales
+    raise ValueError(f"Unknown wire codec {codec!r}; must be one of {CODECS}")
+
+
+def decode_in_jax(qdata: Any, scales: Optional[Any], codec: str, dtype: Any, shape: Tuple[int, ...]) -> Any:
+    """Device-side twin of :func:`dequantize_array`."""
+    import jax.numpy as jnp
+
+    if codec == "exact":
+        return qdata.reshape(shape)
+    if codec == "bf16":
+        return qdata.astype(dtype).reshape(shape)
+    if codec == "int8":
+        n = 1
+        for d in shape:
+            n *= int(d)
+        nblocks = _block_count(n)
+        codes = jnp.zeros(nblocks * INT8_BLOCK, dtype=jnp.float32).at[:n].set(
+            qdata[:n].astype(jnp.float32)
+        )
+        blocks = codes.reshape(max(nblocks, 0), INT8_BLOCK)
+        out = (blocks * scales[:, None]).ravel()[:n]
+        return out.reshape(shape).astype(dtype)
+    raise ValueError(f"Unknown wire codec {codec!r}; must be one of {CODECS}")
+
+
+# ---------------------------------------------------------------------------
+# process-wide wire telemetry
+# ---------------------------------------------------------------------------
+_stats_lock = threading.Lock()
+
+
+def _fresh_stats() -> Dict[str, Any]:
+    return {
+        "bytes_raw": 0,
+        "bytes_encoded": 0,
+        "bytes_raw_quantized": 0,
+        "bytes_encoded_quantized": 0,
+        "codec_counts": {codec: 0 for codec in CODECS},
+        "max_dequant_error": 0.0,
+    }
+
+
+_WIRE_STATS = _fresh_stats()
+
+
+def record_wire(
+    codec: str,
+    bytes_raw: int,
+    bytes_encoded: int,
+    error: float = 0.0,
+    stats: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Accumulate one encoded payload into the process-wide wire counters
+    (and, when given, a per-sync ``stats``/``report`` dict — the
+    ``Metric.sync_report()`` plumbing)."""
+    targets = [_WIRE_STATS] if stats is None else [_WIRE_STATS, stats]
+    with _stats_lock:
+        for target in targets:
+            target["bytes_raw"] = target.get("bytes_raw", 0) + int(bytes_raw)
+            target["bytes_encoded"] = target.get("bytes_encoded", 0) + int(bytes_encoded)
+            if codec != "exact":
+                target["bytes_raw_quantized"] = target.get("bytes_raw_quantized", 0) + int(bytes_raw)
+                target["bytes_encoded_quantized"] = target.get("bytes_encoded_quantized", 0) + int(
+                    bytes_encoded
+                )
+            counts = target.setdefault("codec_counts", {c: 0 for c in CODECS})
+            counts[codec] = counts.get(codec, 0) + 1
+            if error:
+                target["max_dequant_error"] = max(target.get("max_dequant_error", 0.0), float(error))
+
+
+def wire_stats() -> Dict[str, Any]:
+    """Copy of the process-wide wire telemetry: ``bytes_raw`` /
+    ``bytes_encoded`` (codec-level payload bytes over every encoded leaf —
+    the version-independent envelope/header overhead is excluded so the
+    ratio measures the codec), the same split restricted to quantized
+    payloads (``*_quantized``), per-codec payload ``codec_counts``, and the
+    largest observed round-trip ``max_dequant_error``."""
+    with _stats_lock:
+        out = dict(_WIRE_STATS)
+        out["codec_counts"] = dict(_WIRE_STATS["codec_counts"])
+        return out
+
+
+def reset_wire_stats() -> None:
+    with _stats_lock:
+        _WIRE_STATS.clear()
+        _WIRE_STATS.update(_fresh_stats())

@@ -61,6 +61,13 @@ _SYNC_STAT_KEYS = (
     "degraded_partial",
     "bytes_sent",
     "bytes_received",
+    # wire-codec telemetry (parallel/quantize.py): codec-level payload bytes
+    # before/after encoding (envelope overhead excluded — the ratio measures
+    # the codec), plus the same split restricted to quantized payloads
+    "bytes_raw",
+    "bytes_encoded",
+    "bytes_raw_quantized",
+    "bytes_encoded_quantized",
 )
 
 
@@ -68,9 +75,16 @@ def new_sync_stats() -> Dict[str, Any]:
     """Fresh sync-telemetry counters (the template ``Metric.sync_report()``
     reads). ``missing_ranks`` and ``last_sync_outcome``
     (``'complete'|'partial'|'local'|'failed'|None``) reflect the *last* sync;
-    everything else accumulates over the metric's lifetime."""
+    everything else accumulates over the metric's lifetime. Wire-codec
+    fields (``bytes_raw``/``bytes_encoded`` and the ``*_quantized`` split,
+    per-codec ``codec_counts``, ``max_dequant_error``) attribute
+    bytes-on-wire wins to the ``add_state(sync_precision=)`` tags."""
+    from metrics_tpu.parallel.quantize import CODECS
+
     stats: Dict[str, Any] = {key: 0 for key in _SYNC_STAT_KEYS}
     stats["backoff_s"] = 0.0
     stats["missing_ranks"] = []
     stats["last_sync_outcome"] = None
+    stats["codec_counts"] = {codec: 0 for codec in CODECS}
+    stats["max_dequant_error"] = 0.0
     return stats

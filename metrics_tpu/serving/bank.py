@@ -651,16 +651,20 @@ class MetricBank:
     # ------------------------------------------------------------------
     # distributed: banked states ride the existing sync path
     # ------------------------------------------------------------------
-    def sync_state_in_trace(self, axis_name: Any) -> None:
+    def sync_state_in_trace(self, axis_name: Any, hierarchical: bool = False) -> None:
         """Reduce the WHOLE bank across a mesh axis in-trace — valid when
         every process assigns the same tenants to the same slots (dp-style
         replicated serving). The leading tenant axis rides the existing
-        per-leaf collectives untouched (see ``parallel/comm.sync_bank_states``)."""
+        per-leaf collectives untouched (see ``parallel/comm.sync_bank_states``).
+        ``hierarchical=True`` with a multi-axis ``axis_name`` (ordered
+        outer→inner, e.g. ``('host', 'local')``) stages each reduction
+        intra-host first so only per-host partials cross the inter-host
+        fabric."""
         from metrics_tpu.parallel import comm
 
         with self._lock:
             self._bank = comm.sync_bank_states(
-                self._bank, self._template._reductions, axis_name
+                self._bank, self._template._reductions, axis_name, hierarchical=hierarchical
             )
 
     # ------------------------------------------------------------------

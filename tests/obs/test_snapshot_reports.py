@@ -291,7 +291,7 @@ def test_jsonl_roundtrip_and_prometheus_render():
     # the serving plane, that includes the async-fetch counters and the
     # per-bank serving summary
     process = obs.snapshot()
-    assert set(process) == {"engine", "fetch", "serving", "bus", "spans", "warnings"}
+    assert set(process) == {"engine", "fetch", "serving", "wire", "bus", "spans", "warnings"}
     assert process["engine"] == engine.cache_summary()
     assert process["fetch"] == engine.fetch_stats()
     assert set(process["fetch"]) == {"async_fetches", "coalesced_leaves"}

@@ -1,0 +1,205 @@
+"""Hierarchical (staged intra-host → inter-host) in-trace sync collectives.
+
+The 8-virtual-device CPU mesh is split 2x4 as ``('host', 'local')`` —
+reduction staged over ``'local'`` first models the intra-host ICI hop,
+the ``'host'`` stage the inter-host DCN hop. Acceptance: integer sums
+reduce BIT-exactly vs the flat collective; cat ordering matches; the
+engine driver and the serving bank thread the flag end-to-end.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import MeanMetric, SumMetric, engine
+from metrics_tpu.parallel import comm
+
+if hasattr(jax, "shard_map"):  # jax >= 0.6 top-level spelling
+    _shard_map = jax.shard_map
+    _CHECK_KW = "check_vma"
+else:  # pragma: no cover - older jax lane
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+NEEDS_8 = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+
+
+def _mesh_2x4():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("host", "local"))
+
+
+def _run_reduce(x, fx, hierarchical, out_spec=P()):
+    mesh = _mesh_2x4()
+    kw = {_CHECK_KW: False}
+
+    def f(shard):
+        return comm.reduce_in_trace(
+            shard[0], fx, ("host", "local"), hierarchical=hierarchical
+        )
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=(P(("host", "local")),), out_specs=out_spec, **kw
+    )(x)
+
+
+@NEEDS_8
+def test_integer_sum_hierarchical_is_bit_exact_vs_flat():
+    """The acceptance gate: staged integer psum == flat psum == host sum."""
+    x = jnp.arange(8 * 16, dtype=jnp.int32).reshape(8, 16) * 1000003  # big, overflow-free
+    flat = _run_reduce(x, "sum", hierarchical=False)
+    hier = _run_reduce(x, "sum", hierarchical=True)
+    np.testing.assert_array_equal(np.asarray(flat), np.asarray(hier))
+    np.testing.assert_array_equal(np.asarray(hier), np.asarray(x).sum(axis=0))
+
+
+@NEEDS_8
+@pytest.mark.parametrize("fx", ["max", "min"])
+def test_max_min_hierarchical_bit_exact(fx):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(-(2**30), 2**30, size=(8, 5)), dtype=jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(_run_reduce(x, fx, False)), np.asarray(_run_reduce(x, fx, True))
+    )
+
+
+@NEEDS_8
+def test_mean_hierarchical_matches_flat():
+    """Uniform mesh groups: staged mean == flat mean (up to float
+    reassociation; on this tiny input it is exact)."""
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(8, 4)), dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(_run_reduce(x, "mean", False)),
+        np.asarray(_run_reduce(x, "mean", True)),
+        rtol=1e-6,
+    )
+
+
+@NEEDS_8
+def test_cat_hierarchical_preserves_flat_gather_order():
+    """Nested tiled gathers (inner-first) must concatenate in the same
+    host-major rank order as the flat multi-axis gather."""
+    x = jnp.arange(8 * 2, dtype=jnp.float32).reshape(8, 2)
+    flat = _run_reduce(x, "cat", False, out_spec=P(("host", "local")))
+    hier = _run_reduce(x, "cat", True, out_spec=P(("host", "local")))
+    np.testing.assert_array_equal(np.asarray(flat), np.asarray(hier))
+
+
+@NEEDS_8
+def test_none_and_callable_reductions_fall_back_to_flat():
+    x = jnp.arange(8.0).reshape(8, 1)
+    for fx in (None, lambda stacked: jnp.sum(stacked, axis=0)):
+        a = _run_reduce(x, fx, False)
+        b = _run_reduce(x, fx, True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_single_axis_hierarchical_is_a_no_op():
+    """One named axis has no hierarchy: the flag must not change lowering."""
+    assert comm._staged_axes("i", True) is None
+    assert comm._staged_axes(("i",), True) is None
+    assert comm._staged_axes(("host", "local"), False) is None
+    assert comm._staged_axes(("host", "local"), True) == ("host", "local")
+
+
+# ---------------------------------------------------------------------------
+# satellite: unsupported-reduction errors name the state
+# ---------------------------------------------------------------------------
+def test_reduce_in_trace_error_names_state():
+    with pytest.raises(ValueError, match=r"Unsupported dist_reduce_fx for state 'acc\.tp'"):
+        comm.reduce_in_trace(jnp.zeros(3), "median", "i", state="acc.tp")
+    with pytest.raises(ValueError, match="Unsupported dist_reduce_fx: 'median'"):
+        comm.reduce_in_trace(jnp.zeros(3), "median", "i")  # nameless call still works
+
+
+def test_host_reduce_error_names_state():
+    with pytest.raises(ValueError, match=r"for state 'm\.total'.*'median'"):
+        comm.host_reduce(jnp.zeros(3), "median", state="m.total")
+
+
+@NEEDS_8
+def test_sync_state_trees_threads_state_name_into_error():
+    mesh = _mesh_2x4()
+    kw = {_CHECK_KW: False}
+
+    def f(shard):
+        return comm.sync_state_trees(
+            {"m": {"bad": shard[0]}}, {"m": {"bad": "median"}}, ("host", "local")
+        )
+
+    with pytest.raises(ValueError, match=r"for state 'm\.bad'"):
+        _shard_map(
+            f, mesh=mesh, in_specs=(P(("host", "local")),), out_specs=P(), **kw
+        )(jnp.zeros((8, 2)))
+
+
+# ---------------------------------------------------------------------------
+# engine.drive: tuple axis names + hierarchical_sync
+# ---------------------------------------------------------------------------
+@NEEDS_8
+def test_drive_hierarchical_integer_sum_bit_exact():
+    preds = jnp.arange(16 * 4, dtype=jnp.float32).reshape(16, 4)  # int-valued: f32-exact
+
+    def drive(axis_name, shape, names, hier):
+        m = SumMetric(nan_strategy="disable")
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(*shape), names)
+        engine.drive(m, (preds,), axis_name=axis_name, mesh=mesh, hierarchical_sync=hier)
+        return float(m.compute())
+
+    ref = float(np.asarray(preds).sum())
+    assert drive("i", (8,), ("i",), False) == ref
+    assert drive(("host", "local"), (2, 4), ("host", "local"), False) == ref
+    assert drive(("host", "local"), (2, 4), ("host", "local"), True) == ref
+
+
+@NEEDS_8
+def test_drive_hierarchical_requires_multi_axis():
+    m = MeanMetric(nan_strategy="disable")
+    mesh = Mesh(np.array(jax.devices()[:8]), ("i",))
+    with pytest.raises(ValueError, match="MULTI-axis"):
+        engine.drive(
+            m,
+            (jnp.zeros((8, 2)),),
+            axis_name="i",
+            mesh=mesh,
+            hierarchical_sync=True,
+        )
+
+
+def test_axis_world_products():
+    from metrics_tpu.engine.cache import axis_world
+
+    n = len(jax.devices())
+    if n >= 8:
+        mesh = _mesh_2x4()
+        assert axis_world(mesh, "host") == 2
+        assert axis_world(mesh, "local") == 4
+        assert axis_world(mesh, ("host", "local")) == 8
+    else:  # pragma: no cover - single-device lane
+        mesh = Mesh(np.array(jax.devices()[:1]), ("i",))
+        assert axis_world(mesh, ("i",)) == 1
+
+
+# ---------------------------------------------------------------------------
+# serving bank: hierarchical bank sync threads through
+# ---------------------------------------------------------------------------
+@NEEDS_8
+def test_sync_bank_states_hierarchical_matches_flat():
+    bank = {"value": jnp.arange(8 * 4 * 3, dtype=jnp.int32).reshape(8, 4, 3)}
+    mesh = _mesh_2x4()
+    kw = {_CHECK_KW: False}
+
+    def run(hier):
+        def f(shard):
+            return comm.sync_bank_states(
+                {"value": shard[0]}, {"value": "sum"}, ("host", "local"), hierarchical=hier
+            )["value"]
+
+        return _shard_map(
+            f, mesh=mesh, in_specs=(P(("host", "local")),), out_specs=P(), **kw
+        )(bank["value"])
+
+    flat, hier = run(False), run(True)
+    np.testing.assert_array_equal(np.asarray(flat), np.asarray(hier))
+    np.testing.assert_array_equal(np.asarray(hier), np.asarray(bank["value"]).sum(axis=0))

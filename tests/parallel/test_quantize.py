@@ -1,0 +1,504 @@
+"""Quantized sync wire codecs (``parallel/quantize.py`` + wire v2).
+
+Covers the per-codec round-trip bounds, the exact-passthrough contract for
+integer/bool payloads, wire v1 bit-identity for the default path, v1↔v2
+version negotiation from the PUBLIC envelope API, the ``sync_precision``
+threading through ``add_state`` → ``_sync_dist`` → the KV exchange, the
+quantized multihost gather, fault-injection recovery over quantized states,
+and the wire telemetry surfaces.
+"""
+import json
+import pickle
+import struct
+import warnings
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric, obs
+from metrics_tpu.parallel import (
+    CODECS,
+    INT8_BLOCK,
+    SUPPORTED_WIRE_VERSIONS,
+    WIRE_VERSION,
+    WIRE_VERSION_QUANTIZED,
+    comm,
+    new_group,
+    pack_envelope,
+    quantize,
+    unpack_envelope,
+)
+from metrics_tpu.parallel.groups import _decode, _encode, _encode_tree
+from metrics_tpu.resilience import (
+    FaultSpec,
+    InMemoryKVStore,
+    RetryPolicy,
+    run_as_peers,
+)
+from metrics_tpu.utils.exceptions import SyncIntegrityError
+
+FAST_RETRY = RetryPolicy(max_attempts=3, backoff_base_s=0.01, backoff_max_s=0.05)
+
+_seq = [0]
+
+
+def make_group(world, timeout_s=5.0):
+    _seq[0] += 1
+    return new_group(range(world), name=f"quant{_seq[0]}", timeout_s=timeout_s, retry=FAST_RETRY)
+
+
+# ---------------------------------------------------------------------------
+# codec round trips and bounds
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("shape", [(1000,), (37, 11), (), (0,), (3, 0, 2)])
+def test_bf16_round_trip_within_bound(shape):
+    rng = np.random.default_rng(0)
+    arr = rng.normal(size=shape).astype(np.float32) * 100
+    back = _decode(_encode(arr, "bf16"))
+    assert back.dtype == arr.dtype and back.shape == arr.shape
+    bound = quantize.error_bound("bf16", np.max(np.abs(arr)) if arr.size else 0.0)
+    assert np.max(np.abs(back - arr), initial=0.0) <= bound
+
+
+@pytest.mark.parametrize("shape", [(1000,), (37, 11), (), (0,), (256,), (257,)])
+def test_int8_round_trip_within_per_block_bound(shape):
+    rng = np.random.default_rng(1)
+    arr = (rng.normal(size=shape) * 10).astype(np.float32)
+    back = _decode(_encode(arr, "int8"))
+    assert back.dtype == arr.dtype and back.shape == arr.shape
+    if arr.size:
+        flat, dec = arr.ravel(), back.ravel()
+        pad = (-flat.size) % INT8_BLOCK
+        blocks = np.pad(flat, (0, pad)).reshape(-1, INT8_BLOCK)
+        bounds = np.abs(blocks).max(axis=1, keepdims=True) / 254.0 + 1e-9
+        err = np.abs(np.pad(dec, (0, pad)).reshape(-1, INT8_BLOCK) - blocks)
+        assert (err <= bounds).all()
+
+
+def test_bf16_preserves_nonfinite():
+    arr = np.asarray([np.inf, -np.inf, np.nan, 1.0], dtype=np.float32)
+    back = _decode(_encode(arr, "bf16"))
+    assert np.isposinf(back[0]) and np.isneginf(back[1]) and np.isnan(back[2])
+
+
+def test_int8_nonfinite_does_not_crash():
+    """int8 documents finite-only support; non-finite input must clip, not
+    divide-by-inf into NaN scales or crash."""
+    arr = np.asarray([np.inf, 1.0, -2.0], dtype=np.float32)
+    back = _decode(_encode(arr, "int8"))
+    assert np.isfinite(back).all()
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.uint8, np.bool_])
+@pytest.mark.parametrize("precision", ["bf16", "int8"])
+def test_integer_and_bool_pass_through_exact(dtype, precision):
+    arr = np.arange(10).astype(dtype)
+    payload = _encode(arr, precision)
+    assert payload[2] == WIRE_VERSION  # exact passthrough seals v1
+    np.testing.assert_array_equal(_decode(payload), arr)
+
+
+def test_resolve_codec_contract():
+    assert quantize.resolve_codec(None, np.float32) == "exact"
+    assert quantize.resolve_codec("exact", np.float32) == "exact"
+    assert quantize.resolve_codec("bf16", np.float32) == "bf16"
+    assert quantize.resolve_codec("int8", np.float64) == "int8"
+    assert quantize.resolve_codec("int8", np.int32) == "exact"
+    assert quantize.resolve_codec("bf16", np.bool_) == "exact"
+    assert quantize.resolve_codec("bf16", np.dtype("bfloat16")) == "bf16"
+    with pytest.raises(ValueError, match="sync_precision"):
+        quantize.resolve_codec("fp4", np.float32)
+
+
+def test_int8_wire_ratio_near_4x():
+    arr = np.zeros(4 * INT8_BLOCK, dtype=np.float32)
+    qdata, scales, _ = quantize.quantize_array(arr, "int8")
+    ratio = arr.nbytes / (qdata.nbytes + scales.nbytes)
+    assert ratio >= 3.5
+
+
+# ---------------------------------------------------------------------------
+# wire v2 format + public envelope API (satellite: exported negotiation)
+# ---------------------------------------------------------------------------
+def test_exact_payload_is_bit_identical_to_wire_v1():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    header = json.dumps({"dtype": "float32", "shape": [3, 4]}).encode()
+    body = struct.pack(">I", len(header)) + header + arr.tobytes()
+    legacy = struct.pack(">2sBI", b"MT", 1, zlib.crc32(body)) + body
+    assert _encode(arr) == legacy
+    assert _encode(arr, "exact") == legacy
+
+
+def test_quantized_payload_seals_v2_with_codec_header():
+    payload = _encode(np.ones(8, np.float32), "bf16")
+    version, body = unpack_envelope(payload)
+    assert version == WIRE_VERSION_QUANTIZED
+    (header_len,) = struct.unpack(">I", body[:4])
+    header = json.loads(body[4 : 4 + header_len].decode())
+    assert header["codec"] == "bf16" and header["dtype"] == "float32"
+    p8 = _encode(np.ones(8, np.float32), "int8")
+    _, body8 = unpack_envelope(p8)
+    (hl8,) = struct.unpack(">I", body8[:4])
+    assert json.loads(body8[4 : 4 + hl8].decode())["block"] == INT8_BLOCK
+
+
+def test_public_envelope_round_trip_and_version_constants():
+    assert WIRE_VERSION == 1 and WIRE_VERSION_QUANTIZED == 2
+    assert set(SUPPORTED_WIRE_VERSIONS) == {1, 2}
+    for version in SUPPORTED_WIRE_VERSIONS:
+        got_version, got_body = unpack_envelope(pack_envelope(b"abc", version))
+        assert (got_version, got_body) == (version, b"abc")
+    with pytest.raises(ValueError, match="speaks"):
+        pack_envelope(b"abc", version=9)
+
+
+def test_mixed_peer_rejection_names_both_versions():
+    """Satellite: v1↔v2 rejection is explicit, non-transient, and names the
+    peer's version AND the locally spoken version(s)."""
+    v2 = pack_envelope(b"abc", WIRE_VERSION_QUANTIZED)
+    with pytest.raises(SyncIntegrityError, match="version mismatch") as exc_info:
+        unpack_envelope(v2, accept=(WIRE_VERSION,))  # a v1-only peer's view
+    msg = str(exc_info.value)
+    assert "v2" in msg and "v1" in msg and not exc_info.value.transient
+    # the inverse direction: a hypothetical v2-only peer rejecting v1
+    v1 = pack_envelope(b"abc", WIRE_VERSION)
+    with pytest.raises(SyncIntegrityError, match="version mismatch") as exc_info:
+        unpack_envelope(v1, accept=(WIRE_VERSION_QUANTIZED,))
+    msg = str(exc_info.value)
+    assert "v1" in msg and "v2" in msg and not exc_info.value.transient
+
+
+def test_unknown_future_version_is_explicit_and_not_transient():
+    payload = bytearray(pack_envelope(b"abc"))
+    payload[2] = 9
+    with pytest.raises(SyncIntegrityError, match="version mismatch") as exc_info:
+        unpack_envelope(bytes(payload))
+    assert "v9" in str(exc_info.value) and not exc_info.value.transient
+
+
+def test_version_codec_agreement_is_enforced():
+    """A v2 envelope without codec metadata — and a v1 envelope WITH it —
+    are malformed payloads, rejected without retry."""
+    exact = bytearray(_encode(np.arange(3.0, dtype=np.float32)))
+    exact[2] = WIRE_VERSION_QUANTIZED  # relabel: crc covers the BODY only
+    with pytest.raises(SyncIntegrityError, match="version mismatch") as exc_info:
+        _decode(bytes(exact))
+    assert not exc_info.value.transient
+    quantized = bytearray(_encode(np.arange(3.0, dtype=np.float32), "bf16"))
+    quantized[2] = WIRE_VERSION
+    with pytest.raises(SyncIntegrityError, match="version mismatch") as exc_info:
+        _decode(bytes(quantized))
+    assert not exc_info.value.transient
+
+
+def test_corrupted_quantized_payload_raises_crc_mismatch():
+    """Satellite: crc32 corruption of a QUANTIZED payload surfaces the same
+    precise, transient SyncIntegrityError as the exact wire."""
+    for precision in ("bf16", "int8"):
+        payload = bytearray(_encode(np.arange(600, dtype=np.float32), precision))
+        payload[len(payload) // 2] ^= 0xFF
+        with pytest.raises(SyncIntegrityError, match="crc32") as exc_info:
+            _decode(bytes(payload))
+        assert exc_info.value.transient
+
+
+def test_quantized_length_mismatch_is_precise():
+    arr = np.arange(600, dtype=np.float32)
+    payload = _encode(arr, "int8")
+    version, body = unpack_envelope(payload)
+    with pytest.raises(SyncIntegrityError, match="length mismatch"):
+        _decode(pack_envelope(body[:-8], version))
+
+
+def test_unknown_codec_and_foreign_block_size_are_explicit():
+    header = json.dumps({"dtype": "float32", "shape": [4], "codec": "fp4"}).encode()
+    body = struct.pack(">I", len(header)) + header + b"\x00" * 16
+    with pytest.raises(SyncIntegrityError, match="unknown wire codec") as exc_info:
+        _decode(pack_envelope(body, WIRE_VERSION_QUANTIZED))
+    assert not exc_info.value.transient
+    header = json.dumps({"dtype": "float32", "shape": [4], "codec": "int8", "block": 64}).encode()
+    body = struct.pack(">I", len(header)) + header + b"\x00" * 8
+    with pytest.raises(SyncIntegrityError, match="block size") as exc_info:
+        _decode(pack_envelope(body, WIRE_VERSION_QUANTIZED))
+    assert not exc_info.value.transient
+
+
+def test_tree_envelope_version_follows_content():
+    tree = {"scores": [jnp.asarray(np.ones(8, np.float32))], "count": jnp.asarray([3])}
+    assert _encode_tree(tree)[2] == WIRE_VERSION  # all-exact: v1, bit-identical
+    assert _encode_tree(tree, precisions={"scores": "bf16"})[2] == WIRE_VERSION_QUANTIZED
+    # quantized tag on the int leaf only: passthrough keeps the tree v1
+    assert _encode_tree(tree, precisions={"count": "int8"})[2] == WIRE_VERSION
+
+
+# ---------------------------------------------------------------------------
+# add_state(sync_precision=) threading
+# ---------------------------------------------------------------------------
+class QuantMetric(Metric):
+    def __init__(self, precision="exact", **kwargs):
+        super().__init__(jit_update=False, **kwargs)
+        self.add_state(
+            "scores", [], dist_reduce_fx="cat", placeholder=jnp.float32, sync_precision=precision
+        )
+        self.add_state(
+            "curve",
+            [],
+            dist_reduce_fx="cat",
+            placeholder=jax.ShapeDtypeStruct((0, 3), jnp.float32),
+            sync_precision=precision,
+        )
+        # int ids under the SAME tag: must pass through exact
+        self.add_state(
+            "ids",
+            [],
+            dist_reduce_fx="cat",
+            placeholder=jnp.int32,
+            sync_precision=precision,
+        )
+        self.add_state("total", jnp.zeros((64,), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, scores, curve, ids):
+        self.scores.append(jnp.asarray(scores, jnp.float32))
+        self.curve.append(jnp.asarray(curve, jnp.float32))
+        self.ids.append(jnp.asarray(ids, jnp.int32))
+        self.total = self.total + jnp.bincount(jnp.asarray(ids, jnp.int32) % 64, length=64)
+
+    def compute(self):
+        return {
+            "scores": jnp.concatenate([jnp.atleast_1d(x) for x in self.scores]),
+            "curve": jnp.concatenate(self.curve, axis=0),
+            "ids": jnp.concatenate([jnp.atleast_1d(x) for x in self.ids]),
+            "total": self.total,
+        }
+
+
+def _feed(metric, rank, n=400):
+    rng = np.random.default_rng(7)  # same data per precision lane
+    metric.update(
+        rng.normal(size=(n,)) * (rank + 1),
+        rng.normal(size=(n, 3)) + rank,
+        rng.integers(0, 1000, size=(n,)) + rank,
+    )
+
+
+def test_add_state_validates_sync_precision():
+    m = Metric.__new__(QuantMetric)
+    with pytest.raises(ValueError, match="sync_precision"):
+        QuantMetric(precision="fp8")
+    m = QuantMetric("int8")
+    assert m._sync_precisions == {"scores": "int8", "curve": "int8", "ids": "int8", "total": "exact"}
+
+
+def test_sync_precision_survives_pickle_and_clone():
+    m = QuantMetric("bf16")
+    m2 = pickle.loads(pickle.dumps(m))
+    assert m2._sync_precisions == m._sync_precisions
+    assert m.clone()._sync_precisions == m._sync_precisions
+
+
+@pytest.mark.parametrize("precision", ["bf16", "int8"])
+def test_group_sync_quantized_matches_exact_within_bound(precision):
+    """End-to-end 2-rank KV sync: integer states bit-exact vs the exact
+    lane, float states within the documented per-codec bound, and the wire
+    telemetry attributes the byte savings."""
+
+    def run(prec):
+        group = make_group(2)
+        metrics = [QuantMetric(prec, process_group=group) for _ in range(2)]
+        for rank, m in enumerate(metrics):
+            _feed(m, rank)
+        values = run_as_peers(
+            2, lambda rank: jax.tree_util.tree_map(np.asarray, metrics[rank].compute())
+        )
+        return values[0], metrics[0].sync_report()
+
+    exact_vals, exact_report = run("exact")
+    quant_vals, report = run(precision)
+
+    # integer-count states: bit-exact, never quantized
+    np.testing.assert_array_equal(quant_vals["ids"], exact_vals["ids"])
+    np.testing.assert_array_equal(quant_vals["total"], exact_vals["total"])
+    # float states: within the documented per-codec bound
+    for name in ("scores", "curve"):
+        bound = quantize.error_bound(precision, float(np.max(np.abs(exact_vals[name]))))
+        assert np.max(np.abs(quant_vals[name] - exact_vals[name])) <= bound
+    # telemetry: quantized-lane ratio, codec counts, bounded observed error
+    ratio = report["bytes_raw_quantized"] / report["bytes_encoded_quantized"]
+    assert ratio >= (2.0 if precision == "bf16" else 3.5)
+    assert report["codec_counts"][precision] == 2  # scores + curve
+    assert report["codec_counts"]["exact"] >= 2  # ids + total
+    assert report["max_dequant_error"] > 0.0
+    # the exact lane emits NO quantized payloads and records no error
+    assert exact_report["bytes_raw_quantized"] == 0
+    assert exact_report["codec_counts"]["bf16"] == exact_report["codec_counts"]["int8"] == 0
+    assert exact_report["max_dequant_error"] == 0.0
+
+
+def test_drop_and_corrupt_faults_recover_identically_over_quantized_states():
+    """Satellite: the deterministic drop+corrupt fault sequence over a
+    QUANTIZED sync recovers exactly like the exact path — the corrupt read
+    retries to the clean payload, the drop degrades to partial."""
+
+    def run(prec, faults):
+        group = make_group(2, timeout_s=3.0)
+        metrics = [
+            QuantMetric(prec, process_group=group, on_sync_error="partial") for _ in range(2)
+        ]
+        for rank, m in enumerate(metrics):
+            _feed(m, rank, n=128)
+        store = InMemoryKVStore(faults)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            values = run_as_peers(
+                2,
+                lambda rank: jax.tree_util.tree_map(np.asarray, metrics[rank].compute()),
+                store=store,
+            )
+        return values, metrics[0].sync_report()
+
+    faults = lambda: [FaultSpec("corrupt", rank=1, epoch=0)]  # noqa: E731
+    for precision in ("bf16", "int8"):
+        clean_vals, _ = run(precision, [])
+        faulted_vals, report = run(precision, faults())
+        # the corrupted read retried to the identical clean payload:
+        # BIT-identical recovery within the quantized lane
+        for rank in (0, 1):
+            for name in ("scores", "curve", "ids", "total"):
+                np.testing.assert_array_equal(faulted_vals[rank][name], clean_vals[rank][name])
+        assert report["integrity_failures"] >= 1 and report["retries"] >= 1
+        assert report["last_sync_outcome"] == "complete"
+
+        # drop: rank 1's payload never lands -> rank 0 degrades to partial,
+        # exactly as the exact path does
+        dropped_quant, report_q = run(precision, [FaultSpec("drop", rank=1, epoch=0)])
+        dropped_exact, report_e = run("exact", [FaultSpec("drop", rank=1, epoch=0)])
+        assert report_q["missing_ranks"] == report_e["missing_ranks"] == [1]
+        np.testing.assert_array_equal(dropped_quant[0]["ids"], dropped_exact[0]["ids"])
+        np.testing.assert_array_equal(dropped_quant[0]["total"], dropped_exact[0]["total"])
+        bound = quantize.error_bound(
+            precision, float(np.max(np.abs(dropped_exact[0]["scores"])))
+        )
+        assert np.max(np.abs(dropped_quant[0]["scores"] - dropped_exact[0]["scores"])) <= bound
+
+
+# ---------------------------------------------------------------------------
+# world-spanning multihost gather: quantized collective
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def fake_two_process_world(monkeypatch):
+    """Pretend to be a 2-process world whose host collective stacks the local
+    contribution twice (both 'ranks' contribute the same array)."""
+    monkeypatch.setattr(comm, "distributed_available", lambda: True)
+    monkeypatch.setattr(comm, "world_size", lambda: 2)
+    monkeypatch.setattr(comm, "process_index", lambda: 0)
+    calls = []
+
+    def fake_allgather(x):
+        calls.append(np.asarray(x))
+        return jnp.stack([x, x])
+
+    monkeypatch.setattr(comm, "_host_allgather", fake_allgather)
+    return calls
+
+
+@pytest.mark.parametrize("fixed_shape", [True, False])
+def test_gather_all_arrays_moves_narrow_representation(fake_two_process_world, fixed_shape):
+    calls = fake_two_process_world
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(512,)).astype(np.float32))
+    out = comm.gather_all_arrays(x, fixed_shape=fixed_shape, precision="bf16")
+    assert len(out) == 2
+    bound = quantize.error_bound("bf16", float(jnp.max(jnp.abs(x))))
+    for member in out:
+        assert member.dtype == x.dtype
+        assert float(jnp.max(jnp.abs(member - x))) <= bound
+    # the collective itself moved bf16, not f32
+    wire_calls = [c for c in calls if c.dtype == np.dtype("bfloat16")]
+    assert len(wire_calls) == 1 and wire_calls[0].nbytes == x.nbytes // 2
+
+
+def test_gather_all_arrays_int8_gathers_codes_and_scales(fake_two_process_world):
+    calls = fake_two_process_world
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(1024,)).astype(np.float32))
+    out = comm.gather_all_arrays(x, fixed_shape=True, precision="int8")
+    assert len(out) == 2
+    bound = quantize.error_bound("int8", float(jnp.max(jnp.abs(x))))
+    assert float(jnp.max(jnp.abs(out[0] - x))) <= bound
+    assert any(c.dtype == np.int8 for c in calls)  # codes on the wire
+
+
+def test_gather_all_arrays_int_passthrough_is_bit_exact(fake_two_process_world):
+    x = jnp.arange(100, dtype=jnp.int32)
+    out = comm.gather_all_arrays(x, fixed_shape=True, precision="int8")
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(x))
+
+
+def test_multihost_gather_attributes_wire_telemetry_to_report(fake_two_process_world):
+    """The per-metric sync report must attribute wire bytes on the
+    world-spanning path too — quantized AND exact payloads both count, so
+    the whole-payload ratio is comparable across gather paths."""
+    from metrics_tpu.resilience import new_sync_stats
+
+    report = new_sync_stats()
+    xq = jnp.asarray(np.random.default_rng(5).normal(size=(512,)).astype(np.float32))
+    comm.gather_all_arrays(xq, fixed_shape=True, precision="bf16", report=report)
+    xe = jnp.arange(64, dtype=jnp.int32)
+    comm.gather_all_arrays(xe, fixed_shape=True, precision=None, report=report)
+    assert report["bytes_raw_quantized"] == 2048 and report["bytes_encoded_quantized"] == 1024
+    assert report["bytes_raw"] == 2048 + 256 and report["bytes_encoded"] == 1024 + 256
+    assert report["codec_counts"]["bf16"] == 1 and report["codec_counts"]["exact"] == 1
+    assert report["max_dequant_error"] > 0.0
+
+
+def test_state_tree_gather_threads_report_through_world_path(fake_two_process_world, monkeypatch):
+    """gather_state_trees on the default world-spanning path passes the sync
+    report down, so Metric.sync_report() sees quantized bytes there too."""
+    from metrics_tpu.parallel.groups import gather_state_trees
+    from metrics_tpu.resilience import new_sync_stats
+
+    report = new_sync_stats()
+    tree = {
+        "scores": [jnp.asarray(np.ones(256, np.float32))],
+        "total": jnp.arange(8, dtype=jnp.int32),
+    }
+    gather_state_trees(
+        tree,
+        None,
+        policy="raise",
+        report=report,
+        reductions={"scores": "cat", "total": "sum"},
+        sync_precisions={"scores": "bf16"},
+    )
+    assert report["codec_counts"]["bf16"] == 1
+    assert report["bytes_raw_quantized"] == 1024 and report["bytes_encoded_quantized"] == 512
+
+
+# ---------------------------------------------------------------------------
+# telemetry surfaces
+# ---------------------------------------------------------------------------
+def test_wire_stats_surface_in_snapshot_and_prometheus():
+    quantize.reset_wire_stats()
+    _encode(np.ones(600, np.float32), "int8")
+    snap = obs.snapshot()
+    assert snap["wire"]["codec_counts"]["int8"] == 1
+    assert snap["wire"]["bytes_raw"] == 2400
+    assert 0 < snap["wire"]["bytes_encoded_quantized"] < snap["wire"]["bytes_raw_quantized"]
+    text = obs.prometheus_text()
+    assert 'metrics_tpu_wire_payloads_total{codec="int8"} 1' in text
+    assert "metrics_tpu_wire_bytes_raw 2400" in text
+    assert "metrics_tpu_wire_max_dequant_error" in text
+
+
+def test_wire_events_emitted_for_quantized_payloads_only():
+    from metrics_tpu.obs import bus
+
+    with bus.capture() as events:
+        _encode(np.ones(600, np.float32))  # exact: silent
+        _encode(np.ones(600, np.float32), "bf16")
+    wire_events = [e for e in events if e.kind == "wire"]
+    assert len(wire_events) == 1
+    data = wire_events[0].data
+    assert data["codec"] == "bf16" and data["bytes_encoded"] == 1200 and data["bytes_raw"] == 2400
