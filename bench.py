@@ -1799,6 +1799,141 @@ def bench_serving_plane() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# zero-cold-start: AOT warmup manifests (engine/warmup.py)
+# ---------------------------------------------------------------------------
+# One deployment script, three fresh processes: RECORD (env names a missing
+# manifest -> the engine records served signatures and saves at exit), COLD
+# (no manifest -> full trace+compile tax on the first request), WARM (env
+# names the recorded manifest -> import-time AOT warmup). Identical traffic
+# everywhere, so the cold and warm children's results must be bit-identical.
+_COLD_START_CHILD = r"""
+import json, os, sys, time
+forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
+import jax
+if forced:
+    jax.config.update("jax_platforms", forced)
+t_import0 = time.perf_counter()
+import numpy as np
+import jax.numpy as jnp
+import metrics_tpu as mt            # env-wired warmup (if any) happens HERE
+from metrics_tpu.serving import MetricBank
+import_s = time.perf_counter() - t_import0
+
+rng = np.random.default_rng(7)
+mc = mt.MetricCollection({"acc": mt.Accuracy(num_classes=8), "prec": mt.Precision(num_classes=8)})
+solo = mt.Accuracy(num_classes=8, jit_bucket="pow2")
+bank = MetricBank(mt.Accuracy(num_classes=8, jit_bucket="pow2"), capacity=8)
+for t in range(8):                  # control plane: admissions before traffic
+    bank.admit(f"tenant{t}")
+
+def _traffic():
+    p16 = jnp.asarray(rng.uniform(size=(16, 8)).astype(np.float32))
+    y16 = jnp.asarray(rng.integers(0, 8, size=(16,)).astype(np.int32))
+    p5 = jnp.asarray(rng.uniform(size=(5, 8)).astype(np.float32))
+    y5 = jnp.asarray(rng.integers(0, 8, size=(5,)).astype(np.int32))
+    return p16, y16, p5, y5
+
+def _serve_once():
+    p16, y16, p5, y5 = _traffic()
+    mc.update(p16, y16)
+    values = mc.compute()
+    solo.update(p5, y5)             # pow2-bucketed ragged batch
+    bank.apply_batch([(f"tenant{t}", (p5, y5)) for t in range(8)])
+    jax.block_until_ready([list(values.values()), solo._snapshot_state(), bank._bank])
+
+t0 = time.perf_counter()
+_serve_once()                       # the first request: the cold-start tail
+first_ms = (time.perf_counter() - t0) * 1e3
+steady = []
+for _ in range(5):                  # same signatures: steady-state dispatch
+    t0 = time.perf_counter()
+    _serve_once()
+    steady.append((time.perf_counter() - t0) * 1e3)
+steady_ms = float(np.median(steady))
+
+digest = {}
+for key, value in mc.compute().items():
+    digest[key] = np.asarray(value).tobytes().hex()
+digest["solo"] = np.asarray(solo.compute()).tobytes().hex()
+for t in range(8):
+    digest[f"tenant{t}"] = np.asarray(bank.compute(f"tenant{t}")).tobytes().hex()
+
+wr = sys.modules["metrics_tpu.engine.warmup"].warmup_report()
+print(json.dumps({
+    "first_ms": round(first_ms, 3),
+    "steady_ms": round(steady_ms, 3),
+    "import_s": round(import_s, 3),
+    "digest": digest,
+    "programs_warmed": wr["programs_warmed"],
+    "warmed_hits": wr["warmed_hits"],
+    "stale_total": wr["stale_total"],
+    "recorded_programs": wr["recording"]["programs"],
+}))
+"""
+
+
+def bench_cold_start() -> dict:
+    """Cold-start -> first-result latency with and without a warmup manifest,
+    in fresh subprocesses. Asserted by the ``ci.sh --warmup-smoke`` lane:
+
+    1. **>= 2x first-request improvement** — the manifest-warmed worker's
+       first request must run at least twice as fast as the unwarmed cold
+       start (it runs near steady-state: every covered program dispatches
+       through a pre-seeded executable instead of trace+compile).
+    2. **Bit-identity** — the warmed and unwarmed workers serve identical
+       traffic and must produce byte-identical results.
+    3. **Zero staleness** — on an unchanged deployment no ``warmup_stale``
+       event may fire; every covered signature is served warm.
+    """
+    def _child(env_overrides: dict, timeout_s: int = 300) -> dict:
+        env = dict(os.environ)
+        # isolate the comparison: no persistent disk cache, no inherited
+        # manifest — each child gets exactly what its mode sets
+        env.pop("METRICS_TPU_COMPILE_CACHE", None)
+        env.pop("METRICS_TPU_WARMUP_MANIFEST", None)
+        env.update(env_overrides)
+        out = subprocess.run(
+            [sys.executable, "-c", _COLD_START_CHILD],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        lines = [ln for ln in out.stdout.splitlines() if ln.strip().startswith("{")]
+        if out.returncode != 0 or not lines:
+            raise RuntimeError(f"cold-start child rc={out.returncode}: {out.stderr[-300:]}")
+        return json.loads(lines[-1])
+
+    with tempfile.TemporaryDirectory(prefix="metrics_tpu_warmup_") as tmp:
+        manifest = os.path.join(tmp, "manifest.json")
+        record = _child({"METRICS_TPU_WARMUP_MANIFEST": manifest})  # records, saves at exit
+        if not os.path.exists(manifest):
+            raise RuntimeError("recording child saved no manifest")
+        cold = _child({})
+        warm = _child({"METRICS_TPU_WARMUP_MANIFEST": manifest})
+
+    ratio = cold["first_ms"] / max(warm["first_ms"], 1e-6)
+    return {
+        "metric": "cold_start_warmup",
+        "value": round(ratio, 3),
+        "unit": "x_first_request_speedup_with_manifest",
+        "vs_baseline": None,
+        "cold_first_ms": cold["first_ms"],
+        "warm_first_ms": warm["first_ms"],
+        "cold_steady_ms": cold["steady_ms"],
+        "warm_steady_ms": warm["steady_ms"],
+        "warm_import_s": warm["import_s"],  # includes the AOT warmup itself
+        "cold_import_s": cold["import_s"],
+        "recorded_programs": record["recorded_programs"],
+        "programs_warmed": warm["programs_warmed"],
+        "warmed_hits": warm["warmed_hits"],
+        "warm_stale": warm["stale_total"],
+        "parity_ok": cold["digest"] == warm["digest"],
+    }
+
+
+# ---------------------------------------------------------------------------
 # module-API compute() latency on the live backend
 # ---------------------------------------------------------------------------
 def bench_compute_latency() -> dict:
@@ -1884,7 +2019,12 @@ _CONFIGS = [
     ("bench_obs_smoke", 600, False),
     ("bench_eval_driver", 900, False),
     ("bench_serving_plane", 900, False),
+    ("bench_cold_start", 1200, False),
 ]
+
+# the headline runs outside _CONFIGS (measured first, emitted last) but is
+# enumerated and dispatched with the same (name, timeout, needs_accel) shape
+_HEADLINE_CONFIG = ("bench_headline", 1200, True)
 
 _PERSIST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_PARTIAL.json")
 
@@ -2089,122 +2229,72 @@ def _run_config(name: str, timeout_s: int, needs_accel: bool, persisted: dict) -
     return {"metric": name, "error": live_error}
 
 
-def main() -> None:
-    if "--sync-smoke" in sys.argv:
-        # CI fault-injection smoke: deterministic drop+corrupt sequence
-        # through the real sync stack on CPU, one JSON line (see --smoke for
-        # why the platform pin must go through jax.config).
-        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
-        if forced:
-            import jax
+# CI smoke lanes: flag -> (bench config, options). One JSON line each; the
+# shared runner below replaces what used to be seven copy-pasted dispatch
+# blocks. Options: ``small`` seeds METRICS_TPU_BENCH_SMALL=1 (full-size lanes
+# like the serving plane's 1024-session acceptance scenario omit it);
+# ``cpu_devices`` forces N virtual CPU devices for mesh lanes (honored
+# because backends init lazily — see tests/conftest.py).
+_SMOKE_LANES = {
+    # telemetry smoke: one in-process engine exercise
+    "--smoke": ("bench_engine_compile_stats", {"small": True}),
+    # fault-injection: deterministic drop+corrupt through the real sync stack
+    "--sync-smoke": ("bench_sync_resilience", {}),
+    # wire codecs: exactness, bounds, bytes-on-wire, 8-device hierarchy gate
+    "--quant-smoke": ("bench_sync_quantized", {"cpu_devices": 8}),
+    # screening policies: quarantine/mask counts, determinism, overhead
+    "--health-smoke": ("bench_health_screening", {"small": True}),
+    # bus parity, disabled-path overhead, JSONL schema round-trip
+    "--obs-smoke": ("bench_obs_smoke", {"small": True}),
+    # scan-fused epoch vs per-step loop, async coalesced fetch
+    "--driver-smoke": ("bench_eval_driver", {"small": True}),
+    # banked multi-tenant dispatch: amortization, bit-identity, determinism
+    "--serving-smoke": ("bench_serving_plane", {}),
+    # AOT warmup manifests: cold-start->first-result with/without manifest
+    "--warmup-smoke": ("bench_cold_start", {}),
+}
 
-            jax.config.update("jax_platforms", forced)
-        result = bench_sync_resilience()
-        for key, value in _stamp().items():
-            result.setdefault(key, value)
-        emit(result)
-        return
 
-    if "--quant-smoke" in sys.argv:
-        # CI quantized-sync smoke: wire codecs through the real 2-rank KV
-        # exchange on CPU — exactness, bounds, bytes-on-wire reduction, and
-        # the 8-device hierarchical integer psum gate. The mesh needs 8
-        # virtual CPU devices; XLA_FLAGS is honored because backends init
-        # lazily (see tests/conftest.py).
+def _run_smoke(config: str, opts: dict) -> None:
+    """Run one CI smoke lane in-process and emit its JSON line. The env
+    pre-imports jax (axon sitecustomize), so a JAX_PLATFORMS pin must go
+    through jax.config, like tests/conftest.py does."""
+    if opts.get("cpu_devices"):
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
-        if forced:
-            import jax
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={opts['cpu_devices']}"
+            ).strip()
+    forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
+    if forced:
+        import jax
 
-            jax.config.update("jax_platforms", forced)
-        result = bench_sync_quantized()
-        for key, value in _stamp().items():
-            result.setdefault(key, value)
-        emit(result)
-        return
-
-    if "--health-smoke" in sys.argv:
-        # CI numerical-health smoke: clean-then-contaminated stream through a
-        # collection under each policy, one JSON line (platform pin through
-        # jax.config — see --smoke for why).
-        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
-        if forced:
-            import jax
-
-            jax.config.update("jax_platforms", forced)
+        jax.config.update("jax_platforms", forced)
+    if opts.get("small"):
         os.environ.setdefault("METRICS_TPU_BENCH_SMALL", "1")
-        result = bench_health_screening()
-        for key, value in _stamp().items():
-            result.setdefault(key, value)
-        emit(result)
+    result = globals()[config]()
+    for key, value in _stamp().items():
+        result.setdefault(key, value)
+    emit(result)
+
+
+def main() -> None:
+    if "--list" in sys.argv:
+        # enumerate what this suite can run: driver configs (subprocess
+        # isolation, timeouts, fallbacks) and CI smoke lanes (in-process)
+        print("configs (bench.py, or METRICS_TPU_BENCH_CONFIG=<name>):")
+        for name, timeout_s, needs_accel in (_HEADLINE_CONFIG,) + tuple(_CONFIGS):
+            print(f"  {name:<28} timeout={timeout_s}s accel={needs_accel}")
+        print("smoke lanes (bench.py <flag>, one JSON line each):")
+        for flag, (config, opts) in _SMOKE_LANES.items():
+            extras = ", ".join(f"{k}={v}" for k, v in opts.items()) or "-"
+            print(f"  {flag:<28} -> {config} ({extras})")
         return
 
-    if "--obs-smoke" in sys.argv:
-        # CI observability smoke: bus on/off compile parity, disabled-path
-        # guard overhead, fault-injection JSONL schema round-trip, one JSON
-        # line (platform pin through jax.config — see --smoke for why).
-        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
-        if forced:
-            import jax
-
-            jax.config.update("jax_platforms", forced)
-        os.environ.setdefault("METRICS_TPU_BENCH_SMALL", "1")
-        result = bench_obs_smoke()
-        for key, value in _stamp().items():
-            result.setdefault(key, value)
-        emit(result)
-        return
-
-    if "--driver-smoke" in sys.argv:
-        # CI eval-driver smoke: scan-fused epoch vs per-step loop speedup,
-        # bit-identity, one coalesced transfer per compute_async resolve —
-        # one JSON line (platform pin through jax.config — see --smoke for
-        # why).
-        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
-        if forced:
-            import jax
-
-            jax.config.update("jax_platforms", forced)
-        os.environ.setdefault("METRICS_TPU_BENCH_SMALL", "1")
-        result = bench_eval_driver()
-        for key, value in _stamp().items():
-            result.setdefault(key, value)
-        emit(result)
-        return
-
-    if "--serving-smoke" in sys.argv:
-        # CI serving-plane smoke: banked vs per-instance launch amortization,
-        # per-tenant bit-identity, eviction determinism — one JSON line
-        # (platform pin through jax.config — see --smoke for why). NOT run
-        # under the small lane: the acceptance scenario is 1024 sessions.
-        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
-        if forced:
-            import jax
-
-            jax.config.update("jax_platforms", forced)
-        result = bench_serving_plane()
-        for key, value in _stamp().items():
-            result.setdefault(key, value)
-        emit(result)
-        return
-
-    if "--smoke" in sys.argv:
-        # CI telemetry smoke: one in-process engine exercise, one JSON line.
-        # The env pre-imports jax (axon sitecustomize), so a JAX_PLATFORMS
-        # pin must go through jax.config, like tests/conftest.py does.
-        forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
-        if forced:
-            import jax
-
-            jax.config.update("jax_platforms", forced)
-        os.environ.setdefault("METRICS_TPU_BENCH_SMALL", "1")
-        result = bench_engine_compile_stats()
-        for key, value in _stamp().items():
-            result.setdefault(key, value)
-        emit(result)
-        return
+    for flag, (config, opts) in _SMOKE_LANES.items():
+        if flag in sys.argv:
+            _run_smoke(config, opts)
+            return
 
     single = os.environ.get("METRICS_TPU_BENCH_CONFIG")
     if single:  # child mode: run exactly one config
@@ -2252,7 +2342,7 @@ def main() -> None:
     # emitted LAST on stdout (the driver parses the final line) — but
     # recorded in the summary file IMMEDIATELY, so a mid-loop wedge or kill
     # can't lose it
-    head = _run_config("bench_headline", 1200, True, persisted)
+    head = _run_config(*_HEADLINE_CONFIG, persisted)
     if head.get("metric") == "bench_headline":  # error fallback: keep the
         head["metric"] = HEADLINE_METRIC  # driver-parsed headline name stable
     _record(head)

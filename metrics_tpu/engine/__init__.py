@@ -65,3 +65,16 @@ from metrics_tpu.engine.driver import (  # noqa: F401
     fetch_stats,
     reset_fetch_stats,
 )
+from metrics_tpu.engine import warmup as _warmup
+from metrics_tpu.engine.warmup import (  # noqa: F401
+    load_manifest,
+    record_manifest,
+    save_manifest,
+    warmup,
+    warmup_report,
+)
+
+# NOTE: the METRICS_TPU_WARMUP_MANIFEST auto-wiring is triggered from the
+# END of ``metrics_tpu/__init__`` (not here): warming a manifest unpickles
+# metric templates, which imports metric subpackages — impossible while the
+# root package is still half-initialized under this module's import.

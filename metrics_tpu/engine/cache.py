@@ -45,7 +45,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import sys as _sys
+
 from metrics_tpu.engine import bucketing
+import metrics_tpu.engine.warmup  # noqa: F401 — module bound below by path
+
+# resolved through sys.modules, NOT package attribute lookup: engine/__init__
+# later rebinds the package attribute `warmup` to the warmup() FUNCTION, and
+# this module needs the submodule regardless of import order
+_warmup = _sys.modules["metrics_tpu.engine.warmup"]
 from metrics_tpu.obs import bus as _bus
 from metrics_tpu.obs import explain as _explain
 from metrics_tpu.resilience import health as _health
@@ -294,6 +302,13 @@ class SharedEntry:
         # (metrics_tpu.obs.explain) — populated only while the event bus is
         # recording, scoped to the entry so eviction forgets history with it
         self._obs_sigs: Dict[str, Dict[str, Any]] = {}
+        # AOT warmup (metrics_tpu.engine.warmup): executables pre-compiled
+        # from a manifest, keyed (variant, dispatch_key) — consulted before
+        # the jit path so a cold worker's first covered request never
+        # compiles; _warm_covered holds the manifest's promised signatures
+        # per base variant for serve-time staleness detection
+        self._warm: Dict[Tuple[str, Tuple], Callable] = {}
+        self._warm_covered: Dict[str, List[Dict[str, Any]]] = {}
         # the calling instance/member-list is bound per call and read by the
         # traced body — thread-LOCAL so concurrent dispatches through one
         # shared entry neither serialize nor trace against another thread's
@@ -338,11 +353,14 @@ class SharedEntry:
         base_variant = variant.replace("_nodonate", "")
         before_variant = self._variant_traces.get(base_variant, 0)
         # observability context is captured up front (the cell is cleared in
-        # the finally below) and ONLY while the bus records — the disabled
-        # path pays a single bool read
+        # the finally below) — while the bus records, and also while this
+        # entry carries manifest coverage (stale detection needs the
+        # screening flags even with the bus off); the common disabled path
+        # pays one bool read and one empty-dict truth test
         obs_on = _bus.enabled()
+        stale_watch = bool(self._warm_covered)
         obs_source = obs_screening = None
-        if obs_on:
+        if obs_on or stale_watch:
             if self.kind in ("metric_update", "bank_update"):
                 # both kinds bind ONE metric instance as the cell (a bank's
                 # cell is its template); fused/driver kinds bind member lists
@@ -357,16 +375,51 @@ class SharedEntry:
                 obs_screening = tuple(
                     (type(m).__name__, getattr(m, "on_bad_input", "propagate")) for m in cell
                 )
+        # a manifest-warmed entry serves covered signatures from pre-seeded
+        # executables: the jit call path would re-COMPILE (its trace cache is
+        # shared with warmup's lower(), its executable cache is not)
+        warm_fn = warm_key = None
+        if self._warm:
+            try:
+                warm_key = (variant, _warmup.dispatch_key(fn_args))
+                warm_fn = self._warm.get(warm_key)
+            except Exception:  # noqa: BLE001 — unkeyable dispatch: jit path
+                warm_fn = warm_key = None
         try:
             try:
-                out = self._fns[variant](*fn_args)
+                out = (warm_fn or self._fns[variant])(*fn_args)
             except Exception as err:  # noqa: BLE001 — donation probe, re-raised below
-                if not (self.donate and _looks_like_donation_failure(err)):
+                if self.donate and _looks_like_donation_failure(err):
+                    with self._counter_lock:
+                        self.donate = False
+                        self._build(False)
+                        # donating warm executables alias their inputs; the
+                        # rebuilt entry must not serve them again
+                        self._warm.clear()
+                        warm_fn = None
+                    out = self._fns[variant](*fn_args)
+                elif warm_fn is not None:
+                    # a pre-seeded executable rejected the call (device or
+                    # sharding drift the dispatch key cannot see): drop it
+                    # and retry through jit — with the same donation-
+                    # rejection recovery the primary path gets. If the warm
+                    # call was donating and already consumed the state, the
+                    # retry surfaces the deleted-array error — same caveat
+                    # as the donation retry above.
+                    self._warm.pop(warm_key, None)
+                    warm_fn = None
+                    try:
+                        out = self._fns[variant](*fn_args)
+                    except Exception as err2:  # noqa: BLE001 — donation probe
+                        if not (self.donate and _looks_like_donation_failure(err2)):
+                            raise
+                        with self._counter_lock:
+                            self.donate = False
+                            self._build(False)
+                            self._warm.clear()
+                        out = self._fns[variant](*fn_args)
+                else:
                     raise
-                with self._counter_lock:
-                    self.donate = False
-                    self._build(False)
-                out = self._fns[variant](*fn_args)
         finally:
             self.cell = None
         with self._counter_lock:
@@ -394,11 +447,44 @@ class SharedEntry:
                 self.bucketed_calls += 1
                 if stats is not None:
                     stats["bucketed_calls"] += 1
+            if warm_fn is not None:
+                _warmup.count_warm_hit()
+            if delta and stale_watch and base_variant in self._warm_covered:
+                # a serve-time trace on a manifest-covered family: the
+                # manifest went stale — name the changed cache-key component
+                _warmup.note_stale(
+                    self,
+                    base_variant,
+                    self._dispatch_signature(variant, fn_args, obs_screening),
+                    obs_source,
+                )
             if obs_on:
                 self._obs_after_dispatch(
                     variant, base_variant, before_variant, delta, obs_source, obs_screening, fn_args
                 )
-            return out
+        if _warmup.recording():
+            try:
+                _warmup.record_dispatch(self, variant, cell, fn_args)
+            except Exception:  # noqa: BLE001 — recording must never break serving
+                pass
+        return out
+
+    def _dispatch_signature(self, variant: str, fn_args: Tuple, screening: Tuple) -> Dict[str, Any]:
+        """Explainer-style signature of one dispatch — shared by the retrace
+        explainer and the warmup staleness check (``engine/warmup.py`` builds
+        the SAME signature from a manifest's decoded avals, so the stale diff
+        compares like with like)."""
+        bucket = None
+        if variant.startswith("bucketed") and len(fn_args) >= 5 and fn_args[4]:
+            padded = fn_args[1]
+            bucket = int(padded[fn_args[4][0]].shape[0])
+        leaves = jax.tree_util.tree_leaves(fn_args[0]) + jax.tree_util.tree_leaves(fn_args[1:3])
+        return _explain.signature(
+            leaves,
+            bucket=bucket,
+            donate=self.donate and not variant.endswith("_nodonate"),
+            screening=screening,
+        )
 
     def _obs_after_dispatch(
         self,
@@ -417,17 +503,7 @@ class SharedEntry:
         if delta == 0:
             _bus.emit("cache_hit", source=source, entry_kind=self.kind, variant=base_variant)
             return
-        bucket = None
-        if variant.startswith("bucketed") and len(fn_args) >= 5 and fn_args[4]:
-            padded = fn_args[1]
-            bucket = int(padded[fn_args[4][0]].shape[0])
-        leaves = jax.tree_util.tree_leaves(fn_args[0]) + jax.tree_util.tree_leaves(fn_args[1:3])
-        sig = _explain.signature(
-            leaves,
-            bucket=bucket,
-            donate=self.donate and not variant.endswith("_nodonate"),
-            screening=screening,
-        )
+        sig = self._dispatch_signature(variant, fn_args, screening)
         is_retrace = before_variant > 0
         explanation = _explain.record_and_explain(self._obs_sigs, base_variant, sig, is_retrace)
         if is_retrace:
@@ -454,6 +530,7 @@ class SharedEntry:
             "donated_bytes": self.donated_bytes,
             "bucketed_calls": self.bucketed_calls,
             "donate": self.donate,
+            "warmed_programs": len(self._warm),
         }
 
 
@@ -518,6 +595,7 @@ def _make_metric_entry(key: Any, pins: Tuple) -> SharedEntry:
 
 def _make_fused_entry(kind: str, keys: Tuple[str, ...], cache_key: Any, pins: Tuple) -> SharedEntry:
     entry = SharedEntry(cache_key, kind, pins)
+    entry._member_names = keys  # read by the warmup recorder (manifest meta)
     entry.donate = donation_enabled() and kind in ("fused_update", "fused_forward")
 
     # member updates run through the health-screened transition; each
@@ -794,6 +872,13 @@ def _make_driver_entry(
     merged back in) so a full sharded eval epoch is one XLA launch.
     """
     entry = SharedEntry(cache_key, "driver", pins)
+    # warmup-recorder meta: local (no mesh/axis) driver programs can ride a
+    # manifest; mesh-bound ones are skipped (a Mesh handle cannot ride JSON)
+    entry._member_names = keys
+    entry._compute_keys = compute_keys
+    entry._axis_name = axis_name
+    entry._mesh = mesh
+    entry._hierarchical = hierarchical
     # mesh variants scan from the defaults and merge the (replicated) prior
     # state AFTER the in-trace sync — donating the prior would consume the
     # caller's live accumulation, so donation is local-variant only
@@ -992,7 +1077,7 @@ def cache_summary() -> Dict[str, Any]:
     with _LOCK:
         entries = list(_CACHE.values())
     by_kind: Dict[str, Dict[str, int]] = {}
-    totals = {"calls": 0, "compiles": 0, "cache_hits": 0, "retraces": 0, "donated_bytes": 0, "bucketed_calls": 0}
+    totals = {"calls": 0, "compiles": 0, "cache_hits": 0, "retraces": 0, "donated_bytes": 0, "bucketed_calls": 0, "warmed_programs": 0}
     for e in entries:
         s = e.summary()
         kind = by_kind.setdefault(s["kind"], {"entries": 0, **{k: 0 for k in totals}})

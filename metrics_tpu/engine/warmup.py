@@ -1,0 +1,1050 @@
+"""AOT warmup manifests: zero-cold-start serving workers.
+
+The persistent compile cache (``engine/persist.py``) turned a restarted
+worker's recompiles into disk loads — but a cold worker still pays full
+trace+lower+(disk-load) latency on the FIRST request of every signature it
+serves, and that tail dominates restart blast radius in a serving fleet.
+This module closes the loop the ROADMAP names: record what a deployment
+*actually serves*, and ahead-of-time compile the whole set at worker start.
+
+Three phases, composable with the persistent cache:
+
+* **Record (staging).** :func:`record_manifest` turns on a process-wide
+  recorder; every dispatch through the engine's shared cache
+  (``engine/cache.py`` — per-metric, fused-collection, driver, and
+  multi-tenant bank programs) contributes its program signature: entry kind,
+  a process-stable config digest, the dispatch variant, and the full
+  argument avals (shapes, dtypes, **weak_type** — the promotion that causes
+  the classic same-shape second trace), pow2 bucket, donation mode, and
+  screening flags. :func:`save_manifest` writes the de-duplicated set as a
+  versioned JSON manifest; each entry also embeds a compressed pickle of a
+  reset template clone so a later worker can reconstruct the program without
+  the recording process's live objects.
+
+* **Warm (worker start).** :func:`warmup` reads a manifest, rebuilds each
+  entry in the process-wide cache under the IDENTICAL key a live dispatch
+  would use (``metric_fingerprint`` / ``bank_entry`` / ``fused_entry`` /
+  ``driver_entry``), reconstructs abstract avals per recorded program, and
+  runs ``jit(...).lower(avals).compile()`` — XLA compilation (or a
+  persistent-cache disk load, counted as ``persistent_hit``) happens HERE,
+  before the first request. The compiled executables are seeded onto the
+  cache entries (``SharedEntry._warm``), and dispatch consults that store
+  first — so the first request of a covered signature runs at steady-state
+  latency even with a cold disk cache.
+
+* **Detect staleness (serving).** Warmup also seeds the explainer-style
+  signatures the manifest promised (``SharedEntry._warm_covered``). A
+  serve-time trace on a manifest-covered program family means the deployment
+  drifted from what was recorded: the engine emits a ``warmup_stale`` bus
+  event naming the changed cache-key component (avals / dtype / structure /
+  bucket / donation / screening — same vocabulary as the retrace explainer),
+  and :func:`warmup_report` (embedded in ``obs.snapshot()["warmup"]`` and
+  the ``metrics_tpu_warmup_*`` Prometheus gauges) counts them.
+
+Env wiring mirrors ``persist.py``: with ``METRICS_TPU_WARMUP_MANIFEST``
+set, the engine auto-wires at import — if the file exists the worker warms
+from it; if not, recording starts and the manifest is saved at process exit.
+So the whole staging → ship → warm loop needs zero code changes.
+
+Caveats (documented, counted, never silent): programs whose config pins
+id-keyed objects (custom callables) share by identity, which a fresh process
+cannot reproduce — their entries record but warm under a fresh key only the
+warmed template sees; mesh/axis-bound driver programs are skipped (a mesh is
+not serializable); dispatch through a warm executable bypasses jax's C++
+jit fastpath, costing a few extra microseconds of host dispatch per call —
+irrelevant against the multi-ms first-compile it replaces.
+"""
+import base64
+import functools
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.obs import explain as _explain
+from metrics_tpu.obs.warn import warn_once as _warn_once
+
+__all__ = [
+    "ENV_VAR",
+    "MANIFEST_VERSION",
+    "load_manifest",
+    "record_manifest",
+    "recording",
+    "reset_warmup_state",
+    "save_manifest",
+    "stop_recording",
+    "warmup",
+    "warmup_report",
+]
+
+ENV_VAR = "METRICS_TPU_WARMUP_MANIFEST"
+MANIFEST_VERSION = 1
+
+#: Entry kinds a manifest can cover. Driver entries are recorded only for
+#: local (no mesh / no axis_name) epochs: a Mesh handle cannot ride JSON.
+WARMABLE_KINDS = (
+    "metric_update",
+    "bank_update",
+    "fused_update",
+    "fused_forward",
+    "fused_compute",
+    "driver",
+)
+
+_LOCK = threading.RLock()
+
+# recorder state: entries keyed by (kind, digest); each holds the reset
+# template clone (pickled lazily at save) and the de-duplicated program set
+_REC: Dict[str, Any] = {
+    "recording": False,
+    "path": None,
+    "entries": {},  # (kind, digest) -> entry record
+    "programs": 0,
+    "unrecordable": {},  # reason -> count
+}
+
+_MAX_STALE_EVENTS = 32
+
+# warm/serve state: what warmup() loaded + what happened since. The
+# ``seen_*`` sets de-duplicate across repeated warmup() calls (the per-bank
+# ``MetricBank.warmup`` pattern re-reads one manifest many times — counters
+# must describe the manifest, not the call count).
+_WARM: Dict[str, Any] = {
+    "loaded": False,
+    "path": None,
+    "manifest_entries": 0,
+    "manifest_programs": 0,
+    "entries_warmed": 0,
+    "programs_warmed": 0,
+    "programs_failed": 0,
+    "skipped": {},  # reason -> count
+    "errors": [],  # bounded [(source, variant, repr(err))]
+    "warmed_hits": 0,
+    "stale_total": 0,
+    "stale": [],  # bounded explain records
+    "seen_entries": set(),  # (kind, digest) counted in manifest_entries
+    "seen_programs": set(),  # (kind, digest, sha) counted in manifest_programs
+    "counted_warmed": set(),  # (kind, digest) counted in entries_warmed
+}
+
+
+class _Unrecordable(Exception):
+    """A dispatch whose arguments cannot ride a JSON manifest."""
+
+
+# ---------------------------------------------------------------------------
+# stable config digests (cross-process identity)
+# ---------------------------------------------------------------------------
+def _stable_token(value: Any) -> Tuple:
+    """A process-stable stand-in for ``cache._attr_token``: id-pinned objects
+    degrade to their type name. Two configs differing only in the identity
+    of a pinned object share a digest — the warm compile still runs against
+    the manifest's own template, and a mismatched live instance simply
+    misses the warm store (caught by ``warmup_stale``, never wrong)."""
+    from metrics_tpu.engine import cache as _cache
+
+    token = _cache._attr_token(value, [])
+    if token[0] == "id":
+        return ("obj", type(value).__name__)
+    return token
+
+
+def stable_digest(metric: Any) -> str:
+    """Process-stable hex digest of one metric's program identity: class
+    path, jit-relevant config, and state spec — the serializable twin of
+    ``engine.cache.metric_fingerprint``."""
+    from metrics_tpu.engine import cache as _cache
+
+    cls = type(metric)
+    cfg = tuple(
+        (name, _stable_token(metric.__dict__[name]))
+        for name in sorted(metric.__dict__)
+        if not name.startswith("_")
+        and name not in metric._defaults
+        and name not in _cache._FP_SKIP
+    )
+    state_spec: List[Tuple] = []
+    for name in metric._defaults:
+        default = metric._defaults[name]
+        fx = metric._reductions[name]
+        fx_token = fx if (fx is None or isinstance(fx, str)) else ("obj", type(fx).__name__)
+        if isinstance(default, list):
+            state_spec.append((name, "list", fx_token))
+        else:
+            a = np.asarray(default)
+            state_spec.append(
+                (name, a.dtype.str, a.shape, hashlib.sha1(a.tobytes()).hexdigest(), fx_token)
+            )
+    payload = (f"{cls.__module__}.{cls.__qualname__}", cfg, tuple(state_spec))
+    return hashlib.sha1(repr(payload).encode()).hexdigest()
+
+
+def _entry_digest(kind: str, cell: Any, meta: Dict[str, Any]) -> str:
+    """Digest for one cache entry: a bare metric for ``metric_update`` /
+    ``bank_update``, the ordered member set (plus kind meta) for fused and
+    driver programs."""
+    if kind in ("metric_update", "bank_update"):
+        return stable_digest(cell)
+    members = list(cell)
+    payload = (
+        kind,
+        tuple(meta.get("keys", ())),
+        tuple(stable_digest(m) for m in members),
+        tuple(meta.get("compute_keys", ())),
+        bool(meta.get("hierarchical", False)),
+    )
+    return hashlib.sha1(repr(payload).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# argument (de)serialization
+# ---------------------------------------------------------------------------
+_PY_KINDS = {"int": int, "float": float, "bool": bool, "str": str}
+
+
+def _is_treedef(x: Any) -> bool:
+    return type(x).__name__ == "PyTreeDef"
+
+
+def _encode_obj(obj: Any) -> Any:
+    """One dispatch argument -> JSON spec. Array-ish leaves become aval
+    descriptors (shape, dtype, weak_type); python scalars keep their literal
+    value (jit treats them as weak dynamic scalars — the value re-traces
+    nothing, but static-argnum positions need it exactly); containers
+    recurse; treedefs serialize through their container skeleton."""
+    if obj is None:
+        return {"n": 1}
+    if isinstance(obj, bool):  # before int: bool is an int subclass
+        return {"p": ["bool", obj]}
+    if isinstance(obj, (int, float, str)):
+        return {"p": [type(obj).__name__, obj]}
+    shape = getattr(obj, "shape", None)
+    dtype = getattr(obj, "dtype", None)
+    if shape is not None and dtype is not None:
+        return {
+            "a": [list(int(s) for s in shape), str(dtype), bool(getattr(obj, "weak_type", False))]
+        }
+    if isinstance(obj, tuple):
+        return {"t": [_encode_obj(x) for x in obj]}
+    if isinstance(obj, list):
+        return {"l": [_encode_obj(x) for x in obj]}
+    if isinstance(obj, dict):
+        if not all(isinstance(k, str) for k in obj):
+            raise _Unrecordable("dict with non-string keys")
+        return {"d": {k: _encode_obj(v) for k, v in obj.items()}}
+    if _is_treedef(obj):
+        import jax
+
+        sentinel = object()
+        try:
+            skeleton = jax.tree_util.tree_unflatten(obj, [sentinel] * obj.num_leaves)
+        except Exception as err:  # noqa: BLE001 — custom nodes: honest skip
+            raise _Unrecordable(f"unserializable treedef: {err}") from err
+        return {"td": _encode_skeleton(skeleton, sentinel)}
+    raise _Unrecordable(f"argument of type {type(obj).__name__}")
+
+
+def _encode_skeleton(obj: Any, sentinel: Any) -> Any:
+    if obj is sentinel:
+        return {"ph": 1}
+    if obj is None:
+        return {"n": 1}
+    if isinstance(obj, tuple):
+        return {"t": [_encode_skeleton(x, sentinel) for x in obj]}
+    if isinstance(obj, list):
+        return {"l": [_encode_skeleton(x, sentinel) for x in obj]}
+    if isinstance(obj, dict):
+        if not all(isinstance(k, str) for k in obj):
+            raise _Unrecordable("treedef dict with non-string keys")
+        return {"d": {k: _encode_skeleton(v, sentinel) for k, v in obj.items()}}
+    raise _Unrecordable(f"treedef node of type {type(obj).__name__}")
+
+
+class _Leaf:
+    """Placeholder leaf for treedef reconstruction (unregistered => leaf)."""
+
+
+def _decode_obj(spec: Dict[str, Any]) -> Any:
+    """JSON spec -> the object handed to ``jit.lower``: ShapeDtypeStructs
+    for array avals, literal scalars, rebuilt containers and treedefs."""
+    import jax
+
+    if "n" in spec:
+        return None
+    if "p" in spec:
+        kind, value = spec["p"]
+        return _PY_KINDS[kind](value)
+    if "a" in spec:
+        shape, dtype, weak = spec["a"]
+        return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype), weak_type=bool(weak))
+    if "t" in spec:
+        return tuple(_decode_obj(x) for x in spec["t"])
+    if "l" in spec:
+        return [_decode_obj(x) for x in spec["l"]]
+    if "d" in spec:
+        return {k: _decode_obj(v) for k, v in spec["d"].items()}
+    if "td" in spec:
+        skeleton = _decode_skeleton(spec["td"])
+        return jax.tree_util.tree_structure(skeleton)
+    raise ValueError(f"unknown manifest argument spec {spec!r}")
+
+
+def _decode_skeleton(spec: Dict[str, Any]) -> Any:
+    if "ph" in spec:
+        return _Leaf()
+    if "n" in spec:
+        return None
+    if "t" in spec:
+        return tuple(_decode_skeleton(x) for x in spec["t"])
+    if "l" in spec:
+        return [_decode_skeleton(x) for x in spec["l"]]
+    if "d" in spec:
+        return {k: _decode_skeleton(v) for k, v in spec["d"].items()}
+    raise ValueError(f"unknown treedef spec {spec!r}")
+
+
+def _describe_arg(x: Any) -> Tuple:
+    """Hashable description of one dispatch argument — THE key both sides of
+    the warm store compute: :func:`record_dispatch`/:func:`warmup` from the
+    manifest's decoded avals, ``SharedEntry.invoke`` from the concrete
+    arrays of a live dispatch. ShapeDtypeStruct and jax.Array describe
+    identically by construction."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("A", tuple(int(s) for s in shape), str(dtype), bool(getattr(x, "weak_type", False)))
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return ("P", type(x).__name__, x)
+    if isinstance(x, tuple):
+        return ("t",) + tuple(_describe_arg(v) for v in x)
+    if isinstance(x, list):
+        return ("l",) + tuple(_describe_arg(v) for v in x)
+    if isinstance(x, dict):
+        return ("d",) + tuple(sorted((k, _describe_arg(v)) for k, v in x.items()))
+    if _is_treedef(x):
+        return ("T", str(x))
+    return ("O", type(x).__name__)
+
+
+def dispatch_key(fn_args: Tuple[Any, ...]) -> Tuple:
+    """Signature key for one dispatch's full argument tuple."""
+    return tuple(_describe_arg(a) for a in fn_args)
+
+
+# the engine's static_argnums per (kind, variant) — a warm ``Compiled`` is
+# called WITHOUT its static arguments, so the store must know the split.
+# Kept in lockstep with the jit definitions in ``engine/cache.py``.
+_N_DYNAMIC = {
+    ("metric_update", "exact"): 3,
+    ("metric_update", "exact_nodonate"): 3,
+    ("metric_update", "bucketed"): 3,
+    ("metric_update", "bucketed_nodonate"): 3,
+    ("fused_update", "exact"): 3,
+    ("fused_update", "bucketed"): 3,
+    ("fused_forward", "exact"): 3,
+    ("fused_compute", "exact"): 1,
+    ("bank_update", "scatter"): 3,
+    ("bank_update", "scatter_pad"): 4,
+    ("bank_update", "dense"): 3,
+    ("bank_update", "dense_pad"): 4,
+    ("driver", "scan"): 2,
+    ("driver", "scan_pad"): 3,
+    ("driver", "scan_cmp"): 2,
+    ("driver", "scan_pad_cmp"): 3,
+}
+
+
+def _call_warm(compiled: Any, n_dynamic: int, *fn_args: Any) -> Any:
+    return compiled(*fn_args[:n_dynamic])
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+def recording() -> bool:
+    """Whether dispatches are being recorded (cheap hot-path guard)."""
+    return _REC["recording"]
+
+
+def record_manifest(path: Optional[str] = None) -> None:
+    """Start recording every engine dispatch's program signature.
+
+    ``path`` (or ``$METRICS_TPU_WARMUP_MANIFEST``) becomes the default
+    :func:`save_manifest` target. Recording accumulates across calls;
+    :func:`reset_warmup_state` clears it.
+    """
+    with _LOCK:
+        _REC["recording"] = True
+        if path or os.environ.get(ENV_VAR):
+            _REC["path"] = path or os.environ.get(ENV_VAR)
+
+
+def stop_recording() -> None:
+    with _LOCK:
+        _REC["recording"] = False
+
+
+def _count(store: Dict[str, int], reason: str) -> None:
+    store[reason] = store.get(reason, 0) + 1
+
+
+def record_dispatch(entry: Any, variant: str, cell: Any, fn_args: Tuple[Any, ...]) -> None:
+    """Record one successful dispatch into the in-memory manifest (called by
+    ``SharedEntry.invoke`` only while :func:`recording` is True). De-duped
+    per (entry, variant, argument signature), so steady-state traffic costs
+    one dict probe per dispatch."""
+    kind = entry.kind
+    if kind not in WARMABLE_KINDS:
+        return
+    if kind == "driver" and (
+        getattr(entry, "_axis_name", None) is not None or getattr(entry, "_mesh", None) is not None
+    ):
+        with _LOCK:
+            _count(_REC["unrecordable"], "driver_mesh_bound")
+        return
+    if variant.startswith("shard_"):
+        with _LOCK:
+            _count(_REC["unrecordable"], "sharded_variant")
+        return
+    try:
+        prog_key = (variant, dispatch_key(fn_args))
+    except Exception:  # noqa: BLE001 — an unkeyable dispatch is unrecordable
+        with _LOCK:
+            _count(_REC["unrecordable"], "unkeyable_arguments")
+        return
+    meta = _entry_meta(entry)
+    digest = entry.__dict__.get("_warm_digest")
+    if digest is None:
+        digest = _entry_digest(kind, cell, meta)
+        entry._warm_digest = digest
+    with _LOCK:
+        rec = _REC["entries"].get((kind, digest))
+        if rec is not None and prog_key in rec["seen"]:
+            return
+    # encode OUTSIDE the lock (sha1/clone work); worst case two racing
+    # dispatches both encode and one write wins — same signature either way
+    try:
+        specs = [_encode_obj(a) for a in fn_args]
+    except _Unrecordable as err:
+        with _LOCK:
+            _count(_REC["unrecordable"], str(err))
+        return
+    template = None
+    if rec is None:
+        template = _template_payload(kind, cell)
+    with _LOCK:
+        rec = _REC["entries"].get((kind, digest))
+        if rec is None:
+            rec = {
+                "kind": kind,
+                "digest": digest,
+                "source": _entry_source(kind, cell),
+                "meta": meta,
+                "template_obj": template,
+                "programs": {},
+                "seen": set(),
+            }
+            _REC["entries"][(kind, digest)] = rec
+        if prog_key in rec["seen"]:
+            return
+        rec["seen"].add(prog_key)
+        rec["programs"][prog_key] = {
+            "variant": variant,
+            "donate": bool(entry.donate and not variant.endswith("_nodonate")),
+            "args": specs,
+        }
+        _REC["programs"] += 1
+
+
+def _entry_meta(entry: Any) -> Dict[str, Any]:
+    meta: Dict[str, Any] = {}
+    names = getattr(entry, "_member_names", None)
+    if names is not None:
+        meta["keys"] = list(names)
+    if entry.kind == "driver":
+        meta["compute_keys"] = list(getattr(entry, "_compute_keys", ()))
+        meta["hierarchical"] = bool(getattr(entry, "_hierarchical", False))
+    return meta
+
+
+def _entry_source(kind: str, cell: Any) -> str:
+    if kind in ("metric_update", "bank_update"):
+        return type(cell).__name__
+    return "+".join(type(m).__name__ for m in cell)
+
+
+def _clone_reset(metric: Any) -> Any:
+    """Clone with the registered defaults swapped in first: on a first
+    dispatch the live state attributes still hold the trace's tracers
+    (``_update_impl`` restores concrete state after the engine returns), and
+    deep-copying a tracer is not a thing."""
+    saved = metric._snapshot_state()
+    metric._restore_state(metric.init_state())
+    try:
+        tpl = metric.clone()
+    finally:
+        metric._restore_state(saved)
+    tpl.reset()
+    return tpl
+
+
+def _template_payload(kind: str, cell: Any) -> Any:
+    """A reset clone of the dispatching instance(s) — the manifest's
+    reconstruction recipe. ``None`` when cloning fails (warmup then needs an
+    explicit template)."""
+    try:
+        if kind in ("metric_update", "bank_update"):
+            return _clone_reset(cell)
+        return [_clone_reset(m) for m in cell]
+    except Exception:  # noqa: BLE001 — no recipe, counted at save
+        return None
+
+
+def _pickle_template(obj: Any) -> Optional[str]:
+    if obj is None:
+        return None
+    try:
+        blob = pickle.dumps(obj, protocol=4)
+        return base64.b64encode(zlib.compress(blob)).decode("ascii")
+    except Exception:  # noqa: BLE001 — unpicklable template: manifest still useful
+        return None
+
+
+def _unpickle_template(blob: Optional[str]) -> Any:
+    if not blob:
+        return None
+    return pickle.loads(zlib.decompress(base64.b64decode(blob.encode("ascii"))))
+
+
+def save_manifest(path: Optional[str] = None) -> str:
+    """Write the recorded program set as a versioned JSON manifest (atomic
+    replace). Returns the resolved path."""
+    import jax
+
+    path = path or _REC["path"] or os.environ.get(ENV_VAR)
+    if not path:
+        raise ValueError(
+            "save_manifest needs a path: pass one, call record_manifest(path),"
+            f" or set {ENV_VAR}."
+        )
+    path = os.path.abspath(os.path.expanduser(path))
+    # snapshot entries AND their program lists under the lock: a serving
+    # thread can still be recording into rec["programs"] while an atexit or
+    # periodic save iterates (pickling alone stays outside the lock)
+    with _LOCK:
+        snap = [
+            {
+                "kind": rec["kind"],
+                "digest": rec["digest"],
+                "source": rec["source"],
+                "meta": dict(rec["meta"]),
+                "template_obj": rec["template_obj"],
+                "programs": list(rec["programs"].values()),
+            }
+            for rec in _REC["entries"].values()
+        ]
+    out_entries = []
+    for rec in snap:
+        out_entries.append(
+            {
+                "kind": rec["kind"],
+                "digest": rec["digest"],
+                "source": rec["source"],
+                "meta": rec["meta"],
+                "template": _pickle_template(rec["template_obj"]),
+                "programs": rec["programs"],
+            }
+        )
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — backend init failure: still save
+        backend = None
+    doc = {
+        "version": MANIFEST_VERSION,
+        "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "jax_version": jax.__version__,
+        # variant names are donation-dependent (exact vs exact_nodonate), so
+        # a manifest is a per-platform artifact: record where it came from
+        "backend": backend,
+        "entries": out_entries,
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def _validate_manifest(doc: Any, origin: str) -> Dict[str, Any]:
+    if not isinstance(doc, dict) or doc.get("version") != MANIFEST_VERSION:
+        version = doc.get("version") if isinstance(doc, dict) else type(doc).__name__
+        raise ValueError(
+            f"warmup manifest {origin} has version {version!r};"
+            f" this build speaks version {MANIFEST_VERSION}"
+        )
+    if not isinstance(doc.get("entries"), list):
+        raise ValueError(f"warmup manifest {origin} has no entry list")
+    return doc
+
+
+def load_manifest(path: str) -> Dict[str, Any]:
+    """Read and validate a manifest; raises ``ValueError`` on an unknown
+    version or a malformed document."""
+    with open(path) as f:
+        doc = json.load(f)
+    return _validate_manifest(doc, repr(path))
+
+
+# ---------------------------------------------------------------------------
+# warmup
+# ---------------------------------------------------------------------------
+def _template_candidates(templates: Optional[Iterable[Any]]) -> List[Any]:
+    """Live metric templates from explicitly-passed objects. Accepts
+    ``Metric`` instances and ``MetricBank``s (whose template covers both the
+    per-instance and the banked program family); fused/driver entries
+    reconstruct from the manifest's embedded recipe."""
+    out: List[Any] = []
+    for obj in templates or ():
+        tpl = getattr(obj, "_template", None)  # MetricBank duck-type
+        metric = tpl if tpl is not None else obj
+        if hasattr(metric, "_defaults"):
+            out.append(metric)
+    return out
+
+
+def _probe_args_from(rec: Dict[str, Any]) -> Optional[Tuple[Tuple, Dict]]:
+    """(args, kwargs) avals of one recorded program, for the python-init
+    probe — whichever variant layout the entry recorded first."""
+    import jax
+
+    for prog in rec.get("programs", ()):
+        variant = prog.get("variant", "")
+        try:
+            fa = tuple(_decode_obj(spec) for spec in prog["args"])
+            if rec["kind"] == "metric_update":
+                if variant.startswith("exact"):
+                    return fa[1], fa[2]
+                args, kwargs = jax.tree_util.tree_unflatten(fa[3], list(fa[1]))
+                return args, kwargs
+            # bank_update: leaves are stacked [R, ...] per request — strip
+            # the request axis so the probe sees one request's shapes
+            leaves = [
+                jax.ShapeDtypeStruct(x.shape[1:], x.dtype, weak_type=x.weak_type)
+                if hasattr(x, "shape") and len(x.shape) >= 1
+                else x
+                for x in fa[2]
+            ]
+            args, kwargs = jax.tree_util.tree_unflatten(fa[-1], leaves)
+            return args, kwargs
+        except Exception:  # noqa: BLE001 — try the next recorded program
+            continue
+    return None
+
+
+def _match_template(rec: Dict[str, Any], candidates: List[Any]) -> Optional[Any]:
+    """The explicit template matching one manifest entry, by config digest.
+
+    A fresh template may not digest-match yet: config attributes the update
+    body derives (``Accuracy.mode``) settle during the python-init probe,
+    which the recorder had already run before digesting. Replay that probe
+    abstractly on the entry's recorded avals and compare again.
+    """
+    if rec.get("kind") not in ("metric_update", "bank_update"):
+        return None
+    for metric in candidates:
+        if stable_digest(metric) == rec.get("digest"):
+            return metric
+    probe = _probe_args_from(rec)
+    if probe is None:
+        return None
+    from metrics_tpu.engine import cache as _cache
+
+    for metric in candidates:
+        # probe a CLONE: running the one-shot python-init against a foreign
+        # entry's avals would settle the caller's live template (and mark it
+        # probed) with inputs it may never serve — the clone either matches
+        # (and becomes the warm template) or is discarded
+        try:
+            clone = metric.clone()
+            _cache.ensure_python_init(clone, probe[0], probe[1])
+        except Exception:  # noqa: BLE001 — incompatible template: next
+            continue
+        if stable_digest(clone) == rec.get("digest"):
+            return clone
+    return None
+
+
+def _entry_for(kind: str, rec: Dict[str, Any], payload: Any) -> Tuple[Any, Any]:
+    """(cache entry, cell) for one manifest entry — created through the SAME
+    factories live dispatch uses, so the keys match exactly."""
+    from metrics_tpu.engine import cache as _cache
+
+    if kind == "metric_update":
+        key, pins = _cache.metric_fingerprint(payload)
+        entry = _cache._get_or_create(
+            ("metric_update", key), lambda: _cache._make_metric_entry(key, pins)
+        )
+        return entry, payload
+    if kind == "bank_update":
+        return _cache.bank_entry(payload), payload
+    keys = tuple(rec["meta"].get("keys", ()))
+    members = list(payload)
+    if len(keys) != len(members):
+        raise ValueError(f"manifest {kind} entry: {len(keys)} keys vs {len(members)} members")
+    if kind == "driver":
+        entry = _cache.driver_entry(
+            keys,
+            members,
+            compute_keys=tuple(rec["meta"].get("compute_keys", ())),
+            axis_name=None,
+            mesh=None,
+            hierarchical=bool(rec["meta"].get("hierarchical", False)),
+        )
+    else:
+        entry = _cache.fused_entry(kind, keys, members)
+    return entry, members
+
+
+def _covered_signature(entry: Any, variant: str, cell: Any, lower_args: Tuple[Any, ...]) -> Dict[str, Any]:
+    """The explainer-style signature this manifest program promises — built
+    by the SAME ``SharedEntry._dispatch_signature`` a live dispatch uses, so
+    a later stale diff compares like with like (ShapeDtypeStructs describe
+    identically to the concrete arrays they stand for)."""
+    return entry._dispatch_signature(variant, lower_args, _screening_of(entry, cell))
+
+
+def _screening_of(entry: Any, cell: Any) -> Tuple:
+    if entry.kind in ("metric_update", "bank_update"):
+        return (
+            getattr(cell, "on_bad_input", "propagate"),
+            getattr(cell, "health_screen", "nonfinite"),
+            getattr(cell, "jit_bucket", None),
+        )
+    return tuple((type(m).__name__, getattr(m, "on_bad_input", "propagate")) for m in cell)
+
+
+def _snapshot_cell(kind: str, cell: Any) -> List[Tuple[Any, Dict[str, Any]]]:
+    metrics = [cell] if kind in ("metric_update", "bank_update") else list(cell)
+    return [(m, m._snapshot_state()) for m in metrics]
+
+
+def warmup(manifest: Optional[Any] = None, templates: Optional[Iterable[Any]] = None) -> Dict[str, Any]:
+    """AOT-compile every program a manifest records, before the first request.
+
+    ``manifest`` is a path or an already-loaded dict (default:
+    ``$METRICS_TPU_WARMUP_MANIFEST``). ``templates`` optionally supplies
+    live ``Metric``/``MetricBank`` objects matched to manifest entries by
+    config digest — entries without a match fall back to the manifest's
+    embedded template recipe; entries with neither are counted as skipped.
+
+    Every warmed program lands in the process-wide cache under the identical
+    key a live dispatch computes, plus a pre-seeded executable
+    (``SharedEntry._warm``) the dispatcher consults first — with the
+    persistent compile cache enabled and warm, each ``compile()`` here is a
+    disk load counted as ``persistent_hit``. Returns :func:`warmup_report`.
+    """
+    if manifest is None:
+        manifest = os.environ.get(ENV_VAR)
+        if not manifest:
+            raise ValueError(f"warmup needs a manifest: pass a path/dict or set {ENV_VAR}.")
+    if isinstance(manifest, dict):
+        doc = _validate_manifest(manifest, "<dict>")
+        path = None
+    else:
+        doc = load_manifest(manifest)
+        path = manifest
+    candidates = _template_candidates(templates)
+    with _LOCK:
+        _WARM["loaded"] = True
+        if path:
+            _WARM["path"] = os.path.abspath(path)
+    for rec in doc["entries"]:
+        kind = rec.get("kind")
+        programs = rec.get("programs", ())
+        ekey = (kind, rec.get("digest"))
+        with _LOCK:
+            # de-duplicated manifest inventory: re-warming the same manifest
+            # (per-bank warmup, retries) must not inflate what it "carries"
+            if ekey not in _WARM["seen_entries"]:
+                _WARM["seen_entries"].add(ekey)
+                _WARM["manifest_entries"] += 1
+            for prog in programs:
+                pid = _prog_id(rec, prog)
+                if pid not in _WARM["seen_programs"]:
+                    _WARM["seen_programs"].add(pid)
+                    _WARM["manifest_programs"] += 1
+        if kind not in WARMABLE_KINDS:
+            _skip("unknown_kind", len(programs))
+            continue
+        payload = _match_template(rec, candidates)
+        if payload is None:
+            try:
+                payload = _unpickle_template(rec.get("template"))
+            except Exception:  # noqa: BLE001 — a stale pickle must not kill warmup
+                payload = None
+        if payload is None:
+            _skip("no_template", len(programs))
+            continue
+        try:
+            entry, cell = _entry_for(kind, rec, payload)
+        except Exception:  # noqa: BLE001
+            _skip("entry_rebuild_failed", len(programs))
+            continue
+        entry._warm_digest = rec.get("digest")
+        warmed_any = False
+        for prog in programs:
+            if _warm_one(entry, cell, rec, prog):
+                warmed_any = True
+        if warmed_any:
+            with _LOCK:
+                if ekey not in _WARM["counted_warmed"]:
+                    _WARM["counted_warmed"].add(ekey)
+                    _WARM["entries_warmed"] += 1
+    if _bus.enabled():
+        # snapshot under the lock, emit OUTSIDE it: bus subscribers run
+        # synchronously and may dispatch metric updates, whose invoke path
+        # (note_stale/count_warm_hit) takes this module's lock under an
+        # entry's counter lock — emitting while holding _LOCK would invert
+        # that order (the same hazard PR 5 hardened AsyncResult against)
+        with _LOCK:
+            warmed = _WARM["programs_warmed"]
+            failed = _WARM["programs_failed"]
+            entries = _WARM["entries_warmed"]
+        _bus.emit(
+            "warmup",
+            source="engine",
+            event="complete",
+            programs_warmed=warmed,
+            programs_failed=failed,
+            entries_warmed=entries,
+        )
+    return warmup_report()
+
+
+def _prog_id(rec: Dict[str, Any], prog: Dict[str, Any]) -> Tuple:
+    blob = json.dumps([prog.get("variant"), prog.get("args")], sort_keys=True, default=str)
+    return (rec.get("kind"), rec.get("digest"), hashlib.sha1(blob.encode()).hexdigest())
+
+
+def _skip(reason: str, n: int) -> None:
+    with _LOCK:
+        _WARM["skipped"][reason] = _WARM["skipped"].get(reason, 0) + n
+
+
+def _warm_one(entry: Any, cell: Any, rec: Dict[str, Any], prog: Dict[str, Any]) -> bool:
+    variant = prog.get("variant", "")
+    base_variant = variant.replace("_nodonate", "")
+    n_dynamic = _N_DYNAMIC.get((entry.kind, variant))
+    fn = entry._fns.get(variant)
+    if n_dynamic is None or fn is None:
+        _skip("unknown_variant", 1)
+        return False
+    try:
+        lower_args = tuple(_decode_obj(spec) for spec in prog["args"])
+    except Exception as err:  # noqa: BLE001
+        _fail(rec, variant, err)
+        return False
+    key = (variant, dispatch_key(lower_args))
+    if key in entry._warm:
+        return True  # already warmed (idempotent re-warm)
+    saved = _snapshot_cell(entry.kind, cell)
+    entry.cell = cell
+    try:
+        # tracing may run each member's python update body against tracers —
+        # exactly what a first live trace does; compile() consults the
+        # persistent disk cache when one is enabled (counted persistent_hit)
+        compiled = fn.lower(*lower_args).compile()
+    except Exception as err:  # noqa: BLE001 — per-program: count, continue
+        _fail(rec, variant, err)
+        return False
+    finally:
+        entry.cell = None
+        for metric, state in saved:
+            metric._restore_state(state)
+    entry._warm[key] = functools.partial(_call_warm, compiled, n_dynamic)
+    try:
+        sig = _covered_signature(entry, variant, cell, lower_args)
+        entry._warm_covered.setdefault(base_variant, []).append(sig)
+    except Exception:  # noqa: BLE001 — staleness coverage is best-effort
+        pass
+    with _LOCK:
+        _WARM["programs_warmed"] += 1
+    if _bus.enabled():
+        _bus.emit(
+            "warmup",
+            source=rec.get("source", ""),
+            event="program",
+            entry_kind=entry.kind,
+            variant=base_variant,
+        )
+    return True
+
+
+def _fail(rec: Dict[str, Any], variant: str, err: Exception) -> None:
+    with _LOCK:
+        _WARM["programs_failed"] += 1
+        if len(_WARM["errors"]) < _MAX_STALE_EVENTS:
+            _WARM["errors"].append(
+                {"source": rec.get("source", ""), "variant": variant, "error": repr(err)[:200]}
+            )
+
+
+# ---------------------------------------------------------------------------
+# serve-time accounting (called by engine/cache.py)
+# ---------------------------------------------------------------------------
+def count_warm_hit() -> None:
+    with _LOCK:
+        _WARM["warmed_hits"] += 1
+
+
+def note_stale(
+    entry: Any, base_variant: str, sig: Dict[str, Any], source: str
+) -> Optional[Dict[str, Any]]:
+    """A live trace landed on a manifest-covered program family: diff the
+    dispatch signature against the closest covered signature, record the
+    named change, and emit a ``warmup_stale`` bus event (bus permitting).
+    Returns the explanation."""
+    covered = entry._warm_covered.get(base_variant, ())
+    best: Optional[Dict[str, Any]] = None
+    for promised in covered:
+        explanation = _explain.diff(promised, sig)
+        if best is None or len(explanation["changed"]) < len(best["changed"]):
+            best = explanation
+    if best is None:
+        best = {"changed": ["unknown"], "detail": "no covered signature recorded"}
+    record = {
+        "source": source,
+        "entry_kind": entry.kind,
+        "variant": base_variant,
+        "changed": list(best["changed"]),
+        "detail": best["detail"],
+    }
+    with _LOCK:
+        _WARM["stale_total"] += 1
+        if len(_WARM["stale"]) < _MAX_STALE_EVENTS:
+            _WARM["stale"].append(record)
+    if _bus.enabled():
+        _bus.emit(
+            "warmup_stale",
+            source=source,
+            entry_kind=entry.kind,
+            variant=base_variant,
+            explain=best,
+        )
+    _warn_once(
+        f"warmup manifest stale: {source} {entry.kind}/{base_variant} compiled at"
+        f" serve time ({best['detail']}). Re-record the manifest from current"
+        " traffic to restore zero-cold-start restarts.",
+        RuntimeWarning,
+        key=("warmup_stale", source, entry.kind, base_variant),
+    )
+    return best
+
+
+# ---------------------------------------------------------------------------
+# reporting / lifecycle
+# ---------------------------------------------------------------------------
+def warmup_report() -> Dict[str, Any]:
+    """One dict for the whole warmup surface — embedded in
+    ``obs.snapshot()["warmup"]`` and the ``metrics_tpu_warmup_*`` gauges.
+
+    ``manifest_*`` describe what :func:`warmup` loaded; ``programs_warmed``
+    / ``programs_failed`` / ``skipped`` its outcome; ``warmed_hits`` counts
+    dispatches served by a pre-seeded executable; ``stale_total`` +
+    ``stale`` name every serve-time compile on a manifest-covered family
+    (each entry carries the changed cache-key component); ``recording``
+    mirrors the recorder."""
+    with _LOCK:
+        return {
+            "manifest_loaded": _WARM["loaded"],
+            "manifest_path": _WARM["path"],
+            "manifest_entries": _WARM["manifest_entries"],
+            "manifest_programs": _WARM["manifest_programs"],
+            "entries_warmed": _WARM["entries_warmed"],
+            "programs_warmed": _WARM["programs_warmed"],
+            "programs_failed": _WARM["programs_failed"],
+            "skipped": dict(_WARM["skipped"]),
+            "errors": list(_WARM["errors"]),
+            "warmed_hits": _WARM["warmed_hits"],
+            "stale_total": _WARM["stale_total"],
+            "stale": [dict(s) for s in _WARM["stale"]],
+            "recording": {
+                "active": _REC["recording"],
+                "path": _REC["path"],
+                "entries": len(_REC["entries"]),
+                "programs": _REC["programs"],
+                "unrecordable": dict(_REC["unrecordable"]),
+            },
+        }
+
+
+def reset_warmup_state() -> None:
+    """Drop recorder contents and warm/serve counters (tests, fresh runs).
+    Pre-seeded executables on live cache entries are left alone —
+    ``engine.clear_cache()`` drops those with their entries."""
+    with _LOCK:
+        _REC["recording"] = False
+        _REC["path"] = None
+        _REC["entries"].clear()
+        _REC["programs"] = 0
+        _REC["unrecordable"].clear()
+        _WARM.update(
+            loaded=False,
+            path=None,
+            manifest_entries=0,
+            manifest_programs=0,
+            entries_warmed=0,
+            programs_warmed=0,
+            programs_failed=0,
+            warmed_hits=0,
+            stale_total=0,
+        )
+        _WARM["skipped"] = {}
+        _WARM["errors"] = []
+        _WARM["stale"] = []
+        _WARM["seen_entries"] = set()
+        _WARM["seen_programs"] = set()
+        _WARM["counted_warmed"] = set()
+
+
+def _save_at_exit() -> None:
+    try:
+        if _REC["recording"] and _REC["entries"] and _REC["path"]:
+            save_manifest()
+    except Exception:  # noqa: BLE001 — exit hooks must never raise
+        pass
+
+
+def _maybe_autowire_from_env() -> None:
+    """Import-time env wiring (called by ``metrics_tpu.engine``), mirroring
+    ``persist._maybe_enable_from_env``: with ``METRICS_TPU_WARMUP_MANIFEST``
+    set, an existing manifest warms the worker at import; a missing one
+    starts recording and saves at exit — the full staging → ship → warm
+    loop with zero code change. Failures degrade to a warning."""
+    path = os.environ.get(ENV_VAR)
+    if not path:
+        return
+    try:
+        if os.path.exists(path):
+            warmup(path)
+        else:
+            import atexit
+
+            record_manifest(path)
+            atexit.register(_save_at_exit)
+    except Exception as err:  # noqa: BLE001 — import-time: degrade, don't die
+        import warnings
+
+        warnings.warn(
+            f"{ENV_VAR} is set but warmup auto-wiring failed: {err}",
+            RuntimeWarning,
+            stacklevel=2,
+        )

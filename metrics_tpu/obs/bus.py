@@ -56,8 +56,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: (``metrics_tpu.serving``): ``admit`` (a tenant became device-resident in
 #: a ``MetricBank``), ``evict`` (a tenant left its slot — ``spilled`` says
 #: whether its state was kept on host), ``flush`` (one batched cross-tenant
-#: dispatch: ``requests`` updates in one XLA launch). Misc: ``warning`` (a
-#: ``warn_once`` emission).
+#: dispatch: ``requests`` updates in one XLA launch). AOT warmup
+#: (``metrics_tpu.engine.warmup``): ``warmup`` (a manifest program was
+#: AOT-compiled at worker start — ``event`` is ``program`` per executable,
+#: ``complete`` for the run summary), ``warmup_stale`` (a serve-time
+#: compile landed on a manifest-covered program family — carries the
+#: ``explain`` payload naming the changed cache-key component). Misc:
+#: ``warning`` (a ``warn_once`` emission).
 EVENT_KINDS = (
     "compile",
     "cache_hit",
@@ -77,6 +82,8 @@ EVENT_KINDS = (
     "admit",
     "evict",
     "flush",
+    "warmup",
+    "warmup_stale",
     "warning",
 )
 

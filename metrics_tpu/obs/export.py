@@ -50,6 +50,9 @@ def process_snapshot() -> Dict[str, Any]:
         # sync wire codecs (PR 8): bytes-on-wire raw vs encoded, per-codec
         # payload counts, max observed dequantization error
         "wire": _quantize.wire_stats(),
+        # AOT warmup manifests (engine/warmup.py): manifest load/record
+        # state, programs warmed, warm-store hits, staleness events
+        "warmup": _engine.warmup_report(),
         "bus": _bus.summary(),
         "spans": _trace.span_summary(),
         "warnings": {repr(k): v for k, v in _warn.warn_counts().items()},
@@ -228,6 +231,16 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
     for codec in sorted(wire["codec_counts"]):
         _sample("metrics_tpu_wire_payloads_total", wire["codec_counts"][codec], {"codec": codec})
     _sample("metrics_tpu_wire_max_dequant_error", wire["max_dequant_error"], kind="gauge")
+
+    # AOT warmup manifests: warmed program inventory + staleness counters
+    warm = _engine.warmup_report()
+    _sample("metrics_tpu_warmup_manifest_loaded", 1 if warm["manifest_loaded"] else 0, kind="gauge")
+    _sample("metrics_tpu_warmup_manifest_programs", warm["manifest_programs"], kind="gauge")
+    for key in ("entries_warmed", "programs_warmed", "programs_failed", "warmed_hits", "stale_total"):
+        _sample(f"metrics_tpu_warmup_{key}", warm[key])
+    rec = warm["recording"]
+    _sample("metrics_tpu_warmup_recording", 1 if rec["active"] else 0, kind="gauge")
+    _sample("metrics_tpu_warmup_recorded_programs", rec["programs"], kind="gauge")
 
     bus_summary = _bus.summary()
     for kind in sorted(bus_summary["by_kind"]):

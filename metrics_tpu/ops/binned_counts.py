@@ -26,7 +26,31 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from metrics_tpu.obs.warn import warn_once
+
 Array = jax.Array
+
+
+def _tracer_type() -> type:
+    """The Tracer base class, resolved once from its stable home.
+
+    ``jax.core.Tracer`` is a deprecated access path on current jax (moved
+    toward ``jax.extend.core``); probe the new home first so no deprecation
+    warning fires, and fall back through the older spellings."""
+    try:
+        from jax.extend import core as _xcore
+
+        if hasattr(_xcore, "Tracer"):
+            return _xcore.Tracer
+    except ImportError:
+        pass
+    try:
+        return jax._src.core.Tracer
+    except AttributeError:  # pragma: no cover - last resort on exotic builds
+        return jax.core.Tracer
+
+
+_TRACER = _tracer_type()
 
 # [BN, T] f32 intermediates must fit VMEM (~16 MB) several times over
 _BLOCK_N = 1024
@@ -101,7 +125,30 @@ def _binned_counts_xla(preds: Array, target: Array, thresholds: Array):
 
 def binned_stat_counts(preds: Array, target: Array, thresholds: Array, use_pallas: bool = False):
     """``(TP, FP, FN, TN)`` of shape ``[C, T]`` for ``preds/target [N, C]``
-    against ``thresholds [T]``."""
-    if use_pallas and jax.default_backend() == "tpu" and not isinstance(preds, jax.core.Tracer):
-        return _binned_counts_pallas(preds, target, thresholds)
+    against ``thresholds [T]``.
+
+    ``use_pallas=True`` routes through the TPU kernel only for CONCRETE
+    inputs on a TPU backend: under an outer ``jit`` (tracer inputs) the
+    kernel's own inner ``jax.jit`` cannot be entered, and off-TPU the Mosaic
+    kernel cannot lower — both fall back to the XLA formulation
+    (bit-identical results). The fallback warns once per cause so callers
+    know which path actually ran.
+    """
+    if use_pallas:
+        if jax.default_backend() != "tpu":
+            warn_once(
+                "binned_stat_counts(use_pallas=True) ran the XLA fallback:"
+                f" backend is {jax.default_backend()!r}, the Pallas kernel is"
+                " TPU-only.",
+                key=("binned_counts_pallas_fallback", "backend"),
+            )
+        elif isinstance(preds, _TRACER):
+            warn_once(
+                "binned_stat_counts(use_pallas=True) ran the XLA fallback:"
+                " inputs are tracers (called under jit/vmap/scan). Call it"
+                " outside the surrounding jit to use the Pallas kernel.",
+                key=("binned_counts_pallas_fallback", "tracer"),
+            )
+        else:
+            return _binned_counts_pallas(preds, target, thresholds)
     return _binned_counts_xla(preds, target, thresholds)

@@ -649,6 +649,25 @@ class MetricBank:
         return metric
 
     # ------------------------------------------------------------------
+    # zero-cold-start: AOT warmup from a recorded manifest
+    # ------------------------------------------------------------------
+    def warmup(self, manifest: Optional[Any] = None) -> Dict[str, Any]:
+        """AOT-compile the manifest-recorded programs before the first flush,
+        binding THIS bank's template to matching entries (fresher than the
+        manifest's embedded recipe — live config, this process's classes).
+
+        Sugar for ``engine.warmup(manifest, templates=[self])``: a worker
+        that builds its banks at startup calls this per bank (or one
+        ``engine.warmup(manifest, templates=all_banks())``) so the first
+        routed flush of every recorded request signature — including each
+        pow2 request bucket — dispatches through a pre-seeded executable
+        instead of compiling. See ``docs/serving.md`` (cold-start playbook).
+        """
+        from metrics_tpu import engine as _engine
+
+        return _engine.warmup(manifest, templates=[self])
+
+    # ------------------------------------------------------------------
     # distributed: banked states ride the existing sync path
     # ------------------------------------------------------------------
     def sync_state_in_trace(self, axis_name: Any, hierarchical: bool = False) -> None:

@@ -1,0 +1,449 @@
+"""AOT warmup manifests (``engine.warmup``): record/save/load round-trips,
+pre-seeded executable dispatch, staleness detection, persistent-cache
+interplay, and the (slow) fresh-subprocess cold-start round-trip."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu import engine, obs
+from metrics_tpu.serving import MetricBank
+
+# the module, not the same-named engine.warmup() entry point it exports
+import importlib
+
+wm = importlib.import_module("metrics_tpu.engine.warmup")
+
+NUM_CLASSES = 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_warmup_state():
+    wm.stop_recording()
+    wm.reset_warmup_state()
+    engine.clear_cache()
+    yield
+    wm.stop_recording()
+    wm.reset_warmup_state()
+    engine.clear_cache()
+
+
+def _batch(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.uniform(size=(n, NUM_CLASSES)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(n,)).astype(np.int32))
+    return preds, target
+
+
+def _record_accuracy(tmp_path, n_updates=2, **metric_kwargs):
+    path = str(tmp_path / "manifest.json")
+    wm.record_manifest(path)
+    m = mt.Accuracy(num_classes=NUM_CLASSES, **metric_kwargs)
+    preds, target = _batch()
+    for _ in range(n_updates):
+        m.update(preds, target)
+    saved = wm.save_manifest()
+    wm.stop_recording()
+    return m, saved
+
+
+# ---------------------------------------------------------------------------
+# recording + manifest round-trip
+# ---------------------------------------------------------------------------
+def test_record_save_load_round_trip(tmp_path):
+    _, path = _record_accuracy(tmp_path)
+    doc = wm.load_manifest(path)
+    assert doc["version"] == wm.MANIFEST_VERSION
+    kinds = {e["kind"] for e in doc["entries"]}
+    assert "metric_update" in kinds
+    entry = next(e for e in doc["entries"] if e["kind"] == "metric_update")
+    assert entry["source"] == "Accuracy"
+    assert entry["template"]  # embedded reconstruction recipe
+    assert entry["programs"], "no program signatures recorded"
+    # recording is de-duplicated: identical dispatches record one program
+    variants = [p["variant"] for p in entry["programs"]]
+    assert len(variants) == len(set((v, json.dumps(p["args"])) for v, p in zip(variants, entry["programs"])))
+
+
+def test_load_rejects_unknown_version(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({"version": 99, "entries": []}))
+    with pytest.raises(ValueError, match="version"):
+        wm.load_manifest(str(path))
+
+
+def test_save_needs_a_path(monkeypatch):
+    monkeypatch.delenv(wm.ENV_VAR, raising=False)
+    wm.record_manifest()
+    with pytest.raises(ValueError, match=wm.ENV_VAR):
+        wm.save_manifest()
+
+
+def test_recording_off_by_default_and_costs_nothing(tmp_path):
+    m = mt.Accuracy(num_classes=NUM_CLASSES)
+    m.update(*_batch())
+    assert wm.warmup_report()["recording"]["programs"] == 0
+
+
+# ---------------------------------------------------------------------------
+# argument (de)serialization
+# ---------------------------------------------------------------------------
+def test_arg_codec_round_trip_keys_match():
+    """The manifest's decoded avals must produce the SAME dispatch key a
+    live dispatch computes — that equality is what makes the warm store
+    addressable."""
+    state = {"tp": jnp.zeros((4,), jnp.int32), "total": jnp.zeros((), jnp.float32)}
+    args = (jnp.ones((8, 3)), np.arange(8, dtype=np.int64), 0.5, None)
+    kwargs = {"flag": True}
+    treedef = jax.tree_util.tree_flatten((args, kwargs))[1]
+    batched = (0, 1)
+    fn_args = (state, args, kwargs, treedef, batched)
+    specs = [wm._encode_obj(a) for a in fn_args]
+    decoded = tuple(wm._decode_obj(s) for s in specs)
+    assert wm.dispatch_key(decoded) == wm.dispatch_key(fn_args)
+    # the treedef reconstructs structurally identical
+    assert str(decoded[3]) == str(treedef)
+    # weak_type is part of the aval key (the classic second-trace cause)
+    weak = jax.ShapeDtypeStruct((2,), jnp.float32, weak_type=True)
+    strong = jax.ShapeDtypeStruct((2,), jnp.float32)
+    assert wm.dispatch_key((weak,)) != wm.dispatch_key((strong,))
+
+
+def test_stable_digest_is_config_sensitive_and_instance_stable():
+    a1 = mt.Accuracy(num_classes=NUM_CLASSES)
+    a2 = mt.Accuracy(num_classes=NUM_CLASSES)
+    b = mt.Accuracy(num_classes=NUM_CLASSES + 1)
+    assert wm.stable_digest(a1) == wm.stable_digest(a2)
+    assert wm.stable_digest(a1) != wm.stable_digest(b)
+
+
+# ---------------------------------------------------------------------------
+# warm dispatch: pre-seeded executables under identical keys
+# ---------------------------------------------------------------------------
+def test_warmed_first_request_compiles_nothing(tmp_path):
+    recorded, path = _record_accuracy(tmp_path)
+    expected = float(recorded.compute())
+    engine.clear_cache()
+    wm.reset_warmup_state()
+
+    report = wm.warmup(path)
+    assert report["programs_warmed"] > 0
+    assert report["programs_failed"] == 0, report["errors"]
+
+    fresh = mt.Accuracy(num_classes=NUM_CLASSES)
+    preds, target = _batch()
+    fresh.update(preds, target)
+    fresh.update(preds, target)
+    stats = fresh.compile_stats()
+    # every dispatch was served by a pre-seeded executable: zero compiles
+    assert stats["compiles"] == 0, stats
+    assert stats["cache_hits"] == 2
+    assert wm.warmup_report()["warmed_hits"] >= 2
+    assert float(fresh.compute()) == expected
+    assert wm.warmup_report()["stale_total"] == 0
+
+
+def test_warmup_accepts_explicit_templates(tmp_path):
+    _, path = _record_accuracy(tmp_path)
+    doc = wm.load_manifest(path)
+    for entry in doc["entries"]:
+        entry["template"] = None  # force the explicit-template path
+    engine.clear_cache()
+    wm.reset_warmup_state()
+    # without templates every entry is skipped...
+    report = wm.warmup(dict(doc))
+    assert report["programs_warmed"] == 0
+    assert report["skipped"].get("no_template", 0) > 0
+    # ...with a matching live template it warms
+    wm.reset_warmup_state()
+    report = wm.warmup(dict(doc), templates=[mt.Accuracy(num_classes=NUM_CLASSES)])
+    assert report["programs_warmed"] > 0
+
+
+def test_warmup_emits_bus_events(tmp_path):
+    _, path = _record_accuracy(tmp_path, n_updates=1)
+    engine.clear_cache()
+    wm.reset_warmup_state()
+    with obs.bus.capture(kinds=("warmup",)) as events:
+        wm.warmup(path)
+    kinds = [e.data.get("event") for e in events]
+    assert "program" in kinds and "complete" in kinds
+
+
+def test_bucketed_programs_warm_per_bucket(tmp_path):
+    path = str(tmp_path / "manifest.json")
+    wm.record_manifest(path)
+    m = mt.Accuracy(num_classes=NUM_CLASSES, jit_bucket="pow2")
+    m.update(*_batch(n=5))   # bucket 8
+    m.update(*_batch(n=3))   # bucket 4
+    m.update(*_batch(n=7))   # bucket 8 again: same program
+    wm.save_manifest()
+    wm.stop_recording()
+    states = {n: np.asarray(v) for n, v in m._snapshot_state().items()}
+
+    engine.clear_cache()
+    wm.reset_warmup_state()
+    wm.warmup(path)
+    fresh = mt.Accuracy(num_classes=NUM_CLASSES, jit_bucket="pow2")
+    fresh.update(*_batch(n=5))
+    fresh.update(*_batch(n=3))
+    fresh.update(*_batch(n=7))
+    assert fresh.compile_stats()["compiles"] == 0
+    assert wm.warmup_report()["stale_total"] == 0
+    for n, v in fresh._snapshot_state().items():
+        np.testing.assert_array_equal(np.asarray(v), states[n])
+
+
+def test_fused_collection_warms(tmp_path):
+    path = str(tmp_path / "manifest.json")
+    wm.record_manifest(path)
+    mc = mt.MetricCollection(
+        {"acc": mt.Accuracy(num_classes=NUM_CLASSES), "prec": mt.Precision(num_classes=NUM_CLASSES)}
+    )
+    preds, target = _batch(n=8)
+    mc.update(preds, target)
+    expected = {k: np.asarray(v) for k, v in mc.compute().items()}
+    wm.save_manifest()
+    wm.stop_recording()
+
+    engine.clear_cache()
+    wm.reset_warmup_state()
+    report = wm.warmup(path)
+    assert report["programs_warmed"] >= 2  # fused_update + fused_compute
+    fresh = mt.MetricCollection(
+        {"acc": mt.Accuracy(num_classes=NUM_CLASSES), "prec": mt.Precision(num_classes=NUM_CLASSES)}
+    )
+    fresh.update(preds, target)
+    out = fresh.compute()
+    assert fresh._compile_stats["compiles"] == 0, fresh._compile_stats
+    for key, value in expected.items():
+        np.testing.assert_array_equal(np.asarray(out[key]), value)
+
+
+def test_bank_warms_from_manifest(tmp_path):
+    path = str(tmp_path / "manifest.json")
+    wm.record_manifest(path)
+    bank = MetricBank(mt.Accuracy(num_classes=NUM_CLASSES, jit_bucket="pow2"), capacity=4)
+    preds, target = _batch(n=5, seed=3)
+    bank.apply_batch([(t, (preds, target)) for t in range(4)])
+    expected = float(np.asarray(bank.compute(0)))
+    wm.save_manifest()
+    wm.stop_recording()
+
+    engine.clear_cache()
+    wm.reset_warmup_state()
+    fresh_bank = MetricBank(mt.Accuracy(num_classes=NUM_CLASSES, jit_bucket="pow2"), capacity=4)
+    report = fresh_bank.warmup(path)
+    assert report["programs_warmed"] > 0, report
+    fresh_bank.apply_batch([(t, (preds, target)) for t in range(4)])
+    tpl_stats = fresh_bank._template._compile_stats
+    assert tpl_stats["compiles"] == 0, tpl_stats
+    assert wm.warmup_report()["warmed_hits"] >= 1
+    assert float(np.asarray(fresh_bank.compute(0))) == expected
+
+
+# ---------------------------------------------------------------------------
+# staleness: serve-time drift against a covered family is named
+# ---------------------------------------------------------------------------
+def test_stale_manifest_names_changed_component(tmp_path):
+    _, path = _record_accuracy(tmp_path)
+    engine.clear_cache()
+    wm.reset_warmup_state()
+    obs.reset_warn_once()
+    wm.warmup(path)
+    fresh = mt.Accuracy(num_classes=NUM_CLASSES)
+    fresh.update(*_batch())  # covered: warm
+    assert wm.warmup_report()["stale_total"] == 0
+    with obs.bus.capture(kinds=("warmup_stale",)) as events:
+        with pytest.warns(RuntimeWarning, match="warmup manifest stale"):
+            fresh.update(*_batch(n=9))  # a batch shape the manifest never saw
+    report = wm.warmup_report()
+    assert report["stale_total"] == 1
+    assert report["stale"][0]["changed"] == ["avals"]
+    assert "(9," in report["stale"][0]["detail"] or "(9" in report["stale"][0]["detail"]
+    assert len(events) == 1
+    assert events[0].data["explain"]["changed"] == ["avals"]
+    assert events[0].source == "Accuracy"
+
+
+def test_uncovered_entries_never_flag_stale(tmp_path):
+    _, path = _record_accuracy(tmp_path)
+    engine.clear_cache()
+    wm.reset_warmup_state()
+    wm.warmup(path)
+    # a DIFFERENT config compiles at serve time — that's a plain compile,
+    # not manifest staleness (its family was never covered)
+    other = mt.Accuracy(num_classes=NUM_CLASSES + 2)
+    rng = np.random.default_rng(5)
+    other.update(
+        jnp.asarray(rng.uniform(size=(4, NUM_CLASSES + 2)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, NUM_CLASSES + 2, size=(4,)).astype(np.int32)),
+    )
+    assert wm.warmup_report()["stale_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# surfaces: report, snapshot, prometheus
+# ---------------------------------------------------------------------------
+def test_report_in_snapshot_and_prometheus(tmp_path):
+    _, path = _record_accuracy(tmp_path, n_updates=1)
+    engine.clear_cache()
+    wm.reset_warmup_state()
+    wm.warmup(path)
+    snap = obs.snapshot()
+    assert snap["warmup"] == wm.warmup_report()
+    assert snap["warmup"]["programs_warmed"] > 0
+    text = obs.prometheus_text()
+    assert "metrics_tpu_warmup_programs_warmed" in text
+    assert "metrics_tpu_warmup_manifest_loaded 1" in text
+    assert "metrics_tpu_warmup_stale_total 0" in text
+    # engine summary counts the pre-seeded executables per entry kind
+    assert engine.cache_summary()["warmed_programs"] > 0
+
+
+# ---------------------------------------------------------------------------
+# env auto-wiring + persistent-cache interplay (subprocess)
+# ---------------------------------------------------------------------------
+_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+import metrics_tpu as mt
+from metrics_tpu.engine import persist
+rng = np.random.default_rng(0)
+m = mt.Accuracy(num_classes=4)
+preds = jnp.asarray(rng.uniform(size=(8, 4)).astype(np.float32))
+target = jnp.asarray(rng.integers(0, 4, size=(8,)).astype(np.int32))
+t0 = time.perf_counter(); m.update(preds, target)
+jax.block_until_ready(list(m._snapshot_state().values()))
+first_ms = (time.perf_counter() - t0) * 1e3
+steady = []
+for _ in range(5):
+    t0 = time.perf_counter(); m.update(preds, target)
+    jax.block_until_ready(list(m._snapshot_state().values()))
+    steady.append((time.perf_counter() - t0) * 1e3)
+wr = sys.modules["metrics_tpu.engine.warmup"].warmup_report()
+print(json.dumps({
+    "first_ms": first_ms,
+    "steady_ms": float(np.median(steady)),
+    "value": np.asarray(m.compute()).tobytes().hex(),
+    "compiles": m.compile_stats()["compiles"],
+    "warmed": wr["programs_warmed"],
+    "stale": wr["stale_total"],
+    "phits": persist.persistent_cache_stats()["persistent_hits"],
+    "pmiss": persist.persistent_cache_stats()["persistent_misses"],
+}))
+"""
+
+
+def _run_child(tmp_path, manifest=None, cache_dir=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("METRICS_TPU_WARMUP_MANIFEST", None)
+    env.pop("METRICS_TPU_COMPILE_CACHE", None)
+    if manifest:
+        env["METRICS_TPU_WARMUP_MANIFEST"] = manifest
+    if cache_dir:
+        env["METRICS_TPU_COMPILE_CACHE"] = cache_dir
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD], env=env, capture_output=True, text=True, timeout=300
+    )
+    assert out.returncode == 0, out.stderr
+    line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    return json.loads(line)
+
+
+def test_env_wiring_records_then_warms(tmp_path):
+    manifest = str(tmp_path / "env_manifest.json")
+    first = _run_child(tmp_path, manifest=manifest)  # missing file: records
+    assert os.path.exists(manifest), "recording worker saved no manifest at exit"
+    assert first["warmed"] == 0 and first["compiles"] > 0
+    second = _run_child(tmp_path, manifest=manifest)  # existing file: warms
+    assert second["warmed"] > 0
+    assert second["compiles"] == 0, second
+    assert second["stale"] == 0
+    assert second["value"] == first["value"], "warmed result diverged"
+
+
+@pytest.mark.slow
+def test_manifest_warm_compiles_count_as_persistent_hits(tmp_path):
+    """Manifest + persistent cache composed: the warm worker's AOT compiles
+    must be served from disk (counted ``persistent_hit``), and its first
+    request must run near steady state — the cold-start playbook's whole
+    point (docs/serving.md)."""
+    manifest = str(tmp_path / "manifest.json")
+    cache_dir = str(tmp_path / "cc")
+    rec = _run_child(tmp_path, manifest=manifest, cache_dir=cache_dir)
+    assert os.path.exists(manifest)
+    if rec["pmiss"] == 0:
+        pytest.skip("this jax build does not persist CPU executables")
+    warmed = _run_child(tmp_path, manifest=manifest, cache_dir=cache_dir)
+    assert warmed["warmed"] > 0 and warmed["compiles"] == 0
+    # manifest-warmed compiles hit the warm disk cache
+    assert warmed["phits"] > 0, warmed
+    assert warmed["value"] == rec["value"]
+
+
+@pytest.mark.slow
+def test_cold_start_round_trip_first_request_latency(tmp_path):
+    """Fresh-subprocess round trip: the manifest-warmed worker's first
+    request runs at (generously bounded) steady-state latency, and at least
+    2x faster than the unwarmed cold start."""
+    manifest = str(tmp_path / "manifest.json")
+    cache_dir = str(tmp_path / "cc")
+    _run_child(tmp_path, manifest=manifest, cache_dir=cache_dir)  # record + fill disk cache
+    cold = _run_child(tmp_path)  # no manifest, no disk cache
+    warm = _run_child(tmp_path, manifest=manifest, cache_dir=cache_dir)
+    assert warm["stale"] == 0
+    assert warm["value"] == cold["value"], "warmed-vs-unwarmed results must be bit-identical"
+    # parity with steady state, with slack for the python-init probe and CI
+    # noise; the unwarmed cold start sits orders of magnitude above this
+    assert warm["first_ms"] <= max(100 * warm["steady_ms"], cold["first_ms"] / 2), (warm, cold)
+    assert cold["first_ms"] / warm["first_ms"] >= 2.0, (warm, cold)
+
+
+def test_repeated_warmup_reports_stable_counters(tmp_path):
+    """The per-bank ``bank.warmup()`` pattern re-reads one manifest many
+    times; the report must describe the manifest, not the call count — a
+    fully-warmed worker shows programs_warmed == manifest_programs."""
+    _, path = _record_accuracy(tmp_path)
+    engine.clear_cache()
+    wm.reset_warmup_state()
+    first = wm.warmup(path)
+    again = wm.warmup(path)
+    assert again["manifest_entries"] == first["manifest_entries"]
+    assert again["manifest_programs"] == first["manifest_programs"]
+    assert again["entries_warmed"] == first["entries_warmed"]
+    assert again["programs_warmed"] == first["programs_warmed"]
+    assert again["programs_warmed"] == again["manifest_programs"]
+
+
+def test_warmup_validates_dict_manifests():
+    with pytest.raises(ValueError, match="version"):
+        wm.warmup({"version": 99, "entries": []})
+    with pytest.raises(ValueError, match="entry list"):
+        wm.warmup({"version": wm.MANIFEST_VERSION})
+
+
+def test_explicit_template_matching_probes_a_clone_not_the_caller(tmp_path):
+    """Matching must never settle the caller's live template against a
+    foreign entry's avals: a non-matching candidate stays unprobed."""
+    _, path = _record_accuracy(tmp_path)
+    doc = wm.load_manifest(path)
+    for entry in doc["entries"]:
+        entry["template"] = None
+    engine.clear_cache()
+    wm.reset_warmup_state()
+    bystander = mt.Accuracy(num_classes=NUM_CLASSES + 3)
+    match = mt.Accuracy(num_classes=NUM_CLASSES)
+    report = wm.warmup(dict(doc), templates=[bystander, match])
+    assert report["programs_warmed"] > 0
+    assert not bystander.__dict__.get("_engine_probed", False), (
+        "matching probed the non-matching caller template in place"
+    )

@@ -291,13 +291,16 @@ def test_jsonl_roundtrip_and_prometheus_render():
     # the serving plane, that includes the async-fetch counters and the
     # per-bank serving summary
     process = obs.snapshot()
-    assert set(process) == {"engine", "fetch", "serving", "wire", "bus", "spans", "warnings"}
+    assert set(process) == {"engine", "fetch", "serving", "wire", "warmup", "bus", "spans", "warnings"}
     assert process["engine"] == engine.cache_summary()
     assert process["fetch"] == engine.fetch_stats()
     assert set(process["fetch"]) == {"async_fetches", "coalesced_leaves"}
-    # ...and the Prometheus dump mirrors the fetch counters
+    assert process["warmup"] == engine.warmup_report()
+    # ...and the Prometheus dump mirrors the fetch + warmup counters
     assert "metrics_tpu_engine_async_fetches" in text
     assert "metrics_tpu_engine_coalesced_leaves" in text
+    assert "metrics_tpu_warmup_programs_warmed" in text
+    assert "metrics_tpu_warmup_stale_total" in text
 
 
 def test_validate_jsonl_rejects_bad_lines():

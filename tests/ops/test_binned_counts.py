@@ -53,3 +53,44 @@ def test_pallas_exact_match(n, c, t):
     out_x = jax.jit(_binned_counts_xla)(preds, target, ths)
     for a, b, name in zip(out_p, out_x, "tp fp fn tn".split()):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+def test_use_pallas_fallback_warns_which_path_ran():
+    """``use_pallas=True`` must never silently run a different path: off-TPU
+    (and under jit) the XLA fallback runs and says so, once per cause."""
+    from metrics_tpu.obs.warn import reset_warn_once
+
+    rng = np.random.default_rng(3)
+    preds = jnp.asarray(rng.uniform(size=(32, 3)).astype(np.float32))
+    target = jnp.asarray((rng.uniform(size=(32, 3)) > 0.5).astype(np.int32))
+    ths = jnp.linspace(0, 1, 5)
+    if jax.default_backend() == "tpu":
+        pytest.skip("on TPU the concrete-input pallas path runs for real")
+    reset_warn_once()
+    with pytest.warns(UserWarning, match="XLA fallback"):
+        out = binned_stat_counts(preds, target, ths, use_pallas=True)
+    for ours, ref in zip(out, binned_stat_counts(preds, target, ths)):
+        np.testing.assert_array_equal(np.asarray(ours), np.asarray(ref))
+    # once per key: an immediate repeat is deduplicated, results unchanged
+    import warnings as _warnings
+
+    with _warnings.catch_warnings(record=True) as captured:
+        _warnings.simplefilter("always")
+        binned_stat_counts(preds, target, ths, use_pallas=True)
+    assert not [w for w in captured if "XLA fallback" in str(w.message)]
+
+
+def test_tracer_guard_uses_stable_check():
+    """The under-jit guard matches real tracers without touching the
+    deprecated ``jax.core.Tracer`` access path at call time."""
+    from metrics_tpu.ops.binned_counts import _TRACER
+
+    seen = {}
+
+    def probe(x):
+        seen["is_tracer"] = isinstance(x, _TRACER)
+        return x
+
+    jax.jit(probe)(jnp.ones((2, 2)))
+    assert seen["is_tracer"] is True
+    assert not isinstance(jnp.ones(()), _TRACER)
