@@ -58,6 +58,35 @@ def emit(obj) -> None:
     print(json.dumps(obj), flush=True)
 
 
+# One definition of the virtual-device bootstrap, used everywhere a mesh lane
+# needs N CPU devices: called directly in-process (_run_smoke, the shard
+# lane) and interpolated by SOURCE into subprocess scripts (_SYNC_SCRIPT)
+# that must set the flag before THEIR first backend touch.
+def ensure_host_platform_devices(count):
+    """Expose `count` virtual CPU devices via XLA_FLAGS for mesh lanes.
+
+    Honors a pre-set --xla_force_host_platform_device_count (the caller or
+    driver wins; an existing flag is never overridden or duplicated). Must
+    run before the first jax backend touch -- backends init lazily, so a
+    flag set at config entry still lands (see tests/conftest.py). Returns
+    True when it set the flag.
+    """
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        return False
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=%d" % count
+    ).strip()
+    return True
+
+
+def _ensure_host_devices_src() -> str:
+    import inspect
+
+    return inspect.getsource(ensure_host_platform_devices)
+
+
 def _force(x) -> None:
     """Force execution with a host fetch.
 
@@ -680,11 +709,9 @@ def bench_map() -> dict:
 # ---------------------------------------------------------------------------
 # sync overhead: in-trace distributed sync vs identical program without it
 # ---------------------------------------------------------------------------
-_SYNC_SCRIPT = r"""
+_SYNC_SCRIPT = _ensure_host_devices_src() + r"""
 import json, os, time
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+ensure_host_platform_devices(8)
 import jax
 jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
@@ -2004,6 +2031,133 @@ def _headline() -> dict:
 # fetch indefinitely) must cost one config an error line, not the whole run.
 # needs_accel=False configs measure on a pinned-CPU mesh by design and never
 # touch the tunnel.
+def bench_sharded_states() -> dict:
+    """Model-parallel sharded metric states on the 2x4 (dp x mp) CPU mesh.
+
+    The giant-vocab / covariance acceptance scenario (``ci.sh
+    --shard-smoke`` gates every field):
+
+    * a 100k-class ConfusionMatrix epoch driven through
+      ``engine.drive(mesh=, in_specs=)`` with the classwise state sharded
+      over the class axis is BIT-IDENTICAL to the unsharded drive, while
+      each device holds <= 1/4 of the state (``bytes_ratio >= 4`` at mp=4);
+    * 100k-class classwise StatScores the same way;
+    * the sharded lane costs ZERO extra driver compiles vs the unsharded
+      lane, and a repeat sharded drive compiles nothing;
+    * sharded FID (on-mesh Newton-Schulz square root, scalar-only
+      device->host transfer) agrees with the host eigendecomposition path
+      within the documented ``NEWTON_SCHULZ_FID_RTOL``.
+    """
+    ensure_host_platform_devices(8)
+    import jax
+    import jax.numpy as jnp
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import ConfusionMatrix, FrechetInceptionDistance, StatScores, engine
+    from metrics_tpu import sharding as shd
+
+    if len(jax.devices()) < 8:
+        return {
+            "metric": "sharded_states",
+            "error": f"needs 8 devices for the 2x4 mesh, lane has {len(jax.devices())}",
+        }
+    small = bool(os.environ.get("METRICS_TPU_BENCH_SMALL"))
+    C = 10_000 if small else 100_000
+    N_STEPS, B, D_FID = 4, 8, 128 if small else 256
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "mp"))
+    in_specs = P(None, "dp")
+    rng = np.random.RandomState(0)
+
+    def driver_compiles() -> int:
+        return engine.cache_summary()["by_kind"].get("driver", {}).get("compiles", 0)
+
+    # -- 100k-class ConfusionMatrix: classwise [C, 2, 2] state ----------
+    # float probabilities: the multilabel input form (int [N, C] preds would
+    # be read as multidim-multiclass labels and one-hotted to [N, C, C])
+    preds = jnp.asarray(rng.rand(N_STEPS, B, C).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, size=(N_STEPS, B, C)).astype(np.int32))
+    cm_ref = ConfusionMatrix(num_classes=C, multilabel=True)
+    before = driver_compiles()
+    engine.drive(cm_ref, (preds, target))
+    compiles_unsharded = driver_compiles() - before
+
+    cm_sh = ConfusionMatrix(num_classes=C, multilabel=True, class_sharding="mp")
+    before = driver_compiles()
+    t0 = time.perf_counter()
+    engine.drive(cm_sh, (preds, target), mesh=mesh, in_specs=in_specs)
+    jax.block_until_ready(cm_sh.confmat)
+    first_s = time.perf_counter() - t0
+    compiles_sharded = driver_compiles() - before
+    before = driver_compiles()
+    t0 = time.perf_counter()
+    engine.drive(cm_sh, (preds, target), mesh=mesh, in_specs=in_specs)
+    jax.block_until_ready(cm_sh.confmat)
+    steady_s = time.perf_counter() - t0
+    repeat_compiles = driver_compiles() - before
+
+    state = cm_sh.confmat
+    per_device = max(s.data.nbytes for s in state.addressable_shards)
+    bytes_ratio = state.nbytes / per_device
+    confmat_exact = bool(np.array_equal(np.asarray(state), 2 * np.asarray(cm_ref.confmat)))
+
+    # -- 100k-class classwise StatScores: [C] counters ------------------
+    sp = jnp.asarray(rng.randint(0, C, size=(N_STEPS, B)).astype(np.int32))
+    st = jnp.asarray(rng.randint(0, C, size=(N_STEPS, B)).astype(np.int32))
+    ss_ref = StatScores(reduce="macro", num_classes=C)
+    engine.drive(ss_ref, (sp, st))
+    ss_sh = StatScores(reduce="macro", num_classes=C, class_sharding="mp")
+    engine.drive(ss_sh, (sp, st), mesh=mesh, in_specs=in_specs)
+    statscores_exact = bool(
+        np.array_equal(np.asarray(ss_sh.compute()), np.asarray(ss_ref.compute()))
+    )
+
+    # -- FID: feature-axis-sharded covariance + Newton-Schulz -----------
+    def extractor(x):
+        return jnp.asarray(x, jnp.float32)
+
+    fid_ref = FrechetInceptionDistance(feature=extractor, feature_dim=D_FID)
+    fid_sh = FrechetInceptionDistance(
+        feature=extractor, feature_dim=D_FID, feature_sharding="mp"
+    )
+    fid_sh.shard_states(mesh)
+    real = jnp.asarray(rng.rand(512, D_FID).astype(np.float32))
+    fake = jnp.asarray((rng.rand(512, D_FID) * 1.05 + 0.02).astype(np.float32))
+    for m in (fid_ref, fid_sh):
+        m.update(real, real=True)
+        m.update(fake, real=False)
+    v_ref = float(fid_ref.compute())  # host eigendecomposition path
+    v_sh = float(fid_sh.compute())  # on-mesh Newton-Schulz path
+    fid_rel_err = abs(v_sh - v_ref) / max(abs(v_ref), 1e-12)
+    fid_per_device = max(s.data.nbytes for s in fid_sh.real_outer.addressable_shards)
+    fid_bytes_ratio = fid_sh.real_outer.nbytes / fid_per_device
+
+    return {
+        "metric": "sharded_states",
+        "value": round(bytes_ratio, 3),
+        "unit": "x_state_bytes_per_device_reduction",
+        "num_classes": C,
+        "mesh": "2x4 dp*mp",
+        "confmat_exact": confmat_exact,
+        "statscores_exact": statscores_exact,
+        "bytes_ratio": round(bytes_ratio, 3),
+        "per_device_state_bytes": int(per_device),
+        "total_state_bytes": int(state.nbytes),
+        "compiles_unsharded": compiles_unsharded,
+        "compiles_sharded": compiles_sharded,
+        "extra_compiles": compiles_sharded - compiles_unsharded,
+        "repeat_compiles": repeat_compiles,
+        "first_epoch_s": round(first_s, 3),
+        "steady_epoch_s": round(steady_s, 3),
+        "fid_rel_err": fid_rel_err,
+        "fid_rtol": shd.NEWTON_SCHULZ_FID_RTOL,
+        "fid_bytes_ratio": round(fid_bytes_ratio, 3),
+        "fid_value_host": round(v_ref, 6),
+        "fid_value_mesh": round(v_sh, 6),
+        "n": N_STEPS * B,
+    }
+
+
 _CONFIGS = [
     ("bench_fid", 1500, True),
     ("bench_bertscore", 1500, True),
@@ -2020,6 +2174,7 @@ _CONFIGS = [
     ("bench_eval_driver", 900, False),
     ("bench_serving_plane", 900, False),
     ("bench_cold_start", 1200, False),
+    ("bench_sharded_states", 900, False),
 ]
 
 # the headline runs outside _CONFIGS (measured first, emitted last) but is
@@ -2252,6 +2407,8 @@ _SMOKE_LANES = {
     "--serving-smoke": ("bench_serving_plane", {}),
     # AOT warmup manifests: cold-start->first-result with/without manifest
     "--warmup-smoke": ("bench_cold_start", {}),
+    # sharded states: 100k-class parity, >=4x per-device bytes, FID NS gate
+    "--shard-smoke": ("bench_sharded_states", {"cpu_devices": 8}),
 }
 
 
@@ -2260,11 +2417,7 @@ def _run_smoke(config: str, opts: dict) -> None:
     pre-imports jax (axon sitecustomize), so a JAX_PLATFORMS pin must go
     through jax.config, like tests/conftest.py does."""
     if opts.get("cpu_devices"):
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={opts['cpu_devices']}"
-            ).strip()
+        ensure_host_platform_devices(opts["cpu_devices"])
     forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
     if forced:
         import jax

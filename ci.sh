@@ -211,4 +211,27 @@ if [ "$warmup_rc" -eq 3 ]; then
 fi
 [ "$warmup_rc" -eq 0 ] || exit "$warmup_rc"
 
+echo "=== sharded-states smoke (2D dp*mp mesh: parity, per-device bytes, NS sqrt) ==="
+JAX_PLATFORMS=cpu python bench.py --shard-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "sharded_states", obj
+# contract gates: the 100k-class sharded ConfusionMatrix epoch is
+# bit-identical to the unsharded reference, classwise StatScores too
+if obj["confmat_exact"] is not True or obj["statscores_exact"] is not True:
+    print("sharded epoch diverged from the unsharded reference:", line); sys.exit(2)
+# each device holds <= 1/4 of the class-axis state at mp=4
+if obj["bytes_ratio"] < 4.0:
+    print("per-device state bytes reduced %sx < 4x: %s" % (obj["bytes_ratio"], line)); sys.exit(2)
+# the sharded lane compiles exactly as many driver programs as the
+# unsharded one, and a repeat drive compiles nothing
+if obj["extra_compiles"] != 0 or obj["repeat_compiles"] != 0:
+    print("sharded drive cost extra compiles:", line); sys.exit(2)
+# on-mesh Newton-Schulz FID (no host sqrtm round-trip) within tolerance
+if obj["fid_rel_err"] > obj["fid_rtol"]:
+    print("NS FID err %s > rtol %s: %s" % (obj["fid_rel_err"], obj["fid_rtol"], line)); sys.exit(2)
+print("sharded-states smoke OK:", line)
+'
+
 echo "both lanes green"

@@ -50,6 +50,7 @@ from metrics_tpu import engine  # noqa: E402,F401
 from metrics_tpu import obs  # noqa: E402,F401
 from metrics_tpu import resilience  # noqa: E402,F401
 from metrics_tpu import serving  # noqa: E402,F401
+from metrics_tpu import sharding  # noqa: E402,F401
 from metrics_tpu.collections import MetricCollection  # noqa: E402,F401
 from metrics_tpu.utils.exceptions import (  # noqa: E402,F401
     NumericalHealthError,

@@ -28,6 +28,14 @@ class ConfusionMatrix(Metric):
         threshold: probability cutoff binarizing probabilistic inputs.
         multilabel: treat inputs as [N, C] independent binary problems,
             producing a [C, 2, 2] stack.
+        class_sharding: a mesh-axis name (e.g. ``'mp'``) or
+            ``jax.sharding.PartitionSpec`` sharding the CLASS axis of the
+            state — the leading (true-class row) axis of ``[C, C]``, or the
+            class axis of the multilabel ``[C, 2, 2]`` stack. With
+            ``engine.drive(mesh=, in_specs=)`` (or ``shard_states(mesh)``)
+            each device then holds only its 1/mp slice of the matrix and the
+            bincount scatter lands on the owning shard — the giant-vocab
+            (100k+-class) layout. See ``docs/distributed.md``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -50,6 +58,7 @@ class ConfusionMatrix(Metric):
         normalize: Optional[str] = None,
         threshold: float = 0.5,
         multilabel: bool = False,
+        class_sharding: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -62,6 +71,15 @@ class ConfusionMatrix(Metric):
         if normalize not in allowed_normalize:
             raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
 
+        from metrics_tpu.sharding import canonical_spec, class_axis_spec
+
+        # stored in canonical TUPLE form, not as a PartitionSpec: public
+        # attrs enter the engine's config fingerprint, and a plain tuple of
+        # axis names tokenizes stably (P('mp') vs P('mp', None) unify; a
+        # non-tuple PartitionSpec type would be identity-pinned and split
+        # program sharing between identical instances)
+        self.class_sharding = canonical_spec(class_axis_spec(class_sharding)) or None
+
         # the lane's default int (int64 under jax_enable_x64, else int32):
         # the bincount in update produces that dtype, and init/update dtype
         # agreement is what lets the state ride a lax.scan carry unchanged
@@ -71,7 +89,9 @@ class ConfusionMatrix(Metric):
             if multilabel
             else jnp.zeros((num_classes, num_classes), dtype=int_dtype)
         )
-        self.add_state("confmat", default=default, dist_reduce_fx="sum")
+        self.add_state(
+            "confmat", default=default, dist_reduce_fx="sum", sharding=self.class_sharding
+        )
 
     def update(self, preds: Array, target: Array) -> None:
         confmat = _confusion_matrix_update(preds, target, self.num_classes, self.threshold, self.multilabel)

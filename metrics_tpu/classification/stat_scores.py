@@ -53,6 +53,7 @@ class StatScores(Metric):
         ignore_index: Optional[int] = None,
         mdmc_reduce: Optional[str] = None,
         multiclass: Optional[bool] = None,
+        class_sharding: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -74,6 +75,23 @@ class StatScores(Metric):
         if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
             raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
 
+        from metrics_tpu.sharding import canonical_spec, class_axis_spec
+
+        # canonical tuple, not PartitionSpec: fingerprint-stable config (see
+        # ConfusionMatrix.class_sharding)
+        self.class_sharding = canonical_spec(class_axis_spec(class_sharding)) or None
+        if self.class_sharding is not None and (
+            reduce != "macro" or mdmc_reduce == "samplewise"
+        ):
+            # only the classwise [C] counters have a class axis to shard —
+            # micro scalars and samplewise 'cat' buffers do not
+            raise ValueError(
+                "`class_sharding` shards the per-class [num_classes] state"
+                " axis and needs reduce='macro' (without"
+                " mdmc_reduce='samplewise'); "
+                f"got reduce={reduce!r}, mdmc_reduce={mdmc_reduce!r}."
+            )
+
         if mdmc_reduce != "samplewise" and reduce != "samples":
             zeros_shape = [] if reduce == "micro" else [num_classes]
             # the lane's default int (int64 under jax_enable_x64, else int32)
@@ -81,7 +99,12 @@ class StatScores(Metric):
             # stable across updates (scan-carry/donation friendly)
             int_dtype = jnp.asarray(0).dtype
             for s in ("tp", "fp", "tn", "fn"):
-                self.add_state(s, default=jnp.zeros(zeros_shape, dtype=int_dtype), dist_reduce_fx="sum")
+                self.add_state(
+                    s,
+                    default=jnp.zeros(zeros_shape, dtype=int_dtype),
+                    dist_reduce_fx="sum",
+                    sharding=self.class_sharding,
+                )
         else:
             for s in ("tp", "fp", "tn", "fn"):
                 # samplewise rows accumulate in the lane-default int; declare

@@ -857,6 +857,7 @@ def _make_driver_entry(
     axis_name: Optional[Any],
     mesh: Optional[Any],
     hierarchical: bool = False,
+    sharded_members: Optional[List[Any]] = None,
 ) -> SharedEntry:
     """One scan-fused epoch program family (``metrics_tpu.engine.driver``).
 
@@ -870,6 +871,15 @@ def _make_driver_entry(
     variants wrap the epoch in ``shard_map`` over ``axis_name``/``mesh``
     (steps sharded across devices, states synced in-trace, prior state
     merged back in) so a full sharded eval epoch is one XLA launch.
+
+    ``sharded_members`` (with ``mesh`` but no ``axis_name``) selects the
+    GSPMD sharded-STATE mode (``drive(mesh=, in_specs=)``): the plain
+    ``scan*`` variants are built with every registered state sharding pinned
+    onto the carry via ``lax.with_sharding_constraint`` each step — XLA's
+    SPMD partitioner keeps the annotated states resident as shards (class
+    axis, covariance feature axis) and derives the data-axis partial-sum
+    reduction from the batch-sharded inputs. No shard_map wrapper, no merge
+    dance: the carry IS the global accumulation.
     """
     entry = SharedEntry(cache_key, "driver", pins)
     # warmup-recorder meta: local (no mesh/axis) driver programs can ride a
@@ -879,10 +889,30 @@ def _make_driver_entry(
     entry._axis_name = axis_name
     entry._mesh = mesh
     entry._hierarchical = hierarchical
-    # mesh variants scan from the defaults and merge the (replicated) prior
-    # state AFTER the in-trace sync — donating the prior would consume the
-    # caller's live accumulation, so donation is local-variant only
-    entry.donate = donation_enabled() and mesh is None
+    # shard_map variants scan from the defaults and merge the (replicated)
+    # prior state AFTER the in-trace sync — donating the prior would consume
+    # the caller's live accumulation, so they never donate. The GSPMD
+    # sharded-state mode has no such merge dance: its carry is consumed
+    # exactly like the local mode's (and with_sharding_constraint keeps
+    # input/output layouts identical, so aliasing is valid) — donation stays
+    # on there, halving peak per-device bytes of exactly the giant states
+    # the mode exists for.
+    entry.donate = donation_enabled() and (mesh is None or sharded_members is not None)
+
+    if sharded_members is not None:
+        from metrics_tpu.sharding import reduce as _shard_reduce
+
+        # member key -> state name -> NamedSharding, frozen at entry creation
+        # (the specs are part of the cache key, the mesh is id-pinned)
+        _constraints = _shard_reduce.build_constraints(keys, sharded_members, mesh)
+
+        def _constrain(states):
+            return _shard_reduce.constrain_state_tree(states, _constraints)
+
+    else:
+
+        def _constrain(states):
+            return states
 
     def _step(carry, step_leaves, pad, treedef):
         args, kwargs = jax.tree_util.tree_unflatten(treedef, list(step_leaves))
@@ -895,9 +925,14 @@ def _make_driver_entry(
         return new
 
     def _scan_epoch(states, leaves, pads, treedef):
+        states = _constrain(states)
+
         def body(carry, step):
             step_leaves, pad = step if pads is not None else (step, None)
-            return _step(carry, step_leaves, pad, treedef), None
+            # re-pin the carry every step: without the constraint XLA is free
+            # to gather the sharded accumulators between iterations, which is
+            # exactly the resident-state guarantee this mode exists for
+            return _constrain(_step(carry, step_leaves, pad, treedef)), None
 
         xs = tuple(leaves) if pads is None else (tuple(leaves), pads)
         out, _ = jax.lax.scan(body, states, xs)
@@ -1016,11 +1051,16 @@ def driver_entry(
     axis_name: Optional[Any] = None,
     mesh: Optional[Any] = None,
     hierarchical: bool = False,
+    in_specs: Optional[Tuple] = None,
+    state_shardings: Tuple = (),
 ) -> SharedEntry:
     """Shared entry for one scan-fused epoch program: keyed by the member
     names, every member's fingerprint, the in-trace-compute member subset,
-    and the sync axis/mesh — so instances, clones, and identical collections
-    share one compiled epoch per (steps, batch) signature."""
+    the sync axis/mesh, and — for the GSPMD sharded-state mode — the input
+    PartitionSpecs plus every member's registered state shardings, so a 2D
+    (dp×mp) drive compiles its own program family while instances, clones,
+    and identical collections keep sharing one compiled epoch per
+    (steps, batch) signature."""
     member_keys: List[Any] = []
     pins: List[Any] = []
     for m in members:
@@ -1037,11 +1077,20 @@ def driver_entry(
         axis_name,
         id(mesh) if mesh is not None else None,
         hierarchical,
+        in_specs,
+        state_shardings,
     )
     return _get_or_create(
         cache_key,
         lambda: _make_driver_entry(
-            cache_key, tuple(keys), tuple(pins), tuple(compute_keys), axis_name, mesh, hierarchical
+            cache_key,
+            tuple(keys),
+            tuple(pins),
+            tuple(compute_keys),
+            axis_name,
+            mesh,
+            hierarchical,
+            sharded_members=list(members) if in_specs is not None else None,
         ),
     )
 

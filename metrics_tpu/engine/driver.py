@@ -305,6 +305,7 @@ def drive(
     compute_in_trace: bool = False,
     axis_name: Optional[Any] = None,
     mesh: Optional[Any] = None,
+    in_specs: Optional[Any] = None,
     steps_per_chunk: int = 16,
     hierarchical_sync: bool = False,
 ) -> DriveResult:
@@ -329,6 +330,20 @@ def drive(
             epoch, mergeable states, and both arguments together.
             ``axis_name`` may be a TUPLE of mesh axes (ordered outer→inner,
             e.g. ``('host', 'local')``): steps shard over their product.
+        in_specs (with ``mesh``, instead of ``axis_name``): the sharded-STATE
+            mode for 2D (dp×mp) meshes — one ``PartitionSpec`` per stacked
+            update argument (or one broadcast to all) sharding the BATCH
+            axis over the data axis (e.g. ``PartitionSpec(None, 'dp')``;
+            the leading steps axis stays unsharded, the scan consumes it
+            sequentially). States registered with ``add_state(sharding=)``
+            are pinned to their layout on the scan carry with
+            ``with_sharding_constraint``, so a 100k-class classwise state
+            lives as 1/mp-sized shards for the whole epoch while XLA derives
+            the dp-axis reduction from the batch sharding. The carry IS the
+            global accumulation — no merge dance, and on a single process
+            the members stay fully usable afterwards (on a multi-process
+            mesh the host-level sync is disarmed like the shard_map mode).
+            Requires a stacked epoch. See ``docs/distributed.md``.
         hierarchical_sync: with a multi-axis ``axis_name``, stage each
             in-trace sync collective intra-host first, inter-host second
             (``parallel/comm.reduce_in_trace``) — only the per-host partials
@@ -349,12 +364,12 @@ def drive(
     source = type(obj).__name__
     if not _trace.active():
         return _drive_impl(
-            obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source, hierarchical_sync
+            obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source, hierarchical_sync, in_specs
         )
     _keys, _members, _ = _members_of(obj)
     with _trace.span("drive", source, payload=lambda: [m._snapshot_state() for m in _members]):
         return _drive_impl(
-            obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source, hierarchical_sync
+            obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source, hierarchical_sync, in_specs
         )
 
 
@@ -367,17 +382,33 @@ def _drive_impl(
     steps_per_chunk: int,
     source: str,
     hierarchical_sync: bool = False,
+    in_specs: Optional[Any] = None,
 ) -> DriveResult:
     from metrics_tpu.metric import _JIT_FALLBACK_ERRORS
     from metrics_tpu.parallel import comm
     from metrics_tpu.utils.data import _squeeze_if_scalar
 
-    if (axis_name is None) != (mesh is None):
+    gspmd = in_specs is not None
+    if gspmd:
+        if mesh is None:
+            raise ValueError(
+                "drive(in_specs=...) is the sharded-state (GSPMD) mode and"
+                " needs the mesh the specs name axes of: pass mesh= too."
+            )
+        if axis_name is not None or hierarchical_sync:
+            raise ValueError(
+                "drive(in_specs=...) and drive(axis_name=...) are different"
+                " mesh modes: in_specs shards the batch axis + state layout"
+                " under one GSPMD program, axis_name shard_maps the steps"
+                " axis with an explicit in-trace sync. Pass one or the other."
+            )
+    elif (axis_name is None) != (mesh is None):
         raise ValueError(
             "drive(axis_name=..., mesh=...) fold the in-trace sync into a"
-            " shard_map'd epoch and must be passed together (for embedding in"
-            " your own shard_map, scan the pure update_state/sync_state API"
-            " instead — see docs/distributed.md)."
+            " shard_map'd epoch and must be passed together (for a sharded-"
+            "STATE epoch over a 2D mesh pass drive(mesh=, in_specs=); for"
+            " embedding in your own shard_map, scan the pure"
+            " update_state/sync_state API instead — see docs/distributed.md)."
         )
     if steps_per_chunk < 1:
         raise ValueError(f"steps_per_chunk must be >= 1, got {steps_per_chunk}")
@@ -462,7 +493,7 @@ def _drive_impl(
     fused_members = [m for _, m in fused]
     eager_keys = tuple(k for k, _ in eager)
 
-    if mesh is not None:
+    if mesh is not None and not gspmd:
         not_mergeable = [k for k, m in fused if not m._states_mergeable]
         if not_mergeable or eager:
             raise ValueError(
@@ -471,6 +502,30 @@ def _drive_impl(
                 " scans from the defaults and merges the synced delta back;"
                 f" offending members: {sorted(set(not_mergeable) | set(eager_keys))}."
             )
+    norm_in_specs = None
+    shardings_key: Tuple = ()
+    if gspmd:
+        from metrics_tpu.sharding import reduce as _shard_reduce
+        from metrics_tpu.sharding import spec as _shard_spec
+
+        if eager:
+            # same strictness as the axis_name mesh mode: a member that
+            # cannot ride the scan would silently run an unsharded per-step
+            # epoch, and on a multi-process mesh its host-sync bookkeeping
+            # would diverge from the fused members' (double-count hazard)
+            raise ValueError(
+                "drive(mesh=, in_specs=) needs every member scan-drivable —"
+                " eager-fallback/list-state/'raise'-policy members cannot"
+                " ride the sharded scan; offending members:"
+                f" {sorted(set(eager_keys))}. Drive them in a separate local"
+                " drive(), or use shard_states(mesh) + per-step updates"
+                " (the sharded-FID pattern)."
+            )
+        # specs address the positional update arguments; kwargs are flattened
+        # after them and are not present in the stacked form (_stacked_steps
+        # only admits a tuple of arrays)
+        norm_in_specs = _shard_reduce.normalize_in_specs(in_specs, len(leaves))
+        shardings_key = _shard_reduce.state_shardings_key(fused_keys, fused_members)
 
     # zero-row pad corrections are exact only under the row-additivity
     # contract shared with jit_bucket / on_bad_input='mask'
@@ -478,8 +533,10 @@ def _drive_impl(
     batched = _bucketing.batched_leaf_indices(leaves)
 
     # -- in-trace compute eligibility -----------------------------------
+    # (a gspmd carry is already the global accumulation, so in-trace compute
+    # is valid even in a distributed world — the host sync is disarmed below)
     compute_keys: Tuple[str, ...] = ()
-    if compute_in_trace and fused and (axis_name is not None or not comm.distributed_available()):
+    if compute_in_trace and fused and (axis_name is not None or gspmd or not comm.distributed_available()):
         eligible = []
         for k, m in fused:
             if (
@@ -518,18 +575,33 @@ def _drive_impl(
 
     if fused:
         entry = _cache.driver_entry(
-            fused_keys, fused_members, compute_keys, axis_name, mesh, hierarchical_sync
+            fused_keys,
+            fused_members,
+            compute_keys,
+            axis_name,
+            mesh,
+            hierarchical_sync,
+            in_specs=norm_in_specs,
+            state_shardings=shardings_key,
         )
         snapshots = {k: m._snapshot_state() for k, m in fused}
         states: Dict[str, Any] = snapshots
         if entry.donate:
             states = {k: _cache.guard_donated_state(m, snapshots[k]) for k, m in fused}
+        if gspmd:
+            # lay the carry out per the registered specs BEFORE the launch
+            # (reshard telemetry + the program starts from resident shards
+            # instead of an in-program broadcast-then-reshard)
+            states = {
+                k: _shard_spec.place_state_dict(states[k], m, mesh, source=source)
+                for k, m in fused
+            }
 
         def _dispatch(states, chunk_leaves, pads, last):
             variant = "scan_pad" if pads is not None else "scan"
             if last and compute_keys:
                 variant += "_cmp"
-            if mesh is not None:
+            if mesh is not None and not gspmd:
                 variant = "shard_" + variant
             fn_args = (states, tuple(chunk_leaves))
             if pads is not None:
@@ -542,7 +614,15 @@ def _drive_impl(
                 pads = None
                 chunk_leaves = list(stacked_leaves)
                 steps = n_steps
-                if mesh is not None:
+                if gspmd:
+                    # batch-axis data parallelism: steps stay whole (the scan
+                    # consumes them sequentially), each stacked input leaf is
+                    # staged with its NamedSharding; non-divisible batch
+                    # shardings are XLA's problem, not a caller contract
+                    chunk_leaves = _shard_reduce.stage_epoch_inputs(
+                        mesh, norm_in_specs, chunk_leaves
+                    )
+                elif mesh is not None:
                     world = _cache.axis_world(mesh, axis_name)  # axis_name is required with mesh
                     rem = (-steps) % world
                     if rem:
@@ -625,7 +705,7 @@ def _drive_impl(
                 states_out = out
             _bind_states(fused, states_out, n_steps_total)
             _screen_bookkeeping(fused, n_steps_total)
-        if mesh is not None:
+        if mesh is not None and not gspmd:
             # the shard variants' in-trace sync already produced the GLOBAL
             # accumulation on every participating process; the host-side sync
             # dance inside a later compute() would reduce those identical
@@ -639,6 +719,24 @@ def _drive_impl(
                 m._drive_synced = True
             if is_collection:
                 obj._drive_synced = True  # O(1) guard for the fused update path
+        if gspmd:
+            # the GSPMD carry is the global accumulation too — but only a
+            # mesh that SPANS processes makes the host-level sync a double
+            # count. On a single-process mesh (the common giant-vocab eval)
+            # the members stay fully usable: update/forward/compute behave
+            # exactly as after a local drive, on sharded state arrays.
+            _shard_spec.record_drive(fused, mesh)
+            for _, m in fused:
+                if m._state_shardings:
+                    # a driven member is mesh-bound like one that called
+                    # shard_states(mesh): reset() re-places fresh defaults
+                    m._shard_mesh = mesh
+            if _shard_reduce.mesh_spans_processes(mesh):
+                for _, m in fused:
+                    m._to_sync = False
+                    m._drive_synced = True
+                if is_collection:
+                    obj._drive_synced = True
         # (out is None: the tail path above already bound the scanned states
         # and counted/screened both scan and tail steps)
     # -- per-step members over a stacked epoch --------------------------

@@ -41,15 +41,24 @@ def _confusion_matrix_update(
         preds = jnp.argmax(preds, axis=1)
         target = jnp.argmax(target, axis=1)
     if multilabel:
-        unique_mapping = ((2 * target + preds) + 4 * jnp.arange(num_classes)).reshape(-1)
-        minlength = 4 * num_classes
-    else:
-        unique_mapping = (target.reshape(-1) * num_classes + preds.reshape(-1)).astype(jnp.int32)
-        minlength = num_classes**2
-
-    bins = _bincount(unique_mapping, minlength)
-    if multilabel:
-        return bins.reshape(num_classes, 2, 2)
+        # direct per-class reductions instead of a bincount over 4*C bins:
+        # bit-identical integer counts, O(N*C) elementwise work with a batch
+        # reduction — no scatter, so the kernel shards cleanly over BOTH the
+        # batch (dp) and class (mp) axes. The old fused-index bincount forced
+        # the SPMD partitioner into a dense N*C x 4*C one-hot rewrite at
+        # giant-vocab scale (320 GB at C=100k, B=8).
+        dtype = jnp.asarray(0).dtype  # lane default int, matching _bincount
+        p = preds.astype(dtype)
+        t = target.astype(dtype)
+        tp = jnp.sum(p * t, axis=0)
+        fp = jnp.sum(p * (1 - t), axis=0)
+        fn = jnp.sum((1 - p) * t, axis=0)
+        tn = jnp.sum((1 - p) * (1 - t), axis=0)
+        # bin index inside a class is 2*target + preds, so the [C, 2, 2]
+        # layout is [[tn, fp], [fn, tp]] — the reference's reshape order
+        return jnp.stack([tn, fp, fn, tp], axis=-1).reshape(num_classes, 2, 2)
+    unique_mapping = (target.reshape(-1) * num_classes + preds.reshape(-1)).astype(jnp.int32)
+    bins = _bincount(unique_mapping, num_classes**2)
     return bins.reshape(num_classes, num_classes)
 
 

@@ -24,6 +24,17 @@ TPU-native design differences:
   symmetric PSD — two ``eigh`` calls replace the reference's general (and
   CPU-only scipy) ``sqrtm``. The final reduction runs on host in float64
   (same host boundary the reference has, ``image/fid.py:61-106``).
+
+* **Optional sharded, on-mesh compute.** ``feature_sharding='mp'`` shards the
+  ``[d, d]`` second-moment states over the feature axis
+  (``add_state(sharding=PartitionSpec('mp'))``) and switches the compute to
+  the matmul-only Newton–Schulz square root
+  (``metrics_tpu.sharding.linalg``), so the whole FID reduction runs
+  distributed on the mesh and only the scalar result reaches the host — no
+  ``2 d^2`` device→host funnel, no single-core host eigendecomposition. The
+  host path above stays the default and the unsharded fallback; the two
+  agree to the documented ``NEWTON_SCHULZ_FID_RTOL`` (CI parity gate,
+  ``bench.py --shard-smoke``).
 """
 from typing import Any, Callable, Optional, Union
 
@@ -92,6 +103,21 @@ class FrechetInceptionDistance(Metric):
             ``metrics_tpu.image.networks.convert_torch_inception_checkpoint``);
             falls back to ``$METRICS_TPU_INCEPTION_WEIGHTS``. Only used when
             ``feature`` is an int.
+        feature_sharding: a mesh-axis name (e.g. ``'mp'``) or
+            ``jax.sharding.PartitionSpec`` sharding the feature axis of the
+            streaming-statistics states (the ``[d, d]`` second moments and
+            ``[d]`` sums). Requires ``feature_dim``. Call
+            ``shard_states(mesh)`` to place them — FID's extractor-calling
+            update is eager by design, so it accumulates per step on the
+            sharded states (it cannot ride ``engine.drive``'s fused scan);
+            the compute then defaults to the on-mesh Newton–Schulz path.
+        matrix_sqrt: ``'auto'`` (Newton–Schulz when ``feature_sharding`` is
+            set, else the host eigendecomposition), ``'eigh'`` (force the
+            host path), or ``'newton_schulz'`` (force the on-mesh path —
+            matmuls only, scalar-only device→host transfer; agrees with the
+            host path to ``sharding.NEWTON_SCHULZ_FID_RTOL``).
+        sqrt_iters: Newton–Schulz iteration count (quadratic convergence;
+            the default is conservative for covariance spectra).
 
     Example:
         >>> import jax.numpy as jnp
@@ -115,6 +141,9 @@ class FrechetInceptionDistance(Metric):
         feature: Union[int, Callable] = 2048,
         feature_dim: Optional[int] = None,
         weights_path: Optional[str] = None,
+        feature_sharding: Optional[Any] = None,
+        matrix_sqrt: str = "auto",
+        sqrt_iters: int = 40,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)  # extractor call is user code
@@ -129,6 +158,25 @@ class FrechetInceptionDistance(Metric):
         self.inception = feature
         self.feature_dim = feature_dim
 
+        from metrics_tpu.sharding import canonical_spec, class_axis_spec
+
+        if matrix_sqrt not in ("auto", "eigh", "newton_schulz"):
+            raise ValueError(
+                f"`matrix_sqrt` must be 'auto', 'eigh' or 'newton_schulz', got {matrix_sqrt!r}"
+            )
+        # canonical tuple, not PartitionSpec: fingerprint-stable config (see
+        # ConfusionMatrix.class_sharding)
+        self.feature_sharding = canonical_spec(class_axis_spec(feature_sharding)) or None
+        self.matrix_sqrt = matrix_sqrt
+        self.sqrt_iters = int(sqrt_iters)
+        if feature_dim is None and (self.feature_sharding is not None or matrix_sqrt == "newton_schulz"):
+            raise MetricsUserError(
+                "feature_sharding / matrix_sqrt='newton_schulz' operate on the"
+                " O(d^2) streaming-statistics states and need `feature_dim`"
+                " (the buffer-of-features fallback has no fixed covariance"
+                " layout to shard)."
+            )
+
         if feature_dim is not None:
             d = int(feature_dim)
             # float64 when x64 is on; otherwise compensated (Kahan) float32
@@ -136,11 +184,12 @@ class FrechetInceptionDistance(Metric):
             # the host-side float64 reconstruction at compute() keeps ~2x the
             # f32 mantissa. Both halves are plain sums, so psum sync is valid.
             acc_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            shard = self.feature_sharding  # feature axis leads every stat
             for prefix in ("real", "fake"):
-                self.add_state(f"{prefix}_sum", default=jnp.zeros((d,), acc_dtype), dist_reduce_fx="sum")
-                self.add_state(f"{prefix}_sum_c", default=jnp.zeros((d,), acc_dtype), dist_reduce_fx="sum")
-                self.add_state(f"{prefix}_outer", default=jnp.zeros((d, d), acc_dtype), dist_reduce_fx="sum")
-                self.add_state(f"{prefix}_outer_c", default=jnp.zeros((d, d), acc_dtype), dist_reduce_fx="sum")
+                self.add_state(f"{prefix}_sum", default=jnp.zeros((d,), acc_dtype), dist_reduce_fx="sum", sharding=shard)
+                self.add_state(f"{prefix}_sum_c", default=jnp.zeros((d,), acc_dtype), dist_reduce_fx="sum", sharding=shard)
+                self.add_state(f"{prefix}_outer", default=jnp.zeros((d, d), acc_dtype), dist_reduce_fx="sum", sharding=shard)
+                self.add_state(f"{prefix}_outer_c", default=jnp.zeros((d, d), acc_dtype), dist_reduce_fx="sum", sharding=shard)
                 self.add_state(f"{prefix}_n", default=jnp.asarray(0), dist_reduce_fx="sum")
         else:
             self.add_state("real_features", default=[], dist_reduce_fx="cat")
@@ -185,12 +234,40 @@ class FrechetInceptionDistance(Metric):
         cov = diff.T @ diff / (n - 1)
         return mu, cov
 
+    def _resolved_sqrt(self) -> str:
+        if self.matrix_sqrt != "auto":
+            return self.matrix_sqrt
+        return "newton_schulz" if self.feature_sharding is not None else "eigh"
+
+    def _compute_on_mesh(self) -> Array:
+        """The sharded / on-mesh FID: moments reconstructed on-device (the
+        Kahan compensation folded in at the accumulator dtype), both matrix
+        square roots by Newton–Schulz — matmuls only, so the feature-axis
+        sharding of the states flows through every product and only the
+        scalar distance is fetched. Precision: float64 under
+        ``jax_enable_x64``, else float32 with the documented
+        ``NEWTON_SCHULZ_FID_RTOL`` agreement vs the host float64 path."""
+        from metrics_tpu.sharding import linalg as _linalg
+
+        mu1, cov1 = _linalg.covariance_from_sums(
+            self.real_sum + self.real_sum_c, self.real_outer + self.real_outer_c, self.real_n
+        )
+        mu2, cov2 = _linalg.covariance_from_sums(
+            self.fake_sum + self.fake_sum_c, self.fake_outer + self.fake_outer_c, self.fake_n
+        )
+        value = _linalg.fid_from_moments(mu1, cov1, mu2, cov2, iters=self.sqrt_iters)
+        return value.astype(jnp.float32)
+
     def compute(self) -> Array:
         """FID from accumulated statistics, in float64 on host (the compute is
-        extremely precision-sensitive, reference ``fid.py:272-275``)."""
+        extremely precision-sensitive, reference ``fid.py:272-275``) — or
+        entirely on-mesh when the Newton–Schulz path is selected (see
+        ``matrix_sqrt`` / ``feature_sharding``)."""
         if self.feature_dim is not None:
             if int(self.real_n) < 2 or int(self.fake_n) < 2:
                 raise MetricsUserError("FID requires at least two samples in each distribution")
+            if self._resolved_sqrt() == "newton_schulz":
+                return self._compute_on_mesh()
             mu1, cov1 = self._stats_from_moments(
                 np.asarray(self.real_sum, np.float64) + np.asarray(self.real_sum_c, np.float64),
                 np.asarray(self.real_outer, np.float64) + np.asarray(self.real_outer_c, np.float64),

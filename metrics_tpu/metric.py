@@ -273,6 +273,15 @@ class Metric:
         # name -> jax.ShapeDtypeStruct with leading dim 0, or absent (legacy
         # float32 ``zeros((0,))`` contribution). See ``parallel/comm.empty_placeholder``.
         self._list_placeholders: Dict[str, Any] = {}
+        # per-state sharding annotations (``add_state(sharding=)``): name ->
+        # jax.sharding.PartitionSpec. Layout config, not placement — it names
+        # mesh AXES and travels with clones/pickles/checkpoints; a concrete
+        # mesh binds at ``shard_states(mesh)`` / ``engine.drive(mesh=,
+        # in_specs=)`` time. See ``metrics_tpu.sharding``.
+        self._state_shardings: Dict[str, Any] = {}
+        # the mesh the live states were last laid out over (``shard_states``),
+        # re-applied by ``reset()``; process-local — dropped on pickle/clone
+        self._shard_mesh: Optional[Any] = None
 
         self._is_synced = False
         # set by a mesh-mode ``engine.drive``: the state holds the GLOBAL
@@ -311,6 +320,7 @@ class Metric:
         persistent: bool = False,
         placeholder: Optional[Any] = None,
         sync_precision: str = "exact",
+        sharding: Optional[Any] = None,
     ) -> None:
         """Register a metric state (reference ``metric.py:122-190``).
 
@@ -336,6 +346,16 @@ class Metric:
         2-4x fewer bytes on the wire. Integer/bool payloads always pass
         through exact regardless of the tag, so counts can never be
         degraded. The default keeps today's wire v1 payload byte-for-byte.
+
+        ``sharding`` (array states only) annotates the state with a
+        model-parallel layout — a :class:`jax.sharding.PartitionSpec` (or a
+        bare mesh-axis name, shorthand for sharding the leading state axis
+        over it). The annotation is carried by :meth:`state_spec`, validated
+        by :meth:`bind_state`, and honored by :meth:`shard_states` and
+        ``engine.drive(mesh=, in_specs=)``, which pins the state to the
+        layout with ``with_sharding_constraint`` so 100k+-class classwise
+        states and covariance accumulators stay resident as 1/mp-sized
+        shards. See ``metrics_tpu.sharding`` / ``docs/distributed.md``.
         """
         if isinstance(default, list):
             if default:
@@ -369,6 +389,12 @@ class Metric:
                 f" got {sync_precision!r}"
             )
         self._sync_precisions[name] = sync_precision
+        if sharding is not None:
+            from metrics_tpu.sharding import spec as _shard_spec
+
+            self._state_shardings[name] = _shard_spec.normalize_state_sharding(
+                name, sharding, default
+            )
         self._defaults[name] = [] if isinstance(default, list) else default
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
@@ -478,13 +504,22 @@ class Metric:
         """``name -> jax.ShapeDtypeStruct`` for every array state (list
         states map to ``None``). This is the per-tenant slot layout a
         :class:`~metrics_tpu.serving.MetricBank` replicates under its
-        leading tenant axis."""
+        leading tenant axis. States registered with ``add_state(sharding=)``
+        come back as :class:`metrics_tpu.sharding.StateSpec` — the same
+        shape/dtype surface plus the registered
+        :class:`~jax.sharding.PartitionSpec` under ``.sharding``."""
         out: Dict[str, Any] = {}
         for name, default in self._defaults.items():
             if isinstance(default, list):
                 out[name] = None
+                continue
+            arr = jnp.asarray(default)
+            spec = self._state_shardings.get(name)
+            if spec is not None:
+                from metrics_tpu.sharding import StateSpec
+
+                out[name] = StateSpec(arr.shape, arr.dtype, sharding=spec)
             else:
-                arr = jnp.asarray(default)
                 out[name] = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
         return out
 
@@ -538,6 +573,21 @@ class Metric:
                     f" registered as {default.dtype} but the tree holds"
                     f" {arr.dtype} (float/integer kind mismatch)."
                 )
+            registered_sharding = self._state_shardings.get(name)
+            if registered_sharding is not None:
+                from metrics_tpu.sharding import spec as _shard_spec
+
+                # PR-8 error-naming convention: the offending state is named
+                # Class.attr so the failure is attributable to a registration
+                conflict = _shard_spec.sharding_conflict(registered_sharding, value)
+                if conflict is not None:
+                    raise MetricsUserError(
+                        f"bind_state on {type(self).__name__}: state"
+                        f" {type(self).__name__}.{name} is {conflict} —"
+                        " rebind an unsharded/replicated tree (placement will"
+                        " re-lay it out) or one already partitioned per the"
+                        " registered spec."
+                    )
             bound[name] = arr.astype(default.dtype)
         self._restore_state(bound)
         if update_count is not None:
@@ -550,6 +600,19 @@ class Metric:
             np.asarray(state[_health.HEALTH_STATE]) if _health.HEALTH_STATE in state else None,
         )
         return self
+
+    def shard_states(self, mesh: Any) -> "Metric":
+        """Lay the live states out over ``mesh`` per their registered
+        ``add_state(sharding=)`` annotations (``jax.device_put`` with a
+        ``NamedSharding`` per spec; unannotated states are untouched) and
+        remember the mesh so :meth:`reset` re-applies the layout to fresh
+        defaults. The eager-use entry point to the model-parallel state
+        plane — ``engine.drive(mesh=, in_specs=)`` does this implicitly for
+        the scan carry. The mesh binding is process-local: clones and
+        pickles keep the *annotations* but not the placement."""
+        from metrics_tpu.sharding import place_states
+
+        return place_states(self, mesh)
 
     @property
     def _states_mergeable(self) -> bool:
@@ -887,6 +950,12 @@ class Metric:
         self._computed = None
         for name in self._defaults:
             setattr(self, name, self._default_value(name))
+        if self.__dict__.get("_shard_mesh") is not None and self._state_shardings:
+            # the sharding annotation survives reset like every other piece
+            # of registration config: fresh defaults go back onto the mesh
+            from metrics_tpu.sharding import place_states
+
+            place_states(self, self._shard_mesh)
         self._cache = None
         self._is_synced = False
         # a mesh-mode engine.drive leaves `_to_sync = False` (its in-trace
@@ -1224,6 +1293,9 @@ class Metric:
                 "_engine_key_pins",
                 "_inner_update",
                 "_compute_impl",
+                # a Mesh holds live device handles — process-local by nature;
+                # the sharding ANNOTATIONS (_state_shardings) do travel
+                "_shard_mesh",
             )
         }
         # device arrays -> numpy for portability
@@ -1271,6 +1343,8 @@ class Metric:
         self.__dict__.setdefault("_list_placeholders", {})
         self.__dict__.setdefault("_sync_precisions", {})
         self.__dict__.setdefault("_drive_synced", False)
+        self.__dict__.setdefault("_state_shardings", {})
+        self.__dict__.setdefault("_shard_mesh", None)
         for name in self._defaults:
             v = getattr(self, name, None)
             if isinstance(v, list):

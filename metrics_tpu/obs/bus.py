@@ -61,7 +61,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: AOT-compiled at worker start — ``event`` is ``program`` per executable,
 #: ``complete`` for the run summary), ``warmup_stale`` (a serve-time
 #: compile landed on a manifest-covered program family — carries the
-#: ``explain`` payload naming the changed cache-key component). Misc:
+#: ``explain`` payload naming the changed cache-key component). Sharded
+#: states (``metrics_tpu.sharding``): ``reshard`` (state leaves were laid
+#: out onto a mesh — ``leaves`` moved, ``mesh_axes`` names axis sizes; a
+#: drive whose carry already sits in place emits none). Misc:
 #: ``warning`` (a ``warn_once`` emission).
 EVENT_KINDS = (
     "compile",
@@ -79,6 +82,7 @@ EVENT_KINDS = (
     "sync",
     "drive",
     "fetch",
+    "reshard",
     "admit",
     "evict",
     "flush",

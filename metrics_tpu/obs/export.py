@@ -35,6 +35,12 @@ JSONL_SCHEMA_VERSION = 1
 _EVENT_REQUIRED_FIELDS = ("v", "seq", "kind", "t", "source", "data")
 
 
+def _shard_stats() -> Dict[str, Any]:
+    from metrics_tpu.sharding import shard_stats
+
+    return shard_stats()
+
+
 def process_snapshot() -> Dict[str, Any]:
     """The process-wide observability view (no metric argument needed)."""
     from metrics_tpu import engine as _engine
@@ -53,6 +59,9 @@ def process_snapshot() -> Dict[str, Any]:
         # AOT warmup manifests (engine/warmup.py): manifest load/record
         # state, programs warmed, warm-store hits, staleness events
         "warmup": _engine.warmup_report(),
+        # sharded metric states (metrics_tpu.sharding): registered specs,
+        # resharding events, sharded drives, per-device resident bytes
+        "sharding": _shard_stats(),
         "bus": _bus.summary(),
         "spans": _trace.span_summary(),
         "warnings": {repr(k): v for k, v in _warn.warn_counts().items()},
@@ -231,6 +240,25 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
     for codec in sorted(wire["codec_counts"]):
         _sample("metrics_tpu_wire_payloads_total", wire["codec_counts"][codec], {"codec": codec})
     _sample("metrics_tpu_wire_max_dequant_error", wire["max_dequant_error"], kind="gauge")
+
+    # sharded metric states: layout moves, sharded drives, resident bytes
+    shard = _shard_stats()
+    for key in ("sharded_drives", "reshard_events"):
+        _sample(f"metrics_tpu_shard_{key}", shard[key])
+    _sample("metrics_tpu_shard_registered_specs", len(shard["specs"]), kind="gauge")
+    for state_key in sorted(shard["resident"]):
+        resident = shard["resident"][state_key]
+        labels = {"state": state_key, "spec": shard["specs"].get(state_key, "")}
+        _sample(
+            "metrics_tpu_shard_resident_bytes_per_device",
+            resident["per_device_bytes"],
+            labels,
+            kind="gauge",
+        )
+        _sample(
+            "metrics_tpu_shard_state_bytes_total", resident["total_bytes"], labels, kind="gauge"
+        )
+        _sample("metrics_tpu_shard_state_devices", resident["devices"], labels, kind="gauge")
 
     # AOT warmup manifests: warmed program inventory + staleness counters
     warm = _engine.warmup_report()

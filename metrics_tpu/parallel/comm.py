@@ -55,6 +55,23 @@ def world_size() -> int:
     return sim[1] if sim is not None else jax.process_count()
 
 
+def mesh_spans_processes(mesh: Optional[Any]) -> bool:
+    """True when a mesh's devices live on more than one JAX process.
+
+    The discriminator between the two "already globally synced" cases after
+    an in-trace-synced ``engine.drive``: a multi-process mesh means the
+    program's collectives crossed process boundaries, so the host-level
+    gather must be disarmed (it would reduce identical global totals again);
+    a single-process mesh leaves the host sync contract untouched.
+    """
+    if mesh is None:
+        return False
+    try:
+        return len({d.process_index for d in mesh.devices.flat}) > 1
+    except Exception:  # noqa: BLE001 — unknown mesh-like: assume single-process
+        return False
+
+
 def process_index() -> int:
     sim = _simulated_process()
     return sim[0] if sim is not None else jax.process_index()

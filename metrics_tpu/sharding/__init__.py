@@ -1,0 +1,37 @@
+"""Sharded metric states: a model-parallel state plane.
+
+Shard the *state itself* over a mesh axis — class-axis-sharded confusion
+matrices and classwise stat scores for 100k+-class vocabularies, feature-
+axis-sharded FID covariance accumulation with an on-mesh Newton–Schulz
+matrix square root — so metrics whose state outgrows one device never funnel
+to a single host. See ``docs/distributed.md`` ("Sharded metric states") for
+the PartitionSpec contract and the dp-vs-mp axis semantics.
+
+* :mod:`metrics_tpu.sharding.spec` — ``add_state(sharding=PartitionSpec(...))``
+  registration, placement (``Metric.shard_states(mesh)``), and the
+  process-wide telemetry behind ``obs.snapshot()["sharding"]``.
+* :mod:`metrics_tpu.sharding.reduce` — the GSPMD epoch plumbing for
+  ``engine.drive(mesh=, in_specs=)``: batch-axis data-parallel inputs,
+  ``with_sharding_constraint``-pinned state carries, derived dp reductions.
+* :mod:`metrics_tpu.sharding.linalg` — matmul-only dense linear algebra
+  (Newton–Schulz matrix square root) that runs over sharded operands.
+"""
+from metrics_tpu.sharding.linalg import (  # noqa: F401
+    NEWTON_SCHULZ_FID_RTOL,
+    fid_from_moments,
+    newton_schulz_sqrtm,
+)
+from metrics_tpu.sharding.reduce import (  # noqa: F401
+    constrain_state_tree,
+    mesh_spans_processes,
+    normalize_in_specs,
+    stage_epoch_inputs,
+)
+from metrics_tpu.sharding.spec import (  # noqa: F401
+    StateSpec,
+    canonical_spec,
+    class_axis_spec,
+    place_states,
+    reset_shard_stats,
+    shard_stats,
+)

@@ -1,0 +1,150 @@
+"""Sharded-epoch plumbing for ``engine.drive(mesh=, in_specs=)``.
+
+The driver's original mesh mode (``axis_name=``) is *data-parallel*: steps
+are sharded over one axis under ``shard_map``, each shard scans its slice
+from the defaults, and ``parallel/comm.sync_state_trees`` folds the per-shard
+states back together. That mode replicates every state on every device — the
+exact assumption giant-vocab and covariance states break.
+
+This module carries the *model-parallel* mode (GSPMD automatic partitioning,
+the pjit discipline of arXiv:2204.06514): the epoch stays ONE scan program,
+the **batch** axis of every input is sharded over the data axis
+(``in_specs``), and the state carry is pinned to each state's registered
+:class:`~jax.sharding.PartitionSpec` with ``jax.lax.with_sharding_constraint``
+— XLA's SPMD partitioner then keeps every classwise/covariance state resident
+as 1/mp-sized shards and inserts the dp-axis partial-sum reduction itself
+(the same all-reduce ``sync_state_trees`` would have folded in, derived
+instead of hand-written, with the mp axis never gathered).
+"""
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from metrics_tpu.sharding import spec as _spec
+
+__all__ = [
+    "constrain_state_tree",
+    "mesh_spans_processes",
+    "normalize_in_specs",
+    "stage_epoch_inputs",
+    "state_shardings_key",
+]
+
+
+def normalize_in_specs(in_specs: Any, n_args: int) -> Tuple[PartitionSpec, ...]:
+    """Canonicalize ``drive(in_specs=)``: one spec per stacked top-level
+    update argument (a single spec broadcasts to all). Each spec describes
+    the stacked ``[steps, batch, ...]`` layout — the steps axis (dim 0) must
+    stay unsharded (the scan consumes it sequentially; for step-sharded
+    epochs use the ``axis_name=`` shard_map mode instead)."""
+    if isinstance(in_specs, PartitionSpec) or isinstance(in_specs, str):
+        in_specs = (in_specs,) * n_args
+    specs = []
+    for i, entry in enumerate(tuple(in_specs)):
+        if isinstance(entry, str):
+            entry = PartitionSpec(entry)
+        if entry is None:
+            entry = PartitionSpec()
+        if not isinstance(entry, PartitionSpec):
+            raise ValueError(
+                f"drive(in_specs=...): entry {i} must be a PartitionSpec (or"
+                f" None for replicated), got {entry!r}"
+            )
+        if len(entry) > 0 and entry[0] is not None:
+            raise ValueError(
+                f"drive(in_specs=...): entry {i} shards the leading STEPS axis"
+                f" ({entry}); shard the batch axis (e.g. PartitionSpec(None,"
+                " 'dp')) — the scan consumes steps sequentially. For"
+                " step-sharded epochs use drive(axis_name=, mesh=)."
+            )
+        specs.append(entry)
+    if len(specs) != n_args:
+        raise ValueError(
+            f"drive(in_specs=...) has {len(specs)} specs for {n_args} stacked"
+            " update arguments; pass one spec per argument (or a single spec"
+            " to broadcast)."
+        )
+    return tuple(specs)
+
+
+def stage_epoch_inputs(
+    mesh: Any, in_specs: Sequence[PartitionSpec], leaves: Sequence[Any]
+) -> List[Any]:
+    """Device-put the stacked epoch leaves with their ``NamedSharding`` so
+    the one-launch epoch starts from batch-sharded inputs instead of an
+    implicit broadcast-then-reshard."""
+    staged = []
+    for leaf, spec in zip(leaves, in_specs):
+        staged.append(jax.device_put(leaf, _spec.named_sharding(mesh, spec)))
+    return staged
+
+
+def state_shardings_key(
+    keys: Sequence[str], members: Sequence[Any]
+) -> Tuple[Tuple[str, Tuple[Tuple[str, Tuple], ...]], ...]:
+    """Hashable per-member state-sharding summary for the driver cache key:
+    ``((member_key, ((state, canonical_spec), ...)), ...)`` — members without
+    annotations contribute nothing, so unannotated collections key exactly
+    as before."""
+    out = []
+    for key, member in zip(keys, members):
+        shardings = getattr(member, "_state_shardings", None)
+        if not shardings:
+            continue
+        entries = tuple(
+            sorted((name, _spec.canonical_spec(s)) for name, s in shardings.items())
+        )
+        if entries:
+            out.append((key, entries))
+    return tuple(out)
+
+
+def build_constraints(
+    keys: Sequence[str], members: Sequence[Any], mesh: Any
+) -> Dict[str, Dict[str, NamedSharding]]:
+    """Member key -> state name -> ``NamedSharding`` for every registered
+    annotation — the closure :func:`constrain_state_tree` pins the scan carry
+    with."""
+    out: Dict[str, Dict[str, NamedSharding]] = {}
+    for key, member in zip(keys, members):
+        shardings = getattr(member, "_state_shardings", None)
+        if shardings:
+            out[key] = {name: _spec.named_sharding(mesh, s) for name, s in shardings.items()}
+    return out
+
+
+def constrain_state_tree(
+    states: Dict[str, Dict[str, Any]], constraints: Dict[str, Dict[str, NamedSharding]]
+) -> Dict[str, Dict[str, Any]]:
+    """Pin every annotated state leaf to its registered layout inside a
+    trace (``lax.with_sharding_constraint``); unannotated leaves pass
+    through. Applied to the scan carry each step, so XLA keeps the sharded
+    accumulators resident instead of gathering them between steps."""
+    if not constraints:
+        return states
+    out: Dict[str, Dict[str, Any]] = {}
+    for key, state in states.items():
+        member_ns = constraints.get(key)
+        if not member_ns:
+            out[key] = state
+            continue
+        new = dict(state)
+        for name, ns in member_ns.items():
+            value = new.get(name)
+            if value is not None and not isinstance(value, list):
+                new[name] = lax.with_sharding_constraint(value, ns)
+        out[key] = new
+    return out
+
+
+def mesh_spans_processes(mesh: Optional[Any]) -> bool:
+    """True when the mesh's devices live on more than one JAX process — the
+    case where a GSPMD drive's collectives already produced the globally
+    reduced state and the host-level sync must be disarmed. (Canonical
+    implementation lives with the rest of the process-topology logic in
+    :mod:`metrics_tpu.parallel.comm`.)"""
+    from metrics_tpu.parallel import comm
+
+    return comm.mesh_spans_processes(mesh)

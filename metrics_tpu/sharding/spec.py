@@ -1,0 +1,291 @@
+"""Per-state sharding layout: registration, placement, telemetry.
+
+The distributed story before this module was data-parallel only: states were
+replicated per replica and *folded* (psum / host gather) at sync time — which
+assumes every metric's state fits on one device. Giant-vocab classwise states
+(100k+-class confusion matrices, per-class stat scores) and the FID covariance
+pipeline break that assumption; following the pjit/GSPMD discipline of
+"Scalable Training of Language Models using JAX pjit and TPUv4"
+(arXiv:2204.06514) and the distributed-linear-algebra layout of
+arXiv:2112.09017, this package shards the *state itself* over a model-parallel
+mesh axis:
+
+* **Registration** — ``Metric.add_state(..., sharding=PartitionSpec('mp'))``
+  annotates an array state with the mesh-axis layout its leaves should keep.
+  The annotation is config, not placement: it travels with the instance
+  through clones, pickles, checkpoints and resets, and names mesh *axes*
+  (not devices), so one registration serves any mesh that defines the axis.
+* **Placement** — :func:`place_states` / ``Metric.shard_states(mesh)`` lay a
+  live instance's states out over a concrete mesh (``jax.device_put`` with a
+  ``NamedSharding`` per registered spec); ``engine.drive(mesh=, in_specs=)``
+  does the same for the scan carry and pins it with
+  ``jax.lax.with_sharding_constraint`` inside the compiled epoch.
+* **Telemetry** — :func:`shard_stats` (surfaced as
+  ``obs.snapshot()["sharding"]`` and the ``metrics_tpu_shard_*`` Prometheus
+  gauges) tracks registered specs, resharding events, sharded drives, and the
+  per-device resident bytes of each sharded state — the number the whole
+  exercise is about.
+"""
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+__all__ = [
+    "StateSpec",
+    "canonical_spec",
+    "class_axis_spec",
+    "named_sharding",
+    "normalize_state_sharding",
+    "place_state_dict",
+    "place_states",
+    "reset_shard_stats",
+    "shard_stats",
+    "sharding_conflict",
+    "spec_of_value",
+]
+
+
+class StateSpec(jax.ShapeDtypeStruct):
+    """A :class:`jax.ShapeDtypeStruct` that also carries the registered
+    ``sharding`` annotation (a :class:`~jax.sharding.PartitionSpec`, or
+    ``None`` for replicated). This is what :meth:`Metric.state_spec` returns
+    for states registered with ``add_state(sharding=...)`` — shape/dtype
+    consumers (banks, checkpoints) keep working unchanged, layout-aware
+    consumers read ``.sharding``."""
+
+    def __init__(self, shape: Tuple[int, ...], dtype: Any, sharding: Optional[PartitionSpec] = None):
+        # the base constructor only admits concrete jax.sharding.Sharding
+        # objects (device-bound); a registration is a mesh-free
+        # PartitionSpec, so it is assigned after the base init — `sharding`
+        # is a plain instance attribute there, initialized to None
+        super().__init__(shape, dtype)
+        self.sharding = sharding
+
+
+def normalize_state_sharding(name: str, sharding: Any, default: Any) -> PartitionSpec:
+    """Validate and canonicalize one ``add_state(sharding=)`` annotation.
+
+    Accepts a :class:`~jax.sharding.PartitionSpec`, a bare mesh-axis name
+    (``'mp'`` — shorthand for ``PartitionSpec('mp')``: the leading state axis
+    sharded over that axis), or a tuple of axis entries. List states cannot
+    be sharded (their sync contract is the ragged gather), and the spec may
+    not name more dimensions than the registered default has.
+    """
+    if isinstance(default, list):
+        raise ValueError(
+            f"`sharding` for state {name!r}: list ('cat' buffer) states cannot"
+            " carry a sharding annotation — only array states have a stable"
+            " layout to shard."
+        )
+    if isinstance(sharding, str):
+        sharding = PartitionSpec(sharding)
+    elif isinstance(sharding, tuple) and not isinstance(sharding, PartitionSpec):
+        sharding = PartitionSpec(*sharding)
+    if not isinstance(sharding, PartitionSpec):
+        raise ValueError(
+            f"`sharding` for state {name!r} must be a jax.sharding.PartitionSpec"
+            f" (or a mesh-axis name / tuple of entries), got {sharding!r}"
+        )
+    ndim = np.asarray(default).ndim
+    if len(sharding) > ndim:
+        raise ValueError(
+            f"`sharding` for state {name!r} names {len(sharding)} dimensions"
+            f" but the registered default has rank {ndim}: {sharding}"
+        )
+    return sharding
+
+
+def canonical_spec(spec: Optional[PartitionSpec]) -> Tuple:
+    """Hashable canonical form: trailing ``None`` entries trimmed (``P('mp')``
+    and ``P('mp', None)`` describe the same layout)."""
+    if spec is None:
+        return ()
+    entries = tuple(spec)
+    while entries and entries[-1] is None:
+        entries = entries[:-1]
+    return entries
+
+
+def class_axis_spec(class_sharding: Any) -> Optional[PartitionSpec]:
+    """Normalize a classification metric's ``class_sharding`` argument —
+    ``None``, a mesh-axis name, or a PartitionSpec — to the spec for a
+    leading-class-axis state (``[C, ...]``)."""
+    if class_sharding is None:
+        return None
+    if isinstance(class_sharding, PartitionSpec):
+        return class_sharding
+    if isinstance(class_sharding, str):
+        return PartitionSpec(class_sharding)
+    raise ValueError(
+        "`class_sharding` must be a mesh-axis name (e.g. 'mp') or a"
+        f" jax.sharding.PartitionSpec, got {class_sharding!r}"
+    )
+
+
+def named_sharding(mesh: Any, spec: PartitionSpec) -> NamedSharding:
+    """The single construction point for binding a registered (mesh-free)
+    spec to a concrete mesh — placement, staging, and the in-trace
+    constraints all route through here."""
+    return NamedSharding(mesh, spec)
+
+
+def spec_of_value(value: Any) -> Optional[PartitionSpec]:
+    """The :class:`PartitionSpec` a live array is laid out with, or ``None``
+    when it is unsharded (single-device / replicated / not a jax array)."""
+    sharding = getattr(value, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    return spec if canonical_spec(spec) else None
+
+
+def sharding_conflict(registered: PartitionSpec, bound: Any) -> Optional[str]:
+    """``None`` when a bound array's live layout is compatible with the
+    registered spec, else a human-readable description of the conflict.
+
+    Compatible means: unsharded/replicated (placement can re-lay it out), or
+    partitioned exactly along the registered spec. A value partitioned over a
+    *different* axis assignment conflicts — silently accepting it would make
+    every later ``with_sharding_constraint`` a hidden resharding collective.
+    """
+    live = spec_of_value(bound)
+    if live is None:
+        return None
+    if canonical_spec(live) != canonical_spec(registered):
+        return f"laid out as {live} but registered with sharding {registered}"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# process-wide telemetry (obs.snapshot()["sharding"], metrics_tpu_shard_*)
+# ---------------------------------------------------------------------------
+_STATS_LOCK = threading.Lock()
+
+
+def _new_stats() -> Dict[str, Any]:
+    return {
+        # engine.drive(mesh=, in_specs=) epochs executed with sharded carries
+        "sharded_drives": 0,
+        # device_put placements of state leaves onto a mesh (place_states /
+        # drive staging) — each is a host->mesh or mesh->mesh layout move
+        "reshard_events": 0,
+        # registered annotations seen at placement/drive time:
+        # "Class.state" -> str(PartitionSpec)
+        "specs": {},
+        # live layout observed at the LAST placement/drive per sharded state:
+        # "Class.state" -> {per_device_bytes, total_bytes, devices}
+        "resident": {},
+    }
+
+
+_STATS = _new_stats()
+
+
+def shard_stats() -> Dict[str, Any]:
+    """Process-wide sharded-state telemetry (see module docstring)."""
+    with _STATS_LOCK:
+        out = dict(_STATS)
+        out["specs"] = dict(_STATS["specs"])
+        out["resident"] = {k: dict(v) for k, v in _STATS["resident"].items()}
+    return out
+
+
+def reset_shard_stats() -> None:
+    with _STATS_LOCK:
+        _STATS.clear()
+        _STATS.update(_new_stats())
+
+
+def _record_resident(state_key: str, spec: PartitionSpec, value: Any) -> None:
+    """Record one sharded leaf's live footprint (caller holds no lock)."""
+    try:
+        shards = value.addressable_shards
+        per_device = max((s.data.nbytes for s in shards), default=int(value.nbytes))
+        devices = len(value.sharding.device_set)
+    except Exception:  # noqa: BLE001 — telemetry only; never break placement
+        per_device = int(getattr(value, "nbytes", 0))
+        devices = 1
+    with _STATS_LOCK:
+        _STATS["specs"][state_key] = str(spec)
+        _STATS["resident"][state_key] = {
+            "per_device_bytes": int(per_device),
+            "total_bytes": int(getattr(value, "nbytes", 0)),
+            "devices": int(devices),
+        }
+
+
+def _count_reshard(n: int, source: str, mesh: Any) -> None:
+    if n <= 0:
+        return
+    with _STATS_LOCK:
+        _STATS["reshard_events"] += n
+    from metrics_tpu.obs import bus as _bus
+
+    if _bus.enabled():
+        _bus.emit(
+            "reshard",
+            source=source,
+            leaves=n,
+            mesh_axes={k: int(v) for k, v in dict(mesh.shape).items()},
+        )
+
+
+def count_sharded_drive() -> None:
+    with _STATS_LOCK:
+        _STATS["sharded_drives"] += 1
+
+
+def place_state_dict(
+    state: Dict[str, Any], metric: Any, mesh: Any, source: Optional[str] = None
+) -> Dict[str, Any]:
+    """Lay one state dict out over ``mesh`` per the metric's registered
+    shardings (leaves without an annotation are left untouched). Returns the
+    new dict; records reshard telemetry for every moved leaf."""
+    shardings = getattr(metric, "_state_shardings", None)
+    if not shardings:
+        return state
+    cls = type(metric).__name__
+    out = dict(state)
+    moved = 0
+    for name, spec in shardings.items():
+        value = out.get(name)
+        if value is None or isinstance(value, list):
+            continue
+        target = named_sharding(mesh, spec)
+        if getattr(value, "sharding", None) != target:
+            value = jax.device_put(value, target)
+            moved += 1
+        out[name] = value
+        _record_resident(f"{cls}.{name}", spec, value)
+    _count_reshard(moved, source or cls, mesh)
+    return out
+
+
+def place_states(metric: Any, mesh: Any) -> Any:
+    """Lay a live metric's registered-sharded states out over ``mesh`` and
+    remember the mesh (``metric._shard_mesh``) so :meth:`Metric.reset`
+    re-applies the layout to fresh defaults. The body of
+    ``Metric.shard_states``."""
+    placed = place_state_dict(metric._snapshot_state(), metric, mesh)
+    metric._restore_state(placed)
+    metric._shard_mesh = mesh
+    return metric
+
+
+def record_drive(fused: Any, mesh: Any) -> None:
+    """Post-drive bookkeeping for ``engine.drive(mesh=, in_specs=)``: count
+    the sharded epoch and refresh the resident-bytes view of every sharded
+    state the scan carried."""
+    count_sharded_drive()
+    for _key, member in fused:
+        shardings = getattr(member, "_state_shardings", None)
+        if not shardings:
+            continue
+        cls = type(member).__name__
+        for name, spec in shardings.items():
+            value = getattr(member, name, None)
+            if value is not None and not isinstance(value, list):
+                _record_resident(f"{cls}.{name}", spec, value)

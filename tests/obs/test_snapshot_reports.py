@@ -291,16 +291,32 @@ def test_jsonl_roundtrip_and_prometheus_render():
     # the serving plane, that includes the async-fetch counters and the
     # per-bank serving summary
     process = obs.snapshot()
-    assert set(process) == {"engine", "fetch", "serving", "wire", "warmup", "bus", "spans", "warnings"}
+    assert set(process) == {
+        "engine",
+        "fetch",
+        "serving",
+        "wire",
+        "warmup",
+        "sharding",
+        "bus",
+        "spans",
+        "warnings",
+    }
     assert process["engine"] == engine.cache_summary()
     assert process["fetch"] == engine.fetch_stats()
     assert set(process["fetch"]) == {"async_fetches", "coalesced_leaves"}
     assert process["warmup"] == engine.warmup_report()
-    # ...and the Prometheus dump mirrors the fetch + warmup counters
+    from metrics_tpu import sharding as _sharding
+
+    assert process["sharding"] == _sharding.shard_stats()
+    assert set(process["sharding"]) == {"sharded_drives", "reshard_events", "specs", "resident"}
+    # ...and the Prometheus dump mirrors the fetch + warmup + sharding counters
     assert "metrics_tpu_engine_async_fetches" in text
     assert "metrics_tpu_engine_coalesced_leaves" in text
     assert "metrics_tpu_warmup_programs_warmed" in text
     assert "metrics_tpu_warmup_stale_total" in text
+    assert "metrics_tpu_shard_sharded_drives" in text
+    assert "metrics_tpu_shard_reshard_events" in text
 
 
 def test_validate_jsonl_rejects_bad_lines():

@@ -1,0 +1,301 @@
+"""2D-mesh (dp×mp) parity suite for the sharded-state plane.
+
+Every case runs on the 8-virtual-device CPU lane (4 mp shards × 2 dp
+shards): a sharded ``engine.drive(mesh=, in_specs=)`` epoch must be
+bit-or-tolerance-identical to the unsharded single-replica run, with each
+device holding only its slice of the annotated states.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import (
+    ConfusionMatrix,
+    FrechetInceptionDistance,
+    MetricCollection,
+    StatScores,
+    engine,
+)
+from metrics_tpu import sharding as shd
+from metrics_tpu.utils.checkpoint import metric_state_pytree, restore_metric_state_pytree
+
+NUM_CLASSES = 64
+IN_SPECS = P(None, "dp")
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    engine.clear_cache()
+    shd.reset_shard_stats()
+    yield
+    engine.clear_cache()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    assert len(devs) >= 8
+    return Mesh(np.array(devs[:8]).reshape(2, 4), ("dp", "mp"))
+
+
+def _int_epoch(rng, n_steps=6, batch=16, c=NUM_CLASSES):
+    return (
+        jnp.asarray(rng.randint(0, c, size=(n_steps, batch)).astype(np.int32)),
+        jnp.asarray(rng.randint(0, c, size=(n_steps, batch)).astype(np.int32)),
+    )
+
+
+def _per_device_ratio(state):
+    return state.nbytes / max(s.data.nbytes for s in state.addressable_shards)
+
+
+# ---------------------------------------------------------------------------
+# ConfusionMatrix: class-axis-sharded [C, C] and multilabel [C, 2, 2]
+# ---------------------------------------------------------------------------
+def test_confusion_matrix_sharded_drive_bit_identical(mesh):
+    rng = np.random.RandomState(0)
+    epoch = _int_epoch(rng)
+    ref = ConfusionMatrix(num_classes=NUM_CLASSES)
+    engine.drive(ref, epoch)
+    sh = ConfusionMatrix(num_classes=NUM_CLASSES, class_sharding="mp")
+    res = engine.drive(sh, epoch, mesh=mesh, in_specs=IN_SPECS)
+    assert res.fused_keys == ("_",)
+    assert np.array_equal(np.asarray(sh.compute()), np.asarray(ref.compute()))
+    # the class-axis rows live as 1/mp shards on the mesh
+    assert sh.confmat.sharding.spec == P("mp")
+    assert _per_device_ratio(sh.confmat) >= 4.0
+    # single-process mesh: the metric stays fully usable afterwards
+    sh.update(epoch[0][0], epoch[1][0])
+    ref.update(epoch[0][0], epoch[1][0])
+    assert np.array_equal(np.asarray(sh.compute()), np.asarray(ref.compute()))
+    # a driven member is mesh-bound: reset() re-places fresh defaults
+    sh.reset()
+    assert sh.confmat.sharding.spec == P("mp")
+    assert int(jnp.sum(sh.confmat)) == 0
+
+
+def test_confusion_matrix_multilabel_sharded_parity(mesh):
+    rng = np.random.RandomState(1)
+    c = 96
+    # float probabilities -> the true MULTILABEL input path (threshold
+    # binarizes); int same-rank preds would be read as multidim-multiclass
+    preds = jnp.asarray(rng.rand(4, 8, c).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, size=(4, 8, c)).astype(np.int32))
+    ref = ConfusionMatrix(num_classes=c, multilabel=True)
+    engine.drive(ref, (preds, target))
+    sh = ConfusionMatrix(num_classes=c, multilabel=True, class_sharding="mp")
+    engine.drive(sh, (preds, target), mesh=mesh, in_specs=IN_SPECS)
+    assert np.array_equal(np.asarray(sh.confmat), np.asarray(ref.confmat))
+    assert _per_device_ratio(sh.confmat) >= 4.0
+
+
+def test_repeat_sharded_drive_compiles_nothing_extra(mesh):
+    rng = np.random.RandomState(2)
+    epoch = _int_epoch(rng)
+
+    def driver_compiles():
+        return engine.cache_summary()["by_kind"].get("driver", {}).get("compiles", 0)
+
+    ref = ConfusionMatrix(num_classes=NUM_CLASSES)
+    before = driver_compiles()
+    engine.drive(ref, epoch)
+    unsharded = driver_compiles() - before
+
+    sh = ConfusionMatrix(num_classes=NUM_CLASSES, class_sharding="mp")
+    before = driver_compiles()
+    engine.drive(sh, epoch, mesh=mesh, in_specs=IN_SPECS)
+    sharded = driver_compiles() - before
+    # same cache-key count: sharding adds no extra program family
+    assert sharded == unsharded
+    before = driver_compiles()
+    engine.drive(sh, epoch, mesh=mesh, in_specs=IN_SPECS)
+    assert driver_compiles() - before == 0
+    # a CLONE shares the compiled sharded epoch too (same fingerprint)
+    clone = sh.clone()
+    clone.reset()
+    before = driver_compiles()
+    engine.drive(clone, epoch, mesh=mesh, in_specs=IN_SPECS)
+    assert driver_compiles() - before == 0
+
+
+# ---------------------------------------------------------------------------
+# StatScores: classwise [C] counters, incl. health policies inside the scan
+# ---------------------------------------------------------------------------
+def test_stat_scores_sharded_parity(mesh):
+    rng = np.random.RandomState(3)
+    epoch = _int_epoch(rng)
+    ref = StatScores(reduce="macro", num_classes=NUM_CLASSES)
+    engine.drive(ref, epoch)
+    sh = StatScores(reduce="macro", num_classes=NUM_CLASSES, class_sharding="mp")
+    engine.drive(sh, epoch, mesh=mesh, in_specs=IN_SPECS)
+    assert np.array_equal(np.asarray(sh.compute()), np.asarray(ref.compute()))
+    for name in ("tp", "fp", "tn", "fn"):
+        state = getattr(sh, name)
+        assert state.sharding.spec == P("mp")
+        assert _per_device_ratio(state) >= 4.0
+
+
+@pytest.mark.parametrize("policy", ["skip", "mask"])
+def test_health_policies_inside_the_sharded_scan(mesh, policy):
+    """on_bad_input='skip'/'mask' semantics are bit-identical between the
+    sharded scan and the unsharded per-step loop (same traced_update body)."""
+    rng = np.random.RandomState(4)
+    n_steps, batch = 6, 16
+    preds = rng.rand(n_steps, batch, NUM_CLASSES).astype(np.float32)
+    preds[1, :3, 0] = np.nan  # contaminate one step's rows
+    preds[4, 5, 2] = np.inf
+    target = rng.randint(0, NUM_CLASSES, size=(n_steps, batch)).astype(np.int32)
+    epoch = (jnp.asarray(preds), jnp.asarray(target))
+
+    ref = StatScores(reduce="macro", num_classes=NUM_CLASSES, on_bad_input=policy)
+    for i in range(n_steps):
+        ref.update(epoch[0][i], epoch[1][i])
+    sh = StatScores(
+        reduce="macro", num_classes=NUM_CLASSES, class_sharding="mp", on_bad_input=policy
+    )
+    engine.drive(sh, epoch, mesh=mesh, in_specs=IN_SPECS)
+    assert np.array_equal(np.asarray(sh.compute()), np.asarray(ref.compute()))
+    ref_health = ref.health_report()
+    sh_health = sh.health_report()
+    for key in ("nan_count", "inf_count", "rows_masked", "updates_quarantined"):
+        assert sh_health[key] == ref_health[key], (policy, key)
+
+
+def test_collection_sharded_drive(mesh):
+    rng = np.random.RandomState(5)
+    epoch = _int_epoch(rng)
+    ref = MetricCollection(
+        {
+            "cm": ConfusionMatrix(num_classes=NUM_CLASSES),
+            "ss": StatScores(reduce="macro", num_classes=NUM_CLASSES),
+        }
+    )
+    engine.drive(ref, epoch)
+    sh = MetricCollection(
+        {
+            "cm": ConfusionMatrix(num_classes=NUM_CLASSES, class_sharding="mp"),
+            "ss": StatScores(reduce="macro", num_classes=NUM_CLASSES, class_sharding="mp"),
+        }
+    )
+    res = engine.drive(sh, epoch, mesh=mesh, in_specs=IN_SPECS)
+    assert set(res.fused_keys) == {"cm", "ss"}
+    ref_vals, sh_vals = ref.compute(), sh.compute()
+    for key in ref_vals:
+        assert np.array_equal(np.asarray(sh_vals[key]), np.asarray(ref_vals[key])), key
+
+
+# ---------------------------------------------------------------------------
+# checkpoints of sharded states
+# ---------------------------------------------------------------------------
+def test_checkpoint_round_trip_of_driven_sharded_states(mesh):
+    rng = np.random.RandomState(6)
+    epoch = _int_epoch(rng)
+    sh = ConfusionMatrix(num_classes=NUM_CLASSES, class_sharding="mp")
+    engine.drive(sh, epoch, mesh=mesh, in_specs=IN_SPECS)
+    tree = metric_state_pytree(sh)
+    fresh = ConfusionMatrix(num_classes=NUM_CLASSES, class_sharding="mp")
+    restore_metric_state_pytree(fresh, tree)
+    assert np.array_equal(np.asarray(fresh.compute()), np.asarray(sh.compute()))
+    # driving the restored instance keeps accumulating correctly, sharded
+    engine.drive(fresh, epoch, mesh=mesh, in_specs=IN_SPECS)
+    assert np.array_equal(np.asarray(fresh.confmat), 2 * np.asarray(sh.confmat))
+
+
+# ---------------------------------------------------------------------------
+# in_specs / mode validation
+# ---------------------------------------------------------------------------
+def test_in_specs_mode_validation(mesh):
+    rng = np.random.RandomState(7)
+    epoch = _int_epoch(rng)
+    m = ConfusionMatrix(num_classes=NUM_CLASSES, class_sharding="mp")
+    with pytest.raises(ValueError, match="mesh"):
+        engine.drive(m, epoch, in_specs=IN_SPECS)
+    with pytest.raises(ValueError, match="one or the other"):
+        engine.drive(m, epoch, mesh=mesh, axis_name="dp", in_specs=IN_SPECS)
+    with pytest.raises(ValueError, match="STEPS axis"):
+        engine.drive(m, epoch, mesh=mesh, in_specs=P("dp"))
+    with pytest.raises(ValueError, match="stacked"):
+        engine.drive(m, iter([(epoch[0][0], epoch[1][0])]), mesh=mesh, in_specs=IN_SPECS)
+    # a member that cannot ride the scan is rejected loudly (same strictness
+    # as the axis_name mode), not silently driven unsharded per-step
+    eager_member = ConfusionMatrix(num_classes=NUM_CLASSES, jit_update=False)
+    with pytest.raises(ValueError, match="scan-drivable"):
+        engine.drive(eager_member, epoch, mesh=mesh, in_specs=IN_SPECS)
+
+
+# ---------------------------------------------------------------------------
+# FID: feature-axis-sharded covariances + on-mesh Newton–Schulz
+# ---------------------------------------------------------------------------
+def _extractor(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def test_fid_sharded_newton_schulz_matches_host_path(mesh):
+    d = 64
+    rng = np.random.RandomState(8)
+    real = jnp.asarray(rng.rand(300, d).astype(np.float32))
+    fake = jnp.asarray((rng.rand(400, d) * 1.1 + 0.05).astype(np.float32))
+    ref = FrechetInceptionDistance(feature=_extractor, feature_dim=d)
+    sh = FrechetInceptionDistance(feature=_extractor, feature_dim=d, feature_sharding="mp")
+    sh.shard_states(mesh)
+    for m in (ref, sh):
+        m.update(real, real=True)
+        m.update(fake, real=False)
+    v_ref = float(ref.compute())
+    v_sh = float(sh.compute())
+    assert abs(v_sh - v_ref) / max(abs(v_ref), 1e-12) < shd.NEWTON_SCHULZ_FID_RTOL
+    # covariance states stayed feature-axis-sharded through accumulation
+    assert sh.real_outer.sharding.spec == P("mp")
+    assert _per_device_ratio(sh.real_outer) >= 4.0
+
+
+def test_newton_schulz_sqrtm_tolerance_vs_eigh():
+    rng = np.random.RandomState(9)
+    d = 48
+    a = rng.randn(200, d).astype(np.float64)
+    mat = (a.T @ a / 200).astype(np.float32)
+    ns = np.asarray(shd.newton_schulz_sqrtm(jnp.asarray(mat)))
+    vals, vecs = np.linalg.eigh(np.asarray(mat, np.float64))
+    ref = (vecs * np.sqrt(np.clip(vals, 0, None))) @ vecs.T
+    assert np.max(np.abs(ns - ref)) / np.max(np.abs(ref)) < 1e-3
+    # and NS^2 reproduces the input
+    assert np.max(np.abs(ns @ ns - mat)) / np.max(np.abs(mat)) < 1e-3
+
+
+def test_fid_unsharded_keeps_host_path_and_matrix_sqrt_override():
+    d = 16
+    rng = np.random.RandomState(10)
+    real = jnp.asarray(rng.rand(100, d).astype(np.float32))
+    fake = jnp.asarray(rng.rand(120, d).astype(np.float32))
+    host = FrechetInceptionDistance(feature=_extractor, feature_dim=d)
+    forced = FrechetInceptionDistance(feature=_extractor, feature_dim=d, matrix_sqrt="newton_schulz")
+    for m in (host, forced):
+        m.update(real, real=True)
+        m.update(fake, real=False)
+    assert host._resolved_sqrt() == "eigh"
+    assert forced._resolved_sqrt() == "newton_schulz"
+    v_host, v_forced = float(host.compute()), float(forced.compute())
+    assert abs(v_forced - v_host) / max(abs(v_host), 1e-12) < shd.NEWTON_SCHULZ_FID_RTOL
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+def test_sharded_drive_feeds_obs_surfaces(mesh):
+    from metrics_tpu import obs
+
+    rng = np.random.RandomState(11)
+    epoch = _int_epoch(rng)
+    sh = ConfusionMatrix(num_classes=NUM_CLASSES, class_sharding="mp")
+    with obs.capture() as events:
+        engine.drive(sh, epoch, mesh=mesh, in_specs=IN_SPECS)
+    assert any(e.kind == "reshard" for e in events)
+    stats = shd.shard_stats()
+    assert stats["sharded_drives"] == 1
+    resident = stats["resident"]["ConfusionMatrix.confmat"]
+    assert resident["per_device_bytes"] * 4 <= resident["total_bytes"]
+    snap = obs.snapshot()
+    assert snap["sharding"]["sharded_drives"] == 1

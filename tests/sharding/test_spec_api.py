@@ -1,0 +1,204 @@
+"""The sharding registration surface: ``add_state(sharding=)``,
+``state_spec()`` annotations, ``bind_state()`` layout validation,
+``shard_states(mesh)`` placement, and lifecycle carriage (clone / pickle /
+checkpoint / reset)."""
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metrics_tpu import ConfusionMatrix, FrechetInceptionDistance, Metric, StatScores
+from metrics_tpu import sharding as shd
+from metrics_tpu.utils.checkpoint import metric_state_pytree, restore_metric_state_pytree
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+NUM_CLASSES = 8
+
+
+def _mesh():
+    devs = jax.devices()
+    assert len(devs) >= 8, "tests/conftest.py forces 8 virtual CPU devices"
+    return Mesh(np.array(devs[:8]).reshape(2, 4), ("dp", "mp"))
+
+
+class _ShardedSum(Metric):
+    _batch_additive = True
+
+    def __init__(self, n=NUM_CLASSES, sharding="mp", **kw):
+        super().__init__(**kw)
+        self.n = n
+        self.add_state(
+            "total", default=jnp.zeros((n,), jnp.float32), dist_reduce_fx="sum", sharding=sharding
+        )
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x, axis=0)
+
+    def compute(self):
+        return self.total
+
+
+# ---------------------------------------------------------------------------
+# registration
+# ---------------------------------------------------------------------------
+def test_add_state_sharding_registers_partition_spec():
+    m = _ShardedSum()
+    assert m._state_shardings == {"total": P("mp")}
+    # a PartitionSpec registration is accepted verbatim
+    m2 = _ShardedSum(sharding=P("mp"))
+    assert m2._state_shardings["total"] == P("mp")
+
+
+def test_add_state_sharding_rejects_list_states_and_overlong_specs():
+    class BadList(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("buf", default=[], dist_reduce_fx="cat", sharding="mp")
+
+        def update(self):  # pragma: no cover
+            pass
+
+        def compute(self):  # pragma: no cover
+            pass
+
+    with pytest.raises(ValueError, match="list"):
+        BadList()
+
+    class BadRank(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state(
+                "s", default=jnp.zeros((4,)), dist_reduce_fx="sum", sharding=P("mp", None, "dp")
+            )
+
+        def update(self):  # pragma: no cover
+            pass
+
+        def compute(self):  # pragma: no cover
+            pass
+
+    with pytest.raises(ValueError, match="rank"):
+        BadRank()
+
+
+def test_class_sharding_flagship_registrations():
+    cm = ConfusionMatrix(num_classes=NUM_CLASSES, class_sharding="mp")
+    assert cm._state_shardings["confmat"] == P("mp")
+    ss = StatScores(reduce="macro", num_classes=NUM_CLASSES, class_sharding="mp")
+    assert {n: s for n, s in ss._state_shardings.items()} == {
+        n: P("mp") for n in ("tp", "fp", "tn", "fn")
+    }
+    # micro scalars / samplewise cat buffers have no class axis to shard
+    with pytest.raises(ValueError, match="macro"):
+        StatScores(reduce="micro", class_sharding="mp")
+    fid = FrechetInceptionDistance(
+        feature=lambda x: jnp.asarray(x, jnp.float32), feature_dim=4, feature_sharding="mp"
+    )
+    assert fid._state_shardings["real_outer"] == P("mp")
+    with pytest.raises(MetricsUserError, match="feature_dim"):
+        FrechetInceptionDistance(feature=lambda x: x, feature_sharding="mp")
+
+
+# ---------------------------------------------------------------------------
+# state_spec annotation
+# ---------------------------------------------------------------------------
+def test_state_spec_carries_the_sharding_annotation():
+    m = _ShardedSum()
+    spec = m.state_spec()["total"]
+    assert isinstance(spec, shd.StateSpec)
+    assert spec.shape == (NUM_CLASSES,) and spec.dtype == jnp.float32
+    assert spec.sharding == P("mp")
+    # unannotated states keep the plain ShapeDtypeStruct face
+    plain = ConfusionMatrix(num_classes=4).state_spec()["confmat"]
+    assert isinstance(plain, jax.ShapeDtypeStruct)
+    assert getattr(plain, "sharding", None) is None
+
+
+# ---------------------------------------------------------------------------
+# bind_state validation
+# ---------------------------------------------------------------------------
+def test_bind_state_accepts_replicated_and_matching_layouts():
+    mesh = _mesh()
+    m = _ShardedSum()
+    # unsharded host values: fine (placement re-lays them out)
+    m.bind_state({"total": jnp.arange(NUM_CLASSES, dtype=jnp.float32)})
+    # values already partitioned per the registered spec: fine
+    sharded = jax.device_put(
+        jnp.arange(NUM_CLASSES, dtype=jnp.float32), NamedSharding(mesh, P("mp"))
+    )
+    m.bind_state({"total": sharded})
+    assert np.asarray(m.total).tolist() == list(range(NUM_CLASSES))
+
+
+def test_bind_state_rejects_conflicting_layout_naming_class_attr():
+    mesh = _mesh()
+    m = _ShardedSum()
+    wrong = jax.device_put(
+        jnp.arange(NUM_CLASSES, dtype=jnp.float32), NamedSharding(mesh, P("dp"))
+    )
+    with pytest.raises(MetricsUserError, match=r"_ShardedSum\.total"):
+        m.bind_state({"total": wrong})
+
+
+# ---------------------------------------------------------------------------
+# placement + lifecycle
+# ---------------------------------------------------------------------------
+def test_shard_states_places_and_reset_reapplies():
+    mesh = _mesh()
+    m = _ShardedSum()
+    m.update(jnp.ones((3, NUM_CLASSES)))
+    m.shard_states(mesh)
+    assert m.total.sharding.spec == P("mp")
+    per_device = max(s.data.nbytes for s in m.total.addressable_shards)
+    assert per_device * 4 <= m.total.nbytes
+    # reset keeps the layout contract: fresh defaults go back onto the mesh
+    m.reset()
+    assert m.total.sharding.spec == P("mp")
+    assert float(jnp.sum(m.total)) == 0.0
+
+
+def test_clone_and_pickle_carry_annotations_not_placement():
+    mesh = _mesh()
+    m = _ShardedSum()
+    m.update(jnp.ones((2, NUM_CLASSES)))
+    m.shard_states(mesh)
+    for other in (m.clone(), pickle.loads(pickle.dumps(m))):
+        assert other._state_shardings == {"total": P("mp")}
+        assert other._shard_mesh is None  # meshes are process-local
+        assert np.allclose(np.asarray(other.total), np.asarray(m.total))
+
+
+def test_checkpoint_round_trips_sharded_state():
+    mesh = _mesh()
+    m = _ShardedSum()
+    m.update(jnp.asarray(np.random.RandomState(0).rand(4, NUM_CLASSES), jnp.float32))
+    m.shard_states(mesh)
+    tree = metric_state_pytree(m)
+    fresh = _ShardedSum()
+    restore_metric_state_pytree(fresh, tree)
+    assert np.array_equal(np.asarray(fresh.total), np.asarray(m.total))
+    # and a restored-then-placed instance lands back on the registered layout
+    fresh.shard_states(mesh)
+    assert fresh.total.sharding.spec == P("mp")
+
+
+def test_shard_stats_and_reshard_events():
+    from metrics_tpu import obs
+
+    shd.reset_shard_stats()
+    mesh = _mesh()
+    m = _ShardedSum()
+    with obs.capture() as events:
+        m.shard_states(mesh)
+    stats = shd.shard_stats()
+    assert stats["reshard_events"] >= 1
+    assert stats["specs"]["_ShardedSum.total"] == str(P("mp"))
+    resident = stats["resident"]["_ShardedSum.total"]
+    assert resident["per_device_bytes"] * 4 <= resident["total_bytes"]
+    assert resident["devices"] == 8
+    kinds = [e.kind for e in events]
+    assert "reshard" in kinds
