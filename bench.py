@@ -2158,6 +2158,133 @@ def bench_sharded_states() -> dict:
     }
 
 
+def bench_fleet_elasticity() -> dict:
+    """Elastic fleet acceptance scenario (``ci.sh --fleet-smoke`` gates
+    every boolean and bound below):
+
+    * a fleet that GROWS mid-epoch (join) and then LOSES a worker
+      ungracefully (kill, no drain) finishes with per-tenant values
+      BIT-IDENTICAL to a static fleet fed the same stream;
+    * every rebalance is rendezvous-minimal (only joiner-bound / leaver-owned
+      tenants move) and bounded by ~K/n per membership change;
+    * migration latency and rebalance bytes-on-wire are measured per move;
+    * a PR-10 class-sharded [C, C] plane re-laid mp=4 -> mp=2 -> mp=4 via
+      ``fleet.reshard_onto`` round-trips bit-exactly.
+    """
+    ensure_host_platform_devices(8)
+    import jax
+    import jax.numpy as jnp
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import Accuracy, ConfusionMatrix, engine
+    from metrics_tpu import fleet as flt
+
+    small = bool(os.environ.get("METRICS_TPU_BENCH_SMALL"))
+    n_tenants = 24 if small else 48
+    n_steps, n_cls, batch = 8, 5, 8
+    rng = np.random.RandomState(0)
+    stream = []
+    for step in range(n_steps):
+        for i in range(n_tenants):
+            stream.append(
+                (
+                    step,
+                    f"t{i}",
+                    (
+                        jnp.asarray(rng.rand(batch, n_cls).astype(np.float32)),
+                        jnp.asarray(rng.randint(0, n_cls, size=batch).astype(np.int32)),
+                    ),
+                )
+            )
+
+    def template():
+        return Accuracy(num_classes=n_cls)
+
+    # -- static fleet: fixed membership, same stream --------------------
+    static = flt.Fleet(template(), workers=[0, 1, 2], capacity=n_tenants, max_delay_s=None)
+    for _step, tenant, args in stream:
+        static.submit(tenant, *args)
+    static.flush()
+    static_vals = {t: np.asarray(v) for t, v in static.compute_all().items()}
+
+    # -- elastic fleet: join at step 3, ungraceful kill at step 6 -------
+    elastic = flt.Fleet(template(), workers=[0, 1], capacity=n_tenants, max_delay_s=None)
+    join_moves = kill_moves = None
+    join_s = kill_s = 0.0
+    last_step = -1
+    for step, tenant, args in stream:
+        if step != last_step:
+            if step == 3:
+                t0 = time.perf_counter()
+                join_moves = elastic.join(2)
+                join_s = time.perf_counter() - t0
+                flt.assert_minimal_moves(
+                    join_moves,
+                    elastic.epoch.with_workers([0, 1]),
+                    elastic.epoch,
+                    n_tenants=n_tenants,
+                )
+            if step == 6:
+                t0 = time.perf_counter()
+                kill_moves = elastic.kill(1)
+                kill_s = time.perf_counter() - t0
+            last_step = step
+        elastic.submit(tenant, *args)
+    elastic.flush()
+    elastic_vals = {t: np.asarray(v) for t, v in elastic.compute_all().items()}
+    bit_identical = set(elastic_vals) == set(static_vals) and all(
+        np.array_equal(elastic_vals[t], static_vals[t]) for t in static_vals
+    )
+    join_bound = 2.5 * n_tenants / 3  # slack * K/n_new, the CI-gated bound
+    moved_total = len(join_moves) + len(kill_moves)
+    migration_ms = 1000.0 * (join_s + kill_s) / max(1, moved_total)
+
+    # -- mesh-change resharding: [C, C] plane mp=4 -> mp=2 -> mp=4 ------
+    C = 512 if small else 2048
+    devs = jax.devices()
+    mesh4 = Mesh(np.array(devs[:4]).reshape(1, 4), ("dp", "mp"))
+    mesh2 = Mesh(np.array(devs[:2]).reshape(1, 2), ("dp", "mp"))
+    cm = ConfusionMatrix(num_classes=C, class_sharding="mp")
+    engine.drive(
+        cm,
+        (
+            jnp.asarray(rng.randint(0, C, size=(4, 16)).astype(np.int32)),
+            jnp.asarray(rng.randint(0, C, size=(4, 16)).astype(np.int32)),
+        ),
+        mesh=mesh4,
+        in_specs=P(None, "dp"),
+    )
+    before = np.asarray(cm.confmat)
+    t0 = time.perf_counter()
+    flt.reshard_onto(cm, mesh2, verify=True)
+    flt.reshard_onto(cm, mesh4, verify=True)
+    reshard_s = time.perf_counter() - t0
+    reshard_exact = bool(np.array_equal(before, np.asarray(cm.confmat)))
+
+    return {
+        "metric": "fleet_elasticity",
+        "value": round(migration_ms, 3),
+        "unit": "ms_per_tenant_migration",
+        "tenants": n_tenants,
+        "steps": n_steps,
+        "bit_identical_vs_static": bool(bit_identical),
+        "join_moved": len(join_moves),
+        "join_bound": round(join_bound, 1),
+        "join_minimal": all(dst == 2 for _s, dst in join_moves.values()),
+        "kill_recovered": len(kill_moves),
+        "resubmitted_requests": elastic.stats["resubmitted_requests"],
+        "rebalance_bytes": elastic.stats["rebalance_bytes"],
+        "migrations": elastic.stats["migrations"],
+        "migration_failures": elastic.stats["migration_failures"],
+        "final_epoch": elastic.epoch.version,
+        "reshard_bit_identical": reshard_exact,
+        "reshard_round_trip_s": round(reshard_s, 3),
+        "reshard_classes": C,
+        "n": n_steps * n_tenants,
+    }
+
+
 _CONFIGS = [
     ("bench_fid", 1500, True),
     ("bench_bertscore", 1500, True),
@@ -2175,6 +2302,7 @@ _CONFIGS = [
     ("bench_serving_plane", 900, False),
     ("bench_cold_start", 1200, False),
     ("bench_sharded_states", 900, False),
+    ("bench_fleet_elasticity", 900, False),
 ]
 
 # the headline runs outside _CONFIGS (measured first, emitted last) but is
@@ -2409,6 +2537,8 @@ _SMOKE_LANES = {
     "--warmup-smoke": ("bench_cold_start", {}),
     # sharded states: 100k-class parity, >=4x per-device bytes, FID NS gate
     "--shard-smoke": ("bench_sharded_states", {"cpu_devices": 8}),
+    # elastic fleet: kill/join bit-identity, K/n rebalance bound, resharding
+    "--fleet-smoke": ("bench_fleet_elasticity", {"cpu_devices": 8, "small": True}),
 }
 
 

@@ -234,4 +234,30 @@ if obj["fid_rel_err"] > obj["fid_rtol"]:
 print("sharded-states smoke OK:", line)
 '
 
+echo "=== elastic-fleet smoke (kill/join bit-identity, K/n rebalance bound, resharding) ==="
+JAX_PLATFORMS=cpu python bench.py --fleet-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "fleet_elasticity", obj
+# the acceptance gate: mid-epoch join + ungraceful kill finish with
+# per-tenant values bit-identical to a static fleet fed the same stream
+if obj["bit_identical_vs_static"] is not True:
+    print("elastic fleet diverged from the static fleet:", line); sys.exit(2)
+# rendezvous contract: a join moves ONLY joiner-bound tenants, and at most
+# ~K/n of them (2.5x slack for hash variance)
+if obj["join_minimal"] is not True:
+    print("join rebalance moved survivor-to-survivor tenants:", line); sys.exit(2)
+if obj["join_moved"] > obj["join_bound"]:
+    print("join moved %s tenants > %s bound: %s" % (obj["join_moved"], obj["join_bound"], line)); sys.exit(2)
+# the kill recovered every session the dead worker held (none lost), with
+# no migration failures anywhere in the run
+if obj["kill_recovered"] < 1 or obj["migration_failures"] != 0:
+    print("kill recovery incomplete:", line); sys.exit(2)
+# mesh-change resharding (mp=4 -> mp=2 -> mp=4) round-trips bit-exactly
+if obj["reshard_bit_identical"] is not True:
+    print("mesh-change resharding changed bits:", line); sys.exit(2)
+print("elastic-fleet smoke OK:", line)
+'
+
 echo "both lanes green"

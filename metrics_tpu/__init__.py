@@ -47,6 +47,7 @@ from metrics_tpu.audio import (  # noqa: E402,F401
     SignalNoiseRatio,
 )
 from metrics_tpu import engine  # noqa: E402,F401
+from metrics_tpu import fleet  # noqa: E402,F401
 from metrics_tpu import obs  # noqa: E402,F401
 from metrics_tpu import resilience  # noqa: E402,F401
 from metrics_tpu import serving  # noqa: E402,F401

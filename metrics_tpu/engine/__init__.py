@@ -68,6 +68,7 @@ from metrics_tpu.engine.driver import (  # noqa: F401
 from metrics_tpu.engine import warmup as _warmup
 from metrics_tpu.engine.warmup import (  # noqa: F401
     load_manifest,
+    manifest_dict,
     record_manifest,
     save_manifest,
     warmup,

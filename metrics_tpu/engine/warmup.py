@@ -75,6 +75,7 @@ __all__ = [
     "ENV_VAR",
     "MANIFEST_VERSION",
     "load_manifest",
+    "manifest_dict",
     "record_manifest",
     "recording",
     "reset_warmup_state",
@@ -523,18 +524,17 @@ def _unpickle_template(blob: Optional[str]) -> Any:
     return pickle.loads(zlib.decompress(base64.b64decode(blob.encode("ascii"))))
 
 
-def save_manifest(path: Optional[str] = None) -> str:
-    """Write the recorded program set as a versioned JSON manifest (atomic
-    replace). Returns the resolved path."""
+def manifest_dict() -> Dict[str, Any]:
+    """The recorded program set as an in-memory manifest document — exactly
+    what :func:`save_manifest` writes, without the disk round-trip.
+
+    The live record → warm handoff: an elastic fleet warms a *joining*
+    worker's bank from the programs the serving fleet has already compiled
+    (``Fleet.join``), so the new worker takes its first migrated-in tenant
+    and its first routed flush compile-free — no manifest file needs to ship.
+    """
     import jax
 
-    path = path or _REC["path"] or os.environ.get(ENV_VAR)
-    if not path:
-        raise ValueError(
-            "save_manifest needs a path: pass one, call record_manifest(path),"
-            f" or set {ENV_VAR}."
-        )
-    path = os.path.abspath(os.path.expanduser(path))
     # snapshot entries AND their program lists under the lock: a serving
     # thread can still be recording into rec["programs"] while an atexit or
     # periodic save iterates (pickling alone stays outside the lock)
@@ -566,7 +566,7 @@ def save_manifest(path: Optional[str] = None) -> str:
         backend = jax.default_backend()
     except Exception:  # noqa: BLE001 — backend init failure: still save
         backend = None
-    doc = {
+    return {
         "version": MANIFEST_VERSION,
         "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "jax_version": jax.__version__,
@@ -575,6 +575,19 @@ def save_manifest(path: Optional[str] = None) -> str:
         "backend": backend,
         "entries": out_entries,
     }
+
+
+def save_manifest(path: Optional[str] = None) -> str:
+    """Write the recorded program set as a versioned JSON manifest (atomic
+    replace). Returns the resolved path."""
+    path = path or _REC["path"] or os.environ.get(ENV_VAR)
+    if not path:
+        raise ValueError(
+            "save_manifest needs a path: pass one, call record_manifest(path),"
+            f" or set {ENV_VAR}."
+        )
+    path = os.path.abspath(os.path.expanduser(path))
+    doc = manifest_dict()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:

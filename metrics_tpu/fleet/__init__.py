@@ -1,0 +1,110 @@
+"""Elastic fleet layer: rendezvous placement, live migration, resharding.
+
+The serving plane (PRs 7–10) made one worker fast — banked multi-tenant
+dispatch, quantized sync, AOT warmup, sharded states. This package is the
+layer that makes those workers a *service*: a fleet whose size and topology
+change underneath millions of sessions without losing a bit of state.
+
+* :mod:`~metrics_tpu.fleet.placement` — coordination-free tenant→worker
+  assignment: rendezvous (HRW) hashing over a versioned
+  :class:`FleetEpoch`. Any peer answers "who owns tenant T at epoch E"
+  locally, and a fleet-size change moves only ~K/n tenants
+  (:func:`assert_minimal_moves` is the CI-gated contract).
+* :mod:`~metrics_tpu.fleet.migrate` — live migration as a composition of
+  existing machinery: drain (router flush) → checkpoint encode (the PR-7
+  spill path) → one self-describing wire payload riding the PR-8 codecs →
+  publish to a :class:`MigrationLedger` → ``bind_state``-validated re-admit
+  on the new owner, PR-9 manifest-warmed. The ledger holds every payload
+  until admission acks it, so a worker dying mid-migration loses nothing.
+* :mod:`~metrics_tpu.fleet.reshard` — mesh-change resharding: a PR-10
+  ``[C/mp, ...]`` shard plane re-laid bit-exactly onto a different ``mp``
+  via ``device_put``, round-tripped through ``state_spec()``/``bind_state``.
+* :mod:`~metrics_tpu.fleet.router` — :class:`Fleet` (workers + membership +
+  the migration engine, incl. kill recovery under the PR-2 fault harness)
+  and :class:`FleetRouter` (the request-plane face over each worker's PR-7
+  ``RequestRouter``).
+
+Telemetry: ``migrate``/``fleet_epoch`` bus events, the ``"fleet"`` section
+of ``obs.snapshot()`` (:func:`fleet_stats`), and ``metrics_tpu_fleet_*``
+Prometheus gauges. See ``docs/fleet.md`` for the topology model, the
+rendezvous contract, the migration protocol, and resharding semantics.
+"""
+from typing import Any, Dict
+
+from metrics_tpu.fleet.migrate import (  # noqa: F401
+    KVLedger,
+    LocalLedger,
+    MigrationLedger,
+    admit_payload,
+    decode_tenant_payload,
+    encode_tenant_payload,
+    ledger_key,
+)
+from metrics_tpu.fleet.placement import (  # noqa: F401
+    FleetEpoch,
+    assert_minimal_moves,
+    owner,
+    owners,
+    partition_by_owner,
+    placement_diff,
+    rendezvous_score,
+)
+from metrics_tpu.fleet.reshard import reshard_onto  # noqa: F401
+from metrics_tpu.fleet.router import (  # noqa: F401
+    Fleet,
+    FleetRouter,
+    Worker,
+    all_fleets,
+    fleet_summary,
+)
+
+__all__ = [
+    "Fleet",
+    "FleetEpoch",
+    "FleetRouter",
+    "KVLedger",
+    "LocalLedger",
+    "MigrationLedger",
+    "Worker",
+    "admit_payload",
+    "all_fleets",
+    "assert_minimal_moves",
+    "decode_tenant_payload",
+    "encode_tenant_payload",
+    "fleet_stats",
+    "fleet_summary",
+    "ledger_key",
+    "owner",
+    "owners",
+    "partition_by_owner",
+    "placement_diff",
+    "rendezvous_score",
+    "reshard_onto",
+]
+
+_AGGREGATE_KEYS = (
+    "epoch_changes",
+    "migrations",
+    "migration_failures",
+    "rebalance_bytes",
+    "joins",
+    "leaves",
+    "kills",
+    "recovered_tenants",
+    "resubmitted_requests",
+)
+
+
+def fleet_stats() -> Dict[str, Any]:
+    """Process-wide fleet telemetry: live-fleet aggregates plus the per-fleet
+    summaries — the ``"fleet"`` section of ``obs.snapshot()`` and the source
+    of the ``metrics_tpu_fleet_*`` Prometheus gauges."""
+    fleets = fleet_summary()
+    out: Dict[str, Any] = {key: 0 for key in _AGGREGATE_KEYS}
+    out["tenants"] = 0
+    for summary in fleets.values():
+        for key in _AGGREGATE_KEYS:
+            out[key] += summary.get(key, 0)
+        out["tenants"] += summary.get("tenants", 0)
+    out["fleets"] = fleets
+    return out

@@ -1,0 +1,278 @@
+"""Live tenant migration: drain → checkpoint-encode → publish → re-admit.
+
+A tenant's move between workers is deliberately built from pieces that
+already exist and are already tested, composed in a fixed order:
+
+1. **drain** — the source flushes its router so no request for the tenant is
+   in flight (``RequestRouter.flush``; the fleet layer does this before any
+   resize).
+2. **checkpoint-encode** — the tenant leaves the source bank through the
+   EXISTING checkpoint encode (``MetricBank.export_tenant`` →
+   ``utils.checkpoint.metric_state_pytree``): a migrating tenant is exactly a
+   checkpointed metric.
+3. **wire-encode** — the checkpoint tree becomes one self-describing payload
+   whose per-leaf blocks ride the PR-8 wire codecs (``parallel/groups._encode``
+   honoring the template's ``add_state(sync_precision=)`` tags: float states
+   tagged bf16/int8 cross the fleet narrow, integer states always exact),
+   sealed in the same crc32 envelope every sync payload wears — a corrupted
+   migration fails loudly, not by mis-binding state.
+4. **publish** — the payload lands in a :class:`MigrationLedger` keyed by
+   ``(epoch version, tenant)``. The ledger is the crash-safety of the
+   protocol: the source forgets the tenant only *after* publishing, and the
+   destination acknowledges only *after* admission, so a worker dying
+   mid-migration leaves the payload (the tenant's pre-drain state, intact)
+   for a surviving worker to re-admit.
+5. **re-admit** — the new owner decodes, validates through
+   ``Metric.bind_state`` (shape / dtype-kind / PR-10 sharding-layout
+   contract), and imports into its bank (``MetricBank.import_tenant``);
+   with a warmup manifest around (PR 9), the receiving bank is AOT-compiled
+   before its first flush.
+
+Two ledgers: :class:`LocalLedger` (in-process dict — the single-process
+fleet harness and the bench lane) and :class:`KVLedger` (the same four-call
+KV client surface the sync stack speaks, so migrations ride the real
+coordination service — and, under ``simulated_world`` /
+``METRICS_TPU_FAULTS``, the PR-2 fault plans: dropped, corrupted, and
+straggling migration payloads exercise exactly the failure modes the sync
+wire already handles).
+"""
+import json
+import struct
+import threading
+import time
+from typing import Any, Dict, Hashable, List, Optional
+
+import numpy as np
+
+from metrics_tpu.parallel import groups as _groups
+from metrics_tpu.utils.exceptions import MetricsUserError, SyncIntegrityError
+
+__all__ = [
+    "KVLedger",
+    "LocalLedger",
+    "MigrationLedger",
+    "admit_payload",
+    "decode_tenant_payload",
+    "encode_tenant_payload",
+    "ledger_key",
+]
+
+_PAYLOAD_VERSION = 1
+_KEY_PREFIX = "mtpu-fleet"
+
+
+# ---------------------------------------------------------------------------
+# wire codec: one checkpoint tree <-> one sealed payload
+# ---------------------------------------------------------------------------
+def encode_tenant_payload(
+    tree: Dict[str, Any],
+    precisions: Optional[Dict[str, str]] = None,
+    stats: Optional[Dict[str, Any]] = None,
+) -> bytes:
+    """Seal one checkpoint tree (``metric_state_pytree`` output) as a
+    self-describing migration payload.
+
+    Layout: the usual versioned crc32 envelope around a JSON key manifest
+    plus one length-framed block per leaf, each block being a full PR-8 wire
+    payload (``_encode`` — exact v1 bytes, or quantized v2 when the leaf's
+    state carries a ``sync_precision`` tag). Self-describing on purpose: the
+    receiver reconstructs the tree from the payload alone, so sender and
+    receiver never need to agree on a treedef out of band (the checkpoint
+    validator still enforces the template contract at admission).
+    """
+    keys = sorted(tree)
+    blocks: List[bytes] = []
+    for key in keys:
+        value = tree[key]
+        if isinstance(value, dict):
+            raise MetricsUserError(
+                f"migration payloads cannot carry list ('cat' buffer) state"
+                f" {key!r} — banks reject list-state templates, so a banked"
+                " tenant never holds one. Migrate such metrics by checkpoint"
+                " file instead."
+            )
+        tag = (precisions or {}).get(key)
+        blocks.append(_groups._encode(np.asarray(value), tag, stats=stats))
+    header = json.dumps({"v": _PAYLOAD_VERSION, "keys": keys}).encode()
+    body = struct.pack(">I", len(header)) + header
+    body += b"".join(struct.pack(">Q", len(b)) + b for b in blocks)
+    return _groups.pack_envelope(body)
+
+
+def decode_tenant_payload(payload: bytes, context: str = "") -> Dict[str, Any]:
+    """Inverse of :func:`encode_tenant_payload`; every leaf re-verifies its
+    own wire envelope, so corruption anywhere in the payload raises
+    :class:`SyncIntegrityError` naming the migration context."""
+    _version, body = _groups.unpack_envelope(payload, context)
+    if len(body) < 4:
+        raise SyncIntegrityError(f"Truncated migration payload: no header length{context}.")
+    (header_len,) = struct.unpack(">I", body[:4])
+    if 4 + header_len > len(body):
+        raise SyncIntegrityError(
+            f"Truncated migration payload{context}: header claims {header_len}"
+            f" bytes, only {len(body) - 4} present."
+        )
+    try:
+        header = json.loads(body[4 : 4 + header_len].decode())
+        keys = list(header["keys"])
+        version = header["v"]
+    except (ValueError, KeyError, UnicodeDecodeError) as err:
+        raise SyncIntegrityError(f"Unparseable migration payload header{context}: {err}") from err
+    if version != _PAYLOAD_VERSION:
+        raise SyncIntegrityError(
+            f"Migration payload version {version!r} unsupported{context};"
+            f" this build speaks v{_PAYLOAD_VERSION}.",
+            transient=False,
+        )
+    offset = 4 + header_len
+    tree: Dict[str, Any] = {}
+    for key in keys:
+        if offset + 8 > len(body):
+            raise SyncIntegrityError(f"Truncated migration payload at block {key!r}{context}.")
+        (size,) = struct.unpack(">Q", body[offset : offset + 8])
+        offset += 8
+        if offset + size > len(body):
+            raise SyncIntegrityError(
+                f"Truncated migration payload{context}: block {key!r} declares"
+                f" {size} bytes, only {len(body) - offset} remain."
+            )
+        tree[key] = _groups._decode(body[offset : offset + size], context)
+        offset += size
+    return tree
+
+
+def admit_payload(bank: Any, tenant: Hashable, payload: bytes, context: str = "") -> int:
+    """Decode a migration payload and re-admit ``tenant`` into ``bank``.
+
+    The decoded tree is validated on a template clone — first through the
+    checkpoint validator (shapes, dtype kinds, dynamic attrs), then through
+    :meth:`Metric.bind_state`, which additionally enforces the PR-10
+    sharding-layout contract (a tree partitioned over a different axis
+    assignment than the registration is rejected, not silently re-laid) —
+    before :meth:`MetricBank.import_tenant` stages it. Returns the payload
+    size in bytes (the fleet's rebalance-traffic ledger sums these).
+    """
+    tree = decode_tenant_payload(payload, context)
+    bank.import_tenant(tenant, tree)
+    return len(payload)
+
+
+# ---------------------------------------------------------------------------
+# migration ledgers
+# ---------------------------------------------------------------------------
+def _tenant_token(tenant: Hashable) -> str:
+    """Type-framed tenant id for ledger keys — int 1 and str "1" are two
+    distinct sessions (placement type-prefixes ids for the same reason) and
+    must not share a key. Plain ints stay bare so the PR-2 fault plans
+    (which parse an int off the key tail) keep targeting them."""
+    if isinstance(tenant, bool):
+        return f"o:{int(tenant)}"
+    if isinstance(tenant, int):
+        return str(tenant)
+    from metrics_tpu.fleet.placement import _id_bytes
+
+    return _id_bytes(tenant).decode("utf-8", "backslashreplace")
+
+
+def ledger_key(fleet: str, epoch_version: int, tenant: Hashable) -> str:
+    """Stable ledger key. The tenant id rides last (type-framed via
+    :func:`_tenant_token`), mirroring the sync stack's ``.../{epoch}/{rank}``
+    shape, so the PR-2 fault plans (which parse ``(epoch, rank)`` off the
+    key tail) can target migration payloads of integer-identified tenants
+    exactly like sync payloads."""
+    return f"{_KEY_PREFIX}/{fleet}/{epoch_version}/{_tenant_token(tenant)}"
+
+
+class MigrationLedger:
+    """Interface: publish / fetch / ack for in-flight migration payloads.
+
+    The ledger owns crash-safety, not routing: a payload stays readable from
+    publish until the *destination* acks (post-admission), so any surviving
+    worker can complete a migration whose source or destination died."""
+
+    def publish(self, key: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def fetch(self, key: str, timeout_s: float = 5.0) -> bytes:
+        raise NotImplementedError
+
+    def ack(self, key: str) -> None:
+        raise NotImplementedError
+
+    def pending(self) -> List[str]:
+        """Keys published but not yet acked (best-effort; KV-backed ledgers
+        track only the keys this process published)."""
+        raise NotImplementedError
+
+
+class LocalLedger(MigrationLedger):
+    """In-process ledger for the single-process fleet harness/bench."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._data: Dict[str, bytes] = {}
+
+    def publish(self, key: str, payload: bytes) -> None:
+        with self._lock:
+            self._data[key] = bytes(payload)
+
+    def fetch(self, key: str, timeout_s: float = 5.0) -> bytes:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                if key in self._data:
+                    return self._data[key]
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"DEADLINE_EXCEEDED: migration payload {key!r} never published")
+            time.sleep(0.001)
+
+    def ack(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def pending(self) -> List[str]:
+        with self._lock:
+            return sorted(self._data)
+
+
+class KVLedger(MigrationLedger):
+    """Ledger over the coordination-service client the sync stack speaks.
+
+    ``client=None`` resolves the same way ``parallel/groups`` does: the
+    per-thread ``simulated_world`` override first, then the real distributed
+    runtime (wrapped in the env-activated ``METRICS_TPU_FAULTS`` plan) — so
+    migration payloads cross the same fabric, and suffer the same injected
+    faults, as sync payloads.
+    """
+
+    def __init__(self, client: Optional[Any] = None) -> None:
+        self._client = client
+        self._published: List[str] = []
+        self._lock = threading.Lock()
+
+    def _resolve(self) -> Any:
+        if self._client is not None:
+            return self._client
+        return _groups._kv_client()
+
+    def publish(self, key: str, payload: bytes) -> None:
+        self._resolve().key_value_set_bytes(key, payload)
+        with self._lock:
+            if key not in self._published:
+                self._published.append(key)
+
+    def fetch(self, key: str, timeout_s: float = 5.0) -> bytes:
+        return self._resolve().blocking_key_value_get_bytes(key, max(1, int(timeout_s * 1000)))
+
+    def ack(self, key: str) -> None:
+        try:
+            self._resolve().key_value_delete(key)
+        except Exception:  # noqa: BLE001 — best-effort cleanup, like the sync stack's
+            pass
+        with self._lock:
+            if key in self._published:
+                self._published.remove(key)
+
+    def pending(self) -> List[str]:
+        with self._lock:
+            return list(self._published)

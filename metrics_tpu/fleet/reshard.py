@@ -1,0 +1,89 @@
+"""Mesh-change resharding: re-lay a PR-10 shard plane onto a different mesh.
+
+An elastic fleet does not just gain and lose *workers* — a worker that
+restarts on a different slice topology (4 chips instead of 8, a 1x4 ring
+instead of a 2x2 torus) changes the MESH under every
+``add_state(sharding=PartitionSpec(...))`` state it hosts. The PR-10
+annotations were designed for exactly this: they name mesh *axes*, not
+devices, so the same registration serves any mesh defining the axis.
+
+:func:`reshard_onto` is the one supported move. For each annotated state it
+
+1. validates the live value against :meth:`Metric.state_spec` (shape, dtype
+   — resharding must never be the place a corrupted carry sneaks through);
+2. ``jax.device_put``s it onto the new mesh per its registered spec (XLA
+   moves only the shard deltas; a ``[C/mp, ...]`` plane going mp=4 → mp=2
+   coalesces pairs of shards, mp=2 → mp=4 splits them);
+3. re-binds the whole tree through :meth:`Metric.bind_state`, which enforces
+   the PR-10 layout contract one more time on the *placed* values.
+
+The round trip is bit-exact — ``device_put`` re-lays bytes, it computes
+nothing — and :func:`reshard_onto` verifies that when asked
+(``verify=True``: fetches before/after and compares bitwise; the
+``--fleet-smoke`` CI lane runs with verification on). Telemetry rides the
+existing surfaces: each moved leaf is a ``reshard`` bus event, and the
+mesh-change itself increments ``shard_stats()["mesh_changes"]``.
+"""
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from metrics_tpu.sharding import spec as _spec
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+__all__ = ["reshard_onto"]
+
+
+def _annotated_states(metric: Any) -> Dict[str, Any]:
+    shardings = getattr(metric, "_state_shardings", None) or {}
+    return {name: getattr(metric, name) for name in shardings}
+
+
+def reshard_onto(metric: Any, mesh: Any, verify: bool = False) -> Any:
+    """Re-lay ``metric``'s annotated states onto ``mesh`` (see module doc).
+
+    ``verify=True`` fetches every annotated state before and after and
+    raises ``MetricsUserError`` on any bit difference — device_put must be a
+    pure layout move. Returns ``metric`` (mesh-bound, so ``reset()``
+    re-places fresh defaults on the NEW mesh).
+    """
+    shardings = getattr(metric, "_state_shardings", None) or {}
+    if not shardings:
+        raise MetricsUserError(
+            f"reshard_onto: {type(metric).__name__} registers no"
+            " add_state(sharding=) annotations — nothing to re-lay. Use"
+            " shard_states(mesh) for first placement of annotated metrics."
+        )
+    spec_by_name = metric.state_spec()
+    before: Optional[Dict[str, np.ndarray]] = None
+    if verify:
+        before = {n: np.asarray(v) for n, v in _annotated_states(metric).items()}
+    cls = type(metric).__name__
+    state = metric._snapshot_state()
+    for name in shardings:
+        expected = spec_by_name[name]
+        live = jax.numpy.asarray(state[name])
+        if tuple(live.shape) != tuple(expected.shape) or live.dtype != expected.dtype:
+            raise MetricsUserError(
+                f"reshard_onto: state {cls}.{name} is"
+                f" {live.dtype}{tuple(live.shape)} but state_spec() promises"
+                f" {expected.dtype}{tuple(expected.shape)} — refusing to"
+                " re-lay a carry that no longer matches its registration."
+            )
+    placed = _spec.place_state_dict(state, metric, mesh, source=f"fleet.reshard:{cls}")
+    # bind_state re-validates the placed tree (incl. the sharding-layout
+    # contract) and resets the compute cache — a resharded metric must not
+    # serve a value cached from the old layout
+    metric.bind_state(placed, update_count=metric._update_count)
+    metric._shard_mesh = mesh
+    _spec.count_mesh_change()
+    if verify and before is not None:
+        for name, old in before.items():
+            new = np.asarray(getattr(metric, name))
+            if not np.array_equal(old, new, equal_nan=True):
+                raise MetricsUserError(
+                    f"reshard_onto: state {cls}.{name} changed bits across the"
+                    " mesh move — device_put resharding must be bit-exact."
+                )
+    return metric

@@ -1,0 +1,777 @@
+"""The elastic fleet: workers, rendezvous routing, live migration.
+
+:class:`Fleet` composes everything under it into "a service whose size can
+change": each member worker is a PR-7 serving cell (one
+:class:`~metrics_tpu.serving.MetricBank` fronted by one
+:class:`~metrics_tpu.serving.RequestRouter`), tenants are placed by the
+coordination-free rendezvous hash over the versioned
+:class:`~metrics_tpu.fleet.FleetEpoch`, and membership changes move ONLY the
+rendezvous-mandated tenants through the drain → checkpoint-encode → publish →
+re-admit protocol in :mod:`metrics_tpu.fleet.migrate`.
+
+:class:`FleetRouter` is the request-plane face: ``submit``/``poll``/``flush``
+plus ``owner_of(tenant, epoch)`` — the question any worker (or a stateless
+front-end) answers locally. The fleet-wide ``pending_detail()`` aggregates
+each worker router's per-signature starvation view, so an operator sees
+which signature group is deadline-flushing on which worker.
+
+Failure story (exercised by ``tests/fleet`` under the PR-2 harness):
+
+* **graceful leave** — drain, migrate out, decommission; bit-identical to
+  never having had the worker.
+* **kill** — the worker stops serving without cooperation. Its bank's
+  device/host state stands in for the durable spill tier a production
+  deployment would run under the bank (ROADMAP: orbax/disk spill): recovery
+  checkpoint-encodes every session out of the dead worker's bank, publishes
+  each payload to the migration ledger, re-admits on the surviving
+  rendezvous owners, and re-submits the dead router's un-flushed requests —
+  so the full request stream is applied exactly once and final values are
+  bit-identical to a static fleet.
+* **mid-migration kill** — a ``METRICS_TPU_FAULTS`` plan entry of kind
+  ``'kill'`` (``rank`` = integer worker id, ``epoch`` = fleet epoch version)
+  fells the *destination* the moment it is asked to admit: the payload is
+  still in the ledger (published before the source forgot the tenant), so
+  the fleet re-routes to the next surviving owner with the pre-drain state
+  intact.
+"""
+import itertools
+import threading
+import weakref
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Tuple
+
+from metrics_tpu.fleet import migrate as _migrate
+from metrics_tpu.fleet import placement as _placement
+from metrics_tpu.fleet.placement import FleetEpoch
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+__all__ = ["Fleet", "FleetRouter", "Worker", "all_fleets", "fleet_summary"]
+
+_FLEETS: "weakref.WeakSet[Fleet]" = weakref.WeakSet()
+_FLEET_IDS = itertools.count()
+_REGISTRY_LOCK = threading.Lock()
+
+
+def all_fleets() -> List["Fleet"]:
+    with _REGISTRY_LOCK:
+        return sorted(_FLEETS, key=lambda f: f.name)
+
+
+def fleet_summary() -> Dict[str, Any]:
+    """Per-fleet membership/migration telemetry for every live fleet — the
+    per-fleet half of ``obs.snapshot()["fleet"]`` and the source of the
+    labelled ``metrics_tpu_fleet_*`` Prometheus gauges."""
+    return {fleet.name: fleet.summary() for fleet in all_fleets()}
+
+
+class Worker:
+    """One serving cell: a worker id, a bank, and its request router.
+
+    Workers are fleet-internal — requests enter through
+    :meth:`Fleet.submit` / :class:`FleetRouter`, which route by rendezvous —
+    but the object is public so tests and operators can inspect a specific
+    worker's bank/router state.
+    """
+
+    def __init__(
+        self,
+        worker_id: Hashable,
+        template: Any,
+        capacity: int,
+        *,
+        bank_name: Optional[str] = None,
+        max_requests: Optional[int] = None,
+        max_delay_s: Optional[float] = 0.05,
+    ) -> None:
+        from metrics_tpu.serving import MetricBank, RequestRouter
+
+        self.worker_id = worker_id
+        self.alive = True
+        self.bank = MetricBank(template, capacity, name=bank_name or f"fleet:{worker_id}")
+        self.router = RequestRouter(self.bank, max_requests=max_requests, max_delay_s=max_delay_s)
+        self.stats: Dict[str, int] = {
+            "migrations_in": 0,
+            "migrations_out": 0,
+            "bytes_in": 0,
+            "bytes_out": 0,
+        }
+
+    @property
+    def tenants(self) -> List[Hashable]:
+        """Every session this worker holds (device-resident + host-spilled)."""
+        return self.bank.tenants + self.bank.spilled_tenants
+
+    def drain(self) -> int:
+        """Flush the router so no request is in flight; returns requests
+        flushed. The first step of every migration."""
+        return self.router.flush()
+
+    def export_payload(self, tenant: Hashable, precisions: Optional[Dict[str, str]] = None) -> bytes:
+        """Checkpoint-encode ``tenant`` out of this worker (removing the
+        session) into one wire payload."""
+        tree = self.bank.export_tenant(tenant)
+        return _migrate.encode_tenant_payload(tree, precisions)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "alive": self.alive,
+            "tenants": len(self.tenants),
+            "resident": self.bank.occupancy,
+            "spilled": len(self.bank.spilled_tenants),
+            "pending": self.router.pending,
+            **self.stats,
+        }
+
+
+class Fleet:
+    """An elastic group of serving workers with rendezvous tenant placement.
+
+    Args:
+        template: the metric template every worker's bank serves (same
+            bankability contract as :class:`~metrics_tpu.serving.MetricBank`).
+        workers: initial worker ids (any hashables; integer ids additionally
+            make workers targetable by ``METRICS_TPU_FAULTS`` kill entries).
+        capacity: device-resident tenant slots per worker bank.
+        name: telemetry label (defaults to ``fleet<N>``).
+        ledger: migration ledger (default in-process
+            :class:`~metrics_tpu.fleet.LocalLedger`; pass a
+            :class:`~metrics_tpu.fleet.KVLedger` to ship payloads over the
+            coordination service / the simulated-world fault harness).
+        max_delay_s / max_requests: per-worker router flush policy.
+        fault_plan: explicit :class:`~metrics_tpu.resilience.FaultPlan`
+            consulted for ``'kill'`` entries (default: the env-activated
+            ``METRICS_TPU_FAULTS`` plan).
+        migration_precisions: wire codecs for migration payloads. Default
+            ``None`` ships every state EXACT — unlike a sync exchange (where
+            quantization is transient, re-derived from the exact carry every
+            time), a migration's rounding would be baked into the tenant's
+            stored state and compound across resizes, breaking the
+            bit-identical recovery contract. Pass ``True`` to opt into the
+            template's ``add_state(sync_precision=)`` tags, or an explicit
+            ``{state: codec}`` dict, when lossy handoff is acceptable.
+    """
+
+    def __init__(
+        self,
+        template: Any,
+        workers: Iterable[Hashable],
+        capacity: int,
+        *,
+        name: Optional[str] = None,
+        ledger: Optional[_migrate.MigrationLedger] = None,
+        max_requests: Optional[int] = None,
+        max_delay_s: Optional[float] = 0.05,
+        fault_plan: Optional[Any] = None,
+        migration_precisions: Optional[Any] = None,
+    ) -> None:
+        ids = list(workers)
+        if not ids:
+            raise ValueError("a Fleet needs at least one worker")
+        self.name = name if name is not None else f"fleet{next(_FLEET_IDS)}"
+        self._template = template.clone()
+        self.capacity = int(capacity)
+        self._max_requests = max_requests
+        self._max_delay_s = max_delay_s
+        self.ledger = ledger if ledger is not None else _migrate.LocalLedger()
+        if fault_plan is None:
+            # resolved ONCE: re-reading METRICS_TPU_FAULTS (possibly an
+            # @path file) per admission would put disk I/O inside the
+            # per-tenant migration loop
+            from metrics_tpu.resilience import faults as _faults
+
+            fault_plan = _faults.plan_from_env()
+        self._fault_plan = fault_plan
+        self._migration_precisions = migration_precisions
+        # tenant -> ledger key, from publish until the admission acks: the
+        # retryability record behind the partial-rebalance failure contract
+        self._in_flight: Dict[Hashable, str] = {}
+        # requests whose post-recovery resubmission failed — replayed by the
+        # next resize (same park-and-retry contract as _in_flight state)
+        self._parked_requests: List[Tuple[Hashable, Tuple[Any, ...]]] = []
+        self.epoch = FleetEpoch(ids, version=0)
+        self._workers: Dict[Hashable, Worker] = {}
+        for wid in self.epoch.workers:
+            self._workers[wid] = self._new_worker(wid)
+        self._tenants: "dict[Hashable, None]" = {}  # insertion-ordered known-tenant set
+        self._lock = threading.RLock()
+        self.stats: Dict[str, int] = {
+            "epoch_changes": 0,
+            "migrations": 0,
+            "migration_failures": 0,
+            "rebalance_bytes": 0,
+            "joins": 0,
+            "leaves": 0,
+            "kills": 0,
+            "recovered_tenants": 0,
+            "resubmitted_requests": 0,
+        }
+        with _REGISTRY_LOCK:
+            _FLEETS.add(self)
+
+    # ------------------------------------------------------------------
+    # placement / request plane
+    # ------------------------------------------------------------------
+    def _new_worker(self, wid: Hashable) -> Worker:
+        return Worker(
+            wid,
+            self._template,
+            self.capacity,
+            bank_name=f"{self.name}:{wid}",
+            max_requests=self._max_requests,
+            max_delay_s=self._max_delay_s,
+        )
+
+    def _precisions(self) -> Optional[Dict[str, str]]:
+        """Migration payload codecs: EXACT unless the user opted in (see the
+        ``migration_precisions`` arg — sync tags are transient per-exchange,
+        migration rounding would be baked into the stored state)."""
+        opt = self._migration_precisions
+        if opt is None or opt is False:
+            return None
+        if opt is True:
+            tags = {
+                n: p
+                for n, p in getattr(self._template, "_sync_precisions", {}).items()
+                if p and p != "exact"
+            }
+            return tags or None
+        return dict(opt) or None
+
+    def owner_of(self, tenant: Hashable, epoch: Optional[FleetEpoch] = None) -> Hashable:
+        """Who owns ``tenant`` at ``epoch`` (default: the current one) —
+        pure rendezvous, no coordination, same answer on every peer."""
+        return _placement.owner(tenant, epoch if epoch is not None else self.epoch)
+
+    def worker(self, worker_id: Hashable) -> Worker:
+        return self._workers[worker_id]
+
+    @property
+    def workers(self) -> List[Hashable]:
+        return [w for w in self.epoch.workers]
+
+    @property
+    def tenants(self) -> List[Hashable]:
+        with self._lock:
+            return list(self._tenants)
+
+    def _heal_in_flight(self, tenant: Hashable) -> None:
+        """Complete a migration a failed resize left parked in the ledger
+        (see :meth:`resize` failure semantics) before serving the tenant."""
+        key = self._in_flight.get(tenant)
+        if key is None:
+            return
+        old = self.epoch
+        _dst, evolved = self._admit_from_ledger(tenant, key, old, reason="retry")
+        if evolved.version != old.version:
+            # the fault plan felled an owner DURING the heal: run the full
+            # membership-change path, like kill() — its other tenants and
+            # queued requests must be recovered, not stranded
+            epoch, moves, total_bytes, pending, failures = self._recover_all_dead(evolved)
+            failures += self._commit_epoch(
+                old, epoch, moves, total_bytes, pending, reason="fault_plan"
+            )
+            self._raise_if_failed(failures)
+
+    def submit(self, tenant: Hashable, *args: Any) -> int:
+        """Route one update request to the tenant's rendezvous owner;
+        returns requests flushed as a side effect (router semantics)."""
+        with self._lock:
+            self._heal_in_flight(tenant)
+            wid = self.owner_of(tenant)
+            worker = self._workers[wid]
+            if not worker.alive:
+                raise MetricsUserError(
+                    f"fleet {self.name!r}: owner {wid!r} of tenant {tenant!r} is dead"
+                    " but still in the epoch — call kill()/resize() to advance"
+                    " membership before routing more traffic."
+                )
+            self._tenants[tenant] = None
+            return worker.router.submit(tenant, *args)
+
+    def poll(self) -> int:
+        with self._lock:
+            return sum(w.router.poll() for w in self._workers.values() if w.alive)
+
+    def flush(self) -> int:
+        with self._lock:
+            return sum(w.router.flush() for w in self._workers.values() if w.alive)
+
+    def compute(self, tenant: Hashable) -> Any:
+        """The tenant's metric value from its owner's bank (drains first, so
+        a just-submitted request is never silently pending)."""
+        with self._lock:
+            self._heal_in_flight(tenant)
+            worker = self._workers[self.owner_of(tenant)]
+            worker.drain()
+            return worker.bank.compute(tenant)
+
+    def compute_all(self) -> Dict[Hashable, Any]:
+        """Every known tenant's value — partitioned by owner, ONE drain per
+        worker and one batched ``compute_many`` per bank, not a
+        drain + single-slot launch per tenant."""
+        with self._lock:
+            for tenant in list(self._in_flight):
+                self._heal_in_flight(tenant)
+            by_owner = _placement.partition_by_owner(list(self._tenants), self.epoch)
+            out: Dict[Hashable, Any] = {}
+            for wid, tenants in by_owner.items():
+                if not tenants:
+                    continue
+                worker = self._workers[wid]
+                worker.drain()
+                out.update(worker.bank.compute_many(tenants))
+            return out
+
+    # ------------------------------------------------------------------
+    # membership changes (control plane)
+    # ------------------------------------------------------------------
+    def join(self, *worker_ids: Hashable, manifest: Optional[Any] = None) -> Dict[Hashable, Tuple[Hashable, Hashable]]:
+        """Add workers and rebalance. ``manifest`` (a PR-9 warmup manifest
+        path/dict; default: the live in-memory recording when
+        ``engine.record_manifest()`` is active) AOT-compiles each joining
+        worker's bank BEFORE its first migrated-in tenant or routed flush."""
+        self.stats["joins"] += len(worker_ids)
+        return self.resize(tuple(self.epoch.workers) + worker_ids, manifest=manifest)
+
+    def leave(self, *worker_ids: Hashable) -> Dict[Hashable, Tuple[Hashable, Hashable]]:
+        """Gracefully decommission workers: drain, migrate their tenants to
+        the surviving rendezvous owners, drop them from the fleet."""
+        gone = set(worker_ids)
+        unknown = gone - set(self.epoch.workers)
+        if unknown:
+            raise KeyError(
+                f"fleet {self.name!r}: cannot decommission unknown worker(s)"
+                f" {sorted(map(str, unknown))} — not members of epoch"
+                f" v{self.epoch.version}."
+            )
+        self.stats["leaves"] += len(gone)
+        # resize() itself decommissions workers that left the epoch
+        return self.resize([w for w in self.epoch.workers if w not in gone])
+
+    def resize(
+        self, worker_ids: Iterable[Hashable], manifest: Optional[Any] = None
+    ) -> Dict[Hashable, Tuple[Hashable, Hashable]]:
+        """Advance to a new epoch holding exactly ``worker_ids``, migrating
+        exactly the rendezvous-mandated tenants. Returns the move map
+        ``{tenant: (source, dest)}`` actually performed.
+
+        Failure semantics: migrations are isolated per tenant. A tenant whose
+        move fails (corrupted/dropped ledger payload, admission error) keeps
+        its state parked in the ledger (``_in_flight``); the epoch still
+        commits, a ``MetricsUserError`` naming the failed tenants is raised
+        AFTER commit, and the next ``submit``/``compute``/``resize`` touching
+        such a tenant re-admits it from the ledger — a partial rebalance is
+        loud and retryable, never a silent state fork."""
+        with self._lock:
+            old = self.epoch
+            new = old.with_workers(worker_ids)
+            for wid in new.workers:
+                if wid not in self._workers:
+                    self._workers[wid] = self._new_worker(wid)
+                    self._warm_worker(self._workers[wid], manifest)
+            # drain EVERY live router: migration must never overtake a
+            # pending request (per-tenant order is the serving contract)
+            for worker in self._workers.values():
+                if worker.alive:
+                    worker.drain()
+            # old.size == 0 only after a total-loss kill: nothing to diff,
+            # every surviving state is in the in-flight ledger sweep below
+            moves = (
+                _placement.placement_diff(list(self._tenants), old, new) if old.size else {}
+            )
+            final_epoch, performed, moved_bytes, failures = self._migrate_moves(moves, new)
+            # a fault-plan kill mid-resize may leave dead workers still
+            # holding tenants that were never scheduled to move — recover
+            # them (and their un-flushed requests) exactly like kill() does
+            final_epoch, recovered, bytes_rec, pending, rec_failures = self._recover_all_dead(
+                final_epoch
+            )
+            performed.update(recovered)
+            moved_bytes += bytes_rec
+            failures += rec_failures
+            # requests parked by an earlier failed resubmission replay with
+            # this change's recovered requests (oldest first)
+            pending = self._parked_requests + pending
+            self._parked_requests = []
+            # in-flight sweep: tenants parked in the ledger by an earlier
+            # failed move (this resize or a prior one) re-admit toward the
+            # new epoch — a resize is the universal retry
+            for tenant, key in list(self._in_flight.items()):
+                try:
+                    dst, final_epoch = self._admit_from_ledger(
+                        tenant, key, final_epoch, reason="retry"
+                    )
+                    performed.setdefault(tenant, (None, dst))
+                    # a same-call failure that the sweep just completed (e.g.
+                    # a corrupt-N-reads fault healing) is no longer a failure
+                    failures = [(t, e) for t, e in failures if t != tenant]
+                except Exception as err:  # noqa: BLE001 — isolated like any move
+                    self.stats["migration_failures"] += 1
+                    failures.append((tenant, err))
+            failures += self._commit_epoch(old, final_epoch, performed, moved_bytes, pending)
+            self._raise_if_failed(failures)
+            return performed
+
+    def _commit_epoch(
+        self,
+        old: FleetEpoch,
+        epoch: FleetEpoch,
+        performed: Dict[Hashable, Tuple[Hashable, Hashable]],
+        moved_bytes: int,
+        pending: List[Tuple[Hashable, Tuple[Any, ...]]],
+        reason: Optional[str] = None,
+    ) -> List[Tuple[Hashable, BaseException]]:
+        """The shared membership-change epilogue (resize and kill): commit
+        the epoch, decommission workers that left it, resubmit recovered
+        requests, emit the ``fleet_epoch`` event with joined/left derived
+        from the actual old→new membership (cascade kills included).
+        Returns per-request resubmission failures (isolated like every
+        other migration step — a failing resubmit must not drop the rest;
+        its request parks in ``_parked_requests`` for the next resize)."""
+        self.epoch = epoch
+        # a shrink decommissions: workers out of the epoch must not keep
+        # their capacity-sized device banks alive (or keep appearing in
+        # poll/flush/telemetry). A worker still holding tenants or queued
+        # requests (a failed export stranded them) stays registered so its
+        # state remains reachable for the retry.
+        for wid in [w for w in list(self._workers) if w not in epoch.workers]:
+            worker = self._workers[wid]
+            if not worker.tenants and not worker.router.pending:
+                self._workers.pop(wid)
+        self.stats["epoch_changes"] += 1
+        resubmit_failures: List[Tuple[Hashable, BaseException]] = []
+        for tenant, args in pending:
+            try:
+                self.stats["resubmitted_requests"] += 1
+                self.submit(tenant, *args)
+            except Exception as err:  # noqa: BLE001 — isolated; request parked
+                self._parked_requests.append((tenant, args))
+                resubmit_failures.append((tenant, err))
+        if _bus.enabled():
+            payload: Dict[str, Any] = dict(
+                source=self.name,
+                version=epoch.version,
+                workers=epoch.size,
+                joined=len(set(epoch.workers) - set(old.workers)),
+                left=len(set(old.workers) - set(epoch.workers)),
+                moved=len(performed),
+                rebalance_bytes=moved_bytes,
+            )
+            if reason is not None:
+                payload["reason"] = reason
+            _bus.emit("fleet_epoch", **payload)
+        return resubmit_failures
+
+    def _raise_if_failed(self, failures: List[Tuple[Hashable, BaseException]]) -> None:
+        if not failures:
+            return
+        named = ", ".join(f"{t!r} ({type(e).__name__}: {e})" for t, e in failures[:5])
+        more = f" (+{len(failures) - 5} more)" if len(failures) > 5 else ""
+        raise MetricsUserError(
+            f"fleet {self.name!r}: {len(failures)} tenant migration(s) failed —"
+            f" {named}{more}. Each failed tenant's state is parked in the"
+            " migration ledger and re-admits on its next submit()/compute()/"
+            "resize(); no state was lost."
+        ) from failures[0][1]
+
+    def _warm_worker(self, worker: Worker, manifest: Optional[Any]) -> None:
+        """PR-9 composition: a joining worker compiles before first apply."""
+        from metrics_tpu import engine as _engine
+        from metrics_tpu.obs import warn as _warn
+
+        doc = manifest
+        if doc is None and _engine.warmup_report()["recording"]["active"]:
+            doc = _engine.manifest_dict()
+            if not doc.get("entries"):
+                doc = None
+        if doc is None:
+            return
+        try:
+            worker.bank.warmup(doc)
+        except Exception as err:  # noqa: BLE001 — costs latency, never a join
+            self.stats["warmup_failures"] = self.stats.get("warmup_failures", 0) + 1
+            _warn.warn_once(
+                f"fleet {self.name!r}: warmup of joining worker"
+                f" {worker.worker_id!r} failed ({type(err).__name__}: {err});"
+                " the worker serves cold (first flush compiles).",
+                key=("fleet_warmup_failed", self.name),
+            )
+
+    # -- migration engine ----------------------------------------------
+    def _killed_by_plan(self, worker_id: Hashable, epoch_version: int) -> bool:
+        plan = self._fault_plan
+        if plan is None or not isinstance(worker_id, int):
+            return False
+        return plan.kills(worker_id, epoch_version)
+
+    def _mark_dead(self, worker_id: Hashable, reason: str) -> None:
+        worker = self._workers.get(worker_id)
+        if worker is None or not worker.alive:
+            return
+        worker.alive = False
+        self.stats["kills"] += 1
+        if _bus.enabled():
+            _bus.emit(
+                "fleet_epoch",
+                source=self.name,
+                event="worker_dead",
+                worker=str(worker_id),
+                reason=reason,
+                version=self.epoch.version,
+            )
+
+    def _migrate_one(
+        self, tenant: Hashable, source: Worker, epoch: FleetEpoch, reason: str
+    ) -> Tuple[Hashable, FleetEpoch, int]:
+        """Export → publish → re-admit one tenant; the single move sequence
+        shared by rebalances and dead-worker recovery. The ledger key is
+        remembered in ``_in_flight`` from publish until the admission acks,
+        so a failure anywhere leaves the state parked and retryable."""
+        payload = source.export_payload(tenant, self._precisions())
+        key = _migrate.ledger_key(self.name, epoch.version, tenant)
+        self.ledger.publish(key, payload)
+        self._in_flight[tenant] = key
+        source.stats["migrations_out"] += 1
+        source.stats["bytes_out"] += len(payload)
+        dst, epoch = self._admit_from_ledger(
+            tenant, key, epoch, reason=reason, source=source.worker_id
+        )
+        return dst, epoch, len(payload)
+
+    def _migrate_moves(
+        self, moves: Dict[Hashable, Tuple[Hashable, Hashable]], epoch: FleetEpoch
+    ) -> Tuple[
+        FleetEpoch,
+        Dict[Hashable, Tuple[Hashable, Hashable]],
+        int,
+        List[Tuple[Hashable, BaseException]],
+    ]:
+        """Perform ``moves`` toward ``epoch``. Per-tenant failure isolation:
+        one tenant's failed move (its state stays parked in the ledger) never
+        aborts the rest of the rebalance — the caller commits the epoch and
+        raises an aggregate error afterwards. A destination killed by the
+        fault plan mid-migration advances the epoch (survivors only) and
+        re-routes from the still-published payload."""
+        performed: Dict[Hashable, Tuple[Hashable, Hashable]] = {}
+        total_bytes = 0
+        failures: List[Tuple[Hashable, BaseException]] = []
+        for tenant, (src, _dst) in moves.items():
+            source = self._workers[src]
+            try:
+                if tenant not in source.tenants:
+                    # known to the fleet, not materialized on this owner —
+                    # either never flushed anywhere, or parked in the ledger
+                    # by a failed move (the resize in-flight sweep retries it)
+                    continue
+                dst, epoch, n_bytes = self._migrate_one(tenant, source, epoch, "rebalance")
+                performed[tenant] = (src, dst)
+                total_bytes += n_bytes
+            except Exception as err:  # noqa: BLE001 — isolated, aggregated by the caller
+                self.stats["migration_failures"] += 1
+                failures.append((tenant, err))
+        self.stats["rebalance_bytes"] += total_bytes
+        return epoch, performed, total_bytes, failures
+
+    def _admit_from_ledger(
+        self,
+        tenant: Hashable,
+        key: str,
+        epoch: FleetEpoch,
+        reason: str,
+        source: Optional[Hashable] = None,
+    ) -> Tuple[Hashable, FleetEpoch]:
+        """Admit the ledger payload under ``key`` on the tenant's owner at
+        ``epoch``, surviving destination deaths: a dead (or plan-killed)
+        owner shrinks the epoch and the next rendezvous owner takes the
+        tenant — the payload stays published until an admission acks it."""
+        while True:
+            if epoch.size == 0:
+                # counted by the caller's failure isolation; the in-flight
+                # entry keeps the payload retryable
+                raise MetricsUserError(
+                    f"fleet {self.name!r}: no surviving worker can admit"
+                    f" tenant {tenant!r} (payload kept in the ledger under"
+                    f" {key!r})."
+                )
+            dst = _placement.owner(tenant, epoch)
+            worker = self._workers[dst]
+            if worker.alive and self._killed_by_plan(dst, epoch.version):
+                self._mark_dead(dst, reason="fault_plan")
+            if not worker.alive:
+                epoch = epoch.leave(dst)
+                continue
+            payload = self.ledger.fetch(key)
+            n_bytes = _migrate.admit_payload(
+                worker.bank, tenant, payload, context=f" (fleet={self.name!r}, tenant={tenant!r})"
+            )
+            self.ledger.ack(key)
+            self._in_flight.pop(tenant, None)
+            worker.stats["migrations_in"] += 1
+            worker.stats["bytes_in"] += n_bytes
+            self.stats["migrations"] += 1
+            if _bus.enabled():
+                _bus.emit(
+                    "migrate",
+                    source=self.name,
+                    tenant=str(tenant),
+                    src=str(source) if source is not None else None,
+                    dst=str(dst),
+                    bytes=n_bytes,
+                    epoch=epoch.version,
+                    reason=reason,
+                )
+            return dst, epoch
+
+    # ------------------------------------------------------------------
+    # failure handling
+    # ------------------------------------------------------------------
+    def _recover_worker(
+        self, worker_id: Hashable, epoch: FleetEpoch
+    ) -> Tuple[
+        FleetEpoch,
+        Dict[Hashable, Tuple[Hashable, Hashable]],
+        int,
+        List[Tuple[Hashable, Tuple[Any, ...]]],
+        List[Tuple[Hashable, BaseException]],
+    ]:
+        """Drain a DEAD worker's state back into the fleet: every session
+        checkpoint-encoded out of its bank (the durable-spill stand-in),
+        published, and re-admitted on the surviving rendezvous owners at
+        ``epoch`` (minus the dead worker). Returns the evolved epoch, the
+        recovery moves, payload bytes, the dead router's un-flushed requests
+        (the CALLER re-submits them after ``self.epoch`` advances), and the
+        per-tenant failures (isolated; each stays ledger-parked/on the dead
+        bank for a retry, which also keeps the worker registered).
+        """
+        dead = self._workers[worker_id]
+        if worker_id in epoch:
+            epoch = epoch.leave(worker_id)
+        pending = dead.router.drain_pending()
+        moves: Dict[Hashable, Tuple[Hashable, Hashable]] = {}
+        total_bytes = 0
+        failures: List[Tuple[Hashable, BaseException]] = []
+        for tenant in list(dead.tenants):
+            try:
+                dst, epoch, n_bytes = self._migrate_one(tenant, dead, epoch, "recovery")
+                moves[tenant] = (worker_id, dst)
+                total_bytes += n_bytes
+                self.stats["recovered_tenants"] += 1
+            except Exception as err:  # noqa: BLE001 — isolated, aggregated by the caller
+                self.stats["migration_failures"] += 1
+                failures.append((tenant, err))
+        self.stats["rebalance_bytes"] += total_bytes
+        if not dead.tenants:
+            self._workers.pop(worker_id, None)
+        return epoch, moves, total_bytes, pending, failures
+
+    def _recover_all_dead(
+        self, epoch: FleetEpoch
+    ) -> Tuple[
+        FleetEpoch,
+        Dict[Hashable, Tuple[Hashable, Hashable]],
+        int,
+        List[Tuple[Hashable, Tuple[Any, ...]]],
+        List[Tuple[Hashable, BaseException]],
+    ]:
+        """Recover EVERY dead worker still registered, re-scanning until none
+        remain — a destination cascade-killed by the fault plan *during* a
+        recovery is itself recovered, not orphaned with its tenants' state
+        stranded in its dead bank. Each dead worker is attempted once per
+        call (a partially-unrecoverable one stays registered for a retry)."""
+        moves: Dict[Hashable, Tuple[Hashable, Hashable]] = {}
+        total_bytes = 0
+        pending: List[Tuple[Hashable, Tuple[Any, ...]]] = []
+        failures: List[Tuple[Hashable, BaseException]] = []
+        attempted: set = set()
+        while True:
+            dead = [
+                w for w, wk in self._workers.items() if not wk.alive and w not in attempted
+            ]
+            if not dead:
+                return epoch, moves, total_bytes, pending, failures
+            attempted.add(dead[0])
+            epoch, recovered, bytes_rec, reqs, fails = self._recover_worker(dead[0], epoch)
+            moves.update(recovered)
+            total_bytes += bytes_rec
+            pending.extend(reqs)
+            failures += fails
+
+    def kill(self, worker_id: Hashable) -> Dict[Hashable, Tuple[Hashable, Hashable]]:
+        """Ungraceful worker loss: no drain, no cooperation. Recovery
+        checkpoint-encodes every session out of the dead worker's bank (its
+        host/device state standing in for the durable spill tier), publishes
+        each payload, re-admits on the surviving rendezvous owners, and
+        re-submits the dead router's un-flushed requests — the stream is
+        applied exactly once. Returns ``{tenant: (dead_worker, new_owner)}``.
+        """
+        with self._lock:
+            if worker_id not in self._workers:
+                raise KeyError(f"unknown worker {worker_id!r} in fleet {self.name!r}")
+            old = self.epoch
+            self._mark_dead(worker_id, reason="kill")
+            # _recover_all_dead: a destination the fault plan fells DURING
+            # this recovery is recovered in turn, never orphaned
+            epoch, moves, total_bytes, pending, failures = self._recover_all_dead(self.epoch)
+            failures += self._commit_epoch(old, epoch, moves, total_bytes, pending, reason="kill")
+            self._raise_if_failed(failures)
+            return moves
+
+    # ------------------------------------------------------------------
+    # ops surface
+    # ------------------------------------------------------------------
+    def pending_detail(self) -> Dict[Hashable, Dict[str, Any]]:
+        """Per-worker, per-signature pending/starvation view (each worker
+        router's ``pending_detail()`` keyed by worker id)."""
+        with self._lock:
+            return {
+                wid: w.router.pending_detail() for wid, w in self._workers.items() if w.alive
+            }
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "template": type(self._template).__name__,
+                "epoch": self.epoch.version,
+                "workers": {str(wid): w.summary() for wid, w in self._workers.items()},
+                "tenants": len(self._tenants),
+                "capacity": self.capacity,
+                **self.stats,
+            }
+
+    def __repr__(self) -> str:
+        return (
+            f"Fleet(name={self.name!r}, epoch=v{self.epoch.version},"
+            f" workers={len(self._workers)}, tenants={len(self._tenants)})"
+        )
+
+
+class FleetRouter:
+    """The request-plane face of a :class:`Fleet` — rendezvous-routed
+    ``submit``/``poll``/``flush`` wrapping each worker's PR-7
+    :class:`~metrics_tpu.serving.RequestRouter`, plus the coordination-free
+    ``owner_of(tenant, epoch)`` any peer answers locally."""
+
+    def __init__(self, fleet: Fleet) -> None:
+        self.fleet = fleet
+
+    def owner_of(self, tenant: Hashable, epoch: Optional[FleetEpoch] = None) -> Hashable:
+        return self.fleet.owner_of(tenant, epoch)
+
+    def submit(self, tenant: Hashable, *args: Any) -> int:
+        return self.fleet.submit(tenant, *args)
+
+    def poll(self) -> int:
+        return self.fleet.poll()
+
+    def flush(self) -> int:
+        return self.fleet.flush()
+
+    @property
+    def pending(self) -> int:
+        with self.fleet._lock:
+            return sum(
+                w.router.pending for w in self.fleet._workers.values() if w.alive
+            )
+
+    def pending_detail(self) -> Dict[Hashable, Dict[str, Any]]:
+        return self.fleet.pending_detail()

@@ -64,8 +64,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: ``explain`` payload naming the changed cache-key component). Sharded
 #: states (``metrics_tpu.sharding``): ``reshard`` (state leaves were laid
 #: out onto a mesh — ``leaves`` moved, ``mesh_axes`` names axis sizes; a
-#: drive whose carry already sits in place emits none). Misc:
-#: ``warning`` (a ``warn_once`` emission).
+#: drive whose carry already sits in place emits none). Elastic fleet
+#: (``metrics_tpu.fleet``): ``migrate`` (one tenant re-admitted on a new
+#: owner — names tenant/src/dst, payload bytes, epoch version, and the
+#: reason ``rebalance``/``recovery``), ``fleet_epoch`` (a membership change
+#: completed — version, worker count, joined/left, tenants moved, rebalance
+#: bytes; also emitted with ``event="worker_dead"`` when a worker is marked
+#: dead). Misc: ``warning`` (a ``warn_once`` emission).
 EVENT_KINDS = (
     "compile",
     "cache_hit",
@@ -86,6 +91,8 @@ EVENT_KINDS = (
     "admit",
     "evict",
     "flush",
+    "migrate",
+    "fleet_epoch",
     "warmup",
     "warmup_stale",
     "warning",

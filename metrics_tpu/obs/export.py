@@ -41,6 +41,12 @@ def _shard_stats() -> Dict[str, Any]:
     return shard_stats()
 
 
+def _fleet_stats() -> Dict[str, Any]:
+    from metrics_tpu.fleet import fleet_stats
+
+    return fleet_stats()
+
+
 def process_snapshot() -> Dict[str, Any]:
     """The process-wide observability view (no metric argument needed)."""
     from metrics_tpu import engine as _engine
@@ -62,6 +68,9 @@ def process_snapshot() -> Dict[str, Any]:
         # sharded metric states (metrics_tpu.sharding): registered specs,
         # resharding events, sharded drives, per-device resident bytes
         "sharding": _shard_stats(),
+        # elastic fleet (metrics_tpu.fleet): per-fleet membership/occupancy,
+        # migrations, rebalance bytes, kills/recoveries
+        "fleet": _fleet_stats(),
         "bus": _bus.summary(),
         "spans": _trace.span_summary(),
         "warnings": {repr(k): v for k, v in _warn.warn_counts().items()},
@@ -243,7 +252,7 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
 
     # sharded metric states: layout moves, sharded drives, resident bytes
     shard = _shard_stats()
-    for key in ("sharded_drives", "reshard_events"):
+    for key in ("sharded_drives", "reshard_events", "mesh_changes"):
         _sample(f"metrics_tpu_shard_{key}", shard[key])
     _sample("metrics_tpu_shard_registered_specs", len(shard["specs"]), kind="gauge")
     for state_key in sorted(shard["resident"]):
@@ -259,6 +268,24 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
             "metrics_tpu_shard_state_bytes_total", resident["total_bytes"], labels, kind="gauge"
         )
         _sample("metrics_tpu_shard_state_devices", resident["devices"], labels, kind="gauge")
+
+    # elastic fleet: membership, per-worker occupancy, migration traffic
+    fleet = _fleet_stats()
+    for key in ("migrations", "rebalance_bytes", "kills", "recovered_tenants", "epoch_changes"):
+        _sample(f"metrics_tpu_fleet_{key}", fleet[key])
+    _sample("metrics_tpu_fleet_tenants", fleet["tenants"], kind="gauge")
+    for fleet_name in sorted(fleet["fleets"]):
+        summary = fleet["fleets"][fleet_name]
+        fleet_labels = {"fleet": fleet_name, "template": summary.get("template", "")}
+        _sample("metrics_tpu_fleet_epoch", summary["epoch"], fleet_labels, kind="gauge")
+        _sample("metrics_tpu_fleet_workers", len(summary["workers"]), fleet_labels, kind="gauge")
+        for worker_name in sorted(summary["workers"]):
+            worker = summary["workers"][worker_name]
+            labels = {"fleet": fleet_name, "worker": worker_name}
+            _sample("metrics_tpu_fleet_tenants_owned", worker["tenants"], labels, kind="gauge")
+            _sample("metrics_tpu_fleet_worker_alive", 1 if worker["alive"] else 0, labels, kind="gauge")
+            for key in ("migrations_in", "migrations_out", "bytes_in", "bytes_out"):
+                _sample(f"metrics_tpu_fleet_{key}", worker[key], labels)
 
     # AOT warmup manifests: warmed program inventory + staleness counters
     warm = _engine.warmup_report()

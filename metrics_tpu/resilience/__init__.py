@@ -13,8 +13,9 @@ TPUv4 scale; EQuARX degraded collectives). This package holds the pieces:
   policies, and the ``health_report()`` counter state (``docs/numerics.md``).
 * :mod:`~metrics_tpu.resilience.faults` — the deterministic fault-injection
   harness: an in-memory KV fake with per-(rank, epoch) drop/delay/corrupt/
-  straggler faults, per-thread world simulation, and an env-activated
-  (``METRICS_TPU_FAULTS``) wrapper for live clients.
+  straggler faults (plus the fleet-consumed ``kill`` kind), per-thread world
+  simulation, and an env-activated (``METRICS_TPU_FAULTS``) wrapper for live
+  clients.
 * sync telemetry — :func:`new_sync_stats` is the counter template behind
   ``Metric.sync_report()`` (attempts, retries, backoff elapsed, bytes
   exchanged, integrity failures, degraded syncs, missing ranks), mirroring

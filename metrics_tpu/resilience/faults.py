@@ -53,7 +53,7 @@ __all__ = [
 
 FAULTS_ENV_VAR = "METRICS_TPU_FAULTS"
 
-_FAULT_KINDS = ("drop", "delay", "corrupt", "straggler")
+_FAULT_KINDS = ("drop", "delay", "corrupt", "straggler", "kill")
 
 
 class KVTimeoutError(TimeoutError):
@@ -72,9 +72,17 @@ class FaultSpec:
             after it happens; ``'delay'`` — every read of the payload takes an
             extra ``seconds`` (timing out the attempt if its budget is
             smaller); ``'corrupt'`` — the first ``times`` reads return
-            bit-flipped bytes, later reads the true payload.
-        rank: the *publisher* process index whose payload is affected.
-        epoch: exchange epoch the fault applies to; ``None`` = every epoch.
+            bit-flipped bytes, later reads the true payload; ``'kill'`` —
+            consumed by the elastic fleet layer (``metrics_tpu.fleet``), not
+            the KV fake: the worker whose integer id is ``rank`` dies the
+            moment it is asked to admit a migrating tenant at fleet-epoch
+            version ``epoch`` (the mid-migration worker-kill scenario — the
+            payload survives in the migration ledger and a surviving worker
+            re-admits it). KV-level operations never consult kill specs.
+        rank: the *publisher* process index whose payload is affected (for
+            ``'kill'``: the fleet worker id).
+        epoch: exchange epoch the fault applies to (for ``'kill'``: the
+            fleet epoch version); ``None`` = every epoch.
         seconds: delay/straggler duration.
         times: how many corrupted reads ``'corrupt'`` serves before healing.
     """
@@ -139,6 +147,11 @@ class FaultPlan:
             if spec.kind == kind and spec.matches(rank, epoch):
                 return spec
         return None
+
+    def kills(self, rank: int, epoch: Optional[int] = None) -> bool:
+        """True when the plan fells worker/rank ``rank`` at ``epoch`` — the
+        fleet layer's mid-migration kill hook (see the ``'kill'`` kind)."""
+        return self._first("kill", rank, epoch) is not None
 
     def drops_publish(self, key: str) -> bool:
         parsed = _parse_key(key)

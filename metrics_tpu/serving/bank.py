@@ -175,6 +175,8 @@ class MetricBank:
             "dense_launches": 0,
             "bucketed_requests": 0,
             "lost_tenants": 0,
+            "exports": 0,
+            "imports": 0,
         }
         with _REGISTRY_LOCK:
             _BANKS.add(self)
@@ -318,6 +320,67 @@ class MetricBank:
         self._spilled_counts.pop(tenant)
         if _health.HEALTH_STATE in tree:
             self._spilled_health -= np.asarray(tree[_health.HEALTH_STATE], np.int64)
+
+    # ------------------------------------------------------------------
+    # cross-worker handoff (the fleet layer's migration surface)
+    # ------------------------------------------------------------------
+    def export_tenant(self, tenant: Hashable, keep: bool = False) -> Dict[str, Any]:
+        """The tenant's checkpoint-encoded state tree
+        (``utils.checkpoint.metric_state_pytree`` — exactly what LRU spill
+        stores), for handing the session to ANOTHER bank/worker.
+
+        ``keep=False`` (default) removes the session from this bank — the
+        handoff contract: after export, this bank no longer serves the
+        tenant. ``keep=True`` leaves the (now spilled) session in place — a
+        checkpoint read, e.g. for replication. Spilled tenants export even
+        from a poisoned bank (their host state is what poisoning promises
+        survived)."""
+        with self._lock:
+            if tenant in self._slots:
+                self._check_poisoned()
+                self.evict(tenant, spill=True)
+            if tenant not in self._spilled:
+                raise KeyError(f"unknown tenant {tenant!r} in bank {self.name!r}")
+            self.stats["exports"] += 1
+            if keep:
+                return dict(self._spilled[tenant])
+            tree = dict(self._spilled[tenant])
+            self._drop_spilled_entry(tenant)
+            return tree
+
+    def import_tenant(self, tenant: Hashable, tree: Dict[str, Any], admit: bool = True) -> None:
+        """Stage a checkpoint-encoded tenant (an :meth:`export_tenant` tree,
+        or a decoded migration payload) into this bank.
+
+        The tree is validated BEFORE the bank learns the tenant: a template
+        clone restores it through the checkpoint validator (shapes, dtype
+        kinds, dynamic attrs) and then re-binds through
+        :meth:`~metrics_tpu.Metric.bind_state` — the external-state bind
+        contract, including the PR-10 sharding-layout check — so a payload
+        from a different config fails loudly and leaves the bank untouched.
+        ``admit=True`` makes the tenant device-resident immediately (the
+        receiving end of a migration); ``admit=False`` stages it host-spilled
+        for on-demand admission."""
+        from metrics_tpu.utils import checkpoint as _ckpt
+
+        with self._lock:
+            self._check_poisoned()
+            if tenant in self._slots or tenant in self._spilled:
+                raise MetricsUserError(
+                    f"bank {self.name!r} already serves tenant {tenant!r};"
+                    " evict/export it before importing a new state for it."
+                )
+            probe = self._template.clone()
+            _ckpt.restore_metric_state_pytree(probe, dict(tree))
+            probe.bind_state(probe._snapshot_state(), update_count=probe._update_count)
+            staged = _ckpt.metric_state_pytree(probe)
+            self._spilled[tenant] = staged
+            self._spilled_counts[tenant] = probe._update_count
+            if _health.HEALTH_STATE in staged:
+                self._spilled_health += np.asarray(staged[_health.HEALTH_STATE], np.int64)
+            self.stats["imports"] += 1
+            if admit:
+                self.admit(tenant)
 
     # -- slot <-> state plumbing ----------------------------------------
     def _read_slot(self, slot: int) -> Dict[str, Array]:

@@ -88,6 +88,14 @@ class RequestRouter:
         self._clock = clock
         self._groups: Dict[Any, _Group] = {}
         self.stats = {"submitted": 0, "flushes": 0, "deadline_flushes": 0, "size_flushes": 0}
+        # per-signature counters OUTLIVE the signature's group (groups are
+        # deleted when drained): a signature that only ever trickles in under
+        # the deadline — the starvation pattern — keeps its history visible.
+        # Bounded: past _SIG_STATS_CAP distinct signatures (a long-lived
+        # worker fed unbucketed ragged shapes), new ones fold into one
+        # "sig_other" bucket so the map cannot grow for the process lifetime
+        self._sig_labels: Dict[Any, str] = {}
+        self._sig_stats: Dict[str, Dict[str, Any]] = {}
 
     # ------------------------------------------------------------------
     def _signature(self, args: Tuple[Any, ...]) -> Any:
@@ -104,11 +112,46 @@ class RequestRouter:
             sig.append((shape, str(jnp.result_type(leaf))))
         return tuple(sig)
 
+    _SIG_STATS_CAP = 256
+
+    def _sig_label(self, sig: Any) -> str:
+        """Stable short label for one signature group (``sig0``, ``sig1``, …
+        in first-seen order), with the leaf shapes/dtypes kept readable in
+        the per-signature stats entry. Beyond ``_SIG_STATS_CAP`` distinct
+        signatures, new ones share the ``sig_other`` bucket (bounded map;
+        the first-seen signatures keep their dedicated rows)."""
+        label = self._sig_labels.get(sig)
+        if label is None:
+            if len(self._sig_labels) >= self._SIG_STATS_CAP:
+                # NOT cached in _sig_labels: the label map itself must stay
+                # bounded, and the shared bucket needs no per-sig identity
+                if "sig_other" not in self._sig_stats:
+                    self._sig_stats["sig_other"] = {
+                        "signature": f"(signatures beyond the first {self._SIG_STATS_CAP})",
+                        "submitted": 0,
+                        "flushed": 0,
+                        "deadline_flushes": 0,
+                        "size_flushes": 0,
+                    }
+                return "sig_other"
+            label = f"sig{len(self._sig_labels)}"
+            self._sig_labels[sig] = label
+            desc = ";".join(f"{dtype}{list(shape)}" for shape, dtype in sig[1:])
+            self._sig_stats[label] = {
+                "signature": desc,
+                "submitted": 0,
+                "flushed": 0,
+                "deadline_flushes": 0,
+                "size_flushes": 0,
+            }
+        return label
+
     def submit(self, tenant: Hashable, *args: Any) -> int:
         """Queue one update request; returns the number of requests flushed
         as a side effect (0 when the request just queued)."""
         now = self._clock()
         sig = self._signature(args)
+        self._sig_stats[self._sig_label(sig)]["submitted"] += 1
         flushed = 0
         # per-tenant order is global, not per-signature: a request landing in
         # a NEW signature group while the tenant still has pending requests
@@ -131,6 +174,7 @@ class RequestRouter:
         self.stats["submitted"] += 1
         if len(group.waves[0].reqs) >= self.max_requests:
             self.stats["size_flushes"] += 1
+            self._sig_stats[self._sig_label(sig)]["size_flushes"] += 1
             flushed += self._flush_group(sig, waves=1)
         return flushed + self._flush_expired(now)
 
@@ -151,6 +195,41 @@ class RequestRouter:
     def pending(self) -> int:
         return sum(g.pending for g in self._groups.values())
 
+    def pending_detail(self) -> Dict[str, Dict[str, Any]]:
+        """Per-signature queue/starvation view: live pending count and
+        oldest-request wait next to the lifetime submitted / flushed /
+        deadline-flush / size-flush counters — a signature whose traffic
+        only ever leaves by deadline (``deadline_flushes`` high,
+        ``size_flushes`` zero) is starving below the batch size, the thing
+        a fleet operator tunes ``max_requests``/placement for."""
+        now = self._clock()
+        out: Dict[str, Dict[str, Any]] = {
+            label: {**stats, "pending": 0, "oldest_wait_s": 0.0}
+            for label, stats in self._sig_stats.items()
+        }
+        for sig, group in self._groups.items():
+            # += / max: overflow signatures share the "sig_other" bucket
+            entry = out[self._sig_label(sig)]
+            entry["pending"] += group.pending
+            if group.waves and group.pending:
+                entry["oldest_wait_s"] = max(
+                    entry["oldest_wait_s"], round(max(0.0, now - group.oldest_t), 6)
+                )
+        return out
+
+    def drain_pending(self) -> List[Tuple[Hashable, Tuple[Any, ...]]]:
+        """Remove and return every queued request WITHOUT applying it, in
+        per-tenant submission order (a tenant's requests all live in one
+        group, in wave order — cross-group submits flush eagerly). The
+        fleet's kill path re-routes these to the surviving owners; the
+        pending counters reset with the queues."""
+        out: List[Tuple[Hashable, Tuple[Any, ...]]] = []
+        for sig in list(self._groups):
+            group = self._groups.pop(sig)
+            for wave in group.waves:
+                out.extend(wave.reqs.items())
+        return out
+
     # ------------------------------------------------------------------
     def _flush_expired(self, now: float) -> int:
         if self.max_delay_s is None:
@@ -160,6 +239,7 @@ class RequestRouter:
             group = self._groups.get(sig)
             if group is not None and now - group.oldest_t >= self.max_delay_s:
                 self.stats["deadline_flushes"] += 1
+                self._sig_stats[self._sig_label(sig)]["deadline_flushes"] += 1
                 flushed += self._flush_group(sig)
         return flushed
 
@@ -181,6 +261,10 @@ class RequestRouter:
                     applied = self.bank.apply_batch(chunk)
                     self.stats["flushes"] += 1
                     flushed += applied
+                    # counted per chunk, not after the loop: a later chunk
+                    # failing must not lose this chunk's applied requests
+                    # from the per-signature flushed tally
+                    self._sig_stats[self._sig_label(sig)]["flushed"] += applied
                     for tenant, _ in chunk:
                         wave.reqs.pop(tenant, None)
             except Exception:

@@ -172,6 +172,9 @@ def _new_stats() -> Dict[str, Any]:
         # device_put placements of state leaves onto a mesh (place_states /
         # drive staging) — each is a host->mesh or mesh->mesh layout move
         "reshard_events": 0,
+        # whole-plane mesh changes (fleet.reshard_onto): an annotated state
+        # tree re-laid onto a DIFFERENT mesh, e.g. after a topology resize
+        "mesh_changes": 0,
         # registered annotations seen at placement/drive time:
         # "Class.state" -> str(PartitionSpec)
         "specs": {},
@@ -236,6 +239,13 @@ def _count_reshard(n: int, source: str, mesh: Any) -> None:
 def count_sharded_drive() -> None:
     with _STATS_LOCK:
         _STATS["sharded_drives"] += 1
+
+
+def count_mesh_change() -> None:
+    """One whole-plane mesh change (``fleet.reshard_onto``) — the per-leaf
+    ``reshard_events`` count the moves, this counts the topology changes."""
+    with _STATS_LOCK:
+        _STATS["mesh_changes"] += 1
 
 
 def place_state_dict(

@@ -1,0 +1,342 @@
+"""The elasticity acceptance suite: mid-epoch kill/join under the PR-2
+harness, bit-identical final values vs a static fleet, bounded rebalance.
+
+The migration ledger rides the simulated coordination service
+(``simulated_world`` + ``InMemoryKVStore`` + ``KVLedger``), so payloads
+cross the same (fault-injectable) fabric sync payloads do; the mid-migration
+worker-kill regression drives the fleet from a ``METRICS_TPU_FAULTS``-style
+plan with the new ``'kill'`` kind.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, SumMetric, engine
+from metrics_tpu.fleet import (
+    Fleet,
+    FleetRouter,
+    KVLedger,
+    assert_minimal_moves,
+)
+from metrics_tpu.resilience import FaultPlan, InMemoryKVStore, simulated_world
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+NUM_CLASSES = 5
+N_TENANTS = 24
+N_STEPS = 9
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    yield
+    engine.clear_cache()
+
+
+def _template():
+    return Accuracy(num_classes=NUM_CLASSES)
+
+
+def _stream(seed=0):
+    """[(step, tenant, request args)] — one deterministic request per tenant
+    per step, same for every fleet under comparison."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for step in range(N_STEPS):
+        for i in range(N_TENANTS):
+            preds = jnp.asarray(rng.rand(8, NUM_CLASSES).astype(np.float32))
+            target = jnp.asarray(rng.randint(0, NUM_CLASSES, size=8).astype(np.int32))
+            out.append((step, f"t{i}", (preds, target)))
+    return out
+
+
+def _run_static(stream, workers):
+    fleet = Fleet(_template(), workers=workers, capacity=N_TENANTS, max_delay_s=None)
+    router = FleetRouter(fleet)
+    for _step, tenant, args in stream:
+        router.submit(tenant, *args)
+    router.flush()
+    return {t: np.asarray(v) for t, v in fleet.compute_all().items()}
+
+
+def test_kill_and_join_mid_epoch_is_bit_identical_to_static_fleet():
+    """The headline gate: a fleet that grows at step 3 and loses a worker
+    (ungraceful kill, no drain) at step 6 finishes with bit-identical
+    per-tenant values to a static fleet AND to solo instances — and every
+    rebalance stays inside the rendezvous K/n bound."""
+    stream = _stream()
+    static = _run_static(stream, workers=[0, 1, 2])
+
+    solo = {f"t{i}": _template() for i in range(N_TENANTS)}
+    store = InMemoryKVStore()
+    with simulated_world(0, 1, store.client(0)):
+        fleet = Fleet(
+            _template(),
+            workers=[0, 1],
+            capacity=N_TENANTS,
+            max_delay_s=None,
+            ledger=KVLedger(),
+        )
+        router = FleetRouter(fleet)
+        last_step = -1
+        for step, tenant, args in stream:
+            if step != last_step:
+                if step == 3:
+                    moves = fleet.join(2)
+                    assert_minimal_moves(
+                        moves, fleet.epoch.with_workers([0, 1]), fleet.epoch, n_tenants=N_TENANTS
+                    )
+                    assert all(dst == 2 for _src, dst in moves.values())
+                if step == 6:
+                    kill_moves = fleet.kill(1)
+                    assert all(src == 1 for src, _dst in kill_moves.values())
+                last_step = step
+            router.submit(tenant, *args)
+            solo[tenant].update(*args)
+        router.flush()
+        elastic = {t: np.asarray(v) for t, v in fleet.compute_all().items()}
+
+    assert set(elastic) == set(static) == set(solo)
+    for t in static:
+        assert np.array_equal(elastic[t], static[t]), f"tenant {t} diverged from static fleet"
+        assert np.array_equal(elastic[t], np.asarray(solo[t].compute())), f"tenant {t} vs solo"
+    # the kill recovered every session the dead worker held, none lost
+    assert fleet.stats["kills"] == 1
+    assert fleet.stats["recovered_tenants"] == len(kill_moves)
+    assert fleet.epoch.version == 2 and fleet.workers == [0, 2]
+
+
+def test_kill_with_unflushed_requests_resubmits_them():
+    """An ungraceful kill with requests still queued on the dead worker's
+    router re-routes them to the surviving owners — the stream is applied
+    exactly once, values stay bit-identical to solo."""
+    fleet = Fleet(
+        SumMetric(nan_strategy="disable"), workers=[0, 1], capacity=8, max_delay_s=None
+    )
+    solo = {}
+    rng = np.random.RandomState(1)
+    for i in range(10):
+        t = f"t{i}"
+        solo[t] = SumMetric(nan_strategy="disable")
+        for _ in range(2):
+            x = jnp.asarray(rng.rand(4).astype(np.float32))
+            solo[t].update(x)
+            fleet.submit(t, x)
+    fleet.flush()
+    victim = fleet.owner_of("t0")
+    # queue un-flushed traffic on the victim, then kill it without draining
+    queued = [t for t in solo if fleet.owner_of(t) == victim]
+    for t in queued:
+        x = jnp.asarray(rng.rand(4).astype(np.float32))
+        solo[t].update(x)
+        fleet.submit(t, x)
+    assert fleet.worker(victim).router.pending == len(queued)
+    fleet.kill(victim)
+    assert fleet.stats["resubmitted_requests"] == len(queued)
+    fleet.flush()
+    for t, m in solo.items():
+        assert np.array_equal(np.asarray(fleet.compute(t)), np.asarray(m.compute())), t
+
+
+def test_mid_migration_worker_kill_fault_plan_env(monkeypatch):
+    """The ``METRICS_TPU_FAULTS`` regression (satellite): the destination
+    worker dies at the moment it is asked to admit a migrating tenant. The
+    payload survives in the ledger; the tenant is re-admitted on a surviving
+    worker with its pre-drain state intact."""
+    monkeypatch.setenv(
+        "METRICS_TPU_FAULTS", '[{"kind": "kill", "rank": 2, "epoch": 1}]'
+    )
+    fleet = Fleet(
+        SumMetric(nan_strategy="disable"), workers=[0, 1], capacity=16, max_delay_s=None
+    )
+    rng = np.random.RandomState(2)
+    solo = {}
+    for i in range(20):
+        t = f"t{i}"
+        x = jnp.asarray(rng.rand(4).astype(np.float32))
+        solo[t] = SumMetric(nan_strategy="disable")
+        solo[t].update(x)
+        fleet.submit(t, x)
+    fleet.flush()
+    moves = fleet.join(2)  # epoch v1: worker 2 is plan-killed on first admit
+    # the joiner died before serving anything: every move landed on a survivor
+    assert fleet.stats["kills"] == 1
+    assert 2 not in fleet.epoch.workers and fleet.workers == [0, 1]
+    assert all(dst in (0, 1) for _src, dst in moves.values())
+    for t, m in solo.items():
+        got = np.asarray(fleet.compute(t))
+        assert np.array_equal(got, np.asarray(m.compute())), f"tenant {t} lost its pre-drain state"
+    assert fleet.ledger.pending() == []  # every payload was admitted + acked
+
+
+def test_dead_owner_refuses_traffic_until_membership_advances():
+    plan = FaultPlan([{"kind": "kill", "rank": 1, "epoch": 1}])
+    fleet = Fleet(
+        SumMetric(nan_strategy="disable"),
+        workers=[0, 1, 2],
+        capacity=8,
+        max_delay_s=None,
+        fault_plan=plan,
+    )
+    for i in range(12):
+        fleet.submit(f"t{i}", jnp.asarray(np.ones(4, np.float32)))
+    fleet.flush()
+    fleet.leave(2)  # migrations toward epoch v1 fell worker 1 (plan kill)
+    assert fleet.stats["kills"] == 1
+    # every tenant still computes on the lone survivor, nothing stranded
+    for i in range(12):
+        assert fleet.owner_of(f"t{i}") == 0
+        assert float(np.asarray(fleet.compute(f"t{i}"))) == 4.0
+
+
+def test_no_surviving_worker_keeps_payload_in_ledger():
+    plan = FaultPlan([{"kind": "kill", "rank": 1, "epoch": None}])
+    fleet = Fleet(
+        SumMetric(nan_strategy="disable"),
+        workers=[0, 1],
+        capacity=8,
+        max_delay_s=None,
+        fault_plan=plan,
+    )
+    fleet.submit("T", jnp.asarray(np.ones(4, np.float32)))
+    fleet.flush()
+    if fleet.owner_of("T") == 1:  # make worker 0 the holder for determinism
+        fleet.kill(1)
+    with pytest.raises(MetricsUserError, match="no surviving worker"):
+        fleet.kill(0)  # survivor 1 is plan-killed at every epoch -> nobody left
+    assert fleet.ledger.pending()  # the payload is NOT lost
+
+
+def test_cascade_kill_during_recovery_recovers_the_second_victim_too():
+    """A destination the fault plan fells DURING a kill()'s recovery must be
+    recovered in turn — its own tenants' state must not be stranded in its
+    dead bank (a later submit would silently fork them with fresh state)."""
+    # epoch v0 [0,1,2]; kill(1) -> recovery targets epoch v1; the plan fells
+    # worker 2 the first time v1 asks it to admit
+    plan = FaultPlan([{"kind": "kill", "rank": 2, "epoch": 1}])
+    fleet = Fleet(
+        SumMetric(nan_strategy="disable"),
+        workers=[0, 1, 2],
+        capacity=16,
+        max_delay_s=None,
+        fault_plan=plan,
+    )
+    solo = {}
+    rng = np.random.RandomState(3)
+    for i in range(18):
+        t = f"t{i}"
+        x = jnp.asarray(rng.rand(4).astype(np.float32))
+        solo[t] = SumMetric(nan_strategy="disable")
+        solo[t].update(x)
+        fleet.submit(t, x)
+    fleet.flush()
+    had_w2_tenants = any(fleet.owner_of(t) == 2 for t in solo)
+    assert had_w2_tenants  # the scenario must actually exercise the cascade
+    fleet.kill(1)
+    assert fleet.stats["kills"] == 2  # explicit kill + plan cascade
+    assert fleet.workers == [0]
+    # EVERY tenant — worker 1's and cascade-victim 2's — kept its state
+    for t, m in solo.items():
+        assert np.array_equal(np.asarray(fleet.compute(t)), np.asarray(m.compute())), t
+    assert fleet.ledger.pending() == []
+
+
+class _FlakyLedger:
+    """LocalLedger with injectable fetch failures — a dropped/late migration
+    payload, without the KV machinery. ``fail_fetches=N`` fails the first N
+    fetches globally; ``sticky=True`` instead fails EVERY fetch of the first
+    key published until :meth:`heal` is called."""
+
+    def __init__(self, fail_fetches=1, sticky=False):
+        from metrics_tpu.fleet import LocalLedger
+
+        self._inner = LocalLedger()
+        self._fail = fail_fetches
+        self._sticky = sticky
+        self._sticky_key = None
+
+    def heal(self):
+        self._sticky_key = None
+
+    def publish(self, key, payload):
+        if self._sticky and self._sticky_key is None:
+            self._sticky_key = key
+        self._inner.publish(key, payload)
+
+    def fetch(self, key, timeout_s=5.0):
+        if self._sticky:
+            if key == self._sticky_key:
+                raise TimeoutError("DEADLINE_EXCEEDED: injected sticky fetch failure")
+        elif self._fail > 0:
+            self._fail -= 1
+            raise TimeoutError("DEADLINE_EXCEEDED: injected migration fetch failure")
+        return self._inner.fetch(key, timeout_s)
+
+    def ack(self, key):
+        self._inner.ack(key)
+
+    def pending(self):
+        return self._inner.pending()
+
+
+def test_single_fetch_failure_self_heals_within_the_resize():
+    """One flaky fetch: the resize's in-flight retry sweep completes the
+    move in the SAME call — no error surfaces, nothing parked."""
+    fleet = Fleet(
+        SumMetric(nan_strategy="disable"),
+        workers=[0, 1],
+        capacity=16,
+        max_delay_s=None,
+        ledger=_FlakyLedger(fail_fetches=1),
+    )
+    rng = np.random.RandomState(6)
+    for i in range(8):
+        fleet.submit(f"t{i}", jnp.asarray(rng.rand(4).astype(np.float32)))
+    fleet.flush()
+    fleet.join(2)  # does not raise: the sweep retried the one failed fetch
+    assert not fleet._in_flight and fleet.ledger.pending() == []
+    assert fleet.stats["migration_failures"] == 1  # counted, then healed
+
+
+def test_failed_migration_commits_epoch_and_heals_on_next_touch():
+    """A tenant whose payload stays unfetchable (past the in-resize retry)
+    keeps its state parked in the ledger: the resize still commits (no
+    silent fork for the tenants that DID move), raises a loud aggregate
+    error, and once the fault clears the tenant re-admits on its next
+    submit — nothing lost."""
+    ledger = _FlakyLedger(sticky=True)
+    fleet = Fleet(
+        SumMetric(nan_strategy="disable"),
+        workers=[0, 1],
+        capacity=16,
+        max_delay_s=None,
+        ledger=ledger,
+    )
+    rng = np.random.RandomState(5)
+    solo = {}
+    for i in range(12):
+        t = f"t{i}"
+        x = jnp.asarray(rng.rand(4).astype(np.float32))
+        solo[t] = SumMetric(nan_strategy="disable")
+        solo[t].update(x)
+        fleet.submit(t, x)
+    fleet.flush()
+    with pytest.raises(MetricsUserError, match="migration.*failed|failed"):
+        fleet.join(2)
+    # the epoch COMMITTED despite the failure: moved tenants route to their
+    # new owners, the failed tenant is parked (in-flight), none forked
+    assert fleet.epoch.version == 1 and fleet.workers == [0, 1, 2]
+    assert fleet.stats["migration_failures"] == 2  # the move + the sweep retry
+    assert len(fleet._in_flight) == 1
+    (parked,) = list(fleet._in_flight)
+    # once the fault clears, the next touch heals: the parked tenant
+    # re-admits from the ledger with its full pre-move state, keeps serving
+    ledger.heal()
+    x = jnp.asarray(rng.rand(4).astype(np.float32))
+    solo[parked].update(x)
+    fleet.submit(parked, x)
+    fleet.flush()
+    assert not fleet._in_flight and fleet.ledger.pending() == []
+    for t, m in solo.items():
+        assert np.array_equal(np.asarray(fleet.compute(t)), np.asarray(m.compute())), t

@@ -1,0 +1,184 @@
+"""Migration protocol: payload codec, bank handoff, ledger crash-safety."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, SumMetric, engine
+from metrics_tpu.fleet import (
+    LocalLedger,
+    admit_payload,
+    decode_tenant_payload,
+    encode_tenant_payload,
+    ledger_key,
+)
+from metrics_tpu.serving import MetricBank
+from metrics_tpu.utils.exceptions import MetricsUserError, SyncIntegrityError
+
+NUM_CLASSES = 5
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    yield
+    engine.clear_cache()
+
+
+def _req(seed, batch=8):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.rand(batch, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, size=batch).astype(np.int32)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# payload codec
+# ---------------------------------------------------------------------------
+def test_payload_round_trips_a_checkpoint_tree():
+    tree = {
+        "_update_count": 7,
+        "value": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "count": np.asarray(9, np.int64),
+    }
+    payload = encode_tenant_payload(tree)
+    out = decode_tenant_payload(payload)
+    assert set(out) == set(tree)
+    assert int(np.asarray(out["_update_count"])) == 7
+    assert np.array_equal(np.asarray(out["value"]), tree["value"])
+    assert np.asarray(out["count"]).dtype == np.int64
+
+
+def test_payload_corruption_fails_loudly():
+    payload = encode_tenant_payload({"_update_count": 1, "v": np.ones(8, np.float32)})
+    corrupted = bytearray(payload)
+    corrupted[len(corrupted) // 2] ^= 0xFF
+    with pytest.raises(SyncIntegrityError):
+        decode_tenant_payload(bytes(corrupted))
+
+
+def test_payload_rides_the_wire_codecs():
+    """A float leaf tagged bf16 ships ~half the bytes; integer leaves always
+    pass through exact regardless of the tag — the PR-8 codec contract."""
+    big = np.random.RandomState(0).rand(4096).astype(np.float32)
+    tree = {"_update_count": 3, "feats": big, "ids": np.arange(4096, dtype=np.int64)}
+    exact = encode_tenant_payload(tree)
+    narrow = encode_tenant_payload(tree, precisions={"feats": "bf16", "ids": "bf16"})
+    # feats halve (16384 -> 8192 bytes); ids stay exact 8-byte ints
+    assert len(exact) - len(narrow) > 7000
+    out = decode_tenant_payload(narrow)
+    assert np.array_equal(np.asarray(out["ids"]), tree["ids"])  # ints exact
+    assert np.allclose(np.asarray(out["feats"]), big, rtol=1e-2)  # bf16 bound
+
+
+def test_payload_rejects_list_state_trees():
+    with pytest.raises(MetricsUserError, match="list"):
+        encode_tenant_payload({"_update_count": 0, "buf": {"0": np.ones(3)}})
+
+
+# ---------------------------------------------------------------------------
+# bank export / import (the handoff the fleet migration performs)
+# ---------------------------------------------------------------------------
+def test_export_import_round_trip_is_bit_identical():
+    src = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=4, name="mig-src")
+    dst = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=4, name="mig-dst")
+    solo = Accuracy(num_classes=NUM_CLASSES)
+    for i in range(3):
+        src.update("T", *_req(i))
+        solo.update(*_req(i))
+    payload = encode_tenant_payload(src.export_tenant("T"))
+    assert "T" not in src.tenants and "T" not in src.spilled_tenants  # handoff removes
+    admit_payload(dst, "T", payload)
+    assert "T" in dst.tenants
+    assert dst.update_count("T") == 3
+    assert np.array_equal(np.asarray(dst.compute("T")), np.asarray(solo.compute()))
+    # the migrated tenant keeps serving on the new owner
+    dst.update("T", *_req(3))
+    solo.update(*_req(3))
+    assert np.array_equal(np.asarray(dst.compute("T")), np.asarray(solo.compute()))
+
+
+def test_import_validates_before_the_bank_learns_the_tenant():
+    from metrics_tpu import ConfusionMatrix
+
+    src = MetricBank(ConfusionMatrix(num_classes=NUM_CLASSES), capacity=4)
+    rng = np.random.RandomState(0)
+    src.update(
+        "T",
+        jnp.asarray(rng.randint(0, NUM_CLASSES, size=8).astype(np.int32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, size=8).astype(np.int32)),
+    )
+    tree = src.export_tenant("T")
+    wrong = MetricBank(ConfusionMatrix(num_classes=NUM_CLASSES + 2), capacity=4)
+    with pytest.raises(ValueError, match="shape"):
+        wrong.import_tenant("T", tree)
+    assert "T" not in wrong.tenants and "T" not in wrong.spilled_tenants
+
+
+def test_import_rejects_duplicate_sessions():
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=4)
+    bank.update("T", jnp.asarray(np.ones(4, np.float32)))
+    tree = bank.export_tenant("T", keep=True)
+    with pytest.raises(MetricsUserError, match="already serves"):
+        bank.import_tenant("T", tree)
+
+
+def test_export_keep_reads_without_removing():
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=4)
+    bank.update("T", jnp.asarray(np.full(4, 2.0, np.float32)))
+    tree = bank.export_tenant("T", keep=True)
+    assert "T" in bank.spilled_tenants  # export spilled it, kept the session
+    assert float(np.asarray(bank.compute("T"))) == 8.0
+    assert float(np.asarray(tree["value"])) == 8.0
+
+
+def test_health_counters_ride_the_migration():
+    src = MetricBank(SumMetric(nan_strategy="disable", on_bad_input="skip"), capacity=4)
+    dst = MetricBank(SumMetric(nan_strategy="disable", on_bad_input="skip"), capacity=4)
+    src.update("T", jnp.asarray(np.array([1.0, np.nan, 3.0], np.float32)))
+    quarantined = src.summary()["updates_quarantined"]
+    assert quarantined == 1
+    admit_payload(dst, "T", encode_tenant_payload(src.export_tenant("T")))
+    assert dst.summary()["updates_quarantined"] == 1
+
+
+def test_ledger_holds_payloads_until_acked():
+    ledger = LocalLedger()
+    key = ledger_key("f", 3, "T")
+    ledger.publish(key, b"payload-bytes")
+    assert ledger.pending() == [key]
+    assert ledger.fetch(key) == b"payload-bytes"
+    assert ledger.fetch(key) == b"payload-bytes"  # a crash retries the fetch
+    ledger.ack(key)
+    assert ledger.pending() == []
+    with pytest.raises(TimeoutError):
+        ledger.fetch(key, timeout_s=0.01)
+
+
+# ---------------------------------------------------------------------------
+# PR-9 composition: a joining worker warms from the live recording
+# ---------------------------------------------------------------------------
+def test_manifest_dict_matches_save_manifest(tmp_path):
+    import json
+
+    engine.record_manifest()
+    try:
+        bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=4)
+        bank.update("T", *_req(0))
+        doc = engine.manifest_dict()
+        assert doc["entries"], "recording captured no programs"
+        path = engine.save_manifest(str(tmp_path / "m.json"))
+        with open(path) as f:
+            on_disk = json.load(f)
+        assert on_disk["version"] == doc["version"]
+        assert len(on_disk["entries"]) == len(doc["entries"])
+        # the in-memory dict warms directly — no disk round-trip needed
+        report = engine.warmup(doc, templates=[bank])
+        assert report["manifest_programs"] >= 1
+    finally:
+        import importlib
+
+        _w = importlib.import_module("metrics_tpu.engine.warmup")
+        _w.stop_recording()
+        _w.reset_warmup_state()

@@ -1,0 +1,94 @@
+"""Rendezvous placement: determinism, minimal moves, epoch versioning."""
+import pytest
+
+from metrics_tpu.fleet import (
+    FleetEpoch,
+    assert_minimal_moves,
+    owner,
+    owners,
+    partition_by_owner,
+    placement_diff,
+    rendezvous_score,
+)
+
+TENANTS = [f"tenant-{i}" for i in range(200)]
+
+
+def test_scores_are_deterministic_and_type_safe():
+    assert rendezvous_score("w0", "t0") == rendezvous_score("w0", "t0")
+    assert rendezvous_score("w0", "t0") != rendezvous_score("w1", "t0")
+    # int 1 and str "1" must not collide as ids
+    assert rendezvous_score(1, "t0") != rendezvous_score("1", "t0")
+
+
+def test_owner_is_coordination_free():
+    """Two independently-built epochs with the same membership (learned in a
+    different order) place every tenant identically — the property that lets
+    any peer answer ownership locally."""
+    a = FleetEpoch(["w2", "w0", "w1"])
+    b = FleetEpoch(["w0", "w1", "w2"])
+    assert a.workers == b.workers
+    for t in TENANTS:
+        assert owner(t, a) == owner(t, b)
+
+
+def test_epoch_versioning_and_membership():
+    e0 = FleetEpoch(["w0", "w1"])
+    assert e0.version == 0 and e0.size == 2
+    e1 = e0.join("w2")
+    assert e1.version == 1 and "w2" in e1
+    e2 = e1.leave("w0")
+    assert e2.version == 2 and "w0" not in e2
+    with pytest.raises(KeyError):
+        e2.leave("w0")
+    # epochs are immutable values: the old one still answers old questions
+    assert e0.workers == ("w0", "w1")
+
+
+def test_join_moves_only_to_the_joining_worker():
+    e0 = FleetEpoch([f"w{i}" for i in range(4)])
+    e1 = e0.join("w4")
+    moves = placement_diff(TENANTS, e0, e1)
+    assert moves  # some tenants must move to the new worker
+    assert all(dst == "w4" for _src, dst in moves.values())
+    assert_minimal_moves(moves, e0, e1, n_tenants=len(TENANTS))
+    # ~K/(n+1) in expectation; the CI slack bound is 2.5x
+    assert len(moves) <= 2.5 * len(TENANTS) / e1.size
+
+
+def test_leave_moves_only_the_leavers_tenants():
+    e0 = FleetEpoch([f"w{i}" for i in range(5)])
+    owned_by_w2 = [t for t in TENANTS if owner(t, e0) == "w2"]
+    e1 = e0.leave("w2")
+    moves = placement_diff(TENANTS, e0, e1)
+    assert set(moves) == set(owned_by_w2)
+    assert all(src == "w2" for src, _dst in moves.values())
+    assert_minimal_moves(moves, e0, e1, n_tenants=len(TENANTS))
+
+
+def test_failover_target_is_the_second_scorer():
+    e0 = FleetEpoch([f"w{i}" for i in range(4)])
+    for t in TENANTS[:50]:
+        first, second = owners(t, e0, k=2)
+        assert owner(t, e0.leave(first)) == second
+
+
+def test_assert_minimal_moves_rejects_survivor_trades():
+    e0 = FleetEpoch(["w0", "w1", "w2"])
+    e1 = e0.join("w3")
+    with pytest.raises(AssertionError, match="survivors must not trade"):
+        assert_minimal_moves({"t": ("w0", "w1")}, e0, e1)
+
+
+def test_partition_by_owner_covers_every_worker():
+    e0 = FleetEpoch([f"w{i}" for i in range(3)])
+    part = partition_by_owner(TENANTS, e0)
+    assert set(part) == set(e0.workers)
+    assert sum(len(v) for v in part.values()) == len(TENANTS)
+    # rendezvous spreads: no worker holds everything (200 tenants, 3 workers)
+    assert all(0 < len(v) < len(TENANTS) for v in part.values())
+
+
+def test_empty_epoch_cannot_place():
+    with pytest.raises(ValueError, match="no workers"):
+        owner("t", FleetEpoch([]))

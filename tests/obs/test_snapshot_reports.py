@@ -298,6 +298,7 @@ def test_jsonl_roundtrip_and_prometheus_render():
         "wire",
         "warmup",
         "sharding",
+        "fleet",
         "bus",
         "spans",
         "warnings",
@@ -309,14 +310,27 @@ def test_jsonl_roundtrip_and_prometheus_render():
     from metrics_tpu import sharding as _sharding
 
     assert process["sharding"] == _sharding.shard_stats()
-    assert set(process["sharding"]) == {"sharded_drives", "reshard_events", "specs", "resident"}
-    # ...and the Prometheus dump mirrors the fetch + warmup + sharding counters
+    assert set(process["sharding"]) == {
+        "sharded_drives",
+        "reshard_events",
+        "mesh_changes",
+        "specs",
+        "resident",
+    }
+    from metrics_tpu import fleet as _fleet
+
+    assert process["fleet"] == _fleet.fleet_stats()
+    assert {"migrations", "rebalance_bytes", "kills", "fleets"} <= set(process["fleet"])
+    # ...and the Prometheus dump mirrors the fetch + warmup + sharding +
+    # fleet counters
     assert "metrics_tpu_engine_async_fetches" in text
     assert "metrics_tpu_engine_coalesced_leaves" in text
     assert "metrics_tpu_warmup_programs_warmed" in text
     assert "metrics_tpu_warmup_stale_total" in text
     assert "metrics_tpu_shard_sharded_drives" in text
     assert "metrics_tpu_shard_reshard_events" in text
+    assert "metrics_tpu_fleet_migrations" in text
+    assert "metrics_tpu_fleet_rebalance_bytes" in text
 
 
 def test_validate_jsonl_rejects_bad_lines():

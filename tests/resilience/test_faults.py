@@ -161,3 +161,18 @@ def test_faulty_client_straggler_delays_visibility_not_the_publisher():
     client.key_value_delete("pg/s/0/0")
     time.sleep(0.4)
     assert "pg/s/0/0" not in inner.store
+
+
+def test_kill_specs_parse_and_match_but_never_touch_kv():
+    """The fleet-consumed 'kill' kind: plan-parseable (incl. from the env
+    JSON format), matched by (rank, epoch), and invisible to KV-level
+    behavior — drop/delay/corrupt helpers ignore it."""
+    plan = parse_plan('[{"kind": "kill", "rank": 2, "epoch": 1}]')
+    assert plan.kills(2, 1)
+    assert plan.kills(2, None)  # unknown epoch: conservative match
+    assert not plan.kills(2, 0) and not plan.kills(1, 1)
+    assert FaultPlan([FaultSpec("kill", rank=0)]).kills(0, 99)  # every epoch
+    # KV-level helpers never consult kill specs
+    assert not plan.drops_publish("pg/s/1/2")
+    assert plan.read_delay_s("pg/s/1/2") == 0.0
+    assert plan.maybe_corrupt("pg/s/1/2", b"x") == b"x"

@@ -1,0 +1,98 @@
+"""Sharded-state × serving-bank interaction: LRU spill → re-admit round
+trips of PR-10 ``PartitionSpec``-annotated states (previously untested)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import ConfusionMatrix, StatScores, engine
+from metrics_tpu.serving import MetricBank
+
+NUM_CLASSES = 32
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    yield
+    engine.clear_cache()
+
+
+def _mesh(mp=4):
+    devs = jax.devices()
+    assert len(devs) >= mp
+    return Mesh(np.array(devs[:mp]).reshape(1, mp), ("dp", "mp"))
+
+
+def _req(rng, batch=8):
+    return (
+        jnp.asarray(rng.randint(0, NUM_CLASSES, size=batch).astype(np.int32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, size=batch).astype(np.int32)),
+    )
+
+
+def test_annotated_template_banks_spill_and_readmit_bit_identically():
+    """A bank of class-sharded StatScores templates churns through LRU
+    spill/re-admit; every tenant stays bit-identical to a solo instance and
+    the sharding ANNOTATION survives the round trip."""
+    template = StatScores(reduce="macro", num_classes=NUM_CLASSES, class_sharding="mp")
+    bank = MetricBank(template, capacity=2)  # 6 tenants -> constant churn
+    solos = {f"t{i}": template.clone() for i in range(6)}
+    for step in range(4):
+        for t, solo in solos.items():
+            req = _req(np.random.RandomState(1000 * step + hash(t) % 997))
+            solo.update(*req)
+            bank.update(t, *req)
+    assert len(bank.spilled_tenants) == 4  # churn actually spilled
+    for t, solo in solos.items():
+        assert np.array_equal(np.asarray(bank.compute(t)), np.asarray(solo.compute())), t
+        mat = bank.materialize(t)
+        spec = mat.state_spec()
+        assert str(spec["tp"].sharding) == str(P("mp"))  # annotation survived
+        assert mat._update_count == 4
+
+
+def test_spilled_annotated_tenant_readmits_after_mesh_placement():
+    """A tenant whose solo twin lives mesh-placed (shard_states) exports
+    into a bank, spills, re-admits, and still binds back onto the mesh —
+    the full sharded-state serving lifecycle."""
+    rng = np.random.RandomState(1)
+    mesh = _mesh(4)
+    template = ConfusionMatrix(num_classes=NUM_CLASSES, class_sharding="mp")
+    bank = MetricBank(template, capacity=1)
+    solo = template.clone()
+    for step in range(3):
+        req = _req(rng)
+        solo.update(*req)
+        bank.update("hot", *req)
+    bank.update("cold", *_req(rng))  # spills "hot"
+    assert "hot" in bank.spilled_tenants
+    # re-admission decodes the spilled checkpoint exactly
+    assert np.array_equal(np.asarray(bank.compute("hot")), np.asarray(solo.compute()))
+    # the materialized tenant re-lays onto a live mesh per its annotation
+    mat = bank.materialize("hot")
+    mat.shard_states(mesh)
+    assert len(mat.confmat.sharding.device_set) == 4
+    assert np.array_equal(np.asarray(mat.confmat), np.asarray(solo.confmat))
+
+
+def test_export_import_preserves_annotations_across_banks():
+    rng = np.random.RandomState(2)
+    template = StatScores(reduce="macro", num_classes=NUM_CLASSES, class_sharding="mp")
+    src = MetricBank(template, capacity=2)
+    dst = MetricBank(template.clone(), capacity=2)
+    solo = template.clone()
+    for _ in range(3):
+        req = _req(rng)
+        solo.update(*req)
+        src.update("T", *req)
+    dst.import_tenant("T", src.export_tenant("T"))
+    assert np.array_equal(np.asarray(dst.compute("T")), np.asarray(solo.compute()))
+    mat = dst.materialize("T")
+    assert str(mat.state_spec()["fp"].sharding) == str(P("mp"))
+    # bind_state accepts the (replicated) tree and re-validates the layout
+    mat2 = template.clone()
+    mat2.bind_state(mat._snapshot_state(), update_count=3)
+    assert np.array_equal(np.asarray(mat2.compute()), np.asarray(solo.compute()))

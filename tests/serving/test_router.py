@@ -119,3 +119,58 @@ def test_oversized_wave_chunks_to_capacity():
     # ceil(5 / capacity 2) = 3 launches, LRU spill absorbing the overflow
     assert bank.stats["launches"] == 3
     assert bank.occupancy == 2 and len(bank.spilled_tenants) == 3
+
+
+def test_per_signature_deadline_flush_counts_surface_starvation():
+    """The starvation view the fleet layer reads: a signature whose traffic
+    only ever leaves by deadline shows high deadline_flushes and zero
+    size_flushes, per signature — not blurred into the router total."""
+    now = [0.0]
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=8)
+    router = RequestRouter(bank, max_requests=2, max_delay_s=1.0, clock=lambda: now[0])
+    # signature A: always fills the size bound
+    for i in range(4):
+        router.submit(f"a{i}", jnp.asarray(np.ones(4, np.float32)))
+    # signature B: a lone trickler, flushed only by its deadline
+    router.submit("b0", jnp.asarray(np.ones(6, np.float32)))
+    now[0] = 2.0
+    router.poll()
+    detail = router.pending_detail()
+    assert set(detail) == {"sig0", "sig1"}
+    sig_a, sig_b = detail["sig0"], detail["sig1"]
+    assert sig_a["size_flushes"] == 2 and sig_a["deadline_flushes"] == 0
+    assert sig_a["submitted"] == 4 and sig_a["flushed"] == 4
+    assert sig_b["size_flushes"] == 0 and sig_b["deadline_flushes"] == 1
+    assert sig_b["submitted"] == 1 and sig_b["flushed"] == 1
+    # the signature description names leaf dtypes/shapes
+    assert "[4]" in sig_a["signature"] and "[6]" in sig_b["signature"]
+    # history OUTLIVES the drained groups (the group dict is empty now)
+    assert router.pending == 0
+    assert all(d["pending"] == 0 for d in detail.values())
+
+
+def test_pending_detail_reports_live_queue_and_wait():
+    now = [10.0]
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=8)
+    router = RequestRouter(bank, max_requests=8, max_delay_s=None, clock=lambda: now[0])
+    router.submit("a", jnp.asarray(np.ones(4, np.float32)))
+    router.submit("b", jnp.asarray(np.ones(4, np.float32)))
+    now[0] = 10.5
+    detail = router.pending_detail()
+    assert detail["sig0"]["pending"] == 2
+    assert detail["sig0"]["oldest_wait_s"] == pytest.approx(0.5)
+
+
+def test_drain_pending_returns_requests_in_per_tenant_order():
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=8)
+    router = RequestRouter(bank, max_requests=100, max_delay_s=None)
+    v1 = jnp.asarray(np.full(4, 1.0, np.float32))
+    v2 = jnp.asarray(np.full(4, 2.0, np.float32))
+    router.submit("T", v1)
+    router.submit("T", v2)  # second wave, same tenant
+    router.submit("U", v1)
+    drained = router.drain_pending()
+    assert router.pending == 0
+    t_vals = [float(np.asarray(args[0][0])) for t, args in drained if t == "T"]
+    assert t_vals == [1.0, 2.0]  # per-tenant submission order preserved
+    assert bank.stats["launches"] == 0  # nothing was applied
