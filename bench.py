@@ -403,16 +403,18 @@ def _synth_sentences(rng: np.random.RandomState, n: int, length: int) -> list:
     return [" ".join(_WORDS[j] for j in rng.randint(0, len(_WORDS), length)) for i in range(n)]
 
 
-def _hash_tokenizer(text, max_length):
+def _hash_tokenizer(text, max_length, vocab=_BERT_VOCAB, reserved=1000, offset=999, cls=101, sep=102):
+    """crc32-hash own-tokenizer (the one word->id scheme every BERTScore
+    lane shares; callers with smaller vocabs bind vocab/reserved/offset)."""
     import zlib
 
     ids = np.zeros((len(text), max_length), dtype=np.int64)
     mask = np.zeros_like(ids)
     for i, sentence in enumerate(text):
-        tokens = [101] + [
-            zlib.crc32(w.encode()) % (_BERT_VOCAB - 1000) + 999 for w in sentence.split()
+        tokens = [cls] + [
+            zlib.crc32(w.encode()) % (vocab - reserved) + offset for w in sentence.split()
         ]
-        tokens = tokens[: max_length - 1] + [102]
+        tokens = tokens[: max_length - 1] + [sep]
         ids[i, : len(tokens)] = tokens
         mask[i, : len(tokens)] = 1
     return {"input_ids": ids, "attention_mask": mask}
@@ -2158,6 +2160,209 @@ def bench_sharded_states() -> dict:
     }
 
 
+def bench_sharded_encoders() -> dict:
+    """On-mesh metric encoders (``ci.sh --encoder-smoke`` gates every field).
+
+    Four contracts on the 2x4 (dp x mp) CPU mesh:
+
+    * **parity**: an encoder-sharded BERTScore corpus pass (weights
+      mp-sharded over the vocab axis, activations dp-sharded over the
+      sentence axis, pow2 length bucketing) is BIT-identical to the
+      single-device pad-to-max pass;
+    * **zero repeat compiles**: a repeat epoch and a fresh metric instance
+      on the same encoder compile nothing new;
+    * **warmed restart**: a worker restart simulated by ``clear_cache`` +
+      ``warmup(manifest, templates=[encoder])`` serves its first request
+      from pre-seeded executables — ``warmed_hits > 0``, ``stale_total ==
+      0``;
+    * **throughput**: a bert-like transformer scored through the chunked
+      pow2-length-bucketed pass vs the same encoder's fixed pad-to-max
+      single-device launches — >= 2x sentences/s on the CPU lane (the
+      stored single-device BENCH baseline is 2.89 sentences/s).
+    """
+    ensure_host_platform_devices(8)
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import BERTScore, ShardedEncoder, engine
+    from metrics_tpu.encoders import encoder_stats, reset_encoder_stats
+    import sys as _sys
+
+    wu = _sys.modules["metrics_tpu.engine.warmup"]
+
+    if len(jax.devices()) < 8:
+        return {
+            "metric": "sharded_encoders",
+            "error": f"needs 8 devices for the 2x4 mesh, lane has {len(jax.devices())}",
+        }
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "mp"))
+    reset_encoder_stats()
+
+    # ---- parity + compile + warmup contracts: embedding-table encoder ----
+    # (vocab-axis weight sharding is gather-exact and sentence-axis
+    # activation sharding keeps each row's math local, so bit-identity is
+    # the CONTRACT, not a tolerance)
+    vocab, dim, max_len, batch_size = 4096, 32, 64, 8
+    table = jnp.asarray(np.random.RandomState(0).normal(size=(vocab, dim)).astype(np.float32))
+
+    def emb_apply(params, ids, mask):
+        return params["table"][ids] * mask[..., None]
+
+    def make_encoder():
+        return ShardedEncoder(
+            emb_apply,
+            {"table": table},
+            param_specs={"table": P("mp", None)},
+            mesh=mesh,
+            in_specs=P("dp"),
+            out_spec=P("dp"),
+            name="bench_emb",
+        )
+
+    def tokenizer(text, max_length):
+        return _hash_tokenizer(text, max_length, vocab=vocab, reserved=10, offset=5, cls=1, sep=2)
+
+    sent_rng = np.random.RandomState(3)
+    preds = _synth_sentences(sent_rng, 24, 18)
+    target = _synth_sentences(sent_rng, 24, 18)
+    kw = dict(user_tokenizer=tokenizer, max_length=max_len, batch_size=batch_size, idf=True)
+
+    def plain_model(ids, mask):
+        return emb_apply({"table": table}, jnp.asarray(ids), jnp.asarray(mask))
+
+    ref = BERTScore(model=plain_model, length_bucketing=False, **kw)
+    ref.update(preds, target)
+    ref_out = ref.compute()
+
+    encoder = make_encoder()
+    wu.record_manifest()
+    sharded = BERTScore(encoder_sharding=encoder, **kw)
+    sharded.update(preds, target)
+    sharded_out = sharded.compute()
+    manifest = wu.manifest_dict()
+    wu.stop_recording()
+    parity_ok = all(
+        np.array_equal(np.asarray(sharded_out[k]), np.asarray(ref_out[k]))
+        for k in ("precision", "recall", "f1")
+    )
+
+    def encode_compiles() -> int:
+        return engine.cache_summary()["by_kind"].get("encode", {}).get("compiles", 0)
+
+    compiles_first = encode_compiles()
+    repeat = BERTScore(encoder_sharding=encoder, **kw)
+    repeat.update(preds, target)
+    repeat.compute()
+    repeat_compiles = encode_compiles() - compiles_first
+
+    # ---- warmed restart: fresh cache, fresh encoder, manifest-seeded ----
+    engine.clear_cache()
+    wu.reset_warmup_state()
+    encoder2 = make_encoder()
+    report = wu.warmup(manifest, templates=[encoder2])
+    warmed_programs = report["programs_warmed"]
+    warm = BERTScore(encoder_sharding=encoder2, **kw)
+    warm.update(preds, target)
+    warm_out = warm.compute()
+    warm_report = wu.warmup_report()
+    warm_parity = all(
+        np.array_equal(np.asarray(warm_out[k]), np.asarray(ref_out[k]))
+        for k in ("precision", "recall", "f1")
+    )
+    warmed_hits = warm_report["warmed_hits"]
+    warm_stale = warm_report["stale_total"]
+    wu.reset_warmup_state()
+
+    # ---- throughput: bert-like transformer, bucketed vs pad-to-max ------
+    t_vocab, t_dim, t_heads, t_ffn, t_layers, t_len = 8192, 64, 4, 128, 2, 256
+    n_pairs = 32
+
+    class Encoder(nn.Module):
+        @nn.compact
+        def __call__(self, ids, mask):
+            x = nn.Embed(t_vocab, t_dim)(ids)
+            x = x + nn.Embed(t_len, t_dim)(jnp.arange(ids.shape[1])[None, :])
+            x = nn.LayerNorm()(x)
+            attn_mask = mask[:, None, None, :].astype(bool)
+            for _ in range(t_layers):
+                a = nn.SelfAttention(num_heads=t_heads)(x, mask=attn_mask)
+                x = nn.LayerNorm()(x + a)
+                h = nn.Dense(t_ffn)(x)
+                x = nn.LayerNorm()(x + nn.Dense(t_dim)(nn.gelu(h)))
+            return x
+
+    module = Encoder()
+    ones = jnp.ones((1, t_len), jnp.int32)
+    params_shape = jax.eval_shape(module.init, jax.random.PRNGKey(0), ones, ones)
+    leaves, treedef = jax.tree_util.tree_flatten(params_shape)
+    prm_rng = np.random.RandomState(2)
+    params = jax.tree_util.tree_unflatten(
+        treedef,
+        [jnp.asarray(prm_rng.normal(0, 0.02, l.shape).astype(np.float32)) for l in leaves],
+    )
+
+    def bert_apply(params, ids, mask):
+        return module.apply(params, ids, mask)
+
+    def t_tokenizer(text, max_length):
+        return _hash_tokenizer(text, max_length, vocab=t_vocab, reserved=10, offset=5, cls=1, sep=2)
+
+    t_preds = _synth_sentences(sent_rng, n_pairs, 20)  # ~20 words -> 32-token bucket
+    t_target = _synth_sentences(sent_rng, n_pairs, 20)
+    t_kw = dict(user_tokenizer=t_tokenizer, max_length=t_len, batch_size=batch_size)
+
+    jit_plain = jax.jit(bert_apply)
+    plain_forward = lambda ids, m: jit_plain(params, jnp.asarray(np.asarray(ids)), jnp.asarray(np.asarray(m)))  # noqa: E731
+
+    t_encoder = ShardedEncoder(bert_apply, params, in_specs=P("dp"), out_spec=P("dp"), mesh=mesh, name="bench_bert")
+
+    def time_epoch(metric_kwargs):
+        metric = BERTScore(**metric_kwargs)
+        start = time.perf_counter()
+        metric.update(t_preds, t_target)
+        _force(np.asarray(metric.compute()["f1"]))
+        return time.perf_counter() - start
+
+    # compile pass, then a timed steady-state pass (fresh metric, shared
+    # encoder/jit), best-of-2 to shave scheduler noise
+    time_epoch(dict(model=plain_forward, length_bucketing=False, **t_kw))
+    base_s = min(time_epoch(dict(model=plain_forward, length_bucketing=False, **t_kw)) for _ in range(2))
+    time_epoch(dict(encoder_sharding=t_encoder, **t_kw))
+    ours_s = min(time_epoch(dict(encoder_sharding=t_encoder, **t_kw)) for _ in range(2))
+
+    base_rate = 2 * n_pairs / base_s
+    ours_rate = 2 * n_pairs / ours_s
+    stats = encoder_stats()
+
+    return {
+        "metric": "sharded_encoders",
+        "value": round(ours_rate / base_rate, 3),
+        "unit": "x_sentences_per_s_vs_single_device",
+        "mesh": "2x4 dp*mp",
+        "parity_ok": bool(parity_ok),
+        "repeat_compiles": int(repeat_compiles),
+        "recorded_programs": int(
+            sum(len(e["programs"]) for e in manifest["entries"] if e["kind"] == "encode")
+        ),
+        "programs_warmed": int(warmed_programs),
+        "warmed_hits": int(warmed_hits),
+        "warm_stale": int(warm_stale),
+        "warm_parity_ok": bool(warm_parity),
+        "sentences_per_s": round(ours_rate, 2),
+        "baseline_sentences_per_s": round(base_rate, 2),
+        "single_device_reference": 2.89,  # BENCH_SUMMARY bertscore CPU lane
+        "bucketed_dispatches": int(stats["bucketed_dispatches"]),
+        "params_sharded_bytes_ratio": round(
+            stats["encoders"]["bench_emb"]["params_bytes_total"]
+            / max(stats["encoders"]["bench_emb"]["params_bytes_per_device"], 1),
+            3,
+        ),
+        "n": n_pairs,
+    }
+
+
 def bench_fleet_elasticity() -> dict:
     """Elastic fleet acceptance scenario (``ci.sh --fleet-smoke`` gates
     every boolean and bound below):
@@ -2302,6 +2507,7 @@ _CONFIGS = [
     ("bench_serving_plane", 900, False),
     ("bench_cold_start", 1200, False),
     ("bench_sharded_states", 900, False),
+    ("bench_sharded_encoders", 900, False),
     ("bench_fleet_elasticity", 900, False),
 ]
 
@@ -2537,6 +2743,8 @@ _SMOKE_LANES = {
     "--warmup-smoke": ("bench_cold_start", {}),
     # sharded states: 100k-class parity, >=4x per-device bytes, FID NS gate
     "--shard-smoke": ("bench_sharded_states", {"cpu_devices": 8}),
+    # on-mesh encoders: parity, zero repeat compiles, warmed restart, >=2x
+    "--encoder-smoke": ("bench_sharded_encoders", {"cpu_devices": 8}),
     # elastic fleet: kill/join bit-identity, K/n rebalance bound, resharding
     "--fleet-smoke": ("bench_fleet_elasticity", {"cpu_devices": 8, "small": True}),
 }

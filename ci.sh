@@ -234,6 +234,48 @@ if obj["fid_rel_err"] > obj["fid_rtol"]:
 print("sharded-states smoke OK:", line)
 '
 
+echo "=== sharded-encoder smoke (on-mesh encoders: parity, warm restart, throughput) ==="
+# parity / compile / warmup contracts must hold on EVERY attempt (exit 2,
+# never retried); the >=2x bucketed-vs-pad-to-max throughput gate (exit 3)
+# gets one retry — it times two in-process epochs and a throttled CI box
+# can blanket one measurement window
+encoder_smoke() {
+JAX_PLATFORMS=cpu python bench.py --encoder-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "sharded_encoders", obj
+# encoder-program parity: the mp-weight/dp-activation sharded corpus pass
+# is BIT-identical to the single-device pad-to-max pass
+if obj["parity_ok"] is not True:
+    print("sharded encoder pass diverged from single-device:", line); sys.exit(2)
+# zero extra compiles on a repeat epoch + a fresh metric on the same encoder
+if obj["repeat_compiles"] != 0:
+    print("repeat epoch compiled encoder programs:", line); sys.exit(2)
+# warmed restart: the manifest covered every encode program, the restarted
+# worker served from pre-seeded executables, zero warmup_stale, same bits
+if obj["recorded_programs"] <= 0 or obj["programs_warmed"] < obj["recorded_programs"]:
+    print("encode manifest not fully warmed:", line); sys.exit(2)
+if obj["warmed_hits"] <= 0 or obj["warm_stale"] != 0 or obj["warm_parity_ok"] is not True:
+    print("warmed encoder restart not stale-free/bit-identical:", line); sys.exit(2)
+# sharded weights actually resident as shards (4x at mp=4)
+if obj["params_sharded_bytes_ratio"] < 4.0:
+    print("encoder weights not sharded 4x:", line); sys.exit(2)
+# the timing gate (exit 3, one retry): chunked pow2-length-bucketed
+# encoding >= 2x the pad-to-max single-device sentences/s (stored
+# single-device baseline: 2.89 sentences/s on this lane)
+if obj["value"] < 2.0:
+    print("encoder throughput %sx < 2x: %s" % (obj["value"], line)); sys.exit(3)
+print("encoder smoke OK:", line)
+'
+}
+encoder_rc=0; encoder_smoke || encoder_rc=$?
+if [ "$encoder_rc" -eq 3 ]; then
+  echo "encoder throughput gate failed; retrying once"
+  encoder_rc=0; encoder_smoke || encoder_rc=$?
+fi
+[ "$encoder_rc" -eq 0 ] || exit "$encoder_rc"
+
 echo "=== elastic-fleet smoke (kill/join bit-identity, K/n rebalance bound, resharding) ==="
 JAX_PLATFORMS=cpu python bench.py --fleet-smoke | tail -n 1 | python -c '
 import json, sys

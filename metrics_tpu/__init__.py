@@ -46,9 +46,11 @@ from metrics_tpu.audio import (  # noqa: E402,F401
     SignalDistortionRatio,
     SignalNoiseRatio,
 )
+from metrics_tpu import encoders  # noqa: E402,F401
 from metrics_tpu import engine  # noqa: E402,F401
 from metrics_tpu import fleet  # noqa: E402,F401
 from metrics_tpu import obs  # noqa: E402,F401
+from metrics_tpu.encoders import ShardedEncoder  # noqa: E402,F401
 from metrics_tpu import resilience  # noqa: E402,F401
 from metrics_tpu import serving  # noqa: E402,F401
 from metrics_tpu import sharding  # noqa: E402,F401
@@ -234,6 +236,7 @@ __all__ = [
     "ScaleInvariantSignalNoiseRatio",
     "ShortTimeObjectiveIntelligibility",
     "SignalDistortionRatio",
+    "ShardedEncoder",
     "SignalNoiseRatio",
     "SpearmanCorrCoef",
     "Specificity",

@@ -1,0 +1,35 @@
+"""On-mesh metric encoders: the sharded runtime for embedding-scored metrics.
+
+The "model inside the metric" plane — BERTScore's BERT and FID's InceptionV3
+were the last single-device funnels in the codebase; this package partitions
+the encoder itself over the (dp×mp) mesh and streams batches straight into
+sharded metric states:
+
+* :mod:`metrics_tpu.encoders.runtime` — :class:`ShardedEncoder`: per-leaf
+  ``PartitionSpec``-annotated weights placed once onto the mesh, one
+  compiled batch-dp-sharded / activation-mp-constrained forward per input
+  signature through the shared engine cache (entry kind ``encode``, with
+  compile/retrace events and PR-9 warmup-manifest coverage).
+* :mod:`metrics_tpu.encoders.stream` — :func:`encode_stream`: fused
+  encode-then-accumulate chunks with double-buffered host→device staging,
+  pow2 row bucketing for ragged chunks, and ``on_bad_input`` screening
+  upstream of the encoder — the feature corpus never materializes on one
+  host.
+
+Flagships wired onto it: ``FrechetInceptionDistance(encoder_sharding=...)``
+and ``BERTScore(encoder_sharding=...)``. See ``docs/encoders.md``.
+"""
+from metrics_tpu.encoders.runtime import (  # noqa: F401
+    ShardedEncoder,
+    encoder_stats,
+    reset_encoder_stats,
+)
+from metrics_tpu.encoders.stream import StreamResult, encode_stream  # noqa: F401
+
+__all__ = [
+    "ShardedEncoder",
+    "StreamResult",
+    "encode_stream",
+    "encoder_stats",
+    "reset_encoder_stats",
+]

@@ -1,0 +1,568 @@
+"""Sharded encoder runtime: mesh-resident "model inside the metric" programs.
+
+BERTScore and FID are the library's two embedding-scored metrics, and until
+this module their encoders (BERT, InceptionV3) ran as one-device programs:
+weights replicated on a single device, the full feature corpus materialized
+on one host before any sharded accumulation could begin. Following the pjit
+scaling recipe (arXiv:2204.06514) and the TPU serving comparison
+(arXiv:2605.25645), :class:`ShardedEncoder` turns a "callable returning
+``[N, d]`` features" into a mesh-resident program:
+
+* **Weights placed once.** The encoder's parameter pytree is annotated with
+  per-leaf :class:`~jax.sharding.PartitionSpec`\\ s (validated by the same
+  ``sharding/spec.py`` normalization the state plane uses) and
+  ``jax.device_put`` onto the mesh a single time at :meth:`place` — sharded
+  leaves live as 1/mp shards, unannotated leaves replicate.
+* **One compiled forward per input signature.** Dispatch routes through the
+  process-wide engine cache (``engine/cache.py``, entry kind ``encode``), so
+  encoder programs get compile/cache_hit/retrace events, the retrace
+  explainer, and PR-9 AOT warmup manifests exactly like metric transitions —
+  and every encoder object with the same ``(apply_fn, param avals, specs,
+  mesh)`` shares ONE compiled program family.
+* **Batch-dp-sharded in, activation-mp-constrained out.** ``in_specs`` stage
+  each input batch with its ``NamedSharding`` (data axis over ``dp``);
+  ``out_spec`` pins the feature layout with ``with_sharding_constraint`` so
+  features flow straight into feature-sharded metric states (PR 10) without
+  a gather.
+
+The streaming composition — encode-then-accumulate without ever
+materializing the corpus — lives in :mod:`metrics_tpu.encoders.stream`.
+
+Telemetry: :func:`encoder_stats` (surfaced as ``obs.snapshot()["encoders"]``
+and the ``metrics_tpu_encoder_*`` Prometheus gauges) counts placements,
+encode/fused dispatches, streamed chunks/rows, screened rows and
+length-bucketed launches, plus per-encoder resident parameter bytes.
+"""
+import hashlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from metrics_tpu.sharding import spec as _shard_spec
+
+Array = jax.Array
+
+__all__ = ["ShardedEncoder", "encoder_stats", "reset_encoder_stats"]
+
+
+# ---------------------------------------------------------------------------
+# process-wide telemetry (obs.snapshot()["encoders"], metrics_tpu_encoder_*)
+# ---------------------------------------------------------------------------
+_STATS_LOCK = threading.Lock()
+
+
+def _new_stats() -> Dict[str, Any]:
+    return {
+        # ShardedEncoder.place() calls: one host->mesh (or mesh->mesh)
+        # weight layout per call
+        "placements": 0,
+        # plain encode dispatches (encoder(*inputs))
+        "encode_calls": 0,
+        # fused encode+accumulate dispatches (stream.encode_stream chunks)
+        "fused_calls": 0,
+        # streamed chunks and the real (non-pad) rows they carried
+        "stream_chunks": 0,
+        "rows_encoded": 0,
+        # health screening upstream of the encoder (stream driver)
+        "rows_screened": 0,
+        "batches_quarantined": 0,
+        # dispatches whose batch/length axes were pow2-bucketed (row padding
+        # in the stream driver, length trimming in BERTScore's corpus pass)
+        "bucketed_dispatches": 0,
+        # per-encoder weight residency, keyed by encoder name:
+        # {params_bytes_total, params_bytes_per_device, devices, placements}
+        "encoders": {},
+    }
+
+
+_STATS = _new_stats()
+
+
+def encoder_stats() -> Dict[str, Any]:
+    """Process-wide sharded-encoder telemetry (see module docstring)."""
+    with _STATS_LOCK:
+        out = dict(_STATS)
+        out["encoders"] = {k: dict(v) for k, v in _STATS["encoders"].items()}
+    return out
+
+
+def reset_encoder_stats() -> None:
+    with _STATS_LOCK:
+        _STATS.clear()
+        _STATS.update(_new_stats())
+
+
+def _count(key: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        _STATS[key] += n
+
+
+def count_bucketed_dispatch() -> None:
+    """One pow2-bucketed encoder launch (row padding or length trimming) —
+    called by the stream driver and BERTScore's chunked corpus pass."""
+    _count("bucketed_dispatches")
+
+
+def _record_encoder(name: str, total: int, per_device: int, devices: int) -> None:
+    with _STATS_LOCK:
+        rec = _STATS["encoders"].setdefault(
+            name,
+            {"params_bytes_total": 0, "params_bytes_per_device": 0, "devices": 1, "placements": 0},
+        )
+        rec["params_bytes_total"] = int(total)
+        rec["params_bytes_per_device"] = int(per_device)
+        rec["devices"] = int(devices)
+        rec["placements"] += 1
+        _STATS["placements"] += 1
+
+
+# ---------------------------------------------------------------------------
+# spec normalization (reusing the state plane's validation)
+# ---------------------------------------------------------------------------
+def _is_spec_leaf(x: Any) -> bool:
+    return x is None or isinstance(x, (PartitionSpec, str))
+
+
+def _normalize_one_spec(name: str, spec: Any, leaf: Any) -> Optional[PartitionSpec]:
+    if spec is None:
+        return None
+    # same canonicalization + rank validation the add_state(sharding=) plane
+    # applies — one vocabulary for "how a layout annotation is spelled". The
+    # validator only reads rank, so hand it a zero-size stand-in instead of
+    # materializing the (possibly device-resident, possibly GBs) leaf.
+    rank_probe = np.empty((0,) * (np.ndim(leaf) if leaf is not None else 0))
+    return _shard_spec.normalize_state_sharding(name, spec, rank_probe)
+
+
+def _param_paths(params: Any) -> Tuple[List[str], List[Any], Any]:
+    """``(dotted_paths, leaves, treedef)`` of a parameter pytree."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    paths = [jax.tree_util.keystr(path).strip(".") or str(i) for i, (path, _) in enumerate(flat)]
+    return paths, [leaf for _, leaf in flat], treedef
+
+
+def _normalize_param_specs(param_specs: Any, params: Any) -> List[Optional[PartitionSpec]]:
+    """One validated spec (or None) per parameter leaf.
+
+    ``param_specs`` may be ``None`` (all replicated), a callable
+    ``(dotted_path, leaf) -> spec-or-None``, or a pytree matching ``params``
+    whose leaves are ``PartitionSpec`` / mesh-axis name / ``None``.
+    """
+    paths, leaves, treedef = _param_paths(params)
+    if param_specs is None:
+        return [None] * len(leaves)
+    if callable(param_specs) and not _is_spec_leaf(param_specs):
+        return [
+            _normalize_one_spec(path, param_specs(path, leaf), leaf)
+            for path, leaf in zip(paths, leaves)
+        ]
+    spec_leaves = jax.tree_util.tree_leaves(param_specs, is_leaf=_is_spec_leaf)
+    if len(spec_leaves) == 1 and len(leaves) != 1:
+        spec_leaves = spec_leaves * len(leaves)
+    if len(spec_leaves) != len(leaves):
+        raise ValueError(
+            f"param_specs has {len(spec_leaves)} entries for {len(leaves)} parameter"
+            " leaves; pass a matching pytree, a single spec to broadcast, or a"
+            " callable (path, leaf) -> spec."
+        )
+    return [
+        _normalize_one_spec(path, spec, leaf)
+        for path, spec, leaf in zip(paths, spec_leaves, leaves)
+    ]
+
+
+def _normalize_in_specs(in_specs: Any) -> Optional[Tuple[Optional[PartitionSpec], ...]]:
+    """``None`` (no staging) or a tuple of per-input specs. A single spec /
+    axis name broadcasts to every input at dispatch time (stored as a
+    1-tuple sentinel handled in ``_stage_inputs``)."""
+    if in_specs is None:
+        return None
+    if isinstance(in_specs, (PartitionSpec, str)):
+        in_specs = (in_specs,)
+        broadcast = True
+    else:
+        in_specs = tuple(in_specs)
+        broadcast = False
+    out = []
+    for i, entry in enumerate(in_specs):
+        if entry is None:
+            out.append(None)
+            continue
+        if isinstance(entry, str):
+            entry = PartitionSpec(entry)
+        if not isinstance(entry, PartitionSpec):
+            raise ValueError(
+                f"in_specs entry {i} must be a PartitionSpec, mesh-axis name or"
+                f" None, got {entry!r}"
+            )
+        out.append(entry)
+    tup = tuple(out)
+    return ("*", tup[0]) if broadcast else tup
+
+
+def _canon(spec: Optional[PartitionSpec]) -> Tuple:
+    return _shard_spec.canonical_spec(spec)
+
+
+def _divides(shape: Tuple[int, ...], mesh: Any, spec: PartitionSpec) -> bool:
+    """Whether ``device_put`` accepts this (shape, spec) pair — every
+    spec'd dimension must divide by the product of its mesh axes."""
+    for dim, entry in zip(shape, tuple(spec)):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        factor = 1
+        for ax in axes:
+            factor *= int(mesh.shape[ax])
+        if factor and int(dim) % factor:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the runtime
+# ---------------------------------------------------------------------------
+class ShardedEncoder:
+    """A mesh-resident encoder program: ``(params, *inputs) -> features``.
+
+    Args:
+        apply_fn: pure forward ``apply_fn(params, *inputs) -> features`` —
+            e.g. a Flax module's ``apply``, or
+            ``functools.partial(inception._extract, feature='2048', ...)``.
+            Must be trace-compatible (it is compiled through the shared
+            engine cache).
+        params: parameter pytree. Passed as a runtime argument to the
+            compiled program (never baked into the HLO), so encoders sharing
+            ``apply_fn`` + avals + specs share ONE program family.
+        param_specs: per-leaf layout annotations — ``None`` (replicate all),
+            a pytree matching ``params`` with ``PartitionSpec``/axis-name/
+            ``None`` leaves, or a callable ``(dotted_path, leaf) -> spec``.
+            Validated with the same rules as ``add_state(sharding=)``.
+        mesh: bind and place immediately (equivalent to calling
+            :meth:`place` after construction). Without a mesh the encoder
+            runs single-device but still compiles through the shared cache
+            (telemetry + warmup coverage apply either way) — the documented
+            fallback for hosts without a mesh.
+        in_specs: batch staging layout — one ``PartitionSpec`` per input (a
+            single spec broadcasts), e.g. ``PartitionSpec('dp')`` to shard
+            the batch axis over the data axis. Inputs are ``device_put``
+            with their ``NamedSharding`` before dispatch.
+        out_spec: feature layout pinned inside the trace with
+            ``with_sharding_constraint`` (e.g. ``PartitionSpec(None, 'mp')``
+            for mp-sharded features feeding feature-sharded FID states).
+        name: telemetry/obs label; defaults to ``apply_fn``'s name.
+
+    The instance is callable: ``encoder(*inputs)`` dispatches one compiled
+    forward. Identity for the shared cache is
+    ``(apply_fn, param avals, specs, mesh)`` — parameter *values* are
+    runtime data, exactly like metric state in the PR-1 engine.
+    """
+
+    _is_sharded_encoder = True
+
+    def __init__(
+        self,
+        apply_fn: Callable,
+        params: Any,
+        *,
+        param_specs: Any = None,
+        mesh: Optional[Any] = None,
+        in_specs: Any = None,
+        out_spec: Any = None,
+        name: Optional[str] = None,
+    ) -> None:
+        if not callable(apply_fn):
+            raise TypeError(f"apply_fn must be callable, got {type(apply_fn).__name__}")
+        self._apply = apply_fn
+        self.name = name or getattr(apply_fn, "__name__", None) or type(apply_fn).__name__
+        self.params = params
+        self._param_specs = _normalize_param_specs(param_specs, params)
+        self.in_specs = _normalize_in_specs(in_specs)
+        if isinstance(out_spec, str):
+            out_spec = PartitionSpec(out_spec)
+        if out_spec is not None and not isinstance(out_spec, PartitionSpec):
+            raise ValueError(
+                f"out_spec must be a PartitionSpec, mesh-axis name or None, got {out_spec!r}"
+            )
+        self.out_spec = out_spec
+        self.mesh: Optional[Any] = None
+        if mesh is not None:
+            self.place(mesh)
+
+    # -- construction helpers ------------------------------------------
+    @classmethod
+    def from_callable(
+        cls,
+        fn: Callable,
+        *,
+        mesh: Optional[Any] = None,
+        in_specs: Any = None,
+        out_spec: Any = None,
+        name: Optional[str] = None,
+    ) -> "ShardedEncoder":
+        """Wrap a plain ``(*inputs) -> features`` callable (weights hidden in
+        the closure, so no parameter sharding — input staging, activation
+        constraints, shared-cache compilation and telemetry still apply)."""
+
+        def _apply(params, *inputs):
+            del params
+            return fn(*inputs)
+
+        _apply.__name__ = name or getattr(fn, "__name__", None) or type(fn).__name__
+        return cls(
+            _apply, (), mesh=mesh, in_specs=in_specs, out_spec=out_spec, name=_apply.__name__
+        )
+
+    # -- identity -------------------------------------------------------
+    def _param_signature(self) -> Tuple:
+        paths, leaves, _ = _param_paths(self.params)
+        return tuple(
+            (path, tuple(int(s) for s in np.shape(leaf)), str(getattr(leaf, "dtype", np.asarray(leaf).dtype)))
+            for path, leaf in zip(paths, leaves)
+        )
+
+    def _program_key(self) -> Tuple[Tuple, Tuple]:
+        """``(key, pins)`` for the shared cache: the apply callable (id-keyed
+        and pinned), parameter avals, canonical specs, and the bound mesh.
+        Parameter values are runtime arguments, so they do NOT key — two
+        encoders differing only in weights share one program."""
+        cached = self.__dict__.get("_engine_key")
+        if cached is not None:
+            return cached, self.__dict__.get("_engine_key_pins", ())
+        key = (
+            id(self._apply),
+            self._param_signature(),
+            tuple(_canon(s) for s in self._param_specs),
+            () if self.in_specs is None else tuple(
+                e if isinstance(e, str) else _canon(e) for e in self.in_specs
+            ),
+            _canon(self.out_spec),
+            id(self.mesh) if self.mesh is not None else None,
+        )
+        pins: Tuple = (self._apply,) + ((self.mesh,) if self.mesh is not None else ())
+        self._engine_key = key
+        self._engine_key_pins = pins
+        return key, pins
+
+    def stable_digest(self) -> str:
+        """Process-stable identity for warmup manifests: apply-fn qualname,
+        parameter avals and the canonical specs — the serializable twin of
+        :meth:`_program_key` (object identities degrade to names, exactly
+        like ``engine/warmup.stable_digest`` for metrics)."""
+        apply_name = getattr(self._apply, "__qualname__", None) or getattr(
+            self._apply, "__name__", type(self._apply).__name__
+        )
+        payload = (
+            "encode",
+            apply_name,
+            self._param_signature(),
+            tuple(_canon(s) for s in self._param_specs),
+            () if self.in_specs is None else tuple(
+                e if isinstance(e, str) else _canon(e) for e in self.in_specs
+            ),
+            _canon(self.out_spec),
+        )
+        return hashlib.sha1(repr(payload).encode()).hexdigest()
+
+    # -- placement ------------------------------------------------------
+    def place(self, mesh: Any) -> "ShardedEncoder":
+        """Lay the weights out over ``mesh`` once: sharded per annotation,
+        replicated otherwise (``jax.device_put`` with a ``NamedSharding``
+        per leaf). Re-placing onto a different mesh re-lays the whole
+        plane (and invalidates the cached program key — a new mesh is a new
+        program family)."""
+        paths, leaves, treedef = _param_paths(self.params)
+        placed = []
+        total = 0
+        per_device = 0
+        for leaf, spec in zip(leaves, self._param_specs):
+            ns = _shard_spec.named_sharding(mesh, spec if spec is not None else PartitionSpec())
+            value = jax.device_put(leaf, ns)
+            placed.append(value)
+            nbytes = int(getattr(value, "nbytes", 0))
+            total += nbytes
+            try:
+                shard_bytes = max((s.data.nbytes for s in value.addressable_shards), default=nbytes)
+            except Exception:  # noqa: BLE001 — telemetry only
+                shard_bytes = nbytes
+            per_device += int(shard_bytes)
+        self.params = jax.tree_util.tree_unflatten(treedef, placed)
+        self.mesh = mesh
+        # the program key embeds id(mesh): drop the cached key so a re-place
+        # onto a different mesh gets its own entry
+        self.__dict__.pop("_engine_key", None)
+        self.__dict__.pop("_engine_key_pins", None)
+        _record_encoder(self.name, total, per_device, len(getattr(mesh, "devices", np.zeros(1)).flat))
+        return self
+
+    def params_nbytes(self) -> int:
+        return int(
+            sum(int(getattr(x, "nbytes", 0)) for x in jax.tree_util.tree_leaves(self.params))
+        )
+
+    # -- dispatch -------------------------------------------------------
+    def batch_multiple(self) -> int:
+        """The row multiple a staged batch must divide into: the product of
+        the mesh-axis sizes ``in_specs`` shards the leading (batch) axis
+        over — 1 for an unsharded/unbound encoder. Drivers round their pow2
+        row buckets up to this so ``device_put`` staging always divides."""
+        if self.mesh is None or self.in_specs is None:
+            return 1
+        specs = self.in_specs[1:] if self.in_specs and self.in_specs[0] == "*" else self.in_specs
+        mult = 1
+        for spec in specs:
+            if spec is None or len(spec) == 0 or spec[0] is None:
+                continue
+            axes = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+            factor = 1
+            for ax in axes:
+                factor *= int(self.mesh.shape[ax])
+            mult = max(mult, factor)
+        return mult
+
+    def _stage_inputs(self, inputs: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        if self.mesh is None or self.in_specs is None:
+            return inputs
+        specs = self.in_specs
+        if specs and specs[0] == "*":
+            specs = (specs[1],) * len(inputs)
+        staged = []
+        for i, x in enumerate(inputs):
+            spec = specs[i] if i < len(specs) else None
+            if spec is None:
+                staged.append(x)
+                continue
+            ns = _shard_spec.named_sharding(self.mesh, spec)
+            if getattr(x, "sharding", None) != ns:
+                if not _divides(np.shape(x), self.mesh, spec):
+                    # a shape the spec cannot divide (e.g. a lone ragged row
+                    # below the dp world): hand it to jit unstaged rather
+                    # than crash — GSPMD treats it as replicated input
+                    staged.append(x)
+                    continue
+                x = jax.device_put(x, ns)
+            staged.append(x)
+        return tuple(staged)
+
+    def _traced_apply(self, params: Any, inputs: Tuple[Any, ...]) -> Any:
+        """The trace-side body the engine's ``encode`` entries compile: the
+        user forward plus the activation layout constraint."""
+        out = self._apply(params, *inputs)
+        if self.out_spec is not None and self.mesh is not None:
+            out = jax.lax.with_sharding_constraint(
+                out, _shard_spec.named_sharding(self.mesh, self.out_spec)
+            )
+        return out
+
+    def __call__(self, *inputs: Any) -> Any:
+        """One compiled encoder forward through the shared engine cache."""
+        from metrics_tpu.engine import cache as _cache
+
+        entry = _cache.encoder_entry(self)
+        stats = _cache.instance_stats(self)
+        _count("encode_calls")
+        return entry.invoke("encode", self, stats, self.params, *self._stage_inputs(inputs))
+
+    def encode(self, *inputs: Any) -> Any:
+        return self(*inputs)
+
+    def encode_into(self, consumer: Callable, carry: Any, inputs: Tuple[Any, ...], valid: Any) -> Any:
+        """One fused encode+accumulate step: ``consumer(carry, features,
+        valid) -> carry`` folded into the SAME compiled program as the
+        forward, so per-chunk features never exist outside the trace. The
+        entry is keyed by ``(encoder identity, consumer identity)``; pass a
+        stable consumer object (cache it on the owning metric) or every call
+        compiles a fresh program."""
+        from metrics_tpu.engine import cache as _cache
+
+        entry = _cache.encoder_entry(self, consumer=consumer)
+        stats = _cache.instance_stats(self)
+        _count("fused_calls")
+        return entry.invoke(
+            "encode_acc", self, stats, self.params, carry, valid, *self._stage_inputs(inputs)
+        )
+
+    def compile_stats(self) -> Dict[str, int]:
+        """This encoder's share of the engine compile telemetry (same
+        counters as ``Metric.compile_stats()``)."""
+        from metrics_tpu.engine import cache as _cache
+
+        return dict(_cache.instance_stats(self))
+
+    # -- warmup integration --------------------------------------------
+    def _warm_avals(self, variant: str, lower_args: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        """Attach this encoder's shardings to manifest-decoded avals so AOT
+        warm compiles produce executables that accept the mesh-sharded
+        arrays a live dispatch passes (called by ``engine/warmup``). The
+        dispatch key ignores shardings, so the seeded store key still
+        matches."""
+        if self.mesh is None:
+            return lower_args
+        paths, leaves, treedef = _param_paths(lower_args[0])
+        del paths
+        placed = [
+            jax.ShapeDtypeStruct(
+                leaf.shape,
+                leaf.dtype,
+                sharding=_shard_spec.named_sharding(
+                    self.mesh, spec if spec is not None else PartitionSpec()
+                ),
+            )
+            if hasattr(leaf, "shape")
+            else leaf
+            for leaf, spec in zip(leaves, self._param_specs)
+        ]
+        params = jax.tree_util.tree_unflatten(treedef, placed)
+        rest = list(lower_args[1:])
+        # inputs occupy the trailing positions: everything after params for
+        # the plain "encode" variant; after (carry, valid) for "encode_acc"
+        # (which never rides a manifest, but stay correct regardless)
+        if self.in_specs is not None and rest:
+            n_inputs = len(rest) if variant == "encode" else max(0, len(rest) - 2)
+            specs = self.in_specs
+            if specs and specs[0] == "*":
+                specs = (specs[1],) * n_inputs
+            offset = len(rest) - n_inputs
+            for i in range(n_inputs):
+                spec = specs[i] if i < len(specs) else None
+                leaf = rest[offset + i]
+                if spec is not None and hasattr(leaf, "shape"):
+                    rest[offset + i] = jax.ShapeDtypeStruct(
+                        leaf.shape,
+                        leaf.dtype,
+                        sharding=_shard_spec.named_sharding(self.mesh, spec),
+                    )
+        return (params,) + tuple(rest)
+
+    # -- lifecycle ------------------------------------------------------
+    def __deepcopy__(self, memo: Dict) -> "ShardedEncoder":
+        # the runtime is an immutable inference program; metric clones must
+        # SHARE it (a deep copy would fork the id-keyed program identity and
+        # recompile for every clone)
+        return self
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # pickling (warmup-manifest templates, checkpointed metrics): ship
+        # host arrays, drop the process-local mesh binding and cached keys —
+        # the restored encoder re-places via place(mesh)
+        state = dict(self.__dict__)
+        state["params"] = jax.tree_util.tree_map(np.asarray, self.params)
+        state["mesh"] = None
+        state.pop("_engine_key", None)
+        state.pop("_engine_key_pins", None)
+        state.pop("_compile_stats", None)
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+
+    def __repr__(self) -> str:
+        sharded = sum(1 for s in self._param_specs if s is not None)
+        return (
+            f"ShardedEncoder(name={self.name!r}, params={len(self._param_specs)} leaves"
+            f" ({sharded} sharded), mesh={'bound' if self.mesh is not None else 'none'},"
+            f" out_spec={self.out_spec})"
+        )

@@ -1,0 +1,289 @@
+"""Encode-then-accumulate streaming driver.
+
+The funnel this removes: both embedding-scored flagships used to materialize
+their full feature corpus on one host before accumulation could begin (FID
+buffered ``[N, d]`` features or looped eager moment updates; BERTScore held
+the whole tokenized corpus for one pad-to-max launch). :func:`encode_stream`
+composes the PR-5 prefetching idea with the encoder runtime:
+
+* **One fused program per chunk signature.** Each chunk dispatches through
+  the encoder's ``encode_acc`` entry (``engine/cache.py``): forward +
+  ``consumer(carry, features, valid) -> carry`` in the SAME compiled
+  program, so per-chunk features flow straight into (optionally PR-10
+  feature/class-sharded) accumulation states and never exist outside the
+  trace — let alone on the host.
+* **Double-buffered host→device.** Dispatch is async: chunk ``i`` executes
+  on device while the host screens, pads and ``device_put``\\ s chunk
+  ``i+1`` (the PR-5 prefetch discipline — async enqueue gives the overlap
+  with no explicit lookahead).
+* **Ragged chunks don't retrace.** The batch axis is padded to the next
+  power of two and a ``valid`` row mask (a traced argument) excludes pad
+  rows from the accumulation — exact for any consumer, unlike the zero-row
+  *correction* (which needs row-additivity), and capping programs at
+  O(log max_batch).
+* **Screening upstream of the encoder.** A metric's ``on_bad_input`` policy
+  is applied to the RAW inputs before the encoder runs: a quarantined batch
+  never pays the forward, masked rows are zeroed and excluded via the same
+  ``valid`` mask. Counts land in the owning metric's ``health_report()``
+  exactly like per-step screening.
+
+Every chunk emits an ``encode`` bus event (rows, bucket, screened) and
+counts in :func:`~metrics_tpu.encoders.runtime.encoder_stats`.
+"""
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from metrics_tpu.encoders import runtime as _runtime
+from metrics_tpu.engine import bucketing as _bucketing
+from metrics_tpu.obs import bus as _bus
+
+__all__ = ["StreamResult", "encode_stream"]
+
+
+class StreamResult:
+    """What one :func:`encode_stream` did: ``chunks`` dispatched, ``rows``
+    accumulated (pad rows excluded), ``rows_screened`` masked out by the
+    health policy, ``batches_quarantined`` dropped whole."""
+
+    __slots__ = ("chunks", "rows", "rows_screened", "batches_quarantined")
+
+    def __init__(self) -> None:
+        self.chunks = 0
+        self.rows = 0
+        self.rows_screened = 0
+        self.batches_quarantined = 0
+
+    def __repr__(self) -> str:
+        return (
+            f"StreamResult(chunks={self.chunks}, rows={self.rows},"
+            f" rows_screened={self.rows_screened},"
+            f" batches_quarantined={self.batches_quarantined})"
+        )
+
+
+def _as_batches(batches: Any) -> Iterable[Tuple[Any, ...]]:
+    for item in batches:
+        if isinstance(item, (tuple, list)):
+            yield tuple(item)
+        else:
+            yield (item,)
+
+
+def _contamination(inputs: Tuple[Any, ...], nan_only: bool):
+    """Host-side per-row contamination over the float inputs (this is the
+    pre-encoder screen, so it must not touch the device). Returns
+    ``(bad_rows_or_None, nan_count, inf_count)``."""
+    batched = _bucketing.batched_leaf_indices(list(inputs))
+    if not batched:
+        return None, 0, 0
+    n = int(np.shape(inputs[batched[0]])[0])
+    bad = np.zeros((n,), bool)
+    nan_i = inf_i = 0
+    saw_float = False
+    for i in batched:
+        arr = np.asarray(inputs[i])
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        saw_float = True
+        flat = arr.reshape(n, -1)
+        isnan = np.isnan(flat)
+        nan_i += int(isnan.sum())
+        if nan_only:
+            bad |= isnan.any(axis=1)
+        else:
+            isinf = np.isinf(flat)
+            inf_i += int(isinf.sum())
+            bad |= (isnan | isinf).any(axis=1)
+    return (bad if saw_float else None), nan_i, inf_i
+
+
+def _bump_health(screen: Any, nan_i: int, inf_i: int, masked: int = 0, quarantined: int = 0) -> None:
+    """Credit the pre-encoder screen to the owning metric's device health
+    counters (the SAME ``HEALTH_STATE`` slots the per-step screen bumps, so
+    ``health_report()`` covers streamed epochs with no new surface)."""
+    from metrics_tpu.resilience import health as _health
+
+    if screen is None or not _health.health_enabled(screen):
+        return
+    import jax.numpy as jnp
+
+    counts = getattr(screen, _health.HEALTH_STATE)
+    delta = np.zeros(_health.N_SLOTS, dtype=np.asarray(counts).dtype)
+    delta[_health.SLOT_NAN], delta[_health.SLOT_INF] = nan_i, inf_i
+    delta[_health.SLOT_MASKED], delta[_health.SLOT_QUARANTINED] = masked, quarantined
+    setattr(screen, _health.HEALTH_STATE, counts + jnp.asarray(delta))
+
+
+def _screen_batch(
+    inputs: Tuple[Any, ...], policy: str, nan_only: bool, screen: Any, result: StreamResult
+) -> Optional[Tuple[Tuple[Any, ...], Optional[np.ndarray]]]:
+    """Apply one ``on_bad_input`` policy upstream of the encoder. Returns
+    ``(inputs, keep_mask)`` — ``None`` means the whole batch is quarantined
+    (the encoder is never called)."""
+    stats = getattr(screen, "_health_stats", None)
+    if policy == "propagate":
+        return inputs, None
+    if stats is not None:
+        stats["batches_screened"] = stats.get("batches_screened", 0) + 1
+    bad, nan_i, inf_i = _contamination(inputs, nan_only)
+    if bad is None or not bad.any():
+        _bump_health(screen, nan_i, inf_i)
+        return inputs, None
+    n_bad = int(bad.sum())
+    if _bus.enabled():
+        _bus.emit(
+            "quarantine",
+            source=type(screen).__name__ if screen is not None else "encode_stream",
+            policy=policy,
+            nan_count=nan_i,
+            inf_count=inf_i,
+            path="pre_encode",
+        )
+    if policy == "raise":
+        from metrics_tpu.resilience.health import NumericalHealthError
+
+        _bump_health(screen, nan_i, inf_i, quarantined=1)
+        raise NumericalHealthError(
+            f"encode_stream: batch carries {n_bad} contaminated row(s)"
+            f" ({nan_i} nan / {inf_i} inf elements) and the owning metric's"
+            " on_bad_input policy is 'raise'. Screened BEFORE the encoder"
+            " forward — the contamination is in the raw inputs."
+        )
+    if policy == "skip":
+        result.batches_quarantined += 1
+        result.rows_screened += n_bad
+        with _runtime._STATS_LOCK:
+            _runtime._STATS["batches_quarantined"] += 1
+            _runtime._STATS["rows_screened"] += n_bad
+        _bump_health(screen, nan_i, inf_i, quarantined=1)
+        return None
+    # mask: zero the contaminated rows so the encoder sees finite inputs,
+    # and hand the keep-mask down so `valid` excludes them exactly
+    keep = ~bad
+    masked: List[Any] = []
+    batched = set(_bucketing.batched_leaf_indices(list(inputs)))
+    for i, x in enumerate(inputs):
+        arr = np.asarray(x)
+        if i in batched and np.issubdtype(arr.dtype, np.floating):
+            arr = arr.copy()
+            arr[bad] = 0
+        masked.append(arr)
+    result.rows_screened += n_bad
+    with _runtime._STATS_LOCK:
+        _runtime._STATS["rows_screened"] += n_bad
+    _bump_health(screen, nan_i, inf_i, masked=n_bad)
+    return tuple(masked), keep
+
+
+def _prepare_chunk(
+    encoder: Any,
+    inputs: Tuple[Any, ...],
+    keep: Optional[np.ndarray],
+    bucket_rows: bool,
+) -> Tuple[Tuple[Any, ...], Any, int, int]:
+    """Pad the batch axis to a pow2 bucket and build the ``valid`` mask.
+    Returns ``(staged_inputs, valid, n_real_rows, n_raw_rows, bucket)``."""
+    batched = _bucketing.batched_leaf_indices(list(inputs))
+    if not batched:
+        raise ValueError(
+            "encode_stream needs array inputs sharing a leading batch axis;"
+            f" got shapes {[np.shape(x) for x in inputs]}"
+        )
+    n = int(np.shape(inputs[batched[0]])[0])
+    bucket = _bucketing.next_pow2(n) if bucket_rows else n
+    # a dp-sharded batch axis must divide by the shard count: round the
+    # bucket up so the ragged tail still stages (pad rows are masked out)
+    mult = encoder.batch_multiple()
+    if bucket % mult:
+        bucket = ((bucket + mult - 1) // mult) * mult
+    pad = bucket - n
+    staged = list(inputs)
+    if pad:
+        batched_set = set(batched)
+        staged = [
+            np.pad(np.asarray(x), [(0, pad)] + [(0, 0)] * (np.asarray(x).ndim - 1))
+            if i in batched_set
+            else x
+            for i, x in enumerate(staged)
+        ]
+    valid = np.zeros((bucket,), np.float32)
+    if keep is None:
+        valid[:n] = 1.0
+    else:
+        valid[:n] = keep.astype(np.float32)
+    n_real = int(valid.sum())
+    return tuple(staged), valid, n_real, n, bucket
+
+
+def encode_stream(
+    encoder: Any,
+    batches: Any,
+    consumer: Callable,
+    carry: Any,
+    *,
+    screen: Any = None,
+    bucket_rows: bool = True,
+    source: Optional[str] = None,
+) -> Tuple[Any, StreamResult]:
+    """Stream host batches through fused encode+accumulate programs.
+
+    Args:
+        encoder: a :class:`~metrics_tpu.encoders.runtime.ShardedEncoder`.
+        batches: host iterable of per-chunk input tuples (a bare array per
+            chunk is treated as a 1-tuple) — e.g. tokenized ``(ids, mask)``
+            pairs or image batches.
+        consumer: traced ``consumer(carry, features, valid) -> carry`` where
+            ``valid`` is a float ``[bucket]`` row mask (0 for pad rows and
+            health-masked rows). MUST be a stable object across calls — the
+            compiled program is keyed by its identity.
+        carry: initial accumulation pytree (e.g. a metric's streaming
+            states, optionally already mesh-placed/sharded).
+        screen: the metric whose ``on_bad_input``/``health_screen`` policy
+            screens raw inputs upstream of the encoder (None: no screening).
+        bucket_rows: pad the batch axis to pow2 buckets (default) so ragged
+            final chunks reuse the full-chunk program.
+
+    Returns ``(final_carry, StreamResult)``. Each chunk is enqueued as soon
+    as it is staged (jax dispatch is async), so the device executes chunk
+    ``i`` while the host prepares chunk ``i+1``.
+    """
+    policy = getattr(screen, "on_bad_input", "propagate") if screen is not None else "propagate"
+    nan_only = getattr(screen, "health_screen", "nonfinite") == "nan"
+    label = source or (type(screen).__name__ if screen is not None else encoder.name)
+    result = StreamResult()
+
+    def _dispatch(prep: Tuple[Tuple[Any, ...], Any, int, int, int], carry: Any) -> Any:
+        staged, valid, n_real, n_rows, bucket = prep
+        out = encoder.encode_into(consumer, carry, staged, valid)
+        result.chunks += 1
+        result.rows += n_real
+        with _runtime._STATS_LOCK:
+            _runtime._STATS["stream_chunks"] += 1
+            _runtime._STATS["rows_encoded"] += n_real
+            # bucketed = the batch axis was actually padded (bucket vs the
+            # RAW row count — a health-masked row is screening, not bucketing)
+            if bucket != n_rows:
+                _runtime._STATS["bucketed_dispatches"] += 1
+        if _bus.enabled():
+            _bus.emit(
+                "encode",
+                source=label,
+                encoder=encoder.name,
+                rows=n_real,
+                bucket=bucket,
+                fused=True,
+            )
+        return out
+
+    # jax dispatch is async: each chunk is enqueued immediately and the
+    # device executes it while the next loop iteration screens, pads and
+    # stages on the host — the overlap needs no explicit lookahead
+    for raw in _as_batches(batches):
+        screened = _screen_batch(raw, policy, nan_only, screen, result)
+        if screened is None:
+            continue
+        inputs, keep = screened
+        carry = _dispatch(_prepare_chunk(encoder, inputs, keep, bucket_rows), carry)
+    return carry, result

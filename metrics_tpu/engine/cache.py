@@ -370,6 +370,12 @@ class SharedEntry:
                     getattr(cell, "health_screen", "nonfinite"),
                     getattr(cell, "jit_bucket", None),
                 )
+            elif self.kind == "encode":
+                # the cell is a ShardedEncoder: screening happens UPSTREAM
+                # of the encoder (encoders/stream.py), never inside its
+                # compiled program, so the signature carries no policy flags
+                obs_source = getattr(cell, "name", None) or type(cell).__name__
+                obs_screening = ()
             else:
                 obs_source = self.kind
                 obs_screening = tuple(
@@ -836,6 +842,67 @@ def bank_entry(template: Any) -> SharedEntry:
     metric config shares one compiled family per input signature."""
     key, pins = program_identity(template)
     return _get_or_create(("bank_update", key), lambda: _make_bank_entry(key, pins))
+
+
+# ---------------------------------------------------------------------------
+# sharded encoder programs (metrics_tpu.encoders)
+# ---------------------------------------------------------------------------
+def _make_encoder_entry(cache_key: Any, pins: Tuple, consumer: Optional[Callable]) -> SharedEntry:
+    """One compiled encoder-forward family (entry kind ``encode``).
+
+    The cell is a :class:`~metrics_tpu.encoders.runtime.ShardedEncoder`; the
+    traced body is its ``_traced_apply`` (user forward + activation layout
+    constraint). Parameters are a runtime argument — never baked into the
+    HLO — so every encoder object with the same (apply, param avals, specs,
+    mesh) identity shares this entry, exactly like metric state in the
+    per-metric entries. Variants:
+
+    * ``encode`` — ``(params, *inputs) -> features``: the plain forward.
+    * ``encode_acc`` (only when the entry was created with a ``consumer``) —
+      ``(params, carry, valid, *inputs) -> carry``: forward + accumulation
+      fused into ONE program, the streaming driver's chunk step. ``valid``
+      is a traced float row mask (pad/screened rows excluded exactly), so
+      ragged pow2-bucketed chunks share one program per bucket.
+
+    Donation stays off: params are long-lived weights and the carry's
+    ownership belongs to the streaming driver, not XLA.
+    """
+    entry = SharedEntry(cache_key, "encode", pins)
+    entry.donate = False
+
+    def _encode(params, *inputs):
+        entry.mark_trace("encode")
+        return entry.cell._traced_apply(params, inputs)
+
+    def _encode_acc(params, carry, valid, *inputs):
+        entry.mark_trace("encode_acc")
+        feats = entry.cell._traced_apply(params, inputs)
+        return consumer(carry, feats, valid)
+
+    def build(donate: bool) -> None:
+        del donate
+        fns = {"encode": jax.jit(_encode)}
+        if consumer is not None:
+            fns["encode_acc"] = jax.jit(_encode_acc)
+        entry._fns = fns
+
+    entry._build = build
+    build(False)
+    return entry
+
+
+def encoder_entry(encoder: Any, consumer: Optional[Callable] = None) -> SharedEntry:
+    """Shared entry for one encoder program family, keyed by the encoder's
+    program identity (apply callable, param avals, canonical specs, mesh)
+    plus — for the fused streaming step — the consumer's identity. Parameter
+    *values* are runtime data, so restarted/cloned encoders with identical
+    identity share one compiled family per input signature."""
+    key, pins = encoder._program_key()
+    cache_key = ("encode", key, None if consumer is None else id(consumer))
+    all_pins = tuple(pins) + ((consumer,) if consumer is not None else ())
+    return _get_or_create(
+        cache_key, lambda: _make_encoder_entry(cache_key, all_pins, consumer)
+    )
 
 
 def axis_world(mesh: Any, axis_name: Any) -> int:

@@ -90,6 +90,11 @@ MANIFEST_VERSION = 1
 
 #: Entry kinds a manifest can cover. Driver entries are recorded only for
 #: local (no mesh / no axis_name) epochs: a Mesh handle cannot ride JSON.
+#: ``encode`` entries (sharded encoder forwards, ``metrics_tpu.encoders``)
+#: record their param/input AVALS — weights never enter the manifest — and
+#: warm from a live encoder template (``warmup(templates=[encoder])``),
+#: which re-attaches its mesh shardings to the decoded avals; small-weight
+#: unsharded encoders can also warm from the embedded pickle recipe.
 WARMABLE_KINDS = (
     "metric_update",
     "bank_update",
@@ -97,7 +102,12 @@ WARMABLE_KINDS = (
     "fused_forward",
     "fused_compute",
     "driver",
+    "encode",
 )
+
+#: Embedded-template pickle budget for encoder entries: above this the
+#: manifest records avals only and warmup needs an explicit live template.
+_ENCODER_TEMPLATE_MAX_BYTES = 16 << 20
 
 _LOCK = threading.RLock()
 
@@ -193,6 +203,8 @@ def _entry_digest(kind: str, cell: Any, meta: Dict[str, Any]) -> str:
     driver programs."""
     if kind in ("metric_update", "bank_update"):
         return stable_digest(cell)
+    if kind == "encode":
+        return cell.stable_digest()
     members = list(cell)
     payload = (
         kind,
@@ -359,10 +371,15 @@ _N_DYNAMIC = {
     ("driver", "scan_pad"): 3,
     ("driver", "scan_cmp"): 2,
     ("driver", "scan_pad_cmp"): 3,
+    # encoder forwards are variadic over their inputs and carry no static
+    # arguments at all: every position is dynamic (-1 sentinel)
+    ("encode", "encode"): -1,
 }
 
 
 def _call_warm(compiled: Any, n_dynamic: int, *fn_args: Any) -> Any:
+    if n_dynamic < 0:
+        return compiled(*fn_args)
     return compiled(*fn_args[:n_dynamic])
 
 
@@ -413,6 +430,13 @@ def record_dispatch(entry: Any, variant: str, cell: Any, fn_args: Tuple[Any, ...
     if variant.startswith("shard_"):
         with _LOCK:
             _count(_REC["unrecordable"], "sharded_variant")
+        return
+    if variant == "encode_acc":
+        # the fused encode+accumulate step is keyed by a live consumer
+        # callable a fresh process cannot reproduce; the plain forward of
+        # the same encoder still records and warms
+        with _LOCK:
+            _count(_REC["unrecordable"], "encoder_consumer_bound")
         return
     try:
         prog_key = (variant, dispatch_key(fn_args))
@@ -478,6 +502,8 @@ def _entry_meta(entry: Any) -> Dict[str, Any]:
 def _entry_source(kind: str, cell: Any) -> str:
     if kind in ("metric_update", "bank_update"):
         return type(cell).__name__
+    if kind == "encode":
+        return getattr(cell, "name", None) or type(cell).__name__
     return "+".join(type(m).__name__ for m in cell)
 
 
@@ -503,9 +529,34 @@ def _template_payload(kind: str, cell: Any) -> Any:
     try:
         if kind in ("metric_update", "bank_update"):
             return _clone_reset(cell)
+        if kind == "encode":
+            # the embedded recipe is only useful when the restored encoder
+            # lands on the SAME cache entry the live one dispatches through,
+            # and encoder program identity id-keys the apply callable and
+            # the mesh. So: no recipe for mesh-bound encoders (__getstate__
+            # drops the mesh — the restored key could never match), and none
+            # when the apply fn would unpickle to a fresh object (partial/
+            # lambda/closure). Those warm from an explicit live template
+            # (warmup(templates=[encoder]) — matched by digest). Weights
+            # ride the pickle, so giant encoders are also excluded.
+            if cell.mesh is not None:
+                return None
+            fn = cell._apply
+            module = _sys_modules_get(getattr(fn, "__module__", None))
+            if module is None or getattr(module, getattr(fn, "__qualname__", ""), None) is not fn:
+                return None
+            if cell.params_nbytes() <= _ENCODER_TEMPLATE_MAX_BYTES:
+                return cell
+            return None
         return [_clone_reset(m) for m in cell]
     except Exception:  # noqa: BLE001 — no recipe, counted at save
         return None
+
+
+def _sys_modules_get(name: Optional[str]) -> Any:
+    import sys
+
+    return sys.modules.get(name) if name else None
 
 
 def _pickle_template(obj: Any) -> Optional[str]:
@@ -620,15 +671,17 @@ def load_manifest(path: str) -> Dict[str, Any]:
 # warmup
 # ---------------------------------------------------------------------------
 def _template_candidates(templates: Optional[Iterable[Any]]) -> List[Any]:
-    """Live metric templates from explicitly-passed objects. Accepts
-    ``Metric`` instances and ``MetricBank``s (whose template covers both the
-    per-instance and the banked program family); fused/driver entries
+    """Live templates from explicitly-passed objects. Accepts ``Metric``
+    instances, ``MetricBank``s (whose template covers both the per-instance
+    and the banked program family), and ``ShardedEncoder``s (matched to
+    ``encode`` entries by digest — the only way a MESH-bound encoder warms,
+    since its shardings cannot ride the manifest); fused/driver entries
     reconstruct from the manifest's embedded recipe."""
     out: List[Any] = []
     for obj in templates or ():
         tpl = getattr(obj, "_template", None)  # MetricBank duck-type
         metric = tpl if tpl is not None else obj
-        if hasattr(metric, "_defaults"):
+        if hasattr(metric, "_defaults") or getattr(metric, "_is_sharded_encoder", False):
             out.append(metric)
     return out
 
@@ -670,8 +723,14 @@ def _match_template(rec: Dict[str, Any], candidates: List[Any]) -> Optional[Any]
     which the recorder had already run before digesting. Replay that probe
     abstractly on the entry's recorded avals and compare again.
     """
+    if rec.get("kind") == "encode":
+        for obj in candidates:
+            if getattr(obj, "_is_sharded_encoder", False) and obj.stable_digest() == rec.get("digest"):
+                return obj
+        return None
     if rec.get("kind") not in ("metric_update", "bank_update"):
         return None
+    candidates = [m for m in candidates if not getattr(m, "_is_sharded_encoder", False)]
     for metric in candidates:
         if stable_digest(metric) == rec.get("digest"):
             return metric
@@ -708,6 +767,8 @@ def _entry_for(kind: str, rec: Dict[str, Any], payload: Any) -> Tuple[Any, Any]:
         return entry, payload
     if kind == "bank_update":
         return _cache.bank_entry(payload), payload
+    if kind == "encode":
+        return _cache.encoder_entry(payload), payload
     keys = tuple(rec["meta"].get("keys", ()))
     members = list(payload)
     if len(keys) != len(members):
@@ -741,10 +802,14 @@ def _screening_of(entry: Any, cell: Any) -> Tuple:
             getattr(cell, "health_screen", "nonfinite"),
             getattr(cell, "jit_bucket", None),
         )
+    if entry.kind == "encode":
+        return ()
     return tuple((type(m).__name__, getattr(m, "on_bad_input", "propagate")) for m in cell)
 
 
 def _snapshot_cell(kind: str, cell: Any) -> List[Tuple[Any, Dict[str, Any]]]:
+    if kind == "encode":
+        return []  # an encoder is stateless: nothing to save/restore around tracing
     metrics = [cell] if kind in ("metric_update", "bank_update") else list(cell)
     return [(m, m._snapshot_state()) for m in metrics]
 
@@ -865,6 +930,16 @@ def _warm_one(entry: Any, cell: Any, rec: Dict[str, Any], prog: Dict[str, Any]) 
     except Exception as err:  # noqa: BLE001
         _fail(rec, variant, err)
         return False
+    if entry.kind == "encode":
+        # a mesh-bound encoder template re-attaches its NamedShardings to
+        # the decoded avals so the AOT executable accepts the mesh-sharded
+        # arrays a live dispatch passes; dispatch_key ignores shardings, so
+        # the store key is computed from either form identically
+        try:
+            lower_args = cell._warm_avals(variant, lower_args)
+        except Exception as err:  # noqa: BLE001
+            _fail(rec, variant, err)
+            return False
     key = (variant, dispatch_key(lower_args))
     if key in entry._warm:
         return True  # already warmed (idempotent re-warm)

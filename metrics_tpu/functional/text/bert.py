@@ -137,6 +137,34 @@ def _read_baseline_csv(baseline_path: str) -> np.ndarray:
     return baseline[:, 1:4]
 
 
+def _true_width(mask: np.ndarray) -> int:
+    """Last attended column + 1 over a chunk's ORIGINAL attention mask — the
+    token width the encoder actually needs to see."""
+    cols = np.flatnonzero(np.asarray(mask).any(axis=0))
+    return int(cols[-1]) + 1 if cols.size else 1
+
+
+def _bucket_width(mask: np.ndarray, max_length: int) -> int:
+    """pow2 length bucket for one chunk: the smallest power of two covering
+    every attended token, clamped to the padded width. Trailing columns cut
+    here are all-masked, so a mask-correct encoder produces bit-identical
+    embeddings for the kept positions and the greedy matching never sees the
+    difference — while program reuse caps encoder retraces at
+    O(log max_length) instead of one program per corpus width."""
+    from metrics_tpu.engine.bucketing import next_pow2
+
+    return min(int(max_length), next_pow2(_true_width(mask)))
+
+
+def _pad_rows(arr: np.ndarray, rows: int) -> np.ndarray:
+    """Zero-pad the sentence axis up to ``rows`` (pad rows have all-zero
+    attention masks, so their scores are exact zeros and are sliced off)."""
+    arr = np.asarray(arr)
+    if arr.shape[0] >= rows:
+        return arr
+    return np.pad(arr, [(0, rows - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1))
+
+
 def _rescale_metrics_with_baseline(
     out: Dict[str, np.ndarray], baseline: np.ndarray, num_layers: Optional[int],
     all_layers: bool = False,
@@ -231,6 +259,7 @@ def bert_score(
     num_threads: int = 4,
     return_hash: bool = False,
     device: Optional[Any] = None,
+    length_bucketing: bool = True,
 ) -> Dict[str, Union[List[float], str]]:
     """BERTScore precision/recall/F1 between candidate and reference sentences.
 
@@ -256,6 +285,18 @@ def bert_score(
         baseline_path: local baseline CSV (header row, then
             ``layer, precision, recall, f1`` rows); the row used is
             ``num_layers`` (last row when ``None``), as in the reference.
+        length_bucketing: trim each encode chunk to the smallest power-of-two
+            token width covering its attended tokens (and pow2-pad a ragged
+            final chunk's sentence axis), instead of padding every chunk to
+            ``max_length``. Cut columns are fully masked and pad rows score
+            exact zeros, so results are bit-identical for mask-correct
+            encoders (one whose valid-position outputs don't depend on
+            trailing padding — embedding lookups exactly, masked
+            transformers up to the masked-softmax convention); encoder
+            programs are capped at O(log max_length) signatures and
+            short-sentence corpora skip most of the quadratic attention
+            cost. ``False`` restores the fixed ``[batch, max_length]``
+            launches.
 
     Returns:
         dict with per-sentence ``precision``/``recall``/``f1`` lists.
@@ -318,10 +359,46 @@ def bert_score(
     if all_layers:
         score_fn = jax.vmap(_get_precision_recall_f1, in_axes=(0, 0, None, None, None, None))
     chunks: List[Dict[str, Array]] = []
+    # per-side padded widths: a user tokenizer may pad each call to its own
+    # width, and the greedy matching supports unequal preds/target lengths
+    p_width = int(preds_tok["input_ids"].shape[1]) if n else int(max_length)
+    t_width = int(target_tok["input_ids"].shape[1]) if n else int(max_length)
+
+    def _encode_side(ids: np.ndarray, mask: np.ndarray, rows: int, width: int) -> Array:
+        """One chunked encoder launch: trim the token axis to the chunk's
+        pow2 bucket, pow2-pad a ragged sentence axis, slice both back."""
+        ids_c = _pad_rows(ids[:, :width], rows)
+        mask_c = _pad_rows(mask[:, :width], rows)
+        emb = jnp.asarray(forward(ids_c, mask_c))
+        # sentence axis: 0 for [n, L, d], 1 for all_layers [layers, n, L, d]
+        return emb[:, : ids.shape[0]] if all_layers else emb[: ids.shape[0]]
+
     for start in range(0, n, batch_size):
         sl = slice(start, start + batch_size)
-        preds_emb = jnp.asarray(forward(preds_tok["input_ids"][sl], preds_tok["attention_mask"][sl]))
-        target_emb = jnp.asarray(forward(target_tok["input_ids"][sl], target_tok["attention_mask"][sl]))
+        p_ids, p_m = preds_tok["input_ids"][sl], preds_tok["attention_mask"][sl]
+        t_ids, t_m = target_tok["input_ids"][sl], target_tok["attention_mask"][sl]
+        # a ShardedEncoder with a dp-sharded batch axis needs row counts
+        # divisible by the shard count; plain callables multiply by 1
+        mult = forward.batch_multiple() if hasattr(forward, "batch_multiple") else 1
+        if length_bucketing:
+            from metrics_tpu.encoders.runtime import count_bucketed_dispatch
+
+            p_w = _bucket_width(p_m, p_width)
+            t_w = _bucket_width(t_m, t_width)
+            from metrics_tpu.engine.bucketing import next_pow2
+
+            rows = p_ids.shape[0] if p_ids.shape[0] >= batch_size else next_pow2(p_ids.shape[0])
+            if rows % mult:
+                rows = ((rows + mult - 1) // mult) * mult
+            if p_w < p_width or t_w < t_width or rows != p_ids.shape[0]:
+                count_bucketed_dispatch()
+        else:
+            p_w, t_w = p_width, t_width
+            rows = p_ids.shape[0]
+            if rows % mult:
+                rows = ((rows + mult - 1) // mult) * mult
+        preds_emb = _encode_side(p_ids, p_m, rows, p_w)
+        target_emb = _encode_side(t_ids, t_m, rows, t_w)
         want_ndim = 4 if all_layers else 3
         for side, emb in (("preds", preds_emb), ("target", target_emb)):
             if emb.ndim != want_ndim:
@@ -334,10 +411,10 @@ def bert_score(
             score_fn(
                 preds_emb,
                 target_emb,
-                jnp.asarray(preds_mask[sl], preds_emb.dtype),
-                jnp.asarray(target_mask[sl], target_emb.dtype),
-                jnp.asarray(preds_idf_scale[sl], preds_emb.dtype),
-                jnp.asarray(target_idf_scale[sl], target_emb.dtype),
+                jnp.asarray(preds_mask[sl][:, :p_w], preds_emb.dtype),
+                jnp.asarray(target_mask[sl][:, :t_w], target_emb.dtype),
+                jnp.asarray(preds_idf_scale[sl][:, :p_w], preds_emb.dtype),
+                jnp.asarray(target_idf_scale[sl][:, :t_w], target_emb.dtype),
             )
         )
     # sentence axis is last in both layouts: [n] plain, [num_layers, n] stacked

@@ -35,8 +35,19 @@ TPU-native design differences:
   host path above stays the default and the unsharded fallback; the two
   agree to the documented ``NEWTON_SCHULZ_FID_RTOL`` (CI parity gate,
   ``bench.py --shard-smoke``).
+
+* **Optional sharded encoder.** ``encoder_sharding=...`` partitions the
+  extractor itself over the mesh through the
+  :class:`~metrics_tpu.encoders.ShardedEncoder` runtime: weights annotated
+  per leaf and placed once, one compiled forward per input signature
+  (engine entry kind ``encode``), features mp-constrained so they flow
+  straight into the feature-sharded covariance states above.
+  :meth:`update_stream` composes it with the prefetching stream driver —
+  encode + moment accumulation fused into ONE program per chunk, the image
+  corpus never funneling through a single device. See ``docs/encoders.md``.
 """
-from typing import Any, Callable, Optional, Union
+from functools import lru_cache
+from typing import Any, Callable, Iterable, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +74,45 @@ def _validate_features(features: Array) -> Array:
             f"Expected the feature extractor to return a [N, d] array, got shape {features.shape}"
         )
     return features
+
+
+@lru_cache(maxsize=None)
+def _inception_apply_for(feature: str, resize_input: bool):
+    """``(params, imgs) -> [N, d]`` apply for the built-in InceptionV3 tap,
+    memoized so every ``FrechetInceptionDistance(encoder_sharding=<axis>)``
+    of one tap shares a single callable — and with it one compiled encoder
+    program family (identity id-keys the apply)."""
+    from functools import partial
+
+    from metrics_tpu.image.networks.inception import _extract
+
+    return partial(_extract, feature=feature, resize_input=resize_input)
+
+
+@lru_cache(maxsize=None)
+def _moment_consumer_for(feature_dim: int):
+    """See :meth:`FrechetInceptionDistance._moment_consumer` (module-level so
+    the consumer's identity — and with it the fused encode+accumulate
+    program — is shared by every instance of one feature dimensionality)."""
+
+    def consumer(carry, features, valid):
+        if features.ndim != 2 or features.shape[1] != feature_dim:
+            raise MetricsUserError(
+                f"Feature extractor returned shape {tuple(features.shape)},"
+                f" expected [N, {feature_dim}]"
+            )
+        f = features.astype(carry["sum"].dtype) * valid[:, None]
+        outer = jnp.matmul(f.T, f, precision=jax.lax.Precision.HIGHEST)
+        new = dict(carry)
+        for name, delta in (("sum", jnp.sum(f, axis=0)), ("outer", outer)):
+            acc = carry[name]
+            folded = acc + delta
+            new[name + "_c"] = carry[name + "_c"] + ((acc - folded) + delta)
+            new[name] = folded
+        new["n"] = carry["n"] + valid.sum().astype(jnp.asarray(carry["n"]).dtype)
+        return new
+
+    return consumer
 
 
 def _sqrtm_psd(mat: np.ndarray) -> np.ndarray:
@@ -118,6 +168,17 @@ class FrechetInceptionDistance(Metric):
             host path to ``sharding.NEWTON_SCHULZ_FID_RTOL``).
         sqrt_iters: Newton–Schulz iteration count (quadratic convergence;
             the default is conservative for covariance spectra).
+        encoder_sharding: run the extractor itself as a mesh-resident
+            program (``metrics_tpu.encoders``). Either a ready
+            :class:`~metrics_tpu.encoders.ShardedEncoder` (any custom
+            extractor), or — with the built-in InceptionV3 (``feature`` is
+            an int) — a mesh-axis name / ``PartitionSpec`` sharding the
+            network's output-channel axes over that axis
+            (``inception_param_specs``). Call :meth:`shard_states(mesh)
+            <shard_states>` to place weights + states together; features
+            are constrained to ``PartitionSpec(None, axis)`` so they land
+            directly in the feature-sharded moment states. Pairs naturally
+            with ``feature_sharding`` on the same axis.
 
     Example:
         >>> import jax.numpy as jnp
@@ -144,12 +205,14 @@ class FrechetInceptionDistance(Metric):
         feature_sharding: Optional[Any] = None,
         matrix_sqrt: str = "auto",
         sqrt_iters: int = 40,
+        encoder_sharding: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)  # extractor call is user code
         kwargs.setdefault("compute_on_step", False)  # reference ``fid.py:215``
         super().__init__(**kwargs)
-        if isinstance(feature, int):
+        feature_is_int = isinstance(feature, int)
+        if feature_is_int:
             feature = _resolve_feature_extractor(feature, weights_path)
             if feature_dim is None:
                 feature_dim = feature.feature_dim  # O(d^2) streaming stats
@@ -169,6 +232,38 @@ class FrechetInceptionDistance(Metric):
         self.feature_sharding = canonical_spec(class_axis_spec(feature_sharding)) or None
         self.matrix_sqrt = matrix_sqrt
         self.sqrt_iters = int(sqrt_iters)
+
+        # -- sharded encoder runtime (metrics_tpu.encoders) -------------
+        self._encoder_runtime = None  # ShardedEncoder once mesh-bound
+        self._pending_encoder_axis = None  # spec awaiting shard_states(mesh)
+        if encoder_sharding is not None:
+            if getattr(encoder_sharding, "_is_sharded_encoder", False):
+                # a ready runtime: its sharding config IS the annotation;
+                # place it at shard_states(mesh) unless already placed
+                self._encoder_runtime = encoder_sharding if encoder_sharding.mesh is not None else None
+                self._pending_encoder = encoder_sharding
+                self.encoder_sharding = encoder_sharding  # id-pinned in the fingerprint
+            else:
+                axis_spec = canonical_spec(class_axis_spec(encoder_sharding))
+                if not axis_spec or not isinstance(axis_spec[0], str):
+                    raise MetricsUserError(
+                        "`encoder_sharding` must be a mesh-axis name, a"
+                        " PartitionSpec naming one, or a ShardedEncoder; got"
+                        f" {encoder_sharding!r}"
+                    )
+                if not feature_is_int:
+                    raise MetricsUserError(
+                        "`encoder_sharding=<axis>` auto-shards the built-in"
+                        " InceptionV3 extractor (integer `feature`). For a"
+                        " custom extractor pass a ready"
+                        " metrics_tpu.ShardedEncoder instead."
+                    )
+                self.encoder_sharding = axis_spec
+                self._pending_encoder_axis = axis_spec[0]
+                self._pending_encoder = None
+        else:
+            self.encoder_sharding = None
+            self._pending_encoder = None
         if feature_dim is None and (self.feature_sharding is not None or matrix_sqrt == "newton_schulz"):
             raise MetricsUserError(
                 "feature_sharding / matrix_sqrt='newton_schulz' operate on the"
@@ -195,9 +290,155 @@ class FrechetInceptionDistance(Metric):
             self.add_state("real_features", default=[], dist_reduce_fx="cat")
             self.add_state("fake_features", default=[], dist_reduce_fx="cat")
 
+    # ------------------------------------------------------------------
+    # sharded encoder runtime
+    # ------------------------------------------------------------------
+    def shard_states(self, mesh: Any) -> "FrechetInceptionDistance":
+        """Place the registered-sharded states AND the encoder runtime onto
+        ``mesh`` (one ``device_put`` of the weights, per-leaf annotated)."""
+        super().shard_states(mesh)
+        self._bind_encoder_mesh(mesh)
+        return self
+
+    def _bind_encoder_mesh(self, mesh: Any) -> None:
+        from metrics_tpu.encoders import ShardedEncoder
+
+        pending = self.__dict__.get("_pending_encoder")
+        if pending is not None:
+            if pending.mesh is not None and pending.mesh is not mesh:
+                raise MetricsUserError(
+                    f"encoder_sharding runtime {pending.name!r} is placed on a"
+                    " different mesh than shard_states(mesh) received —"
+                    " features would be constrained to one mesh and"
+                    " accumulated on another. Place encoder and states on"
+                    " the same mesh (or pass an unplaced ShardedEncoder and"
+                    " let shard_states place it)."
+                )
+            self._encoder_runtime = pending if pending.mesh is not None else pending.place(mesh)
+            return
+        axis = self.__dict__.get("_pending_encoder_axis")
+        if axis is None:
+            return
+        runtime = self.__dict__.get("_encoder_runtime")
+        if runtime is not None:
+            # internally-built runtime (we own it): follow the states onto
+            # the new mesh instead of leaving features constrained elsewhere
+            if runtime.mesh is not mesh:
+                runtime.place(mesh)
+            return
+        from metrics_tpu.image.networks.inception import inception_param_specs
+        from jax.sharding import PartitionSpec
+
+        extractor = self.inception  # InceptionV3Features (int-feature path)
+        self._encoder_runtime = ShardedEncoder(
+            # memoized per (feature, resize_input): encoder program identity
+            # id-keys the apply callable, so a fresh partial per instance
+            # would give every FID its own compiled InceptionV3 family
+            _inception_apply_for(extractor.feature, extractor.resize_input),
+            extractor.params,
+            param_specs=inception_param_specs(axis),
+            mesh=mesh,
+            out_spec=PartitionSpec(None, axis),
+            name=f"inception_{extractor.feature}",
+        )
+
+    def _encode(self, imgs: Array) -> Array:
+        runtime = self.__dict__.get("_encoder_runtime")
+        if runtime is not None:
+            return runtime(imgs)
+        return self.inception(imgs)
+
+    def __getstate__(self) -> dict:
+        state = super().__getstate__()
+        # process-local encoder machinery, like _shard_mesh: the mesh-bound
+        # runtime is rebuilt at the next shard_states(mesh) from the pending
+        # annotation (pickling it would also double-ship the weights next to
+        # self.inception), and the plain stream wrapper holds an unpicklable
+        # closure and is recreated lazily
+        state.pop("_encoder_runtime", None)
+        state.pop("_plain_stream_encoder", None)
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        super().__setstate__(state)
+        self.__dict__.setdefault("_encoder_runtime", None)
+        self.__dict__.setdefault("_pending_encoder", None)
+        self.__dict__.setdefault("_pending_encoder_axis", None)
+
+    def _stream_encoder(self) -> Any:
+        """The runtime the streaming driver encodes through: the sharded
+        runtime when bound, else a cached plain wrapper around the extractor
+        (single-device fallback — same fused program shape, no mesh)."""
+        runtime = self.__dict__.get("_encoder_runtime")
+        if runtime is not None:
+            return runtime
+        wrapped = self.__dict__.get("_plain_stream_encoder")
+        if wrapped is None:
+            from metrics_tpu.encoders import ShardedEncoder
+
+            wrapped = ShardedEncoder.from_callable(
+                self.inception, name=type(self.inception).__name__
+            )
+            self._plain_stream_encoder = wrapped
+        return wrapped
+
+    def _moment_consumer(self):
+        """Traced ``(carry, features, valid) -> carry`` folding one chunk of
+        features into the streaming moment states — the SAME two-sum/Kahan
+        accumulation :meth:`update` performs, with pad/screened rows zeroed
+        by ``valid`` (multiplying by 1.0 is exact, so an all-valid chunk is
+        bit-identical to a per-step ``update``). Memoized per
+        ``feature_dim`` at module level: the fused encode+accumulate program
+        is keyed by this object's identity, so every FID instance of one
+        dimensionality shares ONE compiled family — zero extra compiles for
+        clones and restarted epochs."""
+        return _moment_consumer_for(int(self.feature_dim))
+
+    def update_stream(self, batches: Iterable[Any], real: bool = True, **stream_kwargs: Any) -> Any:
+        """Stream image batches into the tracked distribution without ever
+        materializing the feature corpus: each chunk runs ONE fused
+        encode+accumulate program (``engine`` entry kind ``encode``) with
+        double-buffered host→device staging, pow2 row bucketing for the
+        ragged final chunk, and this metric's ``on_bad_input`` policy
+        screening raw images UPSTREAM of the encoder. Needs the
+        ``feature_dim`` streaming-statistics states (the buffer-of-features
+        fallback has nothing to accumulate into). Returns the
+        :class:`~metrics_tpu.encoders.StreamResult`.
+        """
+        if self.feature_dim is None:
+            raise MetricsUserError(
+                "update_stream accumulates into the O(d^2) streaming-"
+                "statistics states and needs `feature_dim` (the buffer-of-"
+                "features fallback materializes the corpus by definition)."
+            )
+        from metrics_tpu.encoders import encode_stream
+
+        prefix = "real" if real else "fake"
+        carry = {
+            "sum": getattr(self, f"{prefix}_sum"),
+            "sum_c": getattr(self, f"{prefix}_sum_c"),
+            "outer": getattr(self, f"{prefix}_outer"),
+            "outer_c": getattr(self, f"{prefix}_outer_c"),
+            "n": getattr(self, f"{prefix}_n"),
+        }
+        carry, result = encode_stream(
+            self._stream_encoder(),
+            batches,
+            self._moment_consumer(),
+            carry,
+            screen=self if self.on_bad_input != "propagate" else None,
+            source=type(self).__name__,
+            **stream_kwargs,
+        )
+        for name, value in carry.items():
+            setattr(self, f"{prefix}_{name}", value)
+        self._update_count += result.chunks + result.batches_quarantined
+        self._computed = None
+        return result
+
     def update(self, imgs: Array, real: bool = True) -> None:
         """Extract features and fold them into the tracked distribution."""
-        features = _validate_features(jnp.asarray(self.inception(imgs)))
+        features = _validate_features(jnp.asarray(self._encode(imgs)))
         if self.feature_dim is not None:
             if features.shape[1] != self.feature_dim:
                 raise MetricsUserError(

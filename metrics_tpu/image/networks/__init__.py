@@ -9,11 +9,14 @@ torch checkpoints.
 """
 from metrics_tpu.image.networks.inception import (
     InceptionV3Features,
+    clear_inception_extractor_cache,
     convert_torch_inception_checkpoint,
     inception_param_spec,
+    inception_param_specs,
     inception_v3,
     load_inception_weights,
     random_inception_params,
+    resolve_inception_extractor,
     save_inception_weights,
 )
 from metrics_tpu.image.networks.lpips import (
@@ -29,10 +32,13 @@ from metrics_tpu.image.networks.lpips import (
 __all__ = [
     "InceptionV3Features",
     "LPIPSNetwork",
+    "clear_inception_extractor_cache",
     "convert_torch_inception_checkpoint",
     "convert_torch_lpips_checkpoint",
     "inception_param_spec",
+    "inception_param_specs",
     "inception_v3",
+    "resolve_inception_extractor",
     "load_inception_weights",
     "load_lpips_weights",
     "lpips_distance",

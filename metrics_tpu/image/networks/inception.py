@@ -31,6 +31,7 @@ interpolation (``src = dst * in/out`` — no half-pixel offset) and normalized
 to ``(x - 128) / 128``.
 """
 import os
+import threading
 from functools import partial
 from typing import Any, Dict, Mapping, Sequence, Tuple, Union
 
@@ -478,14 +479,35 @@ def convert_torch_inception_checkpoint(src: str, dst: str) -> None:
     np.savez(_npz_path(dst), **flat)
 
 
+# resolve_inception_extractor memo: every FrechetInceptionDistance (and
+# KID/IS) construction used to re-read and re-convert the ~100MB weights
+# .npz from disk; the extractor is immutable inference state, so one
+# instance per (feature, resolved path, resize_input) serves every metric —
+# which also lets all of them share ONE engine/encode program family (the
+# extractor's id is part of the metric fingerprint).
+_EXTRACTOR_CACHE: Dict[Tuple, "InceptionV3Features"] = {}
+_EXTRACTOR_LOCK = threading.Lock()
+
+
+def clear_inception_extractor_cache() -> None:
+    """Drop memoized extractors (tests / freeing weight memory)."""
+    with _EXTRACTOR_LOCK:
+        _EXTRACTOR_CACHE.clear()
+
+
 def resolve_inception_extractor(
     feature: Union[int, str], weights_path: Union[str, None], resize_input: bool = True
 ) -> InceptionV3Features:
-    """Build the default extractor from a local weights file.
+    """Build (or reuse) the default extractor from a local weights file.
 
     ``weights_path`` falls back to the ``METRICS_TPU_INCEPTION_WEIGHTS`` env
     var; without either, raise the same install-hint-style error the reference
     raises when ``torch-fidelity`` is absent (``image/fid.py:234-238``).
+
+    Memoized per ``(feature, resolved path, resize_input)``: the weights file
+    is read and converted once per process, not once per metric construction.
+    A changed file at the same path keeps serving the cached weights until
+    :func:`clear_inception_extractor_cache`.
     """
     if isinstance(feature, int) and feature not in VALID_FEATURES:
         raise ValueError(
@@ -500,5 +522,40 @@ def resolve_inception_extractor(
             f" `weights_path=dst` (or set ${ENV_WEIGHTS_VAR}). Alternatively pass"
             " `feature=<callable imgs -> [N, d]>`."
         )
+    key = (str(feature), os.path.abspath(os.path.expanduser(path)), bool(resize_input))
+    with _EXTRACTOR_LOCK:
+        cached = _EXTRACTOR_CACHE.get(key)
+    if cached is not None:
+        return cached
     params = load_inception_weights(path)
-    return InceptionV3Features(params, feature, resize_input=resize_input)
+    extractor = InceptionV3Features(params, feature, resize_input=resize_input)
+    with _EXTRACTOR_LOCK:
+        # a racing construction may have won; keep the first so every caller
+        # shares one object (and one engine program family)
+        return _EXTRACTOR_CACHE.setdefault(key, extractor)
+
+
+def inception_param_specs(axis: str = "mp") -> Dict[str, Dict[str, Any]]:
+    """Per-leaf ``PartitionSpec`` annotations sharding the network's output-
+    channel axes over one mesh axis — the tap-over-mp layout for
+    ``FrechetInceptionDistance(encoder_sharding=...)``.
+
+    Every conv kernel (HWIO) shards its O axis, every BN vector its only
+    axis, and the fc kernel its output axis; channel counts in this
+    architecture are all divisible by the 2/4-way mp meshes the CI lanes
+    use (GSPMD pads uneven shards anyway). The returned dict matches the
+    parameter pytree of :func:`inception_param_spec` leaf-for-leaf, ready
+    for ``ShardedEncoder(param_specs=...)``.
+    """
+    from jax.sharding import PartitionSpec
+
+    specs: Dict[str, Dict[str, Any]] = {}
+    for mod, group in inception_param_spec().items():
+        out: Dict[str, Any] = {}
+        for name, shape in group.items():
+            if name == "kernel":
+                out[name] = PartitionSpec(*([None] * (len(shape) - 1) + [axis]))
+            else:
+                out[name] = PartitionSpec(axis)
+        specs[mod] = out
+    return specs

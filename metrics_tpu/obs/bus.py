@@ -70,12 +70,19 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: reason ``rebalance``/``recovery``), ``fleet_epoch`` (a membership change
 #: completed — version, worker count, joined/left, tenants moved, rebalance
 #: bytes; also emitted with ``event="worker_dead"`` when a worker is marked
-#: dead). Misc: ``warning`` (a ``warn_once`` emission).
+#: dead). Sharded encoders (``metrics_tpu.encoders``): ``encode`` (one
+#: streamed encoder chunk dispatched through an ``encode`` cache entry —
+#: carries the encoder name, real ``rows`` accumulated, the pow2 ``bucket``
+#: the batch axis padded to, and ``fused=True`` when the accumulation rode
+#: the same compiled program; compile/cache_hit/retrace events for encoder
+#: programs ride the ordinary engine kinds with ``entry_kind="encode"``).
+#: Misc: ``warning`` (a ``warn_once`` emission).
 EVENT_KINDS = (
     "compile",
     "cache_hit",
     "retrace",
     "bucketed",
+    "encode",
     "sync_attempt",
     "sync_retry",
     "sync_degrade",

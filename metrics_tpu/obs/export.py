@@ -47,6 +47,12 @@ def _fleet_stats() -> Dict[str, Any]:
     return fleet_stats()
 
 
+def _encoder_stats() -> Dict[str, Any]:
+    from metrics_tpu.encoders import encoder_stats
+
+    return encoder_stats()
+
+
 def process_snapshot() -> Dict[str, Any]:
     """The process-wide observability view (no metric argument needed)."""
     from metrics_tpu import engine as _engine
@@ -68,6 +74,10 @@ def process_snapshot() -> Dict[str, Any]:
         # sharded metric states (metrics_tpu.sharding): registered specs,
         # resharding events, sharded drives, per-device resident bytes
         "sharding": _shard_stats(),
+        # sharded encoder runtime (metrics_tpu.encoders): weight placements,
+        # encode/fused dispatches, streamed chunks/rows, upstream screening,
+        # pow2-bucketed launches, per-encoder resident parameter bytes
+        "encoders": _encoder_stats(),
         # elastic fleet (metrics_tpu.fleet): per-fleet membership/occupancy,
         # migrations, rebalance bytes, kills/recoveries
         "fleet": _fleet_stats(),
@@ -268,6 +278,33 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
             "metrics_tpu_shard_state_bytes_total", resident["total_bytes"], labels, kind="gauge"
         )
         _sample("metrics_tpu_shard_state_devices", resident["devices"], labels, kind="gauge")
+
+    # sharded encoder runtime: dispatch/stream counters + weight residency
+    enc = _encoder_stats()
+    for key in (
+        "placements",
+        "encode_calls",
+        "fused_calls",
+        "stream_chunks",
+        "rows_encoded",
+        "rows_screened",
+        "batches_quarantined",
+        "bucketed_dispatches",
+    ):
+        _sample(f"metrics_tpu_encoder_{key}", enc[key])
+    for enc_name in sorted(enc["encoders"]):
+        rec = enc["encoders"][enc_name]
+        labels = {"encoder": enc_name}
+        _sample(
+            "metrics_tpu_encoder_params_bytes_per_device",
+            rec["params_bytes_per_device"],
+            labels,
+            kind="gauge",
+        )
+        _sample(
+            "metrics_tpu_encoder_params_bytes_total", rec["params_bytes_total"], labels, kind="gauge"
+        )
+        _sample("metrics_tpu_encoder_devices", rec["devices"], labels, kind="gauge")
 
     # elastic fleet: membership, per-worker occupancy, migration traffic
     fleet = _fleet_stats()

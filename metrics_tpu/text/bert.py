@@ -27,6 +27,19 @@ class BERTScore(Metric):
         idf: idf-weight tokens over the accumulated references.
         max_length: padded sequence length (fixed padding keeps the cat
             states rectangular for sync).
+        encoder_sharding: a :class:`~metrics_tpu.encoders.ShardedEncoder`
+            to encode with — weights ``PartitionSpec``-annotated and
+            mesh-resident, one compiled batch-dp-sharded forward per chunk
+            signature through the shared engine cache (entry kind
+            ``encode``). Replaces ``model`` (``user_tokenizer`` still
+            required); the compute-time corpus pass then streams chunked,
+            pow2-length-bucketed, dp-sharded encoding instead of
+            single-device launches. See ``docs/encoders.md``.
+        length_bucketing: trim each compute-time encode chunk to its pow2
+            token-width bucket (and pow2-pad the ragged final chunk's
+            sentence axis) instead of padding every launch to
+            ``max_length`` — bit-identical for mask-correct encoders,
+            capping encoder retraces at O(log max_length). Default on.
 
     Example:
         >>> import jax.numpy as jnp
@@ -67,6 +80,8 @@ class BERTScore(Metric):
         max_length: int = 512,
         batch_size: int = 64,
         return_hash: bool = False,
+        encoder_sharding: Optional[Any] = None,
+        length_bucketing: bool = True,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)  # host-side tokenization
@@ -74,6 +89,22 @@ class BERTScore(Metric):
         self.model_name_or_path = model_name_or_path
         self.num_layers = num_layers
         self.all_layers = all_layers
+        if encoder_sharding is not None:
+            if not getattr(encoder_sharding, "_is_sharded_encoder", False):
+                raise ValueError(
+                    "`encoder_sharding` must be a metrics_tpu.ShardedEncoder"
+                    " (the runtime carries the weights and their PartitionSpec"
+                    f" annotations), got {type(encoder_sharding).__name__!r}."
+                    " For a plain callable pass `model=` instead."
+                )
+            if model is not None or user_forward_fn is not None:
+                raise ValueError(
+                    "pass either `model` (a plain callable) or"
+                    " `encoder_sharding` (a ShardedEncoder), not both."
+                )
+            model = encoder_sharding
+        self.encoder_sharding = encoder_sharding  # id-pinned in the fingerprint
+        self.length_bucketing = length_bucketing
         self._forward = model or user_forward_fn
         self.idf = idf
         self.max_length = max_length
@@ -141,6 +172,7 @@ class BERTScore(Metric):
             idf=self.idf,
             max_length=self.max_length,
             batch_size=self.batch_size,
+            length_bucketing=self.length_bucketing,
             return_hash=self.return_hash,
             model_name_or_path=self.model_name_or_path,
             num_layers=self.num_layers,

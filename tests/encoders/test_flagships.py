@@ -1,0 +1,455 @@
+"""Encoder-sharded flagships: BERTScore and FID on the (2, 4) dp×mp mesh.
+
+Sharded-vs-single-device parity contracts:
+
+* BERTScore: BIT-identical — the embedding-table encoder is mask-correct
+  and padding-invariant, weights shard over the vocab axis (gathers move
+  data, no arithmetic) and activations shard over the sentence axis (each
+  row's math stays local to one shard), so no float reassociation exists
+  anywhere on the sharded path.
+* FID: the feature-axis-sharded path flows through the Newton–Schulz matrix
+  square root, which agrees with the host eigendecomposition to the
+  documented ``NEWTON_SCHULZ_FID_RTOL`` — the same tolerance the PR-10
+  shard lane gates.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import BERTScore, FrechetInceptionDistance, ShardedEncoder, engine
+from metrics_tpu.encoders import encoder_stats, reset_encoder_stats
+from metrics_tpu.sharding import NEWTON_SCHULZ_FID_RTOL
+
+VOCAB, DIM, MAX_LEN = 104, 16, 32
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    engine.clear_cache()
+    reset_encoder_stats()
+    yield
+    engine.clear_cache()
+    reset_encoder_stats()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    assert len(devs) >= 8
+    return Mesh(np.array(devs[:8]).reshape(2, 4), ("dp", "mp"))
+
+
+# ---------------------------------------------------------------------------
+# BERTScore
+# ---------------------------------------------------------------------------
+def _tokenizer(text, max_length):
+    ids = np.zeros((len(text), max_length), np.int64)
+    mask = np.zeros_like(ids)
+    for i, sentence in enumerate(text):
+        toks = [1] + [hash(w) % (VOCAB - 10) + 5 for w in sentence.split()][: max_length - 2] + [2]
+        ids[i, : len(toks)] = toks
+        mask[i, : len(toks)] = 1
+    return {"input_ids": ids, "attention_mask": mask}
+
+
+_TABLE = np.random.RandomState(0).normal(size=(VOCAB, DIM)).astype(np.float32)
+
+
+def _plain_model(ids, mask):
+    # the same jnp math as _emb_apply (numpy would promote f32*i64 to f64
+    # where jax keeps f32 — the comparison must not straddle that)
+    return _emb_apply({"table": jnp.asarray(_TABLE)}, jnp.asarray(ids), jnp.asarray(mask))
+
+
+def _emb_apply(params, ids, mask):
+    return params["table"][ids] * mask[..., None]
+
+
+def _bert_encoder(mesh):
+    # weights mp-sharded over the VOCAB axis (gather-exact), activations
+    # dp-sharded over the sentence axis (row-local math) — the layout that
+    # keeps the sharded corpus pass bit-identical
+    return ShardedEncoder(
+        _emb_apply,
+        {"table": jnp.asarray(_TABLE)},
+        param_specs={"table": P("mp", None)},
+        mesh=mesh,
+        in_specs=P("dp"),
+        out_spec=P("dp"),
+        name="bert_emb",
+    )
+
+
+_SENTS = [
+    "the cat sat on the mat",
+    "hello world",
+    "a much longer sentence with many more words than the others here",
+    "tiny",
+    "the quick brown fox jumps over the lazy dog",
+]
+
+
+def _corpus(k=3):
+    preds = (_SENTS * k)[: 5 * k]
+    target = [s.replace("the", "a") for s in preds]
+    return preds, target
+
+
+def _score(metric):
+    out = metric.compute()
+    return {k: np.asarray(out[k]) for k in ("precision", "recall", "f1")}
+
+
+def test_bertscore_sharded_bit_identical_to_single_device(mesh):
+    preds, target = _corpus()
+    kw = dict(user_tokenizer=_tokenizer, max_length=MAX_LEN, batch_size=4, idf=True)
+    ref = BERTScore(model=_plain_model, length_bucketing=False, **kw)
+    ref.update(preds, target)
+    ref_out = _score(ref)
+
+    sharded = BERTScore(encoder_sharding=_bert_encoder(mesh), **kw)
+    sharded.update(preds, target)
+    out = _score(sharded)
+    for key in ref_out:
+        np.testing.assert_array_equal(out[key], ref_out[key])
+
+
+def test_bertscore_length_bucketing_bit_identical_and_caps_retraces():
+    preds, target = _corpus()
+    kw = dict(user_tokenizer=_tokenizer, max_length=MAX_LEN, batch_size=4, idf=True)
+    ref = BERTScore(model=_plain_model, length_bucketing=False, **kw)
+    ref.update(preds, target)
+    ref_out = _score(ref)
+
+    shapes = []
+
+    def recording_model(ids, mask):
+        shapes.append(tuple(np.shape(ids)))
+        return _plain_model(ids, mask)
+
+    bucketed = BERTScore(model=recording_model, **kw)  # length_bucketing default ON
+    bucketed.update(preds, target)
+    out = _score(bucketed)
+    for key in ref_out:
+        np.testing.assert_array_equal(out[key], ref_out[key])
+    # every launch was a pow2 (rows, width) bucket strictly under the
+    # pad-to-max width, so program signatures stay O(log max_len)
+    assert all(w < MAX_LEN and w == 1 << (w.bit_length() - 1) for _, w in set(shapes))
+    assert len(set(shapes)) <= 4
+    assert encoder_stats()["bucketed_dispatches"] > 0
+
+
+def test_bertscore_sharded_zero_extra_compiles_on_repeat_epochs(mesh):
+    preds, target = _corpus()
+    enc = _bert_encoder(mesh)
+    kw = dict(user_tokenizer=_tokenizer, max_length=MAX_LEN, batch_size=4)
+    score = BERTScore(encoder_sharding=enc, **kw)
+    score.update(preds, target)
+    score.compute()
+    compiles = enc.compile_stats()["compiles"]
+    assert compiles >= 1
+
+    # repeat epoch on the same instance + a fresh clone-equivalent instance:
+    # every chunk signature is already compiled
+    score.reset()
+    score.update(preds, target)
+    score.compute()
+    again = BERTScore(encoder_sharding=enc, **kw)
+    again.update(preds, target)
+    again.compute()
+    assert enc.compile_stats()["compiles"] == compiles
+    assert engine.cache_summary()["by_kind"]["encode"]["compiles"] == compiles
+
+
+def test_bertscore_bucketing_handles_per_side_tokenizer_widths():
+    """A user tokenizer may pad each call to its own width — the target side
+    must not be clamped to the preds side's padded width."""
+    from metrics_tpu.functional.text.bert import bert_score
+
+    def ragged_tokenizer(text, max_length):
+        # pad to this call's own max, not the global max_length
+        out = _tokenizer(text, max_length)
+        width = max(1, int(out["attention_mask"].sum(axis=1).max()))
+        return {k: v[:, :width] for k, v in out.items()}
+
+    preds = ["tiny", "also small"]
+    target = ["a very much longer reference sentence with many words in it"] * 2
+    kw = dict(model=_plain_model, user_tokenizer=ragged_tokenizer, max_length=MAX_LEN)
+    bucketed = bert_score(preds, target, length_bucketing=True, **kw)
+    plain = bert_score(preds, target, length_bucketing=False, **kw)
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_array_equal(np.asarray(bucketed[key]), np.asarray(plain[key]))
+    # sanity: the long target side genuinely tokenizes wider than preds
+    p_tok = ragged_tokenizer(preds, MAX_LEN)
+    t_tok = ragged_tokenizer(target, MAX_LEN)
+    assert t_tok["input_ids"].shape[1] > p_tok["input_ids"].shape[1]
+
+
+def test_fid_shard_states_rejects_cross_mesh_encoder(mesh):
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    devs = jax.devices()
+    other = Mesh(np.array(devs[:4]).reshape(1, 4), ("dp", "mp"))
+    enc = ShardedEncoder(
+        _feat_apply,
+        {"w": jnp.asarray(_W)},
+        param_specs={"w": P(None, "mp")},
+        mesh=other,
+        name="cross_mesh",
+    )
+    fid = FrechetInceptionDistance(
+        feature=enc, feature_dim=FEAT_D, feature_sharding="mp", encoder_sharding=enc
+    )
+    with pytest.raises(MetricsUserError, match="different mesh"):
+        fid.shard_states(mesh)
+
+
+def test_bertscore_rejects_non_runtime_encoder_sharding():
+    with pytest.raises(ValueError, match="ShardedEncoder"):
+        BERTScore(encoder_sharding="mp", user_tokenizer=_tokenizer)
+
+
+# ---------------------------------------------------------------------------
+# FID
+# ---------------------------------------------------------------------------
+FEAT_D = 16
+_W = (np.random.RandomState(7).normal(size=(48, FEAT_D)) * 0.2).astype(np.float32)
+
+
+def _feat_apply(params, imgs):
+    flat = jnp.asarray(imgs, jnp.float32).reshape(imgs.shape[0], -1)
+    return flat @ params["w"]
+
+
+def _plain_extractor(imgs):
+    # trace-compatible (update_stream fuses the extractor into a compiled
+    # program — the documented contract for streaming)
+    flat = jnp.asarray(imgs, jnp.float32).reshape(jnp.shape(imgs)[0], -1)
+    return flat @ jnp.asarray(_W)
+
+
+def _fid_encoder(mesh):
+    return ShardedEncoder(
+        _feat_apply,
+        {"w": jnp.asarray(_W)},
+        param_specs={"w": P(None, "mp")},
+        mesh=mesh,
+        in_specs=P("dp"),
+        out_spec=P(None, "mp"),
+        name="fid_feat",
+    )
+
+
+def _image_stream(rng, n_batches=4, batch=16, ragged=5):
+    out = [rng.rand(batch, 3, 4, 4).astype(np.float32) for _ in range(n_batches)]
+    if ragged:
+        out.append(rng.rand(ragged, 3, 4, 4).astype(np.float32))
+    return out
+
+
+def test_fid_sharded_stream_matches_single_device_within_ns_rtol(mesh):
+    rng = np.random.RandomState(0)
+    real = _image_stream(rng)
+    fake = [b * 0.6 + 0.2 for b in _image_stream(rng)]
+
+    ref = FrechetInceptionDistance(feature=_plain_extractor, feature_dim=FEAT_D)
+    for b in real:
+        ref.update(jnp.asarray(b), real=True)
+    for b in fake:
+        ref.update(jnp.asarray(b), real=False)
+    ref_value = float(ref.compute())
+
+    enc = _fid_encoder(mesh)
+    fid = FrechetInceptionDistance(
+        feature=enc, feature_dim=FEAT_D, feature_sharding="mp", encoder_sharding=enc
+    )
+    fid.shard_states(mesh)
+    fid.update_stream(real, real=True)
+    fid.update_stream(fake, real=False)
+    # states stayed feature-sharded through the fused accumulation
+    per_dev = max(s.data.nbytes for s in fid.real_outer.addressable_shards)
+    assert fid.real_outer.nbytes / per_dev == 4.0
+    value = float(fid.compute())
+    assert ref_value > 1e-3  # non-degenerate distributions
+    # sharded encoder + NS sqrt vs host eigendecomposition: documented rtol
+    assert abs(value - ref_value) / abs(ref_value) < NEWTON_SCHULZ_FID_RTOL
+
+
+def test_fid_update_stream_matches_per_step_updates_unsharded():
+    rng = np.random.RandomState(1)
+    real = _image_stream(rng, ragged=0)
+    fake = [b * 0.5 for b in _image_stream(rng, ragged=0)]
+
+    a = FrechetInceptionDistance(feature=_plain_extractor, feature_dim=FEAT_D)
+    for b in real:
+        a.update(jnp.asarray(b), real=True)
+    for b in fake:
+        a.update(jnp.asarray(b), real=False)
+
+    b_metric = FrechetInceptionDistance(feature=_plain_extractor, feature_dim=FEAT_D)
+    b_metric.update_stream(real, real=True)
+    b_metric.update_stream(fake, real=False)
+
+    # no ragged chunk: per-chunk accumulation order is identical, so the
+    # moment states agree bitwise
+    for name in ("real_sum", "real_outer", "fake_sum", "fake_outer", "real_n", "fake_n"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b_metric, name))
+        )
+    assert float(a.compute()) == float(b_metric.compute())
+
+
+def test_fid_stream_ragged_chunk_close_and_counted():
+    rng = np.random.RandomState(2)
+    real = _image_stream(rng)  # ragged 5-row tail
+    a = FrechetInceptionDistance(feature=_plain_extractor, feature_dim=FEAT_D)
+    for b in real:
+        a.update(jnp.asarray(b), real=True)
+        a.update(jnp.asarray(b * 0.5), real=False)
+    b_metric = FrechetInceptionDistance(feature=_plain_extractor, feature_dim=FEAT_D)
+    b_metric.update_stream(real, real=True)
+    b_metric.update_stream([x * 0.5 for x in real], real=False)
+    assert int(b_metric.real_n) == int(a.real_n)
+    np.testing.assert_allclose(float(a.compute()), float(b_metric.compute()), rtol=1e-4)
+    assert encoder_stats()["bucketed_dispatches"] >= 2
+
+
+def test_fid_stream_zero_extra_compiles_on_repeat_epochs(mesh):
+    rng = np.random.RandomState(3)
+    real = _image_stream(rng)
+    enc = _fid_encoder(mesh)
+    fid = FrechetInceptionDistance(
+        feature=enc, feature_dim=FEAT_D, feature_sharding="mp", encoder_sharding=enc
+    )
+    fid.shard_states(mesh)
+    fid.update_stream(real, real=True)
+    compiles = engine.cache_summary()["by_kind"]["encode"]["compiles"]
+    fid.update_stream(real, real=False)
+    fid2 = FrechetInceptionDistance(
+        feature=enc, feature_dim=FEAT_D, feature_sharding="mp", encoder_sharding=enc
+    )
+    fid2.shard_states(mesh)
+    fid2.update_stream(real, real=True)
+    assert engine.cache_summary()["by_kind"]["encode"]["compiles"] == compiles
+
+
+def test_fid_stream_on_bad_input_skip_screens_upstream():
+    rng = np.random.RandomState(4)
+    clean = rng.rand(8, 3, 4, 4).astype(np.float32)
+    bad = clean.copy()
+    bad[1, 0, 0, 0] = np.nan
+    fid = FrechetInceptionDistance(
+        feature=_plain_extractor, feature_dim=FEAT_D, on_bad_input="skip"
+    )
+    result = fid.update_stream([clean, bad, clean], real=True)
+    assert result.batches_quarantined == 1
+    assert int(fid.real_n) == 16
+    report = fid.health_report()
+    assert report["updates_quarantined"] == 1
+    assert report["nan_count"] == 1
+
+
+def test_fid_picklable_after_plain_update_stream():
+    """The lazily-cached plain stream wrapper (a closure) and the mesh-bound
+    runtime are process-local — pickling must drop them, not fail, and must
+    not double-ship the weights."""
+    import pickle
+
+    rng = np.random.RandomState(5)
+    fid = FrechetInceptionDistance(feature=_plain_extractor, feature_dim=FEAT_D)
+    fid.update_stream([rng.rand(8, 3, 4, 4).astype(np.float32)], real=True)
+    fid.update_stream([rng.rand(8, 3, 4, 4).astype(np.float32)], real=False)
+    restored = pickle.loads(pickle.dumps(fid))
+    np.testing.assert_array_equal(np.asarray(restored.real_sum), np.asarray(fid.real_sum))
+    assert restored.__dict__.get("_plain_stream_encoder") is None
+    assert restored.__dict__.get("_encoder_runtime") is None
+    # the restored metric keeps streaming (wrapper recreated lazily)
+    restored.update_stream([rng.rand(4, 3, 4, 4).astype(np.float32)], real=True)
+    assert int(restored.real_n) == 12
+
+
+def test_fid_axis_runtime_follows_states_to_a_new_mesh(mesh, monkeypatch, tmp_path):
+    from metrics_tpu.image.networks import inception as inet
+
+    monkeypatch.setattr(
+        inet, "load_inception_weights", lambda path: inet.random_inception_params(0)
+    )
+    inet.clear_inception_extractor_cache()
+    fid = FrechetInceptionDistance(
+        feature=64, weights_path=str(tmp_path / "w.npz"), encoder_sharding="mp"
+    )
+    fid.shard_states(mesh)
+    devs = jax.devices()
+    mesh2 = Mesh(np.array(devs[:4]).reshape(2, 2), ("dp", "mp"))
+    fid.shard_states(mesh2)
+    assert fid._encoder_runtime.mesh is mesh2
+    inet.clear_inception_extractor_cache()
+
+
+def test_fid_encoder_sharding_requires_int_feature_for_axis_spec():
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    with pytest.raises(MetricsUserError, match="built-in"):
+        FrechetInceptionDistance(
+            feature=_plain_extractor, feature_dim=FEAT_D, encoder_sharding="mp"
+        )
+
+
+def test_fid_int_feature_axis_spec_binds_inception_runtime(mesh, monkeypatch, tmp_path):
+    """encoder_sharding='mp' + feature=<int> wraps the built-in InceptionV3
+    through inception_param_specs and places it at shard_states(mesh)."""
+    from metrics_tpu.image.networks import inception as inet
+
+    monkeypatch.setattr(
+        inet, "load_inception_weights", lambda path: inet.random_inception_params(0)
+    )
+    inet.clear_inception_extractor_cache()
+    fid = FrechetInceptionDistance(
+        feature=64, weights_path=str(tmp_path / "w.npz"), encoder_sharding="mp"
+    )
+    assert fid._encoder_runtime is None  # awaiting mesh
+    fid.shard_states(mesh)
+    runtime = fid._encoder_runtime
+    assert runtime is not None and runtime.mesh is mesh
+    kernel = runtime.params["Conv2d_1a_3x3"]["kernel"]
+    per_dev = max(s.data.nbytes for s in kernel.addressable_shards)
+    assert kernel.nbytes / per_dev == 4.0  # O axis sharded 4-way over mp
+    # a second instance shares the memoized apply -> one program family
+    fid2 = FrechetInceptionDistance(
+        feature=64, weights_path=str(tmp_path / "w.npz"), encoder_sharding="mp"
+    )
+    fid2.shard_states(mesh)
+    assert fid2._encoder_runtime._apply is runtime._apply
+    assert fid2._encoder_runtime._program_key()[0] == runtime._program_key()[0]
+    inet.clear_inception_extractor_cache()
+
+
+# ---------------------------------------------------------------------------
+# memoized extractor resolution (satellite fix)
+# ---------------------------------------------------------------------------
+def test_resolve_inception_extractor_memoized(monkeypatch, tmp_path):
+    from metrics_tpu.image.networks import inception as inet
+
+    loads = []
+
+    def fake_load(path):
+        loads.append(path)
+        return inet.random_inception_params(0)
+
+    monkeypatch.setattr(inet, "load_inception_weights", fake_load)
+    inet.clear_inception_extractor_cache()
+    path = str(tmp_path / "weights.npz")
+    a = inet.resolve_inception_extractor(64, path)
+    b = inet.resolve_inception_extractor(64, path)
+    assert a is b
+    assert len(loads) == 1  # one disk read + conversion, not one per metric
+    # a different tap at the same path is its own entry
+    c = inet.resolve_inception_extractor(192, path)
+    assert c is not a and len(loads) == 2
+    inet.clear_inception_extractor_cache()
+    d = inet.resolve_inception_extractor(64, path)
+    assert d is not a and len(loads) == 3
+    inet.clear_inception_extractor_cache()

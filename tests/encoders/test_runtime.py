@@ -1,0 +1,220 @@
+"""ShardedEncoder runtime: placement, dispatch, program sharing, warmup.
+
+All mesh cases run on the 8-virtual-device CPU lane as a (2, 4) dp×mp mesh
+(the same layout the sharded-states suite uses).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import ShardedEncoder, engine, obs
+from metrics_tpu.encoders import encoder_stats, reset_encoder_stats
+
+VOCAB, DIM = 64, 16
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    engine.clear_cache()
+    reset_encoder_stats()
+    yield
+    engine.clear_cache()
+    reset_encoder_stats()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    assert len(devs) >= 8
+    return Mesh(np.array(devs[:8]).reshape(2, 4), ("dp", "mp"))
+
+
+def _apply(params, ids, mask):
+    return params["table"][ids] * mask[..., None]
+
+
+def _table(seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).normal(size=(VOCAB, DIM)).astype(np.float32)
+    )
+
+
+def _enc(mesh=None, **kw):
+    kw.setdefault("param_specs", {"table": P("mp", None)} if mesh is not None else None)
+    kw.setdefault("in_specs", P("dp") if mesh is not None else None)
+    kw.setdefault("out_spec", P("dp") if mesh is not None else None)
+    return ShardedEncoder(_apply, {"table": _table()}, mesh=mesh, name="toy", **kw)
+
+
+def _batch(rng, n=8, length=5):
+    return (
+        rng.randint(0, VOCAB, size=(n, length)),
+        np.ones((n, length), np.int32),
+    )
+
+
+def test_unsharded_dispatch_matches_direct_apply():
+    enc = _enc()
+    ids, mask = _batch(np.random.RandomState(0))
+    out = enc(ids, mask)
+    ref = _apply({"table": _table()}, jnp.asarray(ids), jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_sharded_dispatch_bit_identical_and_params_resident(mesh):
+    enc = _enc(mesh)
+    ids, mask = _batch(np.random.RandomState(1))
+    out = enc(ids, mask)
+    ref = _apply({"table": _table()}, jnp.asarray(ids), jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # weights were placed once, sharded 4-way over mp
+    table = enc.params["table"]
+    per_dev = max(s.data.nbytes for s in table.addressable_shards)
+    assert table.nbytes / per_dev == 4.0
+    stats = encoder_stats()
+    assert stats["placements"] == 1
+    assert stats["encoders"]["toy"]["params_bytes_per_device"] < stats["encoders"]["toy"]["params_bytes_total"]
+
+
+def test_zero_extra_compiles_on_repeats_and_same_identity(mesh):
+    enc = _enc(mesh)
+    ids, mask = _batch(np.random.RandomState(2))
+    enc(ids, mask)
+    first = dict(enc.compile_stats())
+    assert first["compiles"] == 1
+    for _ in range(3):
+        enc(ids, mask)
+    after = enc.compile_stats()
+    assert after["compiles"] == first["compiles"]
+    assert after["cache_hits"] == first["cache_hits"] + 3
+    # a second encoder with the SAME identity (apply, avals, specs, mesh)
+    # but different weight VALUES shares the compiled program family
+    enc2 = ShardedEncoder(
+        _apply,
+        {"table": _table(9)},
+        param_specs={"table": P("mp", None)},
+        mesh=mesh,
+        in_specs=P("dp"),
+        out_spec=P("dp"),
+        name="toy2",
+    )
+    enc2(ids, mask)
+    assert enc2.compile_stats()["compiles"] == 0
+    assert enc2.compile_stats()["cache_hits"] == 1
+    summary = engine.cache_summary()["by_kind"]["encode"]
+    assert summary["entries"] == 1
+
+
+def test_compile_events_carry_encode_entry_kind(mesh):
+    enc = _enc(mesh)
+    ids, mask = _batch(np.random.RandomState(3))
+    with obs.capture() as events:
+        enc(ids, mask)
+        enc(ids, mask)
+    kinds = [(e.kind, e.data.get("entry_kind")) for e in events]
+    assert ("compile", "encode") in kinds
+    assert ("cache_hit", "encode") in kinds
+    compile_events = [e for e in events if e.kind == "compile"]
+    assert compile_events[0].source == "toy"
+
+
+def test_param_spec_validation_rejects_bad_rank():
+    with pytest.raises(ValueError, match="names 3 dimensions"):
+        ShardedEncoder(
+            _apply, {"table": _table()}, param_specs={"table": P("mp", None, "dp")}
+        )
+
+
+def test_param_specs_callable_form(mesh):
+    enc = ShardedEncoder(
+        _apply,
+        {"table": _table()},
+        param_specs=lambda path, leaf: P("mp", None) if "table" in path else None,
+        mesh=mesh,
+        name="cb",
+    )
+    assert enc.params["table"].nbytes / max(
+        s.data.nbytes for s in enc.params["table"].addressable_shards
+    ) == 4.0
+
+
+def test_from_callable_wraps_closures():
+    table = _table()
+    fn = lambda ids, mask: table[ids] * mask[..., None]  # noqa: E731
+    enc = ShardedEncoder.from_callable(fn, name="closure")
+    ids, mask = _batch(np.random.RandomState(4))
+    np.testing.assert_array_equal(
+        np.asarray(enc(ids, mask)), np.asarray(fn(jnp.asarray(ids), jnp.asarray(mask)))
+    )
+    assert enc.batch_multiple() == 1
+
+
+def test_batch_multiple_reflects_dp_axis(mesh):
+    assert _enc(mesh).batch_multiple() == 2  # P('dp') over the 2-way axis
+    assert _enc().batch_multiple() == 1
+    enc = ShardedEncoder(
+        _apply, {"table": _table()}, in_specs=P(("dp", "mp")), mesh=mesh, name="prod"
+    )
+    assert enc.batch_multiple() == 8
+
+
+def test_deepcopy_shares_runtime(mesh):
+    import copy
+
+    enc = _enc(mesh)
+    assert copy.deepcopy(enc) is enc
+
+
+def test_warmup_manifest_round_trip_seeds_encode_entries(mesh):
+    import sys
+
+    wu = sys.modules["metrics_tpu.engine.warmup"]
+    wu.reset_warmup_state()
+    enc = _enc(mesh)
+    wu.record_manifest()
+    ids, mask = _batch(np.random.RandomState(5))
+    baseline = np.asarray(enc(ids, mask))
+    doc = wu.manifest_dict()
+    wu.stop_recording()
+    assert [e["kind"] for e in doc["entries"]] == ["encode"]
+
+    # simulated worker restart: fresh cache, fresh encoder object
+    engine.clear_cache()
+    wu.reset_warmup_state()
+    enc2 = _enc(mesh)
+    report = wu.warmup(doc, templates=[enc2])
+    assert report["programs_warmed"] == 1 and report["programs_failed"] == 0
+
+    out = np.asarray(enc2(ids, mask))
+    np.testing.assert_array_equal(out, baseline)
+    report = wu.warmup_report()
+    # the first covered request was served by the pre-seeded executable:
+    # no serve-time compile, no staleness
+    assert report["warmed_hits"] == 1
+    assert report["stale_total"] == 0
+    wu.reset_warmup_state()
+
+
+def test_warmup_stale_fires_on_uncovered_signature(mesh):
+    import sys
+
+    wu = sys.modules["metrics_tpu.engine.warmup"]
+    wu.reset_warmup_state()
+    enc = _enc(mesh)
+    wu.record_manifest()
+    ids, mask = _batch(np.random.RandomState(6))
+    enc(ids, mask)
+    doc = wu.manifest_dict()
+    wu.stop_recording()
+
+    engine.clear_cache()
+    wu.reset_warmup_state()
+    enc2 = _enc(mesh)
+    wu.warmup(doc, templates=[enc2])
+    with pytest.warns(RuntimeWarning, match="warmup manifest stale"):
+        enc2(*_batch(np.random.RandomState(7), n=4))  # a signature the manifest never promised
+    assert wu.warmup_report()["stale_total"] == 1
+    wu.reset_warmup_state()

@@ -1,0 +1,186 @@
+"""encode_stream: fused accumulation, ragged chunks, upstream screening."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import ShardedEncoder, engine, obs
+from metrics_tpu.encoders import encode_stream, encoder_stats, reset_encoder_stats
+from metrics_tpu.utils.exceptions import NumericalHealthError
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    engine.clear_cache()
+    reset_encoder_stats()
+    yield
+    engine.clear_cache()
+    reset_encoder_stats()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    assert len(devs) >= 8
+    return Mesh(np.array(devs[:8]).reshape(2, 4), ("dp", "mp"))
+
+
+def _apply(params, x):
+    return x @ params["w"]
+
+
+def _encoder(mesh=None):
+    w = jnp.asarray(np.random.RandomState(0).normal(size=(12, 8)).astype(np.float32))
+    kw = {}
+    if mesh is not None:
+        kw = dict(param_specs={"w": P(None, "mp")}, in_specs=P("dp"), out_spec=P(None, "mp"))
+    return ShardedEncoder(_apply, {"w": w}, mesh=mesh, name="mlp", **kw)
+
+
+def _sum_consumer(carry, feats, valid):
+    f = feats * valid[:, None]
+    return {"s": carry["s"] + jnp.sum(f, axis=0), "n": carry["n"] + valid.sum()}
+
+
+def _carry():
+    return {"s": jnp.zeros((8,), jnp.float32), "n": jnp.asarray(0.0, jnp.float32)}
+
+
+def _ref(batches):
+    w = np.random.RandomState(0).normal(size=(12, 8)).astype(np.float32)
+    total = np.zeros(8, np.float64)
+    n = 0
+    for b in batches:
+        total += (np.asarray(b, np.float64) @ w).sum(axis=0)
+        n += b.shape[0]
+    return total, n
+
+
+def test_stream_accumulates_exactly_with_ragged_final_chunk():
+    rng = np.random.RandomState(1)
+    batches = [rng.rand(16, 12).astype(np.float32) for _ in range(3)]
+    batches.append(rng.rand(5, 12).astype(np.float32))  # ragged tail -> pow2 pad 8
+    carry, result = encode_stream(_encoder(), batches, _sum_consumer, _carry())
+    assert result.chunks == 4 and result.rows == 53
+    ref_total, ref_n = _ref(batches)
+    assert float(carry["n"]) == ref_n
+    np.testing.assert_allclose(np.asarray(carry["s"]), ref_total, rtol=1e-5)
+    # the ragged chunk was pow2-bucketed, not a fresh program per raw size
+    assert encoder_stats()["bucketed_dispatches"] == 1
+
+
+def test_ragged_buckets_cap_program_count():
+    enc = _encoder()
+    rng = np.random.RandomState(2)
+    # many distinct ragged sizes inside one pow2 bucket -> ONE extra program
+    batches = [rng.rand(n, 12).astype(np.float32) for n in (16, 16, 9, 10, 11, 12, 13)]
+    encode_stream(enc, batches, _sum_consumer, _carry())
+    # programs: (16,12) and the 16-bucket reuses it -> exactly one compile
+    assert enc.compile_stats()["compiles"] == 1
+    assert engine.cache_summary()["by_kind"]["encode"]["compiles"] == 1
+
+
+def test_stream_on_sharded_mesh_matches_unsharded(mesh):
+    rng = np.random.RandomState(3)
+    batches = [rng.rand(16, 12).astype(np.float32) for _ in range(3)]
+    batches.append(rng.rand(3, 12).astype(np.float32))
+    carry_m, res_m = encode_stream(_encoder(mesh), batches, _sum_consumer, _carry())
+    carry_u, res_u = encode_stream(_encoder(), batches, _sum_consumer, _carry())
+    assert res_m.rows == res_u.rows
+    np.testing.assert_allclose(
+        np.asarray(carry_m["s"]), np.asarray(carry_u["s"]), rtol=1e-6
+    )
+    assert float(carry_m["n"]) == float(carry_u["n"])
+
+
+def test_stream_emits_encode_events():
+    rng = np.random.RandomState(4)
+    with obs.capture() as events:
+        encode_stream(
+            _encoder(), [rng.rand(8, 12).astype(np.float32)], _sum_consumer, _carry()
+        )
+    encode_events = [e for e in events if e.kind == "encode"]
+    assert len(encode_events) == 1
+    data = encode_events[0].data
+    assert data["rows"] == 8 and data["bucket"] == 8 and data["fused"] is True
+    assert data["encoder"] == "mlp"
+
+
+class _Screen:
+    """Duck-typed owner metric: just the policy attributes + health stats."""
+
+    def __init__(self, policy):
+        self.on_bad_input = policy
+        self.health_screen = "nonfinite"
+        self._health_stats = {"batches_screened": 0}
+
+
+def _contaminated_batches(rng):
+    clean = rng.rand(8, 12).astype(np.float32)
+    bad = rng.rand(8, 12).astype(np.float32)
+    bad[2, 3] = np.nan
+    bad[5, 0] = np.inf
+    return [clean, bad, clean.copy()]
+
+
+def test_skip_policy_quarantines_before_the_encoder():
+    calls = []
+
+    def apply_fn(params, x):
+        del params
+        calls.append(1)
+        return x
+
+    enc = ShardedEncoder(apply_fn, (), name="probe")
+    batches = _contaminated_batches(np.random.RandomState(5))
+    screen = _Screen("skip")
+    carry, result = encode_stream(
+        enc,
+        batches,
+        lambda c, f, v: {"n": c["n"] + v.sum()},
+        {"n": jnp.asarray(0.0)},
+        screen=screen,
+    )
+    assert result.batches_quarantined == 1
+    assert result.chunks == 2 and float(carry["n"]) == 16.0
+    # the contaminated batch never reached the forward: 1 trace for the
+    # first clean chunk, plus 1 cached dispatch for the second
+    assert screen._health_stats["batches_screened"] == 3
+    stats = encoder_stats()
+    assert stats["batches_quarantined"] == 1 and stats["rows_screened"] == 2
+
+
+def test_mask_policy_zeroes_rows_and_excludes_them():
+    enc = _encoder()
+    batches = _contaminated_batches(np.random.RandomState(6))
+    carry, result = encode_stream(
+        enc, batches, _sum_consumer, _carry(), screen=_Screen("mask")
+    )
+    assert result.rows_screened == 2 and result.batches_quarantined == 0
+    # 24 rows in, 2 masked out
+    assert float(carry["n"]) == 22.0
+    ref_total, _ = _ref([batches[0], np.delete(batches[1], (2, 5), axis=0), batches[2]])
+    np.testing.assert_allclose(np.asarray(carry["s"]), ref_total, rtol=1e-5)
+
+
+def test_raise_policy_raises_before_the_encoder():
+    calls = []
+
+    def apply_fn(params, x):
+        del params
+        calls.append(1)
+        return x
+
+    enc = ShardedEncoder(apply_fn, (), name="probe")
+    bad = np.full((4, 12), np.nan, np.float32)
+    with pytest.raises(NumericalHealthError, match="BEFORE the encoder"):
+        encode_stream(
+            enc,
+            [bad],
+            lambda c, f, v: c,
+            {"n": jnp.asarray(0.0)},
+            screen=_Screen("raise"),
+        )
+    assert not calls

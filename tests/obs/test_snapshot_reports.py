@@ -298,6 +298,7 @@ def test_jsonl_roundtrip_and_prometheus_render():
         "wire",
         "warmup",
         "sharding",
+        "encoders",
         "fleet",
         "bus",
         "spans",
@@ -316,6 +317,20 @@ def test_jsonl_roundtrip_and_prometheus_render():
         "mesh_changes",
         "specs",
         "resident",
+    }
+    from metrics_tpu import encoders as _encoders
+
+    assert process["encoders"] == _encoders.encoder_stats()
+    assert set(process["encoders"]) == {
+        "placements",
+        "encode_calls",
+        "fused_calls",
+        "stream_chunks",
+        "rows_encoded",
+        "rows_screened",
+        "batches_quarantined",
+        "bucketed_dispatches",
+        "encoders",
     }
     from metrics_tpu import fleet as _fleet
 
