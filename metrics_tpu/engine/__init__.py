@@ -60,9 +60,11 @@ _persist._maybe_enable_from_env()
 from metrics_tpu.engine.driver import (  # noqa: F401
     AsyncResult,
     DriveResult,
+    DriveSnapshot,
     async_compute,
     drive,
     fetch_stats,
+    load_drive_snapshot,
     reset_fetch_stats,
 )
 from metrics_tpu.engine import warmup as _warmup

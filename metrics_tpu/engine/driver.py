@@ -68,7 +68,16 @@ from metrics_tpu.resilience import health as _health
 
 Array = jax.Array
 
-__all__ = ["AsyncResult", "DriveResult", "async_compute", "drive", "fetch_stats", "reset_fetch_stats"]
+__all__ = [
+    "AsyncResult",
+    "DriveResult",
+    "DriveSnapshot",
+    "async_compute",
+    "drive",
+    "fetch_stats",
+    "load_drive_snapshot",
+    "reset_fetch_stats",
+]
 
 
 # ---------------------------------------------------------------------------
@@ -186,23 +195,235 @@ def async_compute(obj: Any) -> AsyncResult:
 class DriveResult:
     """What one :func:`drive` did: ``steps`` consumed, ``chunks`` dispatched
     (scan launches), the member keys driven through the fused scan
-    (``fused_keys``) vs the per-step path (``eager_keys``), and — when
-    ``compute_in_trace`` was requested — the epoch's computed ``values``."""
+    (``fused_keys``) vs the per-step path (``eager_keys``), — when
+    ``compute_in_trace`` was requested — the epoch's computed ``values``,
+    and ``snapshots`` sealed into the snapshot store (0 unless
+    ``snapshot_store=`` was passed)."""
 
-    __slots__ = ("steps", "chunks", "fused_keys", "eager_keys", "values")
+    __slots__ = ("steps", "chunks", "fused_keys", "eager_keys", "values", "snapshots")
 
-    def __init__(self, steps: int, chunks: int, fused_keys: Tuple[str, ...], eager_keys: Tuple[str, ...], values: Any) -> None:
+    def __init__(
+        self,
+        steps: int,
+        chunks: int,
+        fused_keys: Tuple[str, ...],
+        eager_keys: Tuple[str, ...],
+        values: Any,
+        snapshots: int = 0,
+    ) -> None:
         self.steps = steps
         self.chunks = chunks
         self.fused_keys = fused_keys
         self.eager_keys = eager_keys
         self.values = values
+        self.snapshots = snapshots
 
     def __repr__(self) -> str:
         return (
             f"DriveResult(steps={self.steps}, chunks={self.chunks},"
             f" fused_keys={self.fused_keys}, eager_keys={self.eager_keys})"
         )
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe epochs: periodic carry snapshots + resume
+# ---------------------------------------------------------------------------
+_SNAPSHOT_VERSION = 1
+_SNAP_SEP = "\x00"  # member-key/state-name separator in the flat payload
+
+
+class DriveSnapshot:
+    """One sealed mid-epoch carry: ``step`` scan steps completed, the fused
+    members' state trees at that boundary (``{member_key: {state:
+    ndarray}}``), and their update-learned dynamic attrs (``Accuracy.mode``
+    etc. — the same set the checkpoint encode ships). Written by
+    ``drive(snapshot_store=)``, read back by ``drive(resume_from=)`` /
+    :func:`load_drive_snapshot`."""
+
+    __slots__ = ("step", "states", "final", "dynamics")
+
+    def __init__(
+        self,
+        step: int,
+        states: Dict[str, Dict[str, Any]],
+        final: bool = False,
+        dynamics: Optional[Dict[str, Dict[str, Any]]] = None,
+    ) -> None:
+        self.step = int(step)
+        self.states = states
+        self.final = bool(final)
+        self.dynamics = dynamics or {}
+
+    def __repr__(self) -> str:
+        return (
+            f"DriveSnapshot(step={self.step}, members={sorted(self.states)},"
+            f" final={self.final})"
+        )
+
+
+def _snapshot_store_key(snapshot_key: str) -> str:
+    return f"drive/{snapshot_key}"
+
+
+def _seal_snapshot(
+    states: Dict[str, Dict[str, Any]],
+    step: int,
+    final: bool,
+    dynamics: Optional[Dict[str, Dict[str, Any]]] = None,
+) -> bytes:
+    """Seal a carry snapshot: JSON meta (step index, member keys, dynamic
+    attrs) + the flat state payload in the SAME sealed envelope
+    migration/spill payloads wear (``serving.store.encode_tenant_payload``
+    — always exact), so one codec covers every durable state byte in the
+    process."""
+    import json
+    import struct
+
+    from metrics_tpu.serving import store as _payload
+    from metrics_tpu.parallel import groups as _groups
+    from metrics_tpu.utils.checkpoint import _encode_dynamic
+
+    flat: Dict[str, Any] = {}
+    for member_key, state in states.items():
+        for name, value in state.items():
+            flat[f"{member_key}{_SNAP_SEP}{name}"] = value
+    inner = _payload.encode_tenant_payload(flat, precisions=None)
+    dyn = {
+        k: {a: _encode_dynamic(v) for a, v in attrs.items()}
+        for k, attrs in (dynamics or {}).items()
+        if attrs
+    }
+    meta = json.dumps(
+        {
+            "v": _SNAPSHOT_VERSION,
+            "step": int(step),
+            "final": bool(final),
+            "keys": sorted(states),
+            "dyn": dyn,
+        }
+    ).encode("utf-8")
+    return _groups.pack_envelope(struct.pack(">I", len(meta)) + meta + inner)
+
+
+def _unseal_snapshot(payload: bytes, context: str = "") -> DriveSnapshot:
+    import json
+    import struct
+
+    from metrics_tpu.serving import store as _payload
+    from metrics_tpu.parallel import groups as _groups
+    from metrics_tpu.utils.exceptions import SyncIntegrityError
+
+    _version, body = _groups.unpack_envelope(payload, context)
+    if len(body) < 4:
+        raise SyncIntegrityError(f"Truncated drive snapshot{context}.")
+    (meta_len,) = struct.unpack(">I", body[:4])
+    try:
+        meta = json.loads(body[4 : 4 + meta_len].decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as err:
+        raise SyncIntegrityError(f"Unparseable drive-snapshot meta{context}: {err}") from err
+    if meta.get("v") != _SNAPSHOT_VERSION:
+        raise SyncIntegrityError(
+            f"Drive snapshot version {meta.get('v')!r} unsupported{context};"
+            f" this build speaks v{_SNAPSHOT_VERSION}.",
+            transient=False,
+        )
+    flat = _payload.decode_tenant_payload(body[4 + meta_len :], context)
+    states: Dict[str, Dict[str, Any]] = {}
+    for flat_key, value in flat.items():
+        member_key, _, name = flat_key.partition(_SNAP_SEP)
+        states.setdefault(member_key, {})[name] = value
+    from metrics_tpu.utils.checkpoint import _decode_dynamic
+
+    dynamics = {
+        k: {a: _decode_dynamic(v) for a, v in attrs.items()}
+        for k, attrs in meta.get("dyn", {}).items()
+    }
+    return DriveSnapshot(
+        int(meta["step"]), states, final=bool(meta.get("final", False)), dynamics=dynamics
+    )
+
+
+def load_drive_snapshot(store: Any, snapshot_key: str = "drive") -> DriveSnapshot:
+    """Read the snapshot ``drive(snapshot_store=store, snapshot_key=...)``
+    last sealed — the handle ``drive(resume_from=)`` re-enters from."""
+    from metrics_tpu.serving import store as _spill
+
+    try:
+        payload = store.get(_snapshot_store_key(snapshot_key))
+    except KeyError:
+        raise KeyError(
+            f"no drive snapshot under key {snapshot_key!r} in {type(store).__name__};"
+            " was drive(snapshot_store=, snapshot_key=) ever run against this store?"
+        ) from None
+    _spill.bump("blob_reads")
+    return _unseal_snapshot(payload, context=f" (drive snapshot {snapshot_key!r})")
+
+
+class _SnapshotCtx:
+    """Deferred snapshot writer: each boundary's carry is copied (only when
+    the entry donates — the next dispatch would consume the buffers),
+    fetched asynchronously off the hot path (``AsyncResult`` — the PR-5
+    device→host plane), and sealed into the store one boundary LATER, so the
+    device never waits on durability I/O."""
+
+    def __init__(self, store: Any, every: Optional[int], key: str, source: str) -> None:
+        self.store = store
+        self.every = every
+        self.key = key
+        self.source = source
+        self.base_step = 0  # resume offset: steps completed before this call
+        self.donate = False
+        self.written = 0
+        self.last_snap_step = 0
+        # update-learned dynamic attrs per member, captured once after the
+        # python-init probe (fixed for the whole epoch) — sealed into every
+        # snapshot so a resumed (or completed-and-replayed) run can compute
+        # without re-deriving them from data it never saw
+        self.dynamics: Dict[str, Dict[str, Any]] = {}
+        self._pending: Optional[Tuple[AsyncResult, int, bool]] = None
+
+    def due(self, steps_done: int) -> bool:
+        return self.every is not None and steps_done - self.last_snap_step >= self.every
+
+    def stage(self, states: Dict[str, Dict[str, Any]], steps_done: int, final: bool) -> None:
+        """Queue the carry at ``steps_done`` (epoch-relative, resume offset
+        added here) for durable write; persists the PREVIOUS queued snapshot
+        so the write overlaps the device executing the next chunk."""
+        tree = states
+        if self.donate and not final:
+            # the next dispatch donates these exact buffers; snapshot a copy
+            tree = jax.tree_util.tree_map(jnp.copy, states)
+        handle = AsyncResult(tree, source=f"{self.source}:snapshot")
+        prev, self._pending = self._pending, (handle, self.base_step + steps_done, final)
+        self.last_snap_step = steps_done
+        if prev is not None:
+            self._write(prev)
+        if final:
+            self.flush()
+
+    def flush(self) -> None:
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._write(pending)
+
+    def _write(self, staged: Tuple[AsyncResult, int, bool]) -> None:
+        from metrics_tpu.serving import store as _spill
+
+        handle, step, final = staged
+        payload = _seal_snapshot(handle.result(), step, final, dynamics=self.dynamics)
+        self.store.put(_snapshot_store_key(self.key), payload)
+        self.written += 1
+        _spill.bump("snapshots")
+        _spill.bump("snapshot_bytes", len(payload))
+        if _bus.enabled():
+            _bus.emit(
+                "snapshot",
+                source=self.source,
+                key=self.key,
+                step=step,
+                bytes=len(payload),
+                final=final,
+            )
 
 
 def _members_of(obj: Any) -> Tuple[Tuple[str, ...], List[Any], bool]:
@@ -308,6 +529,10 @@ def drive(
     in_specs: Optional[Any] = None,
     steps_per_chunk: int = 16,
     hierarchical_sync: bool = False,
+    snapshot_store: Optional[Any] = None,
+    snapshot_every: Optional[int] = None,
+    snapshot_key: str = "drive",
+    resume_from: Optional[Any] = None,
 ) -> DriveResult:
     """Run one evaluation epoch through a device-resident scan program.
 
@@ -353,6 +578,30 @@ def drive(
         steps_per_chunk: streaming-mode super-step length ``K``. Larger K
             amortizes more dispatches per launch but delays the first launch
             by K host batches; see ``docs/performance.md``.
+        snapshot_store: a :class:`~metrics_tpu.serving.SpillStore` to seal
+            periodic carry snapshots into — the preemption-safe epoch. Each
+            snapshot is the fused members' exact states at a chunk boundary,
+            device-fetched asynchronously off the hot path (the PR-5 async
+            plane) and written one boundary later, plus a final end-of-epoch
+            snapshot. A stacked epoch is dispatched in ``snapshot_every``-
+            step slices through the SAME scan program family (bit-identical
+            to the single launch — same per-step op order). Local epochs
+            only (no ``mesh``/``axis_name``), every member scan-drivable.
+        snapshot_every: snapshot cadence in steps (boundaries are chunk
+            grained in streaming mode). ``None`` with ``snapshot_store``:
+            only the final end-of-epoch snapshot is written.
+        snapshot_key: the store key snapshots seal under (atomic overwrite —
+            the latest boundary wins; give concurrent epochs distinct keys).
+        resume_from: re-enter a died epoch: a ``SpillStore`` (the snapshot
+            under ``snapshot_key`` is loaded) or a
+            :class:`DriveSnapshot`. The members' states are bound to the
+            snapshot (update counts and screening telemetry included), the
+            first ``snapshot.step`` steps of ``batches`` are skipped, and
+            the remainder re-enters the SAME compiled program family — the
+            final states are bit-identical to an uninterrupted epoch, with
+            zero extra compiles when the original run's programs are cached
+            (same chunk geometry). Pass ``snapshot_store`` too to keep
+            snapshotting while resumed. See ``docs/durability.md``.
 
     Members whose contracts a scan cannot honor (list states, eager
     fallbacks, ``on_bad_input='raise'``, warn-on-removal / non-additive
@@ -364,12 +613,14 @@ def drive(
     source = type(obj).__name__
     if not _trace.active():
         return _drive_impl(
-            obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source, hierarchical_sync, in_specs
+            obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source, hierarchical_sync, in_specs,
+            snapshot_store, snapshot_every, snapshot_key, resume_from,
         )
     _keys, _members, _ = _members_of(obj)
     with _trace.span("drive", source, payload=lambda: [m._snapshot_state() for m in _members]):
         return _drive_impl(
-            obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source, hierarchical_sync, in_specs
+            obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source, hierarchical_sync, in_specs,
+            snapshot_store, snapshot_every, snapshot_key, resume_from,
         )
 
 
@@ -383,6 +634,10 @@ def _drive_impl(
     source: str,
     hierarchical_sync: bool = False,
     in_specs: Optional[Any] = None,
+    snapshot_store: Optional[Any] = None,
+    snapshot_every: Optional[int] = None,
+    snapshot_key: str = "drive",
+    resume_from: Optional[Any] = None,
 ) -> DriveResult:
     from metrics_tpu.metric import _JIT_FALLBACK_ERRORS
     from metrics_tpu.parallel import comm
@@ -423,6 +678,24 @@ def _drive_impl(
     if isinstance(axis_name, (tuple, list)):
         axis_name = tuple(axis_name)
 
+    snap_ctx: Optional[_SnapshotCtx] = None
+    resume: Optional[DriveSnapshot] = None
+    if snapshot_store is not None or resume_from is not None:
+        if mesh is not None or axis_name is not None:
+            raise ValueError(
+                "drive snapshots/resume (snapshot_store=/resume_from=) cover"
+                " the LOCAL epoch path; mesh/axis_name epochs keep their own"
+                " sync semantics — checkpoint the members instead"
+                " (utils.checkpoint) or drive locally."
+            )
+        if snapshot_every is not None and snapshot_every < 1:
+            raise ValueError(f"snapshot_every must be >= 1 (or None), got {snapshot_every}")
+        resume = _resolve_resume(resume_from, snapshot_key)
+        if snapshot_store is not None:
+            snap_ctx = _SnapshotCtx(snapshot_store, snapshot_every, snapshot_key, source)
+            if resume is not None:
+                snap_ctx.base_step = resume.step
+
     keys, members, is_collection = _members_of(obj)
     if mesh is None and any(m._drive_synced for m in members):
         from metrics_tpu.utils.exceptions import MetricsUserError
@@ -459,21 +732,76 @@ def _drive_impl(
             continue
         fused.append((k, m))
 
+    if (snap_ctx is not None or resume is not None) and eager:
+        _raise_not_snapshotable(tuple(k for k, _ in eager))
+
     # -- normalize the epoch into per-step args / stacked leaves --------
     if stacked is not None:
         args_tree, n_steps = stacked
+        if resume is not None:
+            if resume.step > n_steps:
+                from metrics_tpu.utils.exceptions import MetricsUserError
+
+                raise MetricsUserError(
+                    f"drive(resume_from=): the snapshot was taken at step"
+                    f" {resume.step} but the epoch holds only {n_steps} steps"
+                    " — resume must replay the SAME epoch the snapshot"
+                    " interrupted."
+                )
+            args_tree = tuple(jax.tree_util.tree_map(lambda a: a[resume.step :], args_tree))
+            n_steps -= resume.step
         if n_steps == 0:
+            if resume is not None:
+                # the snapshot already covers the whole epoch (a resume of a
+                # COMPLETED run — idempotent): bind and report
+                _bind_resume(fused, resume, source)
+                return DriveResult(
+                    0, 0, tuple(k for k, _ in fused), (), _host_values(obj, compute_in_trace)
+                )
             # an empty shard still reports like any other epoch: values
-            # reflect whatever state the members already hold
-            return DriveResult(0, 0, (), tuple(k for k, _ in eager), _host_values(obj, compute_in_trace))
+            # reflect whatever state the members already hold — and it still
+            # seals its final snapshot, so a uniform restart script's
+            # drive(resume_from=) finds an idempotent completed-run snapshot
+            # instead of a KeyError on the one worker whose shard was empty
+            if snap_ctx is not None:
+                snap_ctx.stage({k: m._snapshot_state() for k, m in fused}, 0, final=True)
+            return DriveResult(
+                0, 0, (), tuple(k for k, _ in eager),
+                _host_values(obj, compute_in_trace),
+                snapshots=snap_ctx.written if snap_ctx is not None else 0,
+            )
         step0 = tuple(jax.tree_util.tree_map(lambda a: a[0], args_tree))
         leaves, treedef = jax.tree_util.tree_flatten((step0, {}))
         stacked_leaves, _ = jax.tree_util.tree_flatten((args_tree, {}))
     else:
         step_iter = _steps_iter(batches)
+        if resume is not None:
+            for skipped in range(resume.step):
+                if next(step_iter, None) is None:
+                    from metrics_tpu.utils.exceptions import MetricsUserError
+
+                    raise MetricsUserError(
+                        f"drive(resume_from=): the stream ended after"
+                        f" {skipped} steps but the snapshot was taken at step"
+                        f" {resume.step} — resume must replay the SAME epoch"
+                        " the snapshot interrupted."
+                    )
         step0 = next(iter(step_iter), None)
         if step0 is None:
-            return DriveResult(0, 0, (), tuple(k for k, _ in eager), _host_values(obj, compute_in_trace))
+            if resume is not None:
+                _bind_resume(fused, resume, source)
+                return DriveResult(
+                    0, 0, tuple(k for k, _ in fused), (), _host_values(obj, compute_in_trace)
+                )
+            # empty stream: seal the final snapshot anyway (see the stacked
+            # empty-epoch branch) so resume_from= stays a uniform no-op
+            if snap_ctx is not None:
+                snap_ctx.stage({k: m._snapshot_state() for k, m in fused}, 0, final=True)
+            return DriveResult(
+                0, 0, (), tuple(k for k, _ in eager),
+                _host_values(obj, compute_in_trace),
+                snapshots=snap_ctx.written if snap_ctx is not None else 0,
+            )
         leaves, treedef = jax.tree_util.tree_flatten((step0, {}))
 
     # python-init probe every fused member against the first step (side
@@ -488,6 +816,12 @@ def _drive_impl(
             continue
         still_fused.append((k, m))
     fused = still_fused
+    if (snap_ctx is not None or resume is not None) and len(fused) < len(keys):
+        _raise_not_snapshotable(tuple(k for k, _ in eager))
+    if resume is not None:
+        # bind the snapshot's states as the epoch baseline BEFORE snapshots
+        # are taken below: the resumed scan continues the interrupted carry
+        _bind_resume(fused, resume, source)
 
     fused_keys = tuple(k for k, _ in fused)
     fused_members = [m for _, m in fused]
@@ -588,6 +922,12 @@ def _drive_impl(
         states: Dict[str, Any] = snapshots
         if entry.donate:
             states = {k: _cache.guard_donated_state(m, snapshots[k]) for k, m in fused}
+        if snap_ctx is not None:
+            snap_ctx.donate = entry.donate
+            snap_ctx.dynamics = {
+                k: {a: getattr(m, a) for a in getattr(m, "_dynamic_state_attrs", ())}
+                for k, m in fused
+            }
         if gspmd:
             # lay the carry out per the registered specs BEFORE the launch
             # (reshard telemetry + the program starts from resident shards
@@ -641,10 +981,37 @@ def _drive_impl(
                         ]
                         pads = [0] * steps + [batch] * rem
                         steps += rem
-                out = _dispatch(states, chunk_leaves, pads, True)
-                n_chunks = 1
-                n_steps_total = n_steps
+                if snap_ctx is not None and snap_ctx.every is not None and snap_ctx.every < steps:
+                    # preemption-safe stacked epoch: dispatch in snapshot_every-
+                    # step slices through the same scan family (identical
+                    # per-step op order — bit-identical to the one-launch
+                    # epoch), sealing the carry at each boundary
+                    every = snap_ctx.every
+                    out = states
+                    pos = 0
+                    while pos < steps:
+                        span = min(every, steps - pos)
+                        slice_leaves = [x[pos : pos + span] for x in chunk_leaves]
+                        last = pos + span >= steps
+                        out = _dispatch(_states_only(out), slice_leaves, None, last)
+                        n_chunks += 1
+                        pos += span
+                        if not last:
+                            snap_ctx.stage(_states_only(out), pos, final=False)
+                    n_steps_total = n_steps
+                else:
+                    out = _dispatch(states, chunk_leaves, pads, True)
+                    n_chunks = 1
+                    n_steps_total = n_steps
             else:
+                on_chunk = None
+                if snap_ctx is not None:
+                    ctx = snap_ctx
+
+                    def on_chunk(out_value: Any, steps_done: int) -> None:
+                        if ctx.due(steps_done):
+                            ctx.stage(_states_only(out_value), steps_done, final=False)
+
                 out, n_steps_total, n_chunks, tail_steps = _stream_chunks(
                     _dispatch,
                     states,
@@ -656,6 +1023,7 @@ def _drive_impl(
                     steps_per_chunk,
                     eager,
                     defer_last=bool(compute_keys),
+                    on_chunk=on_chunk,
                 )
                 # per-step tail: steps the scan could not absorb (shape
                 # change without additivity) — driven through the members'
@@ -739,6 +1107,14 @@ def _drive_impl(
                     obj._drive_synced = True
         # (out is None: the tail path above already bound the scanned states
         # and counted/screened both scan and tail steps)
+        if snap_ctx is not None:
+            # the end-of-epoch snapshot comes from the BOUND member states —
+            # it covers per-step tail updates and the in-trace-compute park
+            # path too, and makes resume-from-a-completed-run an idempotent
+            # no-op replay
+            snap_ctx.stage(
+                {k: m._snapshot_state() for k, m in fused}, n_steps_total, final=True
+            )
     # -- per-step members over a stacked epoch --------------------------
     if stacked is not None and eager:
         for i in range(n_steps):
@@ -765,13 +1141,127 @@ def _drive_impl(
                     if _health.health_enabled(m):
                         _health.check_compute_result(m, value)
         values = _host_values(obj, True)
-    return DriveResult(n_steps_total, n_chunks, fused_keys, eager_keys, values)
+    return DriveResult(
+        n_steps_total,
+        n_chunks,
+        fused_keys,
+        eager_keys,
+        values,
+        snapshots=snap_ctx.written if snap_ctx is not None else 0,
+    )
 
 
 def _chain_first(first: Tuple[Any, ...], rest: Any):
     yield first
     for item in rest:
         yield item
+
+
+def _states_only(value: Any) -> Dict[str, Any]:
+    """The states half of a dispatch output (a ``*_cmp`` variant returns
+    ``(states, values)``)."""
+    return value[0] if isinstance(value, tuple) else value
+
+
+def _raise_not_snapshotable(eager_keys: Tuple[str, ...]) -> None:
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    raise MetricsUserError(
+        "drive snapshots/resume (snapshot_store=/resume_from=) need every"
+        " member scan-drivable: the snapshot IS the scan carry, and an"
+        " eager-fallback/list-state/'raise'-policy member's state never"
+        f" rides it; offending members: {sorted(set(eager_keys))}. Drive"
+        " them in a separate plain drive(), or checkpoint them with"
+        " utils.checkpoint."
+    )
+
+
+def _match_weak_type(arr: Array, default: Any) -> Array:
+    """Give a decoded snapshot leaf the registered default's ``weak_type``
+    (same-dtype only): serialization strips weakness, but the scan carry the
+    snapshot captured was traced with it — aval parity is what makes resume
+    a pure cache hit."""
+    weak = getattr(default, "weak_type", False)
+    if bool(getattr(arr, "weak_type", False)) == bool(weak):
+        return arr
+    if jnp.result_type(default) != arr.dtype:
+        return arr
+    try:
+        from jax._src.lax import lax as _lax_internal
+
+        return _lax_internal._convert_element_type(arr, arr.dtype, weak_type=bool(weak))
+    except Exception:  # noqa: BLE001 — a retrace beats a hard failure
+        return arr
+
+
+def _resolve_resume(resume_from: Any, snapshot_key: str) -> Optional[DriveSnapshot]:
+    if resume_from is None:
+        return None
+    if isinstance(resume_from, DriveSnapshot):
+        return resume_from
+    return load_drive_snapshot(resume_from, snapshot_key)
+
+
+def _bind_resume(fused: List[Tuple[str, Any]], resume: DriveSnapshot, source: str) -> None:
+    """Bind a :class:`DriveSnapshot` as the epoch baseline: validated state
+    restore per member (names, shapes, dtype kinds against the registered
+    defaults — the checkpoint-restore contract), update counts and screening
+    telemetry advanced by the snapshot's step index."""
+    from metrics_tpu.serving import store as _spill
+    from metrics_tpu.utils.checkpoint import dtype_kind
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    keys = tuple(k for k, _ in fused)
+    if set(keys) != set(resume.states):
+        raise MetricsUserError(
+            f"drive(resume_from=): the snapshot covers members"
+            f" {sorted(resume.states)} but this drive fuses {sorted(keys)} —"
+            " resume needs the same metric/collection composition the"
+            " snapshot was taken from."
+        )
+    for k, m in fused:
+        cls = type(m).__name__
+        state = resume.states[k]
+        if set(state) != set(m._defaults):
+            raise MetricsUserError(
+                f"drive(resume_from=): member {k!r} ({cls}) registers states"
+                f" {sorted(m._defaults)} but the snapshot holds"
+                f" {sorted(state)} — different class or config?"
+            )
+        restored: Dict[str, Any] = {}
+        for name, value in state.items():
+            default = m._defaults[name]
+            arr = jnp.asarray(value)
+            if tuple(arr.shape) != tuple(jnp.shape(default)):
+                raise MetricsUserError(
+                    f"drive(resume_from=): state {name!r} of {cls} has"
+                    f" registered shape {tuple(jnp.shape(default))} but the"
+                    f" snapshot holds {tuple(arr.shape)} — different config"
+                    " (e.g. another num_classes)?"
+                )
+            if dtype_kind(arr.dtype) != dtype_kind(jnp.result_type(default)):
+                raise MetricsUserError(
+                    f"drive(resume_from=): state {name!r} of {cls} is"
+                    f" registered as {dtype_kind(jnp.result_type(default))}"
+                    f" but the snapshot holds {dtype_kind(arr.dtype)}."
+                )
+            # restore VERBATIM (incl. the promoted dtype — a weak-typed
+            # default that updates settled to float32 must not be
+            # re-widened), but re-attach the default leaf's weak_type when
+            # the width matches: the interrupted run's carry kept the fresh
+            # state's weakness through the scan, and a strong-typed resume
+            # carry would retrace the cached program for nothing
+            restored[name] = _match_weak_type(arr, default)
+        m._restore_state(restored)
+        for attr, value in resume.dynamics.get(k, {}).items():
+            setattr(m, attr, value)
+        m._update_count += resume.step
+        m._computed = None
+        if _health.health_enabled(m):
+            m._health_stats["batches_screened"] += resume.step
+    _spill.bump("resumes")
+    if _bus.enabled():
+        _bus.emit("recover", source=source, scope="drive", step=resume.step, final=resume.final)
 
 
 def _bind_states(fused: List[Tuple[str, Any]], states_out: Dict[str, Any], n_steps: int) -> None:
@@ -807,6 +1297,7 @@ def _stream_chunks(
     steps_per_chunk: int,
     eager: List[Tuple[str, Any]],
     defer_last: bool = False,
+    on_chunk: Optional[Any] = None,
 ):
     """Chunked streaming with host→device prefetch: stack K same-shape steps
     into a ``[K, batch]`` super-step, stage it host→device, and dispatch it
@@ -818,6 +1309,12 @@ def _stream_chunks(
     and dispatched through the ``*_cmp`` variant — at the cost of the first
     launch waiting for 2K host batches instead of K.
 
+    ``on_chunk(out, steps_done)`` (the drive-snapshot hook) is called after
+    each dispatched chunk whose carry exactly reflects the first
+    ``steps_done`` stream items — i.e. only while no tail step has been
+    consumed yet (a tail step's update is applied host-side AFTER the scan,
+    so later carries are no longer a prefix-exact resume point).
+
     Returns ``(out, n_steps, n_chunks, tail_steps)`` where ``out`` is the
     final program output (carrying the compute values when the last chunk
     used a ``*_cmp`` variant) and ``tail_steps`` are per-step args the scan
@@ -827,10 +1324,12 @@ def _stream_chunks(
     chunk_leaves0: Optional[List[Any]] = None
     chunk_steps: List[List[Any]] = []
     chunk_pads: List[int] = []
-    pending: Optional[Tuple[List[Any], Optional[List[int]]]] = None
+    chunk_real = 0  # real stream items in chunk_steps (synthetic fills excluded)
+    pending: Optional[Tuple[List[Any], Optional[List[int]], int]] = None
     tail_steps: List[Tuple[Any, ...]] = []
     n_steps = 0
     n_chunks = 0
+    dispatched_steps = 0  # real steps reflected in the dispatched carry
     family_full_chunks = 0  # full [K, batch] chunks staged for the CURRENT sig
     out: Any = states
 
@@ -843,25 +1342,34 @@ def _stream_chunks(
             stacked = [jnp.stack([jnp.asarray(x) for x in col]) for col in cols]
         return stacked, (pads if any(pads) else None)
 
+    def _note_chunk(last: bool) -> None:
+        if on_chunk is not None and not last and not tail_steps:
+            on_chunk(out, dispatched_steps)
+
     def _flush(last: bool, cmp: Optional[bool] = None):
-        nonlocal pending, out, n_chunks, chunk_steps, chunk_pads
+        nonlocal pending, out, n_chunks, chunk_steps, chunk_pads, chunk_real, dispatched_steps
         if chunk_steps:
-            staged = _stage(chunk_steps, chunk_pads)
-            chunk_steps, chunk_pads = [], []
+            staged = _stage(chunk_steps, chunk_pads) + (chunk_real,)
+            chunk_steps, chunk_pads, chunk_real = [], [], 0
             if not defer_last:
                 # no *_cmp variant to select on the last chunk: dispatch as
                 # soon as staged (jax dispatch is async — the device starts
                 # on this chunk while the host prepares the next)
                 out = dispatch(_states_of(out), staged[0], staged[1], False)
                 n_chunks += 1
+                dispatched_steps += staged[2]
+                _note_chunk(last)
             else:
                 if pending is not None:
                     out = dispatch(_states_of(out), pending[0], pending[1], False)
                     n_chunks += 1
+                    dispatched_steps += pending[2]
+                    _note_chunk(last)
                 pending = staged
         if last and pending is not None:
             out = dispatch(_states_of(out), pending[0], pending[1], last if cmp is None else cmp)
             n_chunks += 1
+            dispatched_steps += pending[2]
             pending = None
 
     def _states_of(value):
@@ -886,6 +1394,7 @@ def _stream_chunks(
                 padded, pad = folded
                 chunk_steps.append(padded)
                 chunk_pads.append(pad)
+                chunk_real += 1
                 n_steps += 1
                 if len(chunk_steps) >= steps_per_chunk:
                     family_full_chunks += 1
@@ -901,6 +1410,7 @@ def _stream_chunks(
             chunk_leaves0 = list(leaves)
         chunk_steps.append(list(leaves))
         chunk_pads.append(0)
+        chunk_real += 1
         n_steps += 1
         if len(chunk_steps) >= steps_per_chunk:
             family_full_chunks += 1

@@ -36,16 +36,18 @@ coordination service — and, under ``simulated_world`` /
 straggling migration payloads exercise exactly the failure modes the sync
 wire already handles).
 """
-import json
-import struct
 import threading
 import time
 from typing import Any, Dict, Hashable, List, Optional
 
-import numpy as np
-
 from metrics_tpu.parallel import groups as _groups
-from metrics_tpu.utils.exceptions import MetricsUserError, SyncIntegrityError
+# the tenant-payload codec lives with the rest of the durable-plane storage
+# layer (one home for the bytes migration/spill/restore/snapshot share);
+# re-exported here because the fleet is its historical public face
+from metrics_tpu.serving.store import (  # noqa: F401  (re-export)
+    decode_tenant_payload,
+    encode_tenant_payload,
+)
 
 __all__ = [
     "KVLedger",
@@ -55,90 +57,20 @@ __all__ = [
     "decode_tenant_payload",
     "encode_tenant_payload",
     "ledger_key",
+    "reencode_payload",
 ]
 
-_PAYLOAD_VERSION = 1
 _KEY_PREFIX = "mtpu-fleet"
 
 
-# ---------------------------------------------------------------------------
-# wire codec: one checkpoint tree <-> one sealed payload
-# ---------------------------------------------------------------------------
-def encode_tenant_payload(
-    tree: Dict[str, Any],
-    precisions: Optional[Dict[str, str]] = None,
-    stats: Optional[Dict[str, Any]] = None,
-) -> bytes:
-    """Seal one checkpoint tree (``metric_state_pytree`` output) as a
-    self-describing migration payload.
-
-    Layout: the usual versioned crc32 envelope around a JSON key manifest
-    plus one length-framed block per leaf, each block being a full PR-8 wire
-    payload (``_encode`` — exact v1 bytes, or quantized v2 when the leaf's
-    state carries a ``sync_precision`` tag). Self-describing on purpose: the
-    receiver reconstructs the tree from the payload alone, so sender and
-    receiver never need to agree on a treedef out of band (the checkpoint
-    validator still enforces the template contract at admission).
-    """
-    keys = sorted(tree)
-    blocks: List[bytes] = []
-    for key in keys:
-        value = tree[key]
-        if isinstance(value, dict):
-            raise MetricsUserError(
-                f"migration payloads cannot carry list ('cat' buffer) state"
-                f" {key!r} — banks reject list-state templates, so a banked"
-                " tenant never holds one. Migrate such metrics by checkpoint"
-                " file instead."
-            )
-        tag = (precisions or {}).get(key)
-        blocks.append(_groups._encode(np.asarray(value), tag, stats=stats))
-    header = json.dumps({"v": _PAYLOAD_VERSION, "keys": keys}).encode()
-    body = struct.pack(">I", len(header)) + header
-    body += b"".join(struct.pack(">Q", len(b)) + b for b in blocks)
-    return _groups.pack_envelope(body)
-
-
-def decode_tenant_payload(payload: bytes, context: str = "") -> Dict[str, Any]:
-    """Inverse of :func:`encode_tenant_payload`; every leaf re-verifies its
-    own wire envelope, so corruption anywhere in the payload raises
-    :class:`SyncIntegrityError` naming the migration context."""
-    _version, body = _groups.unpack_envelope(payload, context)
-    if len(body) < 4:
-        raise SyncIntegrityError(f"Truncated migration payload: no header length{context}.")
-    (header_len,) = struct.unpack(">I", body[:4])
-    if 4 + header_len > len(body):
-        raise SyncIntegrityError(
-            f"Truncated migration payload{context}: header claims {header_len}"
-            f" bytes, only {len(body) - 4} present."
-        )
-    try:
-        header = json.loads(body[4 : 4 + header_len].decode())
-        keys = list(header["keys"])
-        version = header["v"]
-    except (ValueError, KeyError, UnicodeDecodeError) as err:
-        raise SyncIntegrityError(f"Unparseable migration payload header{context}: {err}") from err
-    if version != _PAYLOAD_VERSION:
-        raise SyncIntegrityError(
-            f"Migration payload version {version!r} unsupported{context};"
-            f" this build speaks v{_PAYLOAD_VERSION}.",
-            transient=False,
-        )
-    offset = 4 + header_len
-    tree: Dict[str, Any] = {}
-    for key in keys:
-        if offset + 8 > len(body):
-            raise SyncIntegrityError(f"Truncated migration payload at block {key!r}{context}.")
-        (size,) = struct.unpack(">Q", body[offset : offset + 8])
-        offset += 8
-        if offset + size > len(body):
-            raise SyncIntegrityError(
-                f"Truncated migration payload{context}: block {key!r} declares"
-                f" {size} bytes, only {len(body) - offset} remain."
-            )
-        tree[key] = _groups._decode(body[offset : offset + size], context)
-        offset += size
-    return tree
+def reencode_payload(payload: bytes, precisions: Optional[Dict[str, str]]) -> bytes:
+    """Re-seal a durable payload with wire-codec ``precisions`` tags — the
+    ONE lossy-handoff route (graceful leave and crash recovery must produce
+    the same bytes when ``migration_precisions`` is opted into). Falsy
+    ``precisions`` returns the payload untouched."""
+    if not precisions:
+        return payload
+    return encode_tenant_payload(decode_tenant_payload(payload), precisions)
 
 
 def admit_payload(bank: Any, tenant: Hashable, payload: bytes, context: str = "") -> int:

@@ -17,22 +17,29 @@ which signature group is deadline-flushing on which worker.
 
 Failure story (exercised by ``tests/fleet`` under the PR-2 harness):
 
-* **graceful leave** — drain, migrate out, decommission; bit-identical to
+* **graceful leave** — drain, migrate out through the spill store (the same
+  export route a crash recovery reads), decommission; bit-identical to
   never having had the worker.
-* **kill** — the worker stops serving without cooperation. Its bank's
-  device/host state stands in for the durable spill tier a production
-  deployment would run under the bank (ROADMAP: orbax/disk spill): recovery
-  checkpoint-encodes every session out of the dead worker's bank, publishes
-  each payload to the migration ledger, re-admits on the surviving
-  rendezvous owners, and re-submits the dead router's un-flushed requests —
-  so the full request stream is applied exactly once and final values are
-  bit-identical to a static fleet.
-* **mid-migration kill** — a ``METRICS_TPU_FAULTS`` plan entry of kind
-  ``'kill'`` (``rank`` = integer worker id, ``epoch`` = fleet epoch version)
-  fells the *destination* the moment it is asked to admit: the payload is
-  still in the ledger (published before the source forgot the tenant), so
-  the fleet re-routes to the next surviving owner with the pre-drain state
-  intact.
+* **kill** — the worker stops serving without cooperation. Recovery reads
+  the worker's SPILL STORE (``MetricBank`` journal + sealed blobs — see
+  ``serving/store.py``), never the dead bank's Python object: every acked
+  session's payload is published to the migration ledger, re-admitted on
+  the surviving rendezvous owners, and the dead router's un-flushed
+  requests are re-submitted — with the fleet's default checkpoint cadence
+  of 1, the full request stream is applied exactly once and final values
+  are bit-identical to a static fleet.
+* **die** — a whole-process crash: the worker's bank and router objects are
+  gone (no graceful export, no request re-submission). Recovery must come
+  entirely from the durable tier — acked state (checkpointed into the
+  store) is restored bit-identically; requests that never reached a
+  checkpoint are lost, which is exactly the durability contract a
+  ``DiskStore`` + ``checkpoint_every_n_flushes=1`` makes empty.
+* **mid-migration kill/die** — a ``METRICS_TPU_FAULTS`` plan entry of kind
+  ``'kill'`` or ``'die'`` (``rank`` = integer worker id, ``epoch`` = fleet
+  epoch version) fells the *destination* the moment it is asked to admit:
+  the payload is still in the ledger (published before the source forgot
+  the tenant), so the fleet re-routes to the next surviving owner with the
+  pre-drain state intact.
 """
 import itertools
 import threading
@@ -43,6 +50,7 @@ from metrics_tpu.fleet import migrate as _migrate
 from metrics_tpu.fleet import placement as _placement
 from metrics_tpu.fleet.placement import FleetEpoch
 from metrics_tpu.obs import bus as _bus
+from metrics_tpu.serving import store as _store
 from metrics_tpu.utils.exceptions import MetricsUserError
 
 __all__ = ["Fleet", "FleetRouter", "Worker", "all_fleets", "fleet_summary"]
@@ -82,13 +90,27 @@ class Worker:
         bank_name: Optional[str] = None,
         max_requests: Optional[int] = None,
         max_delay_s: Optional[float] = 0.05,
+        spill_store: Optional[Any] = None,
+        checkpoint_every_n_flushes: Optional[int] = 1,
     ) -> None:
         from metrics_tpu.serving import MetricBank, RequestRouter
 
         self.worker_id = worker_id
         self.alive = True
-        self.bank = MetricBank(template, capacity, name=bank_name or f"fleet:{worker_id}")
-        self.router = RequestRouter(self.bank, max_requests=max_requests, max_delay_s=max_delay_s)
+        self.bank: Optional[MetricBank] = MetricBank(
+            template,
+            capacity,
+            name=bank_name or f"fleet:{worker_id}",
+            spill_store=spill_store,
+            checkpoint_every_n_flushes=checkpoint_every_n_flushes,
+        )
+        # the durable identity survives a die(): recovery needs the store
+        # and the journal namespace, never the bank object
+        self.bank_name = self.bank.name
+        self.store = self.bank.store
+        self.router: Optional[RequestRouter] = RequestRouter(
+            self.bank, max_requests=max_requests, max_delay_s=max_delay_s
+        )
         self.stats: Dict[str, int] = {
             "migrations_in": 0,
             "migrations_out": 0,
@@ -98,21 +120,45 @@ class Worker:
 
     @property
     def tenants(self) -> List[Hashable]:
-        """Every session this worker holds (device-resident + host-spilled)."""
+        """Every session this worker holds (device-resident + store-spilled).
+        After a die() the bank object is gone and the journal in the spill
+        store is the authority."""
+        if self.bank is None:
+            live, _torn = _store.replay_journal(self.store, self.bank_name)
+            return list(live)
         return self.bank.tenants + self.bank.spilled_tenants
+
+    def forget_memory(self) -> None:
+        """Simulate a whole-process crash: drop the bank and router objects.
+        Only the spill store (and this shell's id/stats) remains readable —
+        recovery MUST come from the durable tier."""
+        self.bank = None
+        self.router = None
 
     def drain(self) -> int:
         """Flush the router so no request is in flight; returns requests
         flushed. The first step of every migration."""
-        return self.router.flush()
+        return self.router.flush() if self.router is not None else 0
 
     def export_payload(self, tenant: Hashable, precisions: Optional[Dict[str, str]] = None) -> bytes:
-        """Checkpoint-encode ``tenant`` out of this worker (removing the
-        session) into one wire payload."""
-        tree = self.bank.export_tenant(tenant)
-        return _migrate.encode_tenant_payload(tree, precisions)
+        """The tenant's sealed durable payload, read THROUGH the spill store
+        (``MetricBank.export_payload`` checkpoints the session and hands back
+        its blob — graceful leave drains through the same route a crash
+        recovery reads). ``precisions`` re-encodes the payload with wire
+        codec tags when lossy handoff was explicitly opted into."""
+        return _migrate.reencode_payload(self.bank.export_payload(tenant), precisions)
 
     def summary(self) -> Dict[str, Any]:
+        if self.bank is None:
+            return {
+                "alive": self.alive,
+                "tenants": len(self.tenants),
+                "resident": 0,
+                "spilled": 0,
+                "pending": 0,
+                "died": True,
+                **self.stats,
+            }
         return {
             "alive": self.alive,
             "tenants": len(self.tenants),
@@ -149,6 +195,22 @@ class Fleet:
             bit-identical recovery contract. Pass ``True`` to opt into the
             template's ``add_state(sync_precision=)`` tags, or an explicit
             ``{state: codec}`` dict, when lossy handoff is acceptable.
+        durable_store: a shared :class:`~metrics_tpu.serving.SpillStore`
+            every worker's bank spills and journals into (per-worker
+            namespacing rides the bank name, ``<fleet>:<worker>`` — give the
+            fleet a stable ``name`` when recovery across process restarts
+            matters). Default ``None``: each worker gets a private
+            :class:`~metrics_tpu.serving.MemoryStore` — kill recovery still
+            flows through the store code route, but state lives only as
+            long as THIS process. Pass a
+            :class:`~metrics_tpu.serving.DiskStore` for preemption-safe
+            workers whose sessions survive a ``die()``/``kill -9``.
+        checkpoint_every_n_flushes: per-worker bank durability cadence
+            (default ``1``: every applied request batch is checkpointed into
+            the store, so kill/die recovery is bit-identical to the last
+            applied request — the CI-gated contract; raise it to trade
+            recovery freshness for lower checkpoint overhead, ``None``
+            disables periodic checkpoints entirely).
     """
 
     def __init__(
@@ -163,6 +225,8 @@ class Fleet:
         max_delay_s: Optional[float] = 0.05,
         fault_plan: Optional[Any] = None,
         migration_precisions: Optional[Any] = None,
+        durable_store: Optional[Any] = None,
+        checkpoint_every_n_flushes: Optional[int] = 1,
     ) -> None:
         ids = list(workers)
         if not ids:
@@ -182,6 +246,8 @@ class Fleet:
             fault_plan = _faults.plan_from_env()
         self._fault_plan = fault_plan
         self._migration_precisions = migration_precisions
+        self._durable_store = durable_store
+        self._ckpt_every = checkpoint_every_n_flushes
         # tenant -> ledger key, from publish until the admission acks: the
         # retryability record behind the partial-rebalance failure contract
         self._in_flight: Dict[Hashable, str] = {}
@@ -202,6 +268,7 @@ class Fleet:
             "joins": 0,
             "leaves": 0,
             "kills": 0,
+            "dies": 0,
             "recovered_tenants": 0,
             "resubmitted_requests": 0,
         }
@@ -219,6 +286,8 @@ class Fleet:
             bank_name=f"{self.name}:{wid}",
             max_requests=self._max_requests,
             max_delay_s=self._max_delay_s,
+            spill_store=self._durable_store,
+            checkpoint_every_n_flushes=self._ckpt_every,
         )
 
     def _precisions(self) -> Optional[Dict[str, str]]:
@@ -436,7 +505,7 @@ class Fleet:
         # state remains reachable for the retry.
         for wid in [w for w in list(self._workers) if w not in epoch.workers]:
             worker = self._workers[wid]
-            if not worker.tenants and not worker.router.pending:
+            if not worker.tenants and (worker.router is None or not worker.router.pending):
                 self._workers.pop(wid)
         self.stats["epoch_changes"] += 1
         resubmit_failures: List[Tuple[Hashable, BaseException]] = []
@@ -504,12 +573,23 @@ class Fleet:
             return False
         return plan.kills(worker_id, epoch_version)
 
-    def _mark_dead(self, worker_id: Hashable, reason: str) -> None:
+    def _died_by_plan(self, worker_id: Hashable, epoch_version: int) -> bool:
+        plan = self._fault_plan
+        if plan is None or not isinstance(worker_id, int):
+            return False
+        return plan.dies(worker_id, epoch_version)
+
+    def _mark_dead(self, worker_id: Hashable, reason: str, forget_memory: bool = False) -> None:
         worker = self._workers.get(worker_id)
         if worker is None or not worker.alive:
             return
         worker.alive = False
         self.stats["kills"] += 1
+        if forget_memory:
+            # whole-process crash semantics: the bank/router objects are
+            # GONE; only the worker's spill store remains readable
+            self.stats["dies"] += 1
+            worker.forget_memory()
         if _bus.enabled():
             _bus.emit(
                 "fleet_epoch",
@@ -595,7 +675,9 @@ class Fleet:
                 )
             dst = _placement.owner(tenant, epoch)
             worker = self._workers[dst]
-            if worker.alive and self._killed_by_plan(dst, epoch.version):
+            if worker.alive and self._died_by_plan(dst, epoch.version):
+                self._mark_dead(dst, reason="fault_plan_die", forget_memory=True)
+            elif worker.alive and self._killed_by_plan(dst, epoch.version):
                 self._mark_dead(dst, reason="fault_plan")
             if not worker.alive:
                 epoch = epoch.leave(dst)
@@ -634,33 +716,84 @@ class Fleet:
         List[Tuple[Hashable, Tuple[Any, ...]]],
         List[Tuple[Hashable, BaseException]],
     ]:
-        """Drain a DEAD worker's state back into the fleet: every session
-        checkpoint-encoded out of its bank (the durable-spill stand-in),
-        published, and re-admitted on the surviving rendezvous owners at
-        ``epoch`` (minus the dead worker). Returns the evolved epoch, the
-        recovery moves, payload bytes, the dead router's un-flushed requests
-        (the CALLER re-submits them after ``self.epoch`` advances), and the
-        per-tenant failures (isolated; each stays ledger-parked/on the dead
-        bank for a retry, which also keeps the worker registered).
+        """Drain a DEAD worker's state back into the fleet FROM ITS SPILL
+        STORE: every acked session's sealed payload is read out of the
+        worker's journal+blobs (``serving/store.durable_tenant_payloads`` —
+        never the dead bank's Python object, which a real crash would have
+        taken with it), published, and re-admitted on the surviving
+        rendezvous owners at ``epoch`` (minus the dead worker). Returns the
+        evolved epoch, the recovery moves, payload bytes, the dead router's
+        un-flushed requests if its memory survived (a ``kill``; the CALLER
+        re-submits them after ``self.epoch`` advances — a ``die`` lost
+        them), and the per-tenant failures (isolated; each failed tenant's
+        payload stays in the store/ledger for a retry, which also keeps the
+        worker registered).
         """
         dead = self._workers[worker_id]
         if worker_id in epoch:
             epoch = epoch.leave(worker_id)
-        pending = dead.router.drain_pending()
+        pending = dead.router.drain_pending() if dead.router is not None else []
+        # the store is the recovery source; the bank object (if a kill left
+        # one) is dead memory — release it so retries can't silently lean on
+        # it and a leaked device pytree doesn't outlive the worker
+        dead.forget_memory()
+        # ONE journal replay serves the whole recovery: the payload read, the
+        # no-blob sweep, and the deregistration check below all reuse `live`
+        live, _torn = _store.replay_journal(dead.store, dead.bank_name)
+        payloads = _store.durable_tenant_payloads(dead.store, dead.bank_name, live=live)
         moves: Dict[Hashable, Tuple[Hashable, Hashable]] = {}
         total_bytes = 0
         failures: List[Tuple[Hashable, BaseException]] = []
-        for tenant in list(dead.tenants):
+        for tenant, (payload, _count) in payloads.items():
             try:
-                dst, epoch, n_bytes = self._migrate_one(tenant, dead, epoch, "recovery")
+                # a tenant an earlier partial recovery already healed onto a
+                # live owner (via the in-flight ledger sweep) must not be
+                # force-re-imported — just sweep the dead namespace
+                if epoch.size:
+                    owner = self._workers.get(_placement.owner(tenant, epoch))
+                    if (
+                        owner is not None
+                        and owner.alive
+                        and owner.bank is not None
+                        and (tenant in owner.bank.tenants or tenant in owner.bank.spilled_tenants)
+                    ):
+                        _store.journal_drop(dead.store, dead.bank_name, tenant)
+                        continue
+                if self._migration_precisions is not None:
+                    payload = _migrate.reencode_payload(payload, self._precisions())
+                key = _migrate.ledger_key(self.name, epoch.version, tenant)
+                self.ledger.publish(key, payload)
+                self._in_flight[tenant] = key
+                dead.stats["migrations_out"] += 1
+                dead.stats["bytes_out"] += len(payload)
+                dst, epoch = self._admit_from_ledger(
+                    tenant, key, epoch, reason="recovery", source=worker_id
+                )
+                # sweep the dead namespace only after the new owner admitted
+                _store.journal_drop(dead.store, dead.bank_name, tenant)
                 moves[tenant] = (worker_id, dst)
-                total_bytes += n_bytes
+                total_bytes += len(payload)
                 self.stats["recovered_tenants"] += 1
             except Exception as err:  # noqa: BLE001 — isolated, aggregated by the caller
                 self.stats["migration_failures"] += 1
                 failures.append((tenant, err))
+        # journal-live sessions with NO blob: the crash landed between the
+        # write-ahead admit record and the defaults-blob put, so the session
+        # never had acked state. Sweep them, or the dead namespace never
+        # empties and the worker is re-scanned forever; their next request
+        # admits them fresh at the registered defaults on the rendezvous
+        # owner — the same defaults restore MetricBank.recover performs
+        for tenant in live:
+            if tenant not in payloads:
+                _store.journal_drop(dead.store, dead.bank_name, tenant)
         self.stats["rebalance_bytes"] += total_bytes
-        if not dead.tenants:
+        # every session left the namespace: admitted elsewhere, or swept
+        # (only a per-tenant failure keeps its payload parked for retry) —
+        # so clear the journal too: die/recover/join cycles would otherwise
+        # grow the namespace's drop records without bound, and a rejoining
+        # worker id should start from an empty log
+        if not failures:
+            dead.store.rewrite_journal(dead.bank_name, [])
             self._workers.pop(worker_id, None)
         return epoch, moves, total_bytes, pending, failures
 
@@ -697,22 +830,38 @@ class Fleet:
             failures += fails
 
     def kill(self, worker_id: Hashable) -> Dict[Hashable, Tuple[Hashable, Hashable]]:
-        """Ungraceful worker loss: no drain, no cooperation. Recovery
-        checkpoint-encodes every session out of the dead worker's bank (its
-        host/device state standing in for the durable spill tier), publishes
+        """Ungraceful worker loss: no drain, no cooperation. Recovery reads
+        every acked session's payload FROM THE WORKER'S SPILL STORE (its
+        journal + sealed blobs — with the fleet's default checkpoint cadence
+        of 1 that is bit-identical to the last applied request), publishes
         each payload, re-admits on the surviving rendezvous owners, and
         re-submits the dead router's un-flushed requests — the stream is
         applied exactly once. Returns ``{tenant: (dead_worker, new_owner)}``.
         """
+        return self._fell(worker_id, die=False)
+
+    def die(self, worker_id: Hashable) -> Dict[Hashable, Tuple[Hashable, Hashable]]:
+        """Whole-process crash: like :meth:`kill`, but the worker's bank AND
+        router objects are gone before recovery starts — no graceful export,
+        no un-flushed-request re-submission; the durable tier is the ONLY
+        recovery source. Acked (checkpointed) state restores bit-identically;
+        requests the worker accepted but never checkpointed are lost — the
+        durability window ``checkpoint_every_n_flushes`` bounds. Returns
+        ``{tenant: (dead_worker, new_owner)}``."""
+        return self._fell(worker_id, die=True)
+
+    def _fell(self, worker_id: Hashable, die: bool) -> Dict[Hashable, Tuple[Hashable, Hashable]]:
         with self._lock:
             if worker_id not in self._workers:
                 raise KeyError(f"unknown worker {worker_id!r} in fleet {self.name!r}")
             old = self.epoch
-            self._mark_dead(worker_id, reason="kill")
+            self._mark_dead(worker_id, reason="die" if die else "kill", forget_memory=die)
             # _recover_all_dead: a destination the fault plan fells DURING
             # this recovery is recovered in turn, never orphaned
             epoch, moves, total_bytes, pending, failures = self._recover_all_dead(self.epoch)
-            failures += self._commit_epoch(old, epoch, moves, total_bytes, pending, reason="kill")
+            failures += self._commit_epoch(
+                old, epoch, moves, total_bytes, pending, reason="die" if die else "kill"
+            )
             self._raise_if_failed(failures)
             return moves
 

@@ -76,6 +76,14 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: the batch axis padded to, and ``fused=True`` when the accumulation rode
 #: the same compiled program; compile/cache_hit/retrace events for encoder
 #: programs ride the ordinary engine kinds with ``entry_kind="encode"``).
+#: Durable state plane (``serving/store.py``, ISSUE 13): ``journal`` (one
+#: write-ahead record appended to a bank's tenant journal — op + tenant),
+#: ``spill_write`` (a sealed tenant payload written to the spill store —
+#: op spill/checkpoint/import, payload bytes), ``recover`` (a
+#: ``MetricBank.recover`` rebuilt a bank from its journal — tenants staged,
+#: torn tail records ignored; also emitted by ``drive(resume_from=)`` with
+#: ``scope="drive"``), ``snapshot`` (a ``drive(snapshot_store=)`` epoch
+#: snapshot sealed — step index, payload bytes, ``final`` flag).
 #: Misc: ``warning`` (a ``warn_once`` emission).
 EVENT_KINDS = (
     "compile",
@@ -98,6 +106,10 @@ EVENT_KINDS = (
     "admit",
     "evict",
     "flush",
+    "journal",
+    "spill_write",
+    "recover",
+    "snapshot",
     "migrate",
     "fleet_epoch",
     "warmup",

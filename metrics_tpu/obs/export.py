@@ -53,6 +53,12 @@ def _encoder_stats() -> Dict[str, Any]:
     return encoder_stats()
 
 
+def _durability_stats() -> Dict[str, Any]:
+    from metrics_tpu.serving import durability_stats
+
+    return durability_stats()
+
+
 def process_snapshot() -> Dict[str, Any]:
     """The process-wide observability view (no metric argument needed)."""
     from metrics_tpu import engine as _engine
@@ -81,6 +87,10 @@ def process_snapshot() -> Dict[str, Any]:
         # elastic fleet (metrics_tpu.fleet): per-fleet membership/occupancy,
         # migrations, rebalance bytes, kills/recoveries
         "fleet": _fleet_stats(),
+        # durable state plane (serving/store.py): journal appends/bytes/
+        # compactions, replayed + torn records, spill blob traffic, bank
+        # checkpoints, crash recoveries, drive snapshots/resumes
+        "durability": _durability_stats(),
         "bus": _bus.summary(),
         "spans": _trace.span_summary(),
         "warnings": {repr(k): v for k, v in _warn.warn_counts().items()},
@@ -323,6 +333,10 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
             _sample("metrics_tpu_fleet_worker_alive", 1 if worker["alive"] else 0, labels, kind="gauge")
             for key in ("migrations_in", "migrations_out", "bytes_in", "bytes_out"):
                 _sample(f"metrics_tpu_fleet_{key}", worker[key], labels)
+
+    # durable state plane: journal/spill/recovery/snapshot counters
+    for key, value in sorted(_durability_stats().items()):
+        _sample(f"metrics_tpu_durable_{key}", value)
 
     # AOT warmup manifests: warmed program inventory + staleness counters
     warm = _engine.warmup_report()

@@ -53,7 +53,7 @@ __all__ = [
 
 FAULTS_ENV_VAR = "METRICS_TPU_FAULTS"
 
-_FAULT_KINDS = ("drop", "delay", "corrupt", "straggler", "kill")
+_FAULT_KINDS = ("drop", "delay", "corrupt", "straggler", "kill", "die")
 
 
 class KVTimeoutError(TimeoutError):
@@ -78,11 +78,16 @@ class FaultSpec:
             moment it is asked to admit a migrating tenant at fleet-epoch
             version ``epoch`` (the mid-migration worker-kill scenario — the
             payload survives in the migration ledger and a surviving worker
-            re-admits it). KV-level operations never consult kill specs.
+            re-admits it); ``'die'`` — like ``'kill'``, but a whole-PROCESS
+            crash: the felled worker's bank and router objects are dropped
+            before recovery starts (no graceful export, un-flushed requests
+            lost), so recovery must come entirely from the durable spill
+            store (``serving/store.py``). KV-level operations never consult
+            kill/die specs.
         rank: the *publisher* process index whose payload is affected (for
-            ``'kill'``: the fleet worker id).
-        epoch: exchange epoch the fault applies to (for ``'kill'``: the
-            fleet epoch version); ``None`` = every epoch.
+            ``'kill'``/``'die'``: the fleet worker id).
+        epoch: exchange epoch the fault applies to (for ``'kill'``/``'die'``:
+            the fleet epoch version); ``None`` = every epoch.
         seconds: delay/straggler duration.
         times: how many corrupted reads ``'corrupt'`` serves before healing.
     """
@@ -152,6 +157,13 @@ class FaultPlan:
         """True when the plan fells worker/rank ``rank`` at ``epoch`` — the
         fleet layer's mid-migration kill hook (see the ``'kill'`` kind)."""
         return self._first("kill", rank, epoch) is not None
+
+    def dies(self, rank: int, epoch: Optional[int] = None) -> bool:
+        """True when the plan crash-fells worker ``rank`` at ``epoch`` with
+        whole-process semantics — the fleet drops the worker's bank/router
+        objects and recovers from the durable store only (the ``'die'``
+        kind)."""
+        return self._first("die", rank, epoch) is not None
 
     def drops_publish(self, key: str) -> bool:
         parsed = _parse_key(key)

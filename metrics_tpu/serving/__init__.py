@@ -12,15 +12,36 @@ device-resident state banks instead of per-instance dispatch.
   encode, and per-tenant results sliced off one coalesced async fetch.
 * :class:`RequestRouter` (``serving/router.py``) — groups incoming updates
   by input signature and flushes size/deadline-bounded waves into the bank.
+* :class:`SpillStore` / :class:`MemoryStore` / :class:`DiskStore`
+  (``serving/store.py``) — the durable state plane: pluggable spill tiers
+  plus the bank's write-ahead tenant journal, so ``MetricBank.recover``
+  rebuilds every acked session after a process crash (see
+  ``docs/durability.md``).
 * :func:`serving_summary` — per-bank occupancy/eviction/quarantine
   telemetry; surfaced in ``obs.snapshot()`` and the Prometheus dump
   (``metrics_tpu_bank_*`` gauges), with ``admit``/``evict``/``flush``
-  events on the bus.
+  events on the bus; :func:`durability_stats` feeds the ``"durability"``
+  section and the ``metrics_tpu_durable_*`` gauges.
 
 See ``docs/serving.md`` for the bank model, admission/eviction policy,
 router flush semantics, and sizing guidance.
 """
+from metrics_tpu.serving.store import (  # noqa: F401  (imported before bank: bank depends on it)
+    DiskStore,
+    MemoryStore,
+    SpillStore,
+    durability_stats,
+)
 from metrics_tpu.serving.bank import MetricBank, all_banks, serving_summary  # noqa: F401
 from metrics_tpu.serving.router import RequestRouter  # noqa: F401
 
-__all__ = ["MetricBank", "RequestRouter", "all_banks", "serving_summary"]
+__all__ = [
+    "DiskStore",
+    "MemoryStore",
+    "MetricBank",
+    "RequestRouter",
+    "SpillStore",
+    "all_banks",
+    "durability_stats",
+    "serving_summary",
+]

@@ -31,15 +31,25 @@ Layout & dispatch (``engine/cache._make_bank_entry``):
 
 Sessions beyond ``capacity`` spill: admission evicts the least-recently
 -used tenant and round-trips its state through the EXISTING checkpoint
-encode (``utils.checkpoint.metric_state_pytree``) onto the host; re-admission
-decodes it back into a free slot exactly. Per-tenant results ride the PR-5
-async plane: :meth:`MetricBank.compute_async` returns one
+encode (``utils.checkpoint.metric_state_pytree``), sealed as a PR-11
+migration payload into the bank's :class:`~metrics_tpu.serving.SpillStore`
+(host RAM by default, disk via :class:`~metrics_tpu.serving.DiskStore`);
+re-admission decodes it back into a free slot exactly. Per-tenant results
+ride the PR-5 async plane: :meth:`MetricBank.compute_async` returns one
 :class:`~metrics_tpu.engine.driver.AsyncResult` whose single coalesced
 device→host fetch carries every requested tenant's value.
 
-Observability: ``admit``/``evict``/``flush`` bus events, and per-bank
-occupancy / eviction / quarantine-rate gauges in ``obs.prometheus_text``
-via :func:`metrics_tpu.serving.serving_summary`.
+Durability (ISSUE 13): every admission, spill, checkpoint, import, and
+drop is logged write-ahead into the store's per-bank journal, and
+``checkpoint_every_n_flushes=`` periodically seals dirty resident tenants'
+states into the store (one coalesced device→host fetch per checkpoint), so
+:meth:`MetricBank.recover` rebuilds every acked session — bit-identical to
+its last durable write — after a process crash. See ``docs/durability.md``.
+
+Observability: ``admit``/``evict``/``flush``/``journal``/``spill_write``/
+``recover`` bus events, and per-bank occupancy / eviction / quarantine-rate
+gauges in ``obs.prometheus_text`` via
+:func:`metrics_tpu.serving.serving_summary`.
 """
 import itertools
 import threading
@@ -54,6 +64,7 @@ from metrics_tpu.engine import bucketing as _bucketing
 from metrics_tpu.engine import cache as _cache
 from metrics_tpu.obs import bus as _bus
 from metrics_tpu.resilience import health as _health
+from metrics_tpu.serving import store as _spill
 from metrics_tpu.utils.exceptions import MetricsUserError
 
 Array = jax.Array
@@ -112,10 +123,35 @@ class MetricBank:
         capacity: number of device-resident tenant slots. Sessions beyond
             it are admitted by spilling the least-recently-used tenant's
             state to host (checkpoint-encoded) and re-admitted on demand.
-        name: label for telemetry (defaults to ``bank<N>``).
+        name: label for telemetry AND the bank's journal/blob namespace in
+            the spill store (defaults to ``bank<N>``). A bank that should be
+            recoverable across process restarts needs a STABLE explicit
+            name — ``recover()`` replays the journal filed under it.
         dense_threshold: fraction of ``capacity`` above which a request
             batch dispatches through the dense full-bank variant instead of
             gather/scatter.
+        spill_store: the :class:`~metrics_tpu.serving.SpillStore` holding
+            spilled tenant payloads and the write-ahead journal. Default: a
+            private :class:`~metrics_tpu.serving.MemoryStore` (today's
+            state-lives-as-long-as-the-process behavior). Pass a
+            :class:`~metrics_tpu.serving.DiskStore` for preemption-safe
+            serving: a killed worker's sessions come back via
+            :meth:`recover`.
+        checkpoint_every_n_flushes: periodic durability cadence — every N
+            applied batches, each *dirty* resident tenant's state is sealed
+            into the store (one coalesced device→host fetch per checkpoint)
+            and journaled. ``None`` (default) disables periodic checkpoints:
+            only spill/import/export writes reach the store. ``1`` makes
+            every flush durable (the elastic fleet's default — recovery is
+            then bit-identical to the last applied request).
+        checkpoint_async: ``False`` (default) seals each periodic checkpoint
+            synchronously — the durable watermark IS the cadence boundary.
+            ``True`` stages the device→host fetch asynchronously (one jitted
+            row gather + ``AsyncResult`` copy, the PR-5 plane) and seals one
+            boundary LATER, keeping durability I/O off the serving hot path
+            at the cost of the watermark trailing by one cadence. A public
+            :meth:`checkpoint` call with nothing dirty (or a second call)
+            seals the staged batch immediately.
 
     ``update(tenant, *args)`` is sugar for a one-request
     :meth:`apply_batch`; real serving traffic should flow through a
@@ -130,9 +166,17 @@ class MetricBank:
         *,
         name: Optional[str] = None,
         dense_threshold: float = 0.5,
+        spill_store: Optional[_spill.SpillStore] = None,
+        checkpoint_every_n_flushes: Optional[int] = None,
+        checkpoint_async: bool = False,
     ) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if checkpoint_every_n_flushes is not None and checkpoint_every_n_flushes < 1:
+            raise ValueError(
+                f"checkpoint_every_n_flushes must be >= 1 (or None), got"
+                f" {checkpoint_every_n_flushes}"
+            )
         reason = _bankable_error(template)
         if reason is not None:
             raise MetricsUserError(
@@ -154,13 +198,42 @@ class MetricBank:
         self._counts: Dict[Hashable, int] = {}
         self._lru: Dict[Hashable, int] = {}
         self._free: List[int] = list(range(self.capacity - 1, -1, -1))  # pop() -> slot 0 first
-        self._spilled: Dict[Hashable, Dict[str, Any]] = {}
-        self._spilled_counts: Dict[Hashable, int] = {}
+        # tenant -> blob key in the spill store; the payload itself (a sealed
+        # PR-11 migration envelope) lives in the store, not on this object
+        self._spilled: Dict[Hashable, str] = {}
+        # last DURABLE update count / health counters per journaled session
+        # (what a crash-recovery would restore; also the compaction source)
+        self._durable_counts: Dict[Hashable, int] = {}
+        self._durable_health: Dict[Hashable, Optional[List[int]]] = {}
+        # per-session generation: minted at fresh admit/import/recover, popped
+        # at drop/export. An async-staged checkpoint seals only if the session
+        # it gathered is STILL the live one — update counts restart at 0 on
+        # re-admission, so a count comparison alone cannot tell "stale seal of
+        # a dropped session" from "fresh progress" (drop → re-admit → the old
+        # staged state must never overwrite the new session's blob)
+        self._gen: Dict[Hashable, int] = {}
+        self._gen_next = 0
         # host aggregate of CURRENTLY-spilled tenants' health counters, so
         # the bank-wide quarantine rate doesn't understate under LRU churn
         # (spilled numerators must not vanish while their requests stay in
         # the lifetime denominator); maintained at spill/readmit/drop
         self._spilled_health = np.zeros(_health.N_SLOTS, dtype=np.int64)
+        self._store = spill_store if spill_store is not None else _spill.MemoryStore()
+        self._ckpt_every = checkpoint_every_n_flushes
+        self._ckpt_async = bool(checkpoint_async)
+        # async mode: (AsyncResult over the gathered rows,
+        # [(tenant, count, gen)]) staged at one checkpoint boundary, sealed
+        # at the next
+        self._pending_ckpt: Optional[Tuple[Any, List[Tuple[Hashable, int, Optional[int]]]]] = None
+        self._ckpt_gather = None  # jitted row gather, compiled on first use
+        self._flushes_since_ckpt = 0
+        self._dirty: Dict[Hashable, None] = {}
+        # count EXISTING records too (a reused namespace — e.g. a rejoining
+        # fleet worker id, or recover() before its rewrite — starts with
+        # history on the store): compaction bounds the true on-store length,
+        # not just this incarnation's appends
+        self._journal_len = len(self._store.journal_frames(self.name))
+        self._defaults_payload: Optional[bytes] = None
         self._tick = 0
         self._lock = threading.RLock()
         self._poisoned = False
@@ -177,9 +250,16 @@ class MetricBank:
             "lost_tenants": 0,
             "exports": 0,
             "imports": 0,
+            "checkpoints": 0,
+            "journal_appends": 0,
         }
         with _REGISTRY_LOCK:
             _BANKS.add(self)
+
+    @property
+    def store(self) -> _spill.SpillStore:
+        """The bank's spill store (the durable tier when persistent)."""
+        return self._store
 
     # ------------------------------------------------------------------
     # admission / eviction (control plane)
@@ -242,11 +322,22 @@ class MetricBank:
             slot = self._free.pop()
             if readmit:
                 state, count = self._decode_spilled(tenant)
-                self._drop_spilled_entry(tenant)
+                # the tenant becomes resident again; its blob STAYS in the
+                # store as the durable watermark (only drop/export delete it)
+                self._unindex_spilled(tenant)
                 writes[slot] = state
                 self._counts[tenant] = count
                 self.stats["readmits"] += 1
             else:
+                # WRITE-AHEAD: the session exists durably (journal record +
+                # defaults blob) before any device state is touched — a crash
+                # right here recovers the tenant at its registered defaults
+                self._journal("admit", tenant)
+                self._store.put(self._blob_key(tenant), self._defaults_sealed())
+                self._durable_counts[tenant] = 0
+                self._durable_health[tenant] = None
+                self._gen[tenant] = self._gen_next
+                self._gen_next += 1
                 writes[slot] = self._defaults
                 self._counts[tenant] = 0
                 self.stats["admits"] += 1
@@ -265,6 +356,10 @@ class MetricBank:
                 )
         if writes:
             self._write_slots(writes)
+        # admission churn journals one record per fresh tenant — bound it
+        # here too, not only at checkpoint boundaries (a default-configured
+        # bank may never checkpoint)
+        self._maybe_compact_journal()
         return slots
 
     def _evict_lru(self, pinned: frozenset) -> None:
@@ -280,14 +375,15 @@ class MetricBank:
         self.evict(victim)
 
     def evict(self, tenant: Hashable, spill: bool = True) -> None:
-        """Remove ``tenant`` from the bank. ``spill=True`` (default) keeps
-        its state on host (checkpoint-encoded) for exact re-admission;
-        ``spill=False`` drops the session. Emits an ``evict`` bus event."""
+        """Remove ``tenant`` from the bank. ``spill=True`` (default) seals
+        its state into the spill store (checkpoint-encoded) for exact
+        re-admission; ``spill=False`` drops the session (journaled, blob
+        deleted). Emits an ``evict`` bus event."""
         with self._lock:
             if not spill and tenant in self._spilled:
-                # dropping a host-spilled session needs no device state, so
+                # dropping a store-spilled session needs no device state, so
                 # it works even on a poisoned bank
-                self._drop_spilled_entry(tenant)
+                self._drop_spilled_entry(tenant, op="drop")
                 return
             self._check_poisoned()
             if tenant not in self._slots:
@@ -295,15 +391,21 @@ class MetricBank:
             slot = self._slots.pop(tenant)
             count = self._counts.pop(tenant)
             self._lru.pop(tenant, None)
+            self._dirty.pop(tenant, None)
             if spill:
                 tree = self._encode_state(self._read_slot(slot), count)
-                self._spilled[tenant] = tree
-                self._spilled_counts[tenant] = count
-                if _health.HEALTH_STATE in tree:
-                    self._spilled_health += np.asarray(tree[_health.HEALTH_STATE], np.int64)
+                self._write_tenant_blob(tenant, tree, count, op="spill")
+                self._index_spilled(tenant)
                 self.stats["spills"] += 1
+            else:
+                self._journal("drop", tenant)
+                self._store.delete(self._blob_key(tenant))
+                self._durable_counts.pop(tenant, None)
+                self._durable_health.pop(tenant, None)
+                self._gen.pop(tenant, None)
             self._free.append(slot)
             self.stats["evictions"] += 1
+            self._maybe_compact_journal()
             if _bus.enabled():
                 _bus.emit(
                     "evict",
@@ -315,11 +417,343 @@ class MetricBank:
                     occupancy=len(self._slots),
                 )
 
-    def _drop_spilled_entry(self, tenant: Hashable) -> None:
-        tree = self._spilled.pop(tenant)
-        self._spilled_counts.pop(tenant)
-        if _health.HEALTH_STATE in tree:
-            self._spilled_health -= np.asarray(tree[_health.HEALTH_STATE], np.int64)
+    def _drop_spilled_entry(self, tenant: Hashable, op: str = "drop") -> None:
+        """Forget a store-spilled session entirely: journal the removal,
+        delete its blob, unwind the health aggregate."""
+        self._journal(op, tenant)
+        self._store.delete(self._spilled[tenant])
+        self._unindex_spilled(tenant)
+        self._durable_counts.pop(tenant, None)
+        self._durable_health.pop(tenant, None)
+        self._gen.pop(tenant, None)
+        self._maybe_compact_journal()
+
+    # ------------------------------------------------------------------
+    # durable plane: journal + sealed blobs in the spill store
+    # ------------------------------------------------------------------
+    def _journal(self, op: str, tenant: Hashable, **extra: Any) -> None:
+        record = _spill.seal_record({"op": op, "t": _spill.durable_token(tenant), **extra})
+        self._journal_many([(op, tenant, record)])
+
+    def _journal_many(self, entries: List[Tuple[str, Hashable, bytes]]) -> None:
+        """Append sealed journal records in one store write (a periodic
+        checkpoint's N tenant records cost one disk append, not N)."""
+        if not entries:
+            return
+        records = [record for _op, _tenant, record in entries]
+        self._store.append_journal_many(self.name, records)
+        self._journal_len += len(records)
+        self.stats["journal_appends"] += len(records)
+        _spill.bump("journal_appends", len(records))
+        _spill.bump("journal_bytes", sum(len(r) for r in records))
+        if _bus.enabled():
+            for op, tenant, _record in entries:
+                _bus.emit(
+                    "journal",
+                    source=type(self._template).__name__,
+                    bank=self.name,
+                    op=op,
+                    tenant=str(tenant),
+                )
+
+    def _blob_key(self, tenant: Hashable) -> str:
+        return _spill.tenant_blob_key(self.name, _spill.durable_token(tenant))
+
+    def _seal_tree(self, tree: Dict[str, Any]) -> bytes:
+        # spill/journal payloads are ALWAYS exact: sync quantization tags are
+        # transient per-exchange (re-derived from the exact carry each time),
+        # but a stored payload is re-bound as THE state — quantized rounding
+        # would bake in and compound across spill/readmit churn (the PR-11
+        # migration_precisions rationale; regression-tested with int8 tags)
+        return _spill.encode_tenant_payload(tree, precisions=None)
+
+    def _defaults_sealed(self) -> bytes:
+        if self._defaults_payload is None:
+            self._defaults_payload = self._seal_tree(self._encode_state(self._defaults, 0))
+        return self._defaults_payload
+
+    def _health_list(self, tree: Dict[str, Any]) -> Optional[List[int]]:
+        if _health.HEALTH_STATE not in tree:
+            return None
+        return [int(x) for x in np.asarray(tree[_health.HEALTH_STATE]).ravel()]
+
+    def _write_tenant_blob(
+        self,
+        tenant: Hashable,
+        tree: Dict[str, Any],
+        count: int,
+        op: str,
+        defer_journal: bool = False,
+    ) -> Optional[Tuple[str, Hashable, bytes]]:
+        """Seal one tenant's checkpoint tree into the store and journal it —
+        the single durable-write route shared by spill, periodic checkpoint,
+        and import. ``defer_journal=True`` returns the sealed journal entry
+        instead of appending it (the checkpoint loop batches one append for
+        all its tenants)."""
+        payload = self._seal_tree(tree)
+        self._store.put(self._blob_key(tenant), payload)
+        health = self._health_list(tree)
+        entry: Optional[Tuple[str, Hashable, bytes]] = None
+        record = _spill.seal_record(
+            {"op": op, "t": _spill.durable_token(tenant), "count": int(count), "health": health}
+        )
+        if defer_journal:
+            entry = (op, tenant, record)
+        else:
+            self._journal_many([(op, tenant, record)])
+        self._durable_counts[tenant] = int(count)
+        self._durable_health[tenant] = health
+        _spill.bump("spill_writes")
+        _spill.bump("spill_bytes", len(payload))
+        if _bus.enabled():
+            _bus.emit(
+                "spill_write",
+                source=type(self._template).__name__,
+                bank=self.name,
+                tenant=str(tenant),
+                op=op,
+                bytes=len(payload),
+            )
+        return entry
+
+    def _index_spilled(self, tenant: Hashable) -> None:
+        self._spilled[tenant] = self._blob_key(tenant)
+        health = self._durable_health.get(tenant)
+        if health is not None:
+            self._spilled_health += np.asarray(health, np.int64)
+
+    def _unindex_spilled(self, tenant: Hashable) -> None:
+        self._spilled.pop(tenant)
+        health = self._durable_health.get(tenant)
+        if health is not None:
+            self._spilled_health -= np.asarray(health, np.int64)
+
+    def _maybe_compact_journal(self) -> None:
+        """Bound the journal: past 4x the live-session count (floor 256), the
+        log is atomically rewritten as one checkpoint record per live session
+        — replay-equivalent, so a long-lived bank's admission/eviction churn
+        cannot grow the journal (or a MemoryStore's RAM) without bound."""
+        live = len(self._slots) + len(self._spilled)
+        if self._journal_len <= max(256, 4 * live):
+            return
+        records = []
+        for tenant in list(self._slots) + list(self._spilled):
+            records.append(
+                _spill.seal_record(
+                    {
+                        "op": "checkpoint",
+                        "t": _spill.durable_token(tenant),
+                        "count": int(self._durable_counts.get(tenant, 0)),
+                        "health": self._durable_health.get(tenant),
+                    }
+                )
+            )
+        self._store.rewrite_journal(self.name, records)
+        self._journal_len = len(records)
+        _spill.bump("journal_compactions")
+
+    def checkpoint(self, tenants: Optional[Iterable[Hashable]] = None) -> int:
+        """Seal resident tenants' CURRENT states into the spill store now —
+        the durable watermark :meth:`recover` restores to. ``tenants=None``
+        checkpoints every *dirty* resident tenant (updated since its last
+        durable write); returns the number checkpointed. One coalesced
+        device→host fetch covers the whole batch."""
+        with self._lock:
+            self._check_poisoned()
+            todo = list(self._dirty) if tenants is None else list(tenants)
+            return self._checkpoint_locked(todo)
+
+    def _checkpoint_locked(self, tenants: List[Hashable]) -> int:
+        tenants = [t for t in tenants if t in self._slots]
+        if not tenants:
+            # nothing new to stage — but an async-staged batch from the
+            # previous boundary still gets sealed, and those tenants count:
+            # the forced-seal idiom gates on this return value
+            return self._seal_pending_checkpoint()
+        if self._ckpt_async:
+            return self._stage_checkpoint_async(tenants)
+        # ONE coalesced device->host fetch for every checkpointed tenant.
+        # When the dirty set covers most of the bank (the periodic-cadence
+        # common case — every resident tenant served since the last
+        # checkpoint), fetch the whole bank and slice on host: per-leaf
+        # device-side row gathers cost an eager op dispatch each, which
+        # dwarfs the extra bytes of the clean rows at serving batch sizes.
+        rows = [self._slots[t] for t in tenants]
+        if 2 * len(tenants) >= len(self._slots):
+            fetched = jax.device_get(self._bank)
+            host = {n: col[np.asarray(rows)] for n, col in fetched.items()}
+        else:
+            idx = jnp.asarray(rows, jnp.int32)
+            host = jax.device_get({n: leaf[idx] for n, leaf in self._bank.items()})
+        entries = []
+        for i, tenant in enumerate(tenants):
+            state = {n: col[i] for n, col in host.items()}
+            tree = self._encode_state(state, self._counts[tenant])
+            entries.append(
+                self._write_tenant_blob(
+                    tenant, tree, self._counts[tenant], op="checkpoint", defer_journal=True
+                )
+            )
+            self._dirty.pop(tenant, None)
+        # one journal append covers the whole checkpoint batch
+        self._journal_many([e for e in entries if e is not None])
+        self.stats["checkpoints"] += 1
+        _spill.bump("checkpoints")
+        self._maybe_compact_journal()
+        return len(tenants)
+
+    def _stage_checkpoint_async(self, tenants: List[Hashable]) -> int:
+        """``checkpoint_async=True``: the hot-path half of a checkpoint is
+        ONE jitted row-gather dispatch plus an async device→host copy (the
+        PR-5 ``AsyncResult`` plane) — the seal + store write happens at the
+        NEXT checkpoint boundary, when the transfer has long completed, so
+        the serving pipeline never stalls on durability I/O. The durable
+        watermark trails by one cadence (the documented tradeoff vs the
+        synchronous default)."""
+        from metrics_tpu.engine.driver import AsyncResult
+
+        if self._ckpt_gather is None:
+            self._ckpt_gather = jax.jit(
+                lambda bank, idx: {n: leaf[idx] for n, leaf in bank.items()}
+            )
+        rows = [self._slots[t] for t in tenants]
+        # pow2-pad the gather index (repeating the first row) so a
+        # fluctuating dirty-tenant count retraces O(log capacity) programs,
+        # not one per distinct size — a fresh XLA compile inside the serving
+        # lock is exactly the stall async staging exists to avoid. The pad
+        # rows ride at the tail and are never read back (metas is shorter).
+        padded = 1 << max(0, len(rows) - 1).bit_length()
+        idx = jnp.asarray(rows + [rows[0]] * (padded - len(rows)), jnp.int32)
+        gathered = self._ckpt_gather(self._bank, idx)  # fresh buffers: safe vs donation
+        handle = AsyncResult(gathered, source=f"bank:{self.name}:checkpoint")
+        prev = self._pending_ckpt
+        self._pending_ckpt = (
+            handle,
+            [(t, self._counts[t], self._gen.get(t)) for t in tenants],
+        )
+        for t in tenants:
+            self._dirty.pop(t, None)
+        self.stats["checkpoints"] += 1
+        _spill.bump("checkpoints")
+        if prev is not None:
+            self._seal_staged(prev)
+        return len(tenants)
+
+    def _seal_pending_checkpoint(self) -> int:
+        """Seal the async-staged batch now (public ``checkpoint()`` calls
+        this so callers can force the durable watermark current: stage +
+        seal = two ``checkpoint()`` calls, or one with no dirty tenants)."""
+        pending, self._pending_ckpt = self._pending_ckpt, None
+        if pending is None:
+            return 0
+        return self._seal_staged(pending)
+
+    def _seal_staged(
+        self, staged: Tuple[Any, List[Tuple[Hashable, int, Optional[int]]]]
+    ) -> int:
+        handle, metas = staged
+        host = handle.result()
+        entries = []
+        sealed = 0
+        for i, (tenant, count, gen) in enumerate(metas):
+            # skip sessions a later durable write (spill/export/import) or a
+            # drop already superseded — a stale seal must never roll the
+            # blob backwards or resurrect a dropped tenant. The generation
+            # check catches drop-then-readmit: the new session restarts its
+            # count at 0 (< the staged count), so only the gen minted at
+            # admission tells the staged rows belong to a dead session
+            if self._gen.get(tenant) != gen:
+                continue
+            durable = self._durable_counts.get(tenant)
+            if durable is None or durable >= count:
+                continue
+            state = {n: col[i] for n, col in host.items()}
+            tree = self._encode_state(state, count)
+            entries.append(
+                self._write_tenant_blob(tenant, tree, count, op="checkpoint", defer_journal=True)
+            )
+            sealed += 1
+        self._journal_many([e for e in entries if e is not None])
+        self._maybe_compact_journal()
+        return sealed
+
+    @classmethod
+    def recover(
+        cls,
+        template: Any,
+        capacity: int,
+        store: _spill.SpillStore,
+        *,
+        name: str,
+        **bank_kwargs: Any,
+    ) -> "MetricBank":
+        """Rebuild the bank named ``name`` from its journal in ``store``
+        after a process crash: every session that was admitted/imported and
+        not dropped is staged host-spilled at its last durable state
+        (bit-identical to the payload its last checkpoint/spill sealed;
+        never-checkpointed sessions restore at the registered defaults), and
+        re-admits on demand exactly like an LRU-spilled tenant. A torn or
+        crc-corrupted journal tail (the record a ``kill -9`` interrupted) is
+        detected and cleanly ignored. Idempotent: recovering twice from the
+        same store stages the same sessions.
+
+        Compose with the PR-9 warmup manifest (``bank.warmup(manifest)``)
+        for a restart that is warm AND stateful before its first request.
+        """
+        live, torn = _spill.replay_journal(store, name)
+        bank = cls(template, capacity, name=name, spill_store=store, **bank_kwargs)
+        with bank._lock:
+            records = []
+            for tenant, rec in live.items():
+                key = _spill.tenant_blob_key(name, _spill.durable_token(tenant))
+                if not store.exists(key):
+                    # admitted write-ahead but the defaults blob was lost to
+                    # the crash: the session never had acked state
+                    store.put(key, bank._defaults_sealed())
+                bank._durable_counts[tenant] = int(rec.get("count", 0))
+                health = rec.get("health")
+                bank._durable_health[tenant] = (
+                    [int(x) for x in health] if health is not None else None
+                )
+                bank._gen[tenant] = bank._gen_next
+                bank._gen_next += 1
+                bank._index_spilled(tenant)
+                records.append(
+                    _spill.seal_record(
+                        {
+                            "op": "checkpoint",
+                            "t": _spill.durable_token(tenant),
+                            "count": bank._durable_counts[tenant],
+                            "health": bank._durable_health[tenant],
+                        }
+                    )
+                )
+            records.append(
+                _spill.seal_record({"op": "recover", "n": len(live), "torn": torn})
+            )
+            # REWRITE, never append: the journal may end in the torn frame
+            # the crash left, and appending after a phantom length-prefix
+            # would bury every post-recovery record inside it (the next
+            # replay would stop at the OLD crash point — dropped tenants
+            # resurrecting, new admissions lost). The rewrite is also the
+            # recover-time compaction: replay history collapses to one
+            # checkpoint record per live session, so repeated preemption /
+            # recover cycles keep restart latency bounded.
+            store.rewrite_journal(name, records)
+            bank._journal_len = len(records)
+            _spill.bump("journal_compactions")
+        _spill.bump("recovers")
+        _spill.bump("recovered_tenants", len(live))
+        if _bus.enabled():
+            _bus.emit(
+                "recover",
+                source=type(bank._template).__name__,
+                bank=name,
+                tenants=len(live),
+                torn_records=torn,
+                persistent=store.persistent,
+            )
+        return bank
 
     # ------------------------------------------------------------------
     # cross-worker handoff (the fleet layer's migration surface)
@@ -327,26 +761,42 @@ class MetricBank:
     def export_tenant(self, tenant: Hashable, keep: bool = False) -> Dict[str, Any]:
         """The tenant's checkpoint-encoded state tree
         (``utils.checkpoint.metric_state_pytree`` — exactly what LRU spill
-        stores), for handing the session to ANOTHER bank/worker.
+        seals into the store), for handing the session to ANOTHER
+        bank/worker.
 
         ``keep=False`` (default) removes the session from this bank — the
         handoff contract: after export, this bank no longer serves the
         tenant. ``keep=True`` leaves the (now spilled) session in place — a
         checkpoint read, e.g. for replication. Spilled tenants export even
-        from a poisoned bank (their host state is what poisoning promises
+        from a poisoned bank (their store payload is what poisoning promises
         survived)."""
         with self._lock:
-            if tenant in self._slots:
-                self._check_poisoned()
-                self.evict(tenant, spill=True)
-            if tenant not in self._spilled:
-                raise KeyError(f"unknown tenant {tenant!r} in bank {self.name!r}")
-            self.stats["exports"] += 1
-            if keep:
-                return dict(self._spilled[tenant])
-            tree = dict(self._spilled[tenant])
-            self._drop_spilled_entry(tenant)
-            return tree
+            payload = self._export_payload_locked(tenant, keep)
+            return _spill.decode_tenant_payload(
+                payload, context=f" (bank {self.name!r}, tenant {tenant!r})"
+            )
+
+    def export_payload(self, tenant: Hashable, keep: bool = False) -> bytes:
+        """The tenant's SEALED durable payload (the PR-11 migration envelope
+        its blob holds), removing the session unless ``keep``. This is the
+        one export route the fleet drains through — graceful ``leave`` and
+        ungraceful recovery both read the store, so both exercise the same
+        bytes a crash recovery would."""
+        with self._lock:
+            return self._export_payload_locked(tenant, keep)
+
+    def _export_payload_locked(self, tenant: Hashable, keep: bool) -> bytes:
+        if tenant in self._slots:
+            self._check_poisoned()
+            self.evict(tenant, spill=True)
+        if tenant not in self._spilled:
+            raise KeyError(f"unknown tenant {tenant!r} in bank {self.name!r}")
+        payload = self._store.get(self._spilled[tenant])
+        _spill.bump("blob_reads")
+        self.stats["exports"] += 1
+        if not keep:
+            self._drop_spilled_entry(tenant, op="export")
+        return payload
 
     def import_tenant(self, tenant: Hashable, tree: Dict[str, Any], admit: bool = True) -> None:
         """Stage a checkpoint-encoded tenant (an :meth:`export_tenant` tree,
@@ -374,11 +824,15 @@ class MetricBank:
             _ckpt.restore_metric_state_pytree(probe, dict(tree))
             probe.bind_state(probe._snapshot_state(), update_count=probe._update_count)
             staged = _ckpt.metric_state_pytree(probe)
-            self._spilled[tenant] = staged
-            self._spilled_counts[tenant] = probe._update_count
-            if _health.HEALTH_STATE in staged:
-                self._spilled_health += np.asarray(staged[_health.HEALTH_STATE], np.int64)
+            # durable-before-served: the sealed payload lands in the store
+            # (and the journal) BEFORE the bank learns the tenant, so a
+            # migration destination's ack is backed by the durable tier
+            self._write_tenant_blob(tenant, staged, probe._update_count, op="import")
+            self._index_spilled(tenant)
+            self._gen[tenant] = self._gen_next
+            self._gen_next += 1
             self.stats["imports"] += 1
+            self._maybe_compact_journal()
             if admit:
                 self.admit(tenant)
 
@@ -414,10 +868,15 @@ class MetricBank:
     def _decode_spilled(self, tenant: Hashable) -> Tuple[Dict[str, Any], int]:
         from metrics_tpu.utils import checkpoint as _ckpt
 
+        payload = self._store.get(self._spilled[tenant])
+        _spill.bump("blob_reads")
+        tree = _spill.decode_tenant_payload(
+            payload, context=f" (bank {self.name!r}, tenant {tenant!r})"
+        )
         tpl = self._template
         saved, saved_count = tpl._snapshot_state(), tpl._update_count
         try:
-            _ckpt.restore_metric_state_pytree(tpl, self._spilled[tenant])
+            _ckpt.restore_metric_state_pytree(tpl, tree)
             return tpl._snapshot_state(), tpl._update_count
         finally:
             tpl._restore_state(saved)
@@ -498,11 +957,17 @@ class MetricBank:
         self._bank = out
         for t in tenants:
             self._counts[t] += 1
+            self._dirty[t] = None
         self.stats["launches"] += 1
         self.stats["requests"] += n_req
         self.stats["dense_launches" if dense else "scatter_launches"] += 1
         if pads is not None:
             self.stats["bucketed_requests"] += n_req
+        if self._ckpt_every is not None:
+            self._flushes_since_ckpt += 1
+            if self._flushes_since_ckpt >= self._ckpt_every:
+                self._flushes_since_ckpt = 0
+                self._checkpoint_locked(list(self._dirty))
         if _bus.enabled():
             _bus.emit(
                 "flush",
@@ -674,8 +1139,8 @@ class MetricBank:
         with self._lock:
             if tenant in self._counts:
                 return self._counts[tenant]
-            if tenant in self._spilled_counts:
-                return self._spilled_counts[tenant]
+            if tenant in self._spilled:
+                return self._durable_counts.get(tenant, 0)
             raise KeyError(f"unknown tenant {tenant!r} in bank {self.name!r}")
 
     def compute(self, tenant: Hashable) -> Any:
@@ -761,6 +1226,9 @@ class MetricBank:
                 "capacity": self.capacity,
                 "occupancy": len(self._slots),
                 "spilled": len(self._spilled),
+                "store": type(self._store).__name__,
+                "store_persistent": self._store.persistent,
+                "dirty_tenants": len(self._dirty),
                 **self.stats,
             }
             requests = self.stats["requests"]

@@ -1,0 +1,643 @@
+"""Durable spill tiers + the write-ahead tenant journal codec.
+
+Until now a spilled tenant lived exactly as long as its Python process: the
+bank's LRU spill dict was host RAM, ``Fleet.kill`` recovery read the dead
+worker's *object*, and a preempted worker lost every session. This module is
+the storage half of the durable state plane (ISSUE 13): a tiny pluggable
+store protocol with two tiers, plus the record codec for the bank's
+write-ahead journal.
+
+* :class:`SpillStore` — the protocol. Two object kinds: **blobs** (sealed
+  tenant-state payloads — the PR-11 migration envelope, so migration, LRU
+  spill, and crash restore all speak ONE codec) keyed by string, and
+  **journals** (append-only record logs, one per bank) replayed by
+  ``MetricBank.recover``.
+* :class:`MemoryStore` — host-RAM tier, the default: exactly today's
+  "spilled tenants survive as long as the process" behavior, but through
+  the same code route the durable tiers use, so every path is exercised by
+  every test.
+* :class:`DiskStore` — the durable tier: blobs are written to a temp file
+  and ``os.replace``'d (atomic — a crash mid-write leaves the previous
+  sealed payload, never a torn one), journals are append-only files of
+  length-framed, crc32-sealed records; replay stops cleanly at a torn or
+  corrupted tail (:func:`read_journal`), so a ``kill -9`` mid-append costs
+  at most the record being written.
+
+Journal records are versioned JSON sealed in the same crc32 envelope every
+sync/migration payload wears (``parallel/groups.pack_envelope``). Tenant
+ids ride as type-framed tokens (:func:`durable_token`) so ``1`` and ``"1"``
+stay distinct sessions and recovery reconstructs the original id.
+
+Telemetry: :func:`durability_stats` (the ``"durability"`` section of
+``obs.snapshot()`` and the ``metrics_tpu_durable_*`` Prometheus gauges);
+``journal``/``spill_write``/``recover``/``snapshot`` bus events are emitted
+by the writers (bank / driver), not the store.
+"""
+import json
+import os
+import struct
+import threading
+import urllib.parse
+from typing import Any, Dict, Hashable, List, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.parallel import groups as _groups
+from metrics_tpu.utils.exceptions import MetricsUserError, SyncIntegrityError
+
+__all__ = [
+    "DiskStore",
+    "MemoryStore",
+    "SpillStore",
+    "decode_tenant_payload",
+    "durability_stats",
+    "durable_token",
+    "encode_tenant_payload",
+    "read_journal",
+    "reset_durability_stats",
+    "seal_record",
+    "token_tenant",
+    "unseal_record",
+]
+
+JOURNAL_VERSION = 1
+
+# process-wide durability telemetry — the "durability" section of
+# obs.snapshot() and the metrics_tpu_durable_* Prometheus family
+_STATS_LOCK = threading.Lock()
+
+
+def _new_stats() -> Dict[str, int]:
+    return {
+        "journal_appends": 0,
+        "journal_bytes": 0,
+        "journal_compactions": 0,
+        "records_replayed": 0,
+        "torn_records": 0,
+        "spill_writes": 0,
+        "spill_bytes": 0,
+        "blob_reads": 0,
+        "checkpoints": 0,
+        "recovers": 0,
+        "recovered_tenants": 0,
+        "snapshots": 0,
+        "snapshot_bytes": 0,
+        "resumes": 0,
+    }
+
+
+_STATS = _new_stats()
+
+
+def bump(key: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        _STATS[key] += n
+
+
+def durability_stats() -> Dict[str, int]:
+    """Process-wide durable-plane counters: journal appends/bytes/compactions,
+    replayed + torn records, spill blob writes/reads/bytes, bank checkpoints,
+    recoveries (and tenants they restored), drive snapshots and resumes."""
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def reset_durability_stats() -> None:
+    with _STATS_LOCK:
+        for key in list(_STATS):
+            _STATS[key] = 0
+
+
+# ---------------------------------------------------------------------------
+# tenant tokens: type-framed, journal-safe, reversible
+# ---------------------------------------------------------------------------
+def durable_token(tenant: Hashable) -> List[Any]:
+    """A JSON-safe, *reversible* encoding of a tenant id. Type-framed so
+    ``1``, ``"1"``, ``True`` and ``1.0`` stay four distinct sessions (the
+    same rationale as ``fleet.migrate.ledger_key``). Supported id types:
+    ``str``/``int``/``bool``/``float``/``None`` — the durable plane must be
+    able to reconstruct the id from bytes after a process crash, so exotic
+    hashables are rejected loudly at admission instead of recovering as a
+    different session."""
+    if isinstance(tenant, bool):
+        return ["b", tenant]
+    if isinstance(tenant, int):
+        return ["i", tenant]
+    if isinstance(tenant, float):
+        return ["f", tenant]
+    if isinstance(tenant, str):
+        return ["s", tenant]
+    if tenant is None:
+        return ["n", None]
+    raise MetricsUserError(
+        f"tenant id {tenant!r} of type {type(tenant).__name__} cannot ride the"
+        " durable state plane: journal records must reconstruct the id after a"
+        " process crash, so ids must be str/int/bool/float/None."
+    )
+
+
+def token_tenant(token: Any) -> Hashable:
+    """Inverse of :func:`durable_token`."""
+    kind, value = token
+    if kind == "b":
+        return bool(value)
+    if kind == "i":
+        return int(value)
+    if kind == "f":
+        return float(value)
+    if kind == "s":
+        return str(value)
+    if kind == "n":
+        return None
+    raise SyncIntegrityError(f"Unknown tenant token kind {kind!r} in journal record.")
+
+
+def token_key(token: List[Any]) -> str:
+    """Stable string form of a token for blob keys."""
+    return urllib.parse.quote(json.dumps(token, sort_keys=True), safe="")
+
+
+# ---------------------------------------------------------------------------
+# journal record codec: versioned JSON in the crc32 envelope
+# ---------------------------------------------------------------------------
+def seal_record(record: Dict[str, Any]) -> bytes:
+    """One journal record: versioned JSON sealed in the same crc32-checked
+    envelope every sync/migration payload wears — a torn or bit-flipped
+    record fails its checksum instead of replaying garbage."""
+    body = dict(record)
+    body.setdefault("v", JOURNAL_VERSION)
+    return _groups.pack_envelope(json.dumps(body, sort_keys=True).encode("utf-8"))
+
+
+def unseal_record(payload: bytes, context: str = "") -> Dict[str, Any]:
+    _version, body = _groups.unpack_envelope(payload, context)
+    try:
+        record = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as err:
+        raise SyncIntegrityError(f"Unparseable journal record{context}: {err}") from err
+    if not isinstance(record, dict):
+        raise SyncIntegrityError(f"Journal record is not an object{context}.")
+    return record
+
+
+def read_journal(store: "SpillStore", journal: str) -> Tuple[List[Dict[str, Any]], int]:
+    """Decode a journal into records, stopping cleanly at the first torn or
+    corrupted record: everything after a record that fails its length frame
+    or crc is the tail a crash was writing — ``(records, torn)`` where
+    ``torn`` counts the ignored frames, including a framing-torn trailing
+    fragment (0 for a clean journal)."""
+    records: List[Dict[str, Any]] = []
+    # a half-written trailing frame never parses as a frame at all — it is
+    # counted too, or a kill -9 mid-append would read back as a clean
+    # shutdown (one combined scan: frames + framing-torn tail flag)
+    frames, tail_torn = store.journal_scan(journal)
+    torn = int(tail_torn)
+    for i, frame in enumerate(frames):
+        try:
+            records.append(unseal_record(frame, context=f" (journal {journal!r}, record {i})"))
+        except SyncIntegrityError:
+            torn += len(frames) - i
+            break
+    # the good prefix WAS replayed — the replayed-vs-torn comparison exists
+    # precisely for the crash-recovery case
+    if torn:
+        bump("torn_records", torn)
+    bump("records_replayed", len(records))
+    return records, torn
+
+
+# ---------------------------------------------------------------------------
+# the store protocol
+# ---------------------------------------------------------------------------
+class SpillStore:
+    """Interface for a spill tier: keyed sealed blobs + per-bank journals.
+
+    ``persistent`` says whether the tier survives the process (drives which
+    recovery guarantees a deployment actually gets). All methods must be
+    thread-safe; blob ``put`` must be atomic (a reader never observes a torn
+    payload — the crc envelope backstops this, atomicity keeps the PREVIOUS
+    payload readable through a crash mid-write)."""
+
+    persistent = False
+
+    def put(self, key: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def append_journal(self, journal: str, record: bytes) -> None:
+        raise NotImplementedError
+
+    def append_journal_many(self, journal: str, records: List[bytes]) -> None:
+        """Append a batch of records in order. Default: one append per
+        record; tiers with per-append open/sync cost (disk) override this
+        with a single write so a periodic checkpoint's N tenant records cost
+        one I/O, not N."""
+        for record in records:
+            self.append_journal(journal, record)
+
+    def journal_frames(self, journal: str) -> List[bytes]:
+        """Raw record frames in append order; a torn trailing frame (partial
+        length prefix / short body) is dropped here, crc validation happens
+        in :func:`read_journal`."""
+        raise NotImplementedError
+
+    def journal_torn_tail(self, journal: str) -> int:
+        """1 if the journal currently ends in a framing-torn tail (the bytes
+        a crash left mid-append), else 0 — so :func:`read_journal` can count
+        framing-level tears alongside crc-level ones. Tiers whose appends
+        cannot tear (memory) keep this default."""
+        return 0
+
+    def journal_scan(self, journal: str) -> Tuple[List[bytes], int]:
+        """``(journal_frames(j), journal_torn_tail(j))`` in one call — tiers
+        where both come from one pass over the same bytes (disk) override
+        this so recovery reads the journal once, not twice."""
+        return self.journal_frames(journal), self.journal_torn_tail(journal)
+
+    def rewrite_journal(self, journal: str, records: List[bytes]) -> None:
+        """Atomically replace a journal's contents (compaction)."""
+        raise NotImplementedError
+
+
+class MemoryStore(SpillStore):
+    """Host-RAM tier — today's spill behavior behind the store protocol.
+
+    State lives as long as the process: the default for solo banks, and the
+    in-process stand-in the fleet harness uses when no durable tier is
+    configured (a ``Fleet.kill`` still recovers, because the *store object*
+    outlives the killed worker's bank)."""
+
+    persistent = False
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._blobs: Dict[str, bytes] = {}
+        self._journals: Dict[str, List[bytes]] = {}
+
+    def put(self, key: str, payload: bytes) -> None:
+        with self._lock:
+            self._blobs[key] = bytes(payload)
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            if key not in self._blobs:
+                raise KeyError(f"no blob {key!r} in MemoryStore")
+            return self._blobs[key]
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._blobs.pop(key, None)
+
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            return key in self._blobs
+
+    def append_journal(self, journal: str, record: bytes) -> None:
+        with self._lock:
+            self._journals.setdefault(journal, []).append(bytes(record))
+
+    def journal_frames(self, journal: str) -> List[bytes]:
+        with self._lock:
+            return list(self._journals.get(journal, ()))
+
+    def rewrite_journal(self, journal: str, records: List[bytes]) -> None:
+        with self._lock:
+            self._journals[journal] = [bytes(r) for r in records]
+
+
+class DiskStore(SpillStore):
+    """Durable disk tier rooted at ``root``.
+
+    * Blobs: one file per key under ``root/blobs/`` (keys percent-quoted),
+      written to a same-directory temp file and ``os.replace``'d — atomic on
+      POSIX, so a crash mid-write never leaves a torn payload where a sealed
+      one stood.
+    * Journals: append-only files under ``root/journal/`` of length-framed
+      crc-sealed records. :meth:`journal_frames` stops at a torn tail (the
+      frame a ``kill -9`` interrupted); :func:`read_journal` additionally
+      drops a crc-corrupted tail.
+    * ``fsync=True`` fsyncs every blob write and journal append — the
+      strict durability contract for preemptible workers; the default
+      ``False`` trusts the OS page cache (survives process death, not
+      host power loss), which is the right tradeoff for preemption-safe
+      serving where the host keeps running.
+    """
+
+    persistent = True
+
+    def __init__(self, root: str, *, fsync: bool = False) -> None:
+        self.root = os.path.abspath(root)
+        self.fsync = bool(fsync)
+        self._blob_dir = os.path.join(self.root, "blobs")
+        self._journal_dir = os.path.join(self.root, "journal")
+        os.makedirs(self._blob_dir, exist_ok=True)
+        os.makedirs(self._journal_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._tmp_ids = 0
+        # journals this process has already appended to (or rewritten):
+        # their tails are known frame-clean, so appends skip the torn-tail
+        # truncation scan
+        self._append_clean: set = set()
+
+    def _blob_path(self, key: str) -> str:
+        return os.path.join(self._blob_dir, urllib.parse.quote(key, safe="") + ".bin")
+
+    def _journal_path(self, journal: str) -> str:
+        return os.path.join(self._journal_dir, urllib.parse.quote(journal, safe="") + ".waj")
+
+    def _write_atomic(self, path: str, payload: bytes) -> None:
+        with self._lock:
+            self._tmp_ids += 1
+            tmp = f"{path}.tmp{os.getpid()}.{self._tmp_ids}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if self.fsync:
+            # the rename itself lives in the directory entry: without a dir
+            # fsync, a power loss can undo the os.replace even though the
+            # file contents were synced (ext4 & friends)
+            self._fsync_dir(os.path.dirname(path))
+
+    @staticmethod
+    def _fsync_dir(path: str) -> None:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def put(self, key: str, payload: bytes) -> None:
+        self._write_atomic(self._blob_path(key), bytes(payload))
+
+    def get(self, key: str) -> bytes:
+        path = self._blob_path(key)
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise KeyError(f"no blob {key!r} in DiskStore({self.root!r})") from None
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._blob_path(key))
+        except FileNotFoundError:
+            pass
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._blob_path(key))
+
+    def append_journal(self, journal: str, record: bytes) -> None:
+        self.append_journal_many(journal, [record])
+
+    def append_journal_many(self, journal: str, records: List[bytes]) -> None:
+        if not records:
+            return
+        body = b"".join(struct.pack(">I", len(r)) + bytes(r) for r in records)
+        path = self._journal_path(journal)
+        with self._lock:
+            created = not os.path.exists(path)
+            # appending after a torn tail would BURY these records inside the
+            # phantom frame the crash left (its length prefix swallows them;
+            # replay would stop at the old crash point forever) — so the
+            # first append this process makes to a journal truncates any torn
+            # bytes first; our own appends are frame-atomic under the lock,
+            # so later appends trust the in-process bookkeeping
+            if not created and journal not in self._append_clean:
+                self._truncate_torn_tail(path)
+            self._append_clean.add(journal)
+            with open(path, "ab") as f:
+                f.write(body)
+                if self.fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            if self.fsync and created:
+                # a journal's FIRST append creates the file — that directory
+                # entry must survive power loss too
+                self._fsync_dir(self._journal_dir)
+
+    @staticmethod
+    def _scan_frames(data: bytes) -> Tuple[List[bytes], int]:
+        """Walk the length-framed records; returns ``(frames, valid_bytes)``
+        — any bytes past ``valid_bytes`` are a framing-torn tail."""
+        frames: List[bytes] = []
+        offset = 0
+        while offset + 4 <= len(data):
+            (size,) = struct.unpack(">I", data[offset : offset + 4])
+            if offset + 4 + size > len(data):
+                break  # torn tail: the frame a crash interrupted
+            frames.append(data[offset + 4 : offset + 4 + size])
+            offset += 4 + size
+        return frames, offset
+
+    def _truncate_torn_tail(self, path: str) -> None:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return
+        _frames, valid = self._scan_frames(data)
+        if valid < len(data):
+            with open(path, "r+b") as f:
+                f.truncate(valid)
+                if self.fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+
+    def _read_journal_bytes(self, journal: str) -> bytes:
+        try:
+            with open(self._journal_path(journal), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return b""
+
+    def journal_frames(self, journal: str) -> List[bytes]:
+        return self._scan_frames(self._read_journal_bytes(journal))[0]
+
+    def journal_torn_tail(self, journal: str) -> int:
+        return self.journal_scan(journal)[1]
+
+    def journal_scan(self, journal: str) -> Tuple[List[bytes], int]:
+        data = self._read_journal_bytes(journal)
+        frames, valid = self._scan_frames(data)
+        return frames, (1 if valid < len(data) else 0)
+
+    def rewrite_journal(self, journal: str, records: List[bytes]) -> None:
+        body = b"".join(struct.pack(">I", len(r)) + bytes(r) for r in records)
+        self._write_atomic(self._journal_path(journal), body)
+        with self._lock:
+            self._append_clean.add(journal)
+
+
+# ---------------------------------------------------------------------------
+# journal replay: the recovery source shared by MetricBank.recover and Fleet
+# ---------------------------------------------------------------------------
+def tenant_blob_key(bank_name: str, token: List[Any]) -> str:
+    """One blob per (bank, tenant), atomically overwritten at each
+    checkpoint/spill — the tenant's durable watermark is always the latest
+    sealed payload, and the journal stays an index, not a log of states."""
+    return f"tenant/{urllib.parse.quote(bank_name, safe='')}/{token_key(token)}"
+
+
+def replay_journal(store: SpillStore, bank_name: str) -> Tuple[Dict[Hashable, Dict[str, Any]], int]:
+    """Replay ``bank_name``'s journal into the live-tenant map:
+    ``{tenant: {"count": int, "health": list|None}}`` for every session that
+    was admitted/imported and not dropped/exported. Unknown record ops are
+    skipped (forward compatibility); returns ``(live, torn_records)``."""
+    records, torn = read_journal(store, bank_name)
+    live: Dict[Hashable, Dict[str, Any]] = {}
+    for rec in records:
+        op = rec.get("op")
+        if "t" not in rec:
+            continue
+        try:
+            tenant = token_tenant(rec["t"])
+        except (SyncIntegrityError, TypeError, ValueError):
+            continue
+        if op == "admit":
+            live.setdefault(tenant, {"count": 0, "health": None})
+        elif op in ("spill", "checkpoint", "import"):
+            live[tenant] = {
+                "count": int(rec.get("count", 0)),
+                "health": rec.get("health"),
+            }
+        elif op in ("drop", "export"):
+            live.pop(tenant, None)
+        # other ops ("recover", future kinds): replay-neutral
+    return live, torn
+
+
+def durable_tenant_payloads(
+    store: SpillStore,
+    bank_name: str,
+    live: Optional[Dict[Hashable, Dict[str, Any]]] = None,
+) -> Dict[Hashable, Tuple[bytes, int]]:
+    """Every live tenant's latest sealed payload (and update count) from
+    ``bank_name``'s journal + blobs — the recovery read ``Fleet`` uses in
+    place of the dead worker's Python objects. Tenants whose blob is missing
+    (a crash between the write-ahead admit record and the defaults blob) are
+    skipped: they never had acked state. Pass ``live`` (a
+    :func:`replay_journal` result) to skip the replay — recovery replays
+    once and reuses the map."""
+    if live is None:
+        live, _torn = replay_journal(store, bank_name)
+    out: Dict[Hashable, Tuple[bytes, int]] = {}
+    for tenant, rec in live.items():
+        key = tenant_blob_key(bank_name, durable_token(tenant))
+        try:
+            payload = store.get(key)
+        except KeyError:
+            continue
+        bump("blob_reads")
+        out[tenant] = (payload, int(rec.get("count", 0)))
+    return out
+
+
+def journal_drop(store: SpillStore, bank_name: str, tenant: Hashable) -> None:
+    """Record that ``tenant`` left ``bank_name`` and delete its blob —
+    the store-side cleanup for recoveries that have no live bank object
+    (a died worker's namespace, swept as each session re-admits elsewhere)."""
+    token = durable_token(tenant)
+    record = seal_record({"op": "drop", "t": token})
+    store.append_journal(bank_name, record)
+    bump("journal_appends")
+    bump("journal_bytes", len(record))
+    store.delete(tenant_blob_key(bank_name, token))
+    if _bus.enabled():
+        _bus.emit("journal", bank=bank_name, op="drop", tenant=str(tenant))
+
+
+_PAYLOAD_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# tenant-payload codec: one checkpoint tree <-> one sealed payload.
+# ONE codec for every durable byte: fleet migration (its historical home,
+# fleet.migrate re-exports), LRU spill, crash restore, and drive snapshots.
+# ---------------------------------------------------------------------------
+def encode_tenant_payload(
+    tree: Dict[str, Any],
+    precisions: Optional[Dict[str, str]] = None,
+    stats: Optional[Dict[str, Any]] = None,
+) -> bytes:
+    """Seal one checkpoint tree (``metric_state_pytree`` output) as a
+    self-describing migration payload.
+
+    Layout: the usual versioned crc32 envelope around a JSON key manifest
+    plus one length-framed block per leaf, each block being a full PR-8 wire
+    payload (``_encode`` — exact v1 bytes, or quantized v2 when the leaf's
+    state carries a ``sync_precision`` tag). Self-describing on purpose: the
+    receiver reconstructs the tree from the payload alone, so sender and
+    receiver never need to agree on a treedef out of band (the checkpoint
+    validator still enforces the template contract at admission).
+    """
+    keys = sorted(tree)
+    blocks: List[bytes] = []
+    for key in keys:
+        value = tree[key]
+        if isinstance(value, dict):
+            raise MetricsUserError(
+                f"migration payloads cannot carry list ('cat' buffer) state"
+                f" {key!r} — banks reject list-state templates, so a banked"
+                " tenant never holds one. Migrate such metrics by checkpoint"
+                " file instead."
+            )
+        tag = (precisions or {}).get(key)
+        blocks.append(_groups._encode(np.asarray(value), tag, stats=stats))
+    header = json.dumps({"v": _PAYLOAD_VERSION, "keys": keys}).encode()
+    body = struct.pack(">I", len(header)) + header
+    body += b"".join(struct.pack(">Q", len(b)) + b for b in blocks)
+    return _groups.pack_envelope(body)
+
+
+def decode_tenant_payload(payload: bytes, context: str = "") -> Dict[str, Any]:
+    """Inverse of :func:`encode_tenant_payload`; every leaf re-verifies its
+    own wire envelope, so corruption anywhere in the payload raises
+    :class:`SyncIntegrityError` naming the migration context."""
+    _version, body = _groups.unpack_envelope(payload, context)
+    if len(body) < 4:
+        raise SyncIntegrityError(f"Truncated migration payload: no header length{context}.")
+    (header_len,) = struct.unpack(">I", body[:4])
+    if 4 + header_len > len(body):
+        raise SyncIntegrityError(
+            f"Truncated migration payload{context}: header claims {header_len}"
+            f" bytes, only {len(body) - 4} present."
+        )
+    try:
+        header = json.loads(body[4 : 4 + header_len].decode())
+        keys = list(header["keys"])
+        version = header["v"]
+    except (ValueError, KeyError, UnicodeDecodeError) as err:
+        raise SyncIntegrityError(f"Unparseable migration payload header{context}: {err}") from err
+    if version != _PAYLOAD_VERSION:
+        raise SyncIntegrityError(
+            f"Migration payload version {version!r} unsupported{context};"
+            f" this build speaks v{_PAYLOAD_VERSION}.",
+            transient=False,
+        )
+    offset = 4 + header_len
+    tree: Dict[str, Any] = {}
+    for key in keys:
+        if offset + 8 > len(body):
+            raise SyncIntegrityError(f"Truncated migration payload at block {key!r}{context}.")
+        (size,) = struct.unpack(">Q", body[offset : offset + 8])
+        offset += 8
+        if offset + size > len(body):
+            raise SyncIntegrityError(
+                f"Truncated migration payload{context}: block {key!r} declares"
+                f" {size} bytes, only {len(body) - offset} remain."
+            )
+        tree[key] = _groups._decode(body[offset : offset + size], context)
+        offset += size
+    return tree

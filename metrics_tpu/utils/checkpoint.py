@@ -28,6 +28,7 @@ from metrics_tpu.utils import enums as _enums
 from metrics_tpu.utils.imports import _ORBAX_AVAILABLE
 
 __all__ = [
+    "dtype_kind",
     "load_metric_state",
     "metric_state_pytree",
     "restore_metric_state_pytree",
@@ -76,12 +77,17 @@ def _decode_dynamic(value: Any) -> Any:
     return value
 
 
-def _dtype_kind(dtype: Any) -> str:
+def dtype_kind(dtype: Any) -> str:
     """Coarse dtype family for restore validation: exact widths legitimately
     differ across the x64/x32 lanes (a float64 checkpoint restored under x32
-    canonicalizes to float32), but float-vs-int-vs-bool never should."""
+    canonicalizes to float32), but float-vs-int-vs-bool never should. Shared
+    by the checkpoint restore below and the drive-resume snapshot binder
+    (``engine.driver._bind_resume``)."""
     kind = np.dtype(dtype).kind
     return {"f": "float", "V": "float", "i": "int", "u": "int", "b": "bool"}.get(kind, kind)
+
+
+_dtype_kind = dtype_kind  # backward-compatible private alias
 
 
 def restore_metric_state_pytree(metric: Metric, tree: Dict[str, Any]) -> Metric:

@@ -1,0 +1,396 @@
+"""Preemption-safe epochs: ``drive(snapshot_store=, snapshot_every=)``
+periodic carry snapshots and ``drive(resume_from=)`` re-entry (ISSUE 13).
+
+The acceptance bar: a resumed epoch — fresh metric object, snapshot bound,
+remaining steps replayed through the SAME compiled program family — finishes
+bit-identical to an uninterrupted run, including ``on_bad_input='skip'/'mask'``
+health counters and the ragged final chunk, with zero extra compiles when the
+original run's programs are cached.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    AUC,
+    Accuracy,
+    ConfusionMatrix,
+    MeanMetric,
+    MetricCollection,
+    StatScores,
+    SumMetric,
+    engine,
+    obs,
+)
+from metrics_tpu.engine import driver
+from metrics_tpu.serving import DiskStore, MemoryStore
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+NUM_CLASSES = 5
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    yield
+    engine.clear_cache()
+
+
+def _epoch(rng, n_steps=8, batch=16, c=NUM_CLASSES, nan_every=None):
+    preds = rng.rand(n_steps, batch, c).astype(np.float32)
+    target = rng.randint(0, c, size=(n_steps, batch)).astype(np.int32)
+    if nan_every:
+        for i in range(0, n_steps, nan_every):
+            preds[i, :3, 0] = np.nan
+    return jnp.asarray(preds), jnp.asarray(target)
+
+
+def _assert_state_equal(m_a, m_b):
+    sa, sb = m_a._snapshot_state(), m_b._snapshot_state()
+    assert set(sa) == set(sb)
+    for name in sa:
+        a, b = jnp.asarray(sa[name]), jnp.asarray(sb[name])
+        assert a.dtype == b.dtype, name
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+def _interrupted(stream, die_after):
+    """A host iterator that dies (raises) after ``die_after`` steps — the
+    preemption stand-in for streaming drives."""
+
+    class _Preempted(RuntimeError):
+        pass
+
+    def _gen():
+        for i, step in enumerate(stream):
+            if i == die_after:
+                raise _Preempted(f"preempted at step {i}")
+            yield step
+
+    return _gen(), _Preempted
+
+
+FACTORIES = [
+    pytest.param(lambda: SumMetric(nan_strategy="disable"), True, id="sum"),
+    pytest.param(lambda: MeanMetric(nan_strategy="disable"), True, id="mean"),
+    pytest.param(lambda: Accuracy(num_classes=NUM_CLASSES), False, id="accuracy"),
+    pytest.param(lambda: StatScores(reduce="macro", num_classes=NUM_CLASSES), False, id="stat_scores"),
+    pytest.param(lambda: ConfusionMatrix(num_classes=NUM_CLASSES), False, id="confmat"),
+]
+
+
+@pytest.mark.parametrize("factory, agg", FACTORIES)
+def test_resume_bit_identity_vs_uninterrupted(factory, agg):
+    """Interrupt a stacked epoch at a snapshot boundary; a FRESH metric
+    resumed from the store finishes bit-identical to an uninterrupted run."""
+    rng = np.random.RandomState(0)
+    preds, target = _epoch(rng, n_steps=9)
+    epoch = (jnp.sum(preds, axis=-1),) if agg else (preds, target)
+
+    m_plain = factory()
+    driver.drive(m_plain, epoch)
+
+    # "die" at step 6: drive the 6-step prefix, final snapshot seals step 6
+    store = MemoryStore()
+    m_dead = factory()
+    prefix = tuple(x[:6] for x in epoch)
+    res = driver.drive(m_dead, prefix, snapshot_store=store)
+    assert res.snapshots >= 1
+    snap = driver.load_drive_snapshot(store)
+    assert snap.step == 6 and snap.final
+
+    m_resume = factory()
+    res2 = driver.drive(m_resume, epoch, resume_from=store)
+    assert res2.steps == 3  # only the un-run suffix was consumed
+    _assert_state_equal(m_resume, m_plain)
+    np.testing.assert_array_equal(
+        np.asarray(m_resume.compute()), np.asarray(m_plain.compute())
+    )
+    assert m_resume._update_count == m_plain._update_count
+
+
+@pytest.mark.parametrize("policy", ["skip", "mask"])
+def test_resume_health_counter_parity(policy):
+    """Resume carries the quarantine bookkeeping: ``_health_counts`` state
+    AND the host-side screening counters match an uninterrupted epoch."""
+    rng = np.random.RandomState(1)
+    preds, target = _epoch(rng, n_steps=8, nan_every=3)
+
+    m_plain = Accuracy(num_classes=NUM_CLASSES, on_bad_input=policy)
+    driver.drive(m_plain, (preds, target))
+
+    store = MemoryStore()
+    m_dead = Accuracy(num_classes=NUM_CLASSES, on_bad_input=policy)
+    driver.drive(m_dead, (preds[:5], target[:5]), snapshot_store=store)
+    m_resume = Accuracy(num_classes=NUM_CLASSES, on_bad_input=policy)
+    driver.drive(m_resume, (preds, target), resume_from=store)
+
+    _assert_state_equal(m_resume, m_plain)
+    np.testing.assert_array_equal(
+        np.asarray(m_resume.compute()), np.asarray(m_plain.compute())
+    )
+    plain_rep, resume_rep = m_plain.health_report(), m_resume.health_report()
+    for key in ("batches_screened", "updates_quarantined", "rows_masked", "nan_count"):
+        assert resume_rep[key] == plain_rep[key], key
+
+
+def test_streaming_interrupt_then_resume_ragged_tail():
+    """The realistic crash: a streaming drive's host iterator dies mid-epoch
+    (after staged chunks already sealed a snapshot); resume replays the SAME
+    stream — including a ragged final batch — bit-identically."""
+    rng = np.random.RandomState(2)
+    preds, target = _epoch(rng, n_steps=10)
+    stream = [(preds[i], target[i]) for i in range(10)]
+    stream[-1] = (preds[9][:7], target[9][:7])  # ragged final chunk
+
+    m_plain = Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(m_plain, iter(stream), steps_per_chunk=2)
+
+    store = MemoryStore()
+    m_dead = Accuracy(num_classes=NUM_CLASSES)
+    dead_iter, Preempted = _interrupted(stream, die_after=7)
+    with pytest.raises(Preempted):
+        driver.drive(
+            m_dead, dead_iter, steps_per_chunk=2, snapshot_store=store, snapshot_every=2
+        )
+    snap = driver.load_drive_snapshot(store)
+    assert 0 < snap.step < 10 and not snap.final  # a genuine mid-epoch carry
+
+    m_resume = Accuracy(num_classes=NUM_CLASSES)
+    res = driver.drive(m_resume, iter(stream), steps_per_chunk=2, resume_from=store)
+    assert res.steps == 10 - snap.step
+    _assert_state_equal(m_resume, m_plain)
+    np.testing.assert_array_equal(
+        np.asarray(m_resume.compute()), np.asarray(m_plain.compute())
+    )
+
+
+def test_resume_zero_extra_compiles():
+    """Resuming re-enters the SAME compiled program family: with the chunk
+    geometry cached by the interrupted run, the resumed drive costs zero new
+    compiles (the ISSUE-13 acceptance gate)."""
+    rng = np.random.RandomState(3)
+    preds, target = _epoch(rng, n_steps=8)
+    store = MemoryStore()
+
+    m_dead = Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(
+        m_dead, (preds[:4], target[:4]), snapshot_store=store, snapshot_every=2
+    )  # compiles the [2, batch] slice program
+    before = engine.cache_summary()["compiles"]
+
+    m_resume = Accuracy(num_classes=NUM_CLASSES)
+    res = driver.drive(
+        m_resume,
+        (preds, target),
+        resume_from=store,
+        snapshot_store=store,
+        snapshot_every=2,
+    )
+    assert res.steps == 4 and res.snapshots >= 1
+    assert engine.cache_summary()["compiles"] == before  # cache hits only
+
+    m_plain = Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(m_plain, (preds, target))
+    _assert_state_equal(m_resume, m_plain)
+
+
+def test_sliced_snapshot_epoch_matches_single_launch():
+    """``snapshot_every < steps`` dispatches a stacked epoch in slices of
+    the same scan family — bit-identical to the one-launch epoch."""
+    rng = np.random.RandomState(4)
+    preds, target = _epoch(rng, n_steps=7)
+    m_one = ConfusionMatrix(num_classes=NUM_CLASSES)
+    driver.drive(m_one, (preds, target))
+    store = MemoryStore()
+    m_sliced = ConfusionMatrix(num_classes=NUM_CLASSES)
+    res = driver.drive(
+        m_sliced, (preds, target), snapshot_store=store, snapshot_every=3
+    )
+    assert res.chunks == 3  # 3 + 3 + 1
+    assert res.snapshots == 3  # boundaries at 3, 6 + the final at 7
+    _assert_state_equal(m_sliced, m_one)
+    assert driver.load_drive_snapshot(store).step == 7
+
+
+def test_resume_of_completed_epoch_is_idempotent_noop():
+    """Resuming from a FINAL snapshot that already covers the whole epoch
+    binds the states and consumes nothing — double recovery is safe, and a
+    never-updated fresh instance computes via the snapshot's dynamic attrs
+    (Accuracy.mode)."""
+    rng = np.random.RandomState(5)
+    preds, target = _epoch(rng, n_steps=6)
+    store = MemoryStore()
+    m_full = Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(m_full, (preds, target), snapshot_store=store)
+
+    m_again = Accuracy(num_classes=NUM_CLASSES)
+    res = driver.drive(m_again, (preds, target), resume_from=store)
+    assert res.steps == 0 and res.chunks == 0
+    _assert_state_equal(m_again, m_full)
+    np.testing.assert_array_equal(
+        np.asarray(m_again.compute()), np.asarray(m_full.compute())
+    )
+    assert m_again._update_count == m_full._update_count
+
+
+def test_empty_epoch_with_snapshot_store_still_seals_a_final_snapshot():
+    """A legitimately empty shard (0 steps) must still write its final
+    snapshot: a uniform restart script calls drive(resume_from=store) on
+    every worker, and the empty one should no-op like the rest — not raise
+    KeyError because the snapshotted drive 'never ran'."""
+    store = MemoryStore()
+    m = SumMetric(nan_strategy="disable")
+    res = driver.drive(m, (jnp.zeros((0, 4)),), snapshot_store=store)
+    assert res.steps == 0 and res.snapshots == 1
+    m2 = SumMetric(nan_strategy="disable")
+    res2 = driver.drive(m2, (jnp.zeros((0, 4)),), resume_from=store)  # no KeyError
+    assert res2.steps == 0
+    # the streaming flavor of the same contract
+    store2 = MemoryStore()
+    res3 = driver.drive(SumMetric(nan_strategy="disable"), iter([]), snapshot_store=store2)
+    assert res3.snapshots == 1
+    driver.drive(SumMetric(nan_strategy="disable"), iter([]), resume_from=store2)
+
+
+def test_collection_resume_parity():
+    rng = np.random.RandomState(6)
+    preds, target = _epoch(rng, n_steps=8)
+    def make():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=NUM_CLASSES),
+                "confmat": ConfusionMatrix(num_classes=NUM_CLASSES),
+            }
+        )
+
+    mc_plain = make()
+    driver.drive(mc_plain, (preds, target))
+
+    store = MemoryStore()
+    mc_dead = make()
+    driver.drive(mc_dead, (preds[:5], target[:5]), snapshot_store=store)
+    mc_resume = make()
+    driver.drive(mc_resume, (preds, target), resume_from=store)
+    for key in ("acc", "confmat"):
+        _assert_state_equal(mc_resume[key], mc_plain[key])
+    plain_vals, resume_vals = mc_plain.compute(), mc_resume.compute()
+    for key in plain_vals:
+        np.testing.assert_array_equal(
+            np.asarray(resume_vals[key]), np.asarray(plain_vals[key])
+        )
+
+
+def test_disk_store_snapshot_round_trip(tmp_path):
+    """Snapshots seal into a DiskStore and load back across store objects —
+    the actual preemption path (a NEW process opens the same root)."""
+    rng = np.random.RandomState(7)
+    preds, target = _epoch(rng, n_steps=6)
+    m_full = Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(m_full, (preds, target))
+
+    m_dead = Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(
+        m_dead,
+        (preds[:4], target[:4]),
+        snapshot_store=DiskStore(str(tmp_path / "snap")),
+    )
+    m_resume = Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(
+        m_resume, (preds, target), resume_from=DiskStore(str(tmp_path / "snap"))
+    )
+    _assert_state_equal(m_resume, m_full)
+
+
+def test_snapshot_events_and_durability_stats():
+    from metrics_tpu.serving import durability_stats
+
+    rng = np.random.RandomState(8)
+    preds, target = _epoch(rng, n_steps=6)
+    store = MemoryStore()
+    before = durability_stats()
+    with obs.capture() as events:
+        m = Accuracy(num_classes=NUM_CLASSES)
+        driver.drive(m, (preds, target), snapshot_store=store, snapshot_every=2)
+        m2 = Accuracy(num_classes=NUM_CLASSES)
+        driver.drive(m2, (preds, target), resume_from=store)
+    kinds = [e.kind for e in events]
+    snaps = [e for e in events if e.kind == "snapshot"]
+    assert len(snaps) == 3 and snaps[-1].data["final"]
+    assert any(
+        e.kind == "recover" and e.data.get("scope") == "drive" for e in events
+    )
+    after = durability_stats()
+    assert after["snapshots"] - before["snapshots"] == 3
+    assert after["resumes"] - before["resumes"] == 1
+    assert after["snapshot_bytes"] > before["snapshot_bytes"]
+
+
+def test_resume_validation_errors():
+    rng = np.random.RandomState(9)
+    preds, target = _epoch(rng, n_steps=4)
+    store = MemoryStore()
+    m = Accuracy(num_classes=NUM_CLASSES)
+    driver.drive(m, (preds, target), snapshot_store=store)
+
+    # a shorter epoch than the snapshot's step index cannot be "the same run"
+    with pytest.raises(MetricsUserError, match="holds only 2 steps"):
+        driver.drive(
+            Accuracy(num_classes=NUM_CLASSES),
+            (preds[:2], target[:2]),
+            resume_from=store,
+        )
+    # different composition
+    with pytest.raises(MetricsUserError, match="composition"):
+        driver.drive(
+            MetricCollection({"acc": Accuracy(num_classes=NUM_CLASSES)}),
+            (preds, target),
+            resume_from=store,
+        )
+    # different class entirely (state-name mismatch)
+    with pytest.raises(MetricsUserError, match="different class or config"):
+        driver.drive(
+            ConfusionMatrix(num_classes=NUM_CLASSES),
+            (preds, target),
+            resume_from=store,
+        )
+    # same class, different config (state shapes disagree)
+    with pytest.raises(MetricsUserError, match="shape"):
+        driver.drive(
+            Accuracy(num_classes=NUM_CLASSES, average="macro"),
+            (preds, target),
+            resume_from=store,
+        )
+    # unknown snapshot key
+    with pytest.raises(KeyError, match="no drive snapshot"):
+        driver.load_drive_snapshot(store, "elsewhere")
+
+
+def test_snapshot_rejects_mesh_and_eager_members():
+    rng = np.random.RandomState(10)
+    preds, target = _epoch(rng, n_steps=4)
+    store = MemoryStore()
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("batch",))
+    with pytest.raises(ValueError, match="LOCAL epoch path"):
+        driver.drive(
+            Accuracy(num_classes=NUM_CLASSES),
+            (preds, target),
+            axis_name="batch",
+            mesh=mesh,
+            snapshot_store=store,
+        )
+    # an eager/list-state member's state never rides the scan carry
+    scores = jnp.asarray(np.random.RandomState(0).rand(4, 16).astype(np.float32))
+    with pytest.raises(MetricsUserError, match="scan-drivable"):
+        driver.drive(AUC(), (scores, scores), snapshot_store=store)
+    with pytest.raises(ValueError, match="snapshot_every must be >= 1"):
+        driver.drive(
+            Accuracy(num_classes=NUM_CLASSES),
+            (preds, target),
+            snapshot_store=store,
+            snapshot_every=0,
+        )

@@ -1,0 +1,291 @@
+"""Whole-process crash recovery (ISSUE 13): ``Fleet.die`` and the
+``METRICS_TPU_FAULTS`` ``'die'`` kind.
+
+``die`` is ``kill`` minus the dead process's memory: the worker's bank and
+router objects are dropped BEFORE recovery starts, so every recovered byte
+must come from the durable spill store (journal + sealed blobs). With the
+fleet's default checkpoint cadence of 1, acked state restores bit-identical;
+requests the worker accepted but never flushed are lost — the documented
+durability window.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, SumMetric, engine
+from metrics_tpu.fleet import Fleet, FleetRouter
+from metrics_tpu.serving import DiskStore, MetricBank
+from metrics_tpu.serving import store as store_mod
+
+NUM_CLASSES = 5
+N_TENANTS = 16
+N_STEPS = 6
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    yield
+    engine.clear_cache()
+
+
+def _template():
+    return Accuracy(num_classes=NUM_CLASSES)
+
+
+def _stream(seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for step in range(N_STEPS):
+        for i in range(N_TENANTS):
+            preds = jnp.asarray(rng.rand(8, NUM_CLASSES).astype(np.float32))
+            target = jnp.asarray(rng.randint(0, NUM_CLASSES, size=8).astype(np.int32))
+            out.append((step, f"t{i}", (preds, target)))
+    return out
+
+
+def _run_static(stream, workers):
+    fleet = Fleet(_template(), workers=workers, capacity=N_TENANTS, max_delay_s=None)
+    router = FleetRouter(fleet)
+    for _step, tenant, args in stream:
+        router.submit(tenant, *args)
+    router.flush()
+    return {t: np.asarray(v) for t, v in fleet.compute_all().items()}
+
+
+def test_die_mid_epoch_is_bit_identical_to_static_fleet():
+    """The headline: a worker whose PROCESS crashes at step 3 (memory gone,
+    store only) — with everything flushed, the fleet finishes bit-identical
+    to a static fleet that never lost anyone."""
+    stream = _stream()
+    static = _run_static(stream, workers=[0, 1, 2])
+
+    fleet = Fleet(_template(), workers=[0, 1, 2], capacity=N_TENANTS, max_delay_s=None)
+    router = FleetRouter(fleet)
+    died = False
+    for step, tenant, args in stream:
+        if step == 3 and not died:
+            router.flush()
+            victim = fleet.workers[-1]
+            owned_before = [t for t in [f"t{i}" for i in range(N_TENANTS)]
+                            if fleet.owner_of(t) == victim]
+            moves = fleet.die(victim)
+            died = True
+            assert fleet.stats["dies"] == 1 and fleet.stats["kills"] == 1
+            assert victim not in fleet.epoch.workers
+            assert sorted(moves) == sorted(owned_before)  # every acked session recovered
+        router.submit(tenant, *args)
+    router.flush()
+    final = {t: np.asarray(v) for t, v in fleet.compute_all().items()}
+    assert set(final) == set(static)
+    for t in static:
+        np.testing.assert_array_equal(final[t], static[t], err_msg=t)
+
+
+def test_die_recovery_reads_zero_bytes_from_dead_memory():
+    """After ``die`` the worker shell has ``bank is None`` — the recovered
+    states can only have come from the spill store."""
+    fleet = Fleet(_template(), workers=[0, 1], capacity=N_TENANTS, max_delay_s=None)
+    solos = {}
+    for i in range(8):
+        t, args = f"t{i}", _stream()[i][2]
+        solos[t] = _template()
+        solos[t].update(*args)
+        fleet.submit(t, *args)
+    fleet.flush()
+    victim = 0
+    shell = fleet._workers[victim]
+    fleet.die(victim)
+    assert shell.bank is None and shell.router is None  # memory really gone
+    for t, solo in solos.items():
+        np.testing.assert_array_equal(
+            np.asarray(fleet.compute(t)), np.asarray(solo.compute()), err_msg=t
+        )
+    # the dead namespace was swept as sessions re-admitted elsewhere
+    live, _torn = store_mod.replay_journal(shell.store, shell.bank_name)
+    assert live == {}
+
+
+def test_die_loses_unflushed_requests_kill_does_not():
+    """The semantic line between the two fells: ``kill`` re-submits the dead
+    router's pending requests (its memory survived); ``die`` cannot — they
+    were never durable."""
+    def build():
+        fleet = Fleet(_template(), workers=[0, 1], capacity=N_TENANTS, max_delay_s=None)
+        acked = {}
+        for i in range(8):
+            t, args = f"t{i}", _stream()[i][2]
+            acked[t] = args
+            fleet.submit(t, *args)
+        fleet.flush()
+        pending = {}
+        for i in range(8):
+            t, args = f"t{i}", _stream(seed=7)[i][2]
+            pending[t] = args
+            fleet.submit(t, *args)  # max_delay_s=None: stays pending
+        return fleet, acked, pending
+
+    for fell, keeps_pending in [("kill", True), ("die", False)]:
+        fleet, acked, pending = build()
+        victim = 0
+        victims_tenants = [t for t in acked if fleet.owner_of(t) == victim]
+        assert victims_tenants  # rendezvous spread across 2 workers
+        getattr(fleet, fell)(victim)
+        fleet.flush()
+        for t in acked:
+            solo = _template()
+            solo.update(*acked[t])
+            was_victims = t in victims_tenants
+            if keeps_pending or not was_victims:
+                solo.update(*pending[t])
+            np.testing.assert_array_equal(
+                np.asarray(fleet.compute(t)),
+                np.asarray(solo.compute()),
+                err_msg=f"{fell}:{t}",
+            )
+
+
+def test_die_with_shared_disk_store(tmp_path):
+    """A fleet over a shared ``DiskStore``: die-recovery reads sealed blobs
+    off disk, and the per-worker journal namespaces ride the stable fleet
+    name."""
+    store = DiskStore(str(tmp_path / "fleet-store"))
+    fleet = Fleet(
+        _template(), workers=[0, 1], capacity=N_TENANTS,
+        name="prod", max_delay_s=None, durable_store=store,
+    )
+    solos = {}
+    for i in range(10):
+        t, args = f"t{i}", _stream()[i][2]
+        solos[t] = _template()
+        solos[t].update(*args)
+        fleet.submit(t, *args)
+    fleet.flush()
+    assert fleet._workers[0].bank_name == "prod:0"  # stable journal namespace
+    fleet.die(1)
+    for t, solo in solos.items():
+        np.testing.assert_array_equal(
+            np.asarray(fleet.compute(t)), np.asarray(solo.compute()), err_msg=t
+        )
+    # the surviving worker's sessions are ALSO crash-recoverable from disk:
+    # the store, not the fleet object, is the durable authority
+    survivor = fleet._workers[0]
+    payloads = store_mod.durable_tenant_payloads(store, survivor.bank_name)
+    assert sorted(payloads) == sorted(
+        t for t in solos if fleet.owner_of(t) == 0
+    )
+    recovered = MetricBank.recover(_template(), N_TENANTS, store, name="prod:0")
+    for t in payloads:
+        np.testing.assert_array_equal(
+            np.asarray(recovered.compute(t)), np.asarray(solos[t].compute()), err_msg=t
+        )
+
+
+def test_die_sweeps_journal_live_tenant_whose_blob_is_missing(tmp_path):
+    """The write-ahead window: a crash between the admit journal record and
+    the defaults-blob put leaves a journal-live session with no payload.
+    Recovery must SWEEP it (its next request admits fresh at defaults on the
+    new owner) — skipping it silently left ``Worker.tenants`` non-empty, so
+    the dead worker was never deregistered and re-scanned forever."""
+    store = DiskStore(str(tmp_path / "fleet-store"))
+    fleet = Fleet(
+        _template(), workers=[0, 1], capacity=N_TENANTS,
+        name="gap", max_delay_s=None, durable_store=store,
+    )
+    solos = {}
+    for i in range(6):
+        t, args = f"t{i}", _stream()[i][2]
+        solos[t] = _template()
+        solos[t].update(*args)
+        fleet.submit(t, *args)
+    fleet.flush()
+    victim = 1
+    victim_tenants = [t for t in solos if fleet.owner_of(t) == victim]
+    assert victim_tenants  # rendezvous should split 6 tenants over 2 workers
+    # forge the window on one of the victim's sessions: journal says admit,
+    # blob gone (the crash landed before the defaults put)
+    bank_name = fleet._workers[victim].bank_name
+    gap = victim_tenants[0]
+    store.delete(store_mod.tenant_blob_key(bank_name, store_mod.durable_token(gap)))
+    fleet.die(victim)
+    assert victim not in fleet._workers  # deregistered, not re-scanned forever
+    # the acked co-tenants recovered bit-identically...
+    for t in victim_tenants[1:]:
+        np.testing.assert_array_equal(
+            np.asarray(fleet.compute(t)), np.asarray(solos[t].compute()), err_msg=t
+        )
+    # ...and the gap session serves fresh-at-defaults, like a new admission
+    req = _stream(7)[0][2]
+    fleet.submit(gap, *req)
+    fleet.flush()
+    fresh = _template()
+    fresh.update(*req)
+    np.testing.assert_array_equal(
+        np.asarray(fleet.compute(gap)), np.asarray(fresh.compute())
+    )
+
+
+def test_fault_plan_die_kind_fells_destination_at_admit(monkeypatch):
+    """The ``METRICS_TPU_FAULTS`` ``'die'`` regression: the migration
+    destination's PROCESS crashes the moment it is asked to admit. The
+    payload survives in the ledger, recovery comes from the store, and the
+    tenant lands on a survivor with its pre-drain state intact."""
+    monkeypatch.setenv(
+        "METRICS_TPU_FAULTS", '[{"kind": "die", "rank": 2, "epoch": 1}]'
+    )
+    fleet = Fleet(
+        SumMetric(nan_strategy="disable"), workers=[0, 1], capacity=16, max_delay_s=None
+    )
+    rng = np.random.RandomState(2)
+    solo = {}
+    for i in range(20):
+        t = f"t{i}"
+        x = jnp.asarray(rng.rand(4).astype(np.float32))
+        solo[t] = SumMetric(nan_strategy="disable")
+        solo[t].update(x)
+        fleet.submit(t, x)
+    fleet.flush()
+    fleet.join(2)  # epoch v1: worker 2 is plan-died on first admit
+    assert fleet.stats["dies"] == 1
+    assert 2 not in fleet.epoch.workers and fleet.workers == [0, 1]
+    dead_shell = fleet._workers.get(2)
+    assert dead_shell is None or dead_shell.bank is None  # memory dropped
+    for t, m in solo.items():
+        np.testing.assert_array_equal(
+            np.asarray(fleet.compute(t)), np.asarray(m.compute()), err_msg=t
+        )
+    assert fleet.ledger.pending() == []
+
+
+def test_graceful_leave_drains_through_the_store():
+    """Satellite: graceful ``leave`` exports THROUGH the spill store — the
+    same sealed-payload route a crash recovery reads — so both paths
+    exercise one codec, and the leaver's durable namespace is swept."""
+    from metrics_tpu.serving import durability_stats
+
+    fleet = Fleet(_template(), workers=[0, 1], capacity=N_TENANTS, max_delay_s=None)
+    solos = {}
+    for i in range(8):
+        t, args = f"t{i}", _stream()[i][2]
+        solos[t] = _template()
+        solos[t].update(*args)
+        fleet.submit(t, *args)
+    fleet.flush()
+    leaver = 1
+    shell = fleet._workers[leaver]
+    reads_before = durability_stats()["blob_reads"]
+    fleet.leave(leaver)
+    assert durability_stats()["blob_reads"] > reads_before  # store-read export
+    for t, solo in solos.items():
+        np.testing.assert_array_equal(
+            np.asarray(fleet.compute(t)), np.asarray(solo.compute()), err_msg=t
+        )
+    live, _torn = store_mod.replay_journal(shell.store, shell.bank_name)
+    assert live == {}  # exports journaled: nothing left filed under the leaver
+
+
+def test_die_unknown_worker_raises():
+    fleet = Fleet(_template(), workers=[0], capacity=4)
+    with pytest.raises(KeyError):
+        fleet.die(99)

@@ -300,6 +300,7 @@ def test_jsonl_roundtrip_and_prometheus_render():
         "sharding",
         "encoders",
         "fleet",
+        "durability",
         "bus",
         "spans",
         "warnings",
@@ -336,6 +337,19 @@ def test_jsonl_roundtrip_and_prometheus_render():
 
     assert process["fleet"] == _fleet.fleet_stats()
     assert {"migrations", "rebalance_bytes", "kills", "fleets"} <= set(process["fleet"])
+    from metrics_tpu import serving as _serving
+
+    assert process["durability"] == _serving.durability_stats()
+    assert {
+        "journal_appends",
+        "torn_records",
+        "spill_writes",
+        "checkpoints",
+        "recovers",
+        "recovered_tenants",
+        "snapshots",
+        "resumes",
+    } <= set(process["durability"])
     # ...and the Prometheus dump mirrors the fetch + warmup + sharding +
     # fleet counters
     assert "metrics_tpu_engine_async_fetches" in text
@@ -346,6 +360,8 @@ def test_jsonl_roundtrip_and_prometheus_render():
     assert "metrics_tpu_shard_reshard_events" in text
     assert "metrics_tpu_fleet_migrations" in text
     assert "metrics_tpu_fleet_rebalance_bytes" in text
+    assert "metrics_tpu_durable_journal_appends" in text
+    assert "metrics_tpu_durable_recovers" in text
 
 
 def test_validate_jsonl_rejects_bad_lines():

@@ -176,3 +176,18 @@ def test_kill_specs_parse_and_match_but_never_touch_kv():
     assert not plan.drops_publish("pg/s/1/2")
     assert plan.read_delay_s("pg/s/1/2") == 0.0
     assert plan.maybe_corrupt("pg/s/1/2", b"x") == b"x"
+
+
+def test_die_specs_parse_and_match_like_kill_with_crash_semantics():
+    """The 'die' kind (ISSUE 13): same (rank, epoch) matching as 'kill',
+    separate predicate (the fleet drops the worker's memory on a die), and
+    equally invisible to KV-level behavior."""
+    plan = parse_plan('[{"kind": "die", "rank": 2, "epoch": 1}]')
+    assert plan.dies(2, 1)
+    assert plan.dies(2, None)  # unknown epoch: conservative match
+    assert not plan.dies(2, 0) and not plan.dies(1, 1)
+    assert not plan.kills(2, 1)  # die is not kill: distinct predicates
+    assert FaultPlan([FaultSpec("die", rank=0)]).dies(0, 99)  # every epoch
+    assert not plan.drops_publish("pg/s/1/2")
+    assert plan.read_delay_s("pg/s/1/2") == 0.0
+    assert plan.maybe_corrupt("pg/s/1/2", b"x") == b"x"

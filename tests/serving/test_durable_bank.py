@@ -1,0 +1,701 @@
+"""The durable state plane (ISSUE 13): pluggable spill tiers, the
+write-ahead tenant journal, and ``MetricBank.recover``.
+
+The acceptance bar: a ``kill -9``'d worker process is rebuilt from its
+``DiskStore`` with every previously-acked tenant's state bit-identical and
+ZERO reliance on the dead process's memory; torn/corrupted journal tails are
+detected (crc) and cleanly ignored; double recovery is idempotent; spill and
+journal payloads always encode EXACT regardless of ``sync_precision`` tags.
+"""
+import os
+import signal
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, Metric, StatScores, engine, obs
+from metrics_tpu.serving import DiskStore, MemoryStore, MetricBank, durability_stats
+from metrics_tpu.serving import store as store_mod
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+NUM_CLASSES = 5
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    yield
+    engine.clear_cache()
+
+
+def _req(seed, batch=8):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.rand(batch, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, size=batch).astype(np.int32)),
+    )
+
+
+def _assert_tenant_equals_solo(bank, tenant, solo):
+    state = bank.tenant_state(tenant)
+    for name, value in solo._snapshot_state().items():
+        np.testing.assert_array_equal(
+            np.asarray(value), np.asarray(state[name]), err_msg=f"{tenant}:{name}"
+        )
+    assert bank.update_count(tenant) == solo._update_count
+    np.testing.assert_array_equal(
+        np.asarray(bank.compute(tenant)), np.asarray(solo.compute())
+    )
+
+
+# ---------------------------------------------------------------------------
+# store protocol
+# ---------------------------------------------------------------------------
+@pytest.fixture(params=["memory", "disk"])
+def any_store(request, tmp_path):
+    if request.param == "memory":
+        return MemoryStore()
+    return DiskStore(str(tmp_path / "store"))
+
+
+def test_store_blob_round_trip(any_store):
+    assert not any_store.exists("k")
+    any_store.put("k", b"payload-1")
+    assert any_store.exists("k") and any_store.get("k") == b"payload-1"
+    any_store.put("k", b"payload-2")  # atomic overwrite
+    assert any_store.get("k") == b"payload-2"
+    any_store.delete("k")
+    assert not any_store.exists("k")
+    any_store.delete("k")  # idempotent
+    with pytest.raises(KeyError):
+        any_store.get("k")
+
+
+def test_store_journal_round_trip(any_store):
+    assert any_store.journal_frames("j") == []
+    records = [store_mod.seal_record({"op": "admit", "i": i}) for i in range(5)]
+    for r in records:
+        any_store.append_journal("j", r)
+    assert any_store.journal_frames("j") == records
+    decoded, torn = store_mod.read_journal(any_store, "j")
+    assert torn == 0 and [r["i"] for r in decoded] == list(range(5))
+    any_store.rewrite_journal("j", records[:2])  # compaction surface
+    assert any_store.journal_frames("j") == records[:2]
+
+
+def test_disk_journal_torn_tail_is_dropped(tmp_path):
+    """A ``kill -9`` mid-append leaves a partial frame; the reader drops it
+    and keeps every sealed record before it."""
+    store = DiskStore(str(tmp_path / "store"))
+    good = [store_mod.seal_record({"op": "admit", "i": i}) for i in range(3)]
+    for r in good:
+        store.append_journal("j", r)
+    path = store._journal_path("j")
+    with open(path, "ab") as f:
+        f.write(struct.pack(">I", 1 << 20) + b"short")  # frame torn mid-body
+    assert store.journal_frames("j") == good
+    with open(path, "ab") as f:
+        f.write(b"\x00\x01")  # not even a full length prefix
+    assert store.journal_frames("j") == good
+
+
+def test_read_journal_stops_at_crc_corrupted_record(any_store):
+    good = store_mod.seal_record({"op": "admit", "t": ["s", "a"]})
+    bad = bytearray(store_mod.seal_record({"op": "admit", "t": ["s", "b"]}))
+    bad[-1] ^= 0xFF  # flip a payload bit: crc must catch it
+    after = store_mod.seal_record({"op": "admit", "t": ["s", "c"]})
+    for frame in (good, bytes(bad), after):
+        any_store.append_journal("j", frame)
+    before = durability_stats()["torn_records"]
+    records, torn = store_mod.read_journal(any_store, "j")
+    # everything from the corrupted record on is the tail a crash wrote
+    assert [r["t"][1] for r in records] == ["a"] and torn == 2
+    assert durability_stats()["torn_records"] == before + 2
+
+
+def test_durable_token_round_trip_and_rejection():
+    for tenant in ["a", 1, 0, True, False, 2.5, None]:
+        token = store_mod.durable_token(tenant)
+        back = store_mod.token_tenant(token)
+        assert back == tenant and type(back) is type(tenant)
+    # 1 and "1" and True stay distinct sessions
+    keys = {store_mod.token_key(store_mod.durable_token(t)) for t in [1, "1", True, 1.0]}
+    assert len(keys) == 4
+    with pytest.raises(MetricsUserError, match="durable state plane"):
+        store_mod.durable_token(("tuple", "id"))
+
+
+def test_bank_rejects_unjournalable_tenant_id():
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=2)
+    with pytest.raises(MetricsUserError, match="durable state plane"):
+        bank.update(("t", 0), *_req(0))
+
+
+# ---------------------------------------------------------------------------
+# recovery (in-process crash: the bank object is discarded)
+# ---------------------------------------------------------------------------
+def _serve(bank, tenants, n_steps, solos=None):
+    for step in range(n_steps):
+        for i, t in enumerate(tenants):
+            req = _req(1000 * step + i)
+            bank.update(t, *req)
+            if solos is not None:
+                solos[t].update(*req)
+
+
+def test_recover_restores_every_acked_tenant_bit_identically(tmp_path):
+    store = DiskStore(str(tmp_path / "store"))
+    tenants = [f"t{i}" for i in range(5)]
+    solos = {t: Accuracy(num_classes=NUM_CLASSES) for t in tenants}
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES),
+        capacity=2,  # 5 tenants through 2 slots: constant spill churn
+        name="crashable",
+        spill_store=store,
+        checkpoint_every_n_flushes=1,
+    )
+    _serve(bank, tenants, 4, solos)
+    assert bank.stats["spills"] > 0 and bank.stats["checkpoints"] > 0
+    del bank  # the process "dies": nothing survives but the DiskStore
+
+    with obs.capture() as events:
+        recovered = MetricBank.recover(
+            Accuracy(num_classes=NUM_CLASSES), 2, store, name="crashable"
+        )
+    assert sorted(recovered.spilled_tenants) == tenants  # staged, not resident
+    for t in tenants:
+        _assert_tenant_equals_solo(recovered, t, solos[t])
+    # the recovered bank keeps serving — and stays durable
+    req = _req(99)
+    recovered.update("t0", *req)
+    solos["t0"].update(*req)
+    _assert_tenant_equals_solo(recovered, "t0", solos["t0"])
+    recover_events = [e for e in events if e.kind == "recover"]
+    assert recover_events and recover_events[0].data["tenants"] == 5
+
+
+def test_double_recovery_is_idempotent(tmp_path):
+    store = DiskStore(str(tmp_path / "store"))
+    solos = {t: Accuracy(num_classes=NUM_CLASSES) for t in ["a", "b"]}
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=2, name="twice",
+        spill_store=store, checkpoint_every_n_flushes=1,
+    )
+    _serve(bank, ["a", "b"], 3, solos)
+    del bank
+    first = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 2, store, name="twice")
+    second = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 2, store, name="twice")
+    assert sorted(first.spilled_tenants) == sorted(second.spilled_tenants) == ["a", "b"]
+    for t in ["a", "b"]:
+        _assert_tenant_equals_solo(second, t, solos[t])
+
+
+def test_recover_ignores_torn_journal_tail(tmp_path):
+    store = DiskStore(str(tmp_path / "store"))
+    solos = {"a": Accuracy(num_classes=NUM_CLASSES)}
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=1, name="torn",
+        spill_store=store, checkpoint_every_n_flushes=1,
+    )
+    _serve(bank, ["a"], 3, solos)
+    del bank
+    # the crash interrupted an append: partial frame + a crc-corrupted record
+    with open(store._journal_path("torn"), "ab") as f:
+        corrupted = bytearray(store_mod.seal_record({"op": "drop", "t": ["s", "a"]}))
+        corrupted[-1] ^= 0xFF
+        f.write(struct.pack(">I", len(corrupted)) + bytes(corrupted))
+        f.write(struct.pack(">I", 999))  # torn mid-frame
+    recovered = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 1, store, name="torn")
+    # the corrupted "drop" tail did NOT erase the session
+    assert recovered.spilled_tenants == ["a"]
+    _assert_tenant_equals_solo(recovered, "a", solos["a"])
+
+
+def test_framing_torn_tail_is_counted_and_truncated_before_append(tmp_path):
+    """A kill -9 mid-append leaves a half-written frame: read_journal must
+    COUNT it (torn=0 would read back as a clean shutdown), and a later
+    append must TRUNCATE it first — appending after a phantom length-prefix
+    buries the new record inside it, so replay would never see it."""
+    store = DiskStore(str(tmp_path / "store"))
+    store.append_journal("j", store_mod.seal_record({"op": "admit", "t": ["s", "a"]}))
+    path = store._journal_path("j")
+    with open(path, "ab") as f:
+        f.write(struct.pack(">I", 999) + b"partial")  # the crash's tail
+    records, torn = store_mod.read_journal(store, "j")
+    assert [r["op"] for r in records] == ["admit"] and torn == 1
+    # a FRESH store handle (the post-crash process) appends a drop: the torn
+    # tail must not swallow it
+    store2 = DiskStore(str(tmp_path / "store"))
+    store_mod.journal_drop(store2, "j", "a")
+    live, torn2 = store_mod.replay_journal(store2, "j")
+    assert live == {} and torn2 == 0  # drop replayed; tail gone
+
+
+def test_journal_drop_on_dead_namespace_survives_torn_tail(tmp_path):
+    """The fleet recovery sweep journal_drops tenants out of a DEAD worker's
+    namespace — whose journal plausibly ends in the crash's torn frame."""
+    store = DiskStore(str(tmp_path / "store"))
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=1, name="deadns",
+        spill_store=store, checkpoint_every_n_flushes=1,
+    )
+    bank.update("a", *_req(0))
+    del bank
+    with open(store._journal_path("deadns"), "ab") as f:
+        f.write(struct.pack(">I", 999))
+    fresh = DiskStore(str(tmp_path / "store"))  # the recovering process
+    assert "a" in store_mod.durable_tenant_payloads(fresh, "deadns")
+    store_mod.journal_drop(fresh, "deadns", "a")
+    assert store_mod.durable_tenant_payloads(fresh, "deadns") == {}
+
+
+def test_async_checkpoint_correct_across_fluctuating_dirty_counts(tmp_path):
+    """The async gather pow2-pads its row index; seals must stay exact for
+    every dirty-set size (pad rows are never read back)."""
+    store = DiskStore(str(tmp_path / "store"))
+    tenants = ["a", "b", "c"]
+    solos = {t: Accuracy(num_classes=NUM_CLASSES) for t in tenants}
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=4, name="fluct",
+        spill_store=store, checkpoint_async=True,
+    )
+    for i, t in enumerate(tenants):  # 3 dirty
+        req = _req(i)
+        bank.update(t, *req)
+        solos[t].update(*req)
+    bank.checkpoint()  # stage 3 (padded to 4)
+    req = _req(9)
+    bank.update("a", *req)  # 1 dirty
+    solos["a"].update(*req)
+    bank.checkpoint()  # seals the 3-batch, stages the 1-batch
+    bank.checkpoint()  # seals the 1-batch
+    del bank
+    recovered = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 4, store, name="fluct")
+    for t in tenants:
+        _assert_tenant_equals_solo(recovered, t, solos[t])
+
+
+def test_recover_rewrites_torn_journal_so_later_records_replay(tmp_path):
+    """recover() must REWRITE the journal, not append to it: appending after
+    a torn length-prefix buries every post-recovery record inside the
+    phantom frame, so a second crash would replay to the FIRST crash point —
+    resurrecting drops and losing new admissions."""
+    store = DiskStore(str(tmp_path / "store"))
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=1, name="rewound",
+        spill_store=store, checkpoint_every_n_flushes=1,
+    )
+    bank.update("a", *_req(0))
+    del bank
+    with open(store._journal_path("rewound"), "ab") as f:
+        f.write(struct.pack(">I", 999))  # torn length-prefix, no body
+    recovered = MetricBank.recover(
+        Accuracy(num_classes=NUM_CLASSES), 1, store, name="rewound",
+        checkpoint_every_n_flushes=1,  # bank_kwargs forward: keep the cadence
+    )
+    assert recovered.spilled_tenants == ["a"]
+    # post-recovery lifecycle: drop 'a', admit + checkpoint 'b'
+    recovered.evict("a", spill=False)
+    solo_b = Accuracy(num_classes=NUM_CLASSES)
+    req = _req(5)
+    recovered.update("b", *req)
+    solo_b.update(*req)
+    del recovered
+    # the second crash must see the post-recovery truth, not the first one's
+    again = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 1, store, name="rewound")
+    assert sorted(again.tenants + again.spilled_tenants) == ["b"]
+    _assert_tenant_equals_solo(again, "b", solo_b)
+
+
+def test_checkpoint_cadence_bounds_the_durability_window(tmp_path):
+    """``checkpoint_every_n_flushes=None``: only explicit checkpoints reach
+    the store — recovery restores the last checkpoint, not the last flush."""
+    store = DiskStore(str(tmp_path / "store"))
+    solo = Accuracy(num_classes=NUM_CLASSES)
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=1, name="window", spill_store=store
+    )
+    for step in range(2):
+        req = _req(step)
+        bank.update("a", *req)
+        solo.update(*req)
+    assert bank.checkpoint() == 1  # seal the dirty resident now
+    bank.update("a", *_req(7))  # applied but never durable
+    del bank
+    recovered = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 1, store, name="window")
+    _assert_tenant_equals_solo(recovered, "a", solo)  # the un-checkpointed flush is lost
+
+
+def test_never_checkpointed_admission_recovers_at_defaults(tmp_path):
+    """The write-ahead contract: an admitted session whose traffic never
+    reached the store recovers at the registered defaults, not as a lost
+    session."""
+    store = DiskStore(str(tmp_path / "store"))
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=2, name="wa", spill_store=store
+    )
+    bank.admit("fresh")
+    bank.update("served", *_req(0))  # cadence None: not durable either
+    del bank
+    recovered = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 2, store, name="wa")
+    assert sorted(recovered.spilled_tenants) == ["fresh", "served"]
+    assert recovered.update_count("fresh") == 0
+    template = Accuracy(num_classes=NUM_CLASSES)
+    for name, default in template._defaults.items():
+        np.testing.assert_array_equal(
+            np.asarray(recovered.tenant_state("fresh")[name]), np.asarray(default)
+        )
+
+
+def test_dropped_tenants_stay_dropped_after_recovery(tmp_path):
+    store = DiskStore(str(tmp_path / "store"))
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=2, name="drops",
+        spill_store=store, checkpoint_every_n_flushes=1,
+    )
+    bank.update("keep", *_req(0))
+    bank.update("gone", *_req(1))
+    bank.evict("gone", spill=False)
+    del bank
+    recovered = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 2, store, name="drops")
+    assert recovered.spilled_tenants == ["keep"]
+
+
+# ---------------------------------------------------------------------------
+# async checkpoints: stage at one boundary, seal at the next
+# ---------------------------------------------------------------------------
+def test_async_checkpoint_watermark_trails_one_boundary(tmp_path):
+    store = DiskStore(str(tmp_path / "store"))
+    solo = Accuracy(num_classes=NUM_CLASSES)
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=1, name="lagged",
+        spill_store=store, checkpoint_async=True,
+    )
+    for step in range(2):
+        req = _req(step)
+        bank.update("a", *req)
+        solo.update(*req)
+    assert bank.checkpoint(["a"]) == 1  # STAGED, not yet durable
+    bank.update("a", *_req(9))
+    assert bank.checkpoint(["a"]) == 1  # stages @3, seals the @2 batch
+    del bank
+    recovered = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 1, store, name="lagged")
+    _assert_tenant_equals_solo(recovered, "a", solo)  # the @2 watermark
+
+
+def test_async_checkpoint_forced_seal_with_empty_call(tmp_path):
+    store = DiskStore(str(tmp_path / "store"))
+    solo = Accuracy(num_classes=NUM_CLASSES)
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=1, name="forced",
+        spill_store=store, checkpoint_async=True,
+    )
+    req = _req(0)
+    bank.update("a", *req)
+    solo.update(*req)
+    bank.checkpoint(["a"])  # stage
+    bank.checkpoint()  # nothing dirty -> seals the staged batch NOW
+    del bank
+    recovered = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 1, store, name="forced")
+    _assert_tenant_equals_solo(recovered, "a", solo)
+
+
+def test_async_stale_seal_never_rolls_durable_state_back(tmp_path):
+    """A spill that lands between stage and seal writes NEWER state; the
+    stale staged batch must not overwrite it (or resurrect a drop)."""
+    store = DiskStore(str(tmp_path / "store"))
+    solo = Accuracy(num_classes=NUM_CLASSES)
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=1, name="noroll",
+        spill_store=store, checkpoint_async=True,
+    )
+    req = _req(0)
+    bank.update("a", *req)
+    solo.update(*req)
+    bank.checkpoint(["a"])  # stage @1
+    req = _req(1)
+    bank.update("a", *req)
+    solo.update(*req)
+    bank.evict("a")  # spill seals @2 — newer than the staged batch
+    bank.checkpoint()  # stale @1 seal must be skipped
+    _assert_tenant_equals_solo(bank, "a", solo)
+    del bank
+    recovered = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 1, store, name="noroll")
+    _assert_tenant_equals_solo(recovered, "a", solo)
+
+    # ...and a dropped tenant stays dropped through a stale seal
+    bank2 = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=1, name="nozombie",
+        spill_store=store, checkpoint_async=True,
+    )
+    bank2.update("z", *_req(2))
+    bank2.checkpoint(["z"])  # stage
+    bank2.evict("z", spill=False)  # drop: blob deleted, journaled
+    bank2.checkpoint()  # stale seal skipped
+    del bank2
+    recovered2 = MetricBank.recover(
+        Accuracy(num_classes=NUM_CLASSES), 1, store, name="nozombie"
+    )
+    assert recovered2.spilled_tenants == [] and recovered2.tenants == []
+
+
+def test_async_stale_seal_skipped_for_dropped_then_readmitted_tenant(tmp_path):
+    """drop → re-admit resets the update count to 0, so the count guard
+    alone would see the staged pre-drop rows as 'progress' and seal the dead
+    session's state over the fresh one; the per-session generation minted at
+    admission is what tells them apart."""
+    store = DiskStore(str(tmp_path / "store"))
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=1, name="regen",
+        spill_store=store, checkpoint_async=True,
+    )
+    bank.update("a", *_req(0))
+    bank.update("a", *_req(1))
+    bank.checkpoint(["a"])  # stage the old session @2
+    bank.evict("a", spill=False)  # drop it
+    bank.admit("a")  # SAME tenant id, brand-new session @0
+    solo = Accuracy(num_classes=NUM_CLASSES)
+    req = _req(7)
+    bank.update("a", *req)
+    solo.update(*req)
+    bank.checkpoint()  # the @2 stale seal must be skipped (gen mismatch)
+    _assert_tenant_equals_solo(bank, "a", solo)
+    bank.checkpoint(["a"])  # stage + force-seal the NEW session
+    bank.checkpoint()
+    del bank
+    recovered = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 1, store, name="regen")
+    _assert_tenant_equals_solo(recovered, "a", solo)
+
+
+def test_journal_bounded_without_checkpoint_cadence(tmp_path):
+    """A default-configured bank (no checkpoint cadence, no explicit
+    checkpoint() calls) must still bound its journal under admission /
+    eviction churn — compaction runs on the churn paths themselves, not
+    only at checkpoint boundaries."""
+    store = DiskStore(str(tmp_path / "store"))
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=1, name="nocadence",
+        spill_store=store,  # checkpoint_every_n_flushes left at None
+    )
+    before = durability_stats()["journal_compactions"]
+    solo = Accuracy(num_classes=NUM_CLASSES)
+    req = _req(0)
+    solo.update(*req)
+    bank.update("keeper", *req)
+    for i in range(300):
+        bank.update(f"ephemeral{i}", *_req(i))
+        bank.evict(f"ephemeral{i}", spill=False)
+    assert durability_stats()["journal_compactions"] > before
+    live = len(bank.tenants) + len(bank.spilled_tenants)
+    assert len(store.journal_frames("nocadence")) <= max(256, 4 * live) + 8
+    del bank
+    recovered = MetricBank.recover(
+        Accuracy(num_classes=NUM_CLASSES), 1, store, name="nocadence"
+    )
+    assert sorted(recovered.spilled_tenants + recovered.tenants) == ["keeper"]
+    _assert_tenant_equals_solo(recovered, "keeper", solo)
+
+
+# ---------------------------------------------------------------------------
+# exact-encode regression: sync_precision tags must not touch stored state
+# ---------------------------------------------------------------------------
+class Int8TaggedSum(Metric):
+    """A metric whose float state is tagged for lossy int8 SYNC — the spill/
+    journal path must ignore the tag (stored state re-binds as THE state;
+    quantized rounding would bake in and compound across churn)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state(
+            "total", jnp.zeros((64,), jnp.float32), dist_reduce_fx="sum",
+            sync_precision="int8",
+        )
+
+    def update(self, values):
+        self.total = self.total + values
+
+    def compute(self):
+        return jnp.sum(self.total)
+
+
+def test_int8_tagged_state_spills_and_restores_bit_identically(tmp_path):
+    # magnitudes int8's per-block absmax/254 grid cannot represent exactly
+    values = jnp.asarray(np.linspace(0.0013, 3.71, 64).astype(np.float32))
+    solo = Int8TaggedSum()
+    solo.update(values)
+    solo.update(values * 0.37)
+
+    store = DiskStore(str(tmp_path / "store"))
+    bank = MetricBank(
+        Int8TaggedSum(), capacity=1, name="int8", spill_store=store,
+        checkpoint_every_n_flushes=1,
+    )
+    bank.update("a", values)
+    bank.update("a", values * 0.37)
+    bank.evict("a")  # spill through the store...
+    _assert_tenant_equals_solo(bank, "a", solo)  # ...and decode exactly
+    del bank
+    recovered = MetricBank.recover(Int8TaggedSum(), 1, store, name="int8")
+    _assert_tenant_equals_solo(recovered, "a", solo)  # crash restore exact too
+
+
+# ---------------------------------------------------------------------------
+# sharded (PR-10) states ride recovery and re-place on the mesh
+# ---------------------------------------------------------------------------
+def test_sharded_states_recover_and_replace_on_mesh(tmp_path):
+    import jax
+    from jax.sharding import Mesh
+
+    store = DiskStore(str(tmp_path / "store"))
+    template = StatScores(reduce="macro", num_classes=32, class_sharding="mp")
+    solo = template.clone()
+    bank = MetricBank(
+        template, capacity=1, name="sharded", spill_store=store,
+        checkpoint_every_n_flushes=1,
+    )
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        req = (
+            jnp.asarray(rng.randint(0, 32, size=8).astype(np.int32)),
+            jnp.asarray(rng.randint(0, 32, size=8).astype(np.int32)),
+        )
+        solo.update(*req)
+        bank.update("T", *req)
+    del bank
+    recovered = MetricBank.recover(template.clone(), 1, store, name="sharded")
+    _assert_tenant_equals_solo(recovered, "T", solo)
+    mat = recovered.materialize("T")
+    assert str(mat.state_spec()["tp"].sharding) != "None"  # annotation survived
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("dp", "mp"))
+    mat.shard_states(mesh)  # re-places onto the mesh per the annotation
+    assert len(mat.tp.sharding.device_set) == 4
+    np.testing.assert_array_equal(np.asarray(mat.tp), np.asarray(solo.tp))
+
+
+# ---------------------------------------------------------------------------
+# journal compaction
+# ---------------------------------------------------------------------------
+def test_journal_compaction_bounds_admission_churn(tmp_path):
+    store = DiskStore(str(tmp_path / "store"))
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=1, name="churny",
+        spill_store=store, checkpoint_every_n_flushes=1,
+    )
+    before = durability_stats()["journal_compactions"]
+    solo = Accuracy(num_classes=NUM_CLASSES)
+    req = _req(0)
+    solo.update(*req)
+    bank.update("keeper", *req)
+    for i in range(140):  # ~2 admit-ish + drop records per cycle
+        bank.update(f"ephemeral{i}", *_req(i))
+        bank.evict(f"ephemeral{i}", spill=False)
+    assert durability_stats()["journal_compactions"] > before
+    live = len(bank.tenants) + len(bank.spilled_tenants)
+    assert len(store.journal_frames("churny")) <= max(256, 4 * live) + 8
+    del bank
+    recovered = MetricBank.recover(Accuracy(num_classes=NUM_CLASSES), 1, store, name="churny")
+    assert recovered.spilled_tenants == ["keeper"]  # replay-equivalent log
+    _assert_tenant_equals_solo(recovered, "keeper", solo)
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+def test_durability_events_and_summary(tmp_path):
+    store = DiskStore(str(tmp_path / "store"))
+    with obs.capture() as events:
+        bank = MetricBank(
+            Accuracy(num_classes=NUM_CLASSES), capacity=1, name="telemetry",
+            spill_store=store, checkpoint_every_n_flushes=1,
+        )
+        bank.update("a", *_req(0))
+        bank.update("b", *_req(1))  # spills "a"
+    kinds = {e.kind for e in events}
+    assert {"journal", "spill_write"} <= kinds
+    ops = {e.data["op"] for e in events if e.kind == "spill_write"}
+    assert {"checkpoint", "spill"} <= ops
+    from metrics_tpu.serving import serving_summary
+
+    summary = serving_summary()["telemetry"]
+    assert summary["store"] == "DiskStore" and summary["store_persistent"]
+    assert summary["checkpoints"] >= 2 and summary["journal_appends"] >= 4
+    stats = durability_stats()
+    assert stats["spill_writes"] > 0 and stats["journal_bytes"] > 0
+    text = obs.prometheus_text()
+    assert "metrics_tpu_durable_spill_writes" in text
+
+
+def test_default_bank_stays_process_local():
+    bank = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=2)
+    assert isinstance(bank.store, MemoryStore) and not bank.store.persistent
+    bank.update("a", *_req(0))
+    bank.evict("a")  # today's behavior, now through the store route
+    assert "a" in bank.spilled_tenants
+    assert bank.store.exists(bank._spilled["a"])
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate: kill -9 a real worker process, recover in this one
+# ---------------------------------------------------------------------------
+_CHILD = r"""
+import os, signal
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", os.environ.get("METRICS_TPU_TEST_X32", "") != "1")
+import jax.numpy as jnp
+from metrics_tpu import Accuracy
+from metrics_tpu.serving import DiskStore, MetricBank
+
+NUM_CLASSES = 5
+root = os.environ["DURABLE_ROOT"]
+bank = MetricBank(
+    Accuracy(num_classes=NUM_CLASSES), capacity=2, name="victim",
+    spill_store=DiskStore(root), checkpoint_every_n_flushes=1,
+)
+tenants = ["t0", "t1", "t2", "t3"]
+for step in range(100):  # "endless" serving loop...
+    for i, t in enumerate(tenants):
+        rng = np.random.RandomState(1000 * step + i)
+        preds = jnp.asarray(rng.rand(8, NUM_CLASSES).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, size=8).astype(np.int32))
+        bank.update(t, preds, target)
+    if step == 3:  # ...killed -9 mid-traffic: no graceful anything
+        print("ACKED", flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+def test_kill_minus_nine_process_recovers_from_disk_store(tmp_path):
+    """A worker process is SIGKILLed mid-traffic; THIS process rebuilds the
+    bank from the DiskStore and every acked tenant is bit-identical to a
+    solo replay of the acked stream — zero bytes read from the dead process.
+    """
+    root = str(tmp_path / "store")
+    env = dict(os.environ, DURABLE_ROOT=root, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD], env=env, capture_output=True, text=True, timeout=300
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    assert "ACKED" in proc.stdout  # it really died mid-loop, after step 3
+
+    tenants = ["t0", "t1", "t2", "t3"]
+    solos = {t: Accuracy(num_classes=NUM_CLASSES) for t in tenants}
+    for step in range(4):  # the acked prefix: steps 0..3 fully applied
+        for i, t in enumerate(tenants):
+            rng = np.random.RandomState(1000 * step + i)
+            preds = jnp.asarray(rng.rand(8, NUM_CLASSES).astype(np.float32))
+            target = jnp.asarray(rng.randint(0, NUM_CLASSES, size=8).astype(np.int32))
+            solos[t].update(preds, target)
+
+    recovered = MetricBank.recover(
+        Accuracy(num_classes=NUM_CLASSES), 2, DiskStore(root), name="victim"
+    )
+    assert sorted(recovered.spilled_tenants) == tenants
+    for t in tenants:
+        _assert_tenant_equals_solo(recovered, t, solos[t])
