@@ -2490,6 +2490,219 @@ def bench_fleet_elasticity() -> dict:
     }
 
 
+def bench_gray_failure() -> dict:
+    """Gray-failure + overload chaos soak (``ci.sh --chaos-smoke`` gates
+    every boolean and bound below):
+
+    * a 4-worker fleet serves tenant streams while worker 1 is SLOW
+      (injected flush latency) and worker 2 is FLAKY (injected intermittent
+      flush errors, 87.5% duty cycle) — the ``METRICS_TPU_FAULTS`` gray
+      kinds, on a FIXED fault plan;
+    * the ``FleetGuard`` scores both off the bus signals and ejects them
+      through the hysteresis path; hedges armed for their stalled requests
+      deliver to the rendezvous failover owners and RACE the kill path's
+      resubmissions — the shared dedup proves exactly-once apply
+      (``duplicates_applied == 0`` while ``duplicates_dropped >= 1``);
+    * a 4x admission burst over the inflight cap, a zero-slack deadline
+      batch, and a retry storm are all shed LOUDLY (``OverloadError``;
+      submitted == applied + shed, nothing silently dropped), and the
+      sustained pressure trips brownout (restored with hysteresis by the
+      end);
+    * every acked (admitted) request's effect is BIT-IDENTICAL to a
+      fault-free solo replay of the same per-tenant acked stream.
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, OverloadError
+    from metrics_tpu import fleet as flt
+    from metrics_tpu.resilience import AdmissionController, parse_plan
+
+    small = bool(os.environ.get("METRICS_TPU_BENCH_SMALL"))
+    n_tenants = 8 if small else 12
+    n_steps = 10
+    n_cls, batch = 5, 8
+    # the fixed fault plan: worker 1 gray-slow, worker 2 gray-flaky; all
+    # request data from one fixed seed — the lane is reproducible end to end
+    plan = parse_plan(
+        '[{"kind": "slow", "rank": 1, "seconds": 0.12},'
+        ' {"kind": "flaky", "rank": 2, "times": 7}]'
+    )
+    rng = np.random.RandomState(0)
+
+    def make_req():
+        return (
+            jnp.asarray(rng.rand(batch, n_cls).astype(np.float32)),
+            jnp.asarray(rng.randint(0, n_cls, size=batch).astype(np.int32)),
+        )
+
+    tenants = [f"t{i}" for i in range(n_tenants)]
+    fleet = flt.Fleet(
+        Accuracy(num_classes=n_cls),
+        workers=[0, 1, 2, 3],
+        capacity=n_tenants,
+        max_delay_s=0.01,
+        fault_plan=plan,
+    )
+    acked = {t: [] for t in tenants}  # per-tenant acked request stream
+
+    # -- phase 0: warm round (compiles land here, not in the guarded EWMA) --
+    for t in tenants:
+        args = make_req()
+        fleet.submit(t, *args)
+        acked[t].append(args)
+    for _ in range(20):  # the flaky worker's duty cycle heals within 8 tries
+        try:
+            fleet.flush()
+            break
+        except Exception:
+            continue
+    else:
+        raise RuntimeError("warm round never flushed through the flaky worker")
+
+    # -- phase 1: guarded + admission-controlled traffic under gray faults --
+    guard = flt.FleetGuard(
+        fleet,
+        latency_threshold_ms=40.0,
+        error_rate_threshold=0.3,
+        probation_after=2,
+        eject_after=4,
+        recover_after=2,
+        min_hedge_delay_s=0.05,
+        min_workers=2,
+    )
+    max_inflight = 2 * n_tenants
+    ctrl = AdmissionController(
+        guard,
+        tenant_rate=10_000.0,
+        tenant_burst=10_000.0,
+        max_inflight=max_inflight,
+        retry_rate=0.5,
+        retry_burst=2.0,
+        brownout_after=1,
+        brownout_recover_after=3,
+        brownout_stretch=4.0,
+    )
+    attempts = 0
+    shed_errors = 0
+    # a zero-slack deadline sheds for ANY owner (the flush deadline alone
+    # exceeds it); preferring a slow-worker tenant keeps the lane honest
+    slow_tenant = next((t for t in tenants if fleet.owner_of(t) == 1), tenants[0])
+
+    def serve_ticks(rounds: int = 3) -> None:
+        # the serving loop's idle ticks: let flush deadlines expire, poll
+        # (flushes waves, scores workers, arms/delivers hedges) — without
+        # these, queues only grow and the inflight cap sheds everything
+        for _ in range(rounds):
+            time.sleep(0.012)
+            guard.poll()
+
+    t0 = time.perf_counter()
+    for step in range(n_steps):
+        for t in tenants:
+            args = make_req()
+            attempts += 1
+            try:
+                ctrl.submit(t, *args)
+                acked[t].append(args)
+            except OverloadError:
+                shed_errors += 1
+        if step == 2:
+            # deadline-aware shedding: zero slack can never be met (the
+            # owner's flush deadline alone exceeds it) — loud reject, the
+            # caller finds out NOW, not after the deadline burned in a queue
+            for _ in range(3):
+                args = make_req()
+                attempts += 1
+                try:
+                    ctrl.submit(slow_tenant, *args, deadline_s=0.0)
+                    acked[slow_tenant].append(args)
+                except OverloadError:
+                    shed_errors += 1
+        if step == 4:
+            # the 4x admission burst: no polls in between, so the inflight
+            # cap is the only thing standing between the burst and the banks
+            for j in range(4 * max_inflight):
+                t = tenants[j % n_tenants]
+                args = make_req()
+                attempts += 1
+                try:
+                    ctrl.submit(t, *args)
+                    acked[t].append(args)
+                except OverloadError:
+                    shed_errors += 1
+            # a retry storm draws from the bounded retry budget
+            for j in range(6):
+                t = tenants[j % n_tenants]
+                args = make_req()
+                attempts += 1
+                try:
+                    ctrl.submit(t, *args, retry=True)
+                    acked[t].append(args)
+                except OverloadError:
+                    shed_errors += 1
+        serve_ticks()
+        ctrl.tick()
+    drained = guard.drain(max_rounds=128)
+    for _ in range(ctrl.brownout_recover_after + 1):  # cool-down ticks
+        ctrl.tick()
+    soak_s = time.perf_counter() - t0
+
+    # -- verdicts -------------------------------------------------------
+    fleet_vals = {t: np.asarray(v) for t, v in fleet.compute_all().items()}
+    bit_identical = True
+    for t in tenants:
+        solo = Accuracy(num_classes=n_cls)
+        for args in acked[t]:
+            solo.update(*args)
+        if not np.array_equal(np.asarray(solo.compute()), fleet_vals[t]):
+            bit_identical = False
+    gsum = guard.summary()
+    csum = ctrl.summary()
+    dedup = fleet.request_dedup.summary()
+    ejected_workers = sorted(
+        int(w) for w, rec in gsum["workers"].items() if rec["state"] == "ejected"
+    )
+    tracked = gsum["submitted"]
+    guard.close()
+    return {
+        "metric": "gray_failure",
+        "value": round(soak_s, 3),
+        "unit": "chaos_soak_s",
+        "tenants": n_tenants,
+        "steps": n_steps,
+        "available": len(fleet_vals) == n_tenants,
+        "drained": bool(drained),
+        "bit_identical": bool(bit_identical),
+        # conservation: every attempt either applied exactly once or shed
+        # loudly — submitted(tracked) == applied, attempts == tracked + sheds
+        "attempts": attempts,
+        "tracked_submitted": tracked,
+        "tracked_applied": gsum["applied"],
+        "sheds": csum["sheds"],
+        "shed_errors_raised": shed_errors,
+        "shed_inflight": csum["shed_inflight"],
+        "shed_deadline": csum["shed_deadline"],
+        "shed_retry_budget": csum["shed_retry_budget"],
+        "outstanding_after_drain": gsum["outstanding"],
+        # the exactly-once hedging proof
+        "hedges_armed": gsum["hedges_armed"],
+        "hedges_delivered": gsum["hedges_delivered"],
+        "hedges_cancelled": gsum["hedges_cancelled"],
+        "duplicates_dropped": dedup["duplicates_dropped"],
+        "duplicates_applied": dedup["duplicates_applied"],
+        # gray detection + conversion to crash-stop
+        "ejections": gsum["ejections"],
+        "ejected_workers": ejected_workers,
+        "flaky_worker_ejected": 2 in ejected_workers,
+        "flush_errors_absorbed": gsum["flush_errors_absorbed"],
+        # brownout engaged under the burst and restored with hysteresis
+        "brownouts_entered": csum["brownouts_entered"],
+        "brownout_active": bool(ctrl.brownout_active),
+        "final_epoch": fleet.epoch.version,
+        "n": attempts,
+    }
+
+
 # ---------------------------------------------------------------------------
 # durable state plane: crash/recover round trip, restart latency, WAL overhead
 # ---------------------------------------------------------------------------
@@ -2738,6 +2951,7 @@ _CONFIGS = [
     ("bench_sharded_encoders", 900, False),
     ("bench_fleet_elasticity", 900, False),
     ("bench_durable_recovery", 900, False),
+    ("bench_gray_failure", 900, False),
 ]
 
 # the headline runs outside _CONFIGS (measured first, emitted last) but is
@@ -2979,6 +3193,9 @@ _SMOKE_LANES = {
     # durable state plane: kill -9 crash/recover bit-identity, restart
     # latency warm-vs-cold, WAL overhead, drive snapshot/resume parity
     "--durable-smoke": ("bench_durable_recovery", {"small": True}),
+    # gray failure + overload: slow/flaky injection, guard ejection, hedged
+    # exactly-once apply, loud shedding, brownout, acked-stream bit-identity
+    "--chaos-smoke": ("bench_gray_failure", {"small": True}),
 }
 
 

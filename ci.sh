@@ -342,4 +342,47 @@ if [ "$durable_rc" -eq 3 ]; then
 fi
 [ "$durable_rc" -eq 0 ] || exit "$durable_rc"
 
+echo "=== gray-failure/overload chaos smoke (slow+flaky injection, guard, hedging, shedding) ==="
+# ISSUE 14 acceptance: with injected slow/flaky workers (fixed fault plan)
+# and a 4x admission burst, the fleet stays available, every acked request
+# is bit-identical to a fault-free replay, sheds are loud OverloadErrors
+# (conservation: attempts == applied + sheds, nothing silently dropped),
+# and the hedge dedup counters prove exactly-once apply
+JAX_PLATFORMS=cpu python bench.py --chaos-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "gray_failure", obj
+# availability: every tenant still computes, all tracked traffic settled
+if obj["available"] is not True or obj["drained"] is not True:
+    print("fleet unavailable / traffic never drained under gray faults:", line); sys.exit(2)
+if obj["outstanding_after_drain"] != 0:
+    print("tracked requests left outstanding:", line); sys.exit(2)
+# bit-identity of every acked request vs a fault-free solo replay
+if obj["bit_identical"] is not True:
+    print("acked-stream results diverged from the fault-free replay:", line); sys.exit(2)
+# conservation: admitted == applied, attempts == admitted + sheds, and
+# every shed raised OverloadError — no silent drops anywhere
+if obj["tracked_submitted"] != obj["tracked_applied"]:
+    print("admitted requests lost (%s submitted, %s applied):" % (obj["tracked_submitted"], obj["tracked_applied"]), line); sys.exit(2)
+if obj["attempts"] != obj["tracked_submitted"] + obj["sheds"] or obj["sheds"] != obj["shed_errors_raised"]:
+    print("request conservation broken (silent drop?):", line); sys.exit(2)
+# the overload defenses all fired, loudly
+if obj["sheds"] < 1 or obj["shed_inflight"] < 1 or obj["shed_deadline"] < 1 or obj["shed_retry_budget"] < 1:
+    print("an admission-control defense never fired:", line); sys.exit(2)
+# gray detection: the flaky worker was ejected through the hysteresis path
+if obj["ejections"] < 1 or obj["flaky_worker_ejected"] is not True:
+    print("the gray-failing worker was never ejected:", line); sys.exit(2)
+# exactly-once hedging: hedges delivered, duplicates dropped pre-state,
+# and ZERO duplicates applied
+if obj["hedges_delivered"] < 1 or obj["duplicates_dropped"] < 1:
+    print("hedging never raced the resubmission path:", line); sys.exit(2)
+if obj["duplicates_applied"] != 0:
+    print("a hedged request applied twice:", line); sys.exit(2)
+# brownout engaged under the burst and was restored with hysteresis
+if obj["brownouts_entered"] < 1 or obj["brownout_active"] is not False:
+    print("brownout never engaged or never restored:", line); sys.exit(2)
+print("chaos smoke OK:", line)
+'
+
 echo "both lanes green"

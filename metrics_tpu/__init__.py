@@ -57,6 +57,7 @@ from metrics_tpu import sharding  # noqa: E402,F401
 from metrics_tpu.collections import MetricCollection  # noqa: E402,F401
 from metrics_tpu.utils.exceptions import (  # noqa: E402,F401
     NumericalHealthError,
+    OverloadError,
     SyncError,
     SyncIntegrityError,
     SyncTimeoutError,
@@ -245,6 +246,7 @@ __all__ = [
     "SumMetric",
     "SyncError",
     "NumericalHealthError",
+    "OverloadError",
     "SyncIntegrityError",
     "SyncTimeoutError",
     "SymmetricMeanAbsolutePercentageError",

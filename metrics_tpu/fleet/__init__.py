@@ -23,6 +23,13 @@ change underneath millions of sessions without losing a bit of state.
   the migration engine, incl. kill recovery under the PR-2 fault harness)
   and :class:`FleetRouter` (the request-plane face over each worker's PR-7
   ``RequestRouter``).
+* :mod:`~metrics_tpu.fleet.guard` — :class:`FleetGuard`, the gray-failure
+  defense: obs-bus health scoring (flush-latency EWMA, error rate,
+  checkpoint lag) with hysteresis into healthy → probation → ejected
+  (ejection rides :meth:`Fleet.kill`), plus hedged submits with
+  exactly-once request-id dedup. Pair with
+  :class:`~metrics_tpu.resilience.overload.AdmissionController` for
+  overload shedding and brownout (``docs/fault_tolerance.md``).
 
 Telemetry: ``migrate``/``fleet_epoch`` bus events, the ``"fleet"`` section
 of ``obs.snapshot()`` (:func:`fleet_stats`), and ``metrics_tpu_fleet_*``
@@ -49,6 +56,7 @@ from metrics_tpu.fleet.placement import (  # noqa: F401
     placement_diff,
     rendezvous_score,
 )
+from metrics_tpu.fleet.guard import FleetGuard, all_guards, guard_stats  # noqa: F401
 from metrics_tpu.fleet.reshard import reshard_onto  # noqa: F401
 from metrics_tpu.fleet.router import (  # noqa: F401
     Fleet,
@@ -61,6 +69,7 @@ from metrics_tpu.fleet.router import (  # noqa: F401
 __all__ = [
     "Fleet",
     "FleetEpoch",
+    "FleetGuard",
     "FleetRouter",
     "KVLedger",
     "LocalLedger",
@@ -68,11 +77,13 @@ __all__ = [
     "Worker",
     "admit_payload",
     "all_fleets",
+    "all_guards",
     "assert_minimal_moves",
     "decode_tenant_payload",
     "encode_tenant_payload",
     "fleet_stats",
     "fleet_summary",
+    "guard_stats",
     "ledger_key",
     "owner",
     "owners",
@@ -92,6 +103,10 @@ _AGGREGATE_KEYS = (
     "kills",
     "recovered_tenants",
     "resubmitted_requests",
+    # parked state (PR-11 park-and-retry, surfaced in ISSUE 14): tenants
+    # waiting in the migration ledger + requests awaiting re-submission
+    "in_flight_tenants",
+    "parked_requests",
 )
 
 

@@ -43,6 +43,7 @@ Failure story (exercised by ``tests/fleet`` under the PR-2 harness):
 """
 import itertools
 import threading
+import time
 import weakref
 from typing import Any, Dict, Hashable, Iterable, List, Optional, Tuple
 
@@ -50,7 +51,9 @@ from metrics_tpu.fleet import migrate as _migrate
 from metrics_tpu.fleet import placement as _placement
 from metrics_tpu.fleet.placement import FleetEpoch
 from metrics_tpu.obs import bus as _bus
+from metrics_tpu.resilience import faults as _faults
 from metrics_tpu.serving import store as _store
+from metrics_tpu.serving.dedup import RequestDedup
 from metrics_tpu.utils.exceptions import MetricsUserError
 
 __all__ = ["Fleet", "FleetRouter", "Worker", "all_fleets", "fleet_summary"]
@@ -92,6 +95,9 @@ class Worker:
         max_delay_s: Optional[float] = 0.05,
         spill_store: Optional[Any] = None,
         checkpoint_every_n_flushes: Optional[int] = 1,
+        request_dedup: Optional[RequestDedup] = None,
+        fault_plan: Optional[Any] = None,
+        epoch_fn: Optional[Any] = None,
     ) -> None:
         from metrics_tpu.serving import MetricBank, RequestRouter
 
@@ -103,7 +109,22 @@ class Worker:
             name=bank_name or f"fleet:{worker_id}",
             spill_store=spill_store,
             checkpoint_every_n_flushes=checkpoint_every_n_flushes,
+            request_dedup=request_dedup,
         )
+        # gray-failure injection (METRICS_TPU_FAULTS 'slow'/'flaky' against
+        # this worker's integer id): the injector rides the bank's flush
+        # path INSIDE its latency/error accounting, so an injected gray
+        # fault is observable through exactly the signals — flush-latency
+        # EWMA, flush_errors, error-carrying flush events — a real slow or
+        # flaky worker produces (what FleetGuard scores)
+        self._fault_plan = fault_plan
+        self._epoch_fn = epoch_fn
+        if (
+            fault_plan is not None
+            and isinstance(worker_id, int)
+            and any(s.kind in ("slow", "flaky") and s.rank == worker_id for s in fault_plan)
+        ):
+            self.bank.fault_injector = self._gray_inject
         # the durable identity survives a die(): recovery needs the store
         # and the journal namespace, never the bank object
         self.bank_name = self.bank.name
@@ -134,6 +155,16 @@ class Worker:
         recovery MUST come from the durable tier."""
         self.bank = None
         self.router = None
+
+    def _gray_inject(self) -> None:
+        epoch = self._epoch_fn() if self._epoch_fn is not None else None
+        slow = self._fault_plan.slow_s(self.worker_id, epoch)
+        if slow:
+            time.sleep(slow)
+        if self._fault_plan.flaky_fails(self.worker_id, epoch):
+            raise _faults.InjectedFaultError(
+                f"UNAVAILABLE: injected flaky flush (worker {self.worker_id})"
+            )
 
     def drain(self) -> int:
         """Flush the router so no request is in flight; returns requests
@@ -251,9 +282,19 @@ class Fleet:
         # tenant -> ledger key, from publish until the admission acks: the
         # retryability record behind the partial-rebalance failure contract
         self._in_flight: Dict[Hashable, str] = {}
-        # requests whose post-recovery resubmission failed — replayed by the
-        # next resize (same park-and-retry contract as _in_flight state)
-        self._parked_requests: List[Tuple[Hashable, Tuple[Any, ...]]] = []
+        # (tenant, args, request_id) requests whose post-recovery
+        # resubmission failed — replayed by the next resize (same
+        # park-and-retry contract as _in_flight state; ids preserved so a
+        # replayed request still dedups against its hedged twin)
+        self._parked_requests: List[Tuple[Hashable, Tuple[Any, ...], Any]] = []
+        # fleet-scoped exactly-once registry: every worker bank shares it,
+        # so a hedge applied on the failover owner and the kill path's
+        # resubmission of the same request cannot both count
+        self.request_dedup = RequestDedup()
+        # synthetic ids for resubmitted requests that arrived untagged — a
+        # resubmission must be distinguishable "queued but flush failed"
+        # vs "never queued" (only the latter may park; see _commit_epoch)
+        self._resub_ids = itertools.count()
         self.epoch = FleetEpoch(ids, version=0)
         self._workers: Dict[Hashable, Worker] = {}
         for wid in self.epoch.workers:
@@ -288,6 +329,9 @@ class Fleet:
             max_delay_s=self._max_delay_s,
             spill_store=self._durable_store,
             checkpoint_every_n_flushes=self._ckpt_every,
+            request_dedup=self.request_dedup,
+            fault_plan=self._fault_plan,
+            epoch_fn=lambda: self.epoch.version,
         )
 
     def _precisions(self) -> Optional[Dict[str, str]]:
@@ -341,9 +385,12 @@ class Fleet:
             )
             self._raise_if_failed(failures)
 
-    def submit(self, tenant: Hashable, *args: Any) -> int:
+    def submit(self, tenant: Hashable, *args: Any, request_id: Any = None) -> int:
         """Route one update request to the tenant's rendezvous owner;
-        returns requests flushed as a side effect (router semantics)."""
+        returns requests flushed as a side effect (router semantics).
+        ``request_id`` tags the request for exactly-once apply through the
+        fleet's shared :class:`~metrics_tpu.serving.RequestDedup` — the
+        contract hedged submits and kill-path resubmission rely on."""
         with self._lock:
             self._heal_in_flight(tenant)
             wid = self.owner_of(tenant)
@@ -355,7 +402,29 @@ class Fleet:
                     " membership before routing more traffic."
                 )
             self._tenants[tenant] = None
-            return worker.router.submit(tenant, *args)
+            return worker.router.submit(tenant, *args, request_id=request_id)
+
+    def has_pending_request(self, request_id: Any) -> bool:
+        """Whether a tagged request is still queued on some live worker's
+        router — combined with ``request_dedup.is_applied``, this answers
+        "did a submission whose flush raised at least land in a queue"
+        (the :class:`~metrics_tpu.fleet.FleetGuard` error-swallowing probe)."""
+        with self._lock:
+            return any(
+                w.router is not None and w.router.has_request_id(request_id)
+                for w in self._workers.values()
+            )
+
+    def pending_requests(self) -> int:
+        """Fleet-wide queued-but-unapplied request count (live workers'
+        routers) — the one pending sum `FleetRouter.pending`, the guard's
+        drain barrier, and admission control's inflight cap all read."""
+        with self._lock:
+            return sum(
+                w.router.pending
+                for w in self._workers.values()
+                if w.alive and w.router is not None
+            )
 
     def poll(self) -> int:
         with self._lock:
@@ -487,7 +556,7 @@ class Fleet:
         epoch: FleetEpoch,
         performed: Dict[Hashable, Tuple[Hashable, Hashable]],
         moved_bytes: int,
-        pending: List[Tuple[Hashable, Tuple[Any, ...]]],
+        pending: List[Tuple[Hashable, Tuple[Any, ...], Any]],
         reason: Optional[str] = None,
     ) -> List[Tuple[Hashable, BaseException]]:
         """The shared membership-change epilogue (resize and kill): commit
@@ -509,12 +578,27 @@ class Fleet:
                 self._workers.pop(wid)
         self.stats["epoch_changes"] += 1
         resubmit_failures: List[Tuple[Hashable, BaseException]] = []
-        for tenant, args in pending:
+        for tenant, args, rid in pending:
+            if rid is None:
+                # tag untagged requests so a flush failure below is
+                # distinguishable from an enqueue failure — and so a later
+                # replay of a parked copy can never double-apply
+                rid = f"{self.name}:resub:{next(self._resub_ids)}"
             try:
                 self.stats["resubmitted_requests"] += 1
-                self.submit(tenant, *args)
-            except Exception as err:  # noqa: BLE001 — isolated; request parked
-                self._parked_requests.append((tenant, args))
+                # the original request id rides the resubmission: if a hedge
+                # for this request was (or will be) delivered to the new
+                # owner, the shared dedup applies exactly one of the two
+                self.submit(tenant, *args, request_id=rid)
+            except Exception as err:  # noqa: BLE001 — isolated
+                if self.request_dedup.is_applied(tenant, rid) or self.has_pending_request(rid):
+                    # the request IS queued (or already applied) — the raise
+                    # was the flush's, i.e. the destination worker's
+                    # sickness, not this request's. Parking a queued request
+                    # would double-apply it on replay; leave it to the
+                    # router's retry and the guard's scoring.
+                    continue
+                self._parked_requests.append((tenant, args, rid))
                 resubmit_failures.append((tenant, err))
         if _bus.enabled():
             payload: Dict[str, Any] = dict(
@@ -713,7 +797,7 @@ class Fleet:
         FleetEpoch,
         Dict[Hashable, Tuple[Hashable, Hashable]],
         int,
-        List[Tuple[Hashable, Tuple[Any, ...]]],
+        List[Tuple[Hashable, Tuple[Any, ...], Any]],
         List[Tuple[Hashable, BaseException]],
     ]:
         """Drain a DEAD worker's state back into the fleet FROM ITS SPILL
@@ -733,9 +817,22 @@ class Fleet:
         if worker_id in epoch:
             epoch = epoch.leave(worker_id)
         pending = dead.router.drain_pending() if dead.router is not None else []
-        # the store is the recovery source; the bank object (if a kill left
-        # one) is dead memory — release it so retries can't silently lean on
-        # it and a leaked device pytree doesn't outlive the worker
+        # a KILLed worker's memory is still readable: seal its dirty
+        # residents' FINAL states into the store before dropping it, so
+        # recovery is exact even when the checkpoint cadence was raised
+        # (e.g. stretched by an overload brownout) — without this, the
+        # store-only read below would lose the acked tail inside the
+        # cadence window. A DIEd worker has no memory (forget_memory ran in
+        # _mark_dead); its loss window is the documented cadence bound.
+        if dead.bank is not None:
+            try:
+                dead.bank.checkpoint()
+                dead.bank.checkpoint()  # second call seals an async-staged batch
+            except Exception:  # noqa: BLE001 — poisoned bank: the store is the best left
+                pass
+        # the store is now the recovery source; the bank object is dead
+        # memory — release it so retries can't silently lean on it and a
+        # leaked device pytree doesn't outlive the worker
         dead.forget_memory()
         # ONE journal replay serves the whole recovery: the payload read, the
         # no-blob sweep, and the deregistration check below all reuse `live`
@@ -803,7 +900,7 @@ class Fleet:
         FleetEpoch,
         Dict[Hashable, Tuple[Hashable, Hashable]],
         int,
-        List[Tuple[Hashable, Tuple[Any, ...]]],
+        List[Tuple[Hashable, Tuple[Any, ...], Any]],
         List[Tuple[Hashable, BaseException]],
     ]:
         """Recover EVERY dead worker still registered, re-scanning until none
@@ -813,7 +910,7 @@ class Fleet:
         call (a partially-unrecoverable one stays registered for a retry)."""
         moves: Dict[Hashable, Tuple[Hashable, Hashable]] = {}
         total_bytes = 0
-        pending: List[Tuple[Hashable, Tuple[Any, ...]]] = []
+        pending: List[Tuple[Hashable, Tuple[Any, ...], Any]] = []
         failures: List[Tuple[Hashable, BaseException]] = []
         attempted: set = set()
         while True:
@@ -884,6 +981,13 @@ class Fleet:
                 "workers": {str(wid): w.summary() for wid, w in self._workers.items()},
                 "tenants": len(self._tenants),
                 "capacity": self.capacity,
+                # the PR-11 park-and-retry state, surfaced: tenants whose
+                # state sits in the migration ledger awaiting re-admission,
+                # and requests whose post-recovery resubmission failed —
+                # both invisible until the next resize unless watched here
+                "in_flight_tenants": len(self._in_flight),
+                "parked_requests": len(self._parked_requests),
+                "dedup": self.request_dedup.summary(),
                 **self.stats,
             }
 
@@ -917,10 +1021,7 @@ class FleetRouter:
 
     @property
     def pending(self) -> int:
-        with self.fleet._lock:
-            return sum(
-                w.router.pending for w in self.fleet._workers.values() if w.alive
-            )
+        return self.fleet.pending_requests()
 
     def pending_detail(self) -> Dict[Hashable, Dict[str, Any]]:
         return self.fleet.pending_detail()

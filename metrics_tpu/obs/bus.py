@@ -84,6 +84,19 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: torn tail records ignored; also emitted by ``drive(resume_from=)`` with
 #: ``scope="drive"``), ``snapshot`` (a ``drive(snapshot_store=)`` epoch
 #: snapshot sealed — step index, payload bytes, ``final`` flag).
+#: Gray-failure / overload defense (``fleet/guard.py``,
+#: ``resilience/overload.py``, ISSUE 14): ``guard`` (a worker health-state
+#: transition — worker, state_from/state_to, breach reasons, the EWMA
+#: readings behind the decision; also emitted by the admission controller
+#: with ``event="brownout_enter"/"brownout_exit"``), ``shed`` (a request
+#: REJECTED by admission control — tenant, reason
+#: tenant_quota/inflight/deadline/retry_budget, pressure detail; every shed
+#: also raises ``OverloadError``, never a silent drop), ``hedge`` (a
+#: tracked request's hedge lifecycle — ``event`` armed/delivered/cancelled,
+#: tenant, request id, primary and rendezvous-failover owner, age). The
+#: ``flush`` event additionally carries ``ms`` (dispatch wall time) on
+#: success or ``error`` (exception class name) on failure — the signals
+#: the guard scores.
 #: Misc: ``warning`` (a ``warn_once`` emission).
 EVENT_KINDS = (
     "compile",
@@ -112,6 +125,9 @@ EVENT_KINDS = (
     "snapshot",
     "migrate",
     "fleet_epoch",
+    "guard",
+    "shed",
+    "hedge",
     "warmup",
     "warmup_stale",
     "warning",

@@ -59,6 +59,12 @@ def _durability_stats() -> Dict[str, Any]:
     return durability_stats()
 
 
+def _guard_stats() -> Dict[str, Any]:
+    from metrics_tpu.fleet import guard_stats
+
+    return guard_stats()
+
+
 def process_snapshot() -> Dict[str, Any]:
     """The process-wide observability view (no metric argument needed)."""
     from metrics_tpu import engine as _engine
@@ -91,6 +97,10 @@ def process_snapshot() -> Dict[str, Any]:
         # compactions, replayed + torn records, spill blob traffic, bank
         # checkpoints, crash recoveries, drive snapshots/resumes
         "durability": _durability_stats(),
+        # gray-failure / overload defense (fleet/guard.py +
+        # resilience/overload.py): per-worker health states, hedge
+        # counters, exactly-once dedup proof, sheds by reason, brownout
+        "guard": _guard_stats(),
         "bus": _bus.summary(),
         "spans": _trace.span_summary(),
         "warnings": {repr(k): v for k, v in _warn.warn_counts().items()},
@@ -321,11 +331,27 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
     for key in ("migrations", "rebalance_bytes", "kills", "recovered_tenants", "epoch_changes"):
         _sample(f"metrics_tpu_fleet_{key}", fleet[key])
     _sample("metrics_tpu_fleet_tenants", fleet["tenants"], kind="gauge")
+    # parked state (PR-11 park-and-retry): tenants waiting in the migration
+    # ledger + requests awaiting re-submission — gauges, they drain to zero
+    _sample("metrics_tpu_fleet_parked_tenants", fleet["in_flight_tenants"], kind="gauge")
+    _sample("metrics_tpu_fleet_parked_requests", fleet["parked_requests"], kind="gauge")
     for fleet_name in sorted(fleet["fleets"]):
         summary = fleet["fleets"][fleet_name]
         fleet_labels = {"fleet": fleet_name, "template": summary.get("template", "")}
         _sample("metrics_tpu_fleet_epoch", summary["epoch"], fleet_labels, kind="gauge")
         _sample("metrics_tpu_fleet_workers", len(summary["workers"]), fleet_labels, kind="gauge")
+        _sample(
+            "metrics_tpu_fleet_parked_tenants",
+            summary["in_flight_tenants"],
+            fleet_labels,
+            kind="gauge",
+        )
+        _sample(
+            "metrics_tpu_fleet_parked_requests",
+            summary["parked_requests"],
+            fleet_labels,
+            kind="gauge",
+        )
         for worker_name in sorted(summary["workers"]):
             worker = summary["workers"][worker_name]
             labels = {"fleet": fleet_name, "worker": worker_name}
@@ -337,6 +363,30 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
     # durable state plane: journal/spill/recovery/snapshot counters
     for key, value in sorted(_durability_stats().items()):
         _sample(f"metrics_tpu_durable_{key}", value)
+
+    # gray-failure / overload defense: worker health states, hedge
+    # lifecycle, exactly-once dedup proof, sheds by reason, brownout
+    guard = _guard_stats()
+    for key in ("healthy", "probation", "ejected"):
+        _sample(f"metrics_tpu_guard_workers_{key}", guard[key], kind="gauge")
+    _sample("metrics_tpu_guard_outstanding_requests", guard["outstanding"], kind="gauge")
+    for key in (
+        "submitted",
+        "applied",
+        "hedges_armed",
+        "hedges_delivered",
+        "hedges_cancelled",
+        "ejections",
+        "duplicates_dropped",
+        "duplicates_applied",
+    ):
+        _sample(f"metrics_tpu_guard_{key}", guard[key])
+    overload = guard["overload"]
+    _sample("metrics_tpu_guard_brownout_active", 1 if overload["brownout_active"] else 0, kind="gauge")
+    for key in ("admitted", "sheds", "retries_admitted", "brownouts_entered"):
+        _sample(f"metrics_tpu_guard_{key}", overload[key])
+    for reason in ("tenant_quota", "inflight", "deadline", "retry_budget"):
+        _sample("metrics_tpu_guard_sheds_by_reason", overload[f"shed_{reason}"], {"reason": reason})
 
     # AOT warmup manifests: warmed program inventory + staleness counters
     warm = _engine.warmup_report()

@@ -394,13 +394,20 @@ _DESYNC_HINT = (
 
 
 def _is_transient_kv_error(err: BaseException) -> bool:
-    """Transient = worth another attempt within the deadline: read timeouts
-    and retryable integrity failures. Classified by message because the real
-    coordination-service client surfaces timeouts as generic runtime errors
-    (``XlaRuntimeError: DEADLINE_EXCEEDED``)."""
+    """Transient = worth another attempt within the deadline: read timeouts,
+    socket-level failures, and retryable integrity failures.
+
+    Classified by TYPE first — ``TimeoutError``, ``ConnectionError``, and
+    ``OSError`` (a raised socket error: reset, refused, unreachable, broken
+    pipe) are infrastructure failures a retry can outlive, so they must
+    never abort the exchange outright — and by message second, because the
+    real coordination-service client surfaces timeouts as generic runtime
+    errors (``XlaRuntimeError: DEADLINE_EXCEEDED``)."""
     if isinstance(err, SyncIntegrityError):
         return err.transient
-    if isinstance(err, TimeoutError):
+    # ConnectionError and TimeoutError are OSError subclasses on 3.10+, but
+    # all three are named so the classification contract reads explicitly
+    if isinstance(err, (TimeoutError, ConnectionError, OSError)):
         return True
     msg = str(err).lower()
     return any(s in msg for s in ("deadline_exceeded", "deadline exceeded", "timed out", "timeout", "unavailable"))

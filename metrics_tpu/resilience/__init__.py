@@ -13,9 +13,17 @@ TPUv4 scale; EQuARX degraded collectives). This package holds the pieces:
   policies, and the ``health_report()`` counter state (``docs/numerics.md``).
 * :mod:`~metrics_tpu.resilience.faults` — the deterministic fault-injection
   harness: an in-memory KV fake with per-(rank, epoch) drop/delay/corrupt/
-  straggler faults (plus the fleet-consumed ``kill`` kind), per-thread world
-  simulation, and an env-activated (``METRICS_TPU_FAULTS``) wrapper for live
-  clients.
+  straggler faults (plus the fleet-consumed crash-stop ``kill``/``die``
+  kinds and the GRAY ``slow``/``flaky`` kinds — injected latency and
+  intermittent errors, honored by the KV layers and the fleet worker flush
+  path), per-thread world simulation, and an env-activated
+  (``METRICS_TPU_FAULTS``) wrapper for live clients.
+* :mod:`~metrics_tpu.resilience.overload` — admission control for the
+  serving request plane: per-tenant token-bucket quotas, a global inflight
+  cap, deadline-aware shedding (every rejection is a loud
+  :class:`~metrics_tpu.utils.exceptions.OverloadError`, never a silent
+  drop), retry budgets, and a brownout mode that stretches flush/checkpoint
+  cadences under sustained pressure (see ``docs/fault_tolerance.md``).
 * sync telemetry — :func:`new_sync_stats` is the counter template behind
   ``Metric.sync_report()`` (attempts, retries, backoff elapsed, bytes
   exchanged, integrity failures, degraded syncs, missing ranks), mirroring
@@ -33,6 +41,7 @@ from metrics_tpu.resilience.faults import (  # noqa: F401
     FaultSpec,
     FaultyClient,
     InMemoryKVStore,
+    InjectedFaultError,
     KVTimeoutError,
     current_client,
     maybe_wrap_client,
@@ -46,6 +55,11 @@ from metrics_tpu.resilience.health import (  # noqa: F401
     HEALTH_POLICIES,
     HEALTH_STATE,
     new_health_stats,
+)
+from metrics_tpu.resilience.overload import (  # noqa: F401
+    AdmissionController,
+    TokenBucket,
+    overload_summary,
 )
 from metrics_tpu.resilience.retry import DEFAULT_RETRY, RetryPolicy  # noqa: F401
 

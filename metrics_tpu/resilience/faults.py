@@ -41,6 +41,7 @@ __all__ = [
     "FaultSpec",
     "FaultyClient",
     "InMemoryKVStore",
+    "InjectedFaultError",
     "KVTimeoutError",
     "current_client",
     "maybe_wrap_client",
@@ -53,13 +54,22 @@ __all__ = [
 
 FAULTS_ENV_VAR = "METRICS_TPU_FAULTS"
 
-_FAULT_KINDS = ("drop", "delay", "corrupt", "straggler", "kill", "die")
+_FAULT_KINDS = ("drop", "delay", "corrupt", "straggler", "kill", "die", "slow", "flaky")
 
 
 class KVTimeoutError(TimeoutError):
     """Timeout raised by the fake store — message mirrors the real
     coordination-service client (``DEADLINE_EXCEEDED``) so the transient-error
     classifier in ``parallel/groups.py`` treats both identically."""
+
+
+class InjectedFaultError(ConnectionError):
+    """The error a ``'flaky'`` fault injects: an intermittent, transient
+    infrastructure failure. Subclasses ``ConnectionError`` so the sync
+    stack's transient classifier (``parallel/groups._is_transient_kv_error``)
+    retries it by *type*, and so fleet-level consumers (the worker flush
+    path, :class:`~metrics_tpu.fleet.FleetGuard`) see exactly the shape a
+    real flaky NIC/RPC layer produces."""
 
 
 @dataclass(frozen=True)
@@ -83,13 +93,27 @@ class FaultSpec:
             before recovery starts (no graceful export, un-flushed requests
             lost), so recovery must come entirely from the durable spill
             store (``serving/store.py``). KV-level operations never consult
-            kill/die specs.
+            kill/die specs. ``'slow'`` — a GRAY failure: the target stays up
+            but every operation takes an extra ``seconds`` *within* its
+            budget (KV fake/live wrapper: reads of the rank's payload sleep
+            but do not time out on their own; fleet worker flush path: each
+            batched apply sleeps before dispatching) — the worker is slow,
+            not dead, which no crash-stop detector sees; ``'flaky'`` — the
+            other gray failure: operations fail intermittently and
+            deterministically (the first ``times`` of every ``times + 1``
+            calls raise :class:`InjectedFaultError`, then one succeeds, and
+            the pattern repeats — ``times=1`` is a 50% error rate), on KV
+            reads of the rank's payload and on the fleet worker's flush path.
         rank: the *publisher* process index whose payload is affected (for
-            ``'kill'``/``'die'``: the fleet worker id).
-        epoch: exchange epoch the fault applies to (for ``'kill'``/``'die'``:
-            the fleet epoch version); ``None`` = every epoch.
-        seconds: delay/straggler duration.
-        times: how many corrupted reads ``'corrupt'`` serves before healing.
+            ``'kill'``/``'die'``, and for ``'slow'``/``'flaky'`` on the
+            worker flush path: the fleet worker id).
+        epoch: exchange epoch the fault applies to (for ``'kill'``/``'die'``/
+            ``'slow'``/``'flaky'`` consulted by the fleet: the fleet epoch
+            version); ``None`` = every epoch.
+        seconds: delay/straggler/slow duration.
+        times: how many corrupted reads ``'corrupt'`` serves before healing;
+            for ``'flaky'``: failures per ``times + 1`` calls (the error
+            duty cycle).
     """
 
     kind: str
@@ -140,6 +164,8 @@ class FaultPlan:
         self.specs = [s if isinstance(s, FaultSpec) else FaultSpec(**s) for s in specs]
         self._lock = threading.Lock()
         self._corrupt_served: Dict[Tuple[FaultSpec, int, int], int] = {}
+        # per-spec call counters behind the deterministic 'flaky' duty cycle
+        self._flaky_calls: Dict[FaultSpec, int] = {}
 
     def __iter__(self):
         return iter(self.specs)
@@ -164,6 +190,35 @@ class FaultPlan:
         objects and recovers from the durable store only (the ``'die'``
         kind)."""
         return self._first("die", rank, epoch) is not None
+
+    def slow_s(self, rank: int, epoch: Optional[int] = None) -> float:
+        """Injected gray latency for ``rank`` at ``epoch`` (0.0 when none) —
+        consulted by the fleet worker flush path and, via
+        :meth:`slow_read_s`, by the KV layers."""
+        spec = self._first("slow", rank, epoch)
+        return spec.seconds if spec else 0.0
+
+    def flaky_fails(self, rank: int, epoch: Optional[int] = None) -> bool:
+        """Whether THIS call against ``rank`` at ``epoch`` should fail with
+        an :class:`InjectedFaultError` — deterministic duty cycle: the first
+        ``times`` of every ``times + 1`` calls fail, then one succeeds, and
+        the pattern repeats. Thread-safe (the counter is claimed under the
+        plan lock, like ``corrupt``'s)."""
+        spec = self._first("flaky", rank, epoch)
+        if spec is None:
+            return False
+        with self._lock:
+            n = self._flaky_calls.get(spec, 0)
+            self._flaky_calls[spec] = n + 1
+        return n % (spec.times + 1) < spec.times
+
+    def slow_read_s(self, key: str) -> float:
+        parsed = _parse_key(key)
+        return self.slow_s(parsed[1], parsed[0]) if parsed else 0.0
+
+    def flaky_read_fails(self, key: str) -> bool:
+        parsed = _parse_key(key)
+        return self.flaky_fails(parsed[1], parsed[0]) if parsed else False
 
     def drops_publish(self, key: str) -> bool:
         parsed = _parse_key(key)
@@ -198,11 +253,30 @@ class FaultPlan:
 
 def parse_plan(text: str) -> FaultPlan:
     """Parse a JSON list of fault dicts, e.g.
-    ``[{"kind": "drop", "rank": 1, "epoch": 0}]``."""
+    ``[{"kind": "drop", "rank": 1, "epoch": 0}]``.
+
+    Strict: an unknown fault ``kind`` or an unknown field raises
+    ``ValueError`` naming the offending spec's index and content — a typo'd
+    ``METRICS_TPU_FAULTS`` entry must fail the run loudly at parse time, not
+    silently inject nothing while the operator believes the fault is live."""
     specs = json.loads(text)
     if not isinstance(specs, list):
         raise ValueError(f"A fault plan must be a JSON list of fault objects, got {type(specs).__name__}")
-    return FaultPlan([FaultSpec(**spec) for spec in specs])
+    parsed = []
+    for i, spec in enumerate(specs):
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"Fault plan entry {i} must be an object, got {type(spec).__name__}: {spec!r}"
+            )
+        try:
+            parsed.append(FaultSpec(**spec))
+        except (TypeError, ValueError) as err:
+            raise ValueError(
+                f"Invalid fault plan entry {i} ({spec!r}): {err}."
+                f" Known kinds: {_FAULT_KINDS};"
+                " known fields: kind, rank, epoch, seconds, times."
+            ) from err
+    return FaultPlan(parsed)
 
 
 def plan_from_env(environ: Optional[Dict[str, str]] = None) -> Optional[FaultPlan]:
@@ -272,6 +346,13 @@ class InMemoryKVStore:
                     f"DEADLINE_EXCEEDED: read of key {key!r} exceeded its {timeout_ms}ms budget"
                 )
             time.sleep(read_delay)
+        gray_slow = self.faults.slow_read_s(key)
+        if gray_slow:
+            # gray 'slow': latency inside the budget — the read still answers
+            # (unlike 'delay', which models a read that can blow its attempt)
+            time.sleep(min(gray_slow, max(0.0, deadline - time.monotonic())))
+        if self.faults.flaky_read_fails(key):
+            raise InjectedFaultError(f"UNAVAILABLE: injected flaky read of key {key!r}")
         return self.faults.maybe_corrupt(key, value)
 
     def _barrier(self, rank: int, barrier_id: str, timeout_ms: int, process_ids: Sequence[int]) -> None:
@@ -366,6 +447,15 @@ class FaultyClient:
                 )
             time.sleep(delay)
             timeout_ms = max(1, int((budget - delay) * 1000))
+        gray_slow = self._plan.slow_read_s(key)
+        if gray_slow:
+            # gray 'slow': latency within the budget, never a self-inflicted
+            # timeout (the remaining budget is passed through to the client)
+            gray_slow = min(gray_slow, max(0.0, timeout_ms / 1000.0 - 0.001))
+            time.sleep(gray_slow)
+            timeout_ms = max(1, int(timeout_ms - gray_slow * 1000))
+        if self._plan.flaky_read_fails(key):
+            raise InjectedFaultError(f"UNAVAILABLE: injected flaky read of key {key!r}")
         value = self._inner.blocking_key_value_get_bytes(key, timeout_ms)
         return self._plan.maybe_corrupt(key, value)
 

@@ -12,6 +12,10 @@ device-resident state banks instead of per-instance dispatch.
   encode, and per-tenant results sliced off one coalesced async fetch.
 * :class:`RequestRouter` (``serving/router.py``) — groups incoming updates
   by input signature and flushes size/deadline-bounded waves into the bank.
+* :class:`RequestDedup` (``serving/dedup.py``) — fleet-scoped exactly-once
+  registry for requests tagged with a ``request_id``: a hedged or replayed
+  twin of an applied request is dropped before any state is touched
+  (ISSUE 14; see ``docs/fault_tolerance.md``).
 * :class:`SpillStore` / :class:`MemoryStore` / :class:`DiskStore`
   (``serving/store.py``) — the durable state plane: pluggable spill tiers
   plus the bank's write-ahead tenant journal, so ``MetricBank.recover``
@@ -33,12 +37,14 @@ from metrics_tpu.serving.store import (  # noqa: F401  (imported before bank: ba
     durability_stats,
 )
 from metrics_tpu.serving.bank import MetricBank, all_banks, serving_summary  # noqa: F401
+from metrics_tpu.serving.dedup import RequestDedup  # noqa: F401
 from metrics_tpu.serving.router import RequestRouter  # noqa: F401
 
 __all__ = [
     "DiskStore",
     "MemoryStore",
     "MetricBank",
+    "RequestDedup",
     "RequestRouter",
     "SpillStore",
     "all_banks",

@@ -53,6 +53,7 @@ gauges in ``obs.prometheus_text`` via
 """
 import itertools
 import threading
+import time
 import weakref
 from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple
 
@@ -152,6 +153,13 @@ class MetricBank:
             at the cost of the watermark trailing by one cadence. A public
             :meth:`checkpoint` call with nothing dirty (or a second call)
             seals the staged batch immediately.
+        request_dedup: a shared :class:`~metrics_tpu.serving.RequestDedup`
+            registry enabling exactly-once apply for requests tagged with a
+            ``request_id`` (``apply_batch(..., request_ids=)``): the second
+            copy of a ``(tenant, request_id)`` — a hedge that raced its
+            primary, or a kill-path resubmission that raced a hedge — is
+            dropped BEFORE any state is touched, and counted. ``None``
+            (default): ids are ignored; every request applies.
 
     ``update(tenant, *args)`` is sugar for a one-request
     :meth:`apply_batch`; real serving traffic should flow through a
@@ -169,6 +177,7 @@ class MetricBank:
         spill_store: Optional[_spill.SpillStore] = None,
         checkpoint_every_n_flushes: Optional[int] = None,
         checkpoint_async: bool = False,
+        request_dedup: Optional[Any] = None,
     ) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -237,6 +246,16 @@ class MetricBank:
         self._tick = 0
         self._lock = threading.RLock()
         self._poisoned = False
+        self._dedup = request_dedup
+        # flush-latency EWMA (ms, alpha 0.2) — the gray-failure signal the
+        # FleetGuard scores; fed by every apply_batch, faults included
+        self._flush_ms_ewma: Optional[float] = None
+        self._last_flush_ms: Optional[float] = None
+        # gray-fault hook: called (no args) at the top of every batched
+        # apply, inside the latency/error accounting, so an injected
+        # slow/flaky fault (METRICS_TPU_FAULTS via the fleet worker) is
+        # visible through exactly the signals a real gray failure produces
+        self.fault_injector: Optional[Any] = None
         self.stats: Dict[str, int] = {
             "admits": 0,
             "readmits": 0,
@@ -252,6 +271,8 @@ class MetricBank:
             "imports": 0,
             "checkpoints": 0,
             "journal_appends": 0,
+            "flush_errors": 0,
+            "dedup_dropped": 0,
         }
         with _REGISTRY_LOCK:
             _BANKS.add(self)
@@ -551,6 +572,32 @@ class MetricBank:
         self._store.rewrite_journal(self.name, records)
         self._journal_len = len(records)
         _spill.bump("journal_compactions")
+
+    def checkpoint_lag(self) -> int:
+        """Updates applied but not yet durable, summed over resident
+        tenants (``update_count - durable_count``) — the journal/checkpoint
+        staleness signal :class:`~metrics_tpu.fleet.FleetGuard` scores. A
+        bank with no durability cadence accumulates lag by design."""
+        with self._lock:
+            return sum(
+                self._counts[t] - self._durable_counts.get(t, 0) for t in self._slots
+            )
+
+    def set_checkpoint_cadence(self, every_n_flushes: Optional[int]) -> None:
+        """Re-tune the periodic durability cadence at runtime — the brownout
+        lever (:class:`~metrics_tpu.resilience.overload.AdmissionController`
+        stretches cadences under sustained pressure and restores them with
+        hysteresis). ``None`` disables periodic checkpoints."""
+        if every_n_flushes is not None and every_n_flushes < 1:
+            raise ValueError(
+                f"checkpoint cadence must be >= 1 (or None), got {every_n_flushes}"
+            )
+        with self._lock:
+            self._ckpt_every = every_n_flushes
+
+    @property
+    def checkpoint_cadence(self) -> Optional[int]:
+        return self._ckpt_every
 
     def checkpoint(self, tenants: Optional[Iterable[Hashable]] = None) -> int:
         """Seal resident tenants' CURRENT states into the spill store now —
@@ -890,9 +937,15 @@ class MetricBank:
         launch; batch requests through a router for amortization)."""
         self.apply_batch([(tenant, args)])
 
-    def apply_batch(self, requests: Sequence[Tuple[Hashable, Tuple[Any, ...]]]) -> int:
+    def apply_batch(
+        self,
+        requests: Sequence[Tuple[Hashable, Tuple[Any, ...]]],
+        request_ids: Optional[Sequence[Any]] = None,
+    ) -> int:
         """Apply a batch of ``(tenant_id, update_args)`` requests in ONE XLA
-        launch; returns the number of requests applied.
+        launch; returns the number of requests CONSUMED from the batch
+        (applied + exactly-once duplicates dropped — the router's pending
+        accounting needs both gone from its queues).
 
         Constraints (the :class:`RequestRouter` guarantees both): at most
         one request per tenant per batch, and every request shares one
@@ -900,14 +953,26 @@ class MetricBank:
         the same pow2 bucket when the template opted into
         ``jit_bucket='pow2'`` (ragged request batches are padded and
         corrected exactly, like a solo bucketed instance).
+
+        ``request_ids`` (aligned with ``requests``; entries may be ``None``)
+        enables exactly-once apply through the bank's shared
+        :class:`~metrics_tpu.serving.RequestDedup`: a request whose
+        ``(tenant, id)`` was already applied — anywhere, by any bank sharing
+        the registry — is dropped before any state (including a fresh
+        session admission) is touched. A failing dispatch releases its
+        claims, so the router's re-queued requests stay appliable.
+
+        Every failed apply is counted (``flush_errors``) and, with the bus
+        recording, emitted as a ``flush`` event carrying ``error`` — the
+        error-rate signal :class:`~metrics_tpu.fleet.FleetGuard` scores.
         """
         if not requests:
             return 0
-        with self._lock:
-            self._check_poisoned()
-            return self._apply_batch_locked(list(requests))
-
-    def _apply_batch_locked(self, requests: List[Tuple[Hashable, Tuple[Any, ...]]]) -> int:
+        requests = list(requests)
+        request_ids = list(request_ids) if request_ids is not None else None
+        # CALLER-side validation raises BEFORE the flush-error accounting: a
+        # buggy client batch is not worker sickness, and must not feed the
+        # error EWMA a FleetGuard ejects on
         tenants = [t for t, _ in requests]
         if len(set(tenants)) != len(tenants):
             raise ValueError(
@@ -920,6 +985,58 @@ class MetricBank:
                 f"batch of {len(requests)} requests exceeds bank capacity"
                 f" {self.capacity}; split it (RequestRouter clamps flushes)."
             )
+        if request_ids is not None and len(request_ids) != len(requests):
+            raise ValueError(
+                f"request_ids ({len(request_ids)}) must align with requests"
+                f" ({len(requests)})"
+            )
+        with self._lock:
+            self._check_poisoned()
+            try:
+                return self._apply_batch_locked(requests, request_ids)
+            except Exception as err:
+                self.stats["flush_errors"] += 1
+                if _bus.enabled():
+                    _bus.emit(
+                        "flush",
+                        source=type(self._template).__name__,
+                        bank=self.name,
+                        requests=len(requests),
+                        error=type(err).__name__,
+                        occupancy=len(self._slots),
+                    )
+                raise
+
+    def _apply_batch_locked(
+        self,
+        requests: List[Tuple[Hashable, Tuple[Any, ...]]],
+        request_ids: Optional[List[Any]] = None,
+    ) -> int:
+        t_start = time.perf_counter()
+        consumed = len(requests)
+        tenants = [t for t, _ in requests]
+        # the gray-fault hook runs INSIDE the latency/error accounting and
+        # BEFORE any state mutation: an injected slow/flaky worker looks, to
+        # every downstream signal, exactly like a real one — and a flaky
+        # failure here leaves the bank untouched for the router's retry
+        if self.fault_injector is not None:
+            self.fault_injector()
+        # exactly-once: drop requests whose (tenant, id) already applied —
+        # before admission, so a duplicate can't even create a session
+        claimed: List[Tuple[Hashable, Any]] = []
+        if self._dedup is not None and request_ids is not None:
+            kept: List[Tuple[Hashable, Tuple[Any, ...]]] = []
+            for (tenant, args), rid in zip(requests, request_ids):
+                if rid is not None:
+                    if not self._dedup.begin(tenant, rid, owner=self.name):
+                        self.stats["dedup_dropped"] += 1
+                        continue
+                    claimed.append((tenant, rid))
+                kept.append((tenant, args))
+            if not kept:
+                return consumed  # every request was a duplicate: no launch
+            requests = kept
+            tenants = [t for t, _ in requests]
         first_args = requests[0][1]
         _cache.ensure_python_init(self._template, first_args, {})
 
@@ -950,11 +1067,17 @@ class MetricBank:
             else:
                 out = self._dispatch_scatter(entry, stats, slots, leaves_per_req, pads, treedef)
         except Exception:
+            # release the exactly-once claims: the router re-queues failed
+            # requests, and their retry must be appliable
+            for tenant, rid in claimed:
+                self._dedup.abort(tenant, rid)
             self._rollback_after_failure()
             raise
         finally:
             self._template._restore_state(tpl_saved)
         self._bank = out
+        for tenant, rid in claimed:
+            self._dedup.commit(tenant, rid)
         for t in tenants:
             self._counts[t] += 1
             self._dirty[t] = None
@@ -968,6 +1091,11 @@ class MetricBank:
             if self._flushes_since_ckpt >= self._ckpt_every:
                 self._flushes_since_ckpt = 0
                 self._checkpoint_locked(list(self._dirty))
+        ms = (time.perf_counter() - t_start) * 1000.0
+        self._last_flush_ms = ms
+        self._flush_ms_ewma = (
+            ms if self._flush_ms_ewma is None else 0.8 * self._flush_ms_ewma + 0.2 * ms
+        )
         if _bus.enabled():
             _bus.emit(
                 "flush",
@@ -977,8 +1105,9 @@ class MetricBank:
                 variant="dense" if dense else "scatter",
                 bucketed=pads is not None,
                 occupancy=len(self._slots),
+                ms=round(ms, 3),
             )
-        return n_req
+        return consumed
 
     def _unify_shapes(
         self, leaves_per_req: List[List[Any]], batched: Tuple[int, ...]
@@ -1229,6 +1358,10 @@ class MetricBank:
                 "store": type(self._store).__name__,
                 "store_persistent": self._store.persistent,
                 "dirty_tenants": len(self._dirty),
+                "flush_ms_ewma": (
+                    round(self._flush_ms_ewma, 3) if self._flush_ms_ewma is not None else None
+                ),
+                "checkpoint_lag": self.checkpoint_lag(),
                 **self.stats,
             }
             requests = self.stats["requests"]

@@ -36,11 +36,15 @@ __all__ = ["RequestRouter"]
 
 
 class _Wave:
-    __slots__ = ("t", "reqs")
+    __slots__ = ("t", "reqs", "ids")
 
     def __init__(self, now: float) -> None:
         self.t = now  # creation time == arrival of its oldest request
         self.reqs: Dict[Hashable, Tuple[Any, ...]] = {}
+        # tenant -> request id (only for tagged requests; the id rides the
+        # wave so a flush can hand it to the bank's exactly-once dedup and a
+        # drain can hand it to the fleet's kill-path resubmission)
+        self.ids: Dict[Hashable, Any] = {}
 
 
 class _Group:
@@ -146,9 +150,16 @@ class RequestRouter:
             }
         return label
 
-    def submit(self, tenant: Hashable, *args: Any) -> int:
+    def submit(self, tenant: Hashable, *args: Any, request_id: Any = None) -> int:
         """Queue one update request; returns the number of requests flushed
-        as a side effect (0 when the request just queued)."""
+        as a side effect (0 when the request just queued).
+
+        ``request_id`` (optional) tags the request for exactly-once apply:
+        the id travels with the request through flushes, drains, and
+        kill-path resubmission, and a bank wired with a shared
+        :class:`~metrics_tpu.serving.RequestDedup` drops a second copy of
+        the same ``(tenant, request_id)`` before touching state — the
+        contract hedged submits (``fleet/guard.py``) rely on."""
         now = self._clock()
         sig = self._signature(args)
         self._sig_stats[self._sig_label(sig)]["submitted"] += 1
@@ -165,10 +176,14 @@ class RequestRouter:
         for wave in group.waves:
             if tenant not in wave.reqs:
                 wave.reqs[tenant] = args
+                if request_id is not None:
+                    wave.ids[tenant] = request_id
                 break
         else:
             fresh = _Wave(now)
             fresh.reqs[tenant] = args
+            if request_id is not None:
+                fresh.ids[tenant] = request_id
             group.waves.append(fresh)
         group.pending += 1
         self.stats["submitted"] += 1
@@ -217,18 +232,31 @@ class RequestRouter:
                 )
         return out
 
-    def drain_pending(self) -> List[Tuple[Hashable, Tuple[Any, ...]]]:
-        """Remove and return every queued request WITHOUT applying it, in
-        per-tenant submission order (a tenant's requests all live in one
-        group, in wave order — cross-group submits flush eagerly). The
-        fleet's kill path re-routes these to the surviving owners; the
-        pending counters reset with the queues."""
-        out: List[Tuple[Hashable, Tuple[Any, ...]]] = []
+    def drain_pending(self) -> List[Tuple[Hashable, Tuple[Any, ...], Any]]:
+        """Remove and return every queued request WITHOUT applying it, as
+        ``(tenant, args, request_id)`` triples (``request_id`` is ``None``
+        for untagged requests) in per-tenant submission order (a tenant's
+        requests all live in one group, in wave order — cross-group submits
+        flush eagerly). The fleet's kill path re-routes these to the
+        surviving owners — ids preserved, so a resubmitted request still
+        dedups against its hedged twin; the pending counters reset with the
+        queues."""
+        out: List[Tuple[Hashable, Tuple[Any, ...], Any]] = []
         for sig in list(self._groups):
             group = self._groups.pop(sig)
             for wave in group.waves:
-                out.extend(wave.reqs.items())
+                out.extend((t, args, wave.ids.get(t)) for t, args in wave.reqs.items())
         return out
+
+    def has_request_id(self, request_id: Any) -> bool:
+        """Whether a tagged request is still queued (un-applied) here — the
+        guard's "did the submission at least land in a queue" probe when a
+        flush raised mid-``submit``."""
+        return any(
+            request_id in wave.ids.values()
+            for group in self._groups.values()
+            for wave in group.waves
+        )
 
     # ------------------------------------------------------------------
     def _flush_expired(self, now: float) -> int:
@@ -258,7 +286,11 @@ class RequestRouter:
             try:
                 for start in range(0, len(requests), self.bank.capacity):
                     chunk = requests[start : start + self.bank.capacity]
-                    applied = self.bank.apply_batch(chunk)
+                    ids = [wave.ids.get(t) for t, _ in chunk]
+                    if any(i is not None for i in ids):
+                        applied = self.bank.apply_batch(chunk, request_ids=ids)
+                    else:
+                        applied = self.bank.apply_batch(chunk)
                     self.stats["flushes"] += 1
                     flushed += applied
                     # counted per chunk, not after the loop: a later chunk
@@ -267,6 +299,7 @@ class RequestRouter:
                     self._sig_stats[self._sig_label(sig)]["flushed"] += applied
                     for tenant, _ in chunk:
                         wave.reqs.pop(tenant, None)
+                        wave.ids.pop(tenant, None)
             except Exception:
                 # a failed dispatch must not lose requests or corrupt the
                 # pending counter: whatever was not applied goes back to the

@@ -56,6 +56,25 @@ class NumericalHealthError(RuntimeError):
     """
 
 
+class OverloadError(RuntimeError):
+    """A serving request was REJECTED by admission control — loudly, never
+    silently dropped.
+
+    Raised by :class:`~metrics_tpu.resilience.overload.AdmissionController`
+    when a request exceeds its tenant's token-bucket quota, would push the
+    fleet past its global inflight cap, cannot meet its deadline given the
+    observed queue/flush latency, or draws from an exhausted retry budget.
+    The message names the tenant, the shed reason, and the pressure reading
+    behind the decision. Subclasses ``RuntimeError`` so generic serving-loop
+    error handlers catch it; callers that implement backpressure should
+    catch it specifically and back off (see ``docs/fault_tolerance.md``)."""
+
+    def __init__(self, message: str, reason: str = "overload", tenant: object = None) -> None:
+        super().__init__(message)
+        self.reason = reason
+        self.tenant = tenant
+
+
 class JitIncompatibleError(ValueError):
     """Raised when an operation is inherently data-dependent and cannot run
     under jit tracing (e.g. inferring ``num_classes`` from label values).

@@ -1,0 +1,380 @@
+"""FleetGuard: bus-signal health scoring, hysteresis ejection, hedged
+submits with exactly-once dedup, and the parked-state surfacing satellite.
+
+Scoring signals are fed synthetically where determinism matters: the guard
+subscribes to the event bus, so emitting ``flush`` events with chosen
+``ms``/``error`` payloads exercises exactly the path a real (or
+fault-injected) bank drives."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import SumMetric, engine, obs
+from metrics_tpu import fleet as flt
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    engine.clear_cache()
+    _bus.clear()
+    yield
+    engine.clear_cache()
+    _bus.disable()
+    _bus.clear()
+
+
+def _template():
+    return SumMetric(nan_strategy="disable")
+
+
+def _val(x=1.0, n=4):
+    return jnp.asarray(np.full(n, x, np.float32))
+
+
+def make_fleet(workers=(0, 1), **kwargs):
+    kwargs.setdefault("max_delay_s", None)
+    return flt.Fleet(_template(), workers=list(workers), capacity=8, **kwargs)
+
+
+def emit_flush(fleet, wid, ms=None, error=None, n=1):
+    """Synthesize the bus signal a worker bank's flush emits."""
+    bank = fleet._workers[wid].bank_name
+    for _ in range(n):
+        data = {"bank": bank, "requests": 1}
+        if error is not None:
+            data["error"] = error
+        else:
+            data["ms"] = ms
+        _bus.emit("flush", source="SumMetric", **data)
+
+
+def test_guard_scores_latency_from_bus_flush_events():
+    fleet = make_fleet()
+    guard = flt.FleetGuard(fleet, latency_threshold_ms=50.0, probation_after=2, eject_after=2)
+    try:
+        emit_flush(fleet, 0, ms=200.0, n=4)
+        emit_flush(fleet, 1, ms=2.0, n=4)
+        rec = guard.summary()["workers"]
+        assert rec["0"]["ewma_ms"] > 50.0 and rec["1"]["ewma_ms"] < 50.0
+        assert guard.observe()[0] == "healthy"  # breach 1 of probation_after=2
+        emit_flush(fleet, 0, ms=200.0)  # fresh evidence: streaks only advance on it
+        assert guard.observe()[0] == "probation"
+        assert guard.worker_states()[1] == "healthy"
+    finally:
+        guard.close()
+
+
+def test_single_latency_spike_does_not_even_reach_probation():
+    """Hysteresis: one slow flush (a compile, a GC pause) decays below the
+    threshold before the consecutive-breach count can act."""
+    fleet = make_fleet()
+    guard = flt.FleetGuard(fleet, latency_threshold_ms=80.0, probation_after=2, eject_after=2)
+    try:
+        emit_flush(fleet, 0, ms=100.0)  # the spike
+        assert guard.observe()[0] == "healthy"  # breach 1, not yet probation
+        # the worker then goes IDLE: with no fresh evidence the stale EWMA
+        # must not be re-counted — arbitrarily many observations later the
+        # worker is still healthy (one slow flush never ejects a worker)
+        for _ in range(10):
+            assert guard.observe()[0] == "healthy"
+        assert guard.summary()["workers"]["0"]["breach_streak"] == 1  # frozen
+        emit_flush(fleet, 0, ms=2.0)  # EWMA decays: 0.7*100 + 0.3*2 < 80
+        assert guard.observe()[0] == "healthy"
+        assert guard.summary()["workers"]["0"]["breach_streak"] == 0  # streak reset
+        assert guard.stats["probations"] == 0
+    finally:
+        guard.close()
+
+
+def test_error_rate_breach_and_probation_recovery_hysteresis():
+    fleet = make_fleet()
+    guard = flt.FleetGuard(
+        fleet,
+        error_rate_threshold=0.5,
+        probation_after=1,
+        eject_after=10,
+        recover_after=2,
+    )
+    try:
+        emit_flush(fleet, 0, error="InjectedFaultError", n=4)
+        assert guard.observe()[0] == "probation"
+        # clean traffic decays the error EWMA; recover_after consecutive
+        # clean (and evidence-fresh) observations heal the worker
+        emit_flush(fleet, 0, ms=2.0, n=8)
+        assert guard.observe()[0] == "probation"  # clean observation 1
+        emit_flush(fleet, 0, ms=2.0, n=2)
+        assert guard.observe()[0] == "healthy"  # clean observation 2
+        kinds = [(e.data["state_from"], e.data["state_to"]) for e in _bus.events("guard")]
+        assert ("healthy", "probation") in kinds and ("probation", "healthy") in kinds
+    finally:
+        guard.close()
+
+
+def test_ejection_rides_fleet_kill_and_recovers_tenants():
+    fleet = make_fleet(workers=(0, 1, 2))
+    # place some accumulated state on every worker BEFORE attaching the
+    # guard: the warm flushes' compile latencies must not pollute the EWMAs
+    tenants = [f"t{i}" for i in range(6)]
+    for t in tenants:
+        fleet.submit(t, _val(2.0))
+    fleet.flush()
+    guard = flt.FleetGuard(
+        fleet, latency_threshold_ms=50.0, probation_after=1, eject_after=1, min_workers=1
+    )
+    try:
+        victim = fleet.owner_of(tenants[0])
+        emit_flush(fleet, victim, ms=500.0, n=4)
+        guard.observe()  # -> probation
+        emit_flush(fleet, victim, ms=500.0)  # the sickness persists
+        guard.observe()  # -> ejected (fleet.kill)
+        assert guard.worker_states()[victim] == "ejected"
+        assert guard.stats["ejections"] == 1
+        assert victim not in fleet.epoch.workers
+        assert fleet.stats["kills"] == 1 and fleet.stats["recovered_tenants"] >= 1
+        # the tenant's accumulation survived the ejection bit-identically
+        assert float(np.asarray(fleet.compute(tenants[0]))) == 8.0
+        assert fleet.owner_of(tenants[0]) != victim
+        # a REJOINED worker id is a new serving cell: scored fresh, not
+        # shadowed by its predecessor's terminal ejected record
+        fleet.join(victim)
+        guard.observe()
+        assert guard.worker_states()[victim] == "healthy"
+        emit_flush(fleet, victim, ms=500.0, n=4)
+        guard.observe()
+        assert guard.worker_states()[victim] == "probation"  # ejectable again
+    finally:
+        guard.close()
+
+
+def test_min_workers_caps_ejection_and_warns():
+    fleet = make_fleet(workers=(0,))
+    guard = flt.FleetGuard(
+        fleet, latency_threshold_ms=10.0, probation_after=1, eject_after=1, min_workers=1
+    )
+    try:
+        emit_flush(fleet, 0, ms=500.0, n=3)
+        with pytest.warns(UserWarning, match="ejection is capped"):
+            guard.observe()  # probation
+            emit_flush(fleet, 0, ms=500.0)
+            guard.observe()  # would eject, but the fleet would be empty
+        assert guard.worker_states()[0] == "probation"
+        assert guard.stats["ejections"] == 0 and guard.stats["ejections_skipped"] >= 1
+        assert 0 in fleet.epoch.workers
+    finally:
+        guard.close()
+
+
+def test_checkpoint_lag_signal_breaches_when_enabled():
+    fleet = make_fleet(workers=(0, 1), checkpoint_every_n_flushes=None)  # lag accumulates
+    guard = flt.FleetGuard(fleet, lag_threshold=2, probation_after=1, eject_after=99)
+    try:
+        tenant = "t0"
+        owner = fleet.owner_of(tenant)
+        for _ in range(4):
+            fleet.submit(tenant, _val())
+            fleet.flush()
+        assert fleet._workers[owner].bank.checkpoint_lag() >= 3
+        guard.observe()
+        assert guard.worker_states()[owner] == "probation"
+        assert "lag" in guard.summary()["workers"][str(owner)]["reasons"]
+    finally:
+        guard.close()
+
+
+def test_hedged_submit_applies_exactly_once_under_failover():
+    """The acceptance-path race in miniature: a tracked request stalls on
+    its primary, its hedge arms, the primary dies (the guard's ejection
+    path uses the same kill), the kill path RESUBMITS the original while
+    the guard DELIVERS the hedge to the new rendezvous owner — and the
+    shared dedup applies exactly one of the two, bit-identically."""
+    clock = [0.0]
+    fleet = make_fleet(workers=(0, 1, 2))
+    guard = flt.FleetGuard(fleet, min_hedge_delay_s=0.5, clock=lambda: clock[0])
+    try:
+        tenant = "hedge-me"
+        primary = fleet.owner_of(tenant)
+        failover = flt.owners(tenant, fleet.epoch, k=2)[1]
+        rid = guard.submit(tenant, _val(5.0))
+        assert fleet.has_pending_request(rid)  # queued, deliberately unflushed
+        guard.poll()
+        assert guard.stats["hedges_armed"] == 0  # younger than the pXX delay
+        clock[0] = 1.0
+        guard.poll()
+        assert guard.stats["hedges_armed"] == 1
+        hedge_events = _bus.events("hedge")
+        assert hedge_events[-1].data["event"] == "armed"
+        assert hedge_events[-1].data["failover"] == str(failover)
+        # the primary dies; the kill path resubmits the queued original
+        fleet.kill(primary)
+        assert fleet.has_pending_request(rid)
+        guard.poll()  # ownership changed -> the hedge copy is delivered
+        assert guard.stats["hedges_delivered"] == 1
+        fleet.flush()
+        clock[0] = 2.0
+        guard.poll()  # observes the apply, resolves the request
+        assert guard.outstanding == 0
+        dedup = fleet.request_dedup.summary()
+        assert dedup["duplicates_dropped"] == 1  # the race really happened
+        assert dedup["duplicates_applied"] == 0  # ... and exactly one applied
+        assert float(np.asarray(fleet.compute(tenant))) == 20.0  # one update of 4x5.0
+    finally:
+        guard.close()
+
+
+def test_hedge_cancelled_when_original_applies_first():
+    clock = [0.0]
+    fleet = make_fleet(workers=(0, 1))
+    guard = flt.FleetGuard(fleet, min_hedge_delay_s=0.1, clock=lambda: clock[0])
+    try:
+        rid = guard.submit("T", _val(3.0))
+        clock[0] = 1.0
+        guard.poll()
+        assert guard.stats["hedges_armed"] == 1
+        fleet.flush()  # the primary applies the original
+        guard.poll()
+        assert guard.stats["hedges_cancelled"] == 1
+        assert guard.stats["hedges_delivered"] == 0
+        assert guard.outstanding == 0
+        assert fleet.request_dedup.is_applied("T", rid)
+        assert float(np.asarray(fleet.compute("T"))) == 12.0
+    finally:
+        guard.close()
+
+
+def test_guard_absorbs_flush_errors_but_raises_enqueue_failures():
+    fleet = make_fleet(workers=(0, 1), max_delay_s=None, max_requests=1)
+    guard = flt.FleetGuard(fleet)
+    try:
+        tenant = "t-flaky"
+        owner = fleet.owner_of(tenant)
+        boom = [True]
+
+        def injector():
+            if boom[0]:
+                boom[0] = False
+                raise ConnectionError("UNAVAILABLE: injected flaky flush")
+
+        fleet._workers[owner].bank.fault_injector = injector
+        # max_requests=1: the submit itself flushes, the flush raises, the
+        # request is re-queued — the guard absorbs and scores it
+        rid = guard.submit(tenant, _val(7.0))
+        assert guard.stats["submit_errors_absorbed"] == 1
+        assert fleet.has_pending_request(rid)
+        assert guard.drain()
+        assert float(np.asarray(fleet.compute(tenant))) == 28.0
+        # an ENQUEUE failure (dead owner still in the epoch) still raises:
+        # the request never reached a queue, absorption would lose it
+        fleet._mark_dead(owner, reason="test")
+        dead_tenant = next(
+            f"d{i}" for i in range(100) if fleet.owner_of(f"d{i}") == owner
+        )
+        with pytest.raises(MetricsUserError, match="is dead"):
+            guard.submit(dead_tenant, _val())
+        assert guard.outstanding == 0  # the failed submission is not tracked
+        # ... nor counted: submitted/applied stay convergent after the raise
+        assert guard.stats["submitted"] == guard.stats["applied"] == 1
+    finally:
+        guard.close()
+
+
+def test_guard_stats_process_view_and_prometheus_gauges():
+    fleet = make_fleet(workers=(0, 1))
+    guard = flt.FleetGuard(fleet, latency_threshold_ms=50.0, probation_after=1)
+    try:
+        emit_flush(fleet, 0, ms=200.0, n=2)
+        guard.observe()
+        stats = flt.guard_stats()
+        assert guard.name in stats["guards"]
+        assert stats["probation"] >= 1
+        assert {"duplicates_dropped", "duplicates_applied", "overload"} <= set(stats)
+        snap = obs.snapshot()
+        assert snap["guard"]["probation"] == stats["probation"]
+        text = obs.prometheus_text()
+        for family in (
+            "metrics_tpu_guard_workers_probation",
+            "metrics_tpu_guard_hedges_armed",
+            "metrics_tpu_guard_duplicates_applied",
+            "metrics_tpu_guard_brownout_active",
+            "metrics_tpu_guard_sheds_by_reason",
+        ):
+            assert family in text
+    finally:
+        guard.close()
+
+
+def test_parked_state_surfaced_in_summary_stats_and_gauges():
+    """ISSUE 14 satellite: the PR-11 park-and-retry state (_in_flight
+    tenants, _parked_requests) is visible in fleet.summary(),
+    fleet_stats(), obs.snapshot()["fleet"], and metrics_tpu_fleet_parked_*
+    gauges — not invisible until the next resize."""
+    fleet = make_fleet(workers=(0, 1))
+    assert fleet.summary()["in_flight_tenants"] == 0
+    assert fleet.summary()["parked_requests"] == 0
+    # stage parked state the way a failed move/resubmission would
+    fleet._in_flight["t-parked"] = "ledger-key"
+    fleet._parked_requests.append(("t-parked", (_val(),), None))
+    summary = fleet.summary()
+    assert summary["in_flight_tenants"] == 1 and summary["parked_requests"] == 1
+    stats = flt.fleet_stats()
+    assert stats["in_flight_tenants"] >= 1 and stats["parked_requests"] >= 1
+    assert obs.snapshot()["fleet"]["in_flight_tenants"] >= 1
+    text = obs.prometheus_text()
+    assert "metrics_tpu_fleet_parked_tenants" in text
+    assert "metrics_tpu_fleet_parked_requests" in text
+    assert f'fleet="{fleet.name}"' in text
+    fleet._in_flight.clear()
+    fleet._parked_requests.clear()
+
+
+def test_departed_workers_are_pruned_from_health_gauges():
+    """A gracefully-departed worker must not be counted healthy forever."""
+    fleet = make_fleet(workers=(0, 1, 2))
+    guard = flt.FleetGuard(fleet)
+    try:
+        emit_flush(fleet, 2, ms=2.0)
+        guard.observe()
+        assert 2 in guard.worker_states()
+        fleet.leave(2)
+        guard.observe()
+        assert 2 not in guard.worker_states()
+        assert guard.summary()["healthy"] == 2
+    finally:
+        guard.close()
+
+
+def test_closing_one_guard_keeps_a_sibling_guards_bus_alive():
+    """close() restores the bus enabled-state only when no other live guard
+    depends on it — guard A's close must not freeze guard B's scoring."""
+    fleet_a = make_fleet(workers=(0, 1))
+    fleet_b = make_fleet(workers=(0, 1))
+    guard_a = flt.FleetGuard(fleet_a)
+    guard_b = flt.FleetGuard(fleet_b)
+    try:
+        guard_a.close()
+        assert _bus.enabled()  # guard_b still needs the signal source
+        emit_flush(fleet_b, 0, ms=3.0)
+        assert guard_b.summary()["workers"]["0"]["flushes"] == 1
+    finally:
+        guard_b.close()
+    assert not _bus.enabled()  # the LAST close restores the prior state
+
+
+def test_kill_during_raised_cadence_still_recovers_bit_identical():
+    """The brownout interaction the chaos lane caught: with the checkpoint
+    cadence raised (as a brownout does), a kill()'s store-only recovery
+    would lose the acked tail inside the cadence window — the kill path
+    must seal the dead worker's final state first (its memory IS readable;
+    only die() loses the window)."""
+    fleet = make_fleet(workers=(0, 1, 2), checkpoint_every_n_flushes=5)
+    tenant = "t-tail"
+    victim = fleet.owner_of(tenant)
+    for i in range(3):  # 3 applied flushes, none checkpointed (cadence 5)
+        fleet.submit(tenant, _val(float(i + 1)))
+        fleet.flush()
+    assert fleet._workers[victim].bank.checkpoint_lag() >= 3
+    fleet.kill(victim)
+    # 4*(1+2+3) = 24: every acked update survived, not just the checkpointed prefix
+    assert float(np.asarray(fleet.compute(tenant))) == 24.0

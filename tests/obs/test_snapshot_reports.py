@@ -301,6 +301,7 @@ def test_jsonl_roundtrip_and_prometheus_render():
         "encoders",
         "fleet",
         "durability",
+        "guard",
         "bus",
         "spans",
         "warnings",
@@ -350,6 +351,20 @@ def test_jsonl_roundtrip_and_prometheus_render():
         "snapshots",
         "resumes",
     } <= set(process["durability"])
+    assert process["guard"] == _fleet.guard_stats()
+    assert {
+        "healthy",
+        "probation",
+        "ejected",
+        "hedges_armed",
+        "hedges_delivered",
+        "duplicates_dropped",
+        "duplicates_applied",
+        "ejections",
+        "guards",
+        "overload",
+    } <= set(process["guard"])
+    assert {"sheds", "brownout_active", "controllers"} <= set(process["guard"]["overload"])
     # ...and the Prometheus dump mirrors the fetch + warmup + sharding +
     # fleet counters
     assert "metrics_tpu_engine_async_fetches" in text

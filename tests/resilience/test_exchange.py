@@ -233,3 +233,39 @@ def test_backoff_elapsed_is_recorded():
     report = new_sync_stats()
     run_as_peers(2, lambda rank: exchange(group, rank, report=report if rank == 0 else None), store=store)
     assert report["backoff_s"] > 0.0
+
+
+def test_transient_classifier_covers_exception_types_and_messages():
+    """ISSUE 14 satellite: TimeoutError/ConnectionError/OSError are
+    transient by TYPE (a raised socket error retries instead of aborting
+    the exchange); generic runtime errors stay classified by message."""
+    from metrics_tpu.parallel.groups import _is_transient_kv_error
+    from metrics_tpu.utils.exceptions import SyncIntegrityError
+
+    # the type route
+    assert _is_transient_kv_error(TimeoutError("anything"))
+    assert _is_transient_kv_error(ConnectionError("peer hung up"))
+    assert _is_transient_kv_error(ConnectionResetError("reset"))
+    assert _is_transient_kv_error(OSError(104, "connection reset by peer"))
+    assert _is_transient_kv_error(BrokenPipeError("pipe"))
+    # the message route (real coordination-service clients raise generic
+    # runtime errors with DEADLINE_EXCEEDED/UNAVAILABLE text)
+    assert _is_transient_kv_error(RuntimeError("DEADLINE_EXCEEDED: kv get"))
+    assert _is_transient_kv_error(RuntimeError("server UNAVAILABLE, try later"))
+    assert not _is_transient_kv_error(RuntimeError("invalid argument"))
+    assert not _is_transient_kv_error(ValueError("bad payload"))
+    # integrity errors keep their own transient flag
+    assert _is_transient_kv_error(SyncIntegrityError("torn", transient=True))
+    assert not _is_transient_kv_error(SyncIntegrityError("version", transient=False))
+
+
+def test_raised_socket_error_is_retried_not_fatal():
+    """A flaky read raising a ConnectionError subclass (the 'flaky' gray
+    fault) must retry within the deadline and recover the full exchange —
+    the type-route regression for the old substring-only classifier."""
+    group = make_group()
+    reports = {r: new_sync_stats() for r in range(2)}
+    store = InMemoryKVStore([FaultSpec("flaky", rank=1, epoch=0, times=1)])
+    out = run_as_peers(2, lambda rank: exchange(group, rank, report=reports[rank]), store=store)
+    np.testing.assert_array_equal(_decode(out[0][1]), np.arange(4) + 100)
+    assert reports[0]["retries"] >= 1  # the ConnectionError was retried

@@ -191,3 +191,75 @@ def test_die_specs_parse_and_match_like_kill_with_crash_semantics():
     assert not plan.drops_publish("pg/s/1/2")
     assert plan.read_delay_s("pg/s/1/2") == 0.0
     assert plan.maybe_corrupt("pg/s/1/2", b"x") == b"x"
+
+
+def test_slow_specs_inject_latency_without_failing_the_read():
+    """The gray 'slow' kind: reads of the target rank's payload take extra
+    time but still ANSWER (unlike 'delay', which can blow its budget)."""
+    plan = parse_plan('[{"kind": "slow", "rank": 1, "epoch": 0, "seconds": 0.05}]')
+    assert plan.slow_s(1, 0) == 0.05
+    assert plan.slow_s(1, None) == 0.05  # unknown epoch: conservative match
+    assert plan.slow_s(0, 0) == 0.0 and plan.slow_s(1, 2) == 0.0
+    store = InMemoryKVStore(plan)
+    store.client(1).key_value_set_bytes("pg/s/0/1", b"slowly")
+    import time as _time
+
+    t0 = _time.monotonic()
+    assert store.client(0).blocking_key_value_get_bytes("pg/s/0/1", 1000) == b"slowly"
+    assert _time.monotonic() - t0 >= 0.05  # the latency really was injected
+
+
+def test_flaky_specs_fail_deterministically_then_heal():
+    """The gray 'flaky' kind: the first `times` of every `times + 1` calls
+    raise InjectedFaultError (a ConnectionError — the transient classifier
+    retries it by TYPE), then one succeeds, repeating."""
+    from metrics_tpu.resilience import InjectedFaultError
+
+    plan = parse_plan('[{"kind": "flaky", "rank": 1, "epoch": 0, "times": 2}]')
+    # duty cycle: fail, fail, ok, fail, fail, ok ...
+    pattern = [plan.flaky_fails(1, 0) for _ in range(6)]
+    assert pattern == [True, True, False, True, True, False]
+    assert not plan.flaky_fails(0, 0)  # other ranks untouched
+    store = InMemoryKVStore(parse_plan('[{"kind": "flaky", "rank": 1, "times": 1}]'))
+    store.client(1).key_value_set_bytes("pg/s/0/1", b"sometimes")
+    with pytest.raises(InjectedFaultError, match="injected flaky read"):
+        store.client(0).blocking_key_value_get_bytes("pg/s/0/1", 200)
+    # the duty cycle heals: the next read succeeds
+    assert store.client(0).blocking_key_value_get_bytes("pg/s/0/1", 200) == b"sometimes"
+    assert issubclass(InjectedFaultError, ConnectionError)
+
+
+def test_faulty_client_applies_slow_and_flaky():
+    from metrics_tpu.resilience import InjectedFaultError
+
+    inner = _FakeInner()
+    inner.store["pg/s/0/1"] = b"payload"
+    client = FaultyClient(
+        inner,
+        parse_plan(
+            '[{"kind": "slow", "rank": 1, "seconds": 0.04},'
+            ' {"kind": "flaky", "rank": 1, "times": 1}]'
+        ),
+    )
+    import time as _time
+
+    with pytest.raises(InjectedFaultError):
+        client.blocking_key_value_get_bytes("pg/s/0/1", 1000)
+    t0 = _time.monotonic()
+    assert client.blocking_key_value_get_bytes("pg/s/0/1", 1000) == b"payload"
+    assert _time.monotonic() - t0 >= 0.04
+
+
+def test_unknown_fault_kind_raises_loudly_at_parse_time(monkeypatch):
+    """A typo'd METRICS_TPU_FAULTS entry must fail the run at parse time,
+    naming the offending spec — never silently inject nothing."""
+    with pytest.raises(ValueError, match=r"entry 1 .*'sloow'.*Unknown fault kind"):
+        parse_plan('[{"kind": "drop", "rank": 0}, {"kind": "sloow", "rank": 1}]')
+    with pytest.raises(ValueError, match=r"entry 0 .*known fields"):
+        parse_plan('[{"kind": "drop", "rank": 0, "secconds": 1}]')  # typo'd field
+    with pytest.raises(ValueError, match=r"entry 0 must be an object"):
+        parse_plan('["drop"]')
+    # the env route surfaces the same loud error
+    monkeypatch.setenv("METRICS_TPU_FAULTS", '[{"kind": "nope", "rank": 0}]')
+    with pytest.raises(ValueError, match="Unknown fault kind"):
+        plan_from_env()

@@ -1,0 +1,178 @@
+"""Admission control: token buckets, inflight cap, deadline shedding,
+retry budgets, and the brownout stretch/restore hysteresis — every
+rejection a loud OverloadError, never a silent drop."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import OverloadError, SumMetric, engine
+from metrics_tpu import fleet as flt
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.resilience import AdmissionController, TokenBucket
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    engine.clear_cache()
+    _bus.clear()
+    yield
+    engine.clear_cache()
+    _bus.disable()
+    _bus.clear()
+
+
+def _val(x=1.0, n=4):
+    return jnp.asarray(np.full(n, x, np.float32))
+
+
+def make_fleet(**kwargs):
+    kwargs.setdefault("max_delay_s", None)
+    return flt.Fleet(
+        SumMetric(nan_strategy="disable"), workers=[0, 1], capacity=8, **kwargs
+    )
+
+
+def test_token_bucket_rate_burst_and_refill():
+    clock = [0.0]
+    bucket = TokenBucket(rate=2.0, burst=3.0, clock=lambda: clock[0])
+    assert [bucket.try_take() for _ in range(4)] == [True, True, True, False]
+    clock[0] = 1.0  # 2 tokens refilled
+    assert bucket.try_take() and bucket.try_take() and not bucket.try_take()
+    clock[0] = 100.0  # refill clamps at burst
+    assert bucket.tokens == pytest.approx(3.0)
+    with pytest.raises(ValueError, match="rate and burst"):
+        TokenBucket(rate=0, burst=1)
+
+
+def test_tenant_quota_sheds_loudly_and_queues_nothing():
+    clock = [0.0]
+    fleet = make_fleet()
+    ctrl = AdmissionController(
+        fleet, tenant_rate=1.0, tenant_burst=2.0, brownout_after=None, clock=lambda: clock[0]
+    )
+    _bus.enable()
+    ctrl.submit("greedy", _val())
+    ctrl.submit("greedy", _val())
+    pending_before = sum(
+        w.router.pending for w in fleet._workers.values() if w.router is not None
+    )
+    with pytest.raises(OverloadError, match="tenant_quota") as err:
+        ctrl.submit("greedy", _val())
+    assert err.value.reason == "tenant_quota" and err.value.tenant == "greedy"
+    # the shed request was NOT queued — rejected means rejected
+    pending_after = sum(
+        w.router.pending for w in fleet._workers.values() if w.router is not None
+    )
+    assert pending_after == pending_before
+    # other tenants' quotas are independent
+    ctrl.submit("frugal", _val())
+    assert ctrl.stats["sheds"] == 1 and ctrl.stats["shed_tenant_quota"] == 1
+    assert ctrl.stats["admitted"] == 3
+    shed_events = _bus.events("shed")
+    assert shed_events and shed_events[-1].data["reason"] == "tenant_quota"
+    # the quota refills with time
+    clock[0] = 5.0
+    ctrl.submit("greedy", _val())
+
+
+def test_global_inflight_cap_sheds():
+    fleet = make_fleet()  # max_delay None: requests stay queued
+    ctrl = AdmissionController(fleet, max_inflight=2, brownout_after=None)
+    ctrl.submit("a", _val())
+    ctrl.submit("b", _val())
+    with pytest.raises(OverloadError, match="inflight"):
+        ctrl.submit("c", _val())
+    assert ctrl.stats["shed_inflight"] == 1
+    fleet.flush()  # queues drain -> admission resumes
+    ctrl.submit("c", _val())
+
+
+def test_deadline_aware_shedding_rejects_unmeetable_deadlines_now():
+    fleet = make_fleet(max_delay_s=0.05)
+    ctrl = AdmissionController(fleet, brownout_after=None)
+    # the flush deadline alone (0.05s) exceeds a 10ms budget: shed NOW,
+    # while the caller can still act — never silently burn the deadline
+    with pytest.raises(OverloadError, match="deadline"):
+        ctrl.submit("t", _val(), deadline_s=0.01)
+    assert ctrl.stats["shed_deadline"] == 1
+    # a meetable deadline is admitted
+    ctrl.submit("t", _val(), deadline_s=5.0)
+    assert ctrl.stats["admitted"] == 1
+
+
+def test_retry_budget_is_bounded_separately_from_fresh_traffic():
+    clock = [0.0]
+    fleet = make_fleet()
+    ctrl = AdmissionController(
+        fleet, retry_rate=0.1, retry_burst=1.0, brownout_after=None, clock=lambda: clock[0]
+    )
+    ctrl.submit("t", _val(), retry=True)  # draws the single budget token
+    with pytest.raises(OverloadError, match="retry_budget"):
+        ctrl.submit("t", _val(), retry=True)
+    assert ctrl.stats["retries_admitted"] == 1
+    assert ctrl.stats["shed_retry_budget"] == 1
+    ctrl.submit("t", _val())  # fresh traffic is not gated by the retry budget
+
+
+def test_brownout_stretches_and_restores_with_hysteresis():
+    fleet = make_fleet(max_delay_s=0.05, checkpoint_every_n_flushes=1)
+    ctrl = AdmissionController(
+        fleet,
+        max_inflight=1,
+        brownout_after=2,
+        brownout_recover_after=2,
+        brownout_stretch=4.0,
+    )
+    _bus.enable()
+    worker = next(iter(fleet._workers.values()))
+    assert worker.router.max_delay_s == 0.05
+    assert worker.bank.checkpoint_cadence == 1
+    # two consecutive hot ticks (a shed each) engage brownout
+    for _ in range(2):
+        ctrl.submit("a", _val())
+        with pytest.raises(OverloadError):
+            ctrl.submit("b", _val())
+        assert not ctrl.tick() or ctrl.brownout_active
+        fleet.flush()
+    assert ctrl.brownout_active
+    assert worker.router.max_delay_s == pytest.approx(0.2)
+    assert worker.bank.checkpoint_cadence == 4
+    events = [e.data.get("event") for e in _bus.events("guard")]
+    assert "brownout_enter" in events
+    # one cool tick is NOT enough (hysteresis)...
+    assert ctrl.tick() is True
+    # ... but recover_after consecutive cool ticks restore the originals
+    assert ctrl.tick() is False
+    assert worker.router.max_delay_s == pytest.approx(0.05)
+    assert worker.bank.checkpoint_cadence == 1
+    assert ctrl.stats["brownouts_entered"] == 1 and ctrl.stats["brownouts_exited"] == 1
+    assert "brownout_exit" in [e.data.get("event") for e in _bus.events("guard")]
+
+
+def test_controller_wraps_a_fleet_guard_and_returns_request_ids():
+    fleet = make_fleet()
+    guard = flt.FleetGuard(fleet)
+    try:
+        ctrl = AdmissionController(guard, brownout_after=None)
+        rid = ctrl.submit("t", _val(2.0))
+        assert isinstance(rid, str) and fleet.has_pending_request(rid)
+        assert ctrl.fleet is fleet  # resolved through guard.fleet
+        assert guard.drain()
+        assert float(np.asarray(fleet.compute("t"))) == 8.0
+    finally:
+        guard.close()
+
+
+def test_overload_summary_aggregates_controllers():
+    from metrics_tpu.resilience import overload_summary
+
+    fleet = make_fleet()
+    ctrl = AdmissionController(fleet, tenant_rate=0.001, tenant_burst=1.0, brownout_after=None)
+    ctrl.submit("t", _val())
+    with pytest.raises(OverloadError):
+        ctrl.submit("t", _val())
+    summary = overload_summary()
+    assert ctrl.name in summary["controllers"]
+    assert summary["sheds"] >= 1 and summary["shed_tenant_quota"] >= 1
+    assert summary["brownout_active"] is False

@@ -166,11 +166,165 @@ def test_drain_pending_returns_requests_in_per_tenant_order():
     router = RequestRouter(bank, max_requests=100, max_delay_s=None)
     v1 = jnp.asarray(np.full(4, 1.0, np.float32))
     v2 = jnp.asarray(np.full(4, 2.0, np.float32))
-    router.submit("T", v1)
-    router.submit("T", v2)  # second wave, same tenant
+    router.submit("T", v1, request_id="r1")
+    router.submit("T", v2)  # second wave, same tenant (untagged)
     router.submit("U", v1)
     drained = router.drain_pending()
     assert router.pending == 0
-    t_vals = [float(np.asarray(args[0][0])) for t, args in drained if t == "T"]
+    t_vals = [float(np.asarray(args[0][0])) for t, args, _rid in drained if t == "T"]
     assert t_vals == [1.0, 2.0]  # per-tenant submission order preserved
+    # request ids survive the drain (the fleet kill path resubmits with them
+    # so a resubmission still dedups against its hedged twin)
+    ids = {(t, rid) for t, _args, rid in drained}
+    assert ("T", "r1") in ids and ("U", None) in ids
     assert bank.stats["launches"] == 0  # nothing was applied
+
+
+def test_sig_stats_overflow_folds_into_bounded_sig_other():
+    """ISSUE 14 satellite: churn more distinct signatures than
+    _SIG_STATS_CAP and assert the stats maps stay bounded while the
+    aggregated pending counts and oldest-wait stay correct through the
+    shared ``sig_other`` bucket."""
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=64)
+    clock = [0.0]
+    router = RequestRouter(bank, max_requests=64, max_delay_s=None, clock=lambda: clock[0])
+    router._SIG_STATS_CAP = 8  # instance override: same fold path, cheap churn
+    n_sigs = 12  # > cap: 8 dedicated rows + 4 folded into sig_other
+    for i in range(n_sigs):
+        clock[0] = float(i)
+        # one request per signature (distinct shapes), distinct tenants so
+        # no cross-group ordering flush fires
+        router.submit(f"t{i}", jnp.asarray(np.ones(i + 1, np.float32)))
+    # the maps are BOUNDED: cap dedicated labels + one shared bucket
+    assert len(router._sig_labels) == 8
+    assert set(router._sig_stats) == {f"sig{i}" for i in range(8)} | {"sig_other"}
+    detail = router.pending_detail()
+    assert len(detail) == 9
+    # aggregation stays correct: every request visible, overflow pending
+    # pooled in sig_other with the OLDEST overflow wait reported
+    assert sum(entry["pending"] for entry in detail.values()) == n_sigs
+    assert detail["sig_other"]["pending"] == 4
+    assert detail["sig_other"]["submitted"] == 4
+    clock[0] = 20.0
+    detail = router.pending_detail()
+    # overflow sigs arrived at t=8..11; the oldest (t=8) defines the wait
+    assert detail["sig_other"]["oldest_wait_s"] == pytest.approx(12.0)
+    assert detail["sig7"]["oldest_wait_s"] == pytest.approx(13.0)
+    # flushing attributes per-signature flushed counts to the shared bucket
+    router.flush()
+    assert router.pending == 0
+    assert detail_total_flushed(router) == n_sigs
+    assert router._sig_stats["sig_other"]["flushed"] == 4
+    # churn MORE new signatures: the maps cannot grow past the cap
+    for i in range(4):
+        clock[0] = 30.0 + i
+        router.submit(f"u{i}", jnp.asarray(np.ones(20 + i, np.float32)))
+    assert len(router._sig_labels) == 8
+    assert len(router._sig_stats) == 9
+    assert router._sig_stats["sig_other"]["submitted"] == 8
+    router.drain_pending()
+
+
+def detail_total_flushed(router):
+    return sum(entry["flushed"] for entry in router.pending_detail().values())
+
+
+def test_request_ids_flow_to_the_banks_dedup():
+    """Tagged requests flush with their ids; a second copy of the same
+    (tenant, id) — whichever router it arrives through — is dropped before
+    any state is touched, and the batch still reports it consumed."""
+    from metrics_tpu.serving import RequestDedup
+
+    dedup = RequestDedup()
+    bank_a = MetricBank(SumMetric(nan_strategy="disable"), capacity=4, request_dedup=dedup)
+    bank_b = MetricBank(SumMetric(nan_strategy="disable"), capacity=4, request_dedup=dedup)
+    router_a = RequestRouter(bank_a, max_requests=8, max_delay_s=None)
+    router_b = RequestRouter(bank_b, max_requests=8, max_delay_s=None)
+    v = jnp.asarray(np.full(4, 3.0, np.float32))
+    router_a.submit("T", v, request_id="r1")
+    router_b.submit("T", v, request_id="r1")  # the hedged twin
+    router_a.flush()
+    assert float(np.asarray(bank_a.tenant_state("T")["value"])) == 12.0
+    # the twin is consumed (queue drains) but NOT applied — and bank_b never
+    # even admits a session for the tenant
+    assert router_b.flush() == 1
+    assert router_b.pending == 0
+    assert bank_b.occupancy == 0 and "T" not in bank_b.tenants
+    assert bank_b.stats["dedup_dropped"] == 1
+    assert dedup.summary()["duplicates_dropped"] == 1
+    assert dedup.summary()["duplicates_applied"] == 0
+
+
+def test_injected_flush_error_requeues_tagged_request_before_any_claim():
+    """A gray-fault injector fires BEFORE dedup claims or admissions: the
+    request re-queues with no claim to leak, and the retry applies."""
+    from metrics_tpu.serving import RequestDedup
+
+    dedup = RequestDedup()
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=4, request_dedup=dedup)
+    router = RequestRouter(bank, max_requests=8, max_delay_s=None)
+    boom = [True]
+
+    def injector():
+        if boom[0]:
+            boom[0] = False
+            raise ConnectionError("UNAVAILABLE: injected")
+
+    bank.fault_injector = injector
+    v = jnp.asarray(np.full(4, 2.0, np.float32))
+    router.submit("T", v, request_id="r1")
+    with pytest.raises(ConnectionError):
+        router.flush()
+    assert router.pending == 1  # re-queued, not lost
+    assert bank.stats["flush_errors"] == 1
+    assert bank.occupancy == 0  # failed before any admission
+    assert dedup.summary()["claims"] == 0  # ... and before any claim
+    assert router.flush() == 1  # the duty cycle healed: the retry applies
+    assert float(np.asarray(bank.tenant_state("T")["value"])) == 8.0
+    assert dedup.is_applied("T", "r1")
+
+
+def test_failed_dispatch_releases_dedup_claims_for_retry():
+    """A dispatch that raises AFTER claiming aborts its exactly-once
+    claims, so the router's re-queued requests stay appliable."""
+    from metrics_tpu.serving import RequestDedup
+
+    dedup = RequestDedup()
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=4, request_dedup=dedup)
+    router = RequestRouter(bank, max_requests=8, max_delay_s=None)
+    orig = bank._dispatch_scatter
+    calls = [0]
+
+    def flaky_dispatch(*args, **kwargs):
+        if calls[0] == 0:
+            calls[0] += 1
+            raise RuntimeError("XLA launch failed")
+        return orig(*args, **kwargs)
+
+    bank._dispatch_scatter = flaky_dispatch
+    v = jnp.asarray(np.full(4, 2.0, np.float32))
+    router.submit("T", v, request_id="r1")
+    with pytest.raises(RuntimeError, match="XLA launch failed"):
+        router.flush()
+    assert router.pending == 1  # re-queued, not lost
+    assert bank.stats["flush_errors"] == 1
+    assert dedup.summary()["aborts"] == 1  # the claim was released
+    assert router.flush() == 1  # the retry applies
+    assert float(np.asarray(bank.tenant_state("T")["value"])) == 8.0
+    assert dedup.is_applied("T", "r1")
+    assert dedup.summary()["duplicates_applied"] == 0
+
+
+def test_caller_validation_errors_are_not_worker_sickness():
+    """A buggy client batch (over-capacity, duplicate tenant, misaligned
+    ids) raises BEFORE the flush-error accounting — it must not feed the
+    error EWMA a FleetGuard ejects on."""
+    bank = MetricBank(SumMetric(nan_strategy="disable"), capacity=2)
+    v = jnp.asarray(np.ones(4, np.float32))
+    with pytest.raises(ValueError, match="exceeds bank capacity"):
+        bank.apply_batch([(f"t{i}", (v,)) for i in range(3)])
+    with pytest.raises(ValueError, match="multiple requests for one tenant"):
+        bank.apply_batch([("t", (v,)), ("t", (v,))])
+    with pytest.raises(ValueError, match="must align"):
+        bank.apply_batch([("t", (v,))], request_ids=["a", "b"])
+    assert bank.stats["flush_errors"] == 0
