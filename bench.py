@@ -2931,6 +2931,205 @@ def bench_durable_recovery() -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# PR 16: kernel tier — interpret-vs-XLA parity, roofline attribution, and the
+# forced-pallas loud-fallback audit for every registered op
+# ---------------------------------------------------------------------------
+def bench_kernel_tier() -> dict:
+    """Three gates over the registry-dispatched kernel tier
+    (``metrics_tpu/ops/registry.py``), asserted by ``ci.sh --kernel-smoke``:
+
+    1. **Parity** — every registered op's Pallas body executes under
+       ``pallas_call(..., interpret=True)`` (any backend) against its XLA
+       composition: bit-identical for integer-count ops
+       (``integer_exact=True``), within the documented tolerance for float
+       ops (summation-order / bf16-dot differences).
+    2. **Attribution** — per-op achieved GB/s (and GFLOP/s where the model
+       counts flops) from timing the jitted XLA composition against
+       ``xla_cost_analysis``'s own byte/flop model, via the same
+       ``_roofline_fields`` every other lane uses; on TPU the native Pallas
+       path is timed too. ``cost_unavailable`` flags backends that expose no
+       cost model rather than inventing numbers.
+    3. **Loud fallbacks** — one dispatch per op under an explicit
+       ``kernel_policy('pallas')``: every dispatch that lands on the XLA
+       path must have BOTH a ``kernel`` bus event naming the reason and a
+       recorded ``warn_once`` (``silent_fallbacks`` must be zero).
+    """
+    import functools
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import obs
+    from metrics_tpu.obs import warn as _warnmod
+    from metrics_tpu.ops import binned_counts as bc
+    from metrics_tpu.ops import pairwise_reduce as pr
+    from metrics_tpu.ops import registry as kreg
+    from metrics_tpu.ops import select_topk as st
+
+    # `metrics_tpu.ops.confusion_counts` the MODULE is shadowed on the package
+    # by the same-named public function, so pull its internals by dotted path
+    from metrics_tpu.ops.confusion_counts import (
+        _confusion_counts_pallas,
+        _confusion_counts_xla,
+        _multilabel_counts_pallas,
+        _multilabel_counts_xla,
+    )
+
+    small = _small()
+    n = 4096 if small else 65536
+    c = 32 if small else 256
+    reps = 3 if small else 10
+    rng = np.random.RandomState(16)
+
+    preds_i = jnp.asarray(rng.randint(0, c, n))
+    target_i = jnp.asarray(rng.randint(0, c, n))
+    ml_c = 16 if small else 128
+    ml_p = jnp.asarray(rng.randint(0, 2, (n, ml_c)))
+    ml_t = jnp.asarray(rng.randint(0, 2, (n, ml_c)))
+    bp = jnp.asarray(rng.rand(n, 4).astype(np.float32))
+    bt = jnp.asarray(rng.randint(0, 2, (n, 4)))
+    ths = jnp.linspace(0.0, 1.0, 51)
+    conf = jnp.asarray(rng.rand(n).astype(np.float32))
+    acc = jnp.asarray((rng.rand(n) > 0.5).astype(np.float32))
+    bounds = jnp.linspace(0.0, 1.0, 16)
+    tk = jnp.asarray(rng.rand(1024 if small else 8192, 200 if small else 1000).astype(np.float32))
+    pw_n = 256 if small else 2048
+    pw_x = jnp.asarray(rng.rand(pw_n, 128).astype(np.float32))
+    pw_y = jnp.asarray(rng.rand(pw_n, 128).astype(np.float32))
+
+    def pw_composition(x, y):
+        # the callers' own XLA formulation (the registry's xla entry for this
+        # op is a hand-back sentinel, so parity is taken against this)
+        xn = jnp.sum(x * x, axis=1, keepdims=True)
+        yn = jnp.sum(y * y, axis=1)[None, :]
+        dist = jnp.sqrt(jnp.clip(xn + yn - 2 * (x @ y.T), min=0))
+        return jnp.sum(dist, axis=-1)
+
+    # op -> (args, pallas fn accepting interpret=, jitted XLA composition,
+    #        documented float rtol — None for bit-exact integer ops)
+    cases = {
+        "confusion_counts": (
+            (preds_i, target_i),
+            functools.partial(_confusion_counts_pallas, num_classes=c),
+            jax.jit(functools.partial(_confusion_counts_xla, num_classes=c)),
+            None,
+        ),
+        "multilabel_counts": (
+            (ml_p, ml_t),
+            _multilabel_counts_pallas,
+            jax.jit(_multilabel_counts_xla),
+            None,
+        ),
+        "binned_counts": ((bp, bt, ths), bc._binned_counts_pallas, jax.jit(bc._binned_counts_xla), None),
+        "binned_calibration": (
+            (conf, acc, bounds),
+            bc._binned_calibration_pallas,
+            jax.jit(bc._binned_calibration_xla),
+            1e-5,
+        ),
+        "select_topk": (
+            (tk,),
+            functools.partial(st._topk_mask, k=5),
+            jax.jit(functools.partial(st._topk_mask_xla, k=5)),
+            None,
+        ),
+        "pairwise_reduce": (
+            (pw_x, pw_y),
+            functools.partial(pr._fused_row_sums, op="euclidean", zero_diagonal=False),
+            jax.jit(pw_composition),
+            2e-2,  # one-pass bf16 dot vs f32 composition (ops/pairwise_reduce.py)
+        ),
+    }
+
+    def _max_rel_err(a, b) -> float:
+        worst = 0.0
+        for la, lb in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+            la = np.asarray(la, np.float64)
+            lb = np.asarray(lb, np.float64)
+            worst = max(worst, float(np.max(np.abs(la - lb) / np.maximum(1.0, np.abs(lb)))))
+        return worst
+
+    ops_report = {}
+    for name, (args, pallas_fn, xla_jit, rtol) in cases.items():
+        interp_out = pallas_fn(*args, interpret=True)
+        xla_out = xla_jit(*args)
+        if rtol is None:
+            exact = all(
+                bool((np.asarray(la) == np.asarray(lb)).all())
+                for la, lb in zip(jax.tree_util.tree_leaves(interp_out), jax.tree_util.tree_leaves(xla_out))
+            )
+            rec = {"parity": "bit_exact", "bit_exact": exact}
+        else:
+            err = _max_rel_err(interp_out, xla_out)
+            rec = {
+                "parity": "tolerance",
+                "max_rel_err": err,
+                "documented_rtol": rtol,
+                "within_tolerance": err <= rtol,
+            }
+        # attribution: time the jitted XLA composition (the path every
+        # backend runs) against XLA's own cost model
+        cost = _xla_cost(xla_jit, *args)
+        _force(xla_jit(*args))  # warm
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(reps):
+            out = xla_jit(*args)
+        _force(out)
+        elapsed = time.perf_counter() - t0
+        rec["xla_ms_per_call"] = round(1e3 * elapsed / reps, 3)
+        rec["cost_unavailable"] = not (cost and cost.get("model_bytes"))
+        rec.update(_roofline_fields(cost, reps, elapsed))
+        if jax.default_backend() == "tpu":
+            # native kernel timing rides along where the op can run natively
+            op_entry = kreg.get_op(name)
+            ok, _why = op_entry.eligible(*args)
+            if ok:
+                _force(pallas_fn(*args))
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    out = pallas_fn(*args)
+                _force(out)
+                p_elapsed = time.perf_counter() - t0
+                rec["pallas_ms_per_call"] = round(1e3 * p_elapsed / reps, 3)
+                if cost and cost.get("model_bytes"):
+                    rec["pallas_achieved_GBps"] = round(
+                        cost["model_bytes"] * reps / p_elapsed / 1e9, 2
+                    )
+        ops_report[name] = rec
+
+    # forced-pallas audit: every XLA landing must be loud (warn_once + event)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with obs.capture(kinds=("kernel",)) as events:
+            with kreg.kernel_policy("pallas"):
+                kreg.dispatch("confusion_counts", preds_i, target_i, num_classes=c)
+                kreg.dispatch("multilabel_counts", ml_p, ml_t)
+                kreg.dispatch("binned_counts", bp, bt, ths)
+                kreg.dispatch("binned_calibration", conf, acc, bounds)
+                kreg.dispatch("select_topk", tk, 5)
+                kreg.dispatch("pairwise_reduce", pw_x, pw_y, op="euclidean", zero_diagonal=False)
+    warn_keys = set(_warnmod.warn_counts())
+    fallbacks = [e for e in events if e.data["path"] == "xla"]
+    silent = [
+        e for e in fallbacks if ("kernel_fallback", e.data["op"], e.data["reason"]) not in warn_keys
+    ]
+    stats = kreg.kernel_stats()
+    return {
+        "metric": "kernel_tier",
+        "n": n,
+        "registered_ops": stats["registered"],
+        "ops": ops_report,
+        "forced_pallas_dispatches": len(events),
+        "forced_pallas_fallbacks": len(fallbacks),
+        "silent_fallbacks": len(silent),
+        "kernel_events_emitted": len(events),
+        "policy_default": kreg.policy(),
+    }
+
+
 _CONFIGS = [
     ("bench_fid", 1500, True),
     ("bench_bertscore", 1500, True),
@@ -2952,6 +3151,7 @@ _CONFIGS = [
     ("bench_fleet_elasticity", 900, False),
     ("bench_durable_recovery", 900, False),
     ("bench_gray_failure", 900, False),
+    ("bench_kernel_tier", 900, False),
 ]
 
 # the headline runs outside _CONFIGS (measured first, emitted last) but is
@@ -3196,6 +3396,9 @@ _SMOKE_LANES = {
     # gray failure + overload: slow/flaky injection, guard ejection, hedged
     # exactly-once apply, loud shedding, brownout, acked-stream bit-identity
     "--chaos-smoke": ("bench_gray_failure", {"small": True}),
+    # kernel tier: interpret-vs-XLA parity per registered op, roofline GB/s
+    # attribution, zero silent fallbacks under kernel_policy('pallas')
+    "--kernel-smoke": ("bench_kernel_tier", {"small": True}),
 }
 
 

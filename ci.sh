@@ -385,4 +385,39 @@ if obj["brownouts_entered"] < 1 or obj["brownout_active"] is not False:
 print("chaos smoke OK:", line)
 '
 
+echo "=== kernel-tier smoke (interpret-vs-XLA parity, rooflines, loud fallbacks) ==="
+# ISSUE 16 acceptance: every registered Pallas kernel body executes under
+# interpret mode on this CPU lane with bit-exact integer-count parity
+# (documented tolerance for float ops), per-op achieved GB/s is attributed
+# against the xla_cost_analysis byte model, and an explicit
+# kernel_policy('pallas') produces ZERO silent fallbacks — every XLA landing
+# carries a warn_once + a kernel bus event naming the reason
+JAX_PLATFORMS=cpu python bench.py --kernel-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "kernel_tier", obj
+if not obj["registered_ops"]:
+    print("kernel registry is empty:", line); sys.exit(2)
+for name, rec in obj["ops"].items():
+    # parity: bit-exact for integer-count ops, documented rtol for float
+    if rec["parity"] == "bit_exact":
+        if rec["bit_exact"] is not True:
+            print("kernel %s interpret-vs-XLA parity broke (bit-exact op):" % name, line); sys.exit(2)
+    else:
+        if rec["within_tolerance"] is not True:
+            print("kernel %s drifted past its documented tolerance (%s > %s):"
+                  % (name, rec["max_rel_err"], rec["documented_rtol"]), line); sys.exit(2)
+    # attribution: every op reports achieved GB/s against the cost model,
+    # unless the backend honestly exposes no cost model at all
+    if not rec.get("cost_unavailable") and "achieved_GBps" not in rec:
+        print("kernel %s has a cost model but no achieved_GBps:" % name, line); sys.exit(2)
+if obj["silent_fallbacks"] != 0:
+    print("%s SILENT fallbacks under kernel_policy(pallas):" % obj["silent_fallbacks"], line); sys.exit(2)
+if obj["kernel_events_emitted"] != obj["forced_pallas_dispatches"]:
+    print("kernel dispatches went unobserved (%s events for %s dispatches):"
+          % (obj["kernel_events_emitted"], obj["forced_pallas_dispatches"]), line); sys.exit(2)
+print("kernel-tier smoke OK (%d ops):" % len(obj["ops"]), line)
+'
+
 echo "both lanes green"

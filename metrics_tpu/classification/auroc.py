@@ -2,14 +2,26 @@
 
 Parity: reference ``torchmetrics/classification/auroc.py:30`` — sample-buffer
 archetype: full preds/target lists (``:152-153``), exact compute at epoch end.
-For a jittable constant-memory alternative use the binned curve metrics.
+
+Two constant-memory alternatives: ``buffer_capacity=N`` (exact results over a
+fixed window, checked overflow) and ``thresholds=T`` (binary only) — a
+streaming binned mode whose update accumulates ``[T]`` TP/FP/FN/TN counters
+through the registry-dispatched ``binned_counts`` kernel
+(``ops/binned_counts.py``) and whose compute traces the trapezoidal area
+under the binned ROC curve. Binned AUROC is an approximation of the exact
+rank statistic, like the reference's ``thresholds=`` argument on the curve
+metrics.
 """
-from typing import Any, Optional
+from typing import Any, Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.ops.binned_counts import binned_stat_counts
+from metrics_tpu.ops.safe_ops import safe_divide
 from metrics_tpu.utils.bounded import CURVE_MULTILABEL_HINT, _BoundedSampleBufferMixin, curve_buffer_specs
+from metrics_tpu.utils.enums import DataType
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -33,6 +45,14 @@ class AUROC(_BoundedSampleBufferMixin, Metric):
         multilabel: bounded-mode declaration that updates carry multi-label
             ``[N, num_classes]`` targets, registering ``[capacity,
             num_classes]`` buffer rows. Only valid with ``buffer_capacity``.
+        thresholds: binary-only streaming binned mode. An int ``T`` bins at
+            ``linspace(0, 1, T)``; a sequence/array is used as-is. The state
+            is four ``[T]`` integer counters (O(T) memory regardless of
+            sample count, ``dist_reduce_fx="sum"``), accumulated through the
+            registry-dispatched ``binned_counts`` op, and compute is the
+            trapezoidal area under the binned ROC curve — an approximation
+            of the exact rank statistic that sharpens with more thresholds.
+            Mutually exclusive with ``buffer_capacity``/``max_fpr``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -58,6 +78,7 @@ class AUROC(_BoundedSampleBufferMixin, Metric):
         max_fpr: Optional[float] = None,
         buffer_capacity: Optional[int] = None,
         multilabel: bool = False,
+        thresholds: Optional[Union[int, Sequence[float], Array]] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -75,13 +96,53 @@ class AUROC(_BoundedSampleBufferMixin, Metric):
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
         self.mode = None
-        self._init_sample_states(
-            buffer_capacity, num_classes, specs=curve_buffer_specs(num_classes, multilabel, buffer_capacity)
-        )
+        if thresholds is not None:
+            if buffer_capacity is not None or multilabel:
+                raise ValueError(
+                    "`thresholds` (streaming binned mode) and `buffer_capacity` are"
+                    " mutually exclusive constant-memory modes — pick one"
+                )
+            if max_fpr is not None:
+                raise ValueError("`max_fpr` is not supported in the binned `thresholds` mode")
+            if isinstance(thresholds, int):
+                if thresholds < 2:
+                    raise ValueError(f"`thresholds` as an int must be >= 2, got {thresholds}")
+                thresholds = jnp.linspace(0.0, 1.0, thresholds, dtype=jnp.float32)
+            else:
+                thresholds = jnp.asarray(thresholds, dtype=jnp.float32)
+                if thresholds.ndim != 1 or thresholds.shape[0] < 2:
+                    raise ValueError("`thresholds` must be a 1D sequence with at least 2 entries")
+            self.thresholds = thresholds  # ascending; compute reverses for the ROC sweep
+            # binned mode never touches the sample buffers; the mixin's
+            # host-side-compute probe reads this attribute, so pin it off
+            self.buffer_capacity = None
+            t = thresholds.shape[0]
+            count_dtype = jnp.asarray(0).dtype
+            for name in ("bTPs", "bFPs", "bFNs", "bTNs"):
+                self.add_state(name, jnp.zeros((t,), dtype=count_dtype), dist_reduce_fx="sum")
+        else:
+            self.thresholds = None
+            self._init_sample_states(
+                buffer_capacity, num_classes, specs=curve_buffer_specs(num_classes, multilabel, buffer_capacity)
+            )
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, mode = _auroc_update(preds, target)
-        self._append_samples(preds, target)
+        if self.thresholds is not None:
+            if mode != DataType.BINARY:
+                raise ValueError(
+                    f"The binned `thresholds` mode of AUROC only supports binary data, got mode {mode}"
+                )
+            # one-pass streaming counter, registry-dispatched ([1, T] -> [T])
+            tps, fps, fns, tns = binned_stat_counts(
+                preds.reshape(-1, 1), (target == 1).astype(jnp.int32).reshape(-1, 1), self.thresholds
+            )
+            self.bTPs = self.bTPs + tps[0]
+            self.bFPs = self.bFPs + fps[0]
+            self.bFNs = self.bFNs + fns[0]
+            self.bTNs = self.bTNs + tns[0]
+        else:
+            self._append_samples(preds, target)
         if self.mode and self.mode != mode:
             raise ValueError(
                 "The mode of data (binary, multi-label, multi-class) should be constant, but changed"
@@ -92,6 +153,14 @@ class AUROC(_BoundedSampleBufferMixin, Metric):
     def compute(self) -> Array:
         if not self.mode:
             raise RuntimeError("You have to have determined mode.")
+        if self.thresholds is not None:
+            tpr = safe_divide(self.bTPs.astype(jnp.float32), (self.bTPs + self.bFNs).astype(jnp.float32))
+            fpr = safe_divide(self.bFPs.astype(jnp.float32), (self.bFPs + self.bTNs).astype(jnp.float32))
+            # ascending thresholds give a descending sweep; reverse and pin the
+            # (0,0) / (1,1) endpoints, then trapezoid
+            tpr = jnp.concatenate([jnp.zeros((1,)), tpr[::-1], jnp.ones((1,))])
+            fpr = jnp.concatenate([jnp.zeros((1,)), fpr[::-1], jnp.ones((1,))])
+            return jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0)
         preds, target = self._collect_samples()
         return _auroc_compute(
             preds, target, self.mode, self.num_classes, self.pos_label, self.average, self.max_fpr

@@ -103,8 +103,9 @@ class BinnedPrecisionRecallCurve(Metric):
             )
             preds = jnp.moveaxis(preds, 1, -1).reshape(-1, self.num_classes)
 
-        # single source of truth for the threshold counters (XLA path by
-        # default; a Pallas variant lives behind use_pallas=True there)
+        # single source of truth for the threshold counters, dispatched
+        # through the kernel registry (kernel_policy picks the one-pass
+        # Pallas streaming counter vs the XLA broadcast composition)
         tp, fp, fn, _ = binned_stat_counts(preds, (target == 1).astype(jnp.int32), self.thresholds)
         self.TPs = self.TPs + tp.astype(self.TPs.dtype)
         self.FPs = self.FPs + fp.astype(self.FPs.dtype)

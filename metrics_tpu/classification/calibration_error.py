@@ -1,17 +1,29 @@
 """CalibrationError module metric.
 
 Parity: reference ``torchmetrics/classification/calibration_error.py:23``.
-The state is the confidences/accuracies buffer (cat), with the binning done
-at compute — identical semantics to the reference; the binning itself is the
+Default mode keeps the reference's state — the confidences/accuracies buffer
+(cat), with the binning done at compute; the binning itself is the
 vectorized jittable kernel.
+
+``streaming_bins=True`` replaces the unbounded buffer with O(n_bins) state:
+each update streams its samples through the registry-dispatched
+``binned_calibration`` op (``ops/binned_counts.py``) into per-bin
+``(count, conf_sum, acc_sum)`` accumulators, and compute recovers the exact
+same per-bin means the buffered path produces (float sums: parity to f32
+summation-order tolerance).
 """
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.classification.calibration_error import _ce_compute, _ce_update
+from metrics_tpu.functional.classification.calibration_error import (
+    _ce_compute,
+    _ce_compute_from_sums,
+    _ce_update,
+)
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.binned_counts import binned_calibration_counts
 from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
@@ -19,6 +31,15 @@ Array = jax.Array
 
 class CalibrationError(Metric):
     """Top-label calibration error (reference ``classification/calibration_error.py:23``).
+
+    Args:
+        n_bins: number of equal-width confidence bins over (0, 1].
+        norm: ``l1`` (ECE), ``l2`` (RMSCE), or ``max`` (MCE).
+        streaming_bins: accumulate per-bin ``(count, conf_sum, acc_sum)``
+            at update time (O(n_bins) state, ``dist_reduce_fx="sum"``)
+            through the registry-dispatched ``binned_calibration`` kernel
+            instead of buffering every sample until compute. Same binning
+            semantics; float-sum parity to f32 tolerance.
 
     Example:
         >>> import jax.numpy as jnp
@@ -32,7 +53,9 @@ class CalibrationError(Metric):
     higher_is_better = False
     DISTANCES = {"l1", "l2", "max"}
 
-    def __init__(self, n_bins: int = 15, norm: str = "l1", **kwargs: Any) -> None:
+    def __init__(
+        self, n_bins: int = 15, norm: str = "l1", streaming_bins: bool = False, **kwargs: Any
+    ) -> None:
         super().__init__(**kwargs)
         if norm not in self.DISTANCES:
             raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
@@ -40,18 +63,37 @@ class CalibrationError(Metric):
             raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
         self.n_bins = n_bins
         self.norm = norm
+        self.streaming_bins = streaming_bins
         self.bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
 
-        float_dtype = jnp.zeros(()).dtype  # lane-default float placeholder
-        self.add_state("confidences", [], dist_reduce_fx="cat", placeholder=float_dtype)
-        self.add_state("accuracies", [], dist_reduce_fx="cat", placeholder=float_dtype)
+        if streaming_bins:
+            for name in ("bin_count", "bin_conf", "bin_acc"):
+                self.add_state(name, jnp.zeros((n_bins,), dtype=jnp.float32), dist_reduce_fx="sum")
+            self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        else:
+            float_dtype = jnp.zeros(()).dtype  # lane-default float placeholder
+            self.add_state("confidences", [], dist_reduce_fx="cat", placeholder=float_dtype)
+            self.add_state("accuracies", [], dist_reduce_fx="cat", placeholder=float_dtype)
 
     def update(self, preds: Array, target: Array) -> None:
         confidences, accuracies = _ce_update(preds, target)
-        self.confidences.append(confidences)
-        self.accuracies.append(accuracies)
+        if self.streaming_bins:
+            count, conf_sum, acc_sum = binned_calibration_counts(
+                confidences, accuracies, self.bin_boundaries
+            )
+            self.bin_count = self.bin_count + count
+            self.bin_conf = self.bin_conf + conf_sum
+            self.bin_acc = self.bin_acc + acc_sum
+            self.total = self.total + confidences.shape[0]
+        else:
+            self.confidences.append(confidences)
+            self.accuracies.append(accuracies)
 
     def compute(self) -> Array:
+        if self.streaming_bins:
+            return _ce_compute_from_sums(
+                self.bin_count, self.bin_conf, self.bin_acc, self.total, norm=self.norm
+            )
         confidences = dim_zero_cat(self.confidences)
         accuracies = dim_zero_cat(self.accuracies)
         return _ce_compute(confidences, accuracies, self.bin_boundaries, norm=self.norm)

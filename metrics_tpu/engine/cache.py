@@ -56,6 +56,7 @@ import metrics_tpu.engine.warmup  # noqa: F401 — module bound below by path
 _warmup = _sys.modules["metrics_tpu.engine.warmup"]
 from metrics_tpu.obs import bus as _bus
 from metrics_tpu.obs import explain as _explain
+from metrics_tpu.ops import registry as _kernels
 from metrics_tpu.resilience import health as _health
 
 Array = jax.Array
@@ -542,6 +543,12 @@ class SharedEntry:
 
 def _get_or_create(cache_key: Any, factory: Callable[[], "SharedEntry"]) -> "SharedEntry":
     global _use_tick
+    # the kernel-dispatch policy shapes what the factories trace (ops routed
+    # through metrics_tpu.ops.registry), so it is part of every entry's
+    # identity: flipping the policy mid-process compiles fresh programs
+    # instead of silently serving ones traced under the old routing. Warmup
+    # rebuilds go through this same choke point, so manifests stay consistent.
+    cache_key = (cache_key, ("kernel_policy", _kernels.policy()))
     with _LOCK:
         entry = _CACHE.get(cache_key)
         if entry is None:

@@ -67,6 +67,34 @@ def _ce_compute(
     return jnp.where(ce > 0, jnp.sqrt(jnp.where(ce > 0, ce, 1.0)), 0.0)
 
 
+def _ce_compute_from_sums(
+    count_bin: Array,
+    conf_sum: Array,
+    acc_sum: Array,
+    total: Array,
+    norm: str = "l1",
+) -> Array:
+    """The ``_ce_compute`` norms from streamed per-bin sums.
+
+    Per-bin mean confidence/accuracy and bin proportions are exactly
+    recoverable from ``(count, conf_sum, acc_sum, total)`` — the O(bins)
+    state ``CalibrationError(streaming_bins=True)`` accumulates through the
+    registry-dispatched ``binned_calibration`` op instead of buffering every
+    sample to compute time.
+    """
+    if norm not in {"l1", "l2", "max"}:
+        raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+    conf_bin = safe_divide(conf_sum, count_bin)
+    acc_bin = safe_divide(acc_sum, count_bin)
+    prop_bin = count_bin / total
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
+    return jnp.where(ce > 0, jnp.sqrt(jnp.where(ce > 0, ce, 1.0)), 0.0)
+
+
 def _ce_update(preds: Array, target: Array) -> Tuple[Array, Array]:
     """Top-1 confidence + correctness (reference ``calibration_error.py:78``)."""
     _, _, mode = _input_format_classification(preds, target)

@@ -11,7 +11,6 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utils.checks import _input_format_classification
-from metrics_tpu.utils.data import _bincount
 from metrics_tpu.obs.warn import warn_once
 from metrics_tpu.utils.enums import DataType
 
@@ -40,26 +39,19 @@ def _confusion_matrix_update(
     if mode not in (DataType.BINARY, DataType.MULTILABEL):
         preds = jnp.argmax(preds, axis=1)
         target = jnp.argmax(target, axis=1)
+    from metrics_tpu.ops.confusion_counts import confusion_counts, multilabel_counts
+
     if multilabel:
-        # direct per-class reductions instead of a bincount over 4*C bins:
-        # bit-identical integer counts, O(N*C) elementwise work with a batch
-        # reduction — no scatter, so the kernel shards cleanly over BOTH the
-        # batch (dp) and class (mp) axes. The old fused-index bincount forced
-        # the SPMD partitioner into a dense N*C x 4*C one-hot rewrite at
-        # giant-vocab scale (320 GB at C=100k, B=8).
-        dtype = jnp.asarray(0).dtype  # lane default int, matching _bincount
-        p = preds.astype(dtype)
-        t = target.astype(dtype)
-        tp = jnp.sum(p * t, axis=0)
-        fp = jnp.sum(p * (1 - t), axis=0)
-        fn = jnp.sum((1 - p) * t, axis=0)
-        tn = jnp.sum((1 - p) * (1 - t), axis=0)
-        # bin index inside a class is 2*target + preds, so the [C, 2, 2]
-        # layout is [[tn, fp], [fn, tp]] — the reference's reshape order
-        return jnp.stack([tn, fp, fn, tp], axis=-1).reshape(num_classes, 2, 2)
-    unique_mapping = (target.reshape(-1) * num_classes + preds.reshape(-1)).astype(jnp.int32)
-    bins = _bincount(unique_mapping, num_classes**2)
-    return bins.reshape(num_classes, num_classes)
+        # registry-dispatched: the XLA composition keeps the PR-10 direct
+        # per-class reductions (no scatter, shards over batch AND class axes
+        # — the fused-index bincount forced a dense N*C x 4*C one-hot
+        # rewrite under SPMD, 320 GB at C=100k); the Pallas kernel computes
+        # the same counts in one streamed pass. Bit-identical either way.
+        return multilabel_counts(preds, target)
+    # registry-dispatched: XLA composition is the fused-index bincount; the
+    # Pallas kernel keeps the sparse [N] index form in VMEM tiles and
+    # contracts one-hot tiles on the MXU — bit-identical integer counts
+    return confusion_counts(preds, target, num_classes=num_classes)
 
 
 def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:

@@ -47,7 +47,7 @@ def pairwise_cosine_similarity(
         xn = xc / jnp.linalg.norm(xc, axis=1, keepdims=True)
         yn = yc / jnp.linalg.norm(yc, axis=1, keepdims=True)
         fused = pairwise_reduce_rows(xn, yn, "cosine", reduction, zero_diag)
-        if fused is not None:  # opt-in Pallas path (see ops/pairwise_reduce.py)
+        if fused is not None:  # registry-dispatched kernel path (ops/pairwise_reduce.py)
             return fused
     distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

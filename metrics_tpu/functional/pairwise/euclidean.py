@@ -46,7 +46,7 @@ def pairwise_euclidean_distance(
 
         xc, yc, zero_diag = _check_input(x, y, zero_diagonal)
         fused = pairwise_reduce_rows(xc, yc, "euclidean", reduction, zero_diag)
-        if fused is not None:  # opt-in Pallas path (see ops/pairwise_reduce.py)
+        if fused is not None:  # registry-dispatched kernel path (ops/pairwise_reduce.py)
             return fused
     distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

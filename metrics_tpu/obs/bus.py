@@ -97,7 +97,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: ``flush`` event additionally carries ``ms`` (dispatch wall time) on
 #: success or ``error`` (exception class name) on failure — the signals
 #: the guard scores.
-#: Misc: ``warning`` (a ``warn_once`` emission).
+#: Misc: ``warning`` (a ``warn_once`` emission); ``kernel`` (one kernel-tier
+#: registry dispatch — ``op``, ``path`` taken (``pallas``/``xla``/
+#: ``interpret``), ``reason``, and the ``policy`` in effect; see
+#: ``ops/registry.py`` and ``docs/kernels.md``).
 EVENT_KINDS = (
     "compile",
     "cache_hit",
@@ -131,6 +134,7 @@ EVENT_KINDS = (
     "warmup",
     "warmup_stale",
     "warning",
+    "kernel",
 )
 
 _DEFAULT_CAPACITY = 4096

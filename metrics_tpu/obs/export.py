@@ -65,6 +65,12 @@ def _guard_stats() -> Dict[str, Any]:
     return guard_stats()
 
 
+def _kernel_stats() -> Dict[str, Any]:
+    from metrics_tpu.ops.registry import kernel_stats
+
+    return kernel_stats()
+
+
 def process_snapshot() -> Dict[str, Any]:
     """The process-wide observability view (no metric argument needed)."""
     from metrics_tpu import engine as _engine
@@ -101,6 +107,9 @@ def process_snapshot() -> Dict[str, Any]:
         # resilience/overload.py): per-worker health states, hedge
         # counters, exactly-once dedup proof, sheds by reason, brownout
         "guard": _guard_stats(),
+        # kernel tier (ops/registry.py): dispatch policy, per-op path
+        # counts (pallas / xla / interpret), loud-fallback tallies by reason
+        "kernels": _kernel_stats(),
         "bus": _bus.summary(),
         "spans": _trace.span_summary(),
         "warnings": {repr(k): v for k, v in _warn.warn_counts().items()},
@@ -397,6 +406,22 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
     rec = warm["recording"]
     _sample("metrics_tpu_warmup_recording", 1 if rec["active"] else 0, kind="gauge")
     _sample("metrics_tpu_warmup_recorded_programs", rec["programs"], kind="gauge")
+
+    # kernel tier: which path each op's dispatches took, and why fallbacks
+    kern = _kernel_stats()
+    _sample("metrics_tpu_kernel_policy_info", 1, {"policy": kern["policy"]}, kind="gauge")
+    _sample("metrics_tpu_kernel_registered_ops", len(kern["registered"]), kind="gauge")
+    for op_name in sorted(kern["by_op"]):
+        rec_op = kern["by_op"][op_name]
+        for path in ("pallas", "xla", "interpret"):
+            _sample("metrics_tpu_kernel_dispatches", rec_op[path], {"op": op_name, "path": path})
+        for reason in sorted(rec_op["reasons"]):
+            _sample(
+                "metrics_tpu_kernel_dispatch_reasons",
+                rec_op["reasons"][reason],
+                {"op": op_name, "reason": reason},
+            )
+        _sample("metrics_tpu_kernel_fallbacks", rec_op["fallbacks"], {"op": op_name})
 
     bus_summary = _bus.summary()
     for kind in sorted(bus_summary["by_kind"]):

@@ -1,6 +1,53 @@
-"""Pallas TPU kernels for hot metric ops (XLA fallbacks included), plus the
-shared branchless numerical guard primitives (``safe_ops``)."""
-from metrics_tpu.ops.binned_counts import binned_stat_counts  # noqa: F401
-from metrics_tpu.ops.safe_ops import kahan_add, safe_divide, saturating_add  # noqa: F401
+"""The kernel tier: registry-dispatched Pallas TPU kernels for hot metric
+ops (XLA composition fallbacks included), plus the shared branchless
+numerical guard primitives (``safe_ops``).
 
-__all__ = ["binned_stat_counts", "kahan_add", "safe_divide", "saturating_add"]
+``kernel_policy`` / ``METRICS_TPU_KERNELS`` pick the path per-process;
+every dispatch is observable through ``kernel_stats()`` and the obs bus.
+See ``docs/kernels.md`` for the registry model and per-op guarantees.
+"""
+from metrics_tpu.ops.registry import (  # noqa: F401
+    POLICIES,
+    POLICY_ENV,
+    KernelOp,
+    dispatch,
+    get_op,
+    kernel_policy,
+    kernel_stats,
+    policy,
+    register,
+    registered_ops,
+    reset_kernel_stats,
+)
+from metrics_tpu.ops.binned_counts import (  # noqa: F401
+    binned_calibration_counts,
+    binned_stat_counts,
+)
+from metrics_tpu.ops.confusion_counts import confusion_counts, multilabel_counts  # noqa: F401
+from metrics_tpu.ops.pairwise_reduce import pairwise_reduce_rows  # noqa: F401
+from metrics_tpu.ops.safe_ops import kahan_add, safe_divide, saturating_add  # noqa: F401
+from metrics_tpu.ops.select_topk import select_topk_mask, topk_mask  # noqa: F401
+
+__all__ = [
+    "POLICIES",
+    "POLICY_ENV",
+    "KernelOp",
+    "binned_calibration_counts",
+    "binned_stat_counts",
+    "confusion_counts",
+    "dispatch",
+    "get_op",
+    "kahan_add",
+    "kernel_policy",
+    "kernel_stats",
+    "multilabel_counts",
+    "pairwise_reduce_rows",
+    "policy",
+    "register",
+    "registered_ops",
+    "reset_kernel_stats",
+    "safe_divide",
+    "saturating_add",
+    "select_topk_mask",
+    "topk_mask",
+]

@@ -1,0 +1,36 @@
+"""Shared jax-version compatibility probes for the kernel tier.
+
+One home for the Tracer-detection probe every registry entry needs (it was
+about to be copy-pasted from ``ops/binned_counts.py`` into three more
+modules). ``jax.core.Tracer`` is a deprecated access path on current jax
+(moved toward ``jax.extend.core``); probe the new home first so no
+deprecation warning fires, and fall back through the older spellings.
+"""
+from typing import Any
+
+import jax
+
+
+def tracer_type() -> type:
+    """The Tracer base class, resolved once from its stable home."""
+    try:
+        from jax.extend import core as _xcore
+
+        if hasattr(_xcore, "Tracer"):
+            return _xcore.Tracer
+    except ImportError:
+        pass
+    try:
+        return jax._src.core.Tracer
+    except AttributeError:  # pragma: no cover - last resort on exotic builds
+        return jax.core.Tracer
+
+
+#: Resolved once at import — ``isinstance(x, TRACER)`` is the stable spelling
+#: of "is this an abstract value inside jit/vmap/scan".
+TRACER = tracer_type()
+
+
+def is_tracer(x: Any) -> bool:
+    """True when ``x`` is an abstract tracer (we are under jit/vmap/scan)."""
+    return isinstance(x, TRACER)

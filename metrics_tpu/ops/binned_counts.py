@@ -1,24 +1,32 @@
-"""Pallas TPU kernel: streaming binned threshold counters.
+"""Pallas TPU kernels: one-pass streaming binned counters.
 
-The hot op behind the binned curve family (``BinnedPrecisionRecallCurve`` and
-descendants, reference ``classification/binned_precision_recall.py:148-175``):
+Two ops behind the binned/bounded update paths:
 
-    TP[c, t] = sum_n  (preds[n, c] >= th[t]) &  target[n, c]
-    FP[c, t] = sum_n  (preds[n, c] >= th[t]) & ~target[n, c]
-    FN[c, t] = sum_n ~(preds[n, c] >= th[t]) &  target[n, c]
-    TN[c, t] = sum_n ~(preds[n, c] >= th[t]) & ~target[n, c]
+* ``binned_counts`` — threshold counters for the binned curve family
+  (``BinnedPrecisionRecallCurve`` and descendants, and the streaming
+  ``AUROC(thresholds=...)`` mode):
 
-The Pallas kernel streams ``N`` in VMEM-resident tiles and keeps the four
-``[C, T]`` accumulators on-chip across the whole grid, so the ``[N, C, T]``
-intermediate never exists outside VMEM.
+      TP[c, t] = sum_n  (preds[n, c] >= th[t]) &  target[n, c]
+      FP[c, t] = sum_n  (preds[n, c] >= th[t]) & ~target[n, c]
+      FN[c, t] = sum_n ~(preds[n, c] >= th[t]) &  target[n, c]
+      TN[c, t] = sum_n ~(preds[n, c] >= th[t]) & ~target[n, c]
 
-**Measured verdict (v5e, N=8192, C=10, T=100, dispatch amortized inside one
-jitted scan): XLA 180 us/update vs Pallas 200 us/update.** XLA's fusion
-already keeps this op on-chip — consistent with the survey's guidance that
-Pallas only pays where a kernel can't be expressed efficiently in XLA ops —
-so :func:`binned_stat_counts` defaults to the XLA formulation and the kernel
-stays available via ``use_pallas=True`` (bit-identical results, exercised in
-tests) as the template for future ops that do beat the fusion.
+  The kernel streams ``N`` in VMEM-resident tiles and keeps the four
+  ``[C, T]`` accumulators on-chip across the whole grid, so the
+  ``[N, C, T]`` intermediate never exists outside VMEM. Integer counts:
+  bit-exact vs the XLA composition.
+* ``binned_calibration`` — per-bin ``(count, conf_sum, acc_sum)`` over
+  ``(lo, hi]`` confidence bins in one streamed pass, the constant-memory
+  update behind ``CalibrationError(streaming_bins=True)``. Float sums:
+  parity vs the segment-sum composition is within documented tolerance
+  (summation order differs across tiles).
+
+Both route through :mod:`metrics_tpu.ops.registry` — ``kernel_policy``
+picks the path, every dispatch is observable, and the CPU CI lane executes
+the kernel bodies under ``pallas_call(..., interpret=True)``. Measured
+per-op verdicts live in the ``bench.py --kernel-smoke`` lane output (see
+``docs/kernels.md``); ``auto`` keeps the XLA formulation by default here
+because XLA's fusion already keeps these ops on-chip.
 """
 import functools
 
@@ -26,34 +34,18 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from metrics_tpu.obs.warn import warn_once
+from metrics_tpu.ops import registry as _registry
+from metrics_tpu.ops._compat import TRACER
 
 Array = jax.Array
 
-
-def _tracer_type() -> type:
-    """The Tracer base class, resolved once from its stable home.
-
-    ``jax.core.Tracer`` is a deprecated access path on current jax (moved
-    toward ``jax.extend.core``); probe the new home first so no deprecation
-    warning fires, and fall back through the older spellings."""
-    try:
-        from jax.extend import core as _xcore
-
-        if hasattr(_xcore, "Tracer"):
-            return _xcore.Tracer
-    except ImportError:
-        pass
-    try:
-        return jax._src.core.Tracer
-    except AttributeError:  # pragma: no cover - last resort on exotic builds
-        return jax.core.Tracer
-
-
-_TRACER = _tracer_type()
+# Back-compat re-export: the tracer probe now lives in ops/_compat.py and is
+# shared by every registry entry.
+_TRACER = TRACER
 
 # [BN, T] f32 intermediates must fit VMEM (~16 MB) several times over
 _BLOCK_N = 1024
+_MAX_CT = 512 * 1024  # the four [C, T] int32 accumulators stay VMEM-resident
 
 
 def _binned_counts_kernel(preds_ref, target_ref, valid_ref, ths_ref, tp_ref, fp_ref, fn_ref, tn_ref):
@@ -86,8 +78,10 @@ def _binned_counts_kernel(preds_ref, target_ref, valid_ref, ths_ref, tp_ref, fp_
         tn_ref[c : c + 1, :] += jnp.sum(jnp.where(above, 0.0, neg_c), axis=0, keepdims=True).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("block_n",))
-def _binned_counts_pallas(preds: Array, target: Array, thresholds: Array, block_n: int = _BLOCK_N):
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def _binned_counts_pallas(
+    preds: Array, target: Array, thresholds: Array, block_n: int = _BLOCK_N, interpret: bool = False
+):
     n, c = preds.shape
     t = thresholds.shape[0]
     n_pad = ((n + block_n - 1) // block_n) * block_n
@@ -109,6 +103,7 @@ def _binned_counts_pallas(preds: Array, target: Array, thresholds: Array, block_
         ],
         out_specs=[acc_spec] * 4,
         out_shape=out_shape,
+        interpret=interpret,
     )(preds_p, target_p, valid, thresholds.astype(jnp.float32)[None, :])
 
 
@@ -123,32 +118,153 @@ def _binned_counts_xla(preds: Array, target: Array, thresholds: Array):
     return tp, fp, fn, tn
 
 
+def _binned_counts_eligible(preds: Array, target: Array, thresholds: Array):
+    if getattr(preds, "ndim", None) != 2 or getattr(target, "ndim", None) != 2:
+        return False, "shape"
+    if getattr(thresholds, "ndim", None) != 1:
+        return False, "shape"
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        return False, "dtype"
+    if preds.shape[1] * thresholds.shape[0] > _MAX_CT:
+        return False, "shape"
+    return True, "ok"
+
+
 def binned_stat_counts(preds: Array, target: Array, thresholds: Array, use_pallas: bool = False):
     """``(TP, FP, FN, TN)`` of shape ``[C, T]`` for ``preds/target [N, C]``
-    against ``thresholds [T]``.
+    against ``thresholds [T]``, dispatched through the kernel registry.
 
-    ``use_pallas=True`` routes through the TPU kernel only for CONCRETE
-    inputs on a TPU backend: under an outer ``jit`` (tracer inputs) the
-    kernel's own inner ``jax.jit`` cannot be entered, and off-TPU the Mosaic
-    kernel cannot lower — both fall back to the XLA formulation
-    (bit-identical results). The fallback warns once per cause so callers
-    know which path actually ran.
+    The process-wide ``kernel_policy`` (``'auto'`` keeps the XLA
+    formulation — XLA's fusion already streams this op on-chip) picks the
+    path; ``use_pallas=True`` is the legacy per-call force, equivalent to
+    dispatching under ``kernel_policy('pallas')``: off-TPU or under an outer
+    jit the XLA fallback runs LOUDLY (``warn_once`` + a ``kernel`` bus event
+    naming the cause), with bit-identical results.
     """
     if use_pallas:
-        if jax.default_backend() != "tpu":
-            warn_once(
-                "binned_stat_counts(use_pallas=True) ran the XLA fallback:"
-                f" backend is {jax.default_backend()!r}, the Pallas kernel is"
-                " TPU-only.",
-                key=("binned_counts_pallas_fallback", "backend"),
-            )
-        elif isinstance(preds, _TRACER):
-            warn_once(
-                "binned_stat_counts(use_pallas=True) ran the XLA fallback:"
-                " inputs are tracers (called under jit/vmap/scan). Call it"
-                " outside the surrounding jit to use the Pallas kernel.",
-                key=("binned_counts_pallas_fallback", "tracer"),
-            )
-        else:
-            return _binned_counts_pallas(preds, target, thresholds)
-    return _binned_counts_xla(preds, target, thresholds)
+        with _registry.kernel_policy("pallas"):
+            return _registry.dispatch("binned_counts", preds, target, thresholds)
+    return _registry.dispatch("binned_counts", preds, target, thresholds)
+
+
+_registry.register(
+    _registry.KernelOp(
+        name="binned_counts",
+        pallas=_binned_counts_pallas,
+        xla=_binned_counts_xla,
+        eligible=_binned_counts_eligible,
+        # the wrapper's inner jit + concrete-input contract predates the
+        # registry; native dispatch stays gated to concrete inputs
+        tracer_ok=False,
+        default_on=False,
+        integer_exact=True,
+    )
+)
+
+
+# ---------------------------------------------------------------------------
+# binned_calibration: per-(lo, hi] bin count / conf_sum / acc_sum, one pass
+# ---------------------------------------------------------------------------
+_CAL_BLOCK_N = 1024
+_CAL_MAX_BINS = 4096
+
+
+def _binned_calibration_kernel(conf_ref, acc_ref, valid_ref, lo_ref, hi_ref, out_ref):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    conf = conf_ref[...]  # [BN, 1] f32
+    acc = acc_ref[...]  # [BN, 1] f32
+    valid = valid_ref[...].astype(jnp.float32)  # [BN, 1]
+    lo = lo_ref[...]  # [1, B]
+    hi = hi_ref[...]  # [1, B]
+    member = ((conf > lo) & (conf <= hi)).astype(jnp.float32) * valid  # [BN, B]
+    out_ref[0:1, :] += jnp.sum(member, axis=0, keepdims=True)
+    out_ref[1:2, :] += jnp.sum(member * conf, axis=0, keepdims=True)
+    out_ref[2:3, :] += jnp.sum(member * acc, axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _binned_calibration_pallas(
+    confidences: Array, accuracies: Array, bin_boundaries: Array, interpret: bool = False
+):
+    n = confidences.shape[0]
+    n_pad = ((n + _CAL_BLOCK_N - 1) // _CAL_BLOCK_N) * _CAL_BLOCK_N
+    valid = (jnp.arange(n_pad) < n).astype(jnp.int32)[:, None]
+    conf = jnp.pad(confidences.astype(jnp.float32).reshape(-1, 1), ((0, n_pad - n), (0, 0)))
+    acc = jnp.pad(accuracies.astype(jnp.float32).reshape(-1, 1), ((0, n_pad - n), (0, 0)))
+    bounds = bin_boundaries.astype(jnp.float32)
+    lo = bounds[:-1][None, :]
+    hi = bounds[1:][None, :]
+    b = lo.shape[1]
+    grid = (n_pad // _CAL_BLOCK_N,)
+    out = pl.pallas_call(
+        _binned_calibration_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_CAL_BLOCK_N, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_CAL_BLOCK_N, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_CAL_BLOCK_N, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, b), lambda i: (0, 0)),
+            pl.BlockSpec((1, b), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((3, b), lambda i: (0, 0)),  # resident across grid
+        out_shape=jax.ShapeDtypeStruct((3, b), jnp.float32),
+        interpret=interpret,
+    )(conf, acc, valid, lo, hi)
+    return out[0], out[1], out[2]
+
+
+def _binned_calibration_xla(confidences: Array, accuracies: Array, bin_boundaries: Array):
+    """Segment-sum composition — the same ``(b[i], b[i+1]]`` binning as
+    ``functional/classification/calibration_error._binning_bucketize``."""
+    n_bins = bin_boundaries.shape[0] - 1
+    conf = confidences.astype(jnp.float32)
+    acc = accuracies.astype(jnp.float32)
+    idx = jnp.searchsorted(bin_boundaries.astype(jnp.float32), conf, side="left") - 1
+    valid = idx >= 0
+    idx = jnp.clip(idx, 0, n_bins - 1)
+    ones = jnp.where(valid, 1.0, 0.0)
+    count = jax.ops.segment_sum(ones, idx, num_segments=n_bins)
+    conf_sum = jax.ops.segment_sum(jnp.where(valid, conf, 0.0), idx, num_segments=n_bins)
+    acc_sum = jax.ops.segment_sum(jnp.where(valid, acc, 0.0), idx, num_segments=n_bins)
+    return count, conf_sum, acc_sum
+
+
+def _binned_calibration_eligible(confidences: Array, accuracies: Array, bin_boundaries: Array):
+    if getattr(confidences, "ndim", None) != 1 or getattr(accuracies, "ndim", None) != 1:
+        return False, "shape"
+    if getattr(bin_boundaries, "ndim", None) != 1 or bin_boundaries.shape[0] < 2:
+        return False, "shape"
+    if bin_boundaries.shape[0] - 1 > _CAL_MAX_BINS:
+        return False, "shape"
+    if not jnp.issubdtype(confidences.dtype, jnp.floating):
+        return False, "dtype"
+    return True, "ok"
+
+
+def binned_calibration_counts(confidences: Array, accuracies: Array, bin_boundaries: Array):
+    """Per-bin ``(count, conf_sum, acc_sum)`` over ``(lo, hi]`` confidence
+    bins, dispatched through the kernel registry. Bins follow the
+    ``_binning_bucketize`` convention: ``conf <= bin_boundaries[0]`` falls
+    in no bin. Float sums — the Pallas path agrees with the segment-sum
+    composition to f32 summation-order tolerance (documented: 1e-5 rel)."""
+    return _registry.dispatch("binned_calibration", confidences, accuracies, bin_boundaries)
+
+
+_registry.register(
+    _registry.KernelOp(
+        name="binned_calibration",
+        pallas=_binned_calibration_pallas,
+        xla=_binned_calibration_xla,
+        eligible=_binned_calibration_eligible,
+        # a pure pallas_call body: safe under an outer trace (the streaming
+        # CalibrationError update is engine-jitted)
+        tracer_ok=True,
+        default_on=False,
+        integer_exact=False,
+    )
+)

@@ -10,19 +10,15 @@ epilogue (clip, sqrt, padding/diagonal masks) on-chip, and accumulates
 per-row sums across the column-tile grid — the ``[N, M]`` matrix never
 exists.
 
-**Measured verdict (v5e, N=M=8192, d=256, chained-scan timing with a host
-fetch per repetition — ``python -m metrics_tpu.ops.pairwise_reduce``):
-XLA 0.239 ms/step vs Pallas 0.268 ms/step — XLA WINS.** The hypothesis
-(XLA materializes [N, M] through HBM before reducing) is false on TPU: XLA
-output-fuses the sqrt+mask+reduce epilogue into the dot, so the matrix never
-hits HBM there either, and its MXU schedule is better than this kernel's.
-Like ``ops/binned_counts.py``, the kernel therefore stays OFF by default —
-``METRICS_TPU_FORCE_PALLAS_PAIRWISE=1`` opts in through
-``pairwise_{euclidean_distance,cosine_similarity}(reduction="sum"|"mean")``
-(results agree with the XLA path to ~2e-2 relative: the kernel uses a
-one-pass bf16 dot; covered by tests) — and the honest loss is recorded here.
-The winning kernel this template produced is ``ops/select_topk.py``, where
-XLA's sort-based lowering genuinely loses.
+Registered as the ``pairwise_reduce`` op in :mod:`metrics_tpu.ops.registry`
+with ``default_on=False``: XLA output-fuses the sqrt+mask+reduce epilogue
+into the dot on TPU, so the matrix never hits HBM on that path either and
+its MXU schedule wins — ``auto`` keeps the composition. The kernel stays
+reachable through ``kernel_policy('pallas')`` or the legacy
+``METRICS_TPU_FORCE_PALLAS_PAIRWISE=1`` env (results agree with the XLA
+path to ~2e-2 relative: the kernel uses a one-pass bf16 dot; covered by
+tests). Measured verdicts live in the ``bench.py --kernel-smoke`` lane
+output (see ``docs/kernels.md``), so the receipt can't drift from the code.
 """
 import functools
 from typing import Optional
@@ -30,6 +26,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from metrics_tpu.ops import registry as _registry
 
 Array = jax.Array
 
@@ -104,15 +102,28 @@ def _fused_row_sums(x: Array, y: Array, op: str, zero_diagonal: bool, interpret:
 
 
 def fused_supported(x: Array, y: Array, force: bool = False) -> bool:
-    """Dispatch gate: TPU backend, supported dtype/size, big enough to win."""
-    if x.ndim != 2 or y.ndim != 2:
-        return False
-    if x.dtype not in (jnp.float32, jnp.bfloat16) or y.dtype not in (jnp.float32, jnp.bfloat16):
-        return False
-    if x.shape[1] > _MAX_D:
-        return False
+    """Legacy dispatch gate (kept for back-compat; the registry's eligibility
+    predicate + policy resolution supersede it)."""
+    ok, _ = _pairwise_eligible(x, y)
     # measured loss vs XLA's fused dot (module docstring): opt-in only
-    return force
+    return ok and force
+
+
+def _pairwise_xla(x: Array, y: Array, op: str = "euclidean", zero_diagonal: bool = False):
+    """Sentinel composition: the functional callers own the XLA formulation
+    (dot + fused epilogue), so the registry fallback hands control back by
+    returning ``None``."""
+    return None
+
+
+def _pairwise_eligible(x: Array, y: Array, op: str = "euclidean", zero_diagonal: bool = False):
+    if getattr(x, "ndim", None) != 2 or getattr(y, "ndim", None) != 2:
+        return False, "shape"
+    if x.shape[1] != y.shape[1] or x.shape[1] > _MAX_D:
+        return False, "shape"
+    if x.dtype not in (jnp.float32, jnp.bfloat16) or y.dtype not in (jnp.float32, jnp.bfloat16):
+        return False, "dtype"
+    return True, "ok"
 
 
 def pairwise_reduce_rows(
@@ -125,21 +136,35 @@ def pairwise_reduce_rows(
     """Row-reduced pairwise op without materializing ``[N, M]``.
 
     ``op``: ``"euclidean"`` (distances; norms fused in-kernel) or ``"cosine"``
-    (callers pass pre-normalized rows). Returns ``None`` when the fused path
-    doesn't apply — callers fall back to the XLA formulation.
+    (callers pass pre-normalized rows). Returns ``None`` when the registry
+    routes to the XLA path — callers fall back to their own composition
+    (``default_on=False``: the kernel runs only under ``kernel_policy``
+    ``'pallas'``/``'interpret'`` or ``METRICS_TPU_FORCE_PALLAS_PAIRWISE=1``).
     """
-    import os
-
-    force = os.environ.get("METRICS_TPU_FORCE_PALLAS_PAIRWISE") == "1"
-    if reduction not in ("sum", "mean") or not fused_supported(x, y, force=force):
+    if reduction not in ("sum", "mean"):
         return None
-    # off-TPU the mosaic kernel can't run natively: interpret mode keeps the
-    # forced path functional (slow, correctness-only) everywhere
-    sums = _fused_row_sums(x, y, op, zero_diagonal, interpret=jax.default_backend() != "tpu")
+    sums = _registry.dispatch("pairwise_reduce", x, y, op=op, zero_diagonal=zero_diagonal)
+    if sums is None:
+        return None
     if reduction == "mean":
         # jnp.mean over the last axis divides by M (zeroed diagonal included)
         return sums / y.shape[0]
     return sums
+
+
+_registry.register(
+    _registry.KernelOp(
+        name="pairwise_reduce",
+        pallas=_fused_row_sums,
+        xla=_pairwise_xla,
+        eligible=_pairwise_eligible,
+        # a pure pallas_call body: safe under an outer trace
+        tracer_ok=True,
+        default_on=False,
+        integer_exact=False,
+        force_env="METRICS_TPU_FORCE_PALLAS_PAIRWISE",
+    )
+)
 
 
 def _bench() -> None:  # pragma: no cover - manual measurement entrypoint

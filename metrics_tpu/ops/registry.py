@@ -1,0 +1,265 @@
+"""Registry-dispatched kernel tier: one policy, per-op Pallas/XLA routing.
+
+Every hot-reduction op registers a :class:`KernelOp` carrying its Pallas
+kernel, the XLA composition it must stay parity-equal with, and a structural
+eligibility predicate (dtype / shape tiling). Consumers call
+:func:`dispatch` instead of hand-rolling ``use_pallas``-style branches, and
+the process-wide policy decides which body runs:
+
+* ``auto`` (default) — the Pallas kernel on TPU where it measurably wins
+  (``default_on`` ops), the XLA composition everywhere else.
+* ``pallas`` — force the native kernel; an ineligible dispatch is a LOUD
+  fallback (``warn_once`` + a ``kernel`` bus event naming the reason),
+  never a silent one.
+* ``xla`` — always the XLA composition (bisection / baseline mode).
+* ``interpret`` — run the kernel body under
+  ``pallas_call(..., interpret=True)`` on any backend: the CPU CI lane's
+  way of executing every kernel for parity instead of skipping it.
+
+Set the policy with :func:`kernel_policy` (sticky call or context manager)
+or the ``METRICS_TPU_KERNELS`` env var. Every dispatch emits a ``kernel``
+obs-bus event (op, path taken, reason) when the bus is enabled and always
+bumps the pull-side counters behind :func:`kernel_stats`,
+``obs.snapshot()["kernels"]``, and the ``metrics_tpu_kernel_*`` Prometheus
+gauges — which path ran is observable, never silent.
+
+The policy is part of the engine's shared-compile-cache key
+(``engine/cache.py``): changing it mid-process compiles new programs
+instead of silently serving ones traced under the old routing.
+
+Measured per-op verdicts live in the ``bench.py --kernel-smoke`` lane
+output (see ``docs/kernels.md``), not in module docstrings, so docs and
+measurements cannot drift.
+"""
+import os
+import threading
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.obs.warn import warn_once
+from metrics_tpu.ops._compat import is_tracer
+
+POLICIES = ("auto", "pallas", "xla", "interpret")
+
+#: Environment default for the process policy (overridden by
+#: :func:`kernel_policy`). Read dynamically so tests and operators can flip
+#: it without re-importing.
+POLICY_ENV = "METRICS_TPU_KERNELS"
+
+
+class KernelOp(NamedTuple):
+    """One registry entry: the kernel, its fallback, and its dispatch gate."""
+
+    name: str
+    #: The Pallas path. Must accept ``interpret=`` so the ``interpret``
+    #: policy can execute the kernel body on any backend.
+    pallas: Callable[..., Any]
+    #: The XLA composition the kernel is parity-tested against.
+    xla: Callable[..., Any]
+    #: Structural eligibility (dtype / shape tiling) -> ``(ok, reason)``.
+    #: Backend and tracer checks are the resolver's job, not this one's.
+    eligible: Callable[..., Tuple[bool, str]]
+    #: Whether the NATIVE kernel is safe under an outer trace (pure
+    #: ``pallas_call`` bodies are; ops whose wrappers make runtime decisions
+    #: or whose SPMD story needs the XLA form opt out).
+    tracer_ok: bool
+    #: Whether ``auto`` prefers the kernel on TPU. Ops where the measured
+    #: verdict favors XLA's fusion register ``False`` and stay reachable
+    #: through ``kernel_policy('pallas')`` / their legacy force env.
+    default_on: bool
+    #: Integer-count op: parity vs the XLA composition is bit-exact (the CI
+    #: gate); float ops document a tolerance instead.
+    integer_exact: bool
+    #: Legacy per-op opt-in env var (e.g. ``METRICS_TPU_FORCE_PALLAS_PAIRWISE``).
+    force_env: Optional[str] = None
+
+
+_REGISTRY: Dict[str, KernelOp] = {}
+_LOCK = threading.RLock()
+_POLICY_OVERRIDE: Optional[str] = None
+
+# pull-side counters (process-wide, recorded even when the bus is disabled —
+# the same contract every other *_stats() surface keeps)
+_STATS: Dict[str, Dict[str, Any]] = {}
+
+
+def register(op: KernelOp) -> KernelOp:
+    """Register (or replace) one kernel-op entry; returns it."""
+    with _LOCK:
+        _REGISTRY[op.name] = op
+    return op
+
+
+def registered_ops() -> Tuple[str, ...]:
+    with _LOCK:
+        return tuple(sorted(_REGISTRY))
+
+
+def get_op(name: str) -> KernelOp:
+    with _LOCK:
+        try:
+            return _REGISTRY[name]
+        except KeyError:
+            raise KeyError(
+                f"Unknown kernel op {name!r}; registered: {sorted(_REGISTRY)}"
+            ) from None
+
+
+def policy() -> str:
+    """The policy in effect: the :func:`kernel_policy` override if set, else
+    ``METRICS_TPU_KERNELS``, else ``auto``. An invalid env value warns once
+    and falls back to ``auto`` (never a crash on a typo'd deploy env)."""
+    if _POLICY_OVERRIDE is not None:
+        return _POLICY_OVERRIDE
+    env = os.environ.get(POLICY_ENV)
+    if env is None:
+        return "auto"
+    if env not in POLICIES:
+        warn_once(
+            f"{POLICY_ENV}={env!r} is not one of {POLICIES}; using 'auto'.",
+            key=("kernel_policy_env", env),
+        )
+        return "auto"
+    return env
+
+
+class kernel_policy:
+    """Set the process-wide kernel dispatch policy.
+
+    Usable as a sticky call — ``kernel_policy('pallas')`` — or a context
+    manager that restores the previous override on exit::
+
+        with kernel_policy('interpret'):
+            ...  # every dispatch executes the Pallas body, any backend
+    """
+
+    def __init__(self, value: str) -> None:
+        if value not in POLICIES:
+            raise ValueError(f"kernel_policy must be one of {POLICIES}, got {value!r}")
+        global _POLICY_OVERRIDE
+        self._prev = _POLICY_OVERRIDE
+        _POLICY_OVERRIDE = value
+
+    def __enter__(self) -> "kernel_policy":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        global _POLICY_OVERRIDE
+        _POLICY_OVERRIDE = self._prev
+
+
+def _resolve(op: KernelOp, pol: str, args: Tuple, kwargs: Dict) -> Tuple[str, str]:
+    """(path, reason) for one dispatch. Paths: ``pallas`` (native kernel),
+    ``interpret`` (kernel body via ``interpret=True``), ``xla``."""
+    ok, why = op.eligible(*args, **kwargs)
+    if pol == "xla":
+        return "xla", "policy_xla"
+    if pol == "interpret":
+        # interpret mode is trace-safe and backend-agnostic: only the
+        # structural gate (dtype / shape tiling) can keep the body from running
+        if not ok:
+            return "xla", why
+        return "interpret", "policy_interpret"
+    forced_env = bool(op.force_env) and os.environ.get(op.force_env) == "1"
+    forced = pol == "pallas" or forced_env
+    if not forced and not op.default_on:
+        # measured verdict: XLA's fusion wins this op — auto stays on the
+        # composition (the --kernel-smoke lane keeps the receipt current)
+        return "xla", "measured_default"
+    if not ok:
+        return "xla", why
+    traced = any(is_tracer(a) for a in args) or any(is_tracer(v) for v in kwargs.values())
+    if traced and not op.tracer_ok:
+        return "xla", "tracer"
+    if jax.default_backend() != "tpu":
+        if forced_env and pol == "auto":
+            # the legacy force envs promised a functional (interpret) path
+            # off-TPU; keep that contract under auto
+            return "interpret", "forced_env_interpret"
+        return "xla", "backend"
+    return "pallas", "policy_pallas" if pol == "pallas" else ("forced_env" if forced_env else "auto")
+
+
+_FALLBACK_DETAIL = {
+    "backend": "backend is {backend!r}, the native Mosaic kernel is TPU-only"
+    " (kernel_policy('interpret') executes the kernel body anywhere)",
+    "tracer": "inputs are tracers (called under jit/vmap/scan) and this op's"
+    " native kernel is gated to concrete dispatches",
+}
+
+
+def _record(op: KernelOp, pol: str, path: str, reason: str) -> None:
+    loud = path == "xla" and pol in ("pallas", "interpret")
+    with _LOCK:
+        rec = _STATS.setdefault(
+            op.name,
+            {"pallas": 0, "xla": 0, "interpret": 0, "fallbacks": 0, "reasons": {}},
+        )
+        rec[path] += 1
+        rec["reasons"][reason] = rec["reasons"].get(reason, 0) + 1
+        if loud:
+            rec["fallbacks"] += 1
+    if loud:
+        detail = _FALLBACK_DETAIL.get(reason, f"ineligible: {reason}")
+        warn_once(
+            f"kernel {op.name!r} (policy {pol!r}) ran the XLA fallback: "
+            + detail.format(backend=jax.default_backend())
+            + ".",
+            key=("kernel_fallback", op.name, reason),
+        )
+    if _bus.enabled():
+        _bus.emit(
+            "kernel", source=op.name, op=op.name, path=path, reason=reason, policy=pol
+        )
+
+
+def dispatch(name: str, *args: Any, **kwargs: Any) -> Any:
+    """Route one op call through the registry under the current policy.
+
+    Returns whatever the chosen body returns. The ``pallas`` path calls the
+    kernel natively, ``interpret`` passes ``interpret=True`` through, and
+    ``xla`` runs the registered composition. Every call is recorded
+    (:func:`kernel_stats`) and — bus enabled — emits a ``kernel`` event.
+    """
+    op = get_op(name)
+    pol = policy()
+    path, reason = _resolve(op, pol, args, kwargs)
+    _record(op, pol, path, reason)
+    if path == "pallas":
+        return op.pallas(*args, **kwargs)
+    if path == "interpret":
+        return op.pallas(*args, interpret=True, **kwargs)
+    return op.xla(*args, **kwargs)
+
+
+def kernel_stats() -> Dict[str, Any]:
+    """Process-wide dispatch counters: per-op path counts, fallback counts,
+    and per-reason tallies — the section ``obs.snapshot()["kernels"]``
+    embeds and the ``metrics_tpu_kernel_*`` Prometheus families render."""
+    with _LOCK:
+        by_op = {
+            name: {
+                "pallas": rec["pallas"],
+                "xla": rec["xla"],
+                "interpret": rec["interpret"],
+                "fallbacks": rec["fallbacks"],
+                "reasons": dict(rec["reasons"]),
+            }
+            for name, rec in sorted(_STATS.items())
+        }
+    totals = {k: sum(rec[k] for rec in by_op.values()) for k in ("pallas", "xla", "interpret", "fallbacks")}
+    return {
+        "policy": policy(),
+        "registered": list(registered_ops()),
+        "dispatches": totals["pallas"] + totals["xla"] + totals["interpret"],
+        **totals,
+        "by_op": by_op,
+    }
+
+
+def reset_kernel_stats() -> None:
+    """Zero the dispatch counters (tests / bench lanes)."""
+    with _LOCK:
+        _STATS.clear()

@@ -5,19 +5,19 @@ The hot op behind every ``top_k`` classification metric
 ``[N, C]`` scores into a 0/1 mask marking each row's k largest entries.
 
 XLA lowers ``lax.top_k`` to a row sort (O(C log^2 C) bitonic passes) followed
-by a scatter — measured 0.64 ms for N=8192, C=1000, k=5 on v5e. But the mask
-doesn't need sorted values: k max-and-suppress sweeps over a VMEM-resident
-tile find the same entries in O(k*C) VPU work. Ties resolve to the lowest
-index, matching ``lax.top_k``'s documented tie-breaking.
+by a scatter. But the mask doesn't need sorted values: k max-and-suppress
+sweeps over a VMEM-resident tile find the same entries in O(k*C) VPU work.
+Ties resolve to the lowest index, matching ``lax.top_k``'s documented
+tie-breaking — parity is exact including NaN rows (NaN ranks greatest), rows
+with fewer than k finite entries, and -0.0/0.0 ties.
 
-**Measured verdict (v5e, N=8192, C=1000, k=5, chained-scan timing with a
-host fetch per repetition — ``python -m metrics_tpu.ops.select_topk``):
-XLA sort+scatter 0.636 ms/step vs Pallas 0.336 ms/step (1.9x)**, with exact
-``lax.top_k`` parity including NaN rows (NaN ranks greatest), rows with
-fewer than k finite entries, and -0.0/0.0 ties. The dispatch in
-``utils/data.select_topk`` uses the kernel on TPU for k>1 and falls back to
-XLA elsewhere (including under ``interpret=True`` for CPU correctness
-tests).
+Registered as the ``select_topk`` op in :mod:`metrics_tpu.ops.registry` and
+consumed by ``utils/data.select_topk`` (every ``top_k`` classification
+metric): ``auto`` runs the kernel on TPU (``default_on`` — this is the op
+where XLA's sort-based lowering measurably loses), the XLA sort+scatter
+elsewhere, and ``kernel_policy('interpret')`` executes the kernel body on
+the CPU CI lane. Measured verdicts live in the ``bench.py --kernel-smoke``
+lane output (see ``docs/kernels.md``), not here.
 """
 import functools
 
@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+
+from metrics_tpu.ops import registry as _registry
 
 Array = jax.Array
 
@@ -94,6 +96,43 @@ def topk_mask_supported(x: Array, k: int, force: bool = False) -> bool:
 def topk_mask(x: Array, k: int, interpret: bool = False) -> Array:
     """0/1 int32 mask of each row's k largest entries (ties -> lowest index)."""
     return _topk_mask(x, k, interpret=interpret)
+
+
+def _topk_mask_xla(x: Array, k: int) -> Array:
+    """Sort+scatter composition (the ``lax.top_k`` reference formulation)."""
+    _, idx = jax.lax.top_k(x, k)
+    zeros = jnp.zeros(x.shape, dtype=jnp.int32)
+    return jnp.put_along_axis(zeros, idx, 1, axis=-1, inplace=False)
+
+
+def _topk_eligible(x: Array, k: int):
+    if getattr(x, "ndim", None) != 2:
+        return False, "shape"
+    if not (1 < k <= _MAX_K) or k > x.shape[1] or x.shape[1] > _MAX_C:
+        return False, "shape"
+    if x.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+        return False, "dtype"
+    return True, "ok"
+
+
+def select_topk_mask(x: Array, k: int) -> Array:
+    """0/1 int32 mask of each row's k largest entries, routed through the
+    kernel registry under the current ``kernel_policy``."""
+    return _registry.dispatch("select_topk", x, k)
+
+
+_registry.register(
+    _registry.KernelOp(
+        name="select_topk",
+        pallas=_topk_mask,
+        xla=_topk_mask_xla,
+        eligible=_topk_eligible,
+        # a pure pallas_call body: safe under the engine's jitted updates
+        tracer_ok=True,
+        default_on=True,
+        integer_exact=True,
+    )
+)
 
 
 def _bench() -> None:  # pragma: no cover - manual measurement entrypoint

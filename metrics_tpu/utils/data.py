@@ -86,16 +86,11 @@ def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
         topk_tensor = jnp.put_along_axis(zeros, idx, 1, axis=dim, inplace=False)
     else:
         moved = jnp.moveaxis(prob_tensor, dim, -1)
-        from metrics_tpu.ops.select_topk import topk_mask, topk_mask_supported
+        # registry-dispatched: kernel_policy picks the sort-free Pallas kernel
+        # vs the lax.top_k+scatter composition (parity is exact, incl. ties)
+        from metrics_tpu.ops import registry as _kernels
 
-        if topk_mask_supported(moved, topk):
-            # sort-free Pallas kernel: 1.9x over lax.top_k+scatter on TPU
-            # (measured verdict in ops/select_topk.py)
-            scattered = topk_mask(moved, topk)
-        else:
-            _, idx = jax.lax.top_k(moved, topk)
-            zeros = jnp.zeros_like(moved, dtype=jnp.int32)
-            scattered = jnp.put_along_axis(zeros, idx, 1, axis=-1, inplace=False)
+        scattered = _kernels.dispatch("select_topk", moved, topk)
         topk_tensor = jnp.moveaxis(scattered, -1, dim)
     return topk_tensor.astype(jnp.int32)
 

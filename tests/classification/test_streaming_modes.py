@@ -1,0 +1,128 @@
+"""Tests for the constant-memory streaming modes that ride the kernel tier:
+``AUROC(thresholds=...)`` (binned ROC counters) and
+``CalibrationError(streaming_bins=True)`` (per-bin running sums)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import AUROC, CalibrationError
+
+
+def _binary_batches(seed, batches=4, n=256):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(batches):
+        target = rng.integers(0, 2, n)
+        # informative scores so AUROC is well away from 0.5
+        preds = np.clip(target * 0.35 + rng.uniform(size=n) * 0.65, 0, 1).astype(np.float32)
+        out.append((jnp.asarray(preds), jnp.asarray(target)))
+    return out
+
+
+class TestBinnedAUROC:
+    def test_close_to_exact_with_many_thresholds(self):
+        batches = _binary_batches(0)
+        exact = AUROC()
+        binned = AUROC(thresholds=512)
+        for p, t in batches:
+            exact.update(p, t)
+            binned.update(p, t)
+        a, b = float(exact.compute()), float(binned.compute())
+        assert 0.5 < a < 1.0
+        assert abs(a - b) < 5e-3
+
+    def test_streaming_equals_single_shot(self):
+        """Accumulating over batches must equal one update over the concat —
+        the counters are pure sums."""
+        batches = _binary_batches(1, batches=3, n=100)
+        streamed = AUROC(thresholds=64)
+        for p, t in batches:
+            streamed.update(p, t)
+        single = AUROC(thresholds=64)
+        single.update(
+            jnp.concatenate([p for p, _ in batches]), jnp.concatenate([t for _, t in batches])
+        )
+        np.testing.assert_array_equal(np.asarray(streamed.bTPs), np.asarray(single.bTPs))
+        np.testing.assert_array_equal(np.asarray(streamed.bTNs), np.asarray(single.bTNs))
+        assert float(streamed.compute()) == pytest.approx(float(single.compute()))
+
+    def test_state_is_constant_memory(self):
+        m = AUROC(thresholds=32)
+        p, t = _binary_batches(2, batches=1, n=4096)[0]
+        m.update(p, t)
+        assert m.bTPs.shape == (32,) and m.bFPs.shape == (32,)
+        assert int(m.bTPs[0] + m.bFNs[0]) == int(np.asarray(t).sum())
+
+    def test_explicit_threshold_sequence(self):
+        m = AUROC(thresholds=[0.0, 0.25, 0.5, 0.75, 1.0])
+        assert m.thresholds.shape == (5,)
+        p, t = _binary_batches(3, batches=1)[0]
+        m.update(p, t)
+        assert 0.0 <= float(m.compute()) <= 1.0
+
+    def test_perfect_and_inverted_separation(self):
+        m = AUROC(thresholds=128)
+        m.update(jnp.asarray([0.05, 0.1, 0.9, 0.95]), jnp.asarray([0, 0, 1, 1]))
+        assert float(m.compute()) == pytest.approx(1.0, abs=1e-2)
+        inv = AUROC(thresholds=128)
+        inv.update(jnp.asarray([0.9, 0.95, 0.05, 0.1]), jnp.asarray([0, 0, 1, 1]))
+        assert float(inv.compute()) == pytest.approx(0.0, abs=1e-2)
+
+    def test_validation_errors(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            AUROC(thresholds=16, buffer_capacity=128)
+        with pytest.raises(ValueError, match="max_fpr"):
+            AUROC(thresholds=16, max_fpr=0.5)
+        with pytest.raises(ValueError, match=">= 2"):
+            AUROC(thresholds=1)
+        with pytest.raises(ValueError, match="1D sequence"):
+            AUROC(thresholds=[[0.1, 0.2]])
+
+    def test_non_binary_update_raises(self):
+        m = AUROC(thresholds=16, num_classes=3)
+        preds = jnp.asarray(np.random.default_rng(4).uniform(size=(8, 3)).astype(np.float32))
+        preds = preds / preds.sum(-1, keepdims=True)
+        target = jnp.asarray([0, 1, 2, 0, 1, 2, 0, 1])
+        with pytest.raises(ValueError, match="only supports binary"):
+            m.update(preds, target)
+
+    def test_reset_zeroes_counters(self):
+        m = AUROC(thresholds=16)
+        p, t = _binary_batches(5, batches=1)[0]
+        m.update(p, t)
+        m.reset()
+        assert int(jnp.sum(m.bTPs + m.bFPs + m.bFNs + m.bTNs)) == 0
+
+
+class TestStreamingCalibration:
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    def test_matches_buffered_across_updates(self, norm):
+        rng = np.random.default_rng(6)
+        buffered = CalibrationError(n_bins=12, norm=norm)
+        streaming = CalibrationError(n_bins=12, norm=norm, streaming_bins=True)
+        for _ in range(4):
+            n = 200
+            target = rng.integers(0, 3, n)
+            logits = rng.uniform(size=(n, 3)).astype(np.float32)
+            preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+            buffered.update(preds, jnp.asarray(target))
+            streaming.update(preds, jnp.asarray(target))
+        assert float(streaming.compute()) == pytest.approx(float(buffered.compute()), abs=1e-5)
+
+    def test_state_is_constant_memory(self):
+        m = CalibrationError(n_bins=10, streaming_bins=True)
+        rng = np.random.default_rng(7)
+        preds = jnp.asarray(rng.uniform(0.5, 1.0, size=500).astype(np.float32))
+        target = jnp.asarray((rng.uniform(size=500) > 0.3).astype(np.int32))
+        m.update(preds, target)
+        assert m.bin_count.shape == (10,) and float(m.total) == 500.0
+        assert float(jnp.sum(m.bin_count)) <= 500.0  # conf == 0 lands in no bin
+
+    def test_forward_and_reset(self):
+        m = CalibrationError(n_bins=5, streaming_bins=True)
+        val = m(jnp.asarray([0.3, 0.6, 0.9, 0.6]), jnp.asarray([0, 1, 1, 0]))
+        ref = CalibrationError(n_bins=5)
+        ref_val = ref(jnp.asarray([0.3, 0.6, 0.9, 0.6]), jnp.asarray([0, 1, 1, 0]))
+        assert float(val) == pytest.approx(float(ref_val), abs=1e-6)
+        m.reset()
+        assert float(m.total) == 0.0 and float(jnp.sum(m.bin_count)) == 0.0

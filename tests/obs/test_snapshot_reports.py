@@ -302,10 +302,15 @@ def test_jsonl_roundtrip_and_prometheus_render():
         "fleet",
         "durability",
         "guard",
+        "kernels",
         "bus",
         "spans",
         "warnings",
     }
+    from metrics_tpu.ops.registry import kernel_stats
+
+    assert process["kernels"] == kernel_stats()
+    assert {"policy", "registered", "dispatches", "fallbacks", "by_op"} <= set(process["kernels"])
     assert process["engine"] == engine.cache_summary()
     assert process["fetch"] == engine.fetch_stats()
     assert set(process["fetch"]) == {"async_fetches", "coalesced_leaves"}

@@ -40,19 +40,50 @@ def test_counts_partition():
     np.testing.assert_array_equal(np.asarray(tp + fp + fn + tn), np.full((c, t), n))
 
 
-@pytest.mark.skipif(jax.default_backend() != "tpu", reason="pallas kernel is TPU-only")
 @pytest.mark.parametrize("n,c,t", [(64, 3, 10), (1000, 10, 100), (5000, 5, 33)])
 def test_pallas_exact_match(n, c, t):
     """The kernel must be bit-identical to the XLA formulation, including the
-    padded-tail masking when N is not a block multiple."""
+    padded-tail masking when N is not a block multiple. Off-TPU the kernel
+    BODY still executes — under ``kernel_policy('interpret')`` — so this is
+    never a skipped-on-CPU test."""
+    from metrics_tpu.ops.registry import kernel_policy
+
     rng = np.random.default_rng(2)
     preds = jnp.asarray(rng.uniform(size=(n, c)).astype(np.float32))
     target = jnp.asarray((rng.uniform(size=(n, c)) > 0.7).astype(np.int32))
     ths = jnp.linspace(0, 1, t)
-    out_p = binned_stat_counts(preds, target, ths, use_pallas=True)
+    with kernel_policy("pallas" if jax.default_backend() == "tpu" else "interpret"):
+        out_p = binned_stat_counts(preds, target, ths)
     out_x = jax.jit(_binned_counts_xla)(preds, target, ths)
     for a, b, name in zip(out_p, out_x, "tp fp fn tn".split()):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+@pytest.mark.parametrize("n,bins", [(64, 5), (1000, 15), (4097, 10)])
+def test_calibration_interpret_parity(n, bins):
+    """The streaming calibration kernel body agrees with the segment-sum
+    composition (float sums: documented 1e-5 relative tolerance), including
+    padded tails and the ``conf <= b[0]`` falls-in-no-bin edge."""
+    from metrics_tpu.ops.binned_counts import _binned_calibration_pallas, _binned_calibration_xla
+    from metrics_tpu.ops.registry import kernel_policy
+
+    rng = np.random.default_rng(4)
+    conf = rng.uniform(size=n).astype(np.float32)
+    conf[: max(1, n // 50)] = 0.0  # exactly b[0]: must land in NO bin
+    acc = (rng.uniform(size=n) > 0.4).astype(np.float32)
+    bounds = jnp.linspace(0, 1, bins + 1)
+    got = _binned_calibration_pallas(jnp.asarray(conf), jnp.asarray(acc), bounds, interpret=True)
+    want = _binned_calibration_xla(jnp.asarray(conf), jnp.asarray(acc), bounds)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))  # counts exact
+    for a, b, name in zip(got[1:], want[1:], ("conf_sum", "acc_sum")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5, err_msg=name)
+    # the registry's interpret policy routes the public wrapper the same way
+    from metrics_tpu.ops.binned_counts import binned_calibration_counts
+
+    with kernel_policy("interpret"):
+        via_registry = binned_calibration_counts(jnp.asarray(conf), jnp.asarray(acc), bounds)
+    for a, b in zip(via_registry, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_use_pallas_fallback_warns_which_path_ran():

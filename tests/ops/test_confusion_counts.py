@@ -1,0 +1,144 @@
+"""Tests for the fused confusion-matrix / multilabel-counts kernels
+(ops/confusion_counts.py). The Pallas bodies execute on every backend via
+``pallas_call(..., interpret=True)`` — no skipped-on-CPU tests — and parity
+vs the XLA compositions is bit-exact (integer counts)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.ops.confusion_counts import (
+    _confusion_counts_pallas,
+    _confusion_counts_xla,
+    _confusion_eligible,
+    _multilabel_counts_pallas,
+    _multilabel_counts_xla,
+    _multilabel_eligible,
+    confusion_counts,
+    multilabel_counts,
+)
+from metrics_tpu.ops.registry import kernel_policy
+
+
+@pytest.mark.parametrize(
+    "n,c",
+    [
+        (64, 3),  # tiny: C far below one class tile
+        (512, 7),  # N exactly one block
+        (1000, 10),  # ragged N tail
+        (513, 130),  # ragged N AND C just past one lane tile
+    ],
+)
+def test_confusion_interpret_bit_exact(n, c):
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.integers(0, c, n))
+    target = jnp.asarray(rng.integers(0, c, n))
+    got = _confusion_counts_pallas(preds, target, num_classes=c, interpret=True)
+    want = _confusion_counts_xla(preds, target, num_classes=c)
+    assert got.dtype == jnp.asarray(want).dtype  # lane-default int parity
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # every sample lands in exactly one cell
+    assert int(jnp.sum(got)) == n
+
+
+def test_confusion_vs_numpy_oracle():
+    rng = np.random.default_rng(1)
+    n, c = 777, 9
+    preds = rng.integers(0, c, n)
+    target = rng.integers(0, c, n)
+    oracle = np.zeros((c, c), np.int64)
+    for t, p in zip(target, preds):
+        oracle[t, p] += 1
+    got = _confusion_counts_pallas(jnp.asarray(preds), jnp.asarray(target), num_classes=c, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), oracle)
+
+
+def test_confusion_eligibility_reasons():
+    p = jnp.zeros((8,), jnp.int32)
+    assert _confusion_eligible(p, p, num_classes=5) == (True, "ok")
+    assert _confusion_eligible(p, p, num_classes=0) == (False, "shape")
+    assert _confusion_eligible(p, p, num_classes=100_000) == (False, "shape")
+    f = jnp.zeros((8,), jnp.float32)
+    assert _confusion_eligible(f, p, num_classes=5) == (False, "dtype")
+
+
+@pytest.mark.parametrize("n,c", [(64, 4), (256, 16), (300, 130)])
+def test_multilabel_interpret_bit_exact(n, c):
+    rng = np.random.default_rng(2)
+    preds = jnp.asarray(rng.integers(0, 2, (n, c)))
+    target = jnp.asarray(rng.integers(0, 2, (n, c)))
+    got = _multilabel_counts_pallas(preds, target, interpret=True)
+    want = _multilabel_counts_xla(preds, target)
+    assert got.shape == (c, 2, 2)
+    assert got.dtype == want.dtype
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # per class the four cells partition the n samples
+    np.testing.assert_array_equal(np.asarray(jnp.sum(got, axis=(1, 2))), np.full(c, n))
+
+
+def test_multilabel_eligibility_reasons():
+    p = jnp.zeros((8, 4), jnp.int32)
+    assert _multilabel_eligible(p, p) == (True, "ok")
+    assert _multilabel_eligible(p[0], p) == (False, "shape")
+    assert _multilabel_eligible(p.astype(jnp.float32), p) == (False, "dtype")
+    assert _multilabel_eligible(p, jnp.zeros((8, 5), jnp.int32)) == (False, "shape")
+
+
+def test_public_wrappers_route_through_registry():
+    rng = np.random.default_rng(3)
+    p = jnp.asarray(rng.integers(0, 4, 100))
+    t = jnp.asarray(rng.integers(0, 4, 100))
+    with kernel_policy("interpret"):
+        via_interpret = confusion_counts(p, t, num_classes=4)
+    with kernel_policy("xla"):
+        via_xla = confusion_counts(p, t, num_classes=4)
+    np.testing.assert_array_equal(np.asarray(via_interpret), np.asarray(via_xla))
+
+    mp = jnp.asarray(rng.integers(0, 2, (100, 6)))
+    mt = jnp.asarray(rng.integers(0, 2, (100, 6)))
+    with kernel_policy("interpret"):
+        ml_interpret = multilabel_counts(mp, mt)
+    with kernel_policy("xla"):
+        ml_xla = multilabel_counts(mp, mt)
+    np.testing.assert_array_equal(np.asarray(ml_interpret), np.asarray(ml_xla))
+
+
+def test_functional_confusion_matrix_unchanged_by_policy():
+    """The consumer (functional confusion_matrix) returns identical counts
+    under every policy — the dispatch is a routing decision, not a semantic
+    one."""
+    from metrics_tpu.functional import confusion_matrix
+
+    rng = np.random.default_rng(4)
+    preds = jnp.asarray(rng.integers(0, 3, 64))
+    target = jnp.asarray(rng.integers(0, 3, 64))
+    baseline = confusion_matrix(preds, target, num_classes=3)
+    for pol in ("auto", "xla", "interpret"):
+        with kernel_policy(pol):
+            np.testing.assert_array_equal(
+                np.asarray(confusion_matrix(preds, target, num_classes=3)), np.asarray(baseline)
+            )
+
+    # multilabel consumer path
+    mp = jnp.asarray(rng.integers(0, 2, (64, 4)))
+    mt = jnp.asarray(rng.integers(0, 2, (64, 4)))
+    ml_base = confusion_matrix(mp, mt, num_classes=4, multilabel=True)
+    assert ml_base.shape == (4, 2, 2)
+    with kernel_policy("interpret"):
+        np.testing.assert_array_equal(
+            np.asarray(confusion_matrix(mp, mt, num_classes=4, multilabel=True)), np.asarray(ml_base)
+        )
+
+
+def test_confusion_matrix_module_metric_jitted_update_still_works():
+    """The engine-jitted ConfusionMatrix update keeps working (tracer_ok=False
+    routes traced dispatches to the SPMD-safe XLA composition)."""
+    from metrics_tpu import ConfusionMatrix
+
+    rng = np.random.default_rng(5)
+    cm = ConfusionMatrix(num_classes=4)
+    p = jnp.asarray(rng.integers(0, 4, 50))
+    t = jnp.asarray(rng.integers(0, 4, 50))
+    cm.update(p, t)
+    out = np.asarray(cm.compute())
+    assert out.shape == (4, 4) and out.sum() == 50

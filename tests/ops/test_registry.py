@@ -1,0 +1,296 @@
+"""Tests for the kernel-tier dispatch registry (ops/registry.py): policy
+resolution, loud-fallback contract, observability (events + stats), and the
+policy's membership in the engine's shared-compile-cache key."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.obs import bus as obs_bus
+from metrics_tpu.obs.warn import reset_warn_once, warn_counts
+from metrics_tpu.ops import registry
+
+
+ON_TPU = jax.default_backend() == "tpu"
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    registry.reset_kernel_stats()
+    reset_warn_once()
+    yield
+    registry.reset_kernel_stats()
+
+
+def _dispatch_confusion(**kw):
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.integers(0, 5, 64))
+    t = jnp.asarray(rng.integers(0, 5, 64))
+    return registry.dispatch("confusion_counts", p, t, num_classes=5, **kw)
+
+
+def _kernel_events(events):
+    return [e for e in events if e.kind == "kernel"]
+
+
+def test_registered_surface():
+    ops = registry.registered_ops()
+    assert {
+        "binned_calibration",
+        "binned_counts",
+        "confusion_counts",
+        "multilabel_counts",
+        "pairwise_reduce",
+        "select_topk",
+    } <= set(ops)
+    op = registry.get_op("confusion_counts")
+    assert op.integer_exact and not op.tracer_ok
+    with pytest.raises(KeyError, match="Unknown kernel op"):
+        registry.get_op("nope")
+
+
+def test_policy_default_env_and_override(monkeypatch):
+    monkeypatch.delenv(registry.POLICY_ENV, raising=False)
+    assert registry.policy() == "auto"
+    monkeypatch.setenv(registry.POLICY_ENV, "xla")
+    assert registry.policy() == "xla"
+    # the sticky override wins over the env
+    with registry.kernel_policy("interpret"):
+        assert registry.policy() == "interpret"
+        # nesting restores the inner previous value
+        with registry.kernel_policy("auto"):
+            assert registry.policy() == "auto"
+        assert registry.policy() == "interpret"
+    assert registry.policy() == "xla"
+    with pytest.raises(ValueError, match="kernel_policy"):
+        registry.kernel_policy("mosaic")
+
+
+def test_invalid_env_warns_once_and_falls_back(monkeypatch):
+    monkeypatch.setenv(registry.POLICY_ENV, "pallaz")
+    with pytest.warns(UserWarning, match="METRICS_TPU_KERNELS"):
+        assert registry.policy() == "auto"
+
+
+def test_policy_xla_reason():
+    with obs_bus.capture(kinds=("kernel",)) as events:
+        with registry.kernel_policy("xla"):
+            _dispatch_confusion()
+    (ev,) = _kernel_events(events)
+    assert ev.data["path"] == "xla" and ev.data["reason"] == "policy_xla"
+    assert ev.data["policy"] == "xla"
+    # baseline mode is not a fallback: quiet, and counted as such
+    assert registry.kernel_stats()["fallbacks"] == 0
+
+
+def test_interpret_policy_executes_kernel_body_everywhere():
+    with obs_bus.capture(kinds=("kernel",)) as events:
+        with registry.kernel_policy("interpret"):
+            out = _dispatch_confusion()
+    (ev,) = _kernel_events(events)
+    assert ev.data["path"] == "interpret" and ev.data["reason"] == "policy_interpret"
+    with registry.kernel_policy("xla"):
+        ref = _dispatch_confusion()
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_auto_backend_fallback_is_quiet_but_observable():
+    if ON_TPU:
+        pytest.skip("off-TPU routing under test")
+    with obs_bus.capture(kinds=("kernel",)) as events:
+        _dispatch_confusion()
+    (ev,) = _kernel_events(events)
+    assert ev.data["path"] == "xla" and ev.data["reason"] == "backend"
+    stats = registry.kernel_stats()
+    assert stats["by_op"]["confusion_counts"]["xla"] == 1
+    assert stats["by_op"]["confusion_counts"]["fallbacks"] == 0  # auto: not loud
+    assert ("kernel_fallback", "confusion_counts", "backend") not in warn_counts()
+
+
+def test_forced_pallas_backend_fallback_is_loud():
+    if ON_TPU:
+        pytest.skip("off-TPU routing under test")
+    with obs_bus.capture(kinds=("kernel",)) as events:
+        with pytest.warns(UserWarning, match="XLA fallback"):
+            with registry.kernel_policy("pallas"):
+                _dispatch_confusion()
+    (ev,) = _kernel_events(events)
+    assert ev.data["path"] == "xla" and ev.data["reason"] == "backend"
+    stats = registry.kernel_stats()["by_op"]["confusion_counts"]
+    assert stats["fallbacks"] == 1 and stats["reasons"] == {"backend": 1}
+    assert ("kernel_fallback", "confusion_counts", "backend") in warn_counts()
+
+
+def test_tracer_fallback_for_tracer_gated_op():
+    """confusion_counts registers tracer_ok=False: under an outer jit the
+    dispatch routes to the SPMD-safe XLA composition with reason 'tracer'."""
+    rng = np.random.default_rng(1)
+    p = jnp.asarray(rng.integers(0, 5, 64))
+    t = jnp.asarray(rng.integers(0, 5, 64))
+    seen = {}
+
+    @jax.jit
+    def update(p, t):
+        with obs_bus.capture(kinds=("kernel",)) as events:
+            out = registry.dispatch("confusion_counts", p, t, num_classes=5)
+        seen["events"] = list(events)
+        return out
+
+    if ON_TPU:
+        with pytest.warns(UserWarning, match="XLA fallback"):
+            with registry.kernel_policy("pallas"):
+                out = update(p, t)
+        (ev,) = _kernel_events(seen["events"])
+        assert ev.data["path"] == "xla" and ev.data["reason"] == "tracer"
+    else:
+        out = update(p, t)  # auto off-TPU: quiet backend/tracer routing
+        (ev,) = _kernel_events(seen["events"])
+        assert ev.data["path"] == "xla" and ev.data["reason"] == "tracer"
+    with registry.kernel_policy("xla"):
+        ref = registry.dispatch("confusion_counts", p, t, num_classes=5)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_tracer_ok_op_dispatches_under_jit():
+    """select_topk registers tracer_ok=True: its pure pallas_call body is
+    trace-safe, so the interpret policy executes it inside an outer jit."""
+    x = jnp.asarray(np.random.default_rng(2).uniform(size=(16, 32)).astype(np.float32))
+    seen = {}
+
+    @jax.jit
+    def run(x):
+        with obs_bus.capture(kinds=("kernel",)) as events:
+            out = registry.dispatch("select_topk", x, 3)
+        seen["events"] = list(events)
+        return out
+
+    with registry.kernel_policy("interpret"):
+        out = run(x)
+    (ev,) = _kernel_events(seen["events"])
+    assert ev.data["path"] == "interpret"
+    assert int(jnp.sum(out)) == 16 * 3
+
+
+def test_dtype_ineligible_falls_back_loudly_under_pallas():
+    """A structurally ineligible dispatch (float labels) under an explicit
+    pallas policy is a LOUD fallback naming the dtype reason."""
+    p = jnp.asarray(np.random.default_rng(3).uniform(size=64).astype(np.float32))
+    t = jnp.asarray(np.random.default_rng(4).uniform(size=64).astype(np.float32))
+    with obs_bus.capture(kinds=("kernel",)) as events:
+        with pytest.warns(UserWarning, match="XLA fallback"):
+            with registry.kernel_policy("pallas"):
+                registry.dispatch("confusion_counts", (p * 5), (t * 5), num_classes=5)
+    (ev,) = _kernel_events(events)
+    assert ev.data["path"] == "xla" and ev.data["reason"] == "dtype"
+    assert ("kernel_fallback", "confusion_counts", "dtype") in warn_counts()
+
+
+def test_dtype_ineligible_under_interpret_is_loud_too():
+    p = jnp.asarray(np.random.default_rng(5).uniform(size=64).astype(np.float32))
+    with obs_bus.capture(kinds=("kernel",)) as events:
+        with pytest.warns(UserWarning, match="XLA fallback"):
+            with registry.kernel_policy("interpret"):
+                registry.dispatch("confusion_counts", p, p, num_classes=5)
+    (ev,) = _kernel_events(events)
+    assert ev.data["path"] == "xla" and ev.data["reason"] == "dtype"
+
+
+def test_measured_default_keeps_auto_on_xla():
+    """binned_counts registers default_on=False (the measured verdict favors
+    XLA's fusion): auto routes to the composition with a reason that names
+    the receipt, quietly."""
+    rng = np.random.default_rng(6)
+    preds = jnp.asarray(rng.uniform(size=(32, 3)).astype(np.float32))
+    target = jnp.asarray((rng.uniform(size=(32, 3)) > 0.5).astype(np.int32))
+    ths = jnp.linspace(0, 1, 5)
+    with obs_bus.capture(kinds=("kernel",)) as events:
+        registry.dispatch("binned_counts", preds, target, ths)
+    (ev,) = _kernel_events(events)
+    assert ev.data["path"] == "xla" and ev.data["reason"] == "measured_default"
+    assert registry.kernel_stats()["fallbacks"] == 0
+
+
+def test_force_env_keeps_legacy_interpret_contract(monkeypatch):
+    """METRICS_TPU_FORCE_PALLAS_PAIRWISE=1 under auto keeps the legacy
+    promise: off-TPU the kernel body still runs (interpret mode)."""
+    if ON_TPU:
+        pytest.skip("off-TPU contract under test")
+    monkeypatch.setenv("METRICS_TPU_FORCE_PALLAS_PAIRWISE", "1")
+    x = jnp.asarray(np.random.default_rng(7).uniform(size=(8, 4)).astype(np.float32))
+    with obs_bus.capture(kinds=("kernel",)) as events:
+        out = registry.dispatch("pairwise_reduce", x, x, op="euclidean", zero_diagonal=False)
+    (ev,) = _kernel_events(events)
+    assert ev.data["path"] == "interpret" and ev.data["reason"] == "forced_env_interpret"
+    assert out is not None and out.shape == (8,)
+
+
+def test_kernel_stats_shape_and_reset():
+    with registry.kernel_policy("xla"):
+        _dispatch_confusion()
+        _dispatch_confusion()
+    stats = registry.kernel_stats()
+    assert stats["dispatches"] == 2 and stats["xla"] == 2
+    assert set(stats) == {
+        "policy",
+        "registered",
+        "dispatches",
+        "pallas",
+        "xla",
+        "interpret",
+        "fallbacks",
+        "by_op",
+    }
+    rec = stats["by_op"]["confusion_counts"]
+    assert set(rec) == {"pallas", "xla", "interpret", "fallbacks", "reasons"}
+    assert rec["reasons"] == {"policy_xla": 2}
+    registry.reset_kernel_stats()
+    assert registry.kernel_stats()["dispatches"] == 0
+
+
+def test_stats_recorded_with_bus_disabled():
+    """The pull-side counters never depend on the bus being on."""
+    assert not obs_bus.enabled()
+    with registry.kernel_policy("xla"):
+        _dispatch_confusion()
+    assert registry.kernel_stats()["by_op"]["confusion_counts"]["xla"] == 1
+
+
+def test_policy_is_part_of_engine_cache_key():
+    """Flipping the policy must compile a fresh program, not serve one traced
+    under the old routing — the policy token rides inside _get_or_create."""
+    from metrics_tpu.engine.cache import _get_or_create
+
+    from metrics_tpu.engine import cache as engine_cache
+
+    class _Entry:
+        def __init__(self, tag):
+            self.tag = tag
+            self.last_used = 0
+
+    try:
+        with registry.kernel_policy("xla"):
+            a = _get_or_create(("registry-key-test",), lambda: _Entry("xla"))
+        with registry.kernel_policy("interpret"):
+            b = _get_or_create(("registry-key-test",), lambda: _Entry("interpret"))
+            b2 = _get_or_create(("registry-key-test",), lambda: _Entry("fresh"))
+        assert a is not b  # different policy -> different entry
+        assert b is b2  # same policy -> cache hit
+    finally:
+        # drop the fake entries so cache_summary() never meets them
+        with engine_cache._LOCK:
+            for key in [k for k, v in engine_cache._CACHE.items() if isinstance(v, _Entry)]:
+                del engine_cache._CACHE[key]
+
+
+def test_snapshot_embeds_kernel_section():
+    from metrics_tpu import obs
+
+    with registry.kernel_policy("xla"):
+        _dispatch_confusion()
+    snap = obs.snapshot()
+    assert snap["kernels"]["by_op"]["confusion_counts"]["xla"] >= 1
+    text = obs.prometheus_text()
+    assert 'metrics_tpu_kernel_dispatches{op="confusion_counts",path="xla"}' in text
+    assert "metrics_tpu_kernel_policy_info" in text
