@@ -420,4 +420,46 @@ if obj["kernel_events_emitted"] != obj["forced_pallas_dispatches"]:
 print("kernel-tier smoke OK (%d ops):" % len(obj["ops"]), line)
 '
 
+echo "=== state-integrity smoke (SDC detection, shadow-replay audit, repair) ==="
+# ISSUE 17 acceptance: forged single-bit corruption (crcs kept
+# self-consistent — only the attestation digests can catch it) is detected
+# 100% at all four boundaries; the corrupting worker walks probation ->
+# ejected on the guard integrity reason; repaired tenants are bit-identical
+# to a fault-free solo replay; a clean soak raises ZERO false positives.
+# Those contracts must hold on EVERY attempt (exit 2, never retried); the
+# audit-overhead timing gate (exit 3) gets one retry — it medians per-flush
+# timings a throttled CI box can skew
+integrity_smoke() {
+JAX_PLATFORMS=cpu python bench.py --integrity-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "integrity", obj
+# detection: every boundary catches its forged corruption
+for boundary in ("checkpoint", "migrate", "resume", "audit"):
+    if obj["detected_%s" % boundary] is not True:
+        print("forged corruption crossed the %s boundary undetected:" % boundary, line); sys.exit(2)
+# localization + response: the bitflipped worker was ejected via the guard
+if obj["corrupt_worker_ejected"] is not True or obj["repairs"] < 1:
+    print("the corrupting worker was never ejected/repaired:", line); sys.exit(2)
+# repair: every surviving tenant bit-identical to a fault-free solo replay
+if obj["repair_bit_identical"] is not True or obj["checked_tenants"] < 1:
+    print("repaired state diverged from the fault-free replay:", line); sys.exit(2)
+# zero false positives over the clean soak (attest + audit verifications)
+if obj["false_positives"] != 0 or obj["soak_verifications"] < 1:
+    print("integrity tripwires fired on clean state:", line); sys.exit(2)
+# the timing gate (exit 3, one retry): sampled shadow-replay audit costs
+# <5% of flush time at audit_rate=1/64
+if obj["value"] >= 0.05:
+    print("audit overhead %s >= 5%% at 1/64: %s" % (obj["value"], line)); sys.exit(3)
+print("integrity smoke OK (audit overhead %s at 1/64):" % obj["value"], line)
+'
+}
+integrity_rc=0; integrity_smoke || integrity_rc=$?
+if [ "$integrity_rc" -eq 3 ]; then
+  echo "integrity audit-overhead gate failed; retrying once"
+  integrity_rc=0; integrity_smoke || integrity_rc=$?
+fi
+[ "$integrity_rc" -eq 0 ] || exit "$integrity_rc"
+
 echo "both lanes green"

@@ -56,8 +56,10 @@ from metrics_tpu import serving  # noqa: E402,F401
 from metrics_tpu import sharding  # noqa: E402,F401
 from metrics_tpu.collections import MetricCollection  # noqa: E402,F401
 from metrics_tpu.utils.exceptions import (  # noqa: E402,F401
+    InjectedFaultError,
     NumericalHealthError,
     OverloadError,
+    StateIntegrityError,
     SyncError,
     SyncIntegrityError,
     SyncTimeoutError,
@@ -245,8 +247,10 @@ __all__ = [
     "StructuralSimilarityIndexMeasure",
     "SumMetric",
     "SyncError",
+    "InjectedFaultError",
     "NumericalHealthError",
     "OverloadError",
+    "StateIntegrityError",
     "SyncIntegrityError",
     "SyncTimeoutError",
     "SymmetricMeanAbsolutePercentageError",

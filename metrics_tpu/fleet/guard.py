@@ -101,6 +101,8 @@ class _WorkerHealth:
         "breach_streak",
         "clean_streak",
         "reasons",
+        "audit_failures",
+        "audit_bad_since_obs",
     )
 
     def __init__(self) -> None:
@@ -118,6 +120,18 @@ class _WorkerHealth:
         self.breach_streak = 0
         self.clean_streak = 0
         self.reasons: Tuple[str, ...] = ()
+        # shadow-replay audit verdicts (integrity plane): a failed audit is
+        # PROOF of corruption, not a latency inference — one failure per
+        # observation window is a breach, scored through the same
+        # probation->eject hysteresis as the gray signals
+        self.audit_failures = 0
+        self.audit_bad_since_obs = 0
+
+    def observe_audit(self, ok: bool) -> None:
+        self.samples += 1  # fresh evidence: the observe pass must not skip it
+        if not ok:
+            self.audit_failures += 1
+            self.audit_bad_since_obs += 1
 
     def observe_flush(self, ms: Optional[float], error: bool) -> None:
         self.samples += 1
@@ -141,6 +155,7 @@ class _WorkerHealth:
             "error_ewma": round(self.err_ewma, 4) if self.err_ewma is not None else None,
             "flushes": self.flushes,
             "errors": self.errors,
+            "audit_failures": self.audit_failures,
             "breach_streak": self.breach_streak,
             "reasons": list(self.reasons),
         }
@@ -301,7 +316,7 @@ class FleetGuard:
         return self._bank_to_worker.get(bank_name)
 
     def _on_event(self, event: Any) -> None:
-        if event.kind != "flush":
+        if event.kind not in ("flush", "audit"):
             return
         bank = event.data.get("bank")
         if bank is None:
@@ -313,7 +328,10 @@ class FleetGuard:
             rec = self._health.get(wid)
             if rec is None:
                 rec = self._health[wid] = _WorkerHealth()
-            rec.observe_flush(event.data.get("ms"), "error" in event.data)
+            if event.kind == "audit":
+                rec.observe_audit(bool(event.data.get("ok")))
+            else:
+                rec.observe_flush(event.data.get("ms"), "error" in event.data)
 
     # ------------------------------------------------------------------
     # request plane: tracked, hedged submits
@@ -454,6 +472,8 @@ class FleetGuard:
             reasons.append("errors")
         if self.lag_threshold is not None and lag is not None and lag > self.lag_threshold:
             reasons.append("lag")
+        if rec.audit_bad_since_obs > 0:
+            reasons.append("integrity")
         return tuple(reasons)
 
     def _transition(
@@ -526,6 +546,9 @@ class FleetGuard:
                     rec = self._health[wid] = _WorkerHealth()
                 rec.reasons = self._breach_reasons(rec, lag)
                 breach = bool(rec.reasons)
+                # an audit failure is consumed by the observation that scored
+                # it — the integrity breach must not re-count on idle ticks
+                rec.audit_bad_since_obs = 0
                 # streaks advance only on FRESH evidence: new flush samples
                 # since the last observation, or a live lag breach (polled
                 # truth, not a cached EWMA). Re-counting a stale EWMA every
@@ -670,6 +693,7 @@ class FleetGuard:
                 "healthy": states.count("healthy"),
                 "probation": states.count("probation"),
                 "ejected": states.count("ejected"),
+                "audit_failures": sum(r.audit_failures for r in self._health.values()),
                 "outstanding": len(self._outstanding),
                 "dedup": self.fleet.request_dedup.summary(),
                 **self.stats,
@@ -685,6 +709,7 @@ _GUARD_AGGREGATE_KEYS = (
     "ejections",
     "ejections_skipped",
     "ejection_errors",
+    "audit_failures",
     "healthy",
     "probation",
     "ejected",

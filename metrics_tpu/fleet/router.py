@@ -98,6 +98,7 @@ class Worker:
         request_dedup: Optional[RequestDedup] = None,
         fault_plan: Optional[Any] = None,
         epoch_fn: Optional[Any] = None,
+        audit_rate: Optional[float] = None,
     ) -> None:
         from metrics_tpu.serving import MetricBank, RequestRouter
 
@@ -110,6 +111,7 @@ class Worker:
             spill_store=spill_store,
             checkpoint_every_n_flushes=checkpoint_every_n_flushes,
             request_dedup=request_dedup,
+            audit_rate=audit_rate,
         )
         # gray-failure injection (METRICS_TPU_FAULTS 'slow'/'flaky' against
         # this worker's integer id): the injector rides the bank's flush
@@ -125,6 +127,18 @@ class Worker:
             and any(s.kind in ("slow", "flaky") and s.rank == worker_id for s in fault_plan)
         ):
             self.bank.fault_injector = self._gray_inject
+        # silent-data-corruption injection ('bitflip' against this worker's
+        # id): the seam sits AFTER the bank's cadence checkpoint inside the
+        # flush, so the flip strikes state already attested clean — the
+        # shape real SDC takes between durability boundaries. Nothing raises
+        # and no latency signal moves; only the integrity plane (digests at
+        # the boundaries, sampled shadow-replay audits) can see it.
+        if (
+            fault_plan is not None
+            and isinstance(worker_id, int)
+            and any(s.kind == "bitflip" and s.rank == worker_id for s in fault_plan)
+        ):
+            self.bank.state_fault_injector = self._bitflip_inject
         # the durable identity survives a die(): recovery needs the store
         # and the journal namespace, never the bank object
         self.bank_name = self.bank.name
@@ -165,6 +179,15 @@ class Worker:
             raise _faults.InjectedFaultError(
                 f"UNAVAILABLE: injected flaky flush (worker {self.worker_id})"
             )
+
+    def _bitflip_inject(self, tenants: List[Hashable]) -> None:
+        from metrics_tpu.resilience import integrity as _integrity
+
+        epoch = self._epoch_fn() if self._epoch_fn is not None else None
+        seq = self._fault_plan.bitflip_site(self.worker_id, epoch)
+        if seq is None or not tenants:
+            return
+        _integrity.inject_bitflip(self.bank, tenants[seq % len(tenants)], seq=seq)
 
     def drain(self) -> int:
         """Flush the router so no request is in flight; returns requests
@@ -258,6 +281,7 @@ class Fleet:
         migration_precisions: Optional[Any] = None,
         durable_store: Optional[Any] = None,
         checkpoint_every_n_flushes: Optional[int] = 1,
+        audit_rate: Optional[float] = None,
     ) -> None:
         ids = list(workers)
         if not ids:
@@ -279,6 +303,7 @@ class Fleet:
         self._migration_precisions = migration_precisions
         self._durable_store = durable_store
         self._ckpt_every = checkpoint_every_n_flushes
+        self._audit_rate = audit_rate
         # tenant -> ledger key, from publish until the admission acks: the
         # retryability record behind the partial-rebalance failure contract
         self._in_flight: Dict[Hashable, str] = {}
@@ -332,6 +357,7 @@ class Fleet:
             request_dedup=self.request_dedup,
             fault_plan=self._fault_plan,
             epoch_fn=lambda: self.epoch.version,
+            audit_rate=self._audit_rate,
         )
 
     def _precisions(self) -> Optional[Dict[str, str]]:

@@ -97,6 +97,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: ``flush`` event additionally carries ``ms`` (dispatch wall time) on
 #: success or ``error`` (exception class name) on failure — the signals
 #: the guard scores.
+#: State-integrity plane (``resilience/integrity.py``, ISSUE 17): ``attest``
+#: (one digest verification at a durability/migration boundary — ``ok``,
+#: bank, tenant, the failing ``leaf`` on mismatch), ``audit`` (one
+#: shadow-replay verdict — ``ok``, bank, tenant, requests replayed, flush
+#: index, diverging ``leaf`` on failure; the guard scores failing audits
+#: toward probation/ejection), ``repair`` (a quarantined tenant rebuilt from
+#: its journaled acked prefix — bank, tenant, restored update count).
 #: Misc: ``warning`` (a ``warn_once`` emission); ``kernel`` (one kernel-tier
 #: registry dispatch — ``op``, ``path`` taken (``pallas``/``xla``/
 #: ``interpret``), ``reason``, and the ``policy`` in effect; see
@@ -133,6 +140,9 @@ EVENT_KINDS = (
     "hedge",
     "warmup",
     "warmup_stale",
+    "attest",
+    "audit",
+    "repair",
     "warning",
     "kernel",
 )

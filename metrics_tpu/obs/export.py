@@ -71,6 +71,12 @@ def _kernel_stats() -> Dict[str, Any]:
     return kernel_stats()
 
 
+def _integrity_stats() -> Dict[str, Any]:
+    from metrics_tpu.resilience.integrity import integrity_stats
+
+    return integrity_stats()
+
+
 def process_snapshot() -> Dict[str, Any]:
     """The process-wide observability view (no metric argument needed)."""
     from metrics_tpu import engine as _engine
@@ -110,6 +116,10 @@ def process_snapshot() -> Dict[str, Any]:
         # kernel tier (ops/registry.py): dispatch policy, per-op path
         # counts (pallas / xla / interpret), loud-fallback tallies by reason
         "kernels": _kernel_stats(),
+        # state-integrity plane (resilience/integrity.py): attestations
+        # recorded/verified/failed, shadow-replay audits sampled/checked/
+        # passed/failed, quarantine repairs, injected bitflips
+        "integrity": _integrity_stats(),
         "bus": _bus.summary(),
         "spans": _trace.span_summary(),
         "warnings": {repr(k): v for k, v in _warn.warn_counts().items()},
@@ -406,6 +416,11 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
     rec = warm["recording"]
     _sample("metrics_tpu_warmup_recording", 1 if rec["active"] else 0, kind="gauge")
     _sample("metrics_tpu_warmup_recorded_programs", rec["programs"], kind="gauge")
+
+    # state-integrity plane: attestation/audit/repair counters — the fired
+    # tripwires (attest_failures, audit_failures) are the alerting surface
+    for key, value in sorted(_integrity_stats().items()):
+        _sample(f"metrics_tpu_integrity_{key}", value)
 
     # kernel tier: which path each op's dispatches took, and why fallbacks
     kern = _kernel_stats()

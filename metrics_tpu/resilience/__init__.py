@@ -18,6 +18,14 @@ TPUv4 scale; EQuARX degraded collectives). This package holds the pieces:
   intermittent errors, honored by the KV layers and the fleet worker flush
   path), per-thread world simulation, and an env-activated
   (``METRICS_TPU_FAULTS``) wrapper for live clients.
+* :mod:`~metrics_tpu.resilience.integrity` — the state-integrity plane:
+  sealed-state attestation (cheap per-leaf fold digests recorded into every
+  durable journal record / migration payload / drive snapshot and verified
+  at every re-admit, recover, resume, and import), the sampled shadow-replay
+  audit (:class:`IntegrityAuditor` re-executes journaled request batches on
+  a solo clone and compares bit-exact), deterministic ``bitflip`` SDC
+  injection, and quarantine + journal-replay repair
+  (``MetricBank.repair_tenant``) — see ``docs/integrity.md``.
 * :mod:`~metrics_tpu.resilience.overload` — admission control for the
   serving request plane: per-tenant token-bucket quotas, a global inflight
   cap, deadline-aware shedding (every rejection is a loud
@@ -55,6 +63,19 @@ from metrics_tpu.resilience.health import (  # noqa: F401
     HEALTH_POLICIES,
     HEALTH_STATE,
     new_health_stats,
+)
+from metrics_tpu.resilience.integrity import (  # noqa: F401
+    AuditEntry,
+    IntegrityAuditor,
+    fold_digest,
+    forge_payload_corruption,
+    forge_snapshot_corruption,
+    inject_bitflip,
+    integrity_stats,
+    leaf_digest,
+    reset_integrity_stats,
+    state_digest,
+    verify_tree,
 )
 from metrics_tpu.resilience.overload import (  # noqa: F401
     AdmissionController,

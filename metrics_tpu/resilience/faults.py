@@ -54,22 +54,18 @@ __all__ = [
 
 FAULTS_ENV_VAR = "METRICS_TPU_FAULTS"
 
-_FAULT_KINDS = ("drop", "delay", "corrupt", "straggler", "kill", "die", "slow", "flaky")
+_FAULT_KINDS = ("drop", "delay", "corrupt", "straggler", "kill", "die", "slow", "flaky", "bitflip")
+
+# Canonical home is utils.exceptions (exported from the package root since the
+# integrity plane landed); re-exported here so every pre-existing
+# ``from metrics_tpu.resilience.faults import InjectedFaultError`` keeps working.
+from metrics_tpu.utils.exceptions import InjectedFaultError  # noqa: E402,F401
 
 
 class KVTimeoutError(TimeoutError):
     """Timeout raised by the fake store — message mirrors the real
     coordination-service client (``DEADLINE_EXCEEDED``) so the transient-error
     classifier in ``parallel/groups.py`` treats both identically."""
-
-
-class InjectedFaultError(ConnectionError):
-    """The error a ``'flaky'`` fault injects: an intermittent, transient
-    infrastructure failure. Subclasses ``ConnectionError`` so the sync
-    stack's transient classifier (``parallel/groups._is_transient_kv_error``)
-    retries it by *type*, and so fleet-level consumers (the worker flush
-    path, :class:`~metrics_tpu.fleet.FleetGuard`) see exactly the shape a
-    real flaky NIC/RPC layer produces."""
 
 
 @dataclass(frozen=True)
@@ -104,16 +100,26 @@ class FaultSpec:
             calls raise :class:`InjectedFaultError`, then one succeeds, and
             the pattern repeats — ``times=1`` is a 50% error rate), on KV
             reads of the rank's payload and on the fleet worker's flush path.
+            ``'bitflip'`` — SILENT data corruption (SDC): consumed by the
+            serving layer, never the KV fake. The fleet worker whose integer
+            id is ``rank`` flips one bit in a tenant's device-resident state
+            *after* an applied update (the bank's post-update injection seam)
+            for the first ``times`` flushes at matching ``epoch``, then
+            heals. The flip site (leaf + bit offset) is derived
+            deterministically from the flip's sequence index, so a run is
+            reproducible; nothing raises — detection must come from the
+            state-integrity plane (``resilience/integrity.py``).
         rank: the *publisher* process index whose payload is affected (for
-            ``'kill'``/``'die'``, and for ``'slow'``/``'flaky'`` on the
-            worker flush path: the fleet worker id).
+            ``'kill'``/``'die'``, and for ``'slow'``/``'flaky'``/``'bitflip'``
+            on the worker flush path: the fleet worker id).
         epoch: exchange epoch the fault applies to (for ``'kill'``/``'die'``/
-            ``'slow'``/``'flaky'`` consulted by the fleet: the fleet epoch
-            version); ``None`` = every epoch.
+            ``'slow'``/``'flaky'``/``'bitflip'`` consulted by the fleet: the
+            fleet epoch version); ``None`` = every epoch.
         seconds: delay/straggler/slow duration.
         times: how many corrupted reads ``'corrupt'`` serves before healing;
             for ``'flaky'``: failures per ``times + 1`` calls (the error
-            duty cycle).
+            duty cycle); for ``'bitflip'``: how many flushes flip a bit
+            before the fault heals.
     """
 
     kind: str
@@ -166,6 +172,8 @@ class FaultPlan:
         self._corrupt_served: Dict[Tuple[FaultSpec, int, int], int] = {}
         # per-spec call counters behind the deterministic 'flaky' duty cycle
         self._flaky_calls: Dict[FaultSpec, int] = {}
+        # per-spec claims behind the deterministic 'bitflip' injection sites
+        self._bitflips_served: Dict[FaultSpec, int] = {}
 
     def __iter__(self):
         return iter(self.specs)
@@ -211,6 +219,26 @@ class FaultPlan:
             n = self._flaky_calls.get(spec, 0)
             self._flaky_calls[spec] = n + 1
         return n % (spec.times + 1) < spec.times
+
+    def bitflip_site(self, rank: int, epoch: Optional[int] = None) -> Optional[int]:
+        """Claim one ``'bitflip'`` injection for worker ``rank`` at ``epoch``.
+
+        Returns the flip's 0-based sequence index while the spec still owes
+        flips (``times`` total, then the fault heals), else ``None``. The
+        caller derives the corruption site (tenant slot, leaf, bit offset)
+        deterministically from this index — see
+        :func:`metrics_tpu.resilience.integrity.inject_bitflip` — so a plan
+        reproduces the exact same SDC every run. Thread-safe (claimed under
+        the plan lock, like ``corrupt``'s counter)."""
+        spec = self._first("bitflip", rank, epoch)
+        if spec is None:
+            return None
+        with self._lock:
+            served = self._bitflips_served.get(spec, 0)
+            if served >= spec.times:
+                return None
+            self._bitflips_served[spec] = served + 1
+        return served
 
     def slow_read_s(self, key: str) -> float:
         parsed = _parse_key(key)

@@ -1,0 +1,463 @@
+"""State-integrity plane: SDC detection, attestation, shadow audit, repair.
+
+Crash-stop failures (the durable store, ISSUE 13) and gray failures (the
+guard, ISSUE 14) leave one failure class uncovered: **silent data corruption**
+— a flaky core, a bad host DMA, or a buggy kernel path flips bits in a bank's
+device-resident accumulators, and every later compute, checkpoint, and
+migration faithfully propagates the wrong answer. The crc32 wire envelope
+(PR 2) seals bytes only from the moment they were *encoded*; corruption
+upstream of sealing is attested as if it were truth. This module turns SDC
+into a detected, localized, repaired failure class:
+
+* **Sealed-state attestation** — :func:`state_digest` folds every state leaf's
+  raw bytes into a cheap 64-bit digest (vectorized xor/fold with positional
+  mixing — any single-bit flip and any word swap changes it). Digests are
+  computed from the ONE coalesced host fetch the checkpoint path already
+  performs, embedded in every ``encode_tenant_payload`` header AND recorded
+  in the journal's checkpoint/spill/import records, then re-verified by
+  :func:`verify_tree` at every boundary a state crosses: blob decode
+  (re-admit, migration import, drive resume) and journal-vs-blob cross-check
+  on recovery. A mismatch raises
+  :class:`~metrics_tpu.utils.exceptions.StateIntegrityError` naming
+  bank/tenant/leaf.
+
+* **Shadow-replay audit** — ``MetricBank(audit_rate=)`` samples applied
+  request batches (journaled via the existing WAL append), capturing the
+  audited tenant's pre/post rows as fresh device buffers fetched
+  asynchronously off the hot path. :class:`IntegrityAuditor` re-executes the
+  batch on a solo template clone and compares bit-exact against the resident
+  slice — the per-tenant-parity contract (PR 7), checked continuously in
+  production. The divergence window a flip can hide in is ``1/audit_rate``
+  flushes.
+
+* **Fault injection** — the ``bitflip`` fault kind
+  (``METRICS_TPU_FAULTS``) drives :func:`inject_bitflip` through the bank's
+  post-update injection seam, and the forge helpers below corrupt *sealed*
+  payloads while keeping every crc self-consistent (the SDC shape checksums
+  cannot see), so CI can prove each detection boundary does real work beyond
+  crc32.
+
+* **Repair** — a detected corruption quarantines the tenant and rebuilds it
+  from the journaled acked prefix through ``MetricBank.repair_tenant`` (the
+  ``recover`` machinery), bounded by the checkpoint cadence window.
+
+Telemetry: ``attest``/``audit``/``repair`` bus events,
+``obs.snapshot()["integrity"]`` (:func:`integrity_stats`), the
+``metrics_tpu_integrity_*`` Prometheus family, and the
+``bench.py --integrity-smoke`` chaos lane. See ``docs/integrity.md``.
+"""
+import json
+import struct
+import threading
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.obs import bus as _obs_bus
+from metrics_tpu.utils.exceptions import StateIntegrityError
+
+__all__ = [
+    "AuditEntry",
+    "IntegrityAuditor",
+    "fold_digest",
+    "forge_payload_corruption",
+    "forge_snapshot_corruption",
+    "inject_bitflip",
+    "integrity_stats",
+    "leaf_digest",
+    "reset_integrity_stats",
+    "state_digest",
+    "verify_tree",
+]
+
+# ---------------------------------------------------------------------------
+# process-wide integrity telemetry — the "integrity" section of obs.snapshot()
+# and the metrics_tpu_integrity_* Prometheus family
+# ---------------------------------------------------------------------------
+_STATS_LOCK = threading.Lock()
+
+
+def _new_stats() -> Dict[str, int]:
+    return {
+        "attests_recorded": 0,
+        "attests_verified": 0,
+        "attest_failures": 0,
+        "audits_sampled": 0,
+        "audits_checked": 0,
+        "audits_passed": 0,
+        "audit_failures": 0,
+        "audits_dropped": 0,
+        "repairs": 0,
+        "repair_failures": 0,
+        "bitflips_injected": 0,
+    }
+
+
+_STATS = _new_stats()
+
+
+def bump(key: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        _STATS[key] += n
+
+
+def integrity_stats() -> Dict[str, int]:
+    """Process-wide state-integrity counters: digests recorded/verified (and
+    verification failures), shadow audits sampled/checked/passed/failed (and
+    entries dropped to the capture bound), tenant repairs, and injected
+    bitflips (chaos runs only)."""
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def reset_integrity_stats() -> None:
+    with _STATS_LOCK:
+        for key in list(_STATS):
+            _STATS[key] = 0
+
+
+# ---------------------------------------------------------------------------
+# sealed-state digests
+# ---------------------------------------------------------------------------
+_FOLD_SEED = 0xCBF29CE484222325
+_FOLD_PRIME = 0x100000001B3
+_FOLD_MIX = 0x9E3779B97F4A7C15
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fold_digest(data: bytes) -> str:
+    """64-bit xor/fold of ``data`` as a 16-hex-char string.
+
+    Vectorized over 8-byte words with positional mixing (each word is
+    multiplied by an odd position-dependent constant before the xor fold), so
+    a single flipped bit is guaranteed to change the digest — odd
+    multiplication is a bijection on Z/2^64 — and swapped or shifted words
+    change it too, which a plain xor fold would miss. Orders of magnitude
+    cheaper than a cryptographic hash; the threat model is hardware SDC, not
+    an adversary.
+    """
+    n = len(data)
+    pad = (-n) % 8
+    if pad:
+        data = data + b"\x00" * pad
+    words = np.frombuffer(data, dtype="<u8")
+    acc = _FOLD_SEED
+    if words.size:
+        idx = np.arange(1, words.size + 1, dtype=np.uint64)
+        mixed = words * ((np.uint64(_FOLD_MIX) * idx) | np.uint64(1))
+        acc ^= int(np.bitwise_xor.reduce(mixed))
+    acc = ((acc ^ n) * _FOLD_PRIME) & _U64
+    return format(acc, "016x")
+
+
+def leaf_digest(value: Any) -> str:
+    """Digest one state leaf: dtype + shape + raw bytes, normalized exactly
+    like the exact wire codec (C order, native byte order) so a digest taken
+    from live state equals the digest of the same leaf after an
+    encode/decode round-trip."""
+    arr = np.asarray(value, order="C")
+    arr = arr.astype(arr.dtype.newbyteorder("="), copy=False)
+    meta = f"{arr.dtype.str}|{arr.shape}".encode()
+    return fold_digest(meta + arr.tobytes())
+
+
+def state_digest(tree: Dict[str, Any]) -> Dict[str, str]:
+    """Per-leaf digests of a state tree (``{leaf_name: 16-hex digest}``).
+
+    Leaf-granular rather than one tree-wide fold so a verification failure
+    localizes the corruption (``StateIntegrityError.leaf``), and so codecs
+    that only attest a subset of leaves (quantized wire payloads are lossy —
+    their digests could never verify) can drop keys without losing coverage
+    of the rest.
+    """
+    return {name: leaf_digest(value) for name, value in sorted(tree.items())}
+
+
+def verify_tree(
+    tree: Dict[str, Any],
+    expected: Optional[Dict[str, str]],
+    *,
+    bank: Any = None,
+    tenant: Any = None,
+    context: str = "",
+) -> None:
+    """Verify ``tree`` against recorded per-leaf digests; raise on mismatch.
+
+    ``expected`` maps leaf names to the digests sealed when the state last
+    crossed an attestation point; ``None``/empty verifies nothing (payloads
+    sealed before the integrity plane existed, quantized leaves). A missing
+    or mismatching leaf raises :class:`StateIntegrityError` naming
+    bank/tenant/leaf; every call lands in :func:`integrity_stats` and (bus
+    enabled) emits an ``attest`` event.
+    """
+    if not expected:
+        return
+    failure: Optional[Tuple[str, str]] = None
+    for leaf in sorted(expected):
+        if leaf not in tree:
+            failure = (leaf, "<missing>")
+            break
+        actual = leaf_digest(tree[leaf])
+        if actual != expected[leaf]:
+            failure = (leaf, actual)
+            break
+    if failure is None:
+        bump("attests_verified")
+        if _obs_bus.enabled():
+            _obs_bus.emit(
+                "attest",
+                source="integrity",
+                ok=True,
+                bank=str(bank) if bank is not None else None,
+                tenant=str(tenant) if tenant is not None else None,
+                leaves=len(expected),
+            )
+        return
+    leaf, actual = failure
+    bump("attest_failures")
+    if _obs_bus.enabled():
+        _obs_bus.emit(
+            "attest",
+            source="integrity",
+            ok=False,
+            bank=str(bank) if bank is not None else None,
+            tenant=str(tenant) if tenant is not None else None,
+            leaf=leaf,
+        )
+    raise StateIntegrityError(
+        f"State failed attestation{context}: leaf {leaf!r} folds to {actual}"
+        f" but was sealed as {expected[leaf]} — the state bytes changed after"
+        " they were attested (silent corruption, a stale/swapped blob, or a"
+        " decode bug). This tenant's resident state cannot be trusted; see"
+        " docs/integrity.md for the quarantine/repair path.",
+        bank=bank,
+        tenant=tenant,
+        leaf=leaf,
+    )
+
+
+# ---------------------------------------------------------------------------
+# fault injection: deterministic device-state bitflips
+# ---------------------------------------------------------------------------
+def inject_bitflip(bank: Any, tenant: Hashable, seq: int = 0) -> Optional[Dict[str, Any]]:
+    """Flip ONE bit in ``tenant``'s device-resident state — the SDC fault.
+
+    The site is a pure function of ``seq`` (the flip's sequence index from
+    ``FaultPlan.bitflip_site``): leaf = ``seq``-th non-empty leaf (cyclic,
+    sorted names), bit = a Knuth-hashed offset into that leaf's bytes — so a
+    fault plan reproduces the exact same corruption every run. Nothing is
+    raised and no event is emitted: the whole point of SDC is that the write
+    path stays silent, and detection must come from attestation or the
+    shadow audit. Returns the site (``{"tenant", "leaf", "bit"}``), or
+    ``None`` when the tenant is not device-resident.
+
+    Called from the bank's post-update seam with the bank lock held (the
+    lock is reentrant, so direct chaos-test calls are safe too).
+    """
+    with bank._lock:
+        slot = bank._slots.get(tenant)
+        if slot is None:
+            return None
+        state = bank._read_slot(slot)
+        names = sorted(state)
+        leaf_name = None
+        for probe in range(len(names)):
+            candidate = names[(seq + probe) % len(names)]
+            if np.asarray(state[candidate]).nbytes > 0:
+                leaf_name = candidate
+                break
+        if leaf_name is None:
+            return None
+        arr = np.array(np.asarray(state[leaf_name]), copy=True)
+        arr = arr.astype(arr.dtype.newbyteorder("="), copy=False)
+        raw = bytearray(arr.tobytes())
+        bit = (seq * 2654435761 + 17) % (len(raw) * 8)
+        raw[bit // 8] ^= 1 << (bit % 8)
+        flipped = np.frombuffer(bytes(raw), dtype=arr.dtype).reshape(arr.shape)
+        state[leaf_name] = flipped
+        bank._write_slots({slot: state})
+    bump("bitflips_injected")
+    return {"tenant": tenant, "leaf": leaf_name, "bit": int(bit)}
+
+
+# ---------------------------------------------------------------------------
+# forged corruption of SEALED payloads (chaos/test helpers)
+# ---------------------------------------------------------------------------
+def forge_payload_corruption(
+    payload: bytes, *, leaf: Optional[str] = None, bit: int = 0
+) -> bytes:
+    """Corrupt one leaf inside a sealed ``encode_tenant_payload`` blob while
+    keeping every crc32 envelope self-consistent.
+
+    A naive bit flip in a stored blob is caught by the PR-2 wire envelope
+    before the integrity plane ever runs; *this* helper models the corruption
+    shape checksums cannot see — bytes that went wrong upstream of sealing
+    (bad DMA during the checkpoint fetch, a buggy encoder) or a store that
+    re-sealed tampered content. It flips ``bit`` in ``leaf``'s encoded data
+    region and re-packs the leaf's inner envelope (recomputing its crc), but
+    leaves the outer header — and the per-leaf digests sealed in it —
+    untouched. Decoding therefore passes every crc check and fails ONLY the
+    digest attestation, which is exactly the property the
+    ``--integrity-smoke`` lane proves.
+    """
+    from metrics_tpu.parallel import groups as _groups
+
+    version, body = _groups.unpack_envelope(payload, " (forge)")
+    (header_len,) = struct.unpack(">I", body[:4])
+    header_bytes = body[4 : 4 + header_len]
+    keys = json.loads(header_bytes.decode())["keys"]
+    offset = 4 + header_len
+    blocks: List[bytes] = []
+    for _ in keys:
+        (block_len,) = struct.unpack(">Q", body[offset : offset + 8])
+        offset += 8
+        blocks.append(body[offset : offset + block_len])
+        offset += block_len
+    target = keys.index(leaf) if leaf is not None else None
+    if target is None:
+        for i, block in enumerate(blocks):
+            iv, ibody = _groups.unpack_envelope(block, " (forge)")
+            (ihl,) = struct.unpack(">I", ibody[:4])
+            if len(ibody) > 4 + ihl:  # first leaf with a non-empty data region
+                target = i
+                break
+        if target is None:
+            raise ValueError("payload has no leaf with a non-empty data region to corrupt")
+    iv, ibody = _groups.unpack_envelope(blocks[target], " (forge)")
+    (ihl,) = struct.unpack(">I", ibody[:4])
+    data = bytearray(ibody[4 + ihl :])
+    if not data:
+        raise ValueError(f"leaf {keys[target]!r} has no data bytes to corrupt")
+    site = bit % (len(data) * 8)
+    data[site // 8] ^= 1 << (site % 8)
+    blocks[target] = _groups.pack_envelope(ibody[: 4 + ihl] + bytes(data), iv)
+    new_body = body[: 4 + header_len] + b"".join(
+        struct.pack(">Q", len(b)) + b for b in blocks
+    )
+    return _groups.pack_envelope(new_body, version)
+
+
+def forge_snapshot_corruption(payload: bytes, *, leaf: Optional[str] = None, bit: int = 0) -> bytes:
+    """:func:`forge_payload_corruption` for a sealed drive snapshot: forges
+    the inner tenant payload and re-packs the outer snapshot envelope, so
+    ``drive(resume_from=)`` sees valid crcs and a failing digest."""
+    from metrics_tpu.parallel import groups as _groups
+
+    version, body = _groups.unpack_envelope(payload, " (forge)")
+    (meta_len,) = struct.unpack(">I", body[:4])
+    inner = forge_payload_corruption(body[4 + meta_len :], leaf=leaf, bit=bit)
+    return _groups.pack_envelope(body[: 4 + meta_len] + inner, version)
+
+
+# ---------------------------------------------------------------------------
+# shadow-replay audit
+# ---------------------------------------------------------------------------
+class AuditEntry:
+    """One sampled flush's audit evidence for a single tenant: the request
+    args applied to it (in batch order), its update count before the flush,
+    and an async capture of its pre/post state rows (fresh device buffers —
+    safe against the dispatch's donation — fetched lazily off the hot path
+    via the PR-5 ``AsyncResult``)."""
+
+    __slots__ = ("tenant", "args_list", "count_before", "capture", "flush_index")
+
+    def __init__(
+        self,
+        tenant: Hashable,
+        args_list: List[Tuple[Any, ...]],
+        count_before: int,
+        capture: Any,
+        flush_index: int,
+    ) -> None:
+        self.tenant = tenant
+        self.args_list = args_list
+        self.count_before = int(count_before)
+        self.capture = capture
+        self.flush_index = int(flush_index)
+
+
+class IntegrityAuditor:
+    """Re-execute sampled flushes on a solo clone; compare bit-exact.
+
+    The bank's banked dispatch is contractually bit-identical to a solo
+    instance fed the same request stream (the PR-7 parity contract, gated in
+    CI since). The auditor turns that contract into a *continuous production
+    check*: for every sampled flush it binds the audited tenant's captured
+    pre-state onto a clone of the bank template, replays the tenant's
+    requests, and compares the result against the captured post-state byte
+    for byte. A divergence means the resident slice was corrupted between
+    capture points (or a kernel produced a wrong result) — it is counted,
+    emitted as a failing ``audit`` event (which the fleet guard scores
+    toward probation/ejection), and, with ``repair=True`` (default),
+    repaired in place via :meth:`MetricBank.repair_tenant`.
+
+    Run :meth:`poll` off the hot path (a maintenance thread, the guard's
+    poll cadence, or a test loop); each call drains the bank's pending
+    captures. The capture's device→host fetch happens here, not in the
+    flush path.
+    """
+
+    def __init__(self, bank: Any, *, repair: bool = True) -> None:
+        self.bank = bank
+        self.repair = repair
+        self.last_failure: Optional[Dict[str, Any]] = None
+
+    def poll(self) -> Dict[str, int]:
+        """Audit every pending capture; returns this poll's verdict counts."""
+        out = {"checked": 0, "passed": 0, "failed": 0, "repaired": 0}
+        for entry in self.bank.take_audits():
+            out["checked"] += 1
+            bump("audits_checked")
+            mismatch = self._check(entry)
+            if mismatch is None:
+                out["passed"] += 1
+                bump("audits_passed")
+                self._emit(entry, ok=True)
+                continue
+            out["failed"] += 1
+            bump("audit_failures")
+            self.last_failure = {"tenant": entry.tenant, "leaf": mismatch}
+            self._emit(entry, ok=False, leaf=mismatch)
+            if self.repair:
+                try:
+                    self.bank.repair_tenant(entry.tenant)
+                    out["repaired"] += 1
+                except Exception:  # noqa: BLE001 — repair failure is counted, not fatal to the poll
+                    bump("repair_failures")
+        return out
+
+    def _check(self, entry: AuditEntry) -> Optional[str]:
+        """Replay the entry on a solo clone; first diverging leaf or None."""
+        fetched = entry.capture.result()
+        pre, post = fetched["pre"], fetched["post"]
+        clone = self.bank._template.clone()
+        clone.bind_state(pre, update_count=entry.count_before)
+        for args in entry.args_list:
+            clone.update(*args)
+        replay = clone._snapshot_state()
+        for leaf in sorted(post):
+            want = np.asarray(replay[leaf])
+            got = np.asarray(post[leaf])
+            want = want.astype(want.dtype.newbyteorder("="), copy=False)
+            got = got.astype(got.dtype.newbyteorder("="), copy=False)
+            if (
+                want.dtype != got.dtype
+                or want.shape != got.shape
+                or np.asarray(want, order="C").tobytes() != np.asarray(got, order="C").tobytes()
+            ):
+                return leaf
+        return None
+
+    def _emit(self, entry: AuditEntry, ok: bool, leaf: Optional[str] = None) -> None:
+        if not _obs_bus.enabled():
+            return
+        data: Dict[str, Any] = {
+            "ok": ok,
+            "bank": self.bank.name,
+            "tenant": str(entry.tenant),
+            "requests": len(entry.args_list),
+            "flush": entry.flush_index,
+        }
+        if leaf is not None:
+            data["leaf"] = leaf
+        _obs_bus.emit("audit", source="integrity", **data)

@@ -65,8 +65,9 @@ from metrics_tpu.engine import bucketing as _bucketing
 from metrics_tpu.engine import cache as _cache
 from metrics_tpu.obs import bus as _bus
 from metrics_tpu.resilience import health as _health
+from metrics_tpu.resilience import integrity as _integrity
 from metrics_tpu.serving import store as _spill
-from metrics_tpu.utils.exceptions import MetricsUserError
+from metrics_tpu.utils.exceptions import MetricsUserError, StateIntegrityError
 
 Array = jax.Array
 
@@ -160,6 +161,15 @@ class MetricBank:
             primary, or a kill-path resubmission that raced a hedge — is
             dropped BEFORE any state is touched, and counted. ``None``
             (default): ids are ignored; every request applies.
+        audit_rate: fraction of applied flushes shadow-audited for silent
+            state corruption (``1/64`` samples every 64th flush; ``None``,
+            the default, disables auditing). A sampled flush journals a
+            replay-neutral audit record (riding the WAL append) and captures
+            one tenant's pre/post state rows as fresh device buffers; an
+            :class:`~metrics_tpu.resilience.IntegrityAuditor` polling
+            :meth:`take_audits` re-executes the requests on a solo template
+            clone and compares bit-exact — the per-tenant-parity contract,
+            checked continuously in production. See ``docs/integrity.md``.
 
     ``update(tenant, *args)`` is sugar for a one-request
     :meth:`apply_batch`; real serving traffic should flow through a
@@ -178,6 +188,7 @@ class MetricBank:
         checkpoint_every_n_flushes: Optional[int] = None,
         checkpoint_async: bool = False,
         request_dedup: Optional[Any] = None,
+        audit_rate: Optional[float] = None,
     ) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -186,6 +197,8 @@ class MetricBank:
                 f"checkpoint_every_n_flushes must be >= 1 (or None), got"
                 f" {checkpoint_every_n_flushes}"
             )
+        if audit_rate is not None and not 0.0 < audit_rate <= 1.0:
+            raise ValueError(f"audit_rate must be in (0, 1] (or None), got {audit_rate}")
         reason = _bankable_error(template)
         if reason is not None:
             raise MetricsUserError(
@@ -214,6 +227,9 @@ class MetricBank:
         # (what a crash-recovery would restore; also the compaction source)
         self._durable_counts: Dict[Hashable, int] = {}
         self._durable_health: Dict[Hashable, Optional[List[int]]] = {}
+        # last attested per-leaf state digests (the journal record's "digest"
+        # field) — what the blob MUST decode back to at re-admit/recover
+        self._durable_digest: Dict[Hashable, Optional[Dict[str, str]]] = {}
         # per-session generation: minted at fresh admit/import/recover, popped
         # at drop/export. An async-staged checkpoint seals only if the session
         # it gathered is STILL the live one — update counts restart at 0 on
@@ -256,6 +272,20 @@ class MetricBank:
         # slow/flaky fault (METRICS_TPU_FAULTS via the fleet worker) is
         # visible through exactly the signals a real gray failure produces
         self.fault_injector: Optional[Any] = None
+        # SDC hook: called (batch tenants) at the very END of every applied
+        # flush — after the cadence checkpoint sealed clean state, before the
+        # audit's post-capture — so an injected 'bitflip' corrupts the
+        # device-resident state exactly where real SDC lands: between
+        # attestation points, visible only to the shadow audit
+        self.state_fault_injector: Optional[Any] = None
+        # shadow-replay audit plane (resilience/integrity.py)
+        self.audit_rate = audit_rate
+        self._audit_period = (
+            None if audit_rate is None else max(1, int(round(1.0 / audit_rate)))
+        )
+        self._flush_index = 0
+        self._audit_cursor = 0  # rotates the audited tenant across samples
+        self._pending_audits: List[Any] = []
         self.stats: Dict[str, int] = {
             "admits": 0,
             "readmits": 0,
@@ -273,6 +303,8 @@ class MetricBank:
             "journal_appends": 0,
             "flush_errors": 0,
             "dedup_dropped": 0,
+            "audits_sampled": 0,
+            "repairs": 0,
         }
         with _REGISTRY_LOCK:
             _BANKS.add(self)
@@ -357,6 +389,9 @@ class MetricBank:
                 self._store.put(self._blob_key(tenant), self._defaults_sealed())
                 self._durable_counts[tenant] = 0
                 self._durable_health[tenant] = None
+                # fresh sessions carry no journal-level digest yet (the
+                # defaults blob's payload header is still attested)
+                self._durable_digest[tenant] = None
                 self._gen[tenant] = self._gen_next
                 self._gen_next += 1
                 writes[slot] = self._defaults
@@ -423,6 +458,7 @@ class MetricBank:
                 self._store.delete(self._blob_key(tenant))
                 self._durable_counts.pop(tenant, None)
                 self._durable_health.pop(tenant, None)
+                self._durable_digest.pop(tenant, None)
                 self._gen.pop(tenant, None)
             self._free.append(slot)
             self.stats["evictions"] += 1
@@ -446,6 +482,7 @@ class MetricBank:
         self._unindex_spilled(tenant)
         self._durable_counts.pop(tenant, None)
         self._durable_health.pop(tenant, None)
+        self._durable_digest.pop(tenant, None)
         self._gen.pop(tenant, None)
         self._maybe_compact_journal()
 
@@ -514,9 +551,21 @@ class MetricBank:
         payload = self._seal_tree(tree)
         self._store.put(self._blob_key(tenant), payload)
         health = self._health_list(tree)
+        # ATTESTATION: the per-leaf digests of exactly the host tree this
+        # durable write seals (computed from the checkpoint path's one
+        # coalesced fetch — no extra device traffic). Recorded in the journal
+        # record, independent of the blob, so a swapped/stale/corrupt blob
+        # cannot satisfy its own embedded digests and still pass re-admit.
+        digest = _integrity.state_digest(tree)
         entry: Optional[Tuple[str, Hashable, bytes]] = None
         record = _spill.seal_record(
-            {"op": op, "t": _spill.durable_token(tenant), "count": int(count), "health": health}
+            {
+                "op": op,
+                "t": _spill.durable_token(tenant),
+                "count": int(count),
+                "health": health,
+                "digest": digest,
+            }
         )
         if defer_journal:
             entry = (op, tenant, record)
@@ -524,6 +573,7 @@ class MetricBank:
             self._journal_many([(op, tenant, record)])
         self._durable_counts[tenant] = int(count)
         self._durable_health[tenant] = health
+        self._durable_digest[tenant] = digest
         _spill.bump("spill_writes")
         _spill.bump("spill_bytes", len(payload))
         if _bus.enabled():
@@ -566,6 +616,8 @@ class MetricBank:
                         "t": _spill.durable_token(tenant),
                         "count": int(self._durable_counts.get(tenant, 0)),
                         "health": self._durable_health.get(tenant),
+                        # compaction must not shed the attestations
+                        "digest": self._durable_digest.get(tenant),
                     }
                 )
             )
@@ -762,6 +814,9 @@ class MetricBank:
                 bank._durable_health[tenant] = (
                     [int(x) for x in health] if health is not None else None
                 )
+                # the journal's attestation survives recovery: re-admission
+                # verifies the blob decodes to exactly these digests
+                bank._durable_digest[tenant] = rec.get("digest")
                 bank._gen[tenant] = bank._gen_next
                 bank._gen_next += 1
                 bank._index_spilled(tenant)
@@ -772,6 +827,7 @@ class MetricBank:
                             "t": _spill.durable_token(tenant),
                             "count": bank._durable_counts[tenant],
                             "health": bank._durable_health[tenant],
+                            "digest": bank._durable_digest[tenant],
                         }
                     )
                 )
@@ -883,6 +939,105 @@ class MetricBank:
             if admit:
                 self.admit(tenant)
 
+    # ------------------------------------------------------------------
+    # state-integrity plane: shadow-replay audit + journal-replay repair
+    # ------------------------------------------------------------------
+    def _capture_audit(
+        self,
+        requests: List[Tuple[Hashable, Tuple[Any, ...]]],
+        audit: Tuple[Hashable, int, Dict[str, Array], int],
+    ) -> None:
+        """Finish a sampled audit capture: snapshot the audited tenant's POST
+        state (fresh device arrays — donation-safe) and hand both captures to
+        an :class:`~metrics_tpu.engine.driver.AsyncResult` so the D2H copies
+        overlap serving; the auditor resolves them off the hot path."""
+        from metrics_tpu.engine.driver import AsyncResult
+
+        tenant, count_before, pre, flush_index = audit
+        post = self._read_slot(self._slots[tenant])
+        # apply_batch enforces one request per tenant per batch, but the
+        # auditor replays a list so the contract lives in one place
+        args_list = [args for t, args in requests if t == tenant]
+        capture = AsyncResult(
+            {"pre": pre, "post": post}, source=f"bank:{self.name}:audit"
+        )
+        entry = _integrity.AuditEntry(
+            tenant=tenant,
+            args_list=args_list,
+            count_before=count_before,
+            capture=capture,
+            flush_index=flush_index,
+        )
+        if len(self._pending_audits) >= 64:
+            # an auditor that stopped polling must not pin device memory
+            self._pending_audits.pop(0)
+            _integrity.bump("audits_dropped")
+        self._pending_audits.append(entry)
+        self.stats["audits_sampled"] += 1
+        _integrity.bump("audits_sampled")
+        # replay-neutral journal record: a durable trace of WHICH flushes
+        # were audited, so a post-hoc investigation can bound the window a
+        # corruption could have slipped through unsampled
+        self._journal(
+            "audit", tenant, count=int(self._counts[tenant]), flush=int(flush_index)
+        )
+
+    def take_audits(self) -> List[Any]:
+        """Drain the pending audit captures (oldest first). The caller — an
+        :class:`~metrics_tpu.resilience.integrity.IntegrityAuditor` — resolves
+        and replays them OFF the serving lock."""
+        with self._lock:
+            out = list(self._pending_audits)
+            self._pending_audits.clear()
+        return out
+
+    def repair_tenant(self, tenant: Hashable) -> int:
+        """Quarantine ``tenant``'s device state and rebuild it from its last
+        attested durable blob; returns the restored update count.
+
+        The corrupted resident state is dropped WITHOUT spilling — spilling
+        would seal the corruption into the durable tier as truth. Re-admission
+        decodes the last checkpointed blob through BOTH attestation layers
+        (payload-embedded digests and the journal's independent seal), so the
+        rebuilt state is bit-identical to the last acked durable prefix.
+        Updates applied since that checkpoint are lost — the same bounded
+        window a crash-recovery replay re-serves, set by the checkpoint
+        cadence. Emits a ``repair`` bus event."""
+        with self._lock:
+            self._check_poisoned()
+            resident = tenant in self._slots
+            if not resident and tenant not in self._spilled:
+                raise KeyError(
+                    f"tenant {tenant!r} is not served by bank {self.name!r}"
+                )
+            if tenant not in self._durable_counts and tenant not in self._spilled:
+                raise StateIntegrityError(
+                    f"cannot repair tenant {tenant!r} on bank {self.name!r}:"
+                    " no durable checkpoint exists to rebuild from",
+                    bank=self.name,
+                    tenant=tenant,
+                )
+            if resident:
+                slot = self._slots.pop(tenant)
+                self._counts.pop(tenant)
+                self._lru.pop(tenant, None)
+                self._dirty.pop(tenant, None)
+                self._free.append(slot)
+                self._index_spilled(tenant)
+            self.admit(tenant)
+            restored = int(self._counts[tenant])
+            self.stats["repairs"] += 1
+            _integrity.bump("repairs")
+            if _bus.enabled():
+                _bus.emit(
+                    "repair",
+                    source=type(self._template).__name__,
+                    bank=self.name,
+                    tenant=str(tenant),
+                    count=restored,
+                )
+            return restored
+
     # -- slot <-> state plumbing ----------------------------------------
     def _read_slot(self, slot: int) -> Dict[str, Array]:
         return {n: leaf[slot] for n, leaf in self._bank.items()}
@@ -919,6 +1074,16 @@ class MetricBank:
         _spill.bump("blob_reads")
         tree = _spill.decode_tenant_payload(
             payload, context=f" (bank {self.name!r}, tenant {tenant!r})"
+        )
+        # second seal: the journal-recorded digests are independent of the
+        # digests embedded in the blob itself, so a stale-but-self-consistent
+        # (or swapped) blob is caught here even though its own header verifies
+        _integrity.verify_tree(
+            tree,
+            self._durable_digest.get(tenant),
+            bank=self.name,
+            tenant=tenant,
+            context=f" (bank {self.name!r}, tenant {tenant!r}, journal attestation)",
         )
         tpl = self._template
         saved, saved_count = tpl._snapshot_state(), tpl._update_count
@@ -1055,6 +1220,23 @@ class MetricBank:
         stats = _cache.instance_stats(self._template)
         slots = self._admit_many(tenants)
 
+        # shadow-replay audit: sample every Nth flush, rotate the audited
+        # tenant, and capture its PRE state before dispatch touches it — the
+        # post state is captured at the very END of the flush (after the
+        # fault seam), so a same-flush corruption is already in evidence
+        audit: Optional[Tuple[Hashable, int, Dict[str, Array], int]] = None
+        if self._audit_period is not None:
+            self._flush_index += 1
+            if self._flush_index % self._audit_period == 0:
+                pick = tenants[self._audit_cursor % len(tenants)]
+                self._audit_cursor += 1
+                audit = (
+                    pick,
+                    int(self._counts[pick]),
+                    self._read_slot(self._slots[pick]),
+                    self._flush_index,
+                )
+
         n_req = len(requests)
         dense = n_req >= self.dense_threshold * self.capacity
         # a trace binds tracer states onto the template (the traced body is
@@ -1091,6 +1273,13 @@ class MetricBank:
             if self._flushes_since_ckpt >= self._ckpt_every:
                 self._flushes_since_ckpt = 0
                 self._checkpoint_locked(list(self._dirty))
+        # the SDC seam sits AFTER the cadence checkpoint: an injected bitflip
+        # lands on device state that was already attested clean, exactly like
+        # real silent corruption striking between durability boundaries
+        if self.state_fault_injector is not None:
+            self.state_fault_injector(list(tenants))
+        if audit is not None:
+            self._capture_audit(requests, audit)
         ms = (time.perf_counter() - t_start) * 1000.0
         self._last_flush_ms = ms
         self._flush_ms_ewma = (

@@ -84,6 +84,7 @@ def _new_stats() -> Dict[str, int]:
         "snapshots": 0,
         "snapshot_bytes": 0,
         "resumes": 0,
+        "torn_tails_truncated": 0,
     }
 
 
@@ -309,6 +310,13 @@ class MemoryStore(SpillStore):
         with self._lock:
             return list(self._journals.get(journal, ()))
 
+    def journal_scan(self, journal: str) -> Tuple[List[bytes], int]:
+        # in-memory appends cannot tear, but the one-pass contract still
+        # holds: one lock acquisition, one read of the frame list — never the
+        # protocol default's two passes (frames, then a separate tail probe)
+        with self._lock:
+            return list(self._journals.get(journal, ())), 0
+
     def rewrite_journal(self, journal: str, records: List[bytes]) -> None:
         with self._lock:
             self._journals[journal] = [bytes(r) for r in records]
@@ -449,6 +457,10 @@ class DiskStore(SpillStore):
             return
         _frames, valid = self._scan_frames(data)
         if valid < len(data):
+            # visible in durability_stats()/metrics_tpu_durable_* — an
+            # operator must be able to see that a crash tore a journal
+            # without reading the store's bytes
+            bump("torn_tails_truncated")
             with open(path, "r+b") as f:
                 f.truncate(valid)
                 if self.fsync:
@@ -506,15 +518,18 @@ def replay_journal(store: SpillStore, bank_name: str) -> Tuple[Dict[Hashable, Di
         except (SyncIntegrityError, TypeError, ValueError):
             continue
         if op == "admit":
-            live.setdefault(tenant, {"count": 0, "health": None})
+            live.setdefault(tenant, {"count": 0, "health": None, "digest": None})
         elif op in ("spill", "checkpoint", "import"):
             live[tenant] = {
                 "count": int(rec.get("count", 0)),
                 "health": rec.get("health"),
+                # the attestation the blob must decode back to — the
+                # journal's independent seal over the blob's content
+                "digest": rec.get("digest"),
             }
         elif op in ("drop", "export"):
             live.pop(tenant, None)
-        # other ops ("recover", future kinds): replay-neutral
+        # other ops ("recover", "audit", future kinds): replay-neutral
     return live, torn
 
 
@@ -581,9 +596,19 @@ def encode_tenant_payload(
     receiver reconstructs the tree from the payload alone, so sender and
     receiver never need to agree on a treedef out of band (the checkpoint
     validator still enforces the template contract at admission).
+
+    Every exactly-coded leaf is additionally *attested*: its 64-bit state
+    digest (``resilience.integrity.leaf_digest``) rides the header's
+    ``digest`` map and is re-verified by :func:`decode_tenant_payload` —
+    catching content that went wrong upstream of this sealing (the corruption
+    shape the crc cannot see). Quantized leaves are lossy and carry no
+    digest; payloads sealed before the integrity plane decode unchanged.
     """
+    from metrics_tpu.resilience import integrity as _integrity
+
     keys = sorted(tree)
     blocks: List[bytes] = []
+    digests: Dict[str, str] = {}
     for key in keys:
         value = tree[key]
         if isinstance(value, dict):
@@ -594,8 +619,13 @@ def encode_tenant_payload(
                 " file instead."
             )
         tag = (precisions or {}).get(key)
-        blocks.append(_groups._encode(np.asarray(value), tag, stats=stats))
-    header = json.dumps({"v": _PAYLOAD_VERSION, "keys": keys}).encode()
+        block, codec = _groups._encode_with_codec(np.asarray(value), tag, stats=stats)
+        blocks.append(block)
+        if codec == "exact":
+            digests[key] = _integrity.leaf_digest(value)
+    if digests:
+        _integrity.bump("attests_recorded")
+    header = json.dumps({"v": _PAYLOAD_VERSION, "keys": keys, "digest": digests}).encode()
     body = struct.pack(">I", len(header)) + header
     body += b"".join(struct.pack(">Q", len(b)) + b for b in blocks)
     return _groups.pack_envelope(body)
@@ -604,7 +634,13 @@ def encode_tenant_payload(
 def decode_tenant_payload(payload: bytes, context: str = "") -> Dict[str, Any]:
     """Inverse of :func:`encode_tenant_payload`; every leaf re-verifies its
     own wire envelope, so corruption anywhere in the payload raises
-    :class:`SyncIntegrityError` naming the migration context."""
+    :class:`SyncIntegrityError` naming the migration context — and every
+    attested leaf re-verifies its sealed state digest, so content-level
+    corruption (valid crcs, wrong bytes) raises
+    :class:`~metrics_tpu.utils.exceptions.StateIntegrityError` naming the
+    leaf. This one decode path is the verification point for every boundary
+    that rides the codec: LRU re-admit, ``MetricBank.recover``, migration
+    import, and ``drive(resume_from=)``."""
     _version, body = _groups.unpack_envelope(payload, context)
     if len(body) < 4:
         raise SyncIntegrityError(f"Truncated migration payload: no header length{context}.")
@@ -640,4 +676,9 @@ def decode_tenant_payload(payload: bytes, context: str = "") -> Dict[str, Any]:
             )
         tree[key] = _groups._decode(body[offset : offset + size], context)
         offset += size
+    expected = header.get("digest")
+    if expected:
+        from metrics_tpu.resilience import integrity as _integrity
+
+        _integrity.verify_tree(tree, expected, context=context)
     return tree

@@ -42,6 +42,47 @@ class SyncIntegrityError(SyncError):
         self.transient = transient
 
 
+class StateIntegrityError(RuntimeError):
+    """Device-resident (or durably stored) metric state failed attestation.
+
+    Raised by the state-integrity plane (``resilience/integrity.py``) when a
+    decoded state tree does not match the digest sealed alongside it — at a
+    durability boundary (journal checkpoint re-admit, ``MetricBank.recover``),
+    a migration import, a drive-snapshot resume, or when the shadow-replay
+    auditor finds the resident tenant slice diverging from a fault-free solo
+    replay. Unlike :class:`SyncIntegrityError` (bytes mangled *on the wire*,
+    often a torn read worth retrying), a state-digest mismatch means the
+    *content* is wrong — retrying the read returns the same corrupt state —
+    so this is its own non-transient family. Carries ``bank``/``tenant``/
+    ``leaf`` so operators can localize the corruption without a debugger.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        bank: object = None,
+        tenant: object = None,
+        leaf: object = None,
+    ) -> None:
+        super().__init__(message)
+        self.bank = bank
+        self.tenant = tenant
+        self.leaf = leaf
+
+
+class InjectedFaultError(ConnectionError):
+    """An artificial failure injected by the fault plan (``METRICS_TPU_FAULTS``).
+
+    Subclasses ``ConnectionError`` so the sync stack's retryable-error
+    classification treats an injected fault exactly like a real transport
+    failure — the resilience machinery under test cannot tell them apart.
+    The message carries the fault kind and site. Exported from the package
+    root so chaos tests catch injected faults without deep-importing
+    ``metrics_tpu.resilience.faults``.
+    """
+
+
 class NumericalHealthError(RuntimeError):
     """A numerical-health policy violation surfaced by the screening layer.
 

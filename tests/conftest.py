@@ -74,6 +74,11 @@ def pytest_configure(config):
         "slow: multi-process / long-haul test; deselected by the ROADMAP tier-1"
         " verify command (-m 'not slow') — ci.sh's thorough lanes still run it",
     )
+    config.addinivalue_line(
+        "markers",
+        "integrity: state-integrity plane (attestation digests, shadow-replay"
+        " audit, bitflip injection, quarantine repair); select with -m integrity",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

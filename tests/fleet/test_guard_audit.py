@@ -1,0 +1,142 @@
+"""FleetGuard x integrity plane (ISSUE 17): audit failures are a scored
+health signal — a worker whose state silently corrupts (bitflip fault plan)
+walks probation -> ejected on "integrity" breaches, and its tenants recover
+onto survivors from the durable store, bit-identical to a fault-free solo
+replay."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, engine
+from metrics_tpu import fleet as flt
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.resilience import faults, integrity
+from metrics_tpu.serving import MemoryStore
+
+NUM_CLASSES = 4
+
+pytestmark = pytest.mark.integrity
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    engine.clear_cache()
+    _bus.clear()
+    integrity.reset_integrity_stats()
+    yield
+    engine.clear_cache()
+    _bus.disable()
+    _bus.clear()
+
+
+def _traffic(step, i):
+    rng = np.random.RandomState(1000 * step + i)
+    return (
+        jnp.asarray(rng.rand(8, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, size=8).astype(np.int32)),
+    )
+
+
+def _run_corrupting_fleet(steps=12):
+    """Drive a 3-worker fleet where worker 1 carries a bitflip fault plan;
+    returns (guard, fleet, applied-args-per-tenant, steps actually run)."""
+    tenants = [f"t{i}" for i in range(6)]
+    plan = faults.parse_plan('[{"kind": "bitflip", "rank": 1, "times": 8}]')
+    fleet = flt.Fleet(
+        Accuracy(num_classes=NUM_CLASSES), workers=[0, 1, 2], capacity=8,
+        fault_plan=plan, durable_store=MemoryStore(),
+        checkpoint_every_n_flushes=1, audit_rate=1.0, max_delay_s=None,
+    )
+    guard = flt.FleetGuard(
+        fleet, probation_after=1, eject_after=2, min_workers=2,
+        latency_threshold_ms=60_000.0, error_rate_threshold=0.5,
+    )
+    auditors = {
+        wid: integrity.IntegrityAuditor(w.bank)
+        for wid, w in fleet._workers.items()
+    }
+    applied = {t: [] for t in tenants}
+    for step in range(steps):
+        for i, t in enumerate(tenants):
+            args = _traffic(step, i)
+            applied[t].append(args)
+            guard.submit(t, *args)
+        for w in fleet._workers.values():
+            if w.router is not None:
+                w.router.flush()
+        for wid, auditor in auditors.items():
+            if fleet._workers[wid].bank is not None:
+                auditor.poll()
+        states = guard.observe()
+        if states.get(1) == "ejected":
+            return guard, fleet, applied, step + 1
+    return guard, fleet, applied, steps
+
+
+def test_corrupting_worker_walks_to_ejected():
+    guard, fleet, _, steps = _run_corrupting_fleet()
+    summary = guard.summary()
+    assert summary["workers"]["1"]["state"] == "ejected"
+    assert steps <= 12
+    # the signal that drove it there was integrity, not latency or errors
+    rec = summary["workers"]["1"]
+    assert rec["audit_failures"] >= 1
+    assert "integrity" in rec.get("last_reasons", ["integrity"]) or rec["audit_failures"]
+    # healthy workers stayed healthy — the signal localizes
+    for wid in ("0", "2"):
+        assert summary["workers"][wid]["state"] == "healthy"
+        assert summary["workers"][wid]["audit_failures"] == 0
+
+
+def test_guard_summary_aggregates_audit_failures():
+    guard, _, _, _ = _run_corrupting_fleet()
+    summary = guard.summary()
+    total = sum(r["audit_failures"] for r in summary["workers"].values())
+    assert summary["audit_failures"] == total >= 1
+
+
+def test_ejected_workers_tenants_recover_bit_identical():
+    _, fleet, applied, _ = _run_corrupting_fleet()
+    checked = 0
+    for t, args_list in applied.items():
+        bank_t = None
+        for w in fleet._workers.values():
+            if w.bank is not None and (
+                t in w.bank.tenants or t in w.bank.spilled_tenants
+            ):
+                bank_t = w.bank
+                break
+        assert bank_t is not None, f"tenant {t} unserved after ejection"
+        checked += 1
+        solo = Accuracy(num_classes=NUM_CLASSES)
+        for args in args_list[: bank_t.update_count(t)]:
+            solo.update(*args)
+        state = bank_t.tenant_state(t)
+        for name, value in solo._snapshot_state().items():
+            np.testing.assert_array_equal(
+                np.asarray(value), np.asarray(state[name]), err_msg=f"{t}/{name}"
+            )
+    assert checked == len(applied)
+
+
+def test_audit_events_score_only_failures():
+    # a clean fleet under full-rate audit accrues samples but zero
+    # audit_failures — the guard never scores passing audits as breaches
+    fleet = flt.Fleet(
+        Accuracy(num_classes=NUM_CLASSES), workers=[0, 1], capacity=8,
+        durable_store=MemoryStore(), checkpoint_every_n_flushes=1,
+        audit_rate=1.0, max_delay_s=None,
+    )
+    guard = flt.FleetGuard(fleet, latency_threshold_ms=60_000.0)
+    auditors = [integrity.IntegrityAuditor(w.bank) for w in fleet._workers.values()]
+    for step in range(4):
+        for i in range(4):
+            guard.submit(f"t{i}", *_traffic(step, i))
+        for w in fleet._workers.values():
+            w.router.flush()
+        for auditor in auditors:
+            auditor.poll()
+        states = guard.observe()
+    assert all(s == "healthy" for s in states.values())
+    assert guard.summary()["audit_failures"] == 0

@@ -301,6 +301,7 @@ def test_jsonl_roundtrip_and_prometheus_render():
         "encoders",
         "fleet",
         "durability",
+        "integrity",
         "guard",
         "kernels",
         "bus",

@@ -1,0 +1,85 @@
+"""Adversarial single-bit fuzz over the sync wire layer (ISSUE 17,
+satellite): every single-bit flip of a sealed payload — envelope header or
+body, exact v1 or quantized v2 — must surface as a loud
+:class:`SyncIntegrityError` at ``unpack_envelope``/``_decode``. No flip may
+decode silently; no flip may escape as a different exception type."""
+import numpy as np
+import pytest
+
+from metrics_tpu.parallel import groups
+from metrics_tpu.utils.exceptions import SyncIntegrityError
+
+pytestmark = pytest.mark.integrity
+
+_HEADER_BITS = groups._ENVELOPE.size * 8  # 7-byte ">2sBI" envelope
+_BODY_SAMPLES = 96  # seeded, bounded — not exhaustive over multi-KB bodies
+
+
+def _flip(payload: bytes, bit: int) -> bytes:
+    raw = bytearray(payload)
+    raw[bit // 8] ^= 1 << (bit % 8)
+    return bytes(raw)
+
+
+def _fuzz_bits(payload: bytes, seed: int):
+    """Every envelope-header bit exhaustively, plus a seeded sample of body
+    bits (always including the first and last body bit)."""
+    nbits = len(payload) * 8
+    bits = list(range(min(_HEADER_BITS, nbits)))
+    body_bits = range(_HEADER_BITS, nbits)
+    if body_bits:
+        rng = np.random.RandomState(seed)
+        picks = rng.choice(len(body_bits), size=min(_BODY_SAMPLES, len(body_bits)), replace=False)
+        bits.extend(sorted({_HEADER_BITS, nbits - 1, *(int(p) + _HEADER_BITS for p in picks)}))
+    return bits
+
+
+def _assert_every_flip_loud(payload: bytes, decode, seed: int):
+    decode(payload)  # the unflipped payload must decode — no false positives
+    for bit in _fuzz_bits(payload, seed):
+        try:
+            decode(_flip(payload, bit))
+        except SyncIntegrityError:
+            continue
+        pytest.fail(f"bit {bit} of {len(payload) * 8} decoded silently")
+
+
+def test_pack_envelope_raw_body_every_flip_detected():
+    payload = groups.pack_envelope(np.random.RandomState(0).bytes(257))
+    _assert_every_flip_loud(payload, lambda p: groups.unpack_envelope(p), seed=1)
+
+
+def test_exact_v1_payload_every_flip_detected():
+    arr = np.random.RandomState(2).rand(17, 3).astype(np.float32)
+    payload, codec = groups._encode_with_codec(arr)
+    assert codec == "exact"
+    version, _ = groups.unpack_envelope(payload)
+    assert version == groups.WIRE_VERSION
+    _assert_every_flip_loud(payload, lambda p: groups._decode(p), seed=3)
+
+
+def test_quantized_v2_payload_every_flip_detected():
+    # int8 per-block quantized leaves seal as wire v2: header carries codec +
+    # block metadata, body carries scales + codes — all under the same crc
+    arr = np.random.RandomState(4).rand(130).astype(np.float32)
+    payload, codec = groups._encode_with_codec(arr, precision="int8")
+    assert codec == "int8"
+    version, _ = groups.unpack_envelope(payload)
+    assert version == groups.WIRE_VERSION_QUANTIZED
+    _assert_every_flip_loud(payload, lambda p: groups._decode(p), seed=5)
+
+
+def test_zero_dim_payload_every_flip_detected():
+    # 0-d leaves (metric counters) produce the smallest real payloads; the
+    # header dominates, so exhaustive coverage is total here
+    payload, codec = groups._encode_with_codec(np.asarray(3.0, np.float32))
+    assert codec == "exact"
+    _assert_every_flip_loud(payload, lambda p: groups._decode(p), seed=6)
+
+
+def test_version_field_flips_never_alias_a_supported_version():
+    # no single-bit flip of one supported version yields another supported
+    # version — a skewed peer can never masquerade via one flipped bit
+    for v in groups.SUPPORTED_WIRE_VERSIONS:
+        for bit in range(8):
+            assert (v ^ (1 << bit)) not in groups.SUPPORTED_WIRE_VERSIONS
