@@ -3369,6 +3369,227 @@ def bench_integrity() -> dict:
     }
 
 
+def bench_rolling_upgrade() -> dict:
+    """Version-skew survival acceptance scenario (``ci.sh --upgrade-smoke``
+    gates every boolean below):
+
+    * a 4-worker fleet is rolling-upgraded MID-TRAFFIC — one worker at a
+      time, canary first under FleetGuard probation with the shadow-replay
+      audit forced to every flush — and every tenant lands bit-identical to
+      a static fleet fed the same stream: zero acked requests lost;
+    * a new build that corrupts state (``bitflip`` riding only the
+      factory-built workers) breaches the canary audit and the fleet
+      AUTO-ROLLS-BACK to the old build — membership restored, no corruption
+      seam left behind, still bit-identical to a fault-free solo replay;
+    * a mixed-version sync group (one peer speaking only wire v1)
+      negotiates down to exact encoding, bit-identical to an all-v1 group;
+    * every sealed golden artifact (``tests/compat/golden``) decodes
+      through the durable-schema registry — shipped versions upcast clean,
+      deliberately-future versions keep raising the named downgrade guard.
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, SchemaVersionError
+    from metrics_tpu.fleet import Fleet, FleetGuard
+    from metrics_tpu.parallel import new_group
+    from metrics_tpu.parallel.groups import (
+        WIRE_VERSION,
+        gather_group_arrays,
+        negotiation_stats,
+        reset_negotiation_stats,
+        speaking,
+    )
+    from metrics_tpu.resilience import RetryPolicy, faults, run_as_peers, schema
+    from metrics_tpu.serving import MemoryStore, MetricBank
+
+    small = bool(os.environ.get("METRICS_TPU_BENCH_SMALL"))
+    n_cls, batch = 4, 8
+    tenants = [f"t{i}" for i in range(8)]
+
+    def _traffic(step, i):
+        rng = np.random.RandomState(1000 * step + i)
+        return (
+            jnp.asarray(rng.rand(batch, n_cls).astype(np.float32)),
+            jnp.asarray(rng.randint(0, n_cls, size=batch).astype(np.int32)),
+        )
+
+    def _make_fleet():
+        return Fleet(
+            Accuracy(num_classes=n_cls), workers=[0, 1, 2, 3], capacity=8,
+            durable_store=MemoryStore(), checkpoint_every_n_flushes=1,
+            max_delay_s=None, fault_plan=faults.parse_plan("[]"),
+        )
+
+    def _make_guard(fleet):
+        return FleetGuard(
+            fleet, probation_after=1, eject_after=2, min_workers=2,
+            latency_threshold_ms=60_000.0, error_rate_threshold=0.5,
+        )
+
+    def _pump(fleet, box):
+        step = box[0]
+        box[0] += 1
+        for i, t in enumerate(tenants):
+            fleet.submit(t, *_traffic(step, i))
+        fleet.flush()
+
+    def _solo_values(n_steps, name):
+        solo = MetricBank(Accuracy(num_classes=n_cls), 8, name=name)
+        for t in tenants:
+            solo.admit(t)
+        for step in range(n_steps):
+            for i, t in enumerate(tenants):
+                solo.update(t, *_traffic(step, i))
+        return {t: np.asarray(solo.compute(t)) for t in tenants}
+
+    # -- 1) rolling upgrade mid-traffic: bit-identical to a static twin ----
+    warm_steps = 2 if small else 3
+    fleet, static = _make_fleet(), _make_fleet()
+    steps, static_steps = [0], [0]
+    for _ in range(warm_steps):
+        _pump(fleet, steps)
+        _pump(static, static_steps)
+    guard = _make_guard(fleet)
+    t0 = time.perf_counter()
+    try:
+        up_report = fleet.rolling_upgrade(
+            lambda wid, f: f.build_worker(wid), guard=guard,
+            canary_steps=3 if small else 4,
+            on_step=lambda f: _pump(f, steps),
+        )
+    finally:
+        guard.close()
+    upgrade_s = time.perf_counter() - t0
+    while static_steps[0] < steps[0]:
+        _pump(static, static_steps)
+    upgraded_vals, static_vals = fleet.compute_all(), static.compute_all()
+    upgrade_bit_identical = all(
+        np.asarray(upgraded_vals[t]).tobytes() == np.asarray(static_vals[t]).tobytes()
+        for t in tenants
+    )
+    # zero lost acked requests: every submitted-and-acked update is counted
+    # in exactly one surviving bank after the full rollout
+    acked_requests = steps[0] * len(tenants)
+    applied_requests = 0
+    for t in tenants:
+        for w in fleet._workers.values():
+            if w.bank is not None and (
+                t in w.bank.tenants or t in w.bank.spilled_tenants
+            ):
+                applied_requests += w.bank.update_count(t)
+                break
+
+    # -- 2) corrupting new build: canary breach -> automatic rollback ------
+    bad_plan = faults.parse_plan('[{"kind": "bitflip", "rank": 0, "times": 8}]')
+    fleet2 = _make_fleet()
+    steps2 = [0]
+    for _ in range(warm_steps):
+        _pump(fleet2, steps2)
+    guard2 = _make_guard(fleet2)
+    try:
+        rb_report = fleet2.rolling_upgrade(
+            lambda wid, f: f.build_worker(wid, fault_plan=bad_plan),
+            guard=guard2, canary_steps=6,
+            on_step=lambda f: _pump(f, steps2),
+        )
+    finally:
+        guard2.close()
+    breach = list(rb_report["breach"] or ())
+    membership_restored = sorted(fleet2.epoch.workers) == [0, 1, 2, 3]
+    seam_removed = fleet2._workers[0].bank.state_fault_injector is None
+    want = _solo_values(steps2[0], "upg-solo")
+    got = fleet2.compute_all()
+    rollback_bit_identical = all(
+        np.asarray(got[t]).tobytes() == want[t].tobytes() for t in tenants
+    )
+
+    # -- 3) mixed-version sync: negotiate down, bit-identical to all-v1 ----
+    reset_negotiation_stats()
+    retry = RetryPolicy(max_attempts=4, backoff_base_s=0.01, backoff_max_s=0.05)
+
+    def _wire_payload(rank):
+        # not bf16-representable exactly: bit-identity PROVES the fallback
+        return (np.arange(8, dtype=np.float32) + 100.0 * rank) / 7.0
+
+    def _gather(rank, group, old_ranks):
+        if rank in old_ranks:
+            with speaking(WIRE_VERSION):
+                return gather_group_arrays(_wire_payload(rank), group, precision="bf16")
+        return gather_group_arrays(_wire_payload(rank), group, precision="bf16")
+
+    mixed_group = new_group(range(3), name="upg-mixed", timeout_s=15.0, retry=retry)
+    mixed = run_as_peers(3, lambda r: _gather(r, mixed_group, (2,)))
+    v1_group = new_group(range(3), name="upg-allv1", timeout_s=15.0, retry=retry)
+    all_v1 = run_as_peers(3, lambda r: _gather(r, v1_group, (0, 1, 2)))
+    mixed_sync_bit_identical = all(
+        np.asarray(mixed[r][p]).tobytes() == np.asarray(all_v1[r][p]).tobytes()
+        and np.asarray(mixed[r][p]).tobytes() == _wire_payload(p).tobytes()
+        for r in range(3)
+        for p in range(3)
+    )
+    neg = negotiation_stats()
+
+    # -- 4) golden corpus: every sealed artifact decodes (or rejects) ------
+    golden_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests", "compat", "golden"
+    )
+    with open(os.path.join(golden_dir, "index.json")) as fh:
+        index = json.load(fh)["artifacts"]
+
+    def _load_artifact(entry):
+        with open(os.path.join(golden_dir, entry["file"]), "rb") as fh:
+            raw = fh.read()
+        return json.loads(raw.decode("utf-8")) if entry["file"].endswith(".json") else raw
+
+    golden_decoded = golden_rejected = golden_failures = 0
+    for entry in index:
+        try:
+            schema.decode_any(entry["family"], _load_artifact(entry), context=" (golden)")
+            outcome = "ok"
+        except SchemaVersionError:
+            outcome = "reject"
+        except Exception:
+            outcome = "error"
+        if outcome == entry["expect"]:
+            golden_decoded += outcome == "ok"
+            golden_rejected += outcome == "reject"
+        else:
+            golden_failures += 1
+    golden_covers_all_families = set(schema.registered_families()) <= {
+        e["family"] for e in index
+    }
+
+    return {
+        "metric": "rolling_upgrade",
+        "value": round(upgrade_s, 3),
+        "unit": "rolling_upgrade_wall_s",
+        "upgrade_bit_identical": bool(upgrade_bit_identical),
+        "workers_upgraded": len(up_report["upgraded"]),
+        "upgrade_rolled_back": bool(up_report["rolled_back"]),
+        "canary_audit_checked": int(up_report["audit"]["checked"]),
+        "canary_audit_failed": int(up_report["audit"]["failed"]),
+        "acked_requests": int(acked_requests),
+        "applied_requests": int(applied_requests),
+        "zero_lost": bool(applied_requests == acked_requests),
+        "rollback_triggered": bool(rb_report["rolled_back"]),
+        "rollback_breach": breach,
+        "rollback_integrity_breach": bool("integrity" in breach),
+        "membership_restored": bool(membership_restored),
+        "corruption_seam_removed": bool(seam_removed),
+        "rollback_bit_identical": bool(rollback_bit_identical),
+        "mixed_sync_bit_identical": bool(mixed_sync_bit_identical),
+        "wire_negotiations": int(neg["negotiations"]),
+        "wire_capped": int(neg["capped"]),
+        "wire_fallback_exact": int(neg["fallback_exact"]),
+        "golden_artifacts": len(index),
+        "golden_decoded": int(golden_decoded),
+        "golden_rejected": int(golden_rejected),
+        "golden_failures": int(golden_failures),
+        "golden_covers_all_families": bool(golden_covers_all_families),
+        "n": int(acked_requests + steps2[0] * len(tenants)),
+    }
+
+
 _CONFIGS = [
     ("bench_fid", 1500, True),
     ("bench_bertscore", 1500, True),
@@ -3392,6 +3613,7 @@ _CONFIGS = [
     ("bench_gray_failure", 900, False),
     ("bench_kernel_tier", 900, False),
     ("bench_integrity", 900, False),
+    ("bench_rolling_upgrade", 900, False),
 ]
 
 # the headline runs outside _CONFIGS (measured first, emitted last) but is
@@ -3643,6 +3865,10 @@ _SMOKE_LANES = {
     # boundaries, shadow-replay audit -> guard eject, bit-identical repair,
     # zero clean-soak false positives, <5% audit overhead at 1/64
     "--integrity-smoke": ("bench_integrity", {"small": True}),
+    # version-skew survival: rolling upgrade bit-identity vs a static twin,
+    # canary auto-rollback on an injected bitflip, mixed-version wire
+    # negotiation parity, every golden compat artifact decoding
+    "--upgrade-smoke": ("bench_rolling_upgrade", {"small": True}),
 }
 
 

@@ -462,4 +462,49 @@ if [ "$integrity_rc" -eq 3 ]; then
 fi
 [ "$integrity_rc" -eq 0 ] || exit "$integrity_rc"
 
+echo "=== version-skew smoke (rolling upgrade, canary rollback, negotiated wire, golden corpus) ==="
+# ISSUE 18 acceptance: a 4-worker fleet rolling-upgraded MID-TRAFFIC lands
+# bit-identical to a static fleet fed the same stream (zero acked requests
+# lost); a corrupting new build breaches the canary's forced shadow audit
+# and the fleet auto-rolls-back to the old build; a mixed-version sync
+# group (one peer speaking only wire v1) negotiates down to exact,
+# bit-identical to an all-v1 group; and EVERY sealed golden compat
+# artifact decodes through the durable-schema registry, with the
+# deliberately-future versions still rejected by name
+JAX_PLATFORMS=cpu python bench.py --upgrade-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "rolling_upgrade", obj
+# the rollout is invisible: bit-identity vs the static twin, all 4 workers
+# upgraded, a clean canary audited at least once without a failure
+if obj["upgrade_bit_identical"] is not True or obj["workers_upgraded"] != 4:
+    print("rolling upgrade diverged from the static fleet:", line); sys.exit(2)
+if obj["upgrade_rolled_back"] is not False:
+    print("a clean rollout rolled back spuriously:", line); sys.exit(2)
+if obj["canary_audit_checked"] < 1 or obj["canary_audit_failed"] != 0:
+    print("the clean canary was never audited (or failed audit):", line); sys.exit(2)
+# zero acked requests lost through the rollout
+if obj["zero_lost"] is not True or obj["applied_requests"] != obj["acked_requests"]:
+    print("acked requests lost during the rollout:", line); sys.exit(2)
+# a corrupting new build rolls back automatically on the integrity breach
+if obj["rollback_triggered"] is not True or obj["rollback_integrity_breach"] is not True:
+    print("the corrupting canary was never rolled back on integrity:", line); sys.exit(2)
+if obj["membership_restored"] is not True or obj["corruption_seam_removed"] is not True:
+    print("the fleet never returned whole to the old build:", line); sys.exit(2)
+if obj["rollback_bit_identical"] is not True:
+    print("state diverged through the rollback:", line); sys.exit(2)
+# mixed-version sync: negotiated down to exact, bit-identical to all-v1
+if obj["mixed_sync_bit_identical"] is not True or obj["wire_fallback_exact"] < 1:
+    print("the mixed-version group failed to negotiate down cleanly:", line); sys.exit(2)
+if obj["wire_negotiations"] < 1:
+    print("wire negotiation never ran:", line); sys.exit(2)
+# golden corpus: every shipped artifact decodes, every future rejects
+if obj["golden_failures"] != 0 or obj["golden_covers_all_families"] is not True:
+    print("a golden compat artifact broke (or a family is unpinned):", line); sys.exit(2)
+if obj["golden_decoded"] < 1 or obj["golden_rejected"] < 1:
+    print("the golden corpus is empty on one side:", line); sys.exit(2)
+print("upgrade smoke OK (%d golden artifacts):" % obj["golden_artifacts"], line)
+'
+
 echo "both lanes green"

@@ -59,6 +59,7 @@ from metrics_tpu.utils.exceptions import (  # noqa: E402,F401
     InjectedFaultError,
     NumericalHealthError,
     OverloadError,
+    SchemaVersionError,
     StateIntegrityError,
     SyncError,
     SyncIntegrityError,
@@ -250,6 +251,7 @@ __all__ = [
     "InjectedFaultError",
     "NumericalHealthError",
     "OverloadError",
+    "SchemaVersionError",
     "StateIntegrityError",
     "SyncIntegrityError",
     "SyncTimeoutError",

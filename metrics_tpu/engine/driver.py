@@ -306,10 +306,21 @@ def _seal_snapshot(
 
 
 def _unseal_snapshot(payload: bytes, context: str = "") -> DriveSnapshot:
+    """Decode a drive snapshot through the durable-schema registry: a
+    snapshot sealed by a newer build raises
+    :class:`~metrics_tpu.utils.exceptions.SchemaVersionError` (downgrade
+    guard) instead of a version mystery."""
+    from metrics_tpu.resilience import schema as _schema
+
+    return _schema.decode_any("snapshot", payload, context=context)
+
+
+def _snapshot_meta(payload: bytes, context: str) -> Tuple[Dict[str, Any], bytes]:
+    """Envelope + meta parse shared by every snapshot schema version (and
+    the registry's version prober)."""
     import json
     import struct
 
-    from metrics_tpu.serving import store as _payload
     from metrics_tpu.parallel import groups as _groups
     from metrics_tpu.utils.exceptions import SyncIntegrityError
 
@@ -321,13 +332,20 @@ def _unseal_snapshot(payload: bytes, context: str = "") -> DriveSnapshot:
         meta = json.loads(body[4 : 4 + meta_len].decode("utf-8"))
     except (ValueError, UnicodeDecodeError) as err:
         raise SyncIntegrityError(f"Unparseable drive-snapshot meta{context}: {err}") from err
-    if meta.get("v") != _SNAPSHOT_VERSION:
-        raise SyncIntegrityError(
-            f"Drive snapshot version {meta.get('v')!r} unsupported{context};"
-            f" this build speaks v{_SNAPSHOT_VERSION}.",
-            transient=False,
-        )
-    flat = _payload.decode_tenant_payload(body[4 + meta_len :], context)
+    if not isinstance(meta, dict):
+        raise SyncIntegrityError(f"Drive-snapshot meta is not an object{context}.")
+    return meta, body[4 + meta_len :]
+
+
+def _snapshot_version_of(payload: bytes) -> Any:
+    return _snapshot_meta(payload, "")[0].get("v")
+
+
+def _decode_snapshot_v1(payload: bytes, context: str) -> DriveSnapshot:
+    from metrics_tpu.serving import store as _payload
+
+    meta, inner = _snapshot_meta(payload, context)
+    flat = _payload.decode_tenant_payload(inner, context)
     states: Dict[str, Dict[str, Any]] = {}
     for flat_key, value in flat.items():
         member_key, _, name = flat_key.partition(_SNAP_SEP)
@@ -341,6 +359,17 @@ def _unseal_snapshot(payload: bytes, context: str = "") -> DriveSnapshot:
     return DriveSnapshot(
         int(meta["step"]), states, final=bool(meta.get("final", False)), dynamics=dynamics
     )
+
+
+def _register_snapshot_schemas() -> None:
+    from metrics_tpu.resilience import schema as _schema
+
+    _schema.register_schema(
+        "snapshot", _SNAPSHOT_VERSION, _decode_snapshot_v1, prober=_snapshot_version_of
+    )
+
+
+_register_snapshot_schemas()
 
 
 def load_drive_snapshot(store: Any, snapshot_key: str = "drive") -> DriveSnapshot:

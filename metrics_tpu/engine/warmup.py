@@ -70,6 +70,8 @@ import numpy as np
 from metrics_tpu.obs import bus as _bus
 from metrics_tpu.obs import explain as _explain
 from metrics_tpu.obs.warn import warn_once as _warn_once
+from metrics_tpu.resilience import schema as _schema
+from metrics_tpu.utils.exceptions import SchemaVersionError
 
 __all__ = [
     "ENV_VAR",
@@ -86,7 +88,12 @@ __all__ = [
 ]
 
 ENV_VAR = "METRICS_TPU_WARMUP_MANIFEST"
-MANIFEST_VERSION = 1
+# v2 (ISSUE 18): same document shape as v1, bumped to pin the format in the
+# durable-schema registry — a v1 manifest (older build) upcasts transparently
+# with a warn_once naming the gap; a manifest from a NEWER build raises
+# SchemaVersionError from load_manifest, and warmup() turns that into a
+# warn + cold-compile fallback so a half-rolled worker still joins.
+MANIFEST_VERSION = 2
 
 #: Entry kinds a manifest can cover. Driver entries are recorded only for
 #: local (no mesh / no axis_name) epochs: a Mesh handle cannot ride JSON.
@@ -647,21 +654,55 @@ def save_manifest(path: Optional[str] = None) -> str:
     return path
 
 
-def _validate_manifest(doc: Any, origin: str) -> Dict[str, Any]:
-    if not isinstance(doc, dict) or doc.get("version") != MANIFEST_VERSION:
-        version = doc.get("version") if isinstance(doc, dict) else type(doc).__name__
-        raise ValueError(
-            f"warmup manifest {origin} has version {version!r};"
-            f" this build speaks version {MANIFEST_VERSION}"
-        )
-    if not isinstance(doc.get("entries"), list):
-        raise ValueError(f"warmup manifest {origin} has no entry list")
+def _manifest_version_of(doc: Any) -> Any:
+    return doc.get("version") if isinstance(doc, dict) else None
+
+
+def _decode_manifest_doc(doc: Any, context: str) -> Dict[str, Any]:
+    """Structural check shared by every manifest schema version."""
+    if not isinstance(doc, dict) or not isinstance(doc.get("entries"), list):
+        raise ValueError(f"warmup manifest{context} has no entry list")
     return doc
 
 
+def _upcast_manifest_v1(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """v1 -> v2: the document shape is unchanged (the bump pins the format
+    in the registry); entries recorded by the older build warm as-is."""
+    out = dict(doc)
+    out["version"] = 2
+    return out
+
+
+_schema.register_schema(
+    "manifest", 1, _decode_manifest_doc, upcast=_upcast_manifest_v1, prober=_manifest_version_of
+)
+_schema.register_schema("manifest", 2, _decode_manifest_doc)
+
+
+def _validate_manifest(doc: Any, origin: str) -> Dict[str, Any]:
+    version = _manifest_version_of(doc)
+    out = _schema.decode_any("manifest", doc, context=f" {origin}")
+    if version != MANIFEST_VERSION:
+        # an older build's manifest: decoded + upcast by the registry —
+        # name the version gap once (warmup_stale-style) so the operator
+        # knows to re-record, but keep warming (strictly better than cold)
+        _warn_once(
+            f"warmup manifest {origin} was written at schema v{version}; this"
+            f" build speaks v{MANIFEST_VERSION}. The registry upcast it and"
+            " warmup proceeds, but re-record the manifest on this build to"
+            " retire the old format.",
+            RuntimeWarning,
+            key=("warmup_manifest_version", str(origin), version),
+        )
+    return out
+
+
 def load_manifest(path: str) -> Dict[str, Any]:
-    """Read and validate a manifest; raises ``ValueError`` on an unknown
-    version or a malformed document."""
+    """Read and validate a manifest through the durable-schema registry;
+    raises ``ValueError`` on a malformed document and
+    :class:`~metrics_tpu.utils.exceptions.SchemaVersionError` on a version
+    from a newer build (an older build's manifest upcasts with a
+    ``warn_once`` naming the gap)."""
     with open(path) as f:
         doc = json.load(f)
     return _validate_manifest(doc, repr(path))
@@ -833,12 +874,36 @@ def warmup(manifest: Optional[Any] = None, templates: Optional[Iterable[Any]] = 
         manifest = os.environ.get(ENV_VAR)
         if not manifest:
             raise ValueError(f"warmup needs a manifest: pass a path/dict or set {ENV_VAR}.")
-    if isinstance(manifest, dict):
-        doc = _validate_manifest(manifest, "<dict>")
-        path = None
-    else:
-        doc = load_manifest(manifest)
-        path = manifest
+    try:
+        if isinstance(manifest, dict):
+            doc = _validate_manifest(manifest, "<dict>")
+            path = None
+        else:
+            doc = load_manifest(manifest)
+            path = manifest
+    except SchemaVersionError as err:
+        # version skew (a manifest this build cannot decode — typically one
+        # written by a NEWER build mid-rollback): a warm start is an
+        # optimization, never a join gate. Warn once naming the gap, count
+        # the skip, and serve cold — programs compile at first dispatch.
+        origin = "<dict>" if isinstance(manifest, dict) else repr(manifest)
+        _warn_once(
+            f"warmup manifest {origin} carries schema v{err.version}; this"
+            f" build speaks v{err.current}. Skipping warmup — programs will"
+            " cold-compile at serve time (worker join is unaffected).",
+            RuntimeWarning,
+            key=("warmup_manifest_version_skew", origin, err.version),
+        )
+        _skip("manifest_version_skew", 1)
+        if _bus.enabled():
+            _bus.emit(
+                "warmup",
+                event="version_skew",
+                origin=origin,
+                version=err.version,
+                current=err.current,
+            )
+        return warmup_report()
     candidates = _template_candidates(templates)
     with _LOCK:
         _WARM["loaded"] = True

@@ -107,6 +107,10 @@ _AGGREGATE_KEYS = (
     # waiting in the migration ledger + requests awaiting re-submission
     "in_flight_tenants",
     "parked_requests",
+    # rolling-upgrade plane (ISSUE 18): workers replaced with a new build,
+    # canary breaches that rolled the fleet back to the old build
+    "upgrades",
+    "rollbacks",
 )
 
 

@@ -624,6 +624,31 @@ class FleetGuard:
                 )
         return states
 
+    def hold_probation(self, worker_id: Hashable) -> None:
+        """Place ``worker_id`` in probation NOW, with a fresh health record
+        — the rolling-upgrade canary hold (:meth:`Fleet.rolling_upgrade`).
+        A canary build must EARN its way to healthy: it starts one breach
+        observation from ejection-grade scrutiny (``eject_after`` applies
+        from a zero streak) and heals to healthy only after
+        ``recover_after`` consecutive clean observations, exactly like a
+        worker that breached its way in."""
+        with self._lock:
+            rec = self._health[worker_id] = _WorkerHealth()
+            rec.state = "probation"
+            self.stats["probations"] += 1
+        if _bus.enabled():
+            _bus.emit(
+                "guard",
+                source=self.name,
+                fleet=self.fleet.name,
+                worker=str(worker_id),
+                state_from="healthy",
+                state_to="probation",
+                reasons=["canary_hold"],
+                ewma_ms=None,
+                error_ewma=None,
+            )
+
     # ------------------------------------------------------------------
     # the serving-loop tick
     # ------------------------------------------------------------------

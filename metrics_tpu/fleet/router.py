@@ -45,7 +45,7 @@ import itertools
 import threading
 import time
 import weakref
-from typing import Any, Dict, Hashable, Iterable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tuple
 
 from metrics_tpu.fleet import migrate as _migrate
 from metrics_tpu.fleet import placement as _placement
@@ -321,6 +321,11 @@ class Fleet:
         # vs "never queued" (only the latter may park; see _commit_epoch)
         self._resub_ids = itertools.count()
         self.epoch = FleetEpoch(ids, version=0)
+        # rolling-upgrade seam: when set, _new_worker routes through this
+        # factory so a joining worker can be a NEW-build cell (different
+        # template/kernels) while sharing the fleet's durable identity
+        # (store namespace, dedup registry) — see rolling_upgrade()
+        self._worker_builder: Optional[Callable[[Hashable, "Fleet"], Optional[Worker]]] = None
         self._workers: Dict[Hashable, Worker] = {}
         for wid in self.epoch.workers:
             self._workers[wid] = self._new_worker(wid)
@@ -337,6 +342,8 @@ class Fleet:
             "dies": 0,
             "recovered_tenants": 0,
             "resubmitted_requests": 0,
+            "upgrades": 0,
+            "rollbacks": 0,
         }
         with _REGISTRY_LOCK:
             _FLEETS.add(self)
@@ -345,10 +352,23 @@ class Fleet:
     # placement / request plane
     # ------------------------------------------------------------------
     def _new_worker(self, wid: Hashable) -> Worker:
-        return Worker(
-            wid,
-            self._template,
-            self.capacity,
+        if self._worker_builder is not None:
+            worker = self._worker_builder(wid, self)
+            if worker is not None:
+                return worker
+        return self.build_worker(wid)
+
+    def build_worker(self, wid: Hashable, **overrides: Any) -> Worker:
+        """Construct a worker wired into THIS fleet's shared identity — the
+        ``<fleet>:<worker>`` store namespace, the fleet-scoped request dedup,
+        the epoch clock — with any ctor keyword overridden. The building
+        block a :meth:`rolling_upgrade` factory should use: pass
+        ``template=`` (a new-build metric, e.g. different kernels/layout)
+        and keep everything durable untouched, so the upgraded cell reads
+        the same journal/blobs its predecessor sealed."""
+        template = overrides.pop("template", None)
+        capacity = overrides.pop("capacity", None)
+        kwargs: Dict[str, Any] = dict(
             bank_name=f"{self.name}:{wid}",
             max_requests=self._max_requests,
             max_delay_s=self._max_delay_s,
@@ -358,6 +378,13 @@ class Fleet:
             fault_plan=self._fault_plan,
             epoch_fn=lambda: self.epoch.version,
             audit_rate=self._audit_rate,
+        )
+        kwargs.update(overrides)
+        return Worker(
+            wid,
+            template if template is not None else self._template,
+            capacity if capacity is not None else self.capacity,
+            **kwargs,
         )
 
     def _precisions(self) -> Optional[Dict[str, str]]:
@@ -575,6 +602,165 @@ class Fleet:
             failures += self._commit_epoch(old, final_epoch, performed, moved_bytes, pending)
             self._raise_if_failed(failures)
             return performed
+
+    # ------------------------------------------------------------------
+    # rolling upgrade (ISSUE 18)
+    # ------------------------------------------------------------------
+    def _emit_upgrade(self, event: str, **fields: Any) -> None:
+        if _bus.enabled():
+            _bus.emit("upgrade", source=self.name, event=event, **fields)
+
+    def _canary_breach(
+        self, wid: Hashable, guard: Optional[Any], audit_failed: int
+    ) -> Tuple[str, ...]:
+        """Why the canary must be rolled back NOW, or ``()``. A canary is
+        held to a stricter standard than a tenured worker: ANY breach
+        reason the guard scores during the hold (integrity, latency,
+        errors, lag) triggers rollback — the guard's own hysteresis exists
+        to avoid ejecting a worker on one bad flush, but a brand-new build
+        showing its first bad flush IS the signal the canary exists for."""
+        reasons: List[str] = []
+        if audit_failed > 0:
+            reasons.append("integrity")
+        worker = self._workers.get(wid)
+        if worker is None or not worker.alive or wid not in self.epoch.workers:
+            reasons.append("dead")
+        if guard is not None:
+            rec = guard.summary().get("workers", {}).get(str(wid))
+            if rec is not None:
+                if rec.get("state") == "ejected":
+                    reasons.append("ejected")
+                for reason in rec.get("reasons", ()):
+                    if reason not in reasons:
+                        reasons.append(reason)
+        return tuple(dict.fromkeys(reasons))
+
+    def rolling_upgrade(
+        self,
+        worker_factory: Callable[[Hashable, "Fleet"], Optional[Worker]],
+        *,
+        manifest: Optional[Any] = None,
+        guard: Optional[Any] = None,
+        canary_steps: int = 8,
+        on_step: Optional[Callable[["Fleet"], Any]] = None,
+    ) -> Dict[str, Any]:
+        """Replace every worker with a ``worker_factory``-built cell, one at
+        a time, with the first replacement held as a CANARY — automatic
+        rollback to the old build on an integrity or latency breach, zero
+        acked requests lost either way.
+
+        Per worker: graceful :meth:`leave` (drain, migrate its tenants to
+        the survivors through the ledger), then :meth:`join` the same id
+        with ``worker_factory(wid, fleet)`` building the cell (return
+        ``None`` to fall back to the default build; use
+        :meth:`build_worker` to inherit the fleet's durable identity) —
+        rendezvous hands the same id the same tenants back, so the upgrade
+        is invisible to placement.
+
+        The FIRST upgraded worker is the canary: its bank's shadow-replay
+        audit is forced to every flush, ``guard.hold_probation`` (when a
+        :class:`~metrics_tpu.fleet.FleetGuard` is passed) pins it under
+        probation-grade scrutiny, and for ``canary_steps`` observation
+        rounds — ``on_step(fleet)`` is the caller's traffic pump — every
+        audit verdict and guard breach reason is checked. A breach rolls
+        back: the canary is :meth:`kill`'ed (its acked sessions recover
+        from the durable store onto the survivors — a failed audit was
+        already repaired in place from the journaled acked prefix, so what
+        migrates back is the correct state), the old build rejoins under
+        the same id, and the rollout aborts. No acked request is lost in
+        either direction; un-flushed requests ride the kill path's
+        resubmission.
+
+        Returns a report: ``upgraded`` (ids now on the new build),
+        ``canary``, ``rolled_back``, ``breach`` (reasons, or ``None``),
+        ``audit`` (canary verdict counts)."""
+        order = sorted(self.epoch.workers, key=str)
+        if len(order) < 2:
+            raise MetricsUserError(
+                f"fleet {self.name!r}: rolling_upgrade needs at least 2 workers"
+                f" (got {len(order)}) — the drained worker's tenants migrate to"
+                " the survivors, and a canary rollback needs somewhere for the"
+                " old build's state to live meanwhile. join() a second worker"
+                " first, or rebuild a singleton fleet in place."
+            )
+        from metrics_tpu.resilience.integrity import IntegrityAuditor
+
+        canary_wid = order[0]
+        upgraded: List[Hashable] = []
+        audit_counts = {"checked": 0, "passed": 0, "failed": 0, "repaired": 0}
+        report: Dict[str, Any] = {
+            "workers": list(order),
+            "canary": canary_wid,
+            "upgraded": upgraded,
+            "rolled_back": False,
+            "breach": None,
+            "audit": audit_counts,
+        }
+        for wid in order:
+            self._emit_upgrade("drain", worker=str(wid), epoch=self.epoch.version)
+            self.leave(wid)
+            self._worker_builder = worker_factory
+            try:
+                self.join(wid, manifest=manifest)
+            finally:
+                self._worker_builder = None
+            self.stats["upgrades"] += 1
+            self._emit_upgrade("replace", worker=str(wid), epoch=self.epoch.version)
+            if wid != canary_wid:
+                upgraded.append(wid)
+                if on_step is not None:
+                    on_step(self)
+                continue
+            # -- canary hold: full-rate shadow audit + probation scrutiny
+            canary = self._workers[wid]
+            saved_cadence = (canary.bank.audit_rate, canary.bank._audit_period)
+            canary.bank.audit_rate = 1.0
+            canary.bank._audit_period = 1
+            auditor = IntegrityAuditor(canary.bank)
+            if guard is not None:
+                guard.hold_probation(wid)
+            self._emit_upgrade("canary_hold", worker=str(wid), steps=canary_steps)
+            breach: Tuple[str, ...] = ()
+            for _ in range(max(1, int(canary_steps))):
+                if on_step is not None:
+                    on_step(self)
+                worker = self._workers.get(wid)
+                if worker is not None and worker.alive and worker.bank is not None:
+                    worker.drain()
+                    verdict = auditor.poll()
+                    for key in audit_counts:
+                        audit_counts[key] += verdict[key]
+                if guard is not None:
+                    guard.observe()
+                breach = self._canary_breach(wid, guard, audit_counts["failed"])
+                if breach:
+                    break
+            if not breach:
+                upgraded.append(wid)
+                canary.bank.audit_rate, canary.bank._audit_period = saved_cadence
+                self._emit_upgrade("canary_pass", worker=str(wid), audit=dict(audit_counts))
+                continue
+            # -- rollback: old build back under the same id, state through
+            # the ledger/durable store — the tested crash-stop machinery
+            self.stats["rollbacks"] += 1
+            report["rolled_back"] = True
+            report["breach"] = list(breach)
+            self._emit_upgrade(
+                "rollback", worker=str(wid), reasons=list(breach), audit=dict(audit_counts)
+            )
+            if wid in self.epoch.workers and wid in self._workers and self._workers[wid].alive:
+                try:
+                    self.kill(wid)
+                except MetricsUserError:
+                    # per-tenant failures are parked in the ledger; the
+                    # rejoin below is the universal retry that re-admits them
+                    pass
+            if wid not in self.epoch.workers:
+                self.join(wid)
+            self._emit_upgrade("complete", rolled_back=True, upgraded=len(upgraded))
+            return report
+        self._emit_upgrade("complete", rolled_back=False, upgraded=len(upgraded))
+        return report
 
     def _commit_epoch(
         self,

@@ -104,6 +104,14 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: index, diverging ``leaf`` on failure; the guard scores failing audits
 #: toward probation/ejection), ``repair`` (a quarantined tenant rebuilt from
 #: its journaled acked prefix — bank, tenant, restored update count).
+#: Version-skew survival (``resilience/schema.py``, ``parallel/groups.py``,
+#: ``fleet/router.py``, ISSUE 18): ``compat`` (one durable-schema decode
+#: through the registry — ``family``, decoded ``version``, ``current``
+#: build version, ``upcasts`` hops walked; also emitted by the wire
+#: negotiator with ``event="wire_negotiated"`` when a group settles below
+#: this build's maximum), ``upgrade`` (one rolling-upgrade step —
+#: ``event`` drain/replace/canary_hold/canary_pass/rollback/complete,
+#: worker, fleet, and the breach reasons on rollback).
 #: Misc: ``warning`` (a ``warn_once`` emission); ``kernel`` (one kernel-tier
 #: registry dispatch — ``op``, ``path`` taken (``pallas``/``xla``/
 #: ``interpret``), ``reason``, and the ``policy`` in effect; see
@@ -143,6 +151,8 @@ EVENT_KINDS = (
     "attest",
     "audit",
     "repair",
+    "compat",
+    "upgrade",
     "warning",
     "kernel",
 )

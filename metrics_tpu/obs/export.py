@@ -77,6 +77,16 @@ def _integrity_stats() -> Dict[str, Any]:
     return integrity_stats()
 
 
+def _compat_stats() -> Dict[str, Any]:
+    from metrics_tpu.parallel import groups as _groups
+    from metrics_tpu.resilience import schema as _schema
+
+    return {
+        "families": _schema.compat_stats(),
+        "wire_negotiation": _groups.negotiation_stats(),
+    }
+
+
 def process_snapshot() -> Dict[str, Any]:
     """The process-wide observability view (no metric argument needed)."""
     from metrics_tpu import engine as _engine
@@ -120,6 +130,11 @@ def process_snapshot() -> Dict[str, Any]:
         # recorded/verified/failed, shadow-replay audits sampled/checked/
         # passed/failed, quarantine repairs, injected bitflips
         "integrity": _integrity_stats(),
+        # version-skew survival (resilience/schema.py + parallel/groups.py):
+        # per-family durable-schema decode/upcast/reject counters and the
+        # wire-version negotiation tallies (groups settled below this
+        # build's maximum, quantized→exact fallbacks)
+        "compat": _compat_stats(),
         "bus": _bus.summary(),
         "spans": _trace.span_summary(),
         "warnings": {repr(k): v for k, v in _warn.warn_counts().items()},
@@ -347,7 +362,15 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
 
     # elastic fleet: membership, per-worker occupancy, migration traffic
     fleet = _fleet_stats()
-    for key in ("migrations", "rebalance_bytes", "kills", "recovered_tenants", "epoch_changes"):
+    for key in (
+        "migrations",
+        "rebalance_bytes",
+        "kills",
+        "recovered_tenants",
+        "epoch_changes",
+        "upgrades",
+        "rollbacks",
+    ):
         _sample(f"metrics_tpu_fleet_{key}", fleet[key])
     _sample("metrics_tpu_fleet_tenants", fleet["tenants"], kind="gauge")
     # parked state (PR-11 park-and-retry): tenants waiting in the migration
@@ -421,6 +444,20 @@ def prometheus_text(obj: Optional[Any] = None) -> str:
     # tripwires (attest_failures, audit_failures) are the alerting surface
     for key, value in sorted(_integrity_stats().items()):
         _sample(f"metrics_tpu_integrity_{key}", value)
+
+    # version-skew survival: per-family durable-schema decode/upcast/reject
+    # counters and wire-negotiation tallies. A nonzero rejects means a
+    # NEWER build's artifact reached this one (downgrade guard fired); a
+    # persistent capped means a mixed-version fleet — finish the rollout.
+    compat = _compat_stats()
+    for family in sorted(compat["families"]):
+        rec = compat["families"][family]
+        labels = {"family": family}
+        _sample("metrics_tpu_compat_schema_current", rec["current"], labels, kind="gauge")
+        for key in ("decodes", "upcasts", "rejects"):
+            _sample(f"metrics_tpu_compat_schema_{key}", rec[key], labels)
+    for key, value in sorted(compat["wire_negotiation"].items()):
+        _sample(f"metrics_tpu_compat_wire_{key}", value)
 
     # kernel tier: which path each op's dispatches took, and why fallbacks
     kern = _kernel_stats()

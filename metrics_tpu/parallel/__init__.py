@@ -38,8 +38,12 @@ from metrics_tpu.parallel.groups import (  # noqa: F401
     gather_group_arrays,
     gather_group_pytrees,
     gather_state_trees,
+    negotiation_stats,
     new_group,
     pack_envelope,
+    reset_negotiation_stats,
+    speaking,
+    spoken_wire_versions,
     unpack_envelope,
 )
 from metrics_tpu.parallel.quantize import (  # noqa: F401

@@ -37,11 +37,14 @@ the KV client and the process identity per thread, which is how all of this
 is tested single-process on CPU.
 """
 import itertools
+import contextlib
+import contextvars
 import json
 import struct
+import threading
 import time
 import zlib
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -76,11 +79,85 @@ _KV_PREFIX = "metrics_tpu/pg"
 #   :class:`SyncIntegrityError` naming both the peer's version and the
 #   locally spoken versions — mixed-version peers must never be retried,
 #   because re-reading the same build's payload can never succeed.
+# * NEGOTIATED (ISSUE 18): before the payload round, every member of a
+#   ProcessGroup advertises the versions it speaks under a fault-immune
+#   ``.../speaks/{rank}`` KV key and the group settles on the HIGHEST
+#   common version for the exchange. A half-rolled fleet (v1-only peers
+#   next to v2 speakers) therefore keeps syncing bit-correctly — quantized
+#   ``sync_precision`` tags transparently fall back to exact on a v1-capped
+#   group — and the hard rejection above remains only for versions nobody
+#   registered (truly unknown builds).
 _WIRE_MAGIC = b"MT"
 WIRE_VERSION = 1
 WIRE_VERSION_QUANTIZED = 2
 SUPPORTED_WIRE_VERSIONS = (WIRE_VERSION, WIRE_VERSION_QUANTIZED)
 _ENVELOPE = struct.Struct(">2sBI")
+
+# per-thread override of the versions this process advertises/speaks — the
+# test harness for mixed-version fleets (a simulated old-build peer runs its
+# whole exchange under ``with speaking(1):``). Default: everything.
+_SPOKEN_OVERRIDE: "contextvars.ContextVar[Optional[Tuple[int, ...]]]" = contextvars.ContextVar(
+    "metrics_tpu_spoken_wire_versions", default=None
+)
+
+# process-wide negotiation telemetry — the "wire_negotiation" block of
+# obs.snapshot()["compat"] and the metrics_tpu_compat_* gauges
+_NEGO_LOCK = threading.Lock()
+
+
+def _new_nego_stats() -> Dict[str, int]:
+    return {
+        "negotiations": 0,  # completed advertisement rounds
+        "capped": 0,  # rounds that settled below this process's max
+        "fallback_exact": 0,  # quantized tags forced to exact by a v1 cap
+    }
+
+
+_NEGO_STATS = _new_nego_stats()
+
+
+def spoken_wire_versions() -> Tuple[int, ...]:
+    """The wire versions this thread advertises during negotiation (a subset
+    of :data:`SUPPORTED_WIRE_VERSIONS`; narrowed by :func:`speaking`)."""
+    override = _SPOKEN_OVERRIDE.get()
+    return override if override is not None else SUPPORTED_WIRE_VERSIONS
+
+
+@contextlib.contextmanager
+def speaking(*versions: int):
+    """Pin the wire versions this thread advertises — simulate an old-build
+    peer in a mixed-version fleet (``with speaking(1): ...`` makes every
+    exchange on this thread negotiate as a v1-only speaker). Versions must
+    be a non-empty subset of :data:`SUPPORTED_WIRE_VERSIONS`."""
+    cleaned = tuple(sorted({int(v) for v in versions}))
+    if not cleaned or any(v not in SUPPORTED_WIRE_VERSIONS for v in cleaned):
+        raise ValueError(
+            f"speaking() needs a non-empty subset of {SUPPORTED_WIRE_VERSIONS}, got {versions!r}."
+        )
+    token = _SPOKEN_OVERRIDE.set(cleaned)
+    try:
+        yield
+    finally:
+        _SPOKEN_OVERRIDE.reset(token)
+
+
+def negotiation_stats() -> Dict[str, int]:
+    """Process-wide wire-negotiation counters: rounds completed, rounds that
+    settled below this build's max version, and quantized-tag exchanges that
+    fell back to exact under a v1-only cap."""
+    with _NEGO_LOCK:
+        return dict(_NEGO_STATS)
+
+
+def reset_negotiation_stats() -> None:
+    with _NEGO_LOCK:
+        for key in list(_NEGO_STATS):
+            _NEGO_STATS[key] = 0
+
+
+def _bump_nego(key: str, n: int = 1) -> None:
+    with _NEGO_LOCK:
+        _NEGO_STATS[key] += n
 
 # per-group monotonic call counters; aligned across processes by the SPMD
 # same-order contract documented above
@@ -249,10 +326,12 @@ def _seal(body: bytes, version: int = WIRE_VERSION) -> bytes:
     return pack_envelope(body, version)
 
 
-def _open_envelope(payload: bytes, context: str = "") -> bytes:
+def _open_envelope(
+    payload: bytes, context: str = "", accept: Optional[Sequence[int]] = None
+) -> bytes:
     """Body-only view of :func:`unpack_envelope` (envelope verification for
     callers that do not interpret the body — e.g. the in-flight read check)."""
-    return unpack_envelope(payload, context)[1]
+    return unpack_envelope(payload, context, accept)[1]
 
 
 def _encode_with_codec(
@@ -313,10 +392,12 @@ def _encode(
     return _encode_with_codec(arr, precision, stats)[0]
 
 
-def _decode(payload: bytes, context: str = "") -> np.ndarray:
+def _decode(
+    payload: bytes, context: str = "", accept: Optional[Sequence[int]] = None
+) -> np.ndarray:
     from metrics_tpu.parallel import quantize as _quant
 
-    version, body = unpack_envelope(payload, context)
+    version, body = unpack_envelope(payload, context, accept)
     if len(body) < 4:
         raise SyncIntegrityError(f"Truncated sync payload: no header length{context}.")
     (header_len,) = struct.unpack(">I", body[:4])
@@ -632,6 +713,108 @@ def _membership_or_raise(group: ProcessGroup) -> Optional[int]:
     return rank
 
 
+def _negotiate_wire_version(group: ProcessGroup, rank: int, policy: str = "raise") -> int:
+    """Advertise this member's spoken wire versions and settle the group on
+    the HIGHEST version every member speaks (ISSUE 18).
+
+    Advertisements ride fault-immune ``{prefix}/{scope}/speaks/{rank}`` KV
+    keys — deliberately OUTSIDE the ``{epoch}/{rank}`` shape the fault plan
+    targets, so injected drop/corrupt/flaky faults exercise the payload
+    exchange, not the handshake (a real coordination service treats both the
+    same; the immunity is a property of the *test harness* keyspace). Keys
+    are tiny, constant per process (re-published idempotently before each
+    exchange, so a restarted peer re-advertises), and never deleted — one
+    bounded key per member per scope.
+
+    A v1-only member caps the whole group at v1: quantized
+    ``sync_precision`` tags transparently fall back to exact for the
+    exchange, keeping a half-rolled fleet syncing bit-correctly. An empty
+    intersection is a NON-transient :class:`SyncIntegrityError` (builds too
+    far apart to interoperate must fail loudly, never garble). Under
+    ``policy='partial'`` a peer whose advertisement never arrives is left
+    out of the intersection — the payload read for that peer degrades under
+    the same policy.
+
+    Negotiation telemetry stays OUT of the sync ``report`` counters on
+    purpose: retry/attempt assertions over faulted exchanges must not see
+    handshake reads. See :func:`negotiation_stats`.
+    """
+    spoken = spoken_wire_versions()
+    if group.size == 1:
+        return max(spoken)
+    client = _kv_client()
+    scope = group._kv_scope
+    own_key = f"{_KV_PREFIX}/{scope}/speaks/{rank}"
+    context = f" (group={group.name!r}, scope={scope!r}, rank={rank})"
+    try:
+        client.key_value_set_bytes(own_key, ",".join(str(v) for v in spoken).encode())
+    except Exception as err:  # noqa: BLE001 — a KV publish failure IS a sync failure
+        raise SyncError(f"KV version advertisement failed{context}: {err}") from err
+    deadline = time.monotonic() + group.timeout_s
+    common = set(spoken)
+    for member in group.ranks:
+        if member == rank:
+            continue
+        key = f"{_KV_PREFIX}/{scope}/speaks/{member}"
+        raw: Optional[bytes] = None
+        last_err: Optional[BaseException] = None
+        while raw is None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                if policy == "partial":
+                    break  # peer never advertised: payload read degrades too
+                raise SyncTimeoutError(
+                    f"Peer rank {member} never advertised its wire versions"
+                    f"{context} within the {group.timeout_s}s deadline."
+                    f"{_DESYNC_HINT} Last error: {last_err}"
+                ) from last_err
+            try:
+                raw = client.blocking_key_value_get_bytes(
+                    key, max(1, int(min(remaining, 2.0) * 1000))
+                )
+            except Exception as err:  # noqa: BLE001 — classified below
+                if not _is_transient_kv_error(err):
+                    raise SyncError(f"KV version-advertisement read failed{context}: {err}") from err
+                last_err = err
+        if raw is None:
+            continue
+        try:
+            peer_spoken = {int(v) for v in raw.decode("ascii").split(",")}
+        except (ValueError, UnicodeDecodeError) as err:
+            raise SyncIntegrityError(
+                f"Unparseable wire-version advertisement from peer rank {member}"
+                f"{context}: {raw!r}.",
+                transient=False,
+            ) from err
+        common &= peer_spoken
+    if not common:
+        raise SyncIntegrityError(
+            f"No common wire version{context}: this member speaks"
+            f" {sorted(spoken)}, the group's intersection is empty. Builds this"
+            " far apart cannot interoperate — finish the rolling upgrade of the"
+            " stragglers first.",
+            transient=False,
+        )
+    negotiated = max(common)
+    _bump_nego("negotiations")
+    if negotiated < max(spoken):
+        _bump_nego("capped")
+        if _obs_bus.enabled():
+            _obs_bus.emit(
+                "compat",
+                event="wire_negotiated",
+                source=f"group:{group.name}",
+                rank=rank,
+                negotiated=negotiated,
+                spoken=list(spoken),
+            )
+    return negotiated
+
+
+def _accepted_versions(cap: int) -> Tuple[int, ...]:
+    return tuple(v for v in SUPPORTED_WIRE_VERSIONS if v <= cap)
+
+
 def gather_group_arrays(
     x: Any,
     group: ProcessGroup,
@@ -656,11 +839,20 @@ def gather_group_arrays(
     rank = _membership_or_raise(group)
     if rank is None:
         return [x]
+    cap = _negotiate_wire_version(group, rank, policy=policy)
+    if precision is not None and cap < WIRE_VERSION_QUANTIZED:
+        # a v1-only peer caps the group: quantized tags fall back to exact
+        # so the half-rolled fleet keeps syncing bit-correctly
+        _bump_nego("fallback_exact")
+        precision = None
+    accept = _accepted_versions(cap)
     payloads = _exchange_bytes(
         _encode(np.asarray(x), precision, stats=report), group, rank, policy=policy, report=report
     )
     return [
-        jnp.asarray(_decode(p, context=f" (group={group.name!r}, peer rank={member})"))
+        jnp.asarray(
+            _decode(p, context=f" (group={group.name!r}, peer rank={member})", accept=accept)
+        )
         for member, p in zip(group.ranks, payloads)
         if p is not None
     ]
@@ -717,11 +909,17 @@ def _encode_tree(
     return _seal(header + b"".join(struct.pack(">Q", len(b)) + b for b in blocks), version)
 
 
-def _decode_tree(payload: bytes, treedef, n_leaves: int, context: str = "") -> Any:
+def _decode_tree(
+    payload: bytes,
+    treedef,
+    n_leaves: int,
+    context: str = "",
+    accept: Optional[Sequence[int]] = None,
+) -> Any:
     import jax
     import jax.numpy as jnp
 
-    body = _open_envelope(payload, context)
+    body = _open_envelope(payload, context, accept)
     if len(body) < 8:
         raise SyncIntegrityError(f"Truncated sync tree payload: no block header{context}.")
     count, sig = struct.unpack(">II", body[:8])
@@ -743,7 +941,7 @@ def _decode_tree(payload: bytes, treedef, n_leaves: int, context: str = "") -> A
                 f"Truncated sync tree payload{context}: block {len(member_leaves)}"
                 f" declares {size} bytes, only {len(body) - offset} remain."
             )
-        member_leaves.append(jnp.asarray(_decode(body[offset : offset + size], context)))
+        member_leaves.append(jnp.asarray(_decode(body[offset : offset + size], context, accept)))
         offset += size
     return jax.tree_util.tree_unflatten(treedef, member_leaves)
 
@@ -780,10 +978,23 @@ def gather_group_pytrees(
     rank = _membership_or_raise(group)
     if rank is None:
         return [tree]
+    cap = _negotiate_wire_version(group, rank, policy=policy)
+    if precisions and cap < WIRE_VERSION_QUANTIZED:
+        # a v1-only peer caps the group: every tagged leaf ships exact, so
+        # the tree seals v1 byte-identical to an all-old group's exchange
+        _bump_nego("fallback_exact")
+        precisions = None
+    accept = _accepted_versions(cap)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     payload = _encode_tree(tree, precisions=precisions, stats=report)
     return [
-        _decode_tree(member_payload, treedef, len(leaves), context=f" (group={group.name!r}, peer rank={member})")
+        _decode_tree(
+            member_payload,
+            treedef,
+            len(leaves),
+            context=f" (group={group.name!r}, peer rank={member})",
+            accept=accept,
+        )
         for member, member_payload in zip(group.ranks, _exchange_bytes(payload, group, rank, policy=policy, report=report))
         if member_payload is not None
     ]
@@ -910,3 +1121,51 @@ def gather_state_trees(
         jax.tree_util.tree_unflatten(treedef, [per_leaf[m] for per_leaf in gathered])
         for m in range(n_members)
     ]
+
+
+# ---------------------------------------------------------------------------
+# durable-schema registration (ISSUE 18): the wire envelope as a registered
+# artifact family. The HOT sync path keeps its own version dispatch above
+# (accept-set narrowing, PR-2 non-transient rejection — behavior tests pin);
+# the registry entry serves the golden compat corpus (tests/compat/) and the
+# downgrade guard for wire payloads decoded OUT of band (a spilled exchange
+# blob inspected by tooling), and counts wire decodes in compat_stats().
+# ---------------------------------------------------------------------------
+def _wire_version_of(payload: bytes) -> int:
+    if len(payload) < _ENVELOPE.size:
+        raise SyncIntegrityError(
+            f"Truncated sync payload: {len(payload)} bytes is smaller than the"
+            f" {_ENVELOPE.size}-byte wire envelope."
+        )
+    magic, version, _crc = _ENVELOPE.unpack(payload[: _ENVELOPE.size])
+    if magic != _WIRE_MAGIC:
+        raise SyncIntegrityError(
+            "Sync payload does not carry the metrics_tpu wire magic.", transient=False
+        )
+    return version
+
+
+def _decode_wire_v1(payload: bytes, context: str) -> np.ndarray:
+    return _decode(payload, context, accept=(WIRE_VERSION,))
+
+
+def _decode_wire_v2(payload: bytes, context: str) -> np.ndarray:
+    return _decode(payload, context, accept=(WIRE_VERSION_QUANTIZED,))
+
+
+def _upcast_wire_v1(arr: np.ndarray) -> np.ndarray:
+    """v1 -> v2: both versions decode to the identical array — v2 only adds
+    codec metadata on the wire, never array semantics."""
+    return arr
+
+
+def _register_wire_schemas() -> None:
+    from metrics_tpu.resilience import schema as _schema
+
+    _schema.register_schema(
+        "wire", WIRE_VERSION, _decode_wire_v1, upcast=_upcast_wire_v1, prober=_wire_version_of
+    )
+    _schema.register_schema("wire", WIRE_VERSION_QUANTIZED, _decode_wire_v2)
+
+
+_register_wire_schemas()

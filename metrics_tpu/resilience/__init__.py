@@ -26,6 +26,15 @@ TPUv4 scale; EQuARX degraded collectives). This package holds the pieces:
   a solo clone and compares bit-exact), deterministic ``bitflip`` SDC
   injection, and quarantine + journal-replay repair
   (``MetricBank.repair_tenant``) — see ``docs/integrity.md``.
+* :mod:`~metrics_tpu.resilience.schema` — the durable-schema registry
+  (ISSUE 18): every durable artifact family (wire envelope, tenant payload,
+  journal record, drive snapshot, warmup manifest) registers
+  ``(family, version, decoder, upcast)``; :func:`decode_any` walks the
+  upcast chain to current so old-format bytes survive a rolling deploy,
+  while a version from the *future* raises a loud, typed
+  :class:`~metrics_tpu.utils.exceptions.SchemaVersionError` (downgrade
+  guard). :func:`compat_stats` feeds ``obs.snapshot()["compat"]`` — see
+  ``docs/compat.md``.
 * :mod:`~metrics_tpu.resilience.overload` — admission control for the
   serving request plane: per-tenant token-bucket quotas, a global inflight
   cap, deadline-aware shedding (every rejection is a loud
@@ -76,6 +85,16 @@ from metrics_tpu.resilience.integrity import (  # noqa: F401
     reset_integrity_stats,
     state_digest,
     verify_tree,
+)
+from metrics_tpu.resilience.schema import (  # noqa: F401
+    SchemaVersionError,
+    compat_stats,
+    current_version,
+    decode_any,
+    register_schema,
+    registered_families,
+    registered_versions,
+    reset_compat_stats,
 )
 from metrics_tpu.resilience.overload import (  # noqa: F401
     AdmissionController,

@@ -1,0 +1,223 @@
+"""Durable-schema registry: one process-wide version map for every byte that
+outlives a process (ISSUE 18).
+
+Before this module, each durable artifact family carried an ad-hoc version
+field checked by its own codec — the wire envelope (``parallel/groups.py``),
+the tenant payload and journal record (``serving/store.py``), the drive
+snapshot (``engine/driver.py``), and the warmup manifest
+(``engine/warmup.py``) — and every one of them treated "version I don't
+recognize" as a terminal error. That is the wrong default for a fleet that
+is never all on one build: a rolling deploy *guarantees* old-format bytes in
+every durable tier, and the first code-rev that bumps a format would strand
+every DiskStore journal and warm manifest behind it.
+
+This registry makes version skew a first-class, *contractual* state:
+
+* Every family registers ``(family, version, decoder, upcast)`` at import
+  time of the module that owns the format. ``decoder`` turns an artifact at
+  that version into that version's canonical object; ``upcast`` lifts a
+  decoded object one step, ``version -> version + 1``. The highest
+  registered version is *current*.
+* :func:`decode_any` probes the artifact's version (each family registers a
+  ``prober`` alongside its first decoder), decodes at that version, then
+  walks the upcast chain to current. Old-but-registered bytes therefore
+  **never** raise — they decode, get counted, and come out current-shaped.
+* A version *ahead* of current — bytes written by a newer build, i.e. a
+  downgrade — raises :class:`~metrics_tpu.utils.exceptions.SchemaVersionError`
+  naming family/version/current. Loud and typed on purpose: a downgrade
+  must read as version skew in a stack trace, never as a crc mystery or a
+  misparsed replay.
+* :func:`compat_stats` counts decodes/upcasts/rejects per family — surfaced
+  as ``obs.snapshot()["compat"]`` and the ``metrics_tpu_compat_*`` gauges,
+  so an operator can see *that* old-format bytes are still flowing (and
+  from which tier) before deleting the old decoders.
+
+The registry holds no bytes and no formats of its own — codecs stay in the
+modules that own them (``serving/store.py`` et al.); this module only owns
+the version *topology* and the skew policy. Families register lazily at
+owner-module import, so importing this module alone pulls in nothing heavy.
+"""
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from metrics_tpu.utils.exceptions import SchemaVersionError
+
+__all__ = [
+    "SchemaVersionError",
+    "compat_stats",
+    "current_version",
+    "decode_any",
+    "register_schema",
+    "registered_families",
+    "registered_versions",
+    "reset_compat_stats",
+]
+
+_LOCK = threading.Lock()
+
+# family -> version -> (decoder, upcast)
+_SCHEMAS: Dict[str, Dict[int, Tuple[Callable[..., Any], Optional[Callable[[Any], Any]]]]] = {}
+# family -> prober(payload) -> version   (None: caller must pass version=)
+_PROBERS: Dict[str, Optional[Callable[[Any], Any]]] = {}
+# family -> {"decodes": n, "upcasts": n, "rejects": n}
+_STATS: Dict[str, Dict[str, int]] = {}
+
+
+def _family_stats(family: str) -> Dict[str, int]:
+    return _STATS.setdefault(family, {"decodes": 0, "upcasts": 0, "rejects": 0})
+
+
+def register_schema(
+    family: str,
+    version: int,
+    decoder: Callable[..., Any],
+    upcast: Optional[Callable[[Any], Any]] = None,
+    prober: Optional[Callable[[Any], Any]] = None,
+) -> None:
+    """Register one ``(family, version)`` point in the durable-schema space.
+
+    ``decoder(payload, context) -> obj`` decodes an artifact known to be at
+    ``version`` into that version's canonical object. ``upcast(obj) -> obj``
+    lifts a decoded object one step toward ``version + 1``; every registered
+    version below current MUST carry one (checked at decode time, not here,
+    so registration order is free). ``prober(payload) -> version`` reads the
+    version out of a raw artifact; registering it on any version of the
+    family is enough. Re-registering a version replaces it (idempotent
+    module re-imports stay safe)."""
+    if not isinstance(version, int) or isinstance(version, bool):
+        raise TypeError(f"schema version must be an int, got {version!r} for family {family!r}")
+    with _LOCK:
+        _SCHEMAS.setdefault(family, {})[version] = (decoder, upcast)
+        if prober is not None or family not in _PROBERS:
+            _PROBERS[family] = prober if prober is not None else _PROBERS.get(family)
+        _family_stats(family)
+
+
+def registered_families() -> List[str]:
+    with _LOCK:
+        return sorted(_SCHEMAS)
+
+
+def registered_versions(family: str) -> List[int]:
+    with _LOCK:
+        return sorted(_SCHEMAS.get(family, ()))
+
+
+def current_version(family: str) -> int:
+    """The highest registered version for ``family`` — what this build
+    writes, and what :func:`decode_any` upcasts everything to."""
+    with _LOCK:
+        versions = _SCHEMAS.get(family)
+        if not versions:
+            raise KeyError(f"no schemas registered for durable family {family!r}")
+        return max(versions)
+
+
+def _reject(family: str, version: Any, current: int, context: str) -> SchemaVersionError:
+    with _LOCK:
+        _family_stats(family)["rejects"] += 1
+    _emit("reject", family=family, version=version, current=current)
+    if isinstance(version, int) and not isinstance(version, bool) and version > current:
+        return SchemaVersionError(
+            f"{family} artifact{context} carries schema v{version}, but this build"
+            f" speaks at most v{current} — the bytes were written by a NEWER build"
+            " (downgrade guard: refusing to guess at a format from the future;"
+            " upgrade this worker or decode on a current build).",
+            family=family,
+            version=version,
+            current=current,
+        )
+    return SchemaVersionError(
+        f"{family} artifact{context} carries unknown schema version {version!r};"
+        f" this build speaks {registered_versions(family)}.",
+        family=family,
+        version=version,
+        current=current,
+    )
+
+
+def _emit(event: str, **fields: Any) -> None:
+    from metrics_tpu.obs import bus as _bus
+
+    if _bus.enabled():
+        _bus.emit("compat", event=event, **fields)
+
+
+def decode_any(
+    family: str,
+    payload: Any,
+    *,
+    version: Optional[int] = None,
+    context: str = "",
+) -> Any:
+    """Decode an artifact of ``family`` at whatever registered version it
+    carries, then walk the upcast chain to current.
+
+    The version is read by the family's registered prober unless passed
+    explicitly. Old registered versions decode and upcast transparently
+    (each hop counted in :func:`compat_stats` and emitted as a ``compat``
+    bus event); a version ahead of current, or unregistered, raises
+    :class:`SchemaVersionError` — the downgrade guard."""
+    with _LOCK:
+        versions = dict(_SCHEMAS.get(family) or {})
+        prober = _PROBERS.get(family)
+    if not versions:
+        raise KeyError(f"no schemas registered for durable family {family!r}")
+    if version is None:
+        if prober is None:
+            raise TypeError(f"family {family!r} registered no prober; pass version= explicitly")
+        version = prober(payload)
+    current = max(versions)
+    if version not in versions:
+        raise _reject(family, version, current, context)
+    decoder, _ = versions[version]
+    obj = decoder(payload, context)
+    with _LOCK:
+        _family_stats(family)["decodes"] += 1
+    hops = 0
+    at = version
+    while at < current:
+        _, upcast = versions[at]
+        if upcast is None:
+            raise SchemaVersionError(
+                f"{family} v{at} registered no upcast toward v{current}{context};"
+                " the upcast chain is broken — register one in the owning module.",
+                family=family,
+                version=at,
+                current=current,
+            )
+        obj = upcast(obj)
+        at += 1
+        hops += 1
+    if hops:
+        with _LOCK:
+            _family_stats(family)["upcasts"] += hops
+        _emit("upcast", family=family, **{"from": version, "to": current, "hops": hops})
+    return obj
+
+
+def compat_stats() -> Dict[str, Any]:
+    """Per-family version-skew telemetry: registered/current versions plus
+    decode/upcast/reject counters since process start (or the last reset).
+    ``upcasts`` > 0 means old-format bytes are still flowing from that tier;
+    ``rejects`` > 0 means something newer (or alien) knocked and was turned
+    away loudly. The ``compat`` section of ``obs.snapshot()``."""
+    with _LOCK:
+        out: Dict[str, Any] = {}
+        for family in sorted(set(_SCHEMAS) | set(_STATS)):
+            versions = sorted(_SCHEMAS.get(family, ()))
+            stats = _STATS.get(family, {"decodes": 0, "upcasts": 0, "rejects": 0})
+            out[family] = {
+                "versions": versions,
+                "current": max(versions) if versions else None,
+                "decodes": stats["decodes"],
+                "upcasts": stats["upcasts"],
+                "rejects": stats["rejects"],
+            }
+        return out
+
+
+def reset_compat_stats() -> None:
+    with _LOCK:
+        for stats in _STATS.values():
+            stats["decodes"] = stats["upcasts"] = stats["rejects"] = 0

@@ -44,6 +44,7 @@ import numpy as np
 
 from metrics_tpu.obs import bus as _bus
 from metrics_tpu.parallel import groups as _groups
+from metrics_tpu.resilience import schema as _schema
 from metrics_tpu.utils.exceptions import MetricsUserError, SyncIntegrityError
 
 __all__ = [
@@ -61,7 +62,13 @@ __all__ = [
     "unseal_record",
 ]
 
-JOURNAL_VERSION = 1
+# v2 (ISSUE 18): the digest-carrying record the integrity plane (ISSUE 17)
+# introduced, pinned by contract. v1 is the pre-integrity digest-less record
+# — previously back-compat only *by accident* (the decoder never looked at
+# the version); now a registered schema with an explicit upcast that fills
+# ``digest: None``, so old journals replay by contract and the golden corpus
+# (tests/compat/) holds both forms forever.
+JOURNAL_VERSION = 2
 
 # process-wide durability telemetry — the "durability" section of
 # obs.snapshot() and the metrics_tpu_durable_* Prometheus family
@@ -172,6 +179,15 @@ def seal_record(record: Dict[str, Any]) -> bytes:
 
 
 def unseal_record(payload: bytes, context: str = "") -> Dict[str, Any]:
+    """Decode one journal record through the durable-schema registry: v1
+    (pre-integrity) records upcast transparently, a record from a *newer*
+    build raises :class:`SchemaVersionError` — loud version skew, never a
+    misparsed replay."""
+    return _schema.decode_any("journal", payload, context=context)
+
+
+def _journal_record_body(payload: bytes, context: str) -> Dict[str, Any]:
+    """Envelope + JSON parse shared by every journal schema version."""
     _version, body = _groups.unpack_envelope(payload, context)
     try:
         record = json.loads(body.decode("utf-8"))
@@ -180,6 +196,28 @@ def unseal_record(payload: bytes, context: str = "") -> Dict[str, Any]:
     if not isinstance(record, dict):
         raise SyncIntegrityError(f"Journal record is not an object{context}.")
     return record
+
+
+def _journal_version_of(payload: bytes) -> Any:
+    # records predating the version field (never shipped, but cheap to honor)
+    # probe as v1 — the digest-less schema
+    return _journal_record_body(payload, "").get("v", 1)
+
+
+def _upcast_journal_v1(record: Dict[str, Any]) -> Dict[str, Any]:
+    """v1 -> v2: pre-integrity records carry no attestation digest; the
+    upcast pins the absence explicitly (``digest: None`` = "unattested",
+    which ``replay_journal`` already treats as skip-verification)."""
+    out = dict(record)
+    out.setdefault("digest", None)
+    out["v"] = 2
+    return out
+
+
+_schema.register_schema(
+    "journal", 1, _journal_record_body, upcast=_upcast_journal_v1, prober=_journal_version_of
+)
+_schema.register_schema("journal", 2, _journal_record_body)
 
 
 def read_journal(store: "SpillStore", journal: str) -> Tuple[List[Dict[str, Any]], int]:
@@ -573,7 +611,12 @@ def journal_drop(store: SpillStore, bank_name: str, tenant: Hashable) -> None:
         _bus.emit("journal", bank=bank_name, op="drop", tenant=str(tenant))
 
 
-_PAYLOAD_VERSION = 1
+# v2 (ISSUE 18): the digest-attested payload the integrity plane (ISSUE 17)
+# introduced, pinned by contract. v1 is the pre-integrity digest-less header
+# — previously decodable only because the digest map happened to be optional;
+# now a registered schema of its own (no attestation to verify), upcast
+# transparently to current by the durable-schema registry.
+_PAYLOAD_VERSION = 2
 
 
 # ---------------------------------------------------------------------------
@@ -640,7 +683,18 @@ def decode_tenant_payload(payload: bytes, context: str = "") -> Dict[str, Any]:
     :class:`~metrics_tpu.utils.exceptions.StateIntegrityError` naming the
     leaf. This one decode path is the verification point for every boundary
     that rides the codec: LRU re-admit, ``MetricBank.recover``, migration
-    import, and ``drive(resume_from=)``."""
+    import, and ``drive(resume_from=)``.
+
+    Versioning rides the durable-schema registry: v1 (pre-integrity,
+    digest-less) payloads decode and upcast transparently; a payload sealed
+    by a *newer* build raises :class:`SchemaVersionError` instead of a
+    mystery parse failure."""
+    return _schema.decode_any("payload", payload, context=context)
+
+
+def _payload_header(payload: bytes, context: str) -> Dict[str, Any]:
+    """Envelope + header parse shared by every payload schema version (and
+    the registry's version prober)."""
     _version, body = _groups.unpack_envelope(payload, context)
     if len(body) < 4:
         raise SyncIntegrityError(f"Truncated migration payload: no header length{context}.")
@@ -652,19 +706,24 @@ def decode_tenant_payload(payload: bytes, context: str = "") -> Dict[str, Any]:
         )
     try:
         header = json.loads(body[4 : 4 + header_len].decode())
-        keys = list(header["keys"])
-        version = header["v"]
-    except (ValueError, KeyError, UnicodeDecodeError) as err:
+        header["keys"] = list(header["keys"])
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as err:
         raise SyncIntegrityError(f"Unparseable migration payload header{context}: {err}") from err
-    if version != _PAYLOAD_VERSION:
-        raise SyncIntegrityError(
-            f"Migration payload version {version!r} unsupported{context};"
-            f" this build speaks v{_PAYLOAD_VERSION}.",
-            transient=False,
-        )
-    offset = 4 + header_len
+    header["_body"] = body
+    header["_offset"] = 4 + header_len
+    return header
+
+
+def _payload_version_of(payload: bytes) -> Any:
+    return _payload_header(payload, "").get("v")
+
+
+def _decode_payload_blocks(payload: bytes, context: str, verify: bool) -> Dict[str, Any]:
+    header = _payload_header(payload, context)
+    body = header["_body"]
+    offset = header["_offset"]
     tree: Dict[str, Any] = {}
-    for key in keys:
+    for key in header["keys"]:
         if offset + 8 > len(body):
             raise SyncIntegrityError(f"Truncated migration payload at block {key!r}{context}.")
         (size,) = struct.unpack(">Q", body[offset : offset + 8])
@@ -677,8 +736,31 @@ def decode_tenant_payload(payload: bytes, context: str = "") -> Dict[str, Any]:
         tree[key] = _groups._decode(body[offset : offset + size], context)
         offset += size
     expected = header.get("digest")
-    if expected:
+    if verify and expected:
         from metrics_tpu.resilience import integrity as _integrity
 
         _integrity.verify_tree(tree, expected, context=context)
     return tree
+
+
+def _decode_payload_v1(payload: bytes, context: str) -> Dict[str, Any]:
+    # pre-integrity payloads seal no digest map — nothing to attest
+    return _decode_payload_blocks(payload, context, verify=False)
+
+
+def _decode_payload_v2(payload: bytes, context: str) -> Dict[str, Any]:
+    return _decode_payload_blocks(payload, context, verify=True)
+
+
+def _upcast_payload_v1(tree: Dict[str, Any]) -> Dict[str, Any]:
+    """v1 -> v2: the decoded state tree is identical across versions — the
+    v2 digest map is a *transport* attestation sealed next to the state, not
+    state itself, so there is nothing to lift (the re-admit path re-seals at
+    current and records fresh digests)."""
+    return tree
+
+
+_schema.register_schema(
+    "payload", 1, _decode_payload_v1, upcast=_upcast_payload_v1, prober=_payload_version_of
+)
+_schema.register_schema("payload", 2, _decode_payload_v2)

@@ -42,6 +42,35 @@ class SyncIntegrityError(SyncError):
         self.transient = transient
 
 
+class SchemaVersionError(RuntimeError):
+    """A durable artifact carries a schema version this build cannot decode.
+
+    Raised by the durable-schema registry (``resilience/schema.py``) when an
+    artifact's version is *ahead* of what this build speaks (a downgrade —
+    bytes written by a newer build; refusing to guess beats replaying
+    misparsed state), or is simply unregistered for its family. Old-but-
+    registered versions never raise: they decode and walk the upcast chain
+    to current. Distinct from :class:`SyncIntegrityError` on purpose — the
+    bytes are *intact* (crc passed); the build is just too old or too new to
+    speak them, and that must read as a version-skew problem in a stack
+    trace, never a crc mystery. Carries ``family``/``version``/``current``
+    so operators can see the gap without a debugger.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        family: object = None,
+        version: object = None,
+        current: object = None,
+    ) -> None:
+        super().__init__(message)
+        self.family = family
+        self.version = version
+        self.current = current
+
+
 class StateIntegrityError(RuntimeError):
     """Device-resident (or durably stored) metric state failed attestation.
 

@@ -1,0 +1,120 @@
+"""The golden-artifact compat corpus (ISSUE 18): sealed bytes of every
+durable artifact family, at every schema version ever shipped, decode
+through the durable-schema registry FOREVER — plus a deliberately-future
+version per family that must keep being rejected by name. A failure here
+means the current build broke decoding of bytes a released build wrote.
+
+Regenerate ONLY on a deliberate schema bump: ``python tools/gen_golden.py``
+(see its docstring — never regenerate to silence this file).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from metrics_tpu.resilience import schema
+from metrics_tpu.utils.exceptions import SchemaVersionError
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+with open(os.path.join(GOLDEN_DIR, "index.json")) as _fh:
+    _INDEX = json.load(_fh)["artifacts"]
+
+
+def _load(entry):
+    with open(os.path.join(GOLDEN_DIR, entry["file"]), "rb") as fh:
+        raw = fh.read()
+    # manifests are JSON documents, not sealed binary — the registry decodes
+    # the parsed doc (exactly what load_manifest hands it)
+    return json.loads(raw.decode("utf-8")) if entry["file"].endswith(".json") else raw
+
+
+@pytest.mark.parametrize(
+    "entry", [e for e in _INDEX if e["expect"] == "ok"], ids=lambda e: e["file"]
+)
+def test_every_shipped_version_still_decodes(entry):
+    decoded = schema.decode_any(entry["family"], _load(entry), context=" (golden)")
+    assert decoded is not None
+
+
+@pytest.mark.parametrize(
+    "entry", [e for e in _INDEX if e["expect"] == "reject"], ids=lambda e: e["file"]
+)
+def test_every_future_version_still_rejects_by_name(entry):
+    with pytest.raises(SchemaVersionError, match="NEWER build") as exc:
+        schema.decode_any(entry["family"], _load(entry), context=" (golden)")
+    assert exc.value.family == entry["family"]
+    assert exc.value.version == entry["version"]
+    assert exc.value.current == schema.current_version(entry["family"])
+
+
+def test_corpus_covers_every_registered_family():
+    covered = {e["family"] for e in _INDEX}
+    missing = set(schema.registered_families()) - covered
+    assert not missing, (
+        f"durable families {sorted(missing)} have no golden artifacts — add"
+        " them to tools/gen_golden.py; every registered family is decoded in"
+        " CI forever"
+    )
+    # and every SHIPPED version of every family is pinned
+    for family in schema.registered_families():
+        pinned = {e["version"] for e in _INDEX if e["family"] == family and e["expect"] == "ok"}
+        assert pinned == set(schema.registered_versions(family)), family
+
+
+def test_journal_v1_upcasts_to_unattested_current():
+    entry = next(e for e in _INDEX if e["file"] == "journal_v1.bin")
+    record = schema.decode_any("journal", _load(entry))
+    assert record["v"] == schema.current_version("journal")
+    assert record["digest"] is None  # pre-integrity => explicitly unattested
+    assert record["op"] == "admit" and record["count"] == 3
+
+
+def test_payload_v1_and_v2_decode_to_the_same_tree():
+    v1 = schema.decode_any("payload", _load(next(e for e in _INDEX if e["file"] == "payload_v1.bin")))
+    v2 = schema.decode_any("payload", _load(next(e for e in _INDEX if e["file"] == "payload_v2.bin")))
+    assert sorted(v1) == sorted(v2) == ["count", "total"]
+    for key in v1:
+        a, b = np.asarray(v1[key]), np.asarray(v2[key])
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
+    np.testing.assert_array_equal(np.asarray(v2["total"]), np.arange(6, dtype=np.float32) * 0.5)
+
+
+def test_wire_goldens_decode_to_the_sealed_array():
+    want = np.arange(12, dtype=np.float32).reshape(3, 4) / 7.0
+    v1 = schema.decode_any("wire", _load(next(e for e in _INDEX if e["file"] == "wire_v1.bin")))
+    np.testing.assert_array_equal(np.asarray(v1), want)  # exact: bit-for-bit
+    v2 = schema.decode_any("wire", _load(next(e for e in _INDEX if e["file"] == "wire_v2.bin")))
+    assert np.asarray(v2).shape == want.shape
+    np.testing.assert_allclose(np.asarray(v2), want, rtol=1e-2)  # bf16: lossy by design
+
+
+def test_snapshot_golden_restores_the_carry():
+    entry = next(e for e in _INDEX if e["file"] == "snapshot_v1.bin")
+    snap = schema.decode_any("snapshot", _load(entry))
+    assert snap.step == 3 and snap.final is False
+    assert sorted(snap.states) == ["m0"]
+    np.testing.assert_array_equal(
+        np.asarray(snap.states["m0"]["total"]), np.arange(6, dtype=np.float32) * 0.5
+    )
+
+
+def test_regeneration_is_byte_stable():
+    """The sealed encoders must stay byte-stable: regenerating the corpus
+    in-memory reproduces the committed files exactly. A diff here means an
+    ENCODER changed shape — which silently orphans every artifact already
+    on disk in production, version bump or not."""
+    from tools.gen_golden import build_corpus
+
+    on_disk = {e["file"]: _load_raw(e["file"]) for e in _INDEX}
+    regenerated = {name: payload for name, _f, _v, _e, payload in build_corpus()}
+    assert sorted(on_disk) == sorted(regenerated)
+    for name in sorted(on_disk):
+        assert on_disk[name] == regenerated[name], f"{name} drifted from the committed golden"
+
+
+def _load_raw(filename):
+    with open(os.path.join(GOLDEN_DIR, filename), "rb") as fh:
+        return fh.read()

@@ -1,0 +1,180 @@
+"""Mixed-version sync groups (ISSUE 18): peers advertise the wire versions
+they speak, the group settles on the highest COMMON version per exchange,
+and quantized tags transparently fall back to exact on a v1-only group —
+so a half-upgraded fleet keeps syncing, bit-identical to an all-v1 fleet,
+under the same injected faults the exchange layer already survives. Truly
+unknown versions keep the PR-2 hard rejection."""
+import numpy as np
+import pytest
+
+from metrics_tpu.parallel import new_group
+from metrics_tpu.parallel.groups import (
+    SUPPORTED_WIRE_VERSIONS,
+    WIRE_VERSION,
+    gather_group_arrays,
+    negotiation_stats,
+    reset_negotiation_stats,
+    speaking,
+    spoken_wire_versions,
+)
+from metrics_tpu.parallel.quantize import reset_wire_stats, wire_stats
+from metrics_tpu.resilience import FaultSpec, InMemoryKVStore, RetryPolicy, run_as_peers
+from metrics_tpu.utils.exceptions import SyncIntegrityError
+
+FAST_RETRY = RetryPolicy(max_attempts=4, backoff_base_s=0.01, backoff_max_s=0.05)
+
+_seq = [0]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    reset_negotiation_stats()
+    reset_wire_stats()
+    yield
+    reset_negotiation_stats()
+    reset_wire_stats()
+
+
+def make_group(world=3, timeout_s=5.0):
+    _seq[0] += 1
+    return new_group(range(world), name=f"mixver{_seq[0]}", timeout_s=timeout_s, retry=FAST_RETRY)
+
+
+def _payload(rank):
+    # deterministic, rank-distinct, not bf16-representable exactly — so a
+    # quantized exchange would visibly round, and bit-identity to the exact
+    # inputs PROVES the group fell back to v1
+    return (np.arange(8, dtype=np.float32) + 100.0 * rank) / 7.0
+
+
+def _gather(rank, group, old_ranks=(), policy="raise", report=None):
+    """One rank's exchange: old-build ranks speak only v1; every rank ASKS
+    for a quantized sync (the new-build default once quantization is on)."""
+    if rank in old_ranks:
+        with speaking(WIRE_VERSION):
+            assert spoken_wire_versions() == (WIRE_VERSION,)
+            return gather_group_arrays(
+                _payload(rank), group, policy=policy, report=report, precision="bf16"
+            )
+    return gather_group_arrays(
+        _payload(rank), group, policy=policy, report=report, precision="bf16"
+    )
+
+
+def test_mixed_group_negotiates_down_to_exact():
+    group = make_group()
+    out = run_as_peers(3, lambda rank: _gather(rank, group, old_ranks=(2,)))
+    for rank in range(3):
+        for peer in range(3):
+            got = np.asarray(out[rank][peer])
+            assert got.dtype == np.float32
+            # EXACT bytes of the float32 inputs: the v2-capable peers fell
+            # back rather than quantizing at the v1-only peer
+            assert got.tobytes() == _payload(peer).tobytes()
+    stats = negotiation_stats()
+    assert stats["negotiations"] == 3
+    assert stats["capped"] == 2  # the two v2-capable peers settled below max
+    assert stats["fallback_exact"] == 3  # every peer dropped its bf16 tag
+    assert wire_stats()["codec_counts"].get("bf16", 0) == 0
+
+
+def test_mixed_group_is_bit_identical_to_all_v1_group():
+    mixed_group = make_group()
+    mixed = run_as_peers(3, lambda rank: _gather(rank, mixed_group, old_ranks=(2,)))
+    v1_group = make_group()
+    all_v1 = run_as_peers(3, lambda rank: _gather(rank, v1_group, old_ranks=(0, 1, 2)))
+    for rank in range(3):
+        for peer in range(3):
+            assert (
+                np.asarray(mixed[rank][peer]).tobytes()
+                == np.asarray(all_v1[rank][peer]).tobytes()
+            )
+
+
+def test_all_current_group_still_quantizes():
+    group = make_group()
+    out = run_as_peers(3, lambda rank: _gather(rank, group, old_ranks=()))
+    assert negotiation_stats()["fallback_exact"] == 0
+    assert wire_stats()["codec_counts"].get("bf16", 0) >= 3
+    # bf16 rounding is visible — this exchange did NOT silently fall back
+    assert np.asarray(out[0][1]).tobytes() != _payload(1).tobytes()
+    np.testing.assert_allclose(np.asarray(out[0][1]), _payload(1), rtol=1e-2)
+
+
+def test_negotiated_exchange_survives_corrupt_faults():
+    """The negotiation keys are not fault-matchable (non-integer epoch
+    segment), so corruption hits the DATA exchange exactly as it always
+    did — retried clean — and the mixed group still lands bit-identical."""
+    group = make_group()
+    store = InMemoryKVStore(
+        [
+            FaultSpec("corrupt", rank=0, epoch=0, times=2),
+            FaultSpec("corrupt", rank=1, epoch=0, times=1),
+        ]
+    )
+    out = run_as_peers(3, lambda rank: _gather(rank, group, old_ranks=(2,)), store=store)
+    for rank in range(3):
+        for peer in range(3):
+            assert np.asarray(out[rank][peer]).tobytes() == _payload(peer).tobytes()
+    assert negotiation_stats()["capped"] == 2
+
+
+def test_dropped_peer_under_partial_policy_keeps_the_negotiated_cap():
+    """A DROPPED (dead) new-build peer must not stall the mixed group: under
+    ``policy='partial'`` the survivors — one of them old-build — still
+    settle on v1 and exchange exact, with the missing rank recorded."""
+    from metrics_tpu.resilience import new_sync_stats
+
+    group = make_group(timeout_s=1.5)
+    store = InMemoryKVStore([FaultSpec("drop", rank=1, epoch=0)])
+    reports = {r: new_sync_stats() for r in range(3)}
+    out = run_as_peers(
+        3,
+        lambda rank: _gather(rank, group, old_ranks=(2,), policy="partial", report=reports[rank]),
+        store=store,
+    )
+    # partial compacts to the responders, ordered by rank: [rank0, rank2]
+    assert len(out[0]) == 2 and len(out[2]) == 2
+    assert reports[0]["missing_ranks"] == [1]
+    # the delivered payloads are the exact float32 inputs — negotiation held
+    assert np.asarray(out[0][1]).tobytes() == _payload(2).tobytes()
+    assert np.asarray(out[2][0]).tobytes() == _payload(0).tobytes()
+
+
+def test_negotiated_exchange_survives_a_flaky_peer():
+    """A flaky OLD-build peer (intermittent KV read failures on its
+    payload) heals within the retry budget; the negotiated fallback holds."""
+    group = make_group()
+    store = InMemoryKVStore([FaultSpec("flaky", rank=2, times=1)])
+    out = run_as_peers(3, lambda rank: _gather(rank, group, old_ranks=(2,)), store=store)
+    for rank in range(3):
+        for peer in range(3):
+            assert np.asarray(out[rank][peer]).tobytes() == _payload(peer).tobytes()
+
+
+def test_disjoint_versions_fail_closed_with_upgrade_guidance():
+    """No common spoken version is a configuration error, named loudly —
+    never a retry loop or a misparse."""
+    group = make_group(world=2, timeout_s=2.0)
+
+    def peer(rank):
+        # rank 0 speaks only v1, rank 1 only v2: intersection is empty
+        with speaking(WIRE_VERSION if rank == 0 else max(SUPPORTED_WIRE_VERSIONS)):
+            with pytest.raises(SyncIntegrityError, match="No common wire version"):
+                gather_group_arrays(_payload(rank), group)
+        return True
+
+    assert run_as_peers(2, peer) == {0: True, 1: True}
+
+
+def test_unknown_future_wire_version_still_hard_rejects():
+    """PR-2 contract preserved: bytes carrying a version NO build speaks
+    raise non-transient SyncIntegrityError naming both sides."""
+    import zlib
+
+    from metrics_tpu.parallel.groups import _ENVELOPE, _WIRE_MAGIC, unpack_envelope
+
+    body = b"from-the-future"
+    forged = _ENVELOPE.pack(_WIRE_MAGIC, 99, zlib.crc32(body)) + body
+    with pytest.raises(SyncIntegrityError, match="99"):
+        unpack_envelope(forged, " (test)")

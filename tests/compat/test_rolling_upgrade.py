@@ -1,0 +1,228 @@
+"""Rolling fleet upgrades with canary auto-rollback (ISSUE 18 tentpole):
+one worker at a time drains, a new-build cell takes its id (and, by
+rendezvous, its tenants back), the FIRST replacement is held as a canary in
+FleetGuard probation with shadow-replay audit forced to every flush — an
+integrity breach rolls the fleet back to the old build, with zero acked
+requests lost in either direction."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, engine
+from metrics_tpu import fleet as flt
+from metrics_tpu.obs import bus as _bus
+from metrics_tpu.resilience import faults
+from metrics_tpu.serving import MemoryStore, MetricBank
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+NUM_CLASSES = 4
+TENANTS = [f"t{i}" for i in range(8)]
+
+pytestmark = pytest.mark.upgrade
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    engine.clear_cache()
+    _bus.clear()
+    yield
+    engine.clear_cache()
+    _bus.disable()
+    _bus.clear()
+
+
+def _traffic(step, i):
+    rng = np.random.RandomState(1000 * step + i)
+    return (
+        jnp.asarray(rng.rand(8, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, size=8).astype(np.int32)),
+    )
+
+
+def _make_fleet(workers=(0, 1, 2, 3)):
+    return flt.Fleet(
+        Accuracy(num_classes=NUM_CLASSES), workers=list(workers), capacity=8,
+        durable_store=MemoryStore(), checkpoint_every_n_flushes=1,
+        max_delay_s=None, fault_plan=faults.parse_plan("[]"),
+    )
+
+
+def _make_guard(fleet):
+    return flt.FleetGuard(
+        fleet, probation_after=1, eject_after=2, min_workers=2,
+        latency_threshold_ms=60_000.0, error_rate_threshold=0.5,
+    )
+
+
+def _pump(fleet, step_box):
+    step = step_box[0]
+    step_box[0] += 1
+    for i, t in enumerate(TENANTS):
+        fleet.submit(t, *_traffic(step, i))
+    fleet.flush()
+
+
+def _solo_values(n_steps):
+    solo = MetricBank(Accuracy(num_classes=NUM_CLASSES), 8, name="solo-ref")
+    for t in TENANTS:
+        solo.admit(t)
+    for step in range(n_steps):
+        for i, t in enumerate(TENANTS):
+            solo.update(t, *_traffic(step, i))
+    return {t: np.asarray(solo.compute(t)) for t in TENANTS}
+
+
+def test_rolling_upgrade_is_invisible_to_tenants():
+    """Mid-traffic upgrade of every worker: values bit-identical to a
+    static fleet fed the same stream, zero acked requests lost."""
+    fleet, static = _make_fleet(), _make_fleet()
+    steps, static_steps = [0], [0]
+    for _ in range(3):
+        _pump(fleet, steps)
+        _pump(static, static_steps)
+    guard = _make_guard(fleet)
+    try:
+        report = fleet.rolling_upgrade(
+            lambda wid, f: f.build_worker(wid),
+            guard=guard,
+            canary_steps=4,
+            on_step=lambda f: _pump(f, steps),
+        )
+    finally:
+        guard.close()
+    assert report["rolled_back"] is False and report["breach"] is None
+    assert sorted(report["upgraded"]) == [0, 1, 2, 3]
+    assert report["canary"] == 0
+    assert report["audit"]["checked"] >= 1 and report["audit"]["failed"] == 0
+    assert fleet.stats["upgrades"] == 4 and fleet.stats["rollbacks"] == 0
+    while static_steps[0] < steps[0]:
+        _pump(static, static_steps)
+    upgraded_vals = fleet.compute_all()
+    static_vals = static.compute_all()
+    for t in TENANTS:
+        assert np.asarray(upgraded_vals[t]).tobytes() == np.asarray(static_vals[t]).tobytes(), t
+
+
+def test_canary_integrity_breach_rolls_back_to_old_build():
+    """A new build that corrupts state (bitflip fault plan riding only the
+    factory-built workers) is caught by the canary's forced shadow audit
+    and rolled back — the fleet returns to the old build with every applied
+    request accounted for, bit-identical to a solo replay."""
+    fleet = _make_fleet()
+    steps = [0]
+    for _ in range(3):
+        _pump(fleet, steps)
+    guard = _make_guard(fleet)
+    bad_plan = faults.parse_plan('[{"kind": "bitflip", "rank": 0, "times": 8}]')
+    events = []
+    _bus.subscribe(lambda e: events.append(e.data.get("event")) if e.kind == "upgrade" else None)
+    try:
+        report = fleet.rolling_upgrade(
+            lambda wid, f: f.build_worker(wid, fault_plan=bad_plan),
+            guard=guard,
+            canary_steps=6,
+            on_step=lambda f: _pump(f, steps),
+        )
+    finally:
+        guard.close()
+    assert report["rolled_back"] is True
+    assert "integrity" in report["breach"]
+    assert report["upgraded"] == []  # the rollout aborted at the canary
+    assert report["audit"]["failed"] >= 1
+    assert fleet.stats["rollbacks"] == 1
+    # the fleet is whole again, on the OLD build: same membership, and the
+    # rejoined worker carries no injected corruption seam
+    assert sorted(fleet.epoch.workers) == [0, 1, 2, 3]
+    assert fleet._workers[0].bank.state_fault_injector is None
+    # zero acked requests lost THROUGH the rollback: solo bit-identity
+    want = _solo_values(steps[0])
+    got = fleet.compute_all()
+    for t in TENANTS:
+        assert np.asarray(got[t]).tobytes() == want[t].tobytes(), t
+    # the lifecycle was narrated on the bus
+    assert events[:3] == ["drain", "replace", "canary_hold"]
+    assert "rollback" in events and events[-1] == "complete"
+
+
+def test_post_rollback_fleet_keeps_serving():
+    fleet = _make_fleet()
+    steps = [0]
+    _pump(fleet, steps)
+    guard = _make_guard(fleet)
+    bad_plan = faults.parse_plan('[{"kind": "bitflip", "rank": 0, "times": 8}]')
+    try:
+        fleet.rolling_upgrade(
+            lambda wid, f: f.build_worker(wid, fault_plan=bad_plan),
+            guard=guard,
+            canary_steps=6,
+            on_step=lambda f: _pump(f, steps),
+        )
+        for _ in range(3):
+            _pump(fleet, steps)
+    finally:
+        guard.close()
+    want = _solo_values(steps[0])
+    got = fleet.compute_all()
+    for t in TENANTS:
+        assert np.asarray(got[t]).tobytes() == want[t].tobytes(), t
+
+
+def test_canary_without_guard_still_audits_and_rolls_back():
+    """The guard is optional — the forced shadow audit alone catches a
+    corrupting canary."""
+    fleet = _make_fleet()
+    steps = [0]
+    for _ in range(2):
+        _pump(fleet, steps)
+    bad_plan = faults.parse_plan('[{"kind": "bitflip", "rank": 0, "times": 8}]')
+    report = fleet.rolling_upgrade(
+        lambda wid, f: f.build_worker(wid, fault_plan=bad_plan),
+        canary_steps=6,
+        on_step=lambda f: _pump(f, steps),
+    )
+    assert report["rolled_back"] is True and "integrity" in report["breach"]
+    want = _solo_values(steps[0])
+    got = fleet.compute_all()
+    for t in TENANTS:
+        assert np.asarray(got[t]).tobytes() == want[t].tobytes(), t
+
+
+def test_rolling_upgrade_needs_at_least_two_workers():
+    fleet = flt.Fleet(
+        Accuracy(num_classes=NUM_CLASSES), workers=[0], capacity=4, max_delay_s=None
+    )
+    with pytest.raises(MetricsUserError, match="at least 2 workers"):
+        fleet.rolling_upgrade(lambda wid, f: f.build_worker(wid))
+
+
+def test_factory_returning_none_falls_back_to_default_build():
+    fleet = _make_fleet((0, 1))
+    steps = [0]
+    _pump(fleet, steps)
+    report = fleet.rolling_upgrade(
+        lambda wid, f: None, canary_steps=2, on_step=lambda f: _pump(f, steps)
+    )
+    assert report["rolled_back"] is False and sorted(report["upgraded"]) == [0, 1]
+
+
+def test_hold_probation_heals_after_clean_observations():
+    """A held canary EARNS healthy: recover_after consecutive clean
+    observations with fresh signal heal it through the guard's ordinary
+    hysteresis."""
+    fleet = _make_fleet((0, 1))
+    guard = flt.FleetGuard(
+        fleet, probation_after=1, eject_after=2, recover_after=2, min_workers=1,
+        latency_threshold_ms=60_000.0, error_rate_threshold=0.5,
+    )
+    steps = [0]
+    try:
+        guard.hold_probation(0)
+        assert guard.worker_states()[0] == "probation"
+        for _ in range(4):
+            _pump(fleet, steps)
+            guard.observe()
+        assert guard.worker_states()[0] == "healthy"
+        assert guard.stats["probations"] == 1 and guard.stats["recoveries"] == 1
+    finally:
+        guard.close()

@@ -1,0 +1,172 @@
+"""Durable-schema registry (ISSUE 18): registration, probing, upcast
+chains, the future-version downgrade guard, compat telemetry — plus the
+pre-integrity (pre-PR-17) byte-fixture regressions for the journal and
+payload families through their REAL entry points."""
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from metrics_tpu.parallel import groups as _groups
+from metrics_tpu.resilience import schema
+from metrics_tpu.serving import store as _store
+from metrics_tpu.utils.exceptions import SchemaVersionError, SyncIntegrityError
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counters():
+    schema.reset_compat_stats()
+    yield
+    schema.reset_compat_stats()
+
+
+# ---------------------------------------------------------------------------
+# registry mechanics (a scratch family — never collides with the real ones)
+# ---------------------------------------------------------------------------
+def _scratch(name="scratch-test"):
+    schema.register_schema(
+        name, 1, lambda p, c: {"seen": 1, "raw": p}, upcast=lambda d: {**d, "seen": 2}
+    )
+    schema.register_schema(
+        name, 2, lambda p, c: {"seen": 2, "raw": p}, upcast=lambda d: {**d, "seen": 3}
+    )
+    schema.register_schema(name, 3, lambda p, c: {"seen": 3, "raw": p})
+    return name
+
+
+def test_decode_at_current_is_a_straight_decode():
+    fam = _scratch()
+    out = schema.decode_any(fam, b"x", version=3)
+    assert out["seen"] == 3
+    assert schema.compat_stats()[fam] == {
+        "versions": [1, 2, 3],
+        "current": 3,
+        "decodes": 1,
+        "upcasts": 0,
+        "rejects": 0,
+    }
+
+
+def test_decode_walks_the_full_upcast_chain():
+    fam = _scratch()
+    out = schema.decode_any(fam, b"x", version=1)
+    assert out["seen"] == 3  # 1 -> 2 -> 3
+    stats = schema.compat_stats()[fam]
+    assert stats["decodes"] == 1 and stats["upcasts"] == 2
+
+
+def test_future_version_raises_named_downgrade_guard():
+    fam = _scratch()
+    with pytest.raises(SchemaVersionError, match="NEWER build") as exc:
+        schema.decode_any(fam, b"x", version=9)
+    assert (exc.value.family, exc.value.version, exc.value.current) == (fam, 9, 3)
+    assert schema.compat_stats()[fam]["rejects"] == 1
+
+
+def test_unknown_old_version_rejects_without_newer_claim():
+    fam = _scratch()
+    with pytest.raises(SchemaVersionError, match="unknown schema version"):
+        schema.decode_any(fam, b"x", version=0)
+
+
+def test_broken_upcast_chain_is_loud():
+    name = "scratch-broken"
+    schema.register_schema(name, 1, lambda p, c: {})  # no upcast, below current
+    schema.register_schema(name, 2, lambda p, c: {})
+    with pytest.raises(SchemaVersionError, match="upcast"):
+        schema.decode_any(name, b"x", version=1)
+
+
+def test_reregistering_a_version_replaces_it():
+    name = "scratch-replace"
+    schema.register_schema(name, 1, lambda p, c: "old")
+    schema.register_schema(name, 1, lambda p, c: "new")
+    assert schema.decode_any(name, b"x", version=1) == "new"
+    assert list(schema.registered_versions(name)) == [1]
+
+
+def test_real_families_are_registered_at_import():
+    families = schema.registered_families()
+    for family in ("journal", "payload", "manifest", "snapshot", "wire"):
+        assert family in families, family
+    assert schema.current_version("journal") == _store.JOURNAL_VERSION
+    assert schema.current_version("payload") == _store._PAYLOAD_VERSION
+
+
+# ---------------------------------------------------------------------------
+# pre-PR-17 byte fixtures: digest-less journal records and payloads, built
+# exactly the way the pre-integrity builds sealed them
+# ---------------------------------------------------------------------------
+def _pre_integrity_journal_record(op="admit", count=5):
+    # the pre-PR-17 sealer: versioned JSON in the crc envelope, no digest
+    body = {"op": op, "t": ["s", "fixture"], "count": count, "v": 1}
+    return _groups.pack_envelope(json.dumps(body, sort_keys=True).encode("utf-8"))
+
+
+def _pre_integrity_payload(tree):
+    # the pre-PR-17 sealer: header carries v+keys only (no digest map)
+    keys = sorted(tree)
+    blocks = [_groups._encode(np.asarray(tree[k])) for k in keys]
+    header = json.dumps({"v": 1, "keys": keys}).encode()
+    body = struct.pack(">I", len(header)) + header
+    body += b"".join(struct.pack(">Q", len(b)) + b for b in blocks)
+    return _groups.pack_envelope(body)
+
+
+def test_pre_integrity_journal_record_unseals_through_real_entry_point():
+    record = _store.unseal_record(_pre_integrity_journal_record(), context=" (fixture)")
+    assert record["v"] == _store.JOURNAL_VERSION
+    assert record["digest"] is None
+    assert record["op"] == "admit" and record["count"] == 5
+    assert schema.compat_stats()["journal"]["upcasts"] == 1
+
+
+def test_pre_integrity_journal_replays_next_to_current_records():
+    """A journal whose head was written by a pre-PR-17 build and whose tail
+    by this one replays as ONE clean record stream."""
+    store = _store.MemoryStore()
+    store.append_journal("mixed", _pre_integrity_journal_record(count=1))
+    store.append_journal(
+        "mixed", _store.seal_record({"op": "admit", "t": ["s", "fixture"], "count": 2})
+    )
+    records, torn = _store.read_journal(store, "mixed")
+    assert torn == 0
+    assert [r["count"] for r in records] == [1, 2]
+    assert all(r["v"] == _store.JOURNAL_VERSION for r in records)
+
+
+def test_pre_integrity_payload_decodes_bit_identical():
+    tree = {
+        "total": np.linspace(0.0, 4.0, 9, dtype=np.float32),
+        "count": np.asarray(9, dtype=np.int64),
+    }
+    out = _store.decode_tenant_payload(_pre_integrity_payload(tree), context=" (fixture)")
+    assert sorted(out) == sorted(tree)
+    for key, want in tree.items():
+        got = np.asarray(out[key])
+        assert got.dtype == want.dtype and got.shape == want.shape
+        assert got.tobytes() == want.tobytes()
+    assert schema.compat_stats()["payload"]["upcasts"] == 1
+
+
+def test_pre_integrity_payload_corruption_still_fails_closed():
+    """The v1 route skips digest attestation (there is none) but NOT the
+    crc envelope — a flipped bit in an old payload still refuses to parse."""
+    payload = bytearray(_pre_integrity_payload({"total": np.arange(4, dtype=np.float32)}))
+    payload[len(payload) // 2] ^= 0x40
+    with pytest.raises(SyncIntegrityError):
+        _store.decode_tenant_payload(bytes(payload), context=" (fixture)")
+
+
+def test_future_journal_record_propagates_loudly_not_as_torn_tail():
+    """read_journal treats SyncIntegrityError as a torn tail; a FUTURE
+    version is not a torn tail — it must escape as SchemaVersionError, or a
+    downgrade would silently truncate a newer build's journal."""
+    store = _store.MemoryStore()
+    future = _groups.pack_envelope(
+        json.dumps({"op": "admit", "t": ["s", "x"], "v": 99}).encode("utf-8")
+    )
+    store.append_journal("future", future)
+    with pytest.raises(SchemaVersionError, match="NEWER build"):
+        _store.read_journal(store, "future")

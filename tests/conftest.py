@@ -79,6 +79,12 @@ def pytest_configure(config):
         "integrity: state-integrity plane (attestation digests, shadow-replay"
         " audit, bitflip injection, quarantine repair); select with -m integrity",
     )
+    config.addinivalue_line(
+        "markers",
+        "upgrade: version-skew survival (durable-schema registry, negotiated"
+        " wire, rolling fleet upgrades with canary auto-rollback); select with"
+        " -m upgrade",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -73,10 +73,24 @@ def test_record_save_load_round_trip(tmp_path):
 
 
 def test_load_rejects_unknown_version(tmp_path):
+    """A manifest from a NEWER build raises the registry's typed skew error
+    (downgrade guard, ISSUE 18) — by name, never a parse mystery."""
+    from metrics_tpu.utils.exceptions import SchemaVersionError
+
     path = tmp_path / "bad.json"
     path.write_text(json.dumps({"version": 99, "entries": []}))
-    with pytest.raises(ValueError, match="version"):
+    with pytest.raises(SchemaVersionError, match="NEWER build"):
         wm.load_manifest(str(path))
+
+
+def test_load_upcasts_older_version_with_warning(tmp_path):
+    """A v1 manifest (older build) loads through the registry: upcast to
+    current, one warning naming the gap — never a failed worker join."""
+    path = tmp_path / "old.json"
+    path.write_text(json.dumps({"version": 1, "entries": []}))
+    with pytest.warns(RuntimeWarning, match="schema v1"):
+        doc = wm.load_manifest(str(path))
+    assert doc["version"] == wm.MANIFEST_VERSION
 
 
 def test_save_needs_a_path(monkeypatch):
@@ -425,8 +439,11 @@ def test_repeated_warmup_reports_stable_counters(tmp_path):
 
 
 def test_warmup_validates_dict_manifests():
-    with pytest.raises(ValueError, match="version"):
-        wm.warmup({"version": 99, "entries": []})
+    # a future-version manifest must not raise out of warmup(): a warm start
+    # is an optimization, never a join gate — warn + cold compile (ISSUE 18)
+    with pytest.warns(RuntimeWarning, match="cold-compile"):
+        report = wm.warmup({"version": 99, "entries": []})
+    assert report["skipped"].get("manifest_version_skew") == 1
     with pytest.raises(ValueError, match="entry list"):
         wm.warmup({"version": wm.MANIFEST_VERSION})
 

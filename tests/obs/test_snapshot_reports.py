@@ -304,6 +304,7 @@ def test_jsonl_roundtrip_and_prometheus_render():
         "integrity",
         "guard",
         "kernels",
+        "compat",
         "bus",
         "spans",
         "warnings",

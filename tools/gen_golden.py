@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Regenerate the golden durable-artifact compat corpus.
+
+``tests/compat/golden/`` holds sealed bytes of every durable artifact
+family (wire payload, tenant payload, journal record, drive snapshot,
+warmup manifest) at every schema version this project has ever shipped,
+plus a deliberately-future version per family. ``tests/compat/test_golden.py``
+decodes every one of them through the durable-schema registry in CI,
+forever: an artifact a released build wrote must keep decoding (or keep
+being *rejected by name*, for the future versions) on every build after it.
+
+Run this ONLY on a deliberate schema bump:
+
+    JAX_PLATFORMS=cpu python tools/gen_golden.py
+
+and commit the diff. Never regenerate to make a failing compat test pass —
+a failing golden means the new code broke decoding of bytes a released
+build wrote, which is exactly the regression the corpus exists to catch.
+Inputs are fixed (np.arange, no clocks, no RNG), so regeneration is
+deterministic and spurious diffs mean a codec changed.
+"""
+import json
+import os
+import struct
+import sys
+import zlib
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import importlib  # noqa: E402
+
+_driver = importlib.import_module("metrics_tpu.engine.driver")  # noqa: E402
+_warmup = importlib.import_module("metrics_tpu.engine.warmup")  # noqa: E402
+from metrics_tpu.parallel import groups as _groups  # noqa: E402
+from metrics_tpu.serving import store as _store  # noqa: E402
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "..", "tests", "compat", "golden")
+
+
+def _arr() -> np.ndarray:
+    return np.arange(12, dtype=np.float32).reshape(3, 4) / 7.0
+
+
+def _tree():
+    return {
+        "total": np.arange(6, dtype=np.float32) * 0.5,
+        "count": np.asarray(6, dtype=np.int32),
+    }
+
+
+def _future_envelope(body: bytes) -> bytes:
+    # pack_envelope refuses to seal unknown versions (by design); a future
+    # build's bytes are forged directly against the envelope struct
+    return _groups._ENVELOPE.pack(_groups._WIRE_MAGIC, 99, zlib.crc32(body)) + body
+
+
+def _payload_with_header_version(version) -> bytes:
+    tree = _tree()
+    keys = sorted(tree)
+    blocks = [_groups._encode(np.asarray(tree[k])) for k in keys]
+    header = json.dumps({"v": version, "keys": keys}).encode()
+    body = struct.pack(">I", len(header)) + header
+    body += b"".join(struct.pack(">Q", len(b)) + b for b in blocks)
+    return _groups.pack_envelope(body)
+
+
+def _snapshot_with_meta_version(version) -> bytes:
+    flat = {f"m0{_driver._SNAP_SEP}{k}": v for k, v in _tree().items()}
+    inner = _store.encode_tenant_payload(flat, precisions=None)
+    meta = json.dumps(
+        {"v": version, "step": 3, "final": False, "keys": ["m0"], "dyn": {}}
+    ).encode("utf-8")
+    return _groups.pack_envelope(struct.pack(">I", len(meta)) + meta + inner)
+
+
+def _manifest_doc(version) -> dict:
+    return {
+        "version": version,
+        "entries": [
+            {
+                "metric": "Accuracy",
+                "kwargs": {"num_classes": 4},
+                "signature": [["f32", [8, 4]], ["i32", [8]]],
+            }
+        ],
+    }
+
+
+def build_corpus():
+    """Every golden artifact: (filename, family, version, expect, bytes)."""
+    artifacts = []
+
+    # -- wire: one PR-8 array payload per envelope version ----------------
+    arr = _arr()
+    wire_v1 = _groups._encode(arr)  # exact => v1 bytes
+    wire_v2 = _groups._encode(arr, "bf16")  # quantized => v2 bytes
+    assert wire_v1[2] == _groups.WIRE_VERSION
+    assert wire_v2[2] == _groups.WIRE_VERSION_QUANTIZED
+    artifacts += [
+        ("wire_v1.bin", "wire", 1, "ok", wire_v1),
+        ("wire_v2.bin", "wire", 2, "ok", wire_v2),
+        ("wire_v99.bin", "wire", 99, "reject", _future_envelope(wire_v1[7:])),
+    ]
+
+    # -- journal: write-ahead tenant records ------------------------------
+    token = ["s", "golden-tenant"]
+    v1_record = {"op": "admit", "t": token, "count": 3, "v": 1}
+    journal_v1 = _groups.pack_envelope(json.dumps(v1_record, sort_keys=True).encode("utf-8"))
+    journal_v2 = _store.seal_record({"op": "admit", "t": token, "count": 3, "digest": "00" * 8})
+    journal_v99 = _groups.pack_envelope(
+        json.dumps({"op": "admit", "t": token, "v": 99}, sort_keys=True).encode("utf-8")
+    )
+    artifacts += [
+        ("journal_v1.bin", "journal", 1, "ok", journal_v1),
+        ("journal_v2.bin", "journal", 2, "ok", journal_v2),
+        ("journal_v99.bin", "journal", 99, "reject", journal_v99),
+    ]
+
+    # -- payload: sealed tenant checkpoint trees --------------------------
+    artifacts += [
+        ("payload_v1.bin", "payload", 1, "ok", _payload_with_header_version(1)),
+        ("payload_v2.bin", "payload", 2, "ok", _store.encode_tenant_payload(_tree())),
+        ("payload_v99.bin", "payload", 99, "reject", _payload_with_header_version(99)),
+    ]
+
+    # -- snapshot: drive() mid-epoch carries ------------------------------
+    flat_states = {"m0": _tree()}
+    artifacts += [
+        (
+            "snapshot_v1.bin",
+            "snapshot",
+            1,
+            "ok",
+            _driver._seal_snapshot(flat_states, step=3, final=False),
+        ),
+        ("snapshot_v99.bin", "snapshot", 99, "reject", _snapshot_with_meta_version(99)),
+    ]
+
+    # -- manifest: AOT warmup manifests (JSON documents) ------------------
+    artifacts += [
+        (
+            "manifest_v1.json",
+            "manifest",
+            1,
+            "ok",
+            json.dumps(_manifest_doc(1), sort_keys=True, indent=1).encode("utf-8"),
+        ),
+        (
+            "manifest_v2.json",
+            "manifest",
+            _warmup.MANIFEST_VERSION,
+            "ok",
+            json.dumps(_manifest_doc(_warmup.MANIFEST_VERSION), sort_keys=True, indent=1).encode(
+                "utf-8"
+            ),
+        ),
+        (
+            "manifest_v99.json",
+            "manifest",
+            99,
+            "reject",
+            json.dumps(_manifest_doc(99), sort_keys=True, indent=1).encode("utf-8"),
+        ),
+    ]
+    return artifacts
+
+
+def main() -> int:
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    artifacts = build_corpus()
+    index = []
+    for filename, family, version, expect, payload in artifacts:
+        with open(os.path.join(GOLDEN_DIR, filename), "wb") as fh:
+            fh.write(payload)
+        index.append(
+            {"file": filename, "family": family, "version": version, "expect": expect}
+        )
+        print(f"  wrote {filename:<20} family={family:<9} v{version:<3} expect={expect}")
+    with open(os.path.join(GOLDEN_DIR, "index.json"), "w") as fh:
+        json.dump({"artifacts": index}, fh, sort_keys=True, indent=1)
+        fh.write("\n")
+    print(f"{len(index)} golden artifacts -> {os.path.relpath(GOLDEN_DIR)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
