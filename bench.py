@@ -1963,6 +1963,232 @@ def bench_cold_start() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# pod-scale serving banks: tenant sharding, bank-level drive, warm restart
+# ---------------------------------------------------------------------------
+# restart-to-first-result child for the pod lane: an UNSHARDED bank (mesh-
+# bound cache entries deliberately don't record into manifests) whose first
+# request is a whole bank.drive epoch — the manifest must cover the
+# bank_drive program family for the warm restart to skip its trace+compile.
+_POD_DRIVE_CHILD = r"""
+import json, os, sys, time
+forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("METRICS_TPU_BENCH_PLATFORM")
+import jax
+if forced:
+    jax.config.update("jax_platforms", forced)
+import numpy as np
+import jax.numpy as jnp
+import metrics_tpu as mt            # env-wired warmup (if any) happens HERE
+from metrics_tpu.serving import MetricBank
+
+rng = np.random.default_rng(5)
+bank = MetricBank(mt.Accuracy(num_classes=8), capacity=4)
+steps = [
+    (
+        jnp.asarray(rng.uniform(size=(16, 8)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, 8, size=(16,)).astype(np.int32)),
+    )
+    for _ in range(6)
+]
+t0 = time.perf_counter()
+mt.engine.drive_bank(bank, "epoch", steps)
+jax.block_until_ready(bank._bank)
+first_ms = (time.perf_counter() - t0) * 1e3
+digest = np.asarray(bank.compute("epoch")).tobytes().hex()
+wr = sys.modules["metrics_tpu.engine.warmup"].warmup_report()
+print(json.dumps({
+    "first_ms": round(first_ms, 3),
+    "digest": digest,
+    "programs_warmed": wr["programs_warmed"],
+    "warmed_hits": wr["warmed_hits"],
+    "stale_total": wr["stale_total"],
+}))
+"""
+
+
+def bench_pod_bank() -> dict:
+    """Pod-scale serving banks (ISSUE 20). Asserted by the ``ci.sh
+    --pod-smoke`` lane:
+
+    1. **Bit-identity at the pod layout** — every tenant served through a
+       tenant-sharded bank (4 tenant shards x mp=2 state sharding, a
+       class-sharded StatScores member) equals a solo instance fed the same
+       stream, exactly, through admit/evict/spill/re-admit churn.
+    2. **Launch amortization** — router-batched dispatch into the
+       tenant-sharded bank must issue >= 5x fewer launches than per-instance
+       dispatch (reported as launches-per-1k-requests).
+    3. **Bank-drive speedup** — ``drive`` folding a whole per-tenant epoch
+       into one launch must beat the per-flush loop by >= 2x on the CPU
+       lane, bit-identically.
+    4. **Warm restart covers bank_drive** — a fresh process restoring the
+       recorded warmup manifest serves its first ``drive`` epoch with the
+       ``bank_drive`` program family pre-seeded, bit-identical to the cold
+       child.
+    """
+    ensure_host_platform_devices(8)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from metrics_tpu import Accuracy, StatScores, engine
+    from metrics_tpu.serving import MetricBank, RequestRouter
+
+    n_classes = 8
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("host", "mp"))
+
+    # -- 1+2: tenant-sharded bit-identity and launch amortization --------
+    tenants = 16 if (_small() or _tiny()) else 64
+    rounds = 3
+    batch = 8
+    rng = np.random.RandomState(31)
+    data = [
+        [
+            (
+                jnp.asarray(rng.randint(0, n_classes, size=batch).astype(np.int32)),
+                jnp.asarray(rng.randint(0, n_classes, size=batch).astype(np.int32)),
+            )
+            for _ in range(rounds)
+        ]
+        for _ in range(tenants)
+    ]
+
+    def _template():
+        return StatScores(reduce="macro", num_classes=n_classes, class_sharding="mp")
+
+    solos = [_template() for _ in range(tenants)]
+    for t in range(tenants):  # warmup round: python-init probes + compiles
+        solos[t].update(*data[t][0])
+    for r in range(1, rounds):
+        for t in range(tenants):
+            solos[t].update(*data[t][r])
+    _force(solos[-1]._snapshot_state())
+    solo_requests = tenants * (rounds - 1)
+    solo_launches = solo_requests  # update() == one XLA launch each
+
+    bank = MetricBank(
+        _template(), capacity=max(1, tenants // 8), mesh=mesh, tenant_axis="host",
+        name="bench_pod",
+    )
+    router = RequestRouter(bank, max_requests=tenants, max_delay_s=None)
+    for t in range(tenants):  # warmup round: admissions + bank compiles
+        router.submit(t, *data[t][0])
+    router.flush()
+    launches0 = bank.stats["launches"]
+    for r in range(1, rounds):
+        for t in range(tenants):
+            router.submit(t, *data[t][r])
+        router.flush()
+    _force(bank._bank)
+    banked_requests = bank.stats["requests"] - tenants
+    banked_launches = bank.stats["launches"] - launches0
+    # capacity < population: the parity sweep below re-admits spilled
+    # tenants, exercising the full pod churn path
+    spills = bank.stats["spills"]
+
+    parity_ok = banked_requests == solo_requests and spills > 0
+    for t in range(tenants):
+        if not np.array_equal(
+            np.asarray(bank.compute(t)), np.asarray(solos[t].compute())
+        ):
+            parity_ok = False
+    pod_summary = bank.summary()
+    amortization = solo_launches / max(1, banked_launches)
+
+    # -- 3: bank-drive vs per-flush epoch --------------------------------
+    epoch_steps = 32 if (_small() or _tiny()) else 64
+    drive_rng = np.random.RandomState(7)
+    steps = [
+        (
+            jnp.asarray(drive_rng.randint(0, n_classes, size=batch).astype(np.int32)),
+            jnp.asarray(drive_rng.randint(0, n_classes, size=batch).astype(np.int32)),
+        )
+        for _ in range(epoch_steps)
+    ]
+
+    def _per_flush_epoch():
+        b = MetricBank(Accuracy(num_classes=n_classes), capacity=2, name="bench_pf")
+        for s in steps:
+            b.update("e", *s)
+        _force(b._bank)
+        return b
+
+    def _driven_epoch():
+        b = MetricBank(Accuracy(num_classes=n_classes), capacity=2, name="bench_dr")
+        engine.drive_bank(b, "e", steps)
+        _force(b._bank)
+        return b
+
+    _per_flush_epoch(), _driven_epoch()  # compile warmup for both paths
+    t0 = time.perf_counter()
+    flush_bank = _per_flush_epoch()
+    flush_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    drive_bank_obj = _driven_epoch()
+    drive_s = time.perf_counter() - t0
+    drive_parity = np.array_equal(
+        np.asarray(drive_bank_obj.compute("e")), np.asarray(flush_bank.compute("e"))
+    )
+    drive_launches = drive_bank_obj.stats["launches"]
+    drive_speedup = flush_s / max(drive_s, 1e-9)
+
+    # -- 4: restart-to-first-result with a bank_drive-covering manifest --
+    def _child(env_overrides: dict, timeout_s: int = 300) -> dict:
+        env = dict(os.environ)
+        env.pop("METRICS_TPU_COMPILE_CACHE", None)
+        env.pop("METRICS_TPU_WARMUP_MANIFEST", None)
+        env.update(env_overrides)
+        out = subprocess.run(
+            [sys.executable, "-c", _POD_DRIVE_CHILD],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        lines = [ln for ln in out.stdout.splitlines() if ln.strip().startswith("{")]
+        if out.returncode != 0 or not lines:
+            raise RuntimeError(f"pod restart child rc={out.returncode}: {out.stderr[-300:]}")
+        return json.loads(lines[-1])
+
+    with tempfile.TemporaryDirectory(prefix="metrics_tpu_pod_") as tmp:
+        manifest = os.path.join(tmp, "manifest.json")
+        _child({"METRICS_TPU_WARMUP_MANIFEST": manifest})  # records, saves at exit
+        try:
+            with open(manifest) as f:
+                manifest_covers_drive = '"bank_drive"' in f.read()
+        except OSError:
+            manifest_covers_drive = False
+        cold = _child({})
+        warm = _child({"METRICS_TPU_WARMUP_MANIFEST": manifest})
+    restart_ratio = cold["first_ms"] / max(warm["first_ms"], 1e-6)
+
+    return {
+        "metric": "pod_bank",
+        "value": round(amortization, 3),
+        "unit": "x_launch_amortization_vs_per_instance",
+        "vs_baseline": None,
+        "tenants": tenants,
+        "tenant_shards": pod_summary["tenant_shards"],
+        "shard_capacity": pod_summary["shard_capacity"],
+        "requests": solo_requests,
+        "launches_per_1k_per_instance": round(1000.0 * solo_launches / solo_requests, 2),
+        "launches_per_1k_banked": round(1000.0 * banked_launches / banked_requests, 2),
+        "parity_ok": bool(parity_ok),
+        "pod_spills": spills,
+        "drive_speedup_vs_per_flush": round(drive_speedup, 3),
+        "drive_parity_ok": bool(drive_parity),
+        "drive_launches": drive_launches,
+        "drive_steps": epoch_steps,
+        "manifest_covers_bank_drive": bool(manifest_covers_drive),
+        "restart_first_ms_cold": cold["first_ms"],
+        "restart_first_ms_warm": warm["first_ms"],
+        "restart_speedup": round(restart_ratio, 3),
+        "restart_parity_ok": cold["digest"] == warm["digest"],
+        "warm_hits": warm["warmed_hits"],
+        "warm_stale": warm["stale_total"],
+    }
+
+
+# ---------------------------------------------------------------------------
 # module-API compute() latency on the live backend
 # ---------------------------------------------------------------------------
 def bench_compute_latency() -> dict:
@@ -3614,6 +3840,7 @@ _CONFIGS = [
     ("bench_kernel_tier", 900, False),
     ("bench_integrity", 900, False),
     ("bench_rolling_upgrade", 900, False),
+    ("bench_pod_bank", 900, False),
 ]
 
 # the headline runs outside _CONFIGS (measured first, emitted last) but is
@@ -3869,6 +4096,10 @@ _SMOKE_LANES = {
     # canary auto-rollback on an injected bitflip, mixed-version wire
     # negotiation parity, every golden compat artifact decoding
     "--upgrade-smoke": ("bench_rolling_upgrade", {"small": True}),
+    # pod-scale banks: tenant-sharded bit-identity (state-sharded member at
+    # mp=2), >=5x launch amortization at the pod layout, bank-drive >=2x vs
+    # per-flush, warm restart covering bank_drive manifest entries
+    "--pod-smoke": ("bench_pod_bank", {"cpu_devices": 8, "small": True}),
 }
 
 

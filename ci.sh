@@ -507,4 +507,49 @@ if obj["golden_decoded"] < 1 or obj["golden_rejected"] < 1:
 print("upgrade smoke OK (%d golden artifacts):" % obj["golden_artifacts"], line)
 '
 
+echo "=== pod-scale bank smoke (tenant sharding, bank-drive, warm restart) ==="
+# ISSUE 20 acceptance: every tenant served through a tenant-sharded bank
+# (4 tenant shards, a class-sharded StatScores member at mp=2) is
+# bit-identical to a solo instance through spill churn; router-batched
+# dispatch amortizes >= 5x fewer launches than per-instance; a bank-drive
+# epoch lands bit-identical to the per-flush loop in ONE launch; and a warm
+# restart's manifest covers the bank_drive program family. Correctness
+# contracts are exit 2 (never retried); the bank-drive speedup timing gate
+# (exit 3) gets one retry — a throttled CI box can skew a wall-clock ratio
+pod_smoke() {
+JAX_PLATFORMS=cpu python bench.py --pod-smoke | tail -n 1 | python -c '
+import json, sys
+line = sys.stdin.read().strip()
+obj = json.loads(line)  # the telemetry line must parse
+assert obj["metric"] == "pod_bank", obj
+# bit-identity at the pod layout, through actual spill churn
+if obj["parity_ok"] is not True or obj["pod_spills"] < 1:
+    print("tenant-sharded bank diverged from solo instances:", line); sys.exit(2)
+if obj["tenant_shards"] != 4:
+    print("the pod layout never sharded the tenant axis:", line); sys.exit(2)
+# launch amortization at the pod layout: >= 5x fewer launches
+if obj["value"] < 5.0:
+    print("pod-bank launch amortization %s < 5x:" % obj["value"], line); sys.exit(2)
+# bank-drive: one launch per epoch, bit-identical to per-flush
+if obj["drive_parity_ok"] is not True or obj["drive_launches"] != 1:
+    print("bank-drive diverged from the per-flush epoch (or multi-launched):", line); sys.exit(2)
+# warm restart: the manifest covers bank_drive entries and replays exactly
+if obj["manifest_covers_bank_drive"] is not True:
+    print("the warmup manifest never recorded a bank_drive program:", line); sys.exit(2)
+if obj["restart_parity_ok"] is not True or obj["warm_stale"] != 0:
+    print("the warm restart diverged (or served stale programs):", line); sys.exit(2)
+# the timing gate (exit 3, one retry): drive >= 2x the per-flush epoch
+if obj["drive_speedup_vs_per_flush"] < 2.0:
+    print("bank-drive speedup %s < 2x vs per-flush:" % obj["drive_speedup_vs_per_flush"], line); sys.exit(3)
+print("pod smoke OK (%sx amortization, %sx drive speedup):"
+      % (obj["value"], obj["drive_speedup_vs_per_flush"]), line)
+'
+}
+pod_rc=0; pod_smoke || pod_rc=$?
+if [ "$pod_rc" -eq 3 ]; then
+  echo "pod bank-drive speedup gate failed; retrying once"
+  pod_rc=0; pod_smoke || pod_rc=$?
+fi
+[ "$pod_rc" -eq 0 ] || exit "$pod_rc"
+
 echo "both lanes green"

@@ -63,6 +63,7 @@ from metrics_tpu.engine.driver import (  # noqa: F401
     DriveSnapshot,
     async_compute,
     drive,
+    drive_bank,
     fetch_stats,
     load_drive_snapshot,
     reset_fetch_stats,

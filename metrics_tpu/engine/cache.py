@@ -362,9 +362,10 @@ class SharedEntry:
         stale_watch = bool(self._warm_covered)
         obs_source = obs_screening = None
         if obs_on or stale_watch:
-            if self.kind in ("metric_update", "bank_update"):
-                # both kinds bind ONE metric instance as the cell (a bank's
-                # cell is its template); fused/driver kinds bind member lists
+            if self.kind in ("metric_update", "bank_update", "bank_drive"):
+                # these kinds bind ONE metric instance as the cell (a bank's
+                # cell is its template); fused/driver/collection-bank kinds
+                # bind member lists
                 obs_source = type(cell).__name__
                 obs_screening = (
                     getattr(cell, "on_bad_input", "propagate"),
@@ -752,7 +753,34 @@ def update_transition(metric: Any, state: Dict[str, Any], args: Tuple[Any, ...],
 # ---------------------------------------------------------------------------
 # multi-tenant bank programs (per-tenant state addressing)
 # ---------------------------------------------------------------------------
-def _make_bank_entry(key: Any, pins: Tuple) -> SharedEntry:
+def _bank_constrainer(constraints: Optional[Dict[str, Any]]) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+    """Closure pinning a bank pytree's leaves to their registered
+    ``NamedSharding`` inside a trace (tenant-sharded banks; identity when
+    the bank is unsharded). Applied to the bank argument AND the returned
+    bank, so input/output layouts match — which is also what keeps donation
+    valid on the sharded families."""
+    if not constraints:
+        return lambda bank: bank
+    import jax.lax as _lax
+
+    def _constrain(bank: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            n: (_lax.with_sharding_constraint(leaf, constraints[n]) if n in constraints else leaf)
+            for n, leaf in bank.items()
+        }
+
+    return _constrain
+
+
+def _make_bank_entry(
+    key: Any,
+    pins: Tuple,
+    *,
+    kind: str = "bank_update",
+    constraints: Optional[Dict[str, Any]] = None,
+    mesh: Optional[Any] = None,
+    request_body_factory: Optional[Callable] = None,
+) -> SharedEntry:
     """One multi-tenant banked-update program family.
 
     The state argument is a BANK: the same state pytree every other entry
@@ -782,30 +810,43 @@ def _make_bank_entry(key: Any, pins: Tuple) -> SharedEntry:
     the same bucket share one launch. All variants donate the bank on
     donating backends — the bank is the carry of a long-lived serving loop.
     """
-    entry = SharedEntry(key, "bank_update", pins)
+    entry = SharedEntry(key, kind, pins)
     entry.donate = donation_enabled()
+    if mesh is not None:
+        # the warmup recorder skips mesh-bound entries (a Mesh handle cannot
+        # ride a JSON manifest) — same contract as the driver's shard mode
+        entry._mesh = mesh
+    _constrain = _bank_constrainer(constraints)
 
-    def _request_body(treedef):
-        def body(state, step_leaves, pad):
-            args, kwargs = jax.tree_util.tree_unflatten(treedef, list(step_leaves))
-            return _health.traced_update(entry.cell, state, args, kwargs, pad_count=pad)
+    if request_body_factory is not None:
+        _request_body = request_body_factory(entry)
+    else:
 
-        return body
+        def _request_body(treedef):
+            def body(state, step_leaves, pad):
+                args, kwargs = jax.tree_util.tree_unflatten(treedef, list(step_leaves))
+                return _health.traced_update(entry.cell, state, args, kwargs, pad_count=pad)
+
+            return body
 
     def _scatter(bank, slots, leaves, pads, treedef):
         entry.mark_trace("scatter" if pads is None else "scatter_pad")
+        bank = _constrain(bank)
         req_states = jax.tree_util.tree_map(lambda leaf: leaf[slots], bank)
         body = _request_body(treedef)
         if pads is None:
             new_states = jax.vmap(lambda s, sl: body(s, sl, None))(req_states, tuple(leaves))
         else:
             new_states = jax.vmap(body)(req_states, tuple(leaves), pads)
-        return jax.tree_util.tree_map(
-            lambda leaf, upd: leaf.at[slots].set(upd), bank, new_states
+        return _constrain(
+            jax.tree_util.tree_map(
+                lambda leaf, upd: leaf.at[slots].set(upd), bank, new_states
+            )
         )
 
     def _dense(bank, active, leaves, pads, treedef):
         entry.mark_trace("dense" if pads is None else "dense_pad")
+        bank = _constrain(bank)
         body = _request_body(treedef)
 
         def per_slot(state, act, step_leaves, pad):
@@ -815,10 +856,12 @@ def _make_bank_entry(key: Any, pins: Tuple) -> SharedEntry:
             return {n: jnp.where(act, new[n], state[n]) for n in new}
 
         if pads is None:
-            return jax.vmap(lambda s, a, sl: per_slot(s, a, sl, None))(
-                bank, active, tuple(leaves)
+            return _constrain(
+                jax.vmap(lambda s, a, sl: per_slot(s, a, sl, None))(
+                    bank, active, tuple(leaves)
+                )
             )
-        return jax.vmap(per_slot)(bank, active, tuple(leaves), pads)
+        return _constrain(jax.vmap(per_slot)(bank, active, tuple(leaves), pads))
 
     def build(donate: bool) -> None:
         argnums = (0,) if donate else ()
@@ -842,13 +885,229 @@ def _make_bank_entry(key: Any, pins: Tuple) -> SharedEntry:
     return entry
 
 
-def bank_entry(template: Any) -> SharedEntry:
+def bank_entry(
+    template: Any,
+    *,
+    tenant_spec: Any = None,
+    state_shardings: Tuple = (),
+    mesh: Optional[Any] = None,
+    constraints: Optional[Dict[str, Any]] = None,
+) -> SharedEntry:
     """Shared entry for one bank program family, keyed by the template's
-    :func:`program_identity` alone — the tenant population is state, not
-    identity, so every bank (and every restarted worker's bank) of the same
-    metric config shares one compiled family per input signature."""
+    :func:`program_identity` — the tenant population is state, not identity,
+    so every bank (and every restarted worker's bank) of the same metric
+    config shares one compiled family per input signature.
+
+    A tenant-sharded bank (``MetricBank(mesh=, tenant_axis=)``) extends the
+    key with ``(tenant_spec, state_shardings, id(mesh))`` — the canonical
+    tenant-axis layout plus every member state's registered
+    ``PartitionSpec`` — and builds its family with the bank leaves pinned to
+    their 2D (tenant-dp × state-mp) ``NamedSharding`` in-trace, so banks on
+    different meshes/layouts never share an executable while unsharded banks
+    keep exactly the pre-sharding key (and ride warmup manifests
+    unchanged)."""
     key, pins = program_identity(template)
-    return _get_or_create(("bank_update", key), lambda: _make_bank_entry(key, pins))
+    if mesh is not None:
+        pins = tuple(pins) + (mesh,)  # id-keyed below: pin against recycling
+    cache_key = (
+        "bank_update",
+        key,
+        tenant_spec,
+        state_shardings,
+        id(mesh) if mesh is not None else None,
+    )
+    return _get_or_create(
+        cache_key,
+        lambda: _make_bank_entry(key, pins, constraints=constraints, mesh=mesh),
+    )
+
+
+def _make_bank_drive_entry(
+    key: Any,
+    pins: Tuple,
+    constraints: Optional[Dict[str, Any]] = None,
+    row_constraints: Optional[Dict[str, Any]] = None,
+    mesh: Optional[Any] = None,
+) -> SharedEntry:
+    """One bank-level epoch program family (entry kind ``bank_drive``).
+
+    The data plane of ``MetricBank.drive``: a whole per-tenant epoch —
+    ``K`` stacked update batches — is ``lax.scan``-ned into ONE bank slot in
+    ONE launch. The scan body is the same health-screened transition the
+    per-flush bank families vmap (``resilience/health.traced_update``), so
+    per-step semantics — ``on_bad_input='skip'/'mask'`` and the pow2 pad-row
+    correction — are bit-identical to ``K`` single-request flushes by
+    construction. Variants:
+
+    * ``scan`` — uniform step shapes: gather the slot's state, scan the
+      transition over the ``[K, ...]`` stacked leaves, scatter the carry
+      back (``leaf.at[slot].set``).
+    * ``scan_pad`` — the pow2 ragged tail: each step carries a traced pad
+      count (the batch-bucketing correction), and the caller pads the STEP
+      axis to a pow2 count with whole no-op steps (``pad == bucket`` makes a
+      step's correction subtract its entire padded batch), so epoch lengths
+      share O(log K) programs like the driver's stream mode.
+
+    The bank is donated on donating backends — it is the carry of the same
+    long-lived serving loop the per-flush families serve. On a tenant-sharded
+    bank the leaves are constraint-pinned in-trace (``constraints``) and the
+    scanned slot row keeps its member-state layout (``row_constraints``), so
+    a state-sharded member's carry stays resident as shards across steps.
+    """
+    entry = SharedEntry(key, "bank_drive", pins)
+    entry.donate = donation_enabled()
+    if mesh is not None:
+        entry._mesh = mesh
+    _constrain = _bank_constrainer(constraints)
+    _constrain_row = _bank_constrainer(row_constraints)
+
+    def _scan(bank, slot, leaves, pads, treedef):
+        entry.mark_trace("scan" if pads is None else "scan_pad")
+        bank = _constrain(bank)
+        state = _constrain_row(jax.tree_util.tree_map(lambda leaf: leaf[slot], bank))
+
+        def body(carry, step):
+            step_leaves, pad = step if pads is not None else (step, None)
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, list(step_leaves))
+            new = _health.traced_update(entry.cell, carry, args, kwargs, pad_count=pad)
+            # re-pin the carry every step (the GSPMD drive discipline): a
+            # state-sharded member's accumulator must stay resident as
+            # shards, never gathered between scan iterations
+            return _constrain_row(new), None
+
+        xs = tuple(leaves) if pads is None else (tuple(leaves), pads)
+        out, _ = jax.lax.scan(body, state, xs)
+        return _constrain(
+            jax.tree_util.tree_map(lambda leaf, s: leaf.at[slot].set(s), bank, out)
+        )
+
+    def build(donate: bool) -> None:
+        argnums = (0,) if donate else ()
+        entry._fns = {
+            "scan": jax.jit(
+                lambda bank, slot, leaves, treedef: _scan(bank, slot, leaves, None, treedef),
+                static_argnums=(3,),
+                donate_argnums=argnums,
+            ),
+            "scan_pad": jax.jit(_scan, static_argnums=(4,), donate_argnums=argnums),
+        }
+
+    entry._build = build
+    build(entry.donate)
+    return entry
+
+
+def bank_drive_entry(
+    template: Any,
+    *,
+    tenant_spec: Any = None,
+    state_shardings: Tuple = (),
+    mesh: Optional[Any] = None,
+    constraints: Optional[Dict[str, Any]] = None,
+    row_constraints: Optional[Dict[str, Any]] = None,
+) -> SharedEntry:
+    """Shared entry for one bank-level epoch family — same addressing scheme
+    as :func:`bank_entry` (program identity + the tenant-sharded layout key),
+    under the ``bank_drive`` kind."""
+    key, pins = program_identity(template)
+    if mesh is not None:
+        pins = tuple(pins) + (mesh,)
+    cache_key = (
+        "bank_drive",
+        key,
+        tenant_spec,
+        state_shardings,
+        id(mesh) if mesh is not None else None,
+    )
+    return _get_or_create(
+        cache_key,
+        lambda: _make_bank_drive_entry(
+            key, pins, constraints=constraints, row_constraints=row_constraints, mesh=mesh
+        ),
+    )
+
+
+def _collection_request_body(keys: Tuple[str, ...]) -> Callable:
+    """Request-body factory for collection banks: the per-request transition
+    is the fused-update member loop (``_make_fused_entry._update``) applied
+    to a FLAT ``"member::state"``-namespaced slot row — one shared screening
+    pass per input leaf, each member's policy applied independently, exactly
+    the fused program family's semantics under the bank's vmap."""
+
+    def factory(entry: SharedEntry) -> Callable:
+        def _split(flat: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+            nested: Dict[str, Dict[str, Any]] = {k: {} for k in keys}
+            for name, value in flat.items():
+                k, state = name.split("::", 1)
+                nested[k][state] = value
+            return nested
+
+        def _request_body(treedef):
+            def body(state_flat, step_leaves, pad):
+                args, kwargs = jax.tree_util.tree_unflatten(treedef, list(step_leaves))
+                states = _split(state_flat)
+                new: Dict[str, Any] = {}
+                with _health.shared_screening():
+                    for k, member in zip(keys, entry.cell):
+                        upd = _health.traced_update(
+                            member, states[k], args, member._filter_kwargs(**kwargs), pad_count=pad
+                        )
+                        for n, v in upd.items():
+                            new[f"{k}::{n}"] = v
+                return new
+
+            return body
+
+        return _request_body
+
+    return factory
+
+
+def collection_bank_entry(
+    keys: Tuple[str, ...],
+    members: List[Any],
+    *,
+    tenant_spec: Any = None,
+    state_shardings: Tuple = (),
+    mesh: Optional[Any] = None,
+    constraints: Optional[Dict[str, Any]] = None,
+) -> SharedEntry:
+    """Shared entry for one collection-bank program family (entry kind
+    ``collection_bank``): the scatter/dense bank dispatch machinery of
+    :func:`bank_entry` with the fused-update member loop as its per-request
+    body, keyed like :func:`fused_entry` — member names + every member's
+    fingerprint (one bank per fused ``MetricCollection`` signature) — plus
+    the tenant-sharded layout components."""
+    member_keys: List[Any] = []
+    pins: List[Any] = []
+    for m in members:
+        k, p = metric_fingerprint(m)
+        member_keys.append(k)
+        pins.extend(p)
+    if mesh is not None:
+        pins.append(mesh)
+    cache_key = (
+        "collection_bank",
+        tuple(keys),
+        tuple(member_keys),
+        tenant_spec,
+        state_shardings,
+        id(mesh) if mesh is not None else None,
+    )
+
+    def _factory() -> SharedEntry:
+        entry = _make_bank_entry(
+            cache_key,
+            tuple(pins),
+            kind="collection_bank",
+            constraints=constraints,
+            mesh=mesh,
+            request_body_factory=_collection_request_body(tuple(keys)),
+        )
+        entry._member_names = tuple(keys)  # warmup-recorder meta parity
+        return entry
+
+    return _get_or_create(cache_key, _factory)
 
 
 # ---------------------------------------------------------------------------

@@ -74,6 +74,7 @@ __all__ = [
     "DriveSnapshot",
     "async_compute",
     "drive",
+    "drive_bank",
     "fetch_stats",
     "load_drive_snapshot",
     "reset_fetch_stats",
@@ -651,6 +652,29 @@ def drive(
             obj, batches, compute_in_trace, axis_name, mesh, steps_per_chunk, source, hierarchical_sync, in_specs,
             snapshot_store, snapshot_every, snapshot_key, resume_from,
         )
+
+
+def drive_bank(bank: Any, tenant: Any, batches: Any) -> None:
+    """Scan one tenant's whole epoch into its :class:`MetricBank` slot in a
+    single launch — :func:`drive`'s amortization applied to the serving
+    plane.
+
+    ``batches`` is a host sequence of per-step update-argument tuples (the
+    same per-step form the bank's ``update``/``apply_batch`` consume). The
+    epoch is stacked on a leading steps axis and folded into the tenant's
+    bank row with one donated ``lax.scan`` program — per-step health
+    screening and ragged-tail pow2 bucketing behave bit-identically to
+    flushing the same steps one at a time, but at one launch per epoch
+    instead of one per flush.
+
+    The resulting state is ordinary bank state: it composes with LRU spill,
+    checkpoints, recovery, and later per-flush updates to the same tenant.
+    Delegates to ``bank.drive`` — see :meth:`MetricBank.drive` for the
+    signature constraints (uniform step treedef; ragged batch sizes need
+    ``jit_bucket='pow2'`` on the template; collection banks reject drive —
+    flush them per wave through a router instead).
+    """
+    bank.drive(tenant, batches)
 
 
 def _drive_impl(

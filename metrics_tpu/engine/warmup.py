@@ -105,6 +105,7 @@ MANIFEST_VERSION = 2
 WARMABLE_KINDS = (
     "metric_update",
     "bank_update",
+    "bank_drive",
     "fused_update",
     "fused_forward",
     "fused_compute",
@@ -206,9 +207,9 @@ def stable_digest(metric: Any) -> str:
 
 def _entry_digest(kind: str, cell: Any, meta: Dict[str, Any]) -> str:
     """Digest for one cache entry: a bare metric for ``metric_update`` /
-    ``bank_update``, the ordered member set (plus kind meta) for fused and
-    driver programs."""
-    if kind in ("metric_update", "bank_update"):
+    ``bank_update`` / ``bank_drive``, the ordered member set (plus kind
+    meta) for fused and driver programs."""
+    if kind in ("metric_update", "bank_update", "bank_drive"):
         return stable_digest(cell)
     if kind == "encode":
         return cell.stable_digest()
@@ -374,6 +375,8 @@ _N_DYNAMIC = {
     ("bank_update", "scatter_pad"): 4,
     ("bank_update", "dense"): 3,
     ("bank_update", "dense_pad"): 4,
+    ("bank_drive", "scan"): 3,
+    ("bank_drive", "scan_pad"): 4,
     ("driver", "scan"): 2,
     ("driver", "scan_pad"): 3,
     ("driver", "scan_cmp"): 2,
@@ -428,11 +431,15 @@ def record_dispatch(entry: Any, variant: str, cell: Any, fn_args: Tuple[Any, ...
     kind = entry.kind
     if kind not in WARMABLE_KINDS:
         return
-    if kind == "driver" and (
-        getattr(entry, "_axis_name", None) is not None or getattr(entry, "_mesh", None) is not None
+    if (
+        getattr(entry, "_axis_name", None) is not None
+        or getattr(entry, "_mesh", None) is not None
     ):
+        # mesh-bound entries of ANY kind (shard-mapped drivers, tenant-
+        # sharded bank/bank_drive families) are unrecordable: a Mesh handle
+        # cannot ride JSON, and their executables are device-bound anyway
         with _LOCK:
-            _count(_REC["unrecordable"], "driver_mesh_bound")
+            _count(_REC["unrecordable"], f"{kind}_mesh_bound")
         return
     if variant.startswith("shard_"):
         with _LOCK:
@@ -507,7 +514,7 @@ def _entry_meta(entry: Any) -> Dict[str, Any]:
 
 
 def _entry_source(kind: str, cell: Any) -> str:
-    if kind in ("metric_update", "bank_update"):
+    if kind in ("metric_update", "bank_update", "bank_drive"):
         return type(cell).__name__
     if kind == "encode":
         return getattr(cell, "name", None) or type(cell).__name__
@@ -534,7 +541,7 @@ def _template_payload(kind: str, cell: Any) -> Any:
     reconstruction recipe. ``None`` when cloning fails (warmup then needs an
     explicit template)."""
     try:
-        if kind in ("metric_update", "bank_update"):
+        if kind in ("metric_update", "bank_update", "bank_drive"):
             return _clone_reset(cell)
         if kind == "encode":
             # the embedded recipe is only useful when the restored encoder
@@ -769,7 +776,7 @@ def _match_template(rec: Dict[str, Any], candidates: List[Any]) -> Optional[Any]
             if getattr(obj, "_is_sharded_encoder", False) and obj.stable_digest() == rec.get("digest"):
                 return obj
         return None
-    if rec.get("kind") not in ("metric_update", "bank_update"):
+    if rec.get("kind") not in ("metric_update", "bank_update", "bank_drive"):
         return None
     candidates = [m for m in candidates if not getattr(m, "_is_sharded_encoder", False)]
     for metric in candidates:
@@ -808,6 +815,8 @@ def _entry_for(kind: str, rec: Dict[str, Any], payload: Any) -> Tuple[Any, Any]:
         return entry, payload
     if kind == "bank_update":
         return _cache.bank_entry(payload), payload
+    if kind == "bank_drive":
+        return _cache.bank_drive_entry(payload), payload
     if kind == "encode":
         return _cache.encoder_entry(payload), payload
     keys = tuple(rec["meta"].get("keys", ()))
@@ -837,7 +846,7 @@ def _covered_signature(entry: Any, variant: str, cell: Any, lower_args: Tuple[An
 
 
 def _screening_of(entry: Any, cell: Any) -> Tuple:
-    if entry.kind in ("metric_update", "bank_update"):
+    if entry.kind in ("metric_update", "bank_update", "bank_drive"):
         return (
             getattr(cell, "on_bad_input", "propagate"),
             getattr(cell, "health_screen", "nonfinite"),
@@ -851,7 +860,7 @@ def _screening_of(entry: Any, cell: Any) -> Tuple:
 def _snapshot_cell(kind: str, cell: Any) -> List[Tuple[Any, Dict[str, Any]]]:
     if kind == "encode":
         return []  # an encoder is stateless: nothing to save/restore around tracing
-    metrics = [cell] if kind in ("metric_update", "bank_update") else list(cell)
+    metrics = [cell] if kind in ("metric_update", "bank_update", "bank_drive") else list(cell)
     return [(m, m._snapshot_state()) for m in metrics]
 
 

@@ -96,7 +96,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: tenant, request id, primary and rendezvous-failover owner, age). The
 #: ``flush`` event additionally carries ``ms`` (dispatch wall time) on
 #: success or ``error`` (exception class name) on failure — the signals
-#: the guard scores.
+#: the guard scores; a shard-local flush on a tenant-sharded bank also
+#: carries ``shard_launches`` (one vmapped launch per owning shard).
+#: Pod-scale banks (``serving/bank.py``, ISSUE 20): ``bank_drive`` (one
+#: bank-level epoch applied into a tenant's slot in ONE ``lax.scan``
+#: launch — bank, tenant, real ``steps`` applied, ``bucketed`` when the
+#: pow2 ragged tail padded the step axis, ``ms`` wall time on success or
+#: ``error`` on failure, occupancy).
 #: State-integrity plane (``resilience/integrity.py``, ISSUE 17): ``attest``
 #: (one digest verification at a durability/migration boundary — ``ok``,
 #: bank, tenant, the failing ``leaf`` on mismatch), ``audit`` (one
@@ -137,6 +143,7 @@ EVENT_KINDS = (
     "admit",
     "evict",
     "flush",
+    "bank_drive",
     "journal",
     "spill_write",
     "recover",

@@ -277,6 +277,13 @@ def sync_bank_states(
     deliberately out of scope here. ``hierarchical=True`` with a multi-axis
     ``axis_name`` stages each reduction intra-host first (see
     :func:`reduce_in_trace`).
+
+    Pod-scale banks compose transparently: a tenant-sharded bank's leaves
+    are still one ``[capacity, ...]`` array per state (the tenant axis is a
+    device LAYOUT, not extra leaves), and a collection bank's namespaced
+    leaves (``"member::state"``) are looked up by their full name in
+    ``reductions`` — ``MetricBank.sync_state_in_trace`` passes its
+    namespaced reduction table, so both shapes ride this same path.
     """
     for name, value in bank.items():
         fx = reductions.get(name)

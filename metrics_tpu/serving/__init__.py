@@ -16,7 +16,8 @@ device-resident state banks instead of per-instance dispatch.
   registry for requests tagged with a ``request_id``: a hedged or replayed
   twin of an applied request is dropped before any state is touched
   (ISSUE 14; see ``docs/fault_tolerance.md``).
-* :class:`SpillStore` / :class:`MemoryStore` / :class:`DiskStore`
+* :class:`SpillStore` / :class:`MemoryStore` / :class:`DiskStore` /
+  :class:`OrbaxStore`
   (``serving/store.py``) — the durable state plane: pluggable spill tiers
   plus the bank's write-ahead tenant journal, so ``MetricBank.recover``
   rebuilds every acked session after a process crash (see
@@ -33,6 +34,7 @@ router flush semantics, and sizing guidance.
 from metrics_tpu.serving.store import (  # noqa: F401  (imported before bank: bank depends on it)
     DiskStore,
     MemoryStore,
+    OrbaxStore,
     SpillStore,
     durability_stats,
 )
@@ -44,6 +46,7 @@ __all__ = [
     "DiskStore",
     "MemoryStore",
     "MetricBank",
+    "OrbaxStore",
     "RequestDedup",
     "RequestRouter",
     "SpillStore",

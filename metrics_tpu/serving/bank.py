@@ -60,6 +60,7 @@ from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tupl
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from metrics_tpu.engine import bucketing as _bucketing
 from metrics_tpu.engine import cache as _cache
@@ -67,6 +68,7 @@ from metrics_tpu.obs import bus as _bus
 from metrics_tpu.resilience import health as _health
 from metrics_tpu.resilience import integrity as _integrity
 from metrics_tpu.serving import store as _spill
+from metrics_tpu.sharding import spec as _shardspec
 from metrics_tpu.utils.exceptions import MetricsUserError, StateIntegrityError
 
 Array = jax.Array
@@ -121,10 +123,33 @@ class MetricBank:
         template: a configured :class:`~metrics_tpu.Metric` defining the
             signature (class + config). The bank clones it — the caller's
             instance stays independent. Every tenant behaves exactly like a
-            private clone of this template.
-        capacity: number of device-resident tenant slots. Sessions beyond
-            it are admitted by spilling the least-recently-used tenant's
-            state to host (checkpoint-encoded) and re-admitted on demand.
+            private clone of this template. A fully-fusable
+            :class:`~metrics_tpu.MetricCollection` is also accepted (a
+            *collection bank*): every member's per-tenant state lives in the
+            same slot under ``"member::state"`` leaf names and one flush
+            runs the fused-update member loop for the whole collection —
+            one launch per wave per collection, not per member.
+        capacity: number of device-resident tenant slots — PER SHARD when
+            ``tenant_axis`` is given (the logical bank then holds
+            ``capacity × n_shards`` resident tenants; see :attr:`capacity`
+            vs :attr:`shard_capacity`). Sessions beyond it are admitted by
+            spilling the least-recently-used tenant's state to host
+            (checkpoint-encoded) and re-admitted on demand.
+        mesh: a :class:`jax.sharding.Mesh` the bank's state leaves are laid
+            out over. Required by ``tenant_axis=`` and by banks whose
+            template registered ``add_state(sharding=...)`` annotations
+            (the PR-10 ``PartitionSpec`` states): each bank leaf is placed
+            as ``PartitionSpec(tenant_axes, *state_spec)`` — the 2D
+            tenant-dp × state-mp layout — and pinned in-trace by the bank
+            program families. ``None`` (default): the replicated
+            single-process bank, byte-for-byte the pre-pod behavior.
+        tenant_axis: mesh axis name (or ordered tuple of names) the LEADING
+            tenant dimension is sharded over — ``PartitionSpec(('host',))``
+            per leaf. Slot addressing becomes shard-local: each shard owns
+            a contiguous ``shard_capacity`` slot range with its own free
+            list, admission picks the emptiest shard, and a scatter flush
+            dispatches one vmapped launch per *owning* shard (see
+            ``docs/serving.md`` “Pod-scale banks”).
         name: label for telemetry AND the bank's journal/blob namespace in
             the spill store (defaults to ``bank<N>``). A bank that should be
             recoverable across process restarts needs a STABLE explicit
@@ -189,6 +214,8 @@ class MetricBank:
         checkpoint_async: bool = False,
         request_dedup: Optional[Any] = None,
         audit_rate: Optional[float] = None,
+        mesh: Optional[Any] = None,
+        tenant_axis: Optional[Any] = None,
     ) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -199,27 +226,167 @@ class MetricBank:
             )
         if audit_rate is not None and not 0.0 < audit_rate <= 1.0:
             raise ValueError(f"audit_rate must be in (0, 1] (or None), got {audit_rate}")
-        reason = _bankable_error(template)
-        if reason is not None:
+        # -- pod-scale layout (mesh / tenant_axis) -------------------------
+        if tenant_axis is not None and mesh is None:
             raise MetricsUserError(
-                f"{type(template).__name__} cannot be served from a MetricBank: {reason}."
-                " Serve such metrics as solo instances."
+                "MetricBank(tenant_axis=) needs mesh= too — the tenant axis"
+                " names a mesh axis the leading tenant dimension is laid out"
+                " over."
             )
-        self._template = template.clone()
-        self.capacity = int(capacity)
+        self._mesh = mesh
+        self._tenant_axes: Tuple[str, ...] = ()
+        self._n_shards = 1
+        if tenant_axis is not None:
+            axes = (tenant_axis,) if isinstance(tenant_axis, str) else tuple(tenant_axis)
+            for ax in axes:
+                if ax not in mesh.shape:
+                    raise MetricsUserError(
+                        f"tenant_axis {ax!r} is not an axis of the mesh"
+                        f" (axes: {tuple(mesh.shape)})."
+                    )
+            self._tenant_axes = axes
+            self._n_shards = int(np.prod([mesh.shape[ax] for ax in axes]))
+
+        # -- template: a single Metric, or a fully-fusable MetricCollection
+        self._is_collection = hasattr(template, "_modules")
+        if self._is_collection:
+            if audit_rate is not None:
+                raise MetricsUserError(
+                    "collection banks do not support audit_rate: the"
+                    " shadow-replay auditor replays solo Metric clones."
+                )
+            all_keys = tuple(template._modules.keys())
+            if any("::" in k for k in all_keys):
+                raise MetricsUserError(
+                    "collection bank member keys may not contain '::' — it is"
+                    " the bank's leaf-name separator."
+                )
+            fusable = set(template._fusable_keys())
+            stragglers = [k for k in all_keys if k not in fusable]
+            if stragglers:
+                raise MetricsUserError(
+                    "a collection bank needs EVERY member on the fused-update"
+                    f" path; these members cannot fuse: {stragglers}."
+                    " Serve them from their own banks (or solo)."
+                )
+            for k in all_keys:
+                reason = _bankable_error(template._modules[k])
+                if reason is not None:
+                    raise MetricsUserError(
+                        f"collection member {k!r} cannot ride a bank: {reason}."
+                    )
+            self._template = template.clone()
+            self._member_keys: Tuple[str, ...] = tuple(self._template._modules.keys())
+            self._members: List[Any] = [self._template._modules[k] for k in self._member_keys]
+            defaults = {
+                f"{k}::{n}": jnp.asarray(m._defaults[n])
+                for k, m in zip(self._member_keys, self._members)
+                for n in m._defaults
+            }
+            self._reductions_ns = {
+                f"{k}::{n}": m._reductions[n]
+                for k, m in zip(self._member_keys, self._members)
+                for n in m._defaults
+            }
+            # the router folds this into its signature grouping (one wave —
+            # one launch — per collection bank, not per member)
+            self._signature_token: Optional[Tuple] = (
+                "collection",
+                self._member_keys,
+                tuple(_cache.metric_fingerprint(m)[0] for m in self._members),
+            )
+        else:
+            reason = _bankable_error(template)
+            if reason is not None:
+                raise MetricsUserError(
+                    f"{type(template).__name__} cannot be served from a MetricBank: {reason}."
+                    " Serve such metrics as solo instances."
+                )
+            self._template = template.clone()
+            self._member_keys = ()
+            self._members = [self._template]
+            defaults = {
+                n: jnp.asarray(self._template._defaults[n]) for n in self._template._defaults
+            }
+            self._reductions_ns = self._template._reductions
+            self._signature_token = None
+
+        # -- per-leaf layout: PartitionSpec(tenant_axes, *state_spec) ------
+        shard_specs: Dict[str, Any] = {}
+        if self._is_collection:
+            for k, m in zip(self._member_keys, self._members):
+                for n, s in (getattr(m, "_state_shardings", None) or {}).items():
+                    if _shardspec.canonical_spec(s):
+                        shard_specs[f"{k}::{n}"] = s
+        else:
+            for n, s in (getattr(self._template, "_state_shardings", None) or {}).items():
+                if _shardspec.canonical_spec(s):
+                    shard_specs[n] = s
+        if mesh is None:
+            # without a mesh the annotations are inert config (they still
+            # travel with spills/exports); the bank stays fully replicated —
+            # byte-for-byte the pre-pod behavior
+            shard_specs = {}
+        for n, s in shard_specs.items():
+            used = {e for entry in tuple(s) for e in (entry if isinstance(entry, tuple) else (entry,)) if e}
+            if used & set(self._tenant_axes):
+                raise MetricsUserError(
+                    f"state {n!r} shards over {sorted(used & set(self._tenant_axes))},"
+                    " which is the bank's tenant_axis — a state axis and the"
+                    " tenant axis cannot share mesh axes."
+                )
+        self._member_state_shardings = shard_specs
+        self._has_sharded_members = bool(shard_specs)
+        self.shard_capacity = int(capacity)
+        self.capacity = int(capacity) * self._n_shards
         self.name = name if name is not None else f"bank{next(_BANK_IDS)}"
         self.dense_threshold = float(dense_threshold)
-        defaults = {
-            n: jnp.asarray(self._template._defaults[n]) for n in self._template._defaults
-        }
         self._defaults = defaults
+        self._leaf_shardings: Dict[str, Any] = {}
+        if mesh is not None:
+            tenant_entry = (
+                self._tenant_axes if len(self._tenant_axes) != 1 else self._tenant_axes[0]
+            ) or None
+            for n in defaults:
+                state_spec = shard_specs.get(n)
+                entries = tuple(state_spec) if state_spec is not None else ()
+                self._leaf_shardings[n] = NamedSharding(
+                    mesh, PartitionSpec(tenant_entry, *entries)
+                )
+            tenant_spec_key = _shardspec.canonical_spec(PartitionSpec(tenant_entry))
+            shardings_key = tuple(
+                sorted((n, _shardspec.canonical_spec(s)) for n, s in shard_specs.items())
+            )
+            self._entry_kwargs: Dict[str, Any] = {
+                "tenant_spec": tenant_spec_key,
+                "state_shardings": shardings_key,
+                "mesh": mesh,
+                "constraints": self._leaf_shardings,
+            }
+            self._row_constraints: Optional[Dict[str, Any]] = {
+                n: NamedSharding(mesh, s) for n, s in shard_specs.items()
+            } or None
+        else:
+            self._entry_kwargs = {}
+            self._row_constraints = None
         self._bank: Dict[str, Array] = {
             n: jnp.repeat(d[None], self.capacity, axis=0) for n, d in defaults.items()
         }
+        if mesh is not None:
+            self._bank = {
+                n: jax.device_put(leaf, self._leaf_shardings[n])
+                for n, leaf in self._bank.items()
+            }
         self._slots: Dict[Hashable, int] = {}
         self._counts: Dict[Hashable, int] = {}
         self._lru: Dict[Hashable, int] = {}
-        self._free: List[int] = list(range(self.capacity - 1, -1, -1))  # pop() -> slot 0 first
+        # per-shard free lists: each tenant shard owns the contiguous slot
+        # range [s*shard_capacity, (s+1)*shard_capacity); pop() -> lowest
+        # slot of the shard first. One list total when unsharded.
+        self._free_by_shard: List[List[int]] = [
+            list(range((s + 1) * self.shard_capacity - 1, s * self.shard_capacity - 1, -1))
+            for s in range(self._n_shards)
+        ]
         # tenant -> blob key in the spill store; the payload itself (a sealed
         # PR-11 migration envelope) lives in the store, not on this object
         self._spilled: Dict[Hashable, str] = {}
@@ -305,6 +472,9 @@ class MetricBank:
             "dedup_dropped": 0,
             "audits_sampled": 0,
             "repairs": 0,
+            "bank_drives": 0,
+            "drive_steps": 0,
+            "coalesced_gathers": 0,
         }
         with _REGISTRY_LOCK:
             _BANKS.add(self)
@@ -335,6 +505,86 @@ class MetricBank:
     def _touch(self, tenant: Hashable) -> None:
         self._tick += 1
         self._lru[tenant] = self._tick
+
+    def _slot_shard(self, slot: int) -> int:
+        return slot // self.shard_capacity
+
+    def _pick_shard(self) -> int:
+        """Admission routing: the emptiest tenant shard (most free slots),
+        lowest shard index on ties — keeps per-shard occupancy balanced so
+        flush waves spread across shard-local launches."""
+        return max(
+            range(self._n_shards), key=lambda s: (len(self._free_by_shard[s]), -s)
+        )
+
+    def _release_slot(self, slot: int) -> None:
+        self._free_by_shard[self._slot_shard(slot)].append(slot)
+
+    # -- template plumbing: one code path for Metric and collection banks --
+    def _entry(self) -> Any:
+        if self._is_collection:
+            return _cache.collection_bank_entry(
+                self._member_keys, self._members, **self._entry_kwargs
+            )
+        return _cache.bank_entry(self._template, **self._entry_kwargs)
+
+    def _drive_entry(self) -> Any:
+        kwargs = dict(self._entry_kwargs)
+        if self._row_constraints is not None:
+            kwargs["row_constraints"] = self._row_constraints
+        return _cache.bank_drive_entry(self._template, **kwargs)
+
+    def _dispatch_cell(self) -> Any:
+        """What the shared entry's traced body binds as its cell: the member
+        list for collection banks (the fused-update loop), the template
+        metric otherwise."""
+        return self._members if self._is_collection else self._template
+
+    def _snapshot_templates(self) -> Any:
+        if self._is_collection:
+            return [m._snapshot_state() for m in self._members]
+        return self._template._snapshot_state()
+
+    def _restore_templates(self, saved: Any) -> None:
+        if self._is_collection:
+            for m, s in zip(self._members, saved):
+                m._restore_state(s)
+        else:
+            self._template._restore_state(saved)
+
+    def _ensure_python_init(self, first_args: Tuple[Any, ...]) -> None:
+        if self._is_collection:
+            for m in self._members:
+                _cache.ensure_python_init(m, first_args, {})
+        else:
+            _cache.ensure_python_init(self._template, first_args, {})
+
+    def _bucketing_active(self, batched: Tuple[int, ...]) -> bool:
+        """Whether ragged request batches may pow2-pad: every member must
+        have opted in (a collection bank buckets only when ALL members
+        tolerate the padded rows + correction)."""
+        if self._is_collection:
+            return bool(batched) and all(
+                _bucketing.bucketing_active(m, batched) for m in self._members
+            )
+        return _bucketing.bucketing_active(self._template, batched)
+
+    def _nest(self, flat: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+        """Split a collection bank's flat ``"member::state"`` row back into
+        the nested ``{member: {state: leaf}}`` collection shape."""
+        nested: Dict[str, Dict[str, Any]] = {k: {} for k in self._member_keys}
+        for n, v in flat.items():
+            k, state = n.split("::", 1)
+            nested[k][state] = v
+        return nested
+
+    def signature_token(self) -> Optional[Tuple]:
+        """Hashable token of a collection bank's FUSED signature (member
+        keys + every member's config fingerprint) — the router folds it into
+        its signature grouping so one wave flushes the whole collection bank
+        in one launch. ``None`` for single-metric banks: their template's
+        fingerprint already keys the group."""
+        return self._signature_token
 
     def _check_poisoned(self) -> None:
         if self._poisoned:
@@ -370,9 +620,9 @@ class MetricBank:
                 slots.append(self._slots[tenant])
                 continue
             readmit = tenant in self._spilled
-            if not self._free:
+            if not any(self._free_by_shard):
                 self._evict_lru(pinned)
-            slot = self._free.pop()
+            slot = self._free_by_shard[self._pick_shard()].pop()
             if readmit:
                 state, count = self._decode_spilled(tenant)
                 # the tenant becomes resident again; its blob STAYS in the
@@ -460,7 +710,7 @@ class MetricBank:
                 self._durable_health.pop(tenant, None)
                 self._durable_digest.pop(tenant, None)
                 self._gen.pop(tenant, None)
-            self._free.append(slot)
+            self._release_slot(slot)
             self.stats["evictions"] += 1
             self._maybe_compact_journal()
             if _bus.enabled():
@@ -678,12 +928,14 @@ class MetricBank:
         # device-side row gathers cost an eager op dispatch each, which
         # dwarfs the extra bytes of the clean rows at serving batch sizes.
         rows = [self._slots[t] for t in tenants]
-        if 2 * len(tenants) >= len(self._slots):
+        if self._mesh is None and not self._has_sharded_members and 2 * len(tenants) >= len(self._slots):
             fetched = jax.device_get(self._bank)
             host = {n: col[np.asarray(rows)] for n, col in fetched.items()}
         else:
-            idx = jnp.asarray(rows, jnp.int32)
-            host = jax.device_get({n: leaf[idx] for n, leaf in self._bank.items()})
+            # sharded banks ALWAYS route through the jitted gather: its
+            # replicated out_shardings un-shard the rows in-program, so the
+            # fetch is one transfer — not one per leaf per shard
+            host = jax.device_get(self._gathered_rows(rows))
         entries = []
         for i, tenant in enumerate(tenants):
             state = {n: col[i] for n, col in host.items()}
@@ -701,6 +953,37 @@ class MetricBank:
         self._maybe_compact_journal()
         return len(tenants)
 
+    def _gathered_rows(self, rows: List[int]) -> Dict[str, Array]:
+        """ONE jitted row gather over the whole bank — the single coalesced
+        fetch primitive behind checkpoints, async staging, and
+        ``compute_many`` on sharded banks.
+
+        The gather index is pow2-padded (repeating the first row) so a
+        fluctuating row count retraces O(log capacity) programs, not one per
+        distinct size — a fresh XLA compile inside the serving lock is
+        exactly the stall the coalesced paths exist to avoid; the pad rows
+        ride at the tail and are never read back. On a mesh-placed bank the
+        gather declares REPLICATED ``out_shardings``: the un-shard happens
+        in-program (one all-gather XLA schedules), so the subsequent
+        device→host copy is one dense transfer instead of one per leaf per
+        shard. Always returns fresh buffers — safe against a later donating
+        flush."""
+        if self._ckpt_gather is None:
+            def _gather(bank, idx):
+                return {n: leaf[idx] for n, leaf in bank.items()}
+
+            if self._mesh is not None:
+                replicated = {
+                    n: NamedSharding(self._mesh, PartitionSpec()) for n in self._bank
+                }
+                self._ckpt_gather = jax.jit(_gather, out_shardings=replicated)
+            else:
+                self._ckpt_gather = jax.jit(_gather)
+        padded = 1 << max(0, len(rows) - 1).bit_length()
+        idx = jnp.asarray(list(rows) + [rows[0]] * (padded - len(rows)), jnp.int32)
+        self.stats["coalesced_gathers"] += 1
+        return self._ckpt_gather(self._bank, idx)
+
     def _stage_checkpoint_async(self, tenants: List[Hashable]) -> int:
         """``checkpoint_async=True``: the hot-path half of a checkpoint is
         ONE jitted row-gather dispatch plus an async device→host copy (the
@@ -711,19 +994,8 @@ class MetricBank:
         synchronous default)."""
         from metrics_tpu.engine.driver import AsyncResult
 
-        if self._ckpt_gather is None:
-            self._ckpt_gather = jax.jit(
-                lambda bank, idx: {n: leaf[idx] for n, leaf in bank.items()}
-            )
         rows = [self._slots[t] for t in tenants]
-        # pow2-pad the gather index (repeating the first row) so a
-        # fluctuating dirty-tenant count retraces O(log capacity) programs,
-        # not one per distinct size — a fresh XLA compile inside the serving
-        # lock is exactly the stall async staging exists to avoid. The pad
-        # rows ride at the tail and are never read back (metas is shorter).
-        padded = 1 << max(0, len(rows) - 1).bit_length()
-        idx = jnp.asarray(rows + [rows[0]] * (padded - len(rows)), jnp.int32)
-        gathered = self._ckpt_gather(self._bank, idx)  # fresh buffers: safe vs donation
+        gathered = self._gathered_rows(rows)  # fresh buffers: safe vs donation
         handle = AsyncResult(gathered, source=f"bank:{self.name}:checkpoint")
         prev = self._pending_ckpt
         self._pending_ckpt = (
@@ -923,14 +1195,31 @@ class MetricBank:
                     f"bank {self.name!r} already serves tenant {tenant!r};"
                     " evict/export it before importing a new state for it."
                 )
-            probe = self._template.clone()
-            _ckpt.restore_metric_state_pytree(probe, dict(tree))
-            probe.bind_state(probe._snapshot_state(), update_count=probe._update_count)
-            staged = _ckpt.metric_state_pytree(probe)
+            if self._is_collection:
+                # validate per member through the same checkpoint validator +
+                # bind_state contract a Metric import rides; re-encode from
+                # the probes so the staged tree is canonical
+                probe = self._template.clone()
+                nested = self._nest(dict(tree))
+                staged = {}
+                count = 0
+                for k, pm in probe._modules.items():
+                    _ckpt.restore_metric_state_pytree(pm, dict(nested[k]))
+                    pm.bind_state(pm._snapshot_state(), update_count=pm._update_count)
+                    count = max(count, pm._update_count)
+                    for n, v in _ckpt.metric_state_pytree(pm).items():
+                        staged[f"{k}::{n}"] = v
+                probe_count = count
+            else:
+                probe = self._template.clone()
+                _ckpt.restore_metric_state_pytree(probe, dict(tree))
+                probe.bind_state(probe._snapshot_state(), update_count=probe._update_count)
+                staged = _ckpt.metric_state_pytree(probe)
+                probe_count = probe._update_count
             # durable-before-served: the sealed payload lands in the store
             # (and the journal) BEFORE the bank learns the tenant, so a
             # migration destination's ack is backed by the durable tier
-            self._write_tenant_blob(tenant, staged, probe._update_count, op="import")
+            self._write_tenant_blob(tenant, staged, probe_count, op="import")
             self._index_spilled(tenant)
             self._gen[tenant] = self._gen_next
             self._gen_next += 1
@@ -1022,7 +1311,7 @@ class MetricBank:
                 self._counts.pop(tenant)
                 self._lru.pop(tenant, None)
                 self._dirty.pop(tenant, None)
-                self._free.append(slot)
+                self._release_slot(slot)
                 self._index_spilled(tenant)
             self.admit(tenant)
             restored = int(self._counts[tenant])
@@ -1051,12 +1340,37 @@ class MetricBank:
             )
             for n, leaf in self._bank.items()
         }
+        if self._mesh is not None:
+            # re-pin after the eager row write: the bank's leaves must enter
+            # every (donating, constraint-pinned) program family in their
+            # registered 2D layout, whatever sharding the eager scatter chose
+            self._bank = {
+                n: jax.device_put(leaf, self._leaf_shardings[n])
+                for n, leaf in self._bank.items()
+            }
 
     def _encode_state(self, state: Dict[str, Any], count: int) -> Dict[str, Any]:
         """Host-encode one tenant's state through the EXISTING checkpoint
-        encode — a spilled tenant is exactly a checkpointed metric."""
+        encode — a spilled tenant is exactly a checkpointed metric. A
+        collection tenant's tree is each member's checkpoint pytree under
+        ``"member::field"`` names (flat, so the sealed-payload codec and the
+        per-leaf attestation digests apply unchanged)."""
         from metrics_tpu.utils import checkpoint as _ckpt
 
+        if self._is_collection:
+            nested = self._nest(state)
+            tree: Dict[str, Any] = {}
+            for k, m in zip(self._member_keys, self._members):
+                saved, saved_count = m._snapshot_state(), m._update_count
+                try:
+                    m._restore_state(nested[k])
+                    m._update_count = count
+                    for n, v in _ckpt.metric_state_pytree(m).items():
+                        tree[f"{k}::{n}"] = v
+                finally:
+                    m._restore_state(saved)
+                    m._update_count = saved_count
+            return tree
         tpl = self._template
         saved, saved_count = tpl._snapshot_state(), tpl._update_count
         try:
@@ -1085,6 +1399,21 @@ class MetricBank:
             tenant=tenant,
             context=f" (bank {self.name!r}, tenant {tenant!r}, journal attestation)",
         )
+        if self._is_collection:
+            nested = self._nest(tree)
+            state: Dict[str, Any] = {}
+            count = 0
+            for k, m in zip(self._member_keys, self._members):
+                saved, saved_count = m._snapshot_state(), m._update_count
+                try:
+                    _ckpt.restore_metric_state_pytree(m, dict(nested[k]))
+                    for n, v in m._snapshot_state().items():
+                        state[f"{k}::{n}"] = v
+                    count = max(count, m._update_count)
+                finally:
+                    m._restore_state(saved)
+                    m._update_count = saved_count
+            return state, count
         tpl = self._template
         saved, saved_count = tpl._snapshot_state(), tpl._update_count
         try:
@@ -1203,7 +1532,7 @@ class MetricBank:
             requests = kept
             tenants = [t for t, _ in requests]
         first_args = requests[0][1]
-        _cache.ensure_python_init(self._template, first_args, {})
+        self._ensure_python_init(first_args)
 
         flat = [jax.tree_util.tree_flatten((args, {})) for _, args in requests]
         treedef = flat[0][1]
@@ -1216,7 +1545,7 @@ class MetricBank:
         batched = _bucketing.batched_leaf_indices(leaves_per_req[0])
         pads = self._unify_shapes(leaves_per_req, batched)
 
-        entry = _cache.bank_entry(self._template)
+        entry = self._entry()
         stats = _cache.instance_stats(self._template)
         slots = self._admit_many(tenants)
 
@@ -1239,15 +1568,41 @@ class MetricBank:
 
         n_req = len(requests)
         dense = n_req >= self.dense_threshold * self.capacity
+        n_launches = 1
         # a trace binds tracer states onto the template (the traced body is
         # `_restore_state` + update); a solo instance overwrites them with
         # the dispatch result, the bank must restore concrete leaves itself
-        tpl_saved = self._template._snapshot_state()
+        tpl_saved = self._snapshot_templates()
         try:
             if dense:
-                out = self._dispatch_dense(entry, stats, slots, leaves_per_req, pads, treedef)
+                out = self._dispatch_dense(
+                    entry, stats, self._bank, slots, leaves_per_req, pads, treedef
+                )
+            elif self._n_shards > 1:
+                # shard-local flush: route each request to its OWNING tenant
+                # shard and dispatch one vmapped launch per shard, threading
+                # the bank through — a cross-shard scatter in one launch
+                # would drag rows across the tenant axis every flush
+                groups: Dict[int, List[int]] = {}
+                for i, slot in enumerate(slots):
+                    groups.setdefault(self._slot_shard(slot), []).append(i)
+                out = self._bank
+                n_launches = len(groups)
+                for shard in sorted(groups):
+                    idxs = groups[shard]
+                    out = self._dispatch_scatter(
+                        entry,
+                        stats,
+                        out,
+                        [slots[i] for i in idxs],
+                        [leaves_per_req[i] for i in idxs],
+                        [pads[i] for i in idxs] if pads is not None else None,
+                        treedef,
+                    )
             else:
-                out = self._dispatch_scatter(entry, stats, slots, leaves_per_req, pads, treedef)
+                out = self._dispatch_scatter(
+                    entry, stats, self._bank, slots, leaves_per_req, pads, treedef
+                )
         except Exception:
             # release the exactly-once claims: the router re-queues failed
             # requests, and their retry must be appliable
@@ -1256,16 +1611,16 @@ class MetricBank:
             self._rollback_after_failure()
             raise
         finally:
-            self._template._restore_state(tpl_saved)
+            self._restore_templates(tpl_saved)
         self._bank = out
         for tenant, rid in claimed:
             self._dedup.commit(tenant, rid)
         for t in tenants:
             self._counts[t] += 1
             self._dirty[t] = None
-        self.stats["launches"] += 1
+        self.stats["launches"] += n_launches
         self.stats["requests"] += n_req
-        self.stats["dense_launches" if dense else "scatter_launches"] += 1
+        self.stats["dense_launches" if dense else "scatter_launches"] += n_launches
         if pads is not None:
             self.stats["bucketed_requests"] += n_req
         if self._ckpt_every is not None:
@@ -1293,10 +1648,137 @@ class MetricBank:
                 requests=n_req,
                 variant="dense" if dense else "scatter",
                 bucketed=pads is not None,
+                shard_launches=n_launches,
                 occupancy=len(self._slots),
                 ms=round(ms, 3),
             )
         return consumed
+
+    def drive(self, tenant: Hashable, batches: Iterable[Tuple[Any, ...]]) -> int:
+        """``lax.scan`` a whole per-tenant epoch into the bank slot in ONE
+        launch — the bank-level ``engine.drive``.
+
+        ``batches`` is the epoch: an iterable of update-argument tuples,
+        each exactly the ``*args`` of one :meth:`update` call, applied in
+        order. The scan body is the same health-screened transition the
+        per-flush path vmaps, so per-step semantics —
+        ``on_bad_input='skip'/'mask'`` and the pow2 ragged-batch correction
+        — are bit-identical to ``len(batches)`` sequential flushes, at one
+        XLA launch instead of K (gate-checked by ``bench.py --pod-smoke``).
+        When the template opted into ``jit_bucket='pow2'``, the STEP axis is
+        also padded to a pow2 count with whole no-op steps (a step whose pad
+        count equals its bucket contributes exactly nothing), so epoch
+        lengths share O(log K) programs. Returns the number of real steps
+        applied. Emits a ``bank_drive`` bus event.
+
+        Counts as one applied flush for the durability cadence; collection
+        banks don't drive yet (their epoch path is per-wave
+        :meth:`apply_batch`)."""
+        batches = [b if isinstance(b, tuple) else (b,) for b in batches]
+        if not batches:
+            return 0
+        if self._is_collection:
+            raise MetricsUserError(
+                "collection banks do not support bank-level drive; feed the"
+                " epoch through apply_batch waves (one fused launch each)."
+            )
+        with self._lock:
+            self._check_poisoned()
+            try:
+                return self._drive_locked(tenant, batches)
+            except Exception as err:
+                self.stats["flush_errors"] += 1
+                if _bus.enabled():
+                    _bus.emit(
+                        "bank_drive",
+                        source=type(self._template).__name__,
+                        bank=self.name,
+                        tenant=str(tenant),
+                        steps=len(batches),
+                        error=type(err).__name__,
+                        occupancy=len(self._slots),
+                    )
+                raise
+
+    def _drive_locked(self, tenant: Hashable, batches: List[Tuple[Any, ...]]) -> int:
+        t_start = time.perf_counter()
+        if self.fault_injector is not None:
+            self.fault_injector()
+        self._ensure_python_init(batches[0])
+        flat = [jax.tree_util.tree_flatten((args, {})) for args in batches]
+        treedef = flat[0][1]
+        if any(td != treedef for _, td in flat[1:]):
+            raise ValueError(
+                "drive() batches disagree on update-argument structure; an"
+                " epoch scans ONE program over uniformly-shaped steps."
+            )
+        leaves_per_step = [leaves for leaves, _ in flat]
+        batched = _bucketing.batched_leaf_indices(leaves_per_step[0])
+        pads = self._unify_shapes(leaves_per_step, batched)
+        entry = self._drive_entry()
+        stats = _cache.instance_stats(self._template)
+        slot = self._admit_many([tenant])[0]
+        n_steps = len(batches)
+        rows = list(leaves_per_step)
+        step_pads = list(pads) if pads is not None else None
+        if step_pads is not None:
+            # pow2 ragged tail: pad the STEP axis with whole no-op steps —
+            # zero inputs and pad == bucket, so each pad step's correction
+            # subtracts its entire padded batch and the carry is untouched
+            bucket = int(np.shape(rows[0][batched[0]])[0])
+            n_padded = _bucketing.next_pow2(n_steps)
+            zero_row = [jnp.zeros_like(jnp.asarray(x)) for x in rows[0]]
+            for _ in range(n_padded - n_steps):
+                rows.append(list(zero_row))
+                step_pads.append(bucket)
+        stacked = self._stack(rows)
+        fn_args: Tuple[Any, ...] = (self._bank, jnp.asarray(slot, jnp.int32), tuple(stacked))
+        variant = "scan"
+        if step_pads is not None:
+            variant = "scan_pad"
+            fn_args += (jnp.asarray(step_pads, jnp.int32),)
+        fn_args += (treedef,)
+        tpl_saved = self._snapshot_templates()
+        try:
+            out = entry.invoke(variant, self._dispatch_cell(), stats, *fn_args)
+        except Exception:
+            self._rollback_after_failure()
+            raise
+        finally:
+            self._restore_templates(tpl_saved)
+        self._bank = out
+        self._counts[tenant] += n_steps
+        self._dirty[tenant] = None
+        self.stats["launches"] += 1
+        self.stats["requests"] += n_steps
+        self.stats["bank_drives"] += 1
+        self.stats["drive_steps"] += n_steps
+        if pads is not None:
+            self.stats["bucketed_requests"] += n_steps
+        if self._ckpt_every is not None:
+            self._flushes_since_ckpt += 1
+            if self._flushes_since_ckpt >= self._ckpt_every:
+                self._flushes_since_ckpt = 0
+                self._checkpoint_locked(list(self._dirty))
+        if self.state_fault_injector is not None:
+            self.state_fault_injector([tenant])
+        ms = (time.perf_counter() - t_start) * 1000.0
+        self._last_flush_ms = ms
+        self._flush_ms_ewma = (
+            ms if self._flush_ms_ewma is None else 0.8 * self._flush_ms_ewma + 0.2 * ms
+        )
+        if _bus.enabled():
+            _bus.emit(
+                "bank_drive",
+                source=type(self._template).__name__,
+                bank=self.name,
+                tenant=str(tenant),
+                steps=n_steps,
+                bucketed=pads is not None,
+                occupancy=len(self._slots),
+                ms=round(ms, 3),
+            )
+        return n_steps
 
     def _unify_shapes(
         self, leaves_per_req: List[List[Any]], batched: Tuple[int, ...]
@@ -1309,7 +1791,7 @@ class MetricBank:
             tuple((tuple(np.shape(x)), str(jnp.result_type(x))) for x in leaves)
             for leaves in leaves_per_req
         ]
-        if not _bucketing.bucketing_active(self._template, batched):
+        if not self._bucketing_active(batched):
             if any(s != sigs[0] for s in sigs[1:]):
                 raise ValueError(
                     "apply_batch requests disagree on input shapes/dtypes and"
@@ -1360,7 +1842,7 @@ class MetricBank:
                 out.append(jnp.stack([jnp.asarray(x) for x in col]))
         return out
 
-    def _dispatch_scatter(self, entry, stats, slots, leaves_per_req, pads, treedef):
+    def _dispatch_scatter(self, entry, stats, bank, slots, leaves_per_req, pads, treedef):
         n_req = len(slots)
         n_padded = _bucketing.next_pow2(n_req)
         rows = list(leaves_per_req)
@@ -1378,15 +1860,15 @@ class MetricBank:
                     req_pads.append(0)
         stacked = self._stack(rows)
         slots_arr = jnp.asarray(slot_ids, jnp.int32)
-        fn_args: Tuple[Any, ...] = (self._bank, slots_arr, tuple(stacked))
+        fn_args: Tuple[Any, ...] = (bank, slots_arr, tuple(stacked))
         variant = "scatter"
         if req_pads is not None:
             variant = "scatter_pad"
             fn_args += (jnp.asarray(req_pads, jnp.int32),)
         fn_args += (treedef,)
-        return entry.invoke(variant, self._template, stats, *fn_args)
+        return entry.invoke(variant, self._dispatch_cell(), stats, *fn_args)
 
-    def _dispatch_dense(self, entry, stats, slots, leaves_per_req, pads, treedef):
+    def _dispatch_dense(self, entry, stats, bank, slots, leaves_per_req, pads, treedef):
         n_leaves = len(leaves_per_req[0])
         cols: List[Array] = []
         slot_idx = jnp.asarray(list(slots), jnp.int32)
@@ -1408,7 +1890,7 @@ class MetricBank:
                 )
         active = np.zeros((self.capacity,), dtype=bool)
         active[list(slots)] = True
-        fn_args: Tuple[Any, ...] = (self._bank, jnp.asarray(active), tuple(cols))
+        fn_args: Tuple[Any, ...] = (bank, jnp.asarray(active), tuple(cols))
         variant = "dense"
         if pads is not None:
             # inactive slots' pad counts are irrelevant (their output is
@@ -1419,7 +1901,7 @@ class MetricBank:
             variant = "dense_pad"
             fn_args += (jnp.asarray(full_pads),)
         fn_args += (treedef,)
-        return entry.invoke(variant, self._template, stats, *fn_args)
+        return entry.invoke(variant, self._dispatch_cell(), stats, *fn_args)
 
     def _rollback_after_failure(self) -> None:
         """A trace-time failure leaves the bank intact; a runtime failure on
@@ -1461,17 +1943,45 @@ class MetricBank:
                 return self._durable_counts.get(tenant, 0)
             raise KeyError(f"unknown tenant {tenant!r} in bank {self.name!r}")
 
-    def compute(self, tenant: Hashable) -> Any:
-        """The tenant's metric value — ``compute()`` of a solo instance
-        holding the same state (device-resident, not yet fetched)."""
+    def _compute_state(self, state: Dict[str, Any]) -> Any:
         from metrics_tpu.utils.data import _squeeze_if_scalar
 
+        if self._is_collection:
+            values = self._template.compute_state(self._nest(state))
+            return {k: _squeeze_if_scalar(v) for k, v in values.items()}
+        return _squeeze_if_scalar(self._template.compute_state(state))
+
+    def compute(self, tenant: Hashable) -> Any:
+        """The tenant's metric value — ``compute()`` of a solo instance
+        holding the same state (device-resident, not yet fetched). On a
+        collection bank: the ``{member: value}`` dict a solo collection's
+        ``compute()`` returns."""
         state = self.tenant_state(tenant)
         with self._lock:
-            return _squeeze_if_scalar(self._template.compute_state(state))
+            return self._compute_state(state)
 
     def compute_many(self, tenants: Iterable[Hashable]) -> Dict[Hashable, Any]:
-        return {t: self.compute(t) for t in tenants}
+        """Per-tenant values. On a mesh-placed bank (tenant-sharded and/or
+        ``PartitionSpec``-annotated member states) every RESIDENT tenant's
+        row rides ONE jitted coalesced gather with replicated outputs —
+        per-tenant ``leaf[slot]`` slices of a sharded leaf would each
+        eager-dispatch a cross-shard gather, one per leaf per tenant."""
+        tenants = list(tenants)
+        if self._mesh is None:
+            return {t: self.compute(t) for t in tenants}
+        out: Dict[Hashable, Any] = {}
+        with self._lock:
+            resident = [t for t in tenants if t in self._slots]
+            if resident:
+                self._check_poisoned()
+                gathered = self._gathered_rows([self._slots[t] for t in resident])
+                for i, t in enumerate(resident):
+                    row = {n: col[i] for n, col in gathered.items()}
+                    out[t] = self._compute_state(row)
+        for t in tenants:
+            if t not in out:
+                out[t] = self.compute(t)  # spilled (host decode) or KeyError
+        return out
 
     def compute_async(self, tenants: Optional[Iterable[Hashable]] = None) -> Any:
         """Per-tenant values sliced off ONE coalesced device→host fetch: an
@@ -1489,9 +1999,18 @@ class MetricBank:
     def materialize(self, tenant: Hashable) -> Any:
         """A standalone clone of the template bound to the tenant's state —
         the escape hatch onto every existing per-instance surface (host
-        sync dance, checkpointing, reports, wrappers)."""
+        sync dance, checkpointing, reports, wrappers). Collection banks
+        return a bound :class:`~metrics_tpu.MetricCollection` clone."""
+        state = self.tenant_state(tenant)
+        count = self.update_count(tenant)
+        if self._is_collection:
+            mc = self._template.clone()
+            nested = self._nest(state)
+            for k, m in mc._modules.items():
+                m.bind_state(nested[k], update_count=count)
+            return mc
         metric = self._template.clone()
-        metric.bind_state(self.tenant_state(tenant), update_count=self.update_count(tenant))
+        metric.bind_state(state, update_count=count)
         return metric
 
     # ------------------------------------------------------------------
@@ -1529,7 +2048,7 @@ class MetricBank:
 
         with self._lock:
             self._bank = comm.sync_bank_states(
-                self._bank, self._template._reductions, axis_name, hierarchical=hierarchical
+                self._bank, self._reductions_ns, axis_name, hierarchical=hierarchical
             )
 
     # ------------------------------------------------------------------
@@ -1542,6 +2061,8 @@ class MetricBank:
             out: Dict[str, Any] = {
                 "template": type(self._template).__name__,
                 "capacity": self.capacity,
+                "tenant_shards": self._n_shards,
+                "shard_capacity": self.shard_capacity,
                 "occupancy": len(self._slots),
                 "spilled": len(self._spilled),
                 "store": type(self._store).__name__,
@@ -1553,23 +2074,33 @@ class MetricBank:
                 "checkpoint_lag": self.checkpoint_lag(),
                 **self.stats,
             }
+            if self._n_shards > 1:
+                occ = [0] * self._n_shards
+                for slot in self._slots.values():
+                    occ[self._slot_shard(slot)] += 1
+                out["shard_occupancy"] = occ
             requests = self.stats["requests"]
             out["launch_amortization"] = (
                 round(requests / self.stats["launches"], 3) if self.stats["launches"] else None
             )
-            health_leaf = self._bank.get(_health.HEALTH_STATE)
+            # collection banks hold one health leaf PER MEMBER ("k::health");
+            # the bank-wide totals sum them all
+            health_names = [
+                n for n in self._bank if n.split("::")[-1] == _health.HEALTH_STATE
+            ]
             occupied = sorted(self._slots.values()) if self._slots else []
             counts_dev = None
             spilled_health = self._spilled_health.copy()
-            if health_leaf is not None and occupied:
+            if health_names and occupied:
                 # the REDUCTION runs under the lock (async dispatch into a
                 # fresh buffer, so a later donating flush can't delete it),
                 # but the blocking device->host FETCH happens outside it: a
                 # scrape landing mid-flush waits on the pending launch, and
                 # holding the bank lock there would stall the serving data
                 # plane behind telemetry
-                counts_dev = health_leaf[jnp.asarray(occupied, jnp.int32)].sum(axis=0)
-        if health_leaf is not None:
+                idx = jnp.asarray(occupied, jnp.int32)
+                counts_dev = sum(self._bank[n][idx].sum(axis=0) for n in health_names)
+        if health_names:
             # resident slots + currently-spilled tenants: the rate's
             # numerator must not shrink when LRU churn moves counters to host
             counts = spilled_health

@@ -105,8 +105,14 @@ class RequestRouter:
     def _signature(self, args: Tuple[Any, ...]) -> Any:
         leaves, treedef = jax.tree_util.tree_flatten((args, {}))
         batched = _bucketing.batched_leaf_indices(leaves)
-        bucketing_on = _bucketing.bucketing_active(self.bank._template, batched)
-        sig: List[Any] = [treedef]
+        # the bank decides bucketing (a collection bank buckets only when
+        # EVERY member opted in — per-member probing here would split one
+        # fused wave into per-member groups and launch per member)
+        bucketing_on = self.bank._bucketing_active(batched)
+        # fold the bank's fused-signature token in (collection banks): one
+        # wave — one launch — flushes the whole collection, keyed by the
+        # COLLECTION fingerprint, never by any single member's
+        sig: List[Any] = [self.bank.signature_token(), treedef]
         for i, leaf in enumerate(leaves):
             shape = tuple(np.shape(leaf))
             if bucketing_on and i in batched:
@@ -140,7 +146,7 @@ class RequestRouter:
                 return "sig_other"
             label = f"sig{len(self._sig_labels)}"
             self._sig_labels[sig] = label
-            desc = ";".join(f"{dtype}{list(shape)}" for shape, dtype in sig[1:])
+            desc = ";".join(f"{dtype}{list(shape)}" for shape, dtype in sig[2:])
             self._sig_stats[label] = {
                 "signature": desc,
                 "submitted": 0,

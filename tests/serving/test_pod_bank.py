@@ -1,0 +1,418 @@
+"""Pod-scale serving banks (ISSUE 20): tenant-sharded ``MetricBank``,
+bank-level ``drive``, collection banks, and the Orbax spill tier.
+
+The acceptance bar: per-tenant results from a tenant-sharded bank —
+including a state-sharded member at mp>=2 — are bit-identical to solo
+instances through admit/evict/spill/re-admit/recover churn; ``drive``
+folds a whole epoch into one launch with the same bits as per-flush
+dispatch; a collection bank flushes every member in one launch per wave.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import (
+    Accuracy,
+    ConfusionMatrix,
+    MetricCollection,
+    StatScores,
+    engine,
+)
+from metrics_tpu.serving import DiskStore, MemoryStore, MetricBank, RequestRouter
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+NUM_CLASSES = 8
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    engine.clear_cache()
+    yield
+    engine.clear_cache()
+
+
+def _pod_mesh(hosts=4, mp=2):
+    devs = jax.devices()
+    assert len(devs) >= hosts * mp
+    return Mesh(np.array(devs[: hosts * mp]).reshape(hosts, mp), ("host", "mp"))
+
+
+def _req(seed, batch=8):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randint(0, NUM_CLASSES, size=batch).astype(np.int32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, size=batch).astype(np.int32)),
+    )
+
+
+def _prob_req(seed, batch=8, nan_rows=0):
+    rng = np.random.RandomState(seed)
+    preds = rng.rand(batch, NUM_CLASSES).astype(np.float32)
+    if nan_rows:
+        preds[:nan_rows, 0] = np.nan
+    target = rng.randint(0, NUM_CLASSES, size=batch).astype(np.int32)
+    return jnp.asarray(preds), jnp.asarray(target)
+
+
+def _assert_tenant_equals_solo(bank, tenant, solo, context=""):
+    np.testing.assert_array_equal(
+        np.asarray(bank.compute(tenant)),
+        np.asarray(solo.compute()),
+        err_msg=f"{tenant} {context}",
+    )
+
+
+# ---------------------------------------------------------------------------
+# tenant-sharded banks: layout, churn, bit-identity (the tentpole)
+# ---------------------------------------------------------------------------
+def test_tenant_sharded_bank_layout_and_summary():
+    mesh = _pod_mesh()
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES), capacity=2, mesh=mesh, tenant_axis="host"
+    )
+    # capacity is PER SHARD: the logical bank holds capacity * n_shards
+    assert bank.capacity == 8 and bank.shard_capacity == 2
+    for i in range(6):
+        bank.update(f"t{i}", *_req(i))
+    s = bank.summary()
+    assert s["tenant_shards"] == 4 and s["shard_capacity"] == 2
+    assert sum(s["shard_occupancy"]) == 6
+    # admission balances across shards: no shard overfills while one is empty
+    assert max(s["shard_occupancy"]) - min(s["shard_occupancy"]) <= 1
+
+
+def test_tenant_sharded_churn_bit_identical_with_state_sharded_member():
+    """8 tenants churn through a 4-shard bank of class-sharded StatScores
+    (mp=2) at one slot per shard: every tenant admits, evicts, spills,
+    re-admits — and stays bit-identical to its solo instance."""
+    mesh = _pod_mesh(hosts=4, mp=2)
+    template = StatScores(reduce="macro", num_classes=NUM_CLASSES, class_sharding="mp")
+    bank = MetricBank(template, capacity=1, mesh=mesh, tenant_axis="host")
+    tenants = [f"u{i}" for i in range(8)]
+    solos = {t: template.clone() for t in tenants}
+    for rnd in range(3):
+        for j, t in enumerate(tenants):
+            req = _req(1000 * rnd + j)
+            solos[t].update(*req)
+            bank.update(t, *req)
+    assert bank.stats["spills"] > 0  # churn actually exercised the spill path
+    for t in tenants:
+        _assert_tenant_equals_solo(bank, t, solos[t], "churn")
+        mat = bank.materialize(t)
+        assert str(mat.state_spec()["tp"].sharding) == str(P("mp"))
+        assert mat._update_count == 3
+
+
+@pytest.mark.parametrize("policy", ["skip", "mask"])
+def test_tenant_sharded_bank_screening_policies_bit_identical(policy):
+    """Health screening (quarantine counters included) rides the tenant
+    shards exactly like the accumulators."""
+    mesh = _pod_mesh()
+    template = Accuracy(num_classes=NUM_CLASSES, on_bad_input=policy)
+    bank = MetricBank(template, capacity=1, mesh=mesh, tenant_axis="host")
+    tenants = [f"u{i}" for i in range(6)]
+    solos = {t: template.clone() for t in tenants}
+    for step in range(4):
+        for j, t in enumerate(tenants):
+            req = _prob_req(100 * step + j, nan_rows=2 if step % 2 else 0)
+            solos[t].update(*req)
+            bank.update(t, *req)
+    for t in tenants:
+        _assert_tenant_equals_solo(bank, t, solos[t], f"policy={policy}")
+    summary = bank.summary()
+    if policy == "skip":
+        assert summary["updates_quarantined"] > 0
+    else:
+        assert summary["rows_masked"] > 0
+
+
+def test_tenant_sharded_scatter_launches_group_by_shard():
+    """A scatter flush touching k shards costs k launches (one vmapped
+    program per shard), not one per request."""
+    mesh = _pod_mesh()
+    bank = MetricBank(
+        Accuracy(num_classes=NUM_CLASSES),
+        capacity=4,
+        mesh=mesh,
+        tenant_axis="host",
+        dense_threshold=1.0,  # keep the scatter path
+    )
+    # 8 tenants spread across the 4 shards -> one batch touches all shards
+    bank.apply_batch([(f"t{i}", _req(i)) for i in range(8)])
+    assert bank.stats["scatter_launches"] == 4
+    assert bank.stats["requests"] == 8
+
+
+def test_diskstore_kill_recover_round_trip_under_tenant_sharding(tmp_path):
+    """The crash-recovery contract survives the pod layout: a tenant-sharded
+    bank's journaled sessions rebuild bit-identically into a FRESH
+    tenant-sharded bank (recover forwards mesh/tenant_axis)."""
+    mesh = _pod_mesh()
+    store = DiskStore(str(tmp_path / "store"))
+    template = StatScores(reduce="macro", num_classes=NUM_CLASSES, class_sharding="mp")
+    bank = MetricBank(
+        template, capacity=1, mesh=mesh, tenant_axis="host",
+        name="pod0", spill_store=store, checkpoint_every_n_flushes=1,
+    )
+    tenants = [f"u{i}" for i in range(6)]
+    solos = {t: template.clone() for t in tenants}
+    for step in range(3):
+        for j, t in enumerate(tenants):
+            req = _req(31 * step + j)
+            solos[t].update(*req)
+            bank.update(t, *req)
+    del bank  # the "kill": only the DiskStore survives
+    recovered = MetricBank.recover(
+        template.clone(), 1, DiskStore(str(tmp_path / "store")), name="pod0",
+        mesh=mesh, tenant_axis="host",
+    )
+    assert recovered.summary()["tenant_shards"] == 4
+    for t in tenants:
+        _assert_tenant_equals_solo(recovered, t, solos[t], "recover")
+    # and the recovered sessions keep accumulating bit-identically
+    for j, t in enumerate(tenants):
+        req = _req(9000 + j)
+        solos[t].update(*req)
+        recovered.update(t, *req)
+    for t in tenants:
+        _assert_tenant_equals_solo(recovered, t, solos[t], "post-recover")
+
+
+def test_compute_async_coalesces_sharded_fetch():
+    """compute_async on a bank with PartitionSpec-annotated member states
+    must coalesce the per-shard fetch into ONE gathered transfer (the
+    satellite fix): per-tenant per-shard device_gets would serialize on the
+    transfer lock."""
+    mesh = _pod_mesh()
+    template = StatScores(reduce="macro", num_classes=NUM_CLASSES, class_sharding="mp")
+    bank = MetricBank(template, capacity=2, mesh=mesh, tenant_axis="host")
+    tenants = [f"u{i}" for i in range(6)]
+    solos = {t: template.clone() for t in tenants}
+    for j, t in enumerate(tenants):
+        req = _req(j)
+        solos[t].update(*req)
+        bank.update(t, *req)
+    before = bank.stats["coalesced_gathers"]
+    result = bank.compute_async(tenants)
+    values = result.result()
+    assert bank.stats["coalesced_gathers"] == before + 1  # ONE gather, 6 tenants
+    for t in tenants:
+        np.testing.assert_array_equal(
+            np.asarray(values[t]), np.asarray(solos[t].compute()), err_msg=t
+        )
+
+
+# ---------------------------------------------------------------------------
+# bank-level drive: one launch per epoch
+# ---------------------------------------------------------------------------
+def test_bank_drive_matches_per_flush_bit_identically():
+    steps = [_req(i) for i in range(6)]
+    driven = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=2)
+    flushed = MetricBank(Accuracy(num_classes=NUM_CLASSES), capacity=2)
+    engine.drive_bank(driven, "e", steps)
+    assert driven.stats["launches"] == 1  # the whole epoch, one program
+    assert driven.stats["bank_drives"] == 1 and driven.stats["drive_steps"] == 6
+    for s in steps:
+        flushed.update("e", *s)
+    np.testing.assert_array_equal(
+        np.asarray(driven.compute("e")), np.asarray(flushed.compute("e"))
+    )
+    assert driven.update_count("e") == 6
+
+
+def test_bank_drive_ragged_pow2_tail_bit_identical():
+    """Ragged per-step batch sizes ride the pow2 zero-step correction —
+    bit-identical to per-flush bucketed dispatch, still one launch."""
+    template = Accuracy(num_classes=NUM_CLASSES, jit_bucket="pow2")
+    rng = np.random.RandomState(3)
+    steps = []
+    for n in (8, 6, 8, 5, 7):
+        steps.append(
+            (
+                jnp.asarray(rng.randint(0, NUM_CLASSES, size=n).astype(np.int32)),
+                jnp.asarray(rng.randint(0, NUM_CLASSES, size=n).astype(np.int32)),
+            )
+        )
+    driven = MetricBank(template, capacity=2)
+    solo = template.clone()
+    driven.drive("e", steps)
+    for s in steps:
+        solo.update(*s)
+    assert driven.stats["launches"] == 1
+    assert driven.stats["bucketed_requests"] == 5
+    np.testing.assert_array_equal(
+        np.asarray(driven.compute("e")), np.asarray(solo.compute())
+    )
+
+
+def test_bank_drive_screening_bit_identical_to_per_flush():
+    """Per-step health screening inside the scan carries the same bits as
+    the per-flush path — quarantine counters included."""
+    template = Accuracy(num_classes=NUM_CLASSES, on_bad_input="skip")
+    steps = [_prob_req(i, nan_rows=2 if i % 2 else 0) for i in range(5)]
+    driven = MetricBank(template, capacity=2)
+    solo = template.clone()
+    driven.drive("e", steps)
+    for s in steps:
+        solo.update(*s)
+    np.testing.assert_array_equal(
+        np.asarray(driven.compute("e")), np.asarray(solo.compute())
+    )
+    state = driven.tenant_state("e")
+    for name, value in solo._snapshot_state().items():
+        np.testing.assert_array_equal(
+            np.asarray(value), np.asarray(state[name]), err_msg=name
+        )
+
+
+def test_bank_drive_on_tenant_sharded_bank():
+    """drive lands in the tenant's OWNING shard slot and composes with
+    per-flush updates and the sharded fetch."""
+    mesh = _pod_mesh()
+    template = StatScores(reduce="macro", num_classes=NUM_CLASSES, class_sharding="mp")
+    bank = MetricBank(template, capacity=2, mesh=mesh, tenant_axis="host")
+    solo = template.clone()
+    steps = [_req(i) for i in range(5)]
+    bank.drive("e", steps)
+    for s in steps:
+        solo.update(*s)
+    extra = _req(99)
+    bank.update("e", *extra)  # per-flush update on the driven state
+    solo.update(*extra)
+    _assert_tenant_equals_solo(bank, "e", solo, "drive+flush")
+
+
+def test_bank_drive_rejects_collections():
+    bank = MetricBank(
+        MetricCollection(
+            {
+                "acc": Accuracy(num_classes=NUM_CLASSES),
+                "cm": ConfusionMatrix(num_classes=NUM_CLASSES),
+            }
+        ),
+        capacity=2,
+    )
+    with pytest.raises(MetricsUserError):
+        bank.drive("e", [_req(0)])
+
+
+# ---------------------------------------------------------------------------
+# collection banks: one launch per wave for a whole MetricCollection
+# ---------------------------------------------------------------------------
+def _collection():
+    return MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "cm": ConfusionMatrix(num_classes=NUM_CLASSES),
+        }
+    )
+
+
+def test_collection_bank_bit_identical_to_solo_collections():
+    bank = MetricBank(_collection(), capacity=2)
+    tenants = [f"u{i}" for i in range(4)]  # > capacity: spill churn too
+    solos = {t: _collection() for t in tenants}
+    for step in range(3):
+        for j, t in enumerate(tenants):
+            req = _req(17 * step + j)
+            solos[t].update(*req)
+            bank.update(t, *req)
+    for t in tenants:
+        got = bank.compute(t)
+        want = solos[t].compute()
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_array_equal(
+                np.asarray(got[k]), np.asarray(want[k]), err_msg=f"{t}:{k}"
+            )
+
+
+def test_router_folds_collection_signature_into_one_wave():
+    """The router groups by the fused COLLECTION fingerprint (satellite
+    fix): one wave flushes the whole collection bank in ONE launch, not one
+    per member."""
+    bank = MetricBank(_collection(), capacity=8)
+    assert bank.signature_token() is not None
+    router = RequestRouter(bank, max_requests=4, max_delay_s=None)
+    for i in range(4):
+        router.submit(f"t{i}", *_req(i))
+    assert router.pending == 0  # the 4th submit tripped the size flush
+    assert bank.stats["launches"] == 1 and bank.stats["requests"] == 4
+
+
+def test_collection_bank_on_tenant_sharded_mesh():
+    mesh = _pod_mesh()
+    bank = MetricBank(_collection(), capacity=1, mesh=mesh, tenant_axis="host")
+    tenants = [f"u{i}" for i in range(6)]  # > 4 slots: cross-shard churn
+    solos = {t: _collection() for t in tenants}
+    for step in range(2):
+        for j, t in enumerate(tenants):
+            req = _req(23 * step + j)
+            solos[t].update(*req)
+            bank.update(t, *req)
+    for t in tenants:
+        got, want = bank.compute(t), solos[t].compute()
+        for k in want:
+            np.testing.assert_array_equal(
+                np.asarray(got[k]), np.asarray(want[k]), err_msg=f"{t}:{k}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Orbax spill tier (optional dependency; skipped cleanly when absent)
+# ---------------------------------------------------------------------------
+orbax = pytest.importorskip("orbax.checkpoint")
+
+
+def _orbax_store(tmp_path):
+    from metrics_tpu.serving import OrbaxStore
+
+    return OrbaxStore(str(tmp_path / "orbax"))
+
+
+def test_orbax_store_blob_and_journal_round_trip(tmp_path):
+    store = _orbax_store(tmp_path)
+    assert not store.exists("k")
+    store.put("k", b"payload-1")
+    assert store.exists("k") and store.get("k") == b"payload-1"
+    store.put("k", b"payload-2")  # atomic overwrite via orbax commit
+    assert store.get("k") == b"payload-2"
+    store.delete("k")
+    assert not store.exists("k")
+    with pytest.raises(KeyError):
+        store.get("k")
+    # journal semantics delegate to the DiskStore record codec
+    store.append_journal("j", b"rec1")
+    store.append_journal_many("j", [b"rec2", b"rec3"])
+    assert store.journal_frames("j") == [b"rec1", b"rec2", b"rec3"]
+    frames, torn = store.journal_scan("j")
+    assert frames == [b"rec1", b"rec2", b"rec3"] and torn == 0
+    store.rewrite_journal("j", [b"only"])
+    assert store.journal_frames("j") == [b"only"]
+
+
+def test_orbax_store_bank_spill_and_recover(tmp_path):
+    template = Accuracy(num_classes=NUM_CLASSES)
+    store = _orbax_store(tmp_path)
+    bank = MetricBank(
+        template, capacity=1, name="ob0", spill_store=store,
+        checkpoint_every_n_flushes=1,
+    )
+    tenants = ["a", "b", "c"]
+    solos = {t: template.clone() for t in tenants}
+    for step in range(3):
+        for j, t in enumerate(tenants):
+            req = _prob_req(7 * step + j)
+            solos[t].update(*req)
+            bank.update(t, *req)  # capacity 1: constant spill churn
+    for t in tenants:
+        _assert_tenant_equals_solo(bank, t, solos[t], "orbax spill")
+    del bank
+    recovered = MetricBank.recover(
+        template.clone(), 1, _orbax_store(tmp_path), name="ob0"
+    )
+    for t in tenants:
+        _assert_tenant_equals_solo(recovered, t, solos[t], "orbax recover")
